@@ -1,0 +1,20 @@
+"""nnstreamer_tpu — TPU-native tensor stream pipeline framework.
+
+A ground-up re-design of the NNStreamer capability set (reference surveyed in
+SURVEY.md) for Cloud TPU: media↔tensor stream pipelines whose inference
+elements compile to XLA and run on TPU via JAX, with sharded multi-chip
+execution (jax.sharding over a Mesh), a gst-launch-style pipeline language,
+and a distributed tensor-query offload layer.
+"""
+
+__version__ = "0.1.0"
+
+from .tensor import (TensorBuffer, TensorFormat, TensorInfo, TensorsConfig,
+                     TensorsInfo, TensorType)
+from .pipeline import (Caps, Element, FlowReturn, Pipeline, parse_launch)
+
+__all__ = [
+    "TensorType", "TensorFormat", "TensorInfo", "TensorsInfo",
+    "TensorsConfig", "TensorBuffer", "Caps", "Element", "FlowReturn",
+    "Pipeline", "parse_launch", "__version__",
+]

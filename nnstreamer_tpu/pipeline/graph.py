@@ -1,0 +1,308 @@
+"""Pipeline container, sources, queues, tee: the scheduling substrate.
+
+Supplies the GStreamer-pipeline role (reference L0, SURVEY.md §1): element
+ownership, state changes, streaming threads, EOS aggregation, error posting.
+Scheduling model: each :class:`Source` owns one streaming thread; dataflow is
+synchronous downstream of it; :class:`Queue` introduces a thread boundary
+with a bounded buffer (backpressure), exactly the role GStreamer's ``queue``
+plays between decoupled segments.
+"""
+
+from __future__ import annotations
+
+import threading
+import queue as _queue
+from typing import Any, Callable, Dict, List, Optional
+
+from ..tensor.buffer import TensorBuffer
+from .caps import Caps
+from .element import (CapsEvent, Element, EOSEvent, Event, FlowReturn, Pad,
+                      PadDirection)
+
+
+class PipelineError(RuntimeError):
+    def __init__(self, element: Element, cause: BaseException):
+        super().__init__(f"element {element.name}: {cause!r}")
+        self.element = element
+        self.cause = cause
+
+
+class Pipeline:
+    """Owns elements, drives state, aggregates EOS/errors.
+
+    Usage::
+
+        p = Pipeline()
+        src, conv, filt, sink = p.add(VideoTestSrc(...), TensorConverter(),
+                                      TensorFilter(...), TensorSink())
+        p.link(src, conv, filt, sink)
+        p.run()          # play + wait EOS + stop
+    """
+
+    def __init__(self, name: str = "pipeline"):
+        self.name = name
+        self.elements: List[Element] = []
+        self._by_name: Dict[str, Element] = {}
+        self._error: Optional[PipelineError] = None
+        self._eos_sinks: set = set()
+        self._cv = threading.Condition()
+        self._playing = False
+
+    # -- construction --------------------------------------------------------
+    def add(self, *elements: Element):
+        for el in elements:
+            if el.name in self._by_name:
+                raise ValueError(f"duplicate element name {el.name!r}")
+            el.pipeline = self
+            self.elements.append(el)
+            self._by_name[el.name] = el
+        return elements if len(elements) > 1 else elements[0]
+
+    def get(self, name: str) -> Element:
+        return self._by_name[name]
+
+    def link(self, *elements: Element) -> None:
+        """Link a chain src→sink, creating request pads as needed."""
+        for a, b in zip(elements, elements[1:]):
+            src = self._pick_src_pad(a)
+            sink = self._pick_sink_pad(b)
+            src.link(sink)
+
+    @staticmethod
+    def _pick_src_pad(el: Element) -> Pad:
+        for p in el.src_pads:
+            if p.peer is None:
+                return p
+        return el.request_src_pad()
+
+    @staticmethod
+    def _pick_sink_pad(el: Element) -> Pad:
+        for p in el.sink_pads:
+            if p.peer is None:
+                return p
+        return el.request_sink_pad()
+
+    # -- state ---------------------------------------------------------------
+    @property
+    def sinks(self) -> List[Element]:
+        return [e for e in self.elements if not e.src_pads]
+
+    def play(self) -> None:
+        self._check_links()
+        for el in self.elements:
+            el.start()
+            el._started = True
+        self._playing = True
+        for el in self.elements:
+            if isinstance(el, Source):
+                el._spawn()
+
+    def _check_links(self) -> None:
+        for el in self.elements:
+            for p in el.sink_pads + el.src_pads:
+                if p.peer is None:
+                    raise RuntimeError(f"unlinked pad {p.full_name}")
+
+    def post_error(self, element: Element, exc: BaseException) -> None:
+        with self._cv:
+            if self._error is None:
+                self._error = PipelineError(element, exc)
+            self._cv.notify_all()
+
+    def _sink_eos(self, element: Element) -> None:
+        with self._cv:
+            self._eos_sinks.add(element.name)
+            self._cv.notify_all()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Wait until every sink reached EOS (or an error was posted)."""
+        sink_names = {e.name for e in self.sinks}
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: self._error is not None
+                or sink_names <= self._eos_sinks, timeout)
+        if self._error is not None:
+            raise self._error
+        if not ok:
+            raise TimeoutError(f"pipeline {self.name}: EOS not reached")
+
+    def stop(self) -> None:
+        self._playing = False
+        for el in self.elements:
+            if isinstance(el, Source):
+                el._halt()
+        for el in self.elements:
+            if el._started:
+                el.stop()
+                el._started = False
+
+    def run(self, timeout: Optional[float] = None) -> None:
+        self.play()
+        try:
+            self.wait(timeout)
+        finally:
+            self.stop()
+
+
+class Source(Element):
+    """Base push source: owns a streaming thread, emits caps then buffers
+    then EOS.  Subclasses implement :meth:`negotiate` (return fixed src
+    caps) and :meth:`create` (return next buffer or None for EOS) —
+    mirroring GstPushSrc's create vfunc (reference datareposrc/srciio use
+    this model)."""
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._thread: Optional[threading.Thread] = None
+        self._halted = threading.Event()
+
+    def negotiate(self) -> Caps:
+        raise NotImplementedError
+
+    def create(self) -> Optional[TensorBuffer]:
+        raise NotImplementedError
+
+    def _spawn(self) -> None:
+        self._halted.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name=f"src:{self.name}", daemon=True)
+        self._thread.start()
+
+    def _halt(self) -> None:
+        self._halted.set()
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        try:
+            caps = self.negotiate()
+            self.announce_src_caps(caps)
+            while not self._halted.is_set():
+                buf = self.create()
+                if buf is None:
+                    break
+                ret = self.push(buf)
+                if ret in (FlowReturn.ERROR, FlowReturn.EOS):
+                    break
+            self.src_pad.push_event(EOSEvent())
+        except Exception as exc:  # noqa: BLE001
+            if self.pipeline is not None:
+                self.pipeline.post_error(self, exc)
+            else:
+                raise
+
+
+class Queue(Element):
+    """Thread-boundary element with a bounded buffer.
+
+    The GStreamer ``queue`` role: decouples upstream/downstream into separate
+    streaming threads with backpressure.  Events travel through the queue
+    in-band to preserve ordering.
+    """
+
+    FACTORY = "queue"
+    PROPERTIES = {"max-size-buffers": (16, "queue capacity")}
+
+    def _make_pads(self):
+        self.add_sink_pad(Caps.any(), "sink")
+        self.add_src_pad(Caps.any(), "src")
+
+    def start(self):
+        self._q: _queue.Queue = _queue.Queue(maxsize=int(self.max_size_buffers))
+        self._worker = threading.Thread(target=self._drain,
+                                        name=f"queue:{self.name}", daemon=True)
+        self._stop = threading.Event()
+        self._worker.start()
+
+    def stop(self):
+        self._stop.set()
+        self._q.put(None)
+        self._worker.join(timeout=10)
+
+    def chain(self, pad, buf):
+        self._q.put(("buf", buf))
+        return FlowReturn.OK
+
+    def set_caps(self, pad, caps):
+        self._q.put(("event", CapsEvent(caps)))
+
+    def on_event(self, pad, event):
+        self._q.put(("event", event))
+
+    def _drain(self):
+        while not self._stop.is_set():
+            item = self._q.get()
+            if item is None:
+                return
+            kind, payload = item
+            try:
+                if kind == "buf":
+                    self.src_pad.push(payload)
+                else:
+                    self.src_pad.push_event(payload)
+                    if isinstance(payload, EOSEvent):
+                        return
+            except Exception as exc:  # noqa: BLE001
+                if self.pipeline is not None:
+                    self.pipeline.post_error(self, exc)
+                return
+
+
+class Tee(Element):
+    """1→N branch duplicator (GStreamer ``tee`` role).  Buffers are shared,
+    not copied — downstream must not mutate in place (same contract as
+    GstBuffer refcount sharing)."""
+
+    FACTORY = "tee"
+
+    def _make_pads(self):
+        self.add_sink_pad(Caps.any(), "sink")
+
+    def request_src_pad(self) -> Pad:
+        return self.add_src_pad(Caps.any())
+
+    def chain(self, pad, buf):
+        for sp in self.src_pads:
+            ret = sp.push(buf.copy())
+            if ret is FlowReturn.ERROR:
+                return ret
+        return FlowReturn.OK
+
+
+class AppSrc(Source):
+    """Programmatic source: caller supplies caps and feeds buffers
+    (GStreamer appsrc role; used heavily by tests the way the reference's
+    gtest pipelines use appsrc, tests/nnstreamer_plugins/unittest_plugins.cc).
+    """
+
+    FACTORY = "appsrc"
+    PROPERTIES = {"caps": (None, "fixed caps to announce")}
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._fifo: _queue.Queue = _queue.Queue()
+
+    def _make_pads(self):
+        self.add_src_pad(Caps.any(), "src")
+
+    def push_buffer(self, buf: TensorBuffer) -> None:
+        self._fifo.put(buf)
+
+    def end_of_stream(self) -> None:
+        self._fifo.put(None)
+
+    def negotiate(self) -> Caps:
+        caps = self.caps
+        if isinstance(caps, str):
+            caps = Caps.from_string(caps)
+        if caps is None:
+            raise ValueError("appsrc requires caps property")
+        return caps
+
+    def create(self) -> Optional[TensorBuffer]:
+        while not self._halted.is_set():
+            try:
+                return self._fifo.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+        return None

@@ -1,0 +1,107 @@
+"""gst-launch-style pipeline string parser.
+
+The reference's user API is gst-launch pipeline strings (every SSAT golden
+test builds one, e.g. tests/nnstreamer_filter_tensorflow2_lite/runTest.sh).
+This parser accepts the same shape of syntax::
+
+    parse_launch("videotestsrc num-buffers=10 ! "
+                 "video/x-raw,format=RGB,width=224,height=224 ! "
+                 "tensor_converter ! "
+                 "tensor_filter framework=xla model=mobilenet_v2 ! "
+                 "tensor_sink name=out")
+
+Supported: element factories with ``key=value`` properties, ``!`` links,
+caps-filter segments (a bare caps string between ``!``), ``name=`` element
+naming, branch references ``name. ! ...`` (tee/demux fan-out).
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import List, Optional
+
+from .caps import Caps
+from .element import CapsEvent, Element, FlowReturn
+from .graph import Pipeline
+from .registry import make_element, register_element
+
+
+@register_element
+class CapsFilter(Element):
+    """Pass-through element that constrains negotiation (GStreamer
+    ``capsfilter`` role — what a bare caps string in a launch line becomes).
+    """
+
+    FACTORY = "capsfilter"
+    PROPERTIES = {"caps": (None, "constraint caps")}
+
+    def _make_pads(self):
+        self.add_sink_pad(Caps.any(), "sink")
+        self.add_src_pad(Caps.any(), "src")
+
+    def set_caps(self, pad, caps):
+        constraint = self.caps
+        if isinstance(constraint, str):
+            constraint = Caps.from_string(constraint)
+        if constraint is not None:
+            inter = caps.intersect(constraint)
+            if inter.is_empty():
+                raise ValueError(
+                    f"capsfilter {self.name}: {caps} ∩ {constraint} is empty")
+        self.src_pad.push_event(CapsEvent(caps))
+
+    def chain(self, pad, buf):
+        return self.src_pad.push(buf)
+
+
+def _coerce(value: str):
+    try:
+        return int(value)
+    except ValueError:
+        pass
+    try:
+        return float(value)
+    except ValueError:
+        pass
+    if value.lower() in ("true", "false"):
+        return value.lower() == "true"
+    return value
+
+
+def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
+    """Build a :class:`Pipeline` from a launch string."""
+    p = pipeline or Pipeline()
+    # split into segments on '!'
+    segments = [s.strip() for s in description.split("!")]
+    prev: Optional[Element] = None
+    for seg in segments:
+        if not seg:
+            raise ValueError("empty segment in launch string")
+        tokens = shlex.split(seg)
+        head = tokens[0]
+        # branch reference: "name."
+        if head.endswith(".") and len(tokens) == 1:
+            prev = p.get(head[:-1])
+            continue
+        # caps filter: token containing '/' before any '=' (media type)
+        if "/" in head and "=" not in head.split(",")[0]:
+            el = CapsFilter(None, caps=Caps.from_string(seg.replace(" ", "")))
+            p.add(el)
+            if prev is not None:
+                p.link(prev, el)
+            prev = el
+            continue
+        props = {}
+        name = None
+        for tok in tokens[1:]:
+            k, _, v = tok.partition("=")
+            if k == "name":
+                name = v
+            else:
+                props[k] = _coerce(v)
+        el = make_element(head, name, **props)
+        p.add(el)
+        if prev is not None:
+            p.link(prev, el)
+        prev = el
+    return p

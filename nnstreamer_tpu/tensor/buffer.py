@@ -1,0 +1,94 @@
+"""Stream buffer: one timestamped frame of N tensors.
+
+TPU-native equivalent of a ``GstBuffer`` holding N ``GstMemory`` chunks of
+tensor data (reference hot-path handling: tensor_filter.c:631-894;
+gst_tensor_buffer_get_nth_memory nnstreamer_plugin_api_impl.c:1549).
+
+Design differences, deliberately TPU-first:
+
+- A tensor payload is an *array handle*, not raw bytes: either a numpy
+  ndarray (host) or a ``jax.Array`` (device/HBM).  Elements pass handles
+  zero-copy; nothing forces a device→host sync until a consumer calls
+  :meth:`TensorBuffer.np` — this is what keeps the filter hot loop async
+  (the reference's equivalent discipline is zero-copy mapping + at-most-one
+  output alloc, tensor_filter.c:671-779).
+- PTS/DTS/duration are integer nanoseconds like GStreamer clock-time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+#: Sentinel for "no timestamp" (GStreamer GST_CLOCK_TIME_NONE analogue).
+CLOCK_TIME_NONE: Optional[int] = None
+
+
+def is_device_array(x: Any) -> bool:
+    """True when ``x`` is a jax.Array (device-resident handle)."""
+    # Avoid importing jax at module import time for host-only tooling.
+    cls = x.__class__
+    return cls.__module__.startswith("jax") or hasattr(x, "addressable_shards")
+
+
+@dataclasses.dataclass
+class TensorBuffer:
+    """One frame of a tensor stream: N tensor payloads + timestamps.
+
+    ``tensors`` entries are numpy arrays or jax Arrays.  ``metas`` carries an
+    optional per-tensor :class:`~nnstreamer_tpu.tensor.meta.TensorMetaInfo`
+    for flexible/sparse streams (None for static streams).
+    """
+
+    tensors: List[Any] = dataclasses.field(default_factory=list)
+    pts: Optional[int] = CLOCK_TIME_NONE
+    duration: Optional[int] = CLOCK_TIME_NONE
+    metas: Optional[List[Any]] = None
+    #: free-form per-buffer metadata (e.g. query client id — reference
+    #: tensor_meta.c query_client_id_t).
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.tensors)
+
+    def np(self, i: int = 0) -> np.ndarray:
+        """Materialize tensor ``i`` on host (device sync happens HERE and
+        only here)."""
+        t = self.tensors[i]
+        if isinstance(t, np.ndarray):
+            return t
+        return np.asarray(t)
+
+    def nbytes(self) -> int:
+        total = 0
+        for t in self.tensors:
+            total += t.nbytes if hasattr(t, "nbytes") else len(t)
+        return total
+
+    def with_tensors(self, tensors: Sequence[Any]) -> "TensorBuffer":
+        """New buffer with same timestamps/extra but different payloads."""
+        return TensorBuffer(tensors=list(tensors), pts=self.pts,
+                            duration=self.duration, extra=dict(self.extra))
+
+    def copy(self) -> "TensorBuffer":
+        return TensorBuffer(tensors=list(self.tensors), pts=self.pts,
+                            duration=self.duration,
+                            metas=list(self.metas) if self.metas else None,
+                            extra=dict(self.extra))
+
+    def __repr__(self) -> str:
+        shapes = ",".join(str(getattr(t, "shape", "?")) for t in self.tensors)
+        return f"TensorBuffer(n={self.num_tensors} shapes=[{shapes}] pts={self.pts})"
+
+
+SECOND = 1_000_000_000
+
+
+def frames_to_ns(frame_index: int, rate_num: int, rate_den: int) -> int:
+    """PTS of frame N at a given framerate, in ns."""
+    if rate_num == 0:
+        return 0
+    return frame_index * SECOND * rate_den // rate_num

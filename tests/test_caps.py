@@ -1,0 +1,113 @@
+"""Caps algebra and tensor caps ↔ config conversion tests."""
+
+from fractions import Fraction
+
+import pytest
+
+from nnstreamer_tpu.pipeline.caps import (ANY_FRAMERATE, Caps, FractionRange,
+                                          IntRange, Structure)
+from nnstreamer_tpu.tensor import TensorFormat, TensorsConfig, TensorsInfo
+from nnstreamer_tpu.tensor.caps_util import (caps_from_config,
+                                             config_from_caps,
+                                             tensors_template_caps)
+
+
+class TestCapsParse:
+    def test_parse_video(self):
+        c = Caps.from_string("video/x-raw,format=RGB,width=640,height=480,"
+                             "framerate=30/1")
+        s = c.first()
+        assert s.name == "video/x-raw"
+        assert s.get("format") == "RGB"
+        assert s.get("width") == 640
+        assert s.get("framerate") == Fraction(30, 1)
+
+    def test_parse_list_and_range(self):
+        c = Caps.from_string("video/x-raw,format={RGB;BGRx},width=[1,4096]")
+        s = c.first()
+        assert s.get("format") == ["RGB", "BGRx"]
+        assert s.get("width") == IntRange(1, 4096)
+
+    def test_parse_alternatives(self):
+        c = Caps.from_string("video/x-raw,format=RGB;audio/x-raw")
+        assert len(c.structures) == 2
+
+    def test_any_empty(self):
+        assert Caps.from_string("ANY").is_any()
+        assert Caps.empty().is_empty()
+
+
+class TestCapsAlgebra:
+    def test_intersect_fixed(self):
+        a = Caps.from_string("video/x-raw,format=RGB,width=640")
+        b = Caps.from_string("video/x-raw,format=RGB")
+        i = a.intersect(b)
+        assert not i.is_empty()
+        assert i.first().get("width") == 640
+
+    def test_intersect_disjoint(self):
+        a = Caps.from_string("video/x-raw,format=RGB")
+        b = Caps.from_string("video/x-raw,format=GRAY8")
+        assert a.intersect(b).is_empty()
+
+    def test_intersect_list(self):
+        a = Caps.from_string("video/x-raw,format={RGB;BGRx}")
+        b = Caps.from_string("video/x-raw,format={BGRx;GRAY8}")
+        assert a.intersect(b).first().get("format") == "BGRx"
+
+    def test_intersect_range_value(self):
+        a = Caps.new("video/x-raw", width=IntRange(1, 4096))
+        b = Caps.new("video/x-raw", width=224)
+        assert a.intersect(b).first().get("width") == 224
+
+    def test_intersect_any(self):
+        a = Caps.any()
+        b = Caps.from_string("video/x-raw,format=RGB")
+        assert a.intersect(b) == b
+
+    def test_fraction_range(self):
+        fr = FractionRange(Fraction(0), Fraction(120))
+        assert fr.contains(Fraction(30, 1))
+        a = Caps.new("other/tensors", framerate=fr)
+        b = Caps.new("other/tensors", framerate=Fraction(30, 1))
+        assert a.intersect(b).first().get("framerate") == Fraction(30, 1)
+
+    def test_fixate(self):
+        c = Caps.from_string("video/x-raw,format={RGB;BGRx},width=[320,640]")
+        f = c.fixate()
+        assert f.is_fixed()
+        assert f.first().get("format") == "RGB"
+        assert f.first().get("width") == 320
+
+    def test_fixate_framerate_prefers_30(self):
+        c = Caps.new("other/tensors", framerate=ANY_FRAMERATE)
+        assert c.fixate().first().get("framerate") == Fraction(30, 1)
+
+
+class TestTensorCaps:
+    def test_config_round_trip(self):
+        cfg = TensorsConfig(info=TensorsInfo.from_strings("3:224:224", "uint8"),
+                            rate=Fraction(30, 1))
+        caps = caps_from_config(cfg)
+        assert caps.is_fixed()
+        back = config_from_caps(caps)
+        assert back.is_equal(cfg)
+
+    def test_flexible_caps(self):
+        cfg = TensorsConfig(format=TensorFormat.FLEXIBLE, rate=Fraction(0, 1))
+        caps = caps_from_config(cfg)
+        back = config_from_caps(caps)
+        assert back.format is TensorFormat.FLEXIBLE
+
+    def test_template_accepts_all_formats(self):
+        tmpl = tensors_template_caps()
+        for fmt in ("static", "flexible", "sparse"):
+            c = Caps.from_string(
+                f"other/tensors,format={fmt},framerate=30/1")
+            assert tmpl.can_intersect(c)
+
+    def test_num_tensors_mismatch_raises(self):
+        caps = Caps.from_string("other/tensors,format=static,num_tensors=2,"
+                                "dimensions=3:4,types=uint8,framerate=30/1")
+        with pytest.raises(ValueError):
+            config_from_caps(caps)

@@ -1,0 +1,179 @@
+"""L1 tensor type system tests.
+
+Models the reference's core-util coverage
+(tests/common/unittest_common.cc: dim string parse/print, info compare,
+size computation, meta header round-trip).
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.tensor import (
+    TENSOR_RANK_LIMIT, TensorBuffer, TensorFormat, TensorInfo, TensorMetaInfo,
+    TensorsConfig, TensorsInfo, TensorType, dim_element_count, dim_parse,
+    dim_to_string, dims_equal, unwrap_flex, wrap_flex,
+)
+from nnstreamer_tpu.tensor.types import dim_to_np_shape, np_shape_to_dim
+from nnstreamer_tpu.tensor import data as tdata
+from fractions import Fraction
+
+
+class TestTensorType:
+    def test_round_trip_names(self):
+        for t in TensorType:
+            assert TensorType.from_string(t.value) is t
+
+    def test_element_sizes(self):
+        assert TensorType.UINT8.element_size == 1
+        assert TensorType.INT16.element_size == 2
+        assert TensorType.FLOAT32.element_size == 4
+        assert TensorType.FLOAT64.element_size == 8
+        assert TensorType.BFLOAT16.element_size == 2
+        assert TensorType.FLOAT16.element_size == 2
+
+    def test_from_np(self):
+        assert TensorType.from_np(np.float32) is TensorType.FLOAT32
+        import ml_dtypes
+
+        assert TensorType.from_np(ml_dtypes.bfloat16) is TensorType.BFLOAT16
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            TensorType.from_string("quaternion")
+
+
+class TestDimensions:
+    def test_parse_print_round_trip(self):
+        assert dim_parse("3:224:224:1") == (3, 224, 224, 1)
+        assert dim_to_string((3, 224, 224, 1)) == "3:224:224"
+        assert dim_to_string((3, 224, 224, 1), trim=False) == "3:224:224:1"
+
+    def test_rank_limit(self):
+        assert dim_parse(":".join(["2"] * TENSOR_RANK_LIMIT)) == (2,) * 8
+        with pytest.raises(ValueError):
+            dim_parse(":".join(["2"] * (TENSOR_RANK_LIMIT + 1)))
+
+    def test_rank_lenient_equality(self):
+        assert dims_equal((3, 224, 224), (3, 224, 224, 1, 1))
+        assert not dims_equal((3, 224, 224), (3, 224, 225))
+
+    def test_element_count(self):
+        assert dim_element_count((3, 224, 224)) == 3 * 224 * 224
+        with pytest.raises(ValueError):
+            dim_element_count((3, 0, 224))
+
+    def test_np_shape_conversion(self):
+        assert dim_to_np_shape((3, 640, 480)) == (480, 640, 3)
+        assert np_shape_to_dim((480, 640, 3)) == (3, 640, 480)
+
+
+class TestTensorInfo:
+    def test_size(self):
+        info = TensorInfo(TensorType.UINT8, (3, 224, 224))
+        assert info.size == 3 * 224 * 224
+        info = TensorInfo(TensorType.FLOAT32, (10,))
+        assert info.size == 40
+
+    def test_equal_ignores_names(self):
+        a = TensorInfo(TensorType.FLOAT32, (3, 4), name="a")
+        b = TensorInfo(TensorType.FLOAT32, (3, 4, 1), name="b")
+        assert a.is_equal(b)
+
+    def test_from_np(self):
+        arr = np.zeros((480, 640, 3), dtype=np.uint8)
+        info = TensorInfo.from_np(arr)
+        assert info.dims == (3, 640, 480)
+        assert info.dtype is TensorType.UINT8
+
+
+class TestTensorsInfo:
+    def test_from_strings(self):
+        ti = TensorsInfo.from_strings("3:224:224,1000", "uint8,float32")
+        assert ti.num_tensors == 2
+        assert ti[0].dims == (3, 224, 224)
+        assert ti[1].dtype is TensorType.FLOAT32
+        assert ti.dims_string() == "3:224:224,1000"
+        assert ti.types_string() == "uint8,float32"
+
+    def test_dot_separator(self):
+        ti = TensorsInfo.from_strings("3:4.5:6", "uint8.int16")
+        assert ti.num_tensors == 2
+
+    def test_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            TensorsInfo.from_strings("3:4,5:6", "uint8")
+
+    def test_total_size(self):
+        ti = TensorsInfo.from_strings("4,4", "float32,uint8")
+        assert ti.total_size() == 16 + 4
+
+
+class TestTensorsConfig:
+    def test_validate(self):
+        cfg = TensorsConfig()
+        assert not cfg.is_valid()
+        cfg = TensorsConfig(info=TensorsInfo.from_strings("3:4", "uint8"),
+                            rate=Fraction(30, 1))
+        assert cfg.is_valid()
+
+    def test_flexible_valid_without_info(self):
+        cfg = TensorsConfig(format=TensorFormat.FLEXIBLE, rate=Fraction(0, 1))
+        assert cfg.is_valid()
+
+    def test_equal(self):
+        a = TensorsConfig(info=TensorsInfo.from_strings("3:4", "uint8"),
+                          rate=Fraction(30, 1))
+        b = TensorsConfig(info=TensorsInfo.from_strings("3:4:1", "uint8"),
+                          rate=Fraction(30, 1))
+        assert a.is_equal(b)
+        b.rate = Fraction(15, 1)
+        assert not a.is_equal(b)
+
+
+class TestFlexMeta:
+    def test_header_round_trip(self):
+        meta = TensorMetaInfo(TensorType.FLOAT32, (3, 224, 224))
+        data = meta.to_bytes()
+        assert len(data) == 128
+        parsed = TensorMetaInfo.from_bytes(data)
+        assert parsed.dtype is TensorType.FLOAT32
+        assert parsed.dims == (3, 224, 224)
+        assert parsed.format is TensorFormat.FLEXIBLE
+
+    def test_wrap_unwrap(self):
+        arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        payload = wrap_flex(arr)
+        meta, out = unwrap_flex(payload)
+        np.testing.assert_array_equal(out, arr)
+        assert meta.dims == (4, 3, 2)
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            TensorMetaInfo.from_bytes(b"\x00" * 128)
+
+
+class TestTensorBuffer:
+    def test_basic(self):
+        buf = TensorBuffer(tensors=[np.zeros((2, 2), np.float32)], pts=100)
+        assert buf.num_tensors == 1
+        assert buf.nbytes() == 16
+        buf2 = buf.with_tensors([np.ones(3, np.uint8)])
+        assert buf2.pts == 100
+        assert buf2.np(0).sum() == 3
+
+
+class TestTypedData:
+    def test_average_std(self):
+        arr = np.array([1, 2, 3, 4], dtype=np.uint8)
+        assert tdata.average(arr) == 2.5
+        assert tdata.std(arr) == pytest.approx(np.std([1, 2, 3, 4]))
+
+    def test_per_channel(self):
+        arr = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+        avg = tdata.average_per_channel(arr)
+        assert avg.shape == (3,)
+        np.testing.assert_allclose(avg, [4.5, 5.5, 6.5])
+
+    def test_typecast(self):
+        v = tdata.typecast(3.7, TensorType.UINT8)
+        assert v == 3
