@@ -1,0 +1,192 @@
+"""bounding_boxes decoder: detection tensors → RGBA overlay video.
+
+Parity with ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c (schemes
+at :148-191): decodes raw detector outputs into boxes (box-prior decode for
+mobilenet-ssd, grid decode for yolov5), thresholds, NMS, and draws
+rectangles into a transparent RGBA canvas sized by option4.
+
+Options (mirroring the reference's option1..5):
+  1: scheme — ``mobilenet-ssd`` | ``yolov5`` | ``raw`` (pre-decoded
+     [ymin,xmin,ymax,xmax] normalized boxes)
+  2: label file path
+  3: box-priors file (mobilenet-ssd; 4 lines × N anchors, as the reference's
+     box_priors.txt)
+  4: output video size ``W:H``
+  5: model input size ``W:H``
+
+Divergence noted: the reference composites label-text sprites; here boxes
+are drawn as 2px outlines and the structured detections ride in
+``extra["objects"]`` (class/score/box) for programmatic consumers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from fractions import Fraction
+from typing import List, Optional
+
+import numpy as np
+
+from ..pipeline.caps import Caps, Structure
+from ..tensor.buffer import TensorBuffer
+from ..tensor.info import TensorsConfig
+from . import Decoder, register_decoder
+from .imagelabel import load_labels
+
+DEFAULT_THRESHOLD = 0.5
+NMS_IOU = 0.5
+_PALETTE = np.array([
+    [255, 0, 0, 255], [0, 255, 0, 255], [0, 0, 255, 255],
+    [255, 255, 0, 255], [0, 255, 255, 255], [255, 0, 255, 255],
+], dtype=np.uint8)
+
+
+@dataclasses.dataclass
+class DetectedObject:
+    class_id: int
+    score: float
+    # normalized [0,1] corners
+    ymin: float
+    xmin: float
+    ymax: float
+    xmax: float
+    label: Optional[str] = None
+
+
+def nms(objs: List[DetectedObject], iou_thresh: float = NMS_IOU
+        ) -> List[DetectedObject]:
+    """Greedy per-class NMS (reference boundingbox NMS)."""
+    objs = sorted(objs, key=lambda o: -o.score)
+    keep: List[DetectedObject] = []
+    for o in objs:
+        ok = True
+        for k in keep:
+            if k.class_id != o.class_id:
+                continue
+            iy = max(0.0, min(o.ymax, k.ymax) - max(o.ymin, k.ymin))
+            ix = max(0.0, min(o.xmax, k.xmax) - max(o.xmin, k.xmin))
+            inter = iy * ix
+            union = ((o.ymax - o.ymin) * (o.xmax - o.xmin)
+                     + (k.ymax - k.ymin) * (k.xmax - k.xmin) - inter)
+            if union > 0 and inter / union > iou_thresh:
+                ok = False
+                break
+        if ok:
+            keep.append(o)
+    return keep
+
+
+@register_decoder
+class BoundingBoxDecoder(Decoder):
+    MODE = "bounding_boxes"
+
+    def __init__(self) -> None:
+        self.scheme = "mobilenet-ssd"
+        self.labels: Optional[List[str]] = None
+        self.priors: Optional[np.ndarray] = None  # (4, N)
+        self.out_w, self.out_h = 640, 480
+        self.in_w, self.in_h = 300, 300
+        self.threshold = DEFAULT_THRESHOLD
+
+    def set_option(self, index: int, value: str) -> None:
+        if index == 1:
+            self.scheme = value
+        elif index == 2 and value:
+            self.labels = load_labels(value)
+        elif index == 3 and value:
+            with open(value, encoding="utf-8") as f:
+                rows = [np.array([float(x) for x in line.split()])
+                        for line in f if line.strip()]
+            self.priors = np.stack(rows[:4], axis=0)
+        elif index == 4 and value:
+            w, _, h = value.partition(":")
+            self.out_w, self.out_h = int(w), int(h)
+        elif index == 5 and value:
+            w, _, h = value.partition(":")
+            self.in_w, self.in_h = int(w), int(h)
+        elif index == 6 and value:
+            self.threshold = float(value)
+
+    def get_out_caps(self, config: TensorsConfig) -> Caps:
+        return Caps([Structure("video/x-raw", {
+            "format": "RGBA", "width": self.out_w, "height": self.out_h,
+            "framerate": config.rate or Fraction(0, 1)})])
+
+    # -- per-scheme decode ---------------------------------------------------
+    def _decode_mobilenet_ssd(self, buf: TensorBuffer) -> List[DetectedObject]:
+        boxes = buf.np(0)    # (N, 4)
+        scores = buf.np(1)   # (N, C)
+        if self.priors is not None:
+            cy = boxes[:, 0] / 10.0 * self.priors[2] + self.priors[0]
+            cx = boxes[:, 1] / 10.0 * self.priors[3] + self.priors[1]
+            h = np.exp(boxes[:, 2] / 5.0) * self.priors[2]
+            w = np.exp(boxes[:, 3] / 5.0) * self.priors[3]
+            ymin, xmin = cy - h / 2, cx - w / 2
+            ymax, xmax = cy + h / 2, cx + w / 2
+        else:
+            ymin, xmin, ymax, xmax = boxes.T
+        cls = scores[:, 1:].argmax(axis=1) + 1  # skip background class 0
+        sc = scores[np.arange(len(cls)), cls]
+        sel = sc >= self.threshold
+        return [DetectedObject(int(c), float(s), float(y0), float(x0),
+                               float(y1), float(x1))
+                for c, s, y0, x0, y1, x1 in zip(
+                    cls[sel], sc[sel], ymin[sel], xmin[sel],
+                    ymax[sel], xmax[sel])]
+
+    def _decode_yolov5(self, buf: TensorBuffer) -> List[DetectedObject]:
+        pred = buf.np(0)  # (N, 5+C): cx,cy,w,h,obj,cls...
+        obj = pred[:, 4]
+        cls_scores = pred[:, 5:] * obj[:, None]
+        cls = cls_scores.argmax(axis=1)
+        sc = cls_scores[np.arange(len(cls)), cls]
+        sel = sc >= self.threshold
+        cx, cy = pred[sel, 0] / self.in_w, pred[sel, 1] / self.in_h
+        w, h = pred[sel, 2] / self.in_w, pred[sel, 3] / self.in_h
+        return [DetectedObject(int(c), float(s), float(y - hh / 2),
+                               float(x - ww / 2), float(y + hh / 2),
+                               float(x + ww / 2))
+                for c, s, x, y, ww, hh in zip(cls[sel], sc[sel], cx, cy, w, h)]
+
+    def _decode_raw(self, buf: TensorBuffer) -> List[DetectedObject]:
+        boxes = buf.np(0)    # (N, 6): class, score, ymin,xmin,ymax,xmax
+        out = []
+        for row in boxes:
+            if row[1] >= self.threshold:
+                out.append(DetectedObject(int(row[0]), float(row[1]),
+                                          *map(float, row[2:6])))
+        return out
+
+    def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
+        if self.scheme == "mobilenet-ssd":
+            objs = self._decode_mobilenet_ssd(buf)
+        elif self.scheme == "yolov5":
+            objs = self._decode_yolov5(buf)
+        elif self.scheme == "raw":
+            objs = self._decode_raw(buf)
+        else:
+            raise ValueError(f"unknown bounding-box scheme {self.scheme!r}")
+        objs = nms(objs)
+        if self.labels:
+            for o in objs:
+                if 0 <= o.class_id < len(self.labels):
+                    o.label = self.labels[o.class_id]
+        canvas = np.zeros((self.out_h, self.out_w, 4), dtype=np.uint8)
+        for o in objs:
+            self._draw_box(canvas, o)
+        out = buf.with_tensors([canvas])
+        out.extra["objects"] = objs
+        return out
+
+    def _draw_box(self, canvas: np.ndarray, o: DetectedObject) -> None:
+        h, w = canvas.shape[:2]
+        y0 = int(np.clip(o.ymin * h, 0, h - 1))
+        y1 = int(np.clip(o.ymax * h, 0, h - 1))
+        x0 = int(np.clip(o.xmin * w, 0, w - 1))
+        x1 = int(np.clip(o.xmax * w, 0, w - 1))
+        color = _PALETTE[o.class_id % len(_PALETTE)]
+        t = 2  # outline thickness
+        canvas[y0:y0 + t, x0:x1 + 1] = color
+        canvas[max(y1 - t + 1, 0):y1 + 1, x0:x1 + 1] = color
+        canvas[y0:y1 + 1, x0:x0 + t] = color
+        canvas[y0:y1 + 1, max(x1 - t + 1, 0):x1 + 1] = color

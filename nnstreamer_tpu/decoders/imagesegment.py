@@ -1,0 +1,58 @@
+"""image_segment decoder: per-pixel class scores → colorized RGBA video.
+
+Parity with ext/nnstreamer/tensor_decoder/tensordec-imagesegment.c
+(tflite-deeplab mode: argmax over the class axis, per-class color map).
+Option1 selects the scheme (``tflite-deeplab`` | ``snpe-deeplab`` | ``argmax``
+for pre-argmaxed int maps).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+import numpy as np
+
+from ..pipeline.caps import Caps, Structure
+from ..tensor.buffer import TensorBuffer
+from ..tensor.info import TensorsConfig
+from . import Decoder, register_decoder
+
+# 21-class VOC-ish color map, RGBA
+_COLORS = np.array(
+    [[0, 0, 0, 0]] + [
+        [(i * 67) % 256, (i * 113) % 256, (i * 197) % 256, 160]
+        for i in range(1, 64)],
+    dtype=np.uint8)
+
+
+@register_decoder
+class ImageSegmentDecoder(Decoder):
+    MODE = "image_segment"
+
+    def __init__(self) -> None:
+        self.scheme = "tflite-deeplab"
+
+    def set_option(self, index: int, value: str) -> None:
+        if index == 1 and value:
+            self.scheme = value
+
+    def get_out_caps(self, config: TensorsConfig) -> Caps:
+        dims = config.info[0].dims
+        if self.scheme == "argmax":
+            w, h = (tuple(dims) + (1, 1))[:2]
+        else:
+            _, w, h = (tuple(dims) + (1, 1, 1))[:3]
+        return Caps([Structure("video/x-raw", {
+            "format": "RGBA", "width": w, "height": h,
+            "framerate": config.rate or Fraction(0, 1)})])
+
+    def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
+        arr = buf.np(0)
+        if self.scheme == "argmax":
+            classes = arr.astype(np.int32)
+        else:
+            classes = arr.argmax(axis=-1).astype(np.int32)  # (H, W)
+        rgba = _COLORS[classes % len(_COLORS)]
+        out = buf.with_tensors([rgba])
+        out.extra["class_map"] = classes
+        return out

@@ -1,0 +1,43 @@
+"""Standard element library.  Importing this package registers every element
+factory (the reference's plugin registerer role,
+gst/nnstreamer/registerer/nnstreamer.c:91-133).
+"""
+
+from . import aggregator  # noqa: F401
+from . import converter  # noqa: F401
+from . import decoder_elem  # noqa: F401
+from . import filter_elem  # noqa: F401
+from . import merge_split  # noqa: F401
+from . import misc  # noqa: F401
+from . import mux  # noqa: F401
+from . import rate  # noqa: F401
+from . import repo  # noqa: F401
+from . import sink  # noqa: F401
+from . import sparse  # noqa: F401
+from . import src  # noqa: F401
+from . import tensor_if  # noqa: F401
+from . import transform  # noqa: F401
+
+from .aggregator import TensorAggregator
+from .converter import TensorConverter
+from .decoder_elem import TensorDecoder
+from .filter_elem import TensorFilter
+from .merge_split import TensorMerge, TensorSplit
+from .misc import DataRepoSrc, Join, TensorCrop, TensorDebug
+from .mux import TensorDemux, TensorMux
+from .rate import TensorRate
+from .repo import TensorRepoSink, TensorRepoSrc
+from .sink import FakeSink, FileSink, TensorSink
+from .sparse import TensorSparseDec, TensorSparseEnc
+from .src import AudioTestSrc, VideoTestSrc
+from .tensor_if import TensorIf, register_if_custom
+from .transform import TensorTransform
+
+__all__ = [
+    "TensorConverter", "TensorDecoder", "TensorFilter", "TensorSink",
+    "FakeSink", "FileSink", "VideoTestSrc", "AudioTestSrc",
+    "TensorTransform", "TensorMux", "TensorDemux", "TensorMerge",
+    "TensorSplit", "TensorAggregator", "TensorIf", "register_if_custom",
+    "TensorRate", "TensorRepoSink", "TensorRepoSrc", "TensorSparseEnc",
+    "TensorSparseDec", "TensorDebug", "Join", "TensorCrop", "DataRepoSrc",
+]

@@ -1,0 +1,73 @@
+"""tensor_decoder element: other/tensors → media via a decoder subplugin.
+
+Parity with gst/nnstreamer/elements/gsttensor_decoder.c (mode + option1..9
+properties select and configure the subplugin; custom callback mode via
+``mode=custom-code`` like the reference tensor_decoder_custom.h).
+"""
+
+from __future__ import annotations
+
+from ..decoders import find_decoder
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, FlowReturn
+from ..pipeline.registry import register_element
+from ..tensor.caps_util import config_from_caps, tensors_template_caps
+
+
+@register_element
+class TensorDecoder(Element):
+    FACTORY = "tensor_decoder"
+    PROPERTIES = dict(
+        {"mode": (None, "decoder mode name")},
+        **{f"option{i}": (None, f"decoder option {i}") for i in range(1, 10)})
+
+    #: custom callbacks registered via register_decoder_custom (reference
+    #: tensor_decoder_custom.h)
+    _CUSTOM = {}
+
+    @classmethod
+    def register_custom(cls, name, fn):
+        cls._CUSTOM[name] = fn
+
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+        self.add_src_pad(Caps.any(), "src")
+
+    def start(self):
+        mode = str(self.mode or "")
+        if not mode:
+            raise ValueError(f"{self.name}: mode property required")
+        if mode == "custom-code":
+            fn = self._CUSTOM.get(str(self.option1))
+            if fn is None:
+                raise ValueError(
+                    f"{self.name}: custom decoder {self.option1!r} "
+                    "not registered")
+            self._decoder = None
+            self._custom_fn = fn
+            return
+        self._custom_fn = None
+        self._decoder = find_decoder(mode)()
+        for i in range(1, 10):
+            val = getattr(self, f"option{i}")
+            if val is not None:
+                self._decoder.set_option(i, str(val))
+
+    def set_caps(self, pad, caps):
+        self._config = config_from_caps(caps)
+        if self._decoder is not None:
+            self.announce_src_caps(self._decoder.get_out_caps(self._config))
+        else:
+            from ..pipeline.caps import Structure
+            from fractions import Fraction
+
+            self.announce_src_caps(Caps([Structure(
+                "application/octet-stream",
+                {"framerate": self._config.rate or Fraction(0, 1)})]))
+
+    def chain(self, pad, buf):
+        if self._custom_fn is not None:
+            out = self._custom_fn(buf, self._config)
+        else:
+            out = self._decoder.decode(buf, self._config)
+        return self.push(out)

@@ -1,0 +1,166 @@
+"""tensor_filter: THE inference element.
+
+Parity with gst/nnstreamer/tensor_filter/tensor_filter.c (+ the shared
+property/lifecycle logic of tensor_filter_common.c):
+
+- properties: framework (incl. ``auto``), model, forced input/output
+  dims/types, accelerator string, custom properties, input-combination /
+  output-combination, latency/throughput readouts, shared key, is-updatable
+  (reference property table tensor_filter_common.c)
+- start() opens the backend (reference :1492-1504 → open_fw :2420)
+- caps: sink accepts static tensors; src caps derived from model output info
+  (reference transform_caps/configure :902-1280), with per-buffer
+  validation in the hot loop (:557-626)
+- hot loop (reference transform :631-894): validate → input-combination →
+  invoke → output-combination/wrap → push, keeping device arrays unsynced
+- model-update custom event (``tensor_filter_update_model``) triggers
+  backend reload (reference :1413-1446)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..filter.framework import (Accelerator, FilterError, FilterProperties,
+                                close_backend, open_backend)
+from ..pipeline.caps import Caps
+from ..pipeline.element import CustomEvent, Element, FlowReturn
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import caps_from_config, static_tensors_caps
+from ..tensor.info import TensorsConfig, TensorsInfo
+from ..tensor.types import np_shape_to_dim
+
+
+def _parse_combination(s) -> Optional[List[int]]:
+    if s in (None, ""):
+        return None
+    return [int(x) for x in str(s).split(",")]
+
+
+@register_element
+class TensorFilter(Element):
+    FACTORY = "tensor_filter"
+    PROPERTIES = {
+        "framework": ("auto", "backend name or auto"),
+        "model": (None, "model name/path/object"),
+        "input-dim": (None, "forced input dims"),
+        "input-type": (None, "forced input types"),
+        "output-dim": (None, "forced output dims"),
+        "output-type": (None, "forced output types"),
+        "accelerator": (None, "e.g. true:tpu"),
+        "custom": (None, "key:value,... custom properties"),
+        "input-combination": (None, "indices of input tensors to feed"),
+        "output-combination": (None, "i0,i1/o0,o1 passthrough+output mix"),
+        "shared-tensor-filter-key": (None, "share backend across instances"),
+        "is-updatable": (False, "allow model-update events"),
+        "latency-report": (False, "report invoke latency"),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(static_tensors_caps(), "sink")
+        self.add_src_pad(static_tensors_caps(), "src")
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        in_info = out_info = None
+        if self.input_dim and self.input_type:
+            in_info = TensorsInfo.from_strings(str(self.input_dim),
+                                               str(self.input_type))
+        if self.output_dim and self.output_type:
+            out_info = TensorsInfo.from_strings(str(self.output_dim),
+                                                str(self.output_type))
+        props = FilterProperties(
+            framework=str(self.framework or "auto"), model=self.model,
+            input_info=in_info, output_info=out_info,
+            accelerators=Accelerator.parse(self.accelerator),
+            custom_properties=FilterProperties.parse_custom(self.custom),
+            shared_key=self.shared_tensor_filter_key)
+        self.fw = open_backend(props)
+        self._props = props
+        self.stats = getattr(self.fw, "stats", None)
+        self._in_comb = _parse_combination(self.input_combination)
+        self._out_comb = None
+        if self.output_combination not in (None, ""):
+            ins, _, outs = str(self.output_combination).partition("/")
+            self._out_comb = (_parse_combination(ins) or [],
+                              _parse_combination(outs) or [])
+
+    def stop(self):
+        close_backend(getattr(self, "fw", None), self._props)
+        self.fw = None
+
+    # -- negotiation ---------------------------------------------------------
+    def set_caps(self, pad, caps):
+        from ..tensor.caps_util import config_from_caps
+
+        in_cfg = config_from_caps(caps)
+        model_in, model_out = self.fw.get_model_info()
+        expect = model_in
+        if self._in_comb is not None:
+            selected = in_cfg.info
+            expect_sel = TensorsInfo([in_cfg.info[i] for i in self._in_comb])
+            if not expect_sel.is_equal(model_in):
+                raise ValueError(
+                    f"{self.name}: input-combination {self._in_comb} gives "
+                    f"{expect_sel}, model wants {model_in}")
+        elif not in_cfg.info.is_equal(expect):
+            # try dynamic renegotiation (reference SET_INPUT_INFO path)
+            try:
+                _, model_out = self.fw.set_input_info(in_cfg.info)
+            except FilterError:
+                raise ValueError(
+                    f"{self.name}: incoming {in_cfg.info} != model "
+                    f"input {expect}") from None
+        self._in_config = in_cfg
+        out_infos = model_out
+        if self._out_comb is not None:
+            ins, outs = self._out_comb
+            combined = [in_cfg.info[i] for i in ins] + \
+                       [model_out[i] for i in outs]
+            out_infos = TensorsInfo(combined)
+        self._out_config = TensorsConfig(info=out_infos, rate=in_cfg.rate)
+        self.announce_src_caps(caps_from_config(self._out_config))
+
+    # -- hot loop ------------------------------------------------------------
+    def chain(self, pad, buf: TensorBuffer) -> FlowReturn:
+        fw = self.fw
+        if fw is None or not fw.opened:
+            raise RuntimeError(f"{self.name}: not started")
+        # per-buffer validation against negotiated meta (reference :557-626)
+        in_info = self._in_config.info
+        if buf.num_tensors != in_info.num_tensors:
+            raise ValueError(
+                f"{self.name}: buffer has {buf.num_tensors} tensors, "
+                f"negotiated {in_info.num_tensors}")
+        tensors = buf.tensors
+        if self._in_comb is not None:
+            tensors = [tensors[i] for i in self._in_comb]
+        outs = fw.invoke(list(tensors))
+        out_tensors = outs
+        if self._out_comb is not None:
+            ins, sel = self._out_comb
+            out_tensors = [buf.tensors[i] for i in ins] + \
+                          [outs[i] for i in sel]
+        return self.push(buf.with_tensors(out_tensors))
+
+    # -- events --------------------------------------------------------------
+    def on_event(self, pad, event):
+        if isinstance(event, CustomEvent) and \
+                event.name == "tensor_filter_update_model":
+            if not self.is_updatable:
+                raise RuntimeError(f"{self.name}: not is-updatable")
+            self.fw.handle_event("reload_model", event.data)
+            return  # consumed, like the reference custom-event sink
+        super().on_event(pad, event)
+
+    # -- stats readout (reference readable props :2163-2171) -----------------
+    @property
+    def latency(self) -> int:
+        stats = getattr(self, "stats", None)
+        return stats.latency_us if stats else -1
+
+    @property
+    def throughput(self) -> float:
+        stats = getattr(self, "stats", None)
+        return stats.throughput if stats else 0.0

@@ -1,0 +1,139 @@
+"""tensor_mux / tensor_demux: frame composition and decomposition.
+
+Parity with gst/nnstreamer/elements/gsttensor_mux.c (N streams → one
+multi-tensor frame, PTS-synced via the policies of
+:mod:`nnstreamer_tpu.pipeline.clock`) and gsttensor_demux.c (one frame →
+N streams, with ``tensorpick`` selection).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Dict, List, Optional
+
+from ..pipeline.caps import Caps
+from ..pipeline.clock import CollectPads, SyncMode
+from ..pipeline.element import CapsEvent, Element, EOSEvent, FlowReturn, Pad
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import (caps_from_config, config_from_caps,
+                                static_tensors_caps, tensors_template_caps)
+from ..tensor.info import TensorsConfig, TensorsInfo
+
+
+@register_element
+class TensorMux(Element):
+    FACTORY = "tensor_mux"
+    PROPERTIES = {
+        "sync-mode": ("slowest", "nosync|slowest|basepad|refresh"),
+        "sync-option": (None, "basepad: '<pad>:<duration_ns>'"),
+    }
+
+    def _make_pads(self):
+        self.add_src_pad(static_tensors_caps(), "src")
+
+    def request_sink_pad(self) -> Pad:
+        return self.add_sink_pad(static_tensors_caps())
+
+    def start(self):
+        dur = None
+        base_pad = 0
+        if self.sync_option:
+            parts = str(self.sync_option).split(":")
+            if len(parts) == 2:
+                base_pad, dur = int(parts[0]), int(parts[1])
+            else:
+                dur = int(parts[0])
+        self._collect = CollectPads(len(self.sink_pads),
+                                    SyncMode.from_string(self.sync_mode), dur,
+                                    base_pad=base_pad)
+        self._pad_index = {p.name: i for i, p in enumerate(self.sink_pads)}
+        self._pad_configs: Dict[int, TensorsConfig] = {}
+        self._announced = False
+
+    # -- negotiation: src caps = concatenation of all sink infos -------------
+    def set_caps(self, pad, caps):
+        idx = self._pad_index[pad.name]
+        self._pad_configs[idx] = config_from_caps(caps)
+        if len(self._pad_configs) == len(self.sink_pads) and not self._announced:
+            infos: List = []
+            for i in range(len(self.sink_pads)):
+                infos.extend(self._pad_configs[i].info)
+            rate = self._pad_configs[0].rate or Fraction(0, 1)
+            cfg = TensorsConfig(info=TensorsInfo(list(infos)), rate=rate)
+            self._announced = True
+            self.announce_src_caps(caps_from_config(cfg))
+
+    def chain(self, pad, buf):
+        idx = self._pad_index[pad.name]
+        frame_set = self._collect.push(idx, buf)
+        if frame_set is None:
+            return FlowReturn.OK
+        return self.push(self._combine(frame_set))
+
+    def _combine(self, frame_set: List[TensorBuffer]) -> TensorBuffer:
+        tensors = []
+        for b in frame_set:
+            tensors.extend(b.tensors)
+        pts = max((b.pts or 0) for b in frame_set)
+        return TensorBuffer(tensors=tensors, pts=pts,
+                            duration=frame_set[0].duration)
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            idx = self._pad_index[pad.name]
+            if self._collect.set_eos(idx):
+                for fs in self._collect.flush_remaining():
+                    self.push(self._combine(fs))
+                self.src_pad.push_event(EOSEvent())
+            return
+        # forward non-EOS events once (from pad 0 only, to avoid duplicates)
+        if self._pad_index[pad.name] == 0:
+            super().on_event(pad, event)
+
+
+@register_element
+class TensorDemux(Element):
+    FACTORY = "tensor_demux"
+    PROPERTIES = {
+        "tensorpick": (None, "comma list: which tensors to expose, in order; "
+                             "supports 'i' or 'i:j:k' groups per src pad"),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(static_tensors_caps(), "sink")
+
+    def request_src_pad(self) -> Pad:
+        return self.add_src_pad(static_tensors_caps())
+
+    def start(self):
+        self._picks: Optional[List[List[int]]] = None
+        if self.tensorpick not in (None, ""):
+            self._picks = [[int(x) for x in grp.split(":")]
+                           for grp in str(self.tensorpick).split(",")]
+
+    def _groups(self, num_tensors: int) -> List[List[int]]:
+        if self._picks is not None:
+            return self._picks
+        return [[i] for i in range(num_tensors)]
+
+    def set_caps(self, pad, caps):
+        cfg = config_from_caps(caps)
+        groups = self._groups(cfg.info.num_tensors)
+        if len(groups) < len(self.src_pads):
+            raise ValueError(
+                f"{self.name}: {len(self.src_pads)} src pads but only "
+                f"{len(groups)} tensor groups")
+        for sp, grp in zip(self.src_pads, groups):
+            infos = TensorsInfo([cfg.info[i].copy() for i in grp])
+            out = TensorsConfig(info=infos, rate=cfg.rate)
+            sp.push_event(CapsEvent(caps_from_config(out)))
+
+    def chain(self, pad, buf):
+        groups = self._groups(buf.num_tensors)
+        for sp, grp in zip(self.src_pads, groups):
+            out = buf.with_tensors([buf.tensors[i] for i in grp])
+            ret = sp.push(out)
+            if ret is FlowReturn.ERROR:
+                return ret
+        return FlowReturn.OK

@@ -1,0 +1,63 @@
+"""tensor_rate: framerate control + throttling for tensor streams.
+
+Parity with gst/nnstreamer/elements/gsttensor_rate.c: drop/duplicate frames
+to hit a target ``framerate``; ``throttle`` mode simply drops to an upper
+bound (the QoS role the reference wires to tensor_filter throttling).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+from ..pipeline.caps import Caps, Structure
+from ..pipeline.element import Element, FlowReturn
+from ..pipeline.registry import register_element
+from ..tensor.buffer import SECOND, TensorBuffer
+from ..tensor.caps_util import caps_from_config, config_from_caps, \
+    tensors_template_caps
+
+
+@register_element
+class TensorRate(Element):
+    FACTORY = "tensor_rate"
+    PROPERTIES = {
+        "framerate": (None, "target rate 'N/D'"),
+        "throttle": (True, "drop-only (no duplication)"),
+        "silent": (True, ""),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+        self.add_src_pad(tensors_template_caps(), "src")
+
+    def start(self):
+        if self.framerate in (None, ""):
+            raise ValueError(f"{self.name}: framerate required")
+        self._target = Fraction(str(self.framerate))
+        self._next_pts = 0
+        self.dropped = 0
+        self.duplicated = 0
+
+    def set_caps(self, pad, caps):
+        cfg = config_from_caps(caps)
+        cfg.rate = self._target
+        self.announce_src_caps(caps_from_config(cfg))
+
+    def chain(self, pad, buf):
+        interval = SECOND * self._target.denominator // self._target.numerator
+        pts = buf.pts or 0
+        if pts + (buf.duration or 0) < self._next_pts:
+            self.dropped += 1
+            return FlowReturn.DROPPED
+        ret = FlowReturn.OK
+        while pts + (buf.duration or interval) >= self._next_pts:
+            out = buf.copy()
+            out.pts = self._next_pts
+            out.duration = interval
+            ret = self.push(out)
+            self._next_pts += interval
+            if bool(self.throttle):
+                break
+            if ret is not FlowReturn.OK:
+                break
+        return ret

@@ -1,0 +1,105 @@
+"""tensor_reposink / tensor_reposrc: in-process circular stream repository.
+
+Parity with gst/nnstreamer/elements/gsttensor_repo.c (+reposink/reposrc):
+a process-global slot table keyed by ``slot-index`` lets a pipeline feed
+its own upstream (recurrent topologies) without a direct element link.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Dict, Optional
+
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.graph import Source
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import tensors_template_caps
+
+
+class _Repo:
+    """Process-global slot table (reference gsttensor_repo.c table)."""
+
+    def __init__(self) -> None:
+        self._slots: Dict[int, _queue.Queue] = {}
+        self._caps: Dict[int, Caps] = {}
+        self._lock = threading.Lock()
+
+    def slot(self, index: int) -> _queue.Queue:
+        with self._lock:
+            if index not in self._slots:
+                self._slots[index] = _queue.Queue(maxsize=32)
+            return self._slots[index]
+
+    def set_caps(self, index: int, caps: Caps) -> None:
+        with self._lock:
+            self._caps[index] = caps
+
+    def get_caps(self, index: int) -> Optional[Caps]:
+        with self._lock:
+            return self._caps.get(index)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slots.clear()
+            self._caps.clear()
+
+
+repo = _Repo()
+
+
+@register_element
+class TensorRepoSink(Element):
+    FACTORY = "tensor_reposink"
+    PROPERTIES = {"slot-index": (0, "repository slot")}
+
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+
+    def set_caps(self, pad, caps):
+        repo.set_caps(int(self.slot_index), caps)
+
+    def chain(self, pad, buf):
+        repo.slot(int(self.slot_index)).put(buf)
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            repo.slot(int(self.slot_index)).put(None)
+            self.post_eos_reached()
+
+
+@register_element
+class TensorRepoSrc(Source):
+    FACTORY = "tensor_reposrc"
+    PROPERTIES = {"slot-index": (0, "repository slot"),
+                  "caps": (None, "caps to announce (else slot caps)")}
+
+    def _make_pads(self):
+        self.add_src_pad(tensors_template_caps(), "src")
+
+    def negotiate(self) -> Caps:
+        if self.caps is not None:
+            c = self.caps
+            return Caps.from_string(c) if isinstance(c, str) else c
+        # wait briefly for the writer to register caps
+        import time
+
+        for _ in range(100):
+            c = repo.get_caps(int(self.slot_index))
+            if c is not None:
+                return c
+            time.sleep(0.02)
+        raise RuntimeError(f"{self.name}: no caps in slot {self.slot_index}")
+
+    def create(self) -> Optional[TensorBuffer]:
+        q = repo.slot(int(self.slot_index))
+        while not self._halted.is_set():
+            try:
+                item = q.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            return item  # None = EOS sentinel from reposink
+        return None

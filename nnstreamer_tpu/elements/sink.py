@@ -1,0 +1,127 @@
+"""Sink elements: tensor_sink (signal emitter), fakesink, filesink.
+
+Parity with gst/nnstreamer/elements/gsttensor_sink.c: an appsink-like
+element emitting a ``new-data`` callback per buffer, which is how
+applications and all the reference's sink unit tests consume pipeline
+output (tests/nnstreamer_sink/unittest_sink.cc).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+
+
+@register_element
+class TensorSink(Element):
+    FACTORY = "tensor_sink"
+    PROPERTIES = {
+        "emit-signal": (True, "invoke new-data callbacks"),
+        "sync": (False, "no-op (no wall-clock sync yet)"),
+        "collect": (True, "keep buffers in .results"),
+        "max-results": (0, "cap on retained buffers, 0 = unlimited"),
+    }
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._callbacks: List[Callable[[TensorBuffer], None]] = []
+        self.results: List[TensorBuffer] = []
+        self._caps: Optional[Caps] = None
+        self._eos = threading.Event()
+
+    def _make_pads(self):
+        self.add_sink_pad(Caps.any(), "sink")
+
+    def connect(self, signal: str, cb: Callable[[TensorBuffer], None]) -> None:
+        """GObject-signal-style registration: connect("new-data", fn)."""
+        if signal != "new-data":
+            raise ValueError(f"unknown signal {signal!r}")
+        self._callbacks.append(cb)
+
+    def set_caps(self, pad, caps):
+        self._caps = caps
+
+    @property
+    def caps(self) -> Optional[Caps]:
+        return self._caps
+
+    def chain(self, pad, buf):
+        if self.collect:
+            self.results.append(buf)
+            cap = int(self.max_results)
+            if cap > 0 and len(self.results) > cap:
+                self.results.pop(0)
+        if self.emit_signal:
+            for cb in self._callbacks:
+                cb(buf)
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            self._eos.set()
+            self.post_eos_reached()
+
+    def wait_eos(self, timeout: Optional[float] = None) -> bool:
+        return self._eos.wait(timeout)
+
+
+@register_element
+class FakeSink(Element):
+    """Discards buffers (GStreamer fakesink role)."""
+
+    FACTORY = "fakesink"
+
+    def _make_pads(self):
+        self.add_sink_pad(Caps.any(), "sink")
+
+    def set_caps(self, pad, caps):
+        pass
+
+    def chain(self, pad, buf):
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            self.post_eos_reached()
+
+
+@register_element
+class FileSink(Element):
+    """Appends raw tensor bytes to a file (multifilesink/filesink role used
+    by the reference golden tests to byte-compare outputs)."""
+
+    FACTORY = "filesink"
+    PROPERTIES = {"location": (None, "output path")}
+
+    def _make_pads(self):
+        self.add_sink_pad(Caps.any(), "sink")
+
+    def start(self):
+        if not self.location:
+            raise ValueError(f"{self.name}: location required")
+        self._f = open(str(self.location), "wb")
+
+    def stop(self):
+        f = getattr(self, "_f", None)
+        if f is not None and not f.closed:
+            f.close()
+
+    def set_caps(self, pad, caps):
+        pass
+
+    def chain(self, pad, buf):
+        for i in range(buf.num_tensors):
+            self._f.write(np.ascontiguousarray(buf.np(i)).tobytes())
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            self._f.flush()
+            self.post_eos_reached()

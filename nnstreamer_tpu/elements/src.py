@@ -1,0 +1,186 @@
+"""Media test sources: videotestsrc/audiotestsrc equivalents.
+
+The reference relies on GStreamer's videotestsrc for every golden test and
+benchmark pipeline (e.g. tests/nnstreamer_filter_tensorflow2_lite/runTest.sh).
+These sources produce the same role: deterministic synthetic frames at a
+negotiated format/rate, honoring downstream caps constraints (capsfilter).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Optional
+
+import numpy as np
+
+from ..pipeline.caps import ANY_FRAMERATE, Caps, FractionRange, IntRange, Structure
+from ..pipeline.element import FlowReturn
+from ..pipeline.graph import Source
+from ..pipeline.registry import register_element
+from ..tensor.buffer import SECOND, TensorBuffer
+
+VIDEO_FORMATS = ["RGB", "BGRx", "GRAY8"]  # reference converter's video set
+_CHANNELS = {"RGB": 3, "BGRx": 4, "GRAY8": 1}
+
+
+def video_template_caps() -> Caps:
+    return Caps([Structure("video/x-raw", {
+        "format": list(VIDEO_FORMATS),
+        "width": IntRange(1, 1 << 15),
+        "height": IntRange(1, 1 << 15),
+        "framerate": ANY_FRAMERATE,
+    })])
+
+
+@register_element
+class VideoTestSrc(Source):
+    """Deterministic video pattern source.
+
+    Patterns: ``smpte`` (color bands), ``gradient``, ``checkers``,
+    ``random`` (seeded), ``solid`` (color via ``foreground-color``).
+    """
+
+    FACTORY = "videotestsrc"
+    PROPERTIES = {
+        "num-buffers": (-1, "frames to emit, -1 = unlimited"),
+        "pattern": ("smpte", "smpte|gradient|checkers|random|solid"),
+        "foreground-color": (0xFFFFFF, "solid pattern RGB"),
+        "seed": (42, "random pattern seed"),
+    }
+
+    def _make_pads(self):
+        self.add_src_pad(video_template_caps(), "src")
+
+    def start(self):
+        self._count = 0
+        self._rng = np.random.default_rng(int(self.seed))
+
+    def negotiate(self) -> Caps:
+        allowed = self.src_pad.peer_allowed_caps()
+        caps = self.src_pad.template.intersect(allowed)
+        if caps.is_empty():
+            raise ValueError(f"{self.name}: cannot negotiate with downstream")
+        # Default resolution when unconstrained.
+        fixed = caps.first().fields
+        defaults = {"width": 320, "height": 240,
+                    "framerate": Fraction(30, 1)}
+        s = dict(fixed)
+        for k, d in defaults.items():
+            v = s.get(k)
+            if isinstance(v, (IntRange, FractionRange)):
+                # prefer the default when allowed, else let fixate() pick
+                # from the range (its low end)
+                if v.contains(d):
+                    s[k] = d
+            elif v is None:
+                s[k] = d
+        caps = Caps([Structure("video/x-raw", s)]).fixate()
+        self._caps = caps
+        st = caps.first()
+        self._w, self._h = int(st.get("width")), int(st.get("height"))
+        self._format = str(st.get("format"))
+        self._rate = st.get("framerate")
+        return caps
+
+    def create(self) -> Optional[TensorBuffer]:
+        n = int(self.num_buffers)
+        if n >= 0 and self._count >= n:
+            return None
+        frame = self._render(self._count)
+        rate = self._rate or Fraction(30, 1)
+        dur = SECOND * rate.denominator // max(rate.numerator, 1)
+        buf = TensorBuffer(tensors=[frame], pts=self._count * dur,
+                           duration=dur)
+        self._count += 1
+        return buf
+
+    def _render(self, n: int) -> np.ndarray:
+        w, h, ch = self._w, self._h, _CHANNELS[self._format]
+        pattern = str(self.pattern)
+        if pattern == "random":
+            return self._rng.integers(0, 256, (h, w, ch), dtype=np.uint8)
+        if pattern == "solid":
+            color = int(self.foreground_color)
+            rgb = [(color >> 16) & 0xFF, (color >> 8) & 0xFF, color & 0xFF]
+            px = np.array((rgb + [255])[:ch], dtype=np.uint8)
+            return np.broadcast_to(px, (h, w, ch)).copy()
+        if pattern == "checkers":
+            yy, xx = np.mgrid[0:h, 0:w]
+            cell = ((xx // 8 + yy // 8 + n) % 2) * 255
+            return np.repeat(cell.astype(np.uint8)[..., None], ch, axis=2)
+        if pattern == "gradient":
+            row = np.linspace(0, 255, w, dtype=np.uint8)
+            frame = np.broadcast_to(row[None, :, None], (h, w, ch))
+            return np.ascontiguousarray(
+                np.roll(frame, shift=n, axis=1))
+        # smpte-ish: 7 vertical color bars
+        bars = np.array([
+            [191, 191, 191], [191, 191, 0], [0, 191, 191], [0, 191, 0],
+            [191, 0, 191], [191, 0, 0], [0, 0, 191]], dtype=np.uint8)
+        idx = (np.arange(w) * 7 // max(w, 1)).clip(0, 6)
+        frame = bars[idx][None, :, :].repeat(h, axis=0)
+        if ch == 1:
+            frame = frame.mean(axis=2, keepdims=True).astype(np.uint8)
+        elif ch == 4:
+            frame = np.concatenate(
+                [frame, np.full((h, w, 1), 255, np.uint8)], axis=2)
+        return np.ascontiguousarray(frame)
+
+
+@register_element
+class AudioTestSrc(Source):
+    """Sine-wave audio source (audiotestsrc role)."""
+
+    FACTORY = "audiotestsrc"
+    PROPERTIES = {
+        "num-buffers": (-1, ""),
+        "samplesperbuffer": (1024, ""),
+        "freq": (440.0, "sine frequency"),
+    }
+
+    def _make_pads(self):
+        self.add_src_pad(Caps([Structure("audio/x-raw", {
+            "format": ["S16LE", "U8", "F32LE"],
+            "channels": IntRange(1, 16),
+            "rate": IntRange(1, 384000),
+        })]), "src")
+
+    def start(self):
+        self._count = 0
+
+    def negotiate(self) -> Caps:
+        allowed = self.src_pad.peer_allowed_caps()
+        caps = self.src_pad.template.intersect(allowed)
+        s = dict(caps.first().fields)
+        if not isinstance(s.get("channels"), int):
+            s["channels"] = 1
+        if not isinstance(s.get("rate"), int):
+            s["rate"] = 44100
+        caps = Caps([Structure("audio/x-raw", s)]).fixate()
+        self._caps = caps
+        st = caps.first()
+        self._format = str(st.get("format"))
+        self._channels = int(st.get("channels"))
+        self._rate = int(st.get("rate"))
+        return caps
+
+    def create(self) -> Optional[TensorBuffer]:
+        n = int(self.num_buffers)
+        if n >= 0 and self._count >= n:
+            return None
+        spb = int(self.samplesperbuffer)
+        t0 = self._count * spb
+        t = (np.arange(spb) + t0) / self._rate
+        wave = np.sin(2 * np.pi * float(self.freq) * t)
+        if self._format == "S16LE":
+            data = (wave * 32767).astype(np.int16)
+        elif self._format == "U8":
+            data = ((wave * 127) + 128).astype(np.uint8)
+        else:
+            data = wave.astype(np.float32)
+        samples = np.repeat(data[:, None], self._channels, axis=1)
+        pts = t0 * SECOND // self._rate
+        dur = spb * SECOND // self._rate
+        buf = TensorBuffer(tensors=[samples], pts=pts, duration=dur)
+        self._count += 1
+        return buf

@@ -1,0 +1,143 @@
+"""tensor_if: data-dependent stream routing.
+
+Parity with gst/nnstreamer/elements/gsttensor_if.c (enums at
+gsttensor_if.h:42-141): a compared value (per-tensor value / tensor
+average / custom callback) tested with an operator against supplied
+operand(s) routes each buffer to the ``then`` or ``else`` behavior:
+passthrough, skip, fill-zero, or tensorpick on two src pads (src_0 = then,
+src_1 = else when both linked).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, EOSEvent, FlowReturn, Pad
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import static_tensors_caps
+
+_OPS = {
+    "eq": lambda v, a, b: v == a,
+    "ne": lambda v, a, b: v != a,
+    "gt": lambda v, a, b: v > a,
+    "ge": lambda v, a, b: v >= a,
+    "lt": lambda v, a, b: v < a,
+    "le": lambda v, a, b: v <= a,
+    "range-inclusive": lambda v, a, b: a <= v <= b,
+    "range-exclusive": lambda v, a, b: a < v < b,
+    "not-in-range-inclusive": lambda v, a, b: not (a <= v <= b),
+    "not-in-range-exclusive": lambda v, a, b: not (a < v < b),
+}
+
+_CUSTOM_CONDS: dict = {}
+
+
+def register_if_custom(name: str, fn: Callable[[TensorBuffer], bool]) -> None:
+    """Custom condition callback (reference tensor_if.h custom API)."""
+    _CUSTOM_CONDS[name] = fn
+
+
+@register_element
+class TensorIf(Element):
+    FACTORY = "tensor_if"
+    PROPERTIES = {
+        "compared-value": ("a-value", "a-value|tensor-average|custom"),
+        "compared-value-option": (None, "e.g. '0:0:0:0,0' index or tensor idx"),
+        "supplied-value": (None, "operand(s), comma separated"),
+        "operator": ("gt", "|".join(_OPS)),
+        "then": ("passthrough", "passthrough|skip|fill-zero|tensorpick"),
+        "then-option": (None, "tensorpick indices"),
+        "else": ("skip", "passthrough|skip|fill-zero|tensorpick"),
+        "else-option": (None, "tensorpick indices"),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(static_tensors_caps(), "sink")
+        self.add_src_pad(static_tensors_caps(), "src_0")
+
+    def request_src_pad(self) -> Pad:
+        if len(self.src_pads) >= 2:
+            raise ValueError("tensor_if has at most 2 src pads")
+        return self.add_src_pad(static_tensors_caps(), "src_1")
+
+    def start(self):
+        op = str(self.operator)
+        if op not in _OPS:
+            raise ValueError(f"unknown operator {op}")
+        self._op = _OPS[op]
+        sup = str(self.supplied_value or "0")
+        vals = [float(x) for x in sup.split(",")]
+        self._a = vals[0]
+        self._b = vals[1] if len(vals) > 1 else vals[0]
+
+    def _compared_value(self, buf: TensorBuffer) -> float:
+        cv = str(self.compared_value)
+        opt = self.compared_value_option
+        if cv == "custom":
+            fn = _CUSTOM_CONDS.get(str(opt))
+            if fn is None:
+                raise ValueError(f"custom condition {opt!r} not registered")
+            return fn(buf)
+        if cv == "tensor-average":
+            idx = int(opt) if opt not in (None, "") else 0
+            return float(np.mean(buf.np(idx)))
+        # a-value: "i0:i1:...,tensor_idx" picks one element
+        if opt in (None, ""):
+            return float(np.ravel(buf.np(0))[0])
+        coord_s, _, tidx = str(opt).partition(",")
+        tensor = buf.np(int(tidx) if tidx else 0)
+        coords = tuple(int(x) for x in coord_s.split(":"))
+        # reference coords are innermost-first; numpy index is reversed
+        np_idx = tuple(reversed(coords))[-tensor.ndim:]
+        return float(tensor[np_idx])
+
+    def _apply_behavior(self, behavior: str, option, buf: TensorBuffer
+                        ) -> Optional[TensorBuffer]:
+        if behavior == "passthrough":
+            return buf
+        if behavior == "skip":
+            return None
+        if behavior == "fill-zero":
+            return buf.with_tensors(
+                [np.zeros_like(buf.np(i)) for i in range(buf.num_tensors)])
+        if behavior == "tensorpick":
+            picks = [int(x) for x in str(option).split(",")]
+            return buf.with_tensors([buf.tensors[i] for i in picks])
+        raise ValueError(f"unknown behavior {behavior!r}")
+
+    def chain(self, pad, buf):
+        v = self._compared_value(buf)
+        cond = bool(self._op(v, self._a, self._b))
+        if cond:
+            out = self._apply_behavior(str(self.then), self.then_option, buf)
+            target = self.src_pads[0]
+        else:
+            out = self._apply_behavior(str(getattr(self, "else")),
+                                       self.else_option, buf)
+            target = (self.src_pads[1] if len(self.src_pads) > 1
+                      else self.src_pads[0])
+        if out is None:
+            return FlowReturn.DROPPED
+        return target.push(out)
+
+    def set_caps(self, pad, caps):
+        from ..pipeline.element import CapsEvent
+        from ..tensor.caps_util import caps_from_config, config_from_caps
+        from ..tensor.info import TensorsConfig, TensorsInfo
+
+        cfg = config_from_caps(caps)
+        behaviors = [(str(self.then), self.then_option),
+                     (str(getattr(self, "else")), self.else_option)]
+        for sp, (behavior, option) in zip(self.src_pads, behaviors):
+            if behavior == "tensorpick" and cfg.info.num_tensors:
+                picks = [int(x) for x in str(option).split(",")]
+                out = TensorsConfig(
+                    info=TensorsInfo([cfg.info[i].copy() for i in picks]),
+                    rate=cfg.rate)
+                sp.push_event(CapsEvent(caps_from_config(out)))
+            else:
+                sp.push_event(CapsEvent(caps))

@@ -1,0 +1,13 @@
+"""Filter framework layer (L3/L4): backend ABI, registry, single-invoke."""
+
+from .framework import (Accelerator, FilterError, FilterFramework,
+                        FilterProperties, FilterStatistics, detect_framework,
+                        find_filter, list_filters, register_filter,
+                        shared_models)
+from .single import FilterSingle
+
+__all__ = [
+    "FilterFramework", "FilterProperties", "FilterError", "Accelerator",
+    "FilterStatistics", "register_filter", "find_filter", "list_filters",
+    "detect_framework", "shared_models", "FilterSingle",
+]

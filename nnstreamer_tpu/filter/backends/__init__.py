@@ -1,0 +1,13 @@
+"""Built-in filter backends.  Importing this package registers them all
+(the in-process analogue of subplugin .so discovery,
+gst/nnstreamer/nnstreamer_subplugin.c:116)."""
+
+from .custom import (CustomEasyFilter, CustomFilter, DummyFilter,
+                     register_custom_easy, unregister_custom_easy)
+from .python import PythonFilter
+from .xla import XLAFilter
+
+__all__ = [
+    "XLAFilter", "CustomFilter", "CustomEasyFilter", "DummyFilter",
+    "PythonFilter", "register_custom_easy", "unregister_custom_easy",
+]

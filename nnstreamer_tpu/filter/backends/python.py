@@ -1,0 +1,95 @@
+"""Python-script filter backend: a .py file as the model.
+
+Parity with the reference python3 subplugin
+(ext/nnstreamer/tensor_filter/tensor_filter_python3.cc + helper: embeds
+CPython, loads a user script defining a class with getInputDim/getOutputDim/
+invoke).  Here the host language *is* Python, so this backend reduces to
+importing the script and adapting its class — same script contract as the
+reference fixtures (tests/test_models/models/passthrough.py).
+
+Script contract: define ``class CustomFilter`` (or a module-level
+``filter_instance``) with methods:
+
+- ``getInputDim() -> TensorsInfo`` (or list of (dims, dtype-name) pairs)
+- ``getOutputDim() -> TensorsInfo``
+- ``invoke(inputs: list[np.ndarray]) -> list[np.ndarray]``
+- optionally ``setInputDim(in_info) -> (in_info, out_info)``
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import time
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from ...tensor.info import TensorInfo, TensorsInfo
+from ...tensor.types import TensorType
+from ..framework import (Accelerator, FilterError, FilterFramework,
+                         FilterProperties, FilterStatistics, register_filter)
+
+
+def _coerce_info(value) -> TensorsInfo:
+    if isinstance(value, TensorsInfo):
+        return value
+    # list of (dims, dtype) pairs, dims innermost-first like the reference
+    infos = []
+    for dims, dtype in value:
+        infos.append(TensorInfo(TensorType.from_string(str(dtype)),
+                                tuple(dims)))
+    return TensorsInfo(infos)
+
+
+@register_filter
+class PythonFilter(FilterFramework):
+    """``framework=python``: model is a path to a .py script."""
+
+    NAME = "python"
+    SUPPORTED_ACCELERATORS = (Accelerator.CPU,)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._obj = None
+        self.stats = FilterStatistics()
+
+    def open(self, props: FilterProperties) -> None:
+        path = str(props.model)
+        if not os.path.exists(path):
+            raise FilterError(f"python: script not found: {path}")
+        name = f"_nns_pyfilter_{abs(hash(path)) & 0xffffff:x}"
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        if hasattr(mod, "filter_instance"):
+            self._obj = mod.filter_instance
+        elif hasattr(mod, "CustomFilter"):
+            self._obj = mod.CustomFilter()
+        else:
+            raise FilterError(
+                f"python: {path} defines neither CustomFilter nor "
+                "filter_instance")
+        super().open(props)
+
+    def get_model_info(self) -> Tuple[TensorsInfo, TensorsInfo]:
+        return (_coerce_info(self._obj.getInputDim()),
+                _coerce_info(self._obj.getOutputDim()))
+
+    def set_input_info(self, in_info: TensorsInfo):
+        if hasattr(self._obj, "setInputDim"):
+            new_in, new_out = self._obj.setInputDim(in_info)
+            return _coerce_info(new_in), _coerce_info(new_out)
+        return super().set_input_info(in_info)
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        t0 = time.monotonic_ns()
+        outs = self._obj.invoke([np.asarray(t) for t in inputs])
+        self.stats.record(time.monotonic_ns() - t0)
+        return list(outs)
+
+    @classmethod
+    def handles_model(cls, model: Any) -> bool:
+        return isinstance(model, str) and model.endswith(".py")

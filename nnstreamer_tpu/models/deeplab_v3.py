@@ -1,0 +1,73 @@
+"""DeepLabV3 semantic segmentation — benchmark config 3.
+
+Parity with the reference fixture ``deeplabv3_257_mv_gpu.tflite`` consumed by
+the ``image_segment`` decoder (reference:
+ext/nnstreamer/tensor_decoder/tensordec-imagesegment.c, tflite-deeplab mode:
+output is per-pixel class scores (21 × W × H), decoder takes argmax).
+
+TPU-first: MobileNetV2 backbone + ASPP-lite head, bf16, bilinear upsample
+inside the jitted graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..tensor.info import TensorInfo, TensorsInfo
+from ..tensor.types import TensorType
+from .mobilenet_v2 import _ConvBN, _InvertedResidual, _INVERTED_RESIDUAL_CFG
+from .registry import Model, register_model
+
+NUM_SEG_CLASSES = 21  # PASCAL VOC, same as the tflite fixture
+
+
+class _DeepLabV3(nn.Module):
+    num_classes: int = NUM_SEG_CLASSES
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        size = x.shape[0]
+        # Backbone at output-stride 16 (stop before the last stride-2 stage).
+        x = _ConvBN(32, (3, 3), strides=2, dtype=self.dtype)(x[None])
+        for t, ch, n, s in _INVERTED_RESIDUAL_CFG[:5]:
+            for i in range(n):
+                x = _InvertedResidual(ch, s if i == 0 else 1, t,
+                                      dtype=self.dtype)(x)
+        # ASPP-lite: 1x1 conv + global pooling branch.
+        a = _ConvBN(256, (1, 1), dtype=self.dtype)(x)
+        g = jnp.mean(x, axis=(1, 2), keepdims=True)
+        g = _ConvBN(256, (1, 1), dtype=self.dtype)(g)
+        g = jnp.broadcast_to(g, a.shape)
+        y = _ConvBN(256, (1, 1), dtype=self.dtype)(
+            jnp.concatenate([a, g], axis=-1))
+        y = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype)(y)
+        y = jax.image.resize(y.astype(jnp.float32),
+                             (1, size, size, self.num_classes), "bilinear")
+        return y[0]
+
+
+def build_deeplab_v3(custom_props: Dict[str, str]) -> Model:
+    seed = int(custom_props.get("seed", 0))
+    size = int(custom_props.get("input_size", 257))
+    dtype = jnp.dtype(custom_props.get("dtype", "bfloat16"))
+    module = _DeepLabV3(dtype=dtype)
+    variables = module.init(jax.random.PRNGKey(seed),
+                            jnp.zeros((size, size, 3), dtype))
+
+    def forward(variables, frame):
+        x = frame.astype(dtype) * (1.0 / 127.5) - 1.0
+        return (module.apply(variables, x),)
+
+    in_info = TensorsInfo([TensorInfo(TensorType.UINT8, (3, size, size))])
+    out_info = TensorsInfo(
+        [TensorInfo(TensorType.FLOAT32, (NUM_SEG_CLASSES, size, size))])
+    return Model(name="deeplab_v3", forward=forward, params=variables,
+                 in_info=in_info, out_info=out_info)
+
+
+register_model("deeplab_v3")(build_deeplab_v3)

@@ -1,0 +1,69 @@
+"""PoseNet pose estimation — benchmark config 4.
+
+Parity with the reference PoseNet fixture consumed by the ``pose_estimation``
+decoder (reference: ext/nnstreamer/tensor_decoder/tensordec-pose.c: outputs
+keypoint heatmaps (K × W' × H') and short-range offsets (2K × W' × H'),
+decoder finds per-keypoint argmax + offset refinement and draws a skeleton).
+
+TPU-first: MobileNetV2 backbone, two conv heads, one fused graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..tensor.info import TensorInfo, TensorsInfo
+from ..tensor.types import TensorType
+from .mobilenet_v2 import _ConvBN, _InvertedResidual, _INVERTED_RESIDUAL_CFG
+from .registry import Model, register_model
+
+NUM_KEYPOINTS = 17  # COCO
+
+
+class _PoseNet(nn.Module):
+    num_keypoints: int = NUM_KEYPOINTS
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = _ConvBN(32, (3, 3), strides=2, dtype=self.dtype)(x[None])
+        for t, ch, n, s in _INVERTED_RESIDUAL_CFG:
+            for i in range(n):
+                x = _InvertedResidual(ch, s if i == 0 else 1, t,
+                                      dtype=self.dtype)(x)
+        heat = nn.Conv(self.num_keypoints, (1, 1), dtype=self.dtype)(x)
+        offs = nn.Conv(2 * self.num_keypoints, (1, 1), dtype=self.dtype)(x)
+        return (jax.nn.sigmoid(heat.astype(jnp.float32))[0],
+                offs.astype(jnp.float32)[0])
+
+
+def build_posenet(custom_props: Dict[str, str]) -> Model:
+    seed = int(custom_props.get("seed", 0))
+    size = int(custom_props.get("input_size", 257))
+    dtype = jnp.dtype(custom_props.get("dtype", "bfloat16"))
+    module = _PoseNet(dtype=dtype)
+    variables = module.init(jax.random.PRNGKey(seed),
+                            jnp.zeros((size, size, 3), dtype))
+    out_hw = jax.eval_shape(
+        lambda v, x: module.apply(v, x), variables,
+        jax.ShapeDtypeStruct((size, size, 3), dtype))[0].shape[:2]
+
+    def forward(variables, frame):
+        x = frame.astype(dtype) * (1.0 / 127.5) - 1.0
+        return module.apply(variables, x)
+
+    h, w = out_hw
+    in_info = TensorsInfo([TensorInfo(TensorType.UINT8, (3, size, size))])
+    out_info = TensorsInfo([
+        TensorInfo(TensorType.FLOAT32, (NUM_KEYPOINTS, w, h)),
+        TensorInfo(TensorType.FLOAT32, (2 * NUM_KEYPOINTS, w, h)),
+    ])
+    return Model(name="posenet", forward=forward, params=variables,
+                 in_info=in_info, out_info=out_info)
+
+
+register_model("posenet")(build_posenet)

@@ -1,0 +1,68 @@
+"""Model registry: named, jittable models the XLA filter backend serves.
+
+The reference loads vendor model files (.tflite/.pb/.pt …) through per-SDK
+subplugins (SURVEY.md §2.4).  TPU-native, a "model" is a pure JAX function +
+params compiled by XLA; the registry replaces file-extension dispatch with
+named model specs (file paths to orbax checkpoints also resolve here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..tensor.info import TensorsInfo
+
+
+@dataclasses.dataclass
+class Model:
+    """A ready-to-serve model.
+
+    ``forward(params, *inputs) -> tuple(outputs)`` must be jittable, operate
+    on *unbatched* numpy-shaped arrays (one stream frame), and keep its
+    FLOPs in MXU-friendly form (bf16 matmuls/convs).  ``in_info``/``out_info``
+    use reference dim order (innermost first).
+    """
+
+    name: str
+    forward: Callable[..., Tuple[Any, ...]]
+    params: Any
+    in_info: TensorsInfo
+    out_info: TensorsInfo
+    #: optional training step factory (loss, optimizer) for trainer parity
+    make_train_step: Optional[Callable[..., Any]] = None
+
+
+#: name -> build(custom_props: dict) -> Model
+_MODELS: Dict[str, Callable[[Dict[str, str]], Model]] = {}
+
+
+def register_model(name: str):
+    def deco(build: Callable[[Dict[str, str]], Model]):
+        _MODELS[name] = build
+        return build
+    return deco
+
+
+def _ensure_loaded() -> None:
+    from . import mobilenet_v2, ssd, deeplab_v3, posenet  # noqa: F401
+
+
+def get_model(name: str, custom_props: Optional[Dict[str, str]] = None) -> Model:
+    _ensure_loaded()
+    if name not in _MODELS:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_MODELS)}")
+    return _MODELS[name](custom_props or {})
+
+
+def has_model(name: str) -> bool:
+    try:
+        _ensure_loaded()
+    except Exception:  # pragma: no cover - import errors surface later
+        return False
+    return name in _MODELS
+
+
+def list_models() -> List[str]:
+    _ensure_loaded()
+    return sorted(_MODELS)

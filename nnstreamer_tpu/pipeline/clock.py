@@ -1,0 +1,142 @@
+"""N-pad time synchronization for combining elements (mux/merge).
+
+Parity with the reference's collectpads sync engine
+(gst_tensor_time_sync_*, nnstreamer_plugin_api_impl.c:34-450; policy doc
+Documentation/synchronization-policies-at-mux-merge.md): policies decide
+which per-pad buffers form one output frame and what PTS it carries.
+
+- ``nosync``: pair buffers by arrival order (FIFO zip).
+- ``slowest``: output PTS = max of head PTS; pads ahead of that PTS wait,
+  pads behind drop forward until within range.
+- ``basepad``: pad0 drives; option ``N:duration`` — other pads pick their
+  newest buffer not newer than pad0's PTS + duration.
+- ``refresh``: emit whenever pad0 produces, reusing the latest buffer seen
+  on other pads.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Dict, List, Optional
+
+from ..tensor.buffer import TensorBuffer
+
+
+class SyncMode(enum.Enum):
+    NOSYNC = "nosync"
+    SLOWEST = "slowest"
+    BASEPAD = "basepad"
+    REFRESH = "refresh"
+
+    @classmethod
+    def from_string(cls, s: Optional[str]) -> "SyncMode":
+        if not s:
+            return cls.NOSYNC
+        return cls(str(s).strip().lower())
+
+
+class CollectPads:
+    """Per-pad FIFOs + a sync policy; thread-safe (each upstream branch may
+    chain from its own streaming thread, as with GStreamer collectpads)."""
+
+    def __init__(self, num_pads: int, mode: SyncMode = SyncMode.NOSYNC,
+                 base_duration_ns: Optional[int] = None, base_pad: int = 0):
+        self.num_pads = num_pads
+        self.mode = mode
+        self.base_duration_ns = base_duration_ns
+        self.base_pad = base_pad
+        self._fifos: Dict[int, List[TensorBuffer]] = {
+            i: [] for i in range(num_pads)}
+        self._latest: Dict[int, Optional[TensorBuffer]] = {
+            i: None for i in range(num_pads)}
+        self._eos: Dict[int, bool] = {i: False for i in range(num_pads)}
+        self._lock = threading.Lock()
+
+    def add_pad(self) -> int:
+        with self._lock:
+            i = self.num_pads
+            self.num_pads += 1
+            self._fifos[i] = []
+            self._latest[i] = None
+            self._eos[i] = False
+            return i
+
+    def push(self, pad_index: int, buf: TensorBuffer
+             ) -> Optional[List[TensorBuffer]]:
+        """Queue a buffer; return one synchronized frame set if ready."""
+        with self._lock:
+            self._fifos[pad_index].append(buf)
+            self._latest[pad_index] = buf
+            return self._collect_locked()
+
+    def set_eos(self, pad_index: int) -> bool:
+        """Mark a pad EOS; returns True when all pads are EOS."""
+        with self._lock:
+            self._eos[pad_index] = True
+            return all(self._eos.values())
+
+    def _collect_locked(self) -> Optional[List[TensorBuffer]]:
+        mode = self.mode
+        if mode is SyncMode.NOSYNC:
+            if all(self._fifos[i] for i in range(self.num_pads)):
+                return [self._fifos[i].pop(0) for i in range(self.num_pads)]
+            return None
+        if mode is SyncMode.SLOWEST:
+            if not all(self._fifos[i] for i in range(self.num_pads)):
+                return None
+            target = max(self._fifos[i][0].pts or 0
+                         for i in range(self.num_pads))
+            out = []
+            for i in range(self.num_pads):
+                fifo = self._fifos[i]
+                # drop stale buffers: keep newest with pts <= target
+                while len(fifo) > 1 and (fifo[1].pts or 0) <= target:
+                    fifo.pop(0)
+                out.append(fifo.pop(0))
+            return out
+        if mode is SyncMode.BASEPAD:
+            bp = self.base_pad
+            if not self._fifos[bp]:
+                return None
+            base = self._fifos[bp][0]
+            limit = (base.pts or 0) + (self.base_duration_ns or 0)
+            out: List[Optional[TensorBuffer]] = [None] * self.num_pads
+            for i in range(self.num_pads):
+                if i == bp:
+                    continue
+                fifo = self._fifos[i]
+                if not fifo:
+                    if self._latest[i] is None:
+                        return None
+                    out[i] = self._latest[i]
+                    continue
+                while len(fifo) > 1 and (fifo[1].pts or 0) <= limit:
+                    fifo.pop(0)
+                out[i] = fifo.pop(0) if fifo else self._latest[i]
+            out[bp] = self._fifos[bp].pop(0)
+            return out
+        if mode is SyncMode.REFRESH:
+            bp = self.base_pad
+            if not self._fifos[bp]:
+                return None
+            if any(self._latest[i] is None for i in range(self.num_pads)):
+                return None
+            out = []
+            for i in range(self.num_pads):
+                if i == bp:
+                    out.append(self._fifos[bp].pop(0))
+                    continue
+                fifo = self._fifos[i]
+                out.append(fifo.pop(0) if fifo else self._latest[i])
+            return out
+        raise AssertionError(mode)
+
+    def flush_remaining(self) -> List[List[TensorBuffer]]:
+        """At EOS drain complete frame-sets still queued (nosync only)."""
+        frames = []
+        with self._lock:
+            while all(self._fifos[i] for i in range(self.num_pads)):
+                frames.append([self._fifos[i].pop(0)
+                               for i in range(self.num_pads)])
+        return frames

@@ -130,6 +130,15 @@ class Pad:
         if self.peer is not None:
             self.peer.element._event_entry(self.peer, event)
 
+    def peer_allowed_caps(self) -> Caps:
+        """Downstream CAPS query (GStreamer gst_pad_peer_query_caps role):
+        what would the peer accept?  Passthrough elements forward the query
+        further downstream, so a source can honor capsfilter constraints."""
+        if self.peer is None:
+            return Caps.any()
+        allowed = self.peer.element.get_allowed_caps(self.peer)
+        return allowed.intersect(self.peer.template)
+
 
 class Element:
     """Base pipeline element.
@@ -255,6 +264,12 @@ class Element:
         """Default: forward events (incl. EOS) to all src pads."""
         for sp in self.src_pads:
             sp.push_event(event)
+
+    def get_allowed_caps(self, sink_pad: Pad) -> Caps:
+        """Answer a downstream caps query on ``sink_pad``.  Default: the pad
+        template (transform elements accept their template regardless of what
+        they output).  Passthrough elements should forward downstream."""
+        return sink_pad.template
 
     # -- helpers -------------------------------------------------------------
     def announce_src_caps(self, caps: Caps, pad: Optional[Pad] = None) -> None:

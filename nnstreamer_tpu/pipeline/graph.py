@@ -18,6 +18,7 @@ from ..tensor.buffer import TensorBuffer
 from .caps import Caps
 from .element import (CapsEvent, Element, EOSEvent, Event, FlowReturn, Pad,
                       PadDirection)
+from .registry import register_element
 
 
 class PipelineError(RuntimeError):
@@ -90,7 +91,10 @@ class Pipeline:
     def play(self) -> None:
         self._check_links()
         for el in self.elements:
-            el.start()
+            try:
+                el.start()
+            except Exception as exc:  # noqa: BLE001
+                raise PipelineError(el, exc) from exc
             el._started = True
         self._playing = True
         for el in self.elements:
@@ -137,8 +141,8 @@ class Pipeline:
                 el._started = False
 
     def run(self, timeout: Optional[float] = None) -> None:
-        self.play()
         try:
+            self.play()
             self.wait(timeout)
         finally:
             self.stop()
@@ -192,6 +196,7 @@ class Source(Element):
                 raise
 
 
+@register_element
 class Queue(Element):
     """Thread-boundary element with a bounded buffer.
 
@@ -216,18 +221,39 @@ class Queue(Element):
 
     def stop(self):
         self._stop.set()
+        # drain so the sentinel always fits even if the worker died with a
+        # full queue (upstream error case)
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
         self._q.put(None)
         self._worker.join(timeout=10)
 
+    def get_allowed_caps(self, sink_pad):
+        return self.src_pad.peer_allowed_caps()
+
+    def _enqueue(self, item) -> FlowReturn:
+        """Bounded put that can't deadlock: gives up when the queue is being
+        stopped or the drain worker died."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return FlowReturn.OK
+            except _queue.Full:
+                if not self._worker.is_alive():
+                    return FlowReturn.ERROR
+        return FlowReturn.EOS
+
     def chain(self, pad, buf):
-        self._q.put(("buf", buf))
-        return FlowReturn.OK
+        return self._enqueue(("buf", buf))
 
     def set_caps(self, pad, caps):
-        self._q.put(("event", CapsEvent(caps)))
+        self._enqueue(("event", CapsEvent(caps)))
 
     def on_event(self, pad, event):
-        self._q.put(("event", event))
+        self._enqueue(("event", event))
 
     def _drain(self):
         while not self._stop.is_set():
@@ -248,6 +274,7 @@ class Queue(Element):
                 return
 
 
+@register_element
 class Tee(Element):
     """1→N branch duplicator (GStreamer ``tee`` role).  Buffers are shared,
     not copied — downstream must not mutate in place (same contract as
@@ -261,6 +288,12 @@ class Tee(Element):
     def request_src_pad(self) -> Pad:
         return self.add_src_pad(Caps.any())
 
+    def get_allowed_caps(self, sink_pad):
+        allowed = Caps.any()
+        for sp in self.src_pads:
+            allowed = allowed.intersect(sp.peer_allowed_caps())
+        return allowed
+
     def chain(self, pad, buf):
         for sp in self.src_pads:
             ret = sp.push(buf.copy())
@@ -269,6 +302,7 @@ class Tee(Element):
         return FlowReturn.OK
 
 
+@register_element
 class AppSrc(Source):
     """Programmatic source: caller supplies caps and feeds buffers
     (GStreamer appsrc role; used heavily by tests the way the reference's

@@ -39,16 +39,23 @@ class CapsFilter(Element):
         self.add_sink_pad(Caps.any(), "sink")
         self.add_src_pad(Caps.any(), "src")
 
-    def set_caps(self, pad, caps):
+    def _constraint(self) -> Caps:
         constraint = self.caps
         if isinstance(constraint, str):
             constraint = Caps.from_string(constraint)
-        if constraint is not None:
-            inter = caps.intersect(constraint)
-            if inter.is_empty():
-                raise ValueError(
-                    f"capsfilter {self.name}: {caps} ∩ {constraint} is empty")
+        return constraint if constraint is not None else Caps.any()
+
+    def set_caps(self, pad, caps):
+        inter = caps.intersect(self._constraint())
+        if inter.is_empty():
+            raise ValueError(
+                f"capsfilter {self.name}: {caps} does not satisfy "
+                f"{self._constraint()}")
         self.src_pad.push_event(CapsEvent(caps))
+
+    def get_allowed_caps(self, sink_pad):
+        downstream = self.src_pad.peer_allowed_caps()
+        return self._constraint().intersect(downstream)
 
     def chain(self, pad, buf):
         return self.src_pad.push(buf)
@@ -56,7 +63,7 @@ class CapsFilter(Element):
 
 def _coerce(value: str):
     try:
-        return int(value)
+        return int(value, 0)  # handles decimal and 0x… hex
     except ValueError:
         pass
     try:

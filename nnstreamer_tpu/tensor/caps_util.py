@@ -24,8 +24,8 @@ def caps_from_config(config: TensorsConfig) -> Caps:
     fields["format"] = str(config.format)
     if config.format is TensorFormat.STATIC and config.info.num_tensors > 0:
         fields["num_tensors"] = config.info.num_tensors
-        fields["dimensions"] = config.info.dims_string()
-        fields["types"] = config.info.types_string()
+        fields["dimensions"] = config.info.dims_string(sep=".")
+        fields["types"] = config.info.types_string(sep=".")
     fields["framerate"] = (config.rate if config.rate is not None
                            else ANY_FRAMERATE)
     return Caps([Structure(TENSORS_MIME, fields)])

@@ -174,14 +174,16 @@ class TensorsInfo:
         ]
         return cls(infos=infos)
 
-    def dims_string(self) -> str:
-        return ",".join(dim_to_string(i.dims) for i in self.infos)
+    def dims_string(self, sep: str = ",") -> str:
+        """``sep="."`` is the in-caps separator (reference caps use ``.``
+        because ``,`` delimits caps fields)."""
+        return sep.join(dim_to_string(i.dims) for i in self.infos)
 
-    def types_string(self) -> str:
-        return ",".join(str(i.dtype) for i in self.infos)
+    def types_string(self, sep: str = ",") -> str:
+        return sep.join(str(i.dtype) for i in self.infos)
 
-    def names_string(self) -> str:
-        return ",".join(i.name or "" for i in self.infos)
+    def names_string(self, sep: str = ",") -> str:
+        return sep.join(i.name or "" for i in self.infos)
 
     def total_size(self) -> int:
         return sum(i.size for i in self.infos)

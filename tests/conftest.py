@@ -13,6 +13,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +
                                " --xla_force_host_platform_device_count=8").strip()
 
+# The environment may pre-initialize jax (sitecustomize on PYTHONPATH) with
+# a different default platform; the config update below wins regardless.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
@@ -23,3 +29,19 @@ def jax_cpu_devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {devs}"
     return devs
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: XLA-compile-heavy tests (run with -m slow)")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+
+    if config.getoption("-m"):
+        return
+    skip = _pytest.mark.skip(reason="slow; run with -m slow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,193 @@
+"""Decoder subplugin tests: crafted tensors → expected media/labels/boxes.
+
+Models the reference decoder coverage (golden byte-compare in SSAT suites,
+tests/nnstreamer_decoder*/); here expectations are programmatic.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+from nnstreamer_tpu.elements import TensorDecoder, TensorSink
+from nnstreamer_tpu.tensor import TensorBuffer
+from nnstreamer_tpu.decoders import list_decoders
+
+
+def tcaps(dims, types, n=1, rate="30/1"):
+    return (f"other/tensors,format=static,num_tensors={n},dimensions={dims},"
+            f"types={types},framerate={rate}")
+
+
+def decode_one(caps, decoder_props, tensors):
+    p = Pipeline()
+    src = AppSrc("src", caps=caps)
+    dec = TensorDecoder("d", **decoder_props)
+    sink = TensorSink("out")
+    p.add(src, dec, sink)
+    p.link(src, dec, sink)
+    src.push_buffer(TensorBuffer(tensors=tensors, pts=0))
+    src.end_of_stream()
+    p.run(timeout=10)
+    return sink
+
+
+class TestRegistry:
+    def test_modes_present(self):
+        modes = list_decoders()
+        for m in ("image_labeling", "bounding_boxes", "image_segment",
+                  "pose_estimation", "direct_video", "octet_stream"):
+            assert m in modes, m
+
+
+class TestImageLabel:
+    def test_argmax_label(self, tmp_path):
+        labels = tmp_path / "labels.txt"
+        labels.write_text("cat\ndog\nbird\n")
+        scores = np.array([0.1, 0.9, 0.2], np.float32)
+        sink = decode_one(tcaps("3", "float32"),
+                          {"mode": "image_labeling", "option1": str(labels)},
+                          [scores])
+        out = sink.results[0]
+        assert out.extra["label"] == "dog"
+        assert out.extra["index"] == 1
+        assert bytes(out.np(0)) == b"dog"
+        assert sink.caps.first().name == "text/x-raw"
+
+    def test_without_labels_uses_index(self):
+        scores = np.zeros(10, np.float32)
+        scores[7] = 1
+        sink = decode_one(tcaps("10", "float32"),
+                          {"mode": "image_labeling"}, [scores])
+        assert sink.results[0].extra["label"] == "7"
+
+
+class TestDirectVideo:
+    def test_rgb(self):
+        frame = np.random.default_rng(0).integers(
+            0, 255, (8, 8, 3), dtype=np.uint8)
+        sink = decode_one(tcaps("3:8:8", "uint8"),
+                          {"mode": "direct_video"}, [frame])
+        st = sink.caps.first()
+        assert st.name == "video/x-raw"
+        assert st.get("format") == "RGB"
+        assert st.get("width") == 8
+        np.testing.assert_array_equal(sink.results[0].np(0), frame)
+
+    def test_rejects_float(self):
+        from nnstreamer_tpu.pipeline import PipelineError
+
+        with pytest.raises(PipelineError):
+            decode_one(tcaps("3:8:8", "float32"),
+                       {"mode": "direct_video"},
+                       [np.zeros((8, 8, 3), np.float32)])
+
+
+class TestBoundingBoxes:
+    def test_raw_scheme_draws(self):
+        # one confident box: class 1, score .9, covering center area
+        rows = np.array([[1, 0.9, 0.25, 0.25, 0.75, 0.75],
+                         [2, 0.1, 0, 0, 1, 1]], np.float32)  # below thresh
+        sink = decode_one(
+            tcaps("6:2", "float32"),
+            {"mode": "bounding_boxes", "option1": "raw",
+             "option4": "64:64"},
+            [rows])
+        out = sink.results[0]
+        objs = out.extra["objects"]
+        assert len(objs) == 1
+        assert objs[0].class_id == 1
+        canvas = out.np(0)
+        assert canvas.shape == (64, 64, 4)
+        assert canvas[16, 32].any()  # top edge drawn
+        assert not canvas[0, 0].any()  # outside box transparent
+
+    def test_nms_merges_overlaps(self):
+        rows = np.array([[1, 0.9, 0.2, 0.2, 0.8, 0.8],
+                         [1, 0.8, 0.22, 0.22, 0.82, 0.82],
+                         [1, 0.7, 0.21, 0.2, 0.81, 0.8]], np.float32)
+        sink = decode_one(
+            tcaps("6:3", "float32"),
+            {"mode": "bounding_boxes", "option1": "raw"},
+            [rows])
+        assert len(sink.results[0].extra["objects"]) == 1
+
+    def test_mobilenet_ssd_with_priors(self, tmp_path):
+        # 2 anchors, identity-ish priors: cy cx h w rows
+        priors = tmp_path / "priors.txt"
+        priors.write_text("0.5 0.5\n0.5 0.5\n1.0 1.0\n1.0 1.0\n")
+        boxes = np.zeros((2, 4), np.float32)  # zero offsets = centered box
+        scores = np.zeros((2, 3), np.float32)
+        scores[0, 2] = 0.95
+        sink = decode_one(
+            tcaps("4:2.3:2", "float32.float32", n=2),
+            {"mode": "bounding_boxes", "option1": "mobilenet-ssd",
+             "option3": str(priors)},
+            [boxes, scores])
+        objs = sink.results[0].extra["objects"]
+        assert len(objs) == 1
+        assert objs[0].class_id == 2
+        assert abs(objs[0].ymin - 0.0) < 1e-6  # 0.5±0.5 box
+        assert abs(objs[0].ymax - 1.0) < 1e-6
+
+    def test_yolov5_scheme(self):
+        # one cell: cx,cy,w,h in px(64 input), obj, 2 class scores
+        pred = np.array([[32, 32, 32, 32, 1.0, 0.1, 0.9]], np.float32)
+        sink = decode_one(
+            tcaps("7:1", "float32"),
+            {"mode": "bounding_boxes", "option1": "yolov5",
+             "option5": "64:64"},
+            [pred])
+        objs = sink.results[0].extra["objects"]
+        assert len(objs) == 1
+        assert objs[0].class_id == 1
+        assert abs(objs[0].xmin - 0.25) < 1e-5
+
+
+class TestImageSegment:
+    def test_argmax_colorization(self):
+        scores = np.zeros((4, 4, 3), np.float32)
+        scores[:2, :, 1] = 1  # top half class 1
+        scores[2:, :, 2] = 1  # bottom half class 2
+        sink = decode_one(tcaps("3:4:4", "float32"),
+                          {"mode": "image_segment"}, [scores])
+        out = sink.results[0]
+        cm = out.extra["class_map"]
+        assert (cm[:2] == 1).all()
+        assert (cm[2:] == 2).all()
+        rgba = out.np(0)
+        assert rgba.shape == (4, 4, 4)
+        assert (rgba[0, 0] != rgba[3, 0]).any()
+
+
+class TestPose:
+    def test_keypoint_extraction(self):
+        hh, ww, k = 8, 8, 17
+        heat = np.zeros((hh, ww, k), np.float32)
+        for i in range(k):
+            heat[i % hh, (i * 2) % ww, i] = 1.0
+        offs = np.zeros((hh, ww, 2 * k), np.float32)
+        sink = decode_one(
+            tcaps(f"{k}:{ww}:{hh}.{2*k}:{ww}:{hh}",
+                  "float32.float32", n=2),
+            {"mode": "pose_estimation", "option1": "64:64",
+             "option2": "64:64"},
+            [heat, offs])
+        out = sink.results[0]
+        kps = out.extra["keypoints"]
+        assert len(kps) == k
+        x0, y0, s0 = kps[0]
+        assert s0 == 1.0
+        assert x0 == 0.0 and y0 == 0.0
+        canvas = out.np(0)
+        assert canvas.shape == (64, 64, 4)
+        assert canvas.any()
+
+
+class TestOctetStream:
+    def test_flatten(self):
+        arr = np.arange(6, dtype=np.uint8).reshape(2, 3)
+        sink = decode_one(tcaps("3:2", "uint8"),
+                          {"mode": "octet_stream"}, [arr])
+        np.testing.assert_array_equal(sink.results[0].np(0),
+                                      np.arange(6, dtype=np.uint8))
+        assert sink.caps.first().name == "application/octet-stream"

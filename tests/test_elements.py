@@ -1,0 +1,401 @@
+"""Element library tests: transform/mux/demux/merge/split/aggregator/if/
+rate/sparse/crop/repo/datarepo — golden-style expectations modeled on the
+reference SSAT suites (tests/nnstreamer_*/runTest.sh byte-compare patterns).
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.pipeline import AppSrc, Pipeline, Queue
+from nnstreamer_tpu.elements import (TensorAggregator, TensorDemux,
+                                     TensorIf, TensorMerge, TensorMux,
+                                     TensorSink, TensorSplit,
+                                     TensorTransform, register_if_custom)
+from nnstreamer_tpu.tensor import TensorBuffer
+
+
+def tcaps(dims="4", types="float32", n=1, rate="30/1"):
+    return (f"other/tensors,format=static,num_tensors={n},dimensions={dims},"
+            f"types={types},framerate={rate}")
+
+
+def run_chain(src_caps, element, buffers, timeout=10):
+    """appsrc ! element ! tensor_sink helper; returns sink results."""
+    p = Pipeline()
+    src = AppSrc("src", caps=src_caps)
+    sink = TensorSink("out")
+    p.add(src, element, sink)
+    p.link(src, element, sink)
+    for b in buffers:
+        src.push_buffer(b)
+    src.end_of_stream()
+    p.run(timeout=timeout)
+    return sink
+
+
+class TestTransform:
+    def test_typecast(self):
+        sink = run_chain(
+            tcaps("4", "uint8"),
+            TensorTransform("t", mode="typecast", option="float32"),
+            [TensorBuffer(tensors=[np.array([1, 2, 3, 4], np.uint8)], pts=0)])
+        out = sink.results[0].np(0)
+        assert out.dtype == np.float32
+        assert sink.caps.first().get("types") == "float32"
+
+    def test_arithmetic_chain(self):
+        sink = run_chain(
+            tcaps("3", "uint8"),
+            TensorTransform("t", mode="arithmetic",
+                            option="typecast:float32,add:-127.5,div:127.5"),
+            [TensorBuffer(tensors=[np.array([0, 127, 255], np.uint8)],
+                          pts=0)])
+        np.testing.assert_allclose(sink.results[0].np(0),
+                                   [-1.0, -0.00392157, 1.0], atol=1e-5)
+
+    def test_arithmetic_per_channel(self):
+        sink = run_chain(
+            tcaps("3:2", "float32"),
+            TensorTransform("t", mode="arithmetic", option="add:1,2,3"),
+            [TensorBuffer(tensors=[np.zeros((2, 3), np.float32)], pts=0)])
+        np.testing.assert_array_equal(sink.results[0].np(0),
+                                      [[1, 2, 3], [1, 2, 3]])
+
+    def test_transpose(self):
+        # reference dims (3,4) -> perm 1:0 -> (4,3); numpy (4,3)->(3,4)
+        sink = run_chain(
+            tcaps("3:4", "float32"),
+            TensorTransform("t", mode="transpose", option="1:0"),
+            [TensorBuffer(tensors=[np.arange(12, np.float32).reshape(4, 3)
+                                   if False else
+                                   np.arange(12, dtype=np.float32)
+                                   .reshape(4, 3)], pts=0)])
+        out = sink.results[0].np(0)
+        assert out.shape == (3, 4)
+        np.testing.assert_array_equal(
+            out, np.arange(12, dtype=np.float32).reshape(4, 3).T)
+
+    def test_stand_default(self):
+        data = np.array([1, 2, 3, 4], np.float32)
+        sink = run_chain(
+            tcaps("4", "float32"),
+            TensorTransform("t", mode="stand", option="default"),
+            [TensorBuffer(tensors=[data], pts=0)])
+        out = sink.results[0].np(0)
+        assert abs(out.mean()) < 1e-5
+        assert abs(out.std() - 1.0) < 1e-3
+
+    def test_clamp(self):
+        sink = run_chain(
+            tcaps("4", "float32"),
+            TensorTransform("t", mode="clamp", option="0:1"),
+            [TensorBuffer(tensors=[np.array([-5, 0.5, 2, 1], np.float32)],
+                          pts=0)])
+        np.testing.assert_array_equal(sink.results[0].np(0), [0, 0.5, 1, 1])
+
+    def test_dimchg(self):
+        # dims (3,224,224) NHWC->NCHW-ish move: dim 0 -> position 2
+        sink = run_chain(
+            tcaps("3:4:5", "float32"),
+            TensorTransform("t", mode="dimchg", option="0:2"),
+            [TensorBuffer(tensors=[np.zeros((5, 4, 3), np.float32)], pts=0)])
+        assert sink.results[0].np(0).shape == (3, 5, 4)
+        assert sink.caps.first().get("dimensions") == "4:5:3"
+
+    def test_apply_selective(self):
+        p = Pipeline()
+        src = AppSrc("src", caps=tcaps("2.2", "float32.float32", n=2))
+        t = TensorTransform("t", mode="arithmetic", option="mul:10",
+                            apply="0")
+        sink = TensorSink("out")
+        p.add(src, t, sink)
+        p.link(src, t, sink)
+        src.push_buffer(TensorBuffer(
+            tensors=[np.ones(2, np.float32), np.ones(2, np.float32)], pts=0))
+        src.end_of_stream()
+        p.run(timeout=10)
+        assert sink.results[0].np(0)[0] == 10
+        assert sink.results[0].np(1)[0] == 1
+
+
+class TestMuxDemux:
+    def _mux_pipeline(self, sync_mode="slowest"):
+        p = Pipeline()
+        s1 = AppSrc("s1", caps=tcaps("2", "float32"))
+        s2 = AppSrc("s2", caps=tcaps("3", "float32"))
+        q1, q2 = Queue("q1"), Queue("q2")
+        mux = TensorMux("mux", **{"sync-mode": sync_mode})
+        sink = TensorSink("out")
+        p.add(s1, s2, q1, q2, mux, sink)
+        p.link(s1, q1, mux)
+        p.link(s2, q2)
+        p.link(q2, mux)
+        p.link(mux, sink)
+        return p, s1, s2, sink
+
+    def test_mux_combines(self):
+        p, s1, s2, sink = self._mux_pipeline()
+        for i in range(3):
+            s1.push_buffer(TensorBuffer(
+                tensors=[np.full(2, i, np.float32)], pts=i * 100))
+            s2.push_buffer(TensorBuffer(
+                tensors=[np.full(3, 10 + i, np.float32)], pts=i * 100))
+        s1.end_of_stream()
+        s2.end_of_stream()
+        p.run(timeout=10)
+        assert len(sink.results) == 3
+        frame = sink.results[0]
+        assert frame.num_tensors == 2
+        assert frame.np(0).shape == (2,)
+        assert frame.np(1).shape == (3,)
+        st = sink.caps.first()
+        assert st.get("num_tensors") == 2
+        assert st.get("dimensions") == "2.3"
+
+    def test_demux_splits(self):
+        p = Pipeline()
+        src = AppSrc("src", caps=tcaps("2.3", "float32.float32", n=2))
+        demux = TensorDemux("d")
+        o1, o2 = TensorSink("o1"), TensorSink("o2")
+        p.add(src, demux, o1, o2)
+        p.link(src, demux, o1)
+        p.link(demux, o2)
+        src.push_buffer(TensorBuffer(tensors=[
+            np.ones(2, np.float32), np.zeros(3, np.float32)], pts=0))
+        src.end_of_stream()
+        p.run(timeout=10)
+        assert o1.results[0].np(0).shape == (2,)
+        assert o2.results[0].np(0).shape == (3,)
+
+    def test_demux_tensorpick(self):
+        p = Pipeline()
+        src = AppSrc("src", caps=tcaps("2.3", "float32.float32", n=2))
+        demux = TensorDemux("d", tensorpick="1")
+        o1 = TensorSink("o1")
+        p.add(src, demux, o1)
+        p.link(src, demux, o1)
+        src.push_buffer(TensorBuffer(tensors=[
+            np.ones(2, np.float32), np.zeros(3, np.float32)], pts=0))
+        src.end_of_stream()
+        p.run(timeout=10)
+        assert o1.results[0].np(0).shape == (3,)
+
+
+class TestMergeSplit:
+    def test_merge_concat_dim0(self):
+        p = Pipeline()
+        s1 = AppSrc("s1", caps=tcaps("2", "float32"))
+        s2 = AppSrc("s2", caps=tcaps("2", "float32"))
+        q1, q2 = Queue("q1"), Queue("q2")
+        merge = TensorMerge("m", mode="linear", option=0)
+        sink = TensorSink("out")
+        p.add(s1, s2, q1, q2, merge, sink)
+        p.link(s1, q1, merge)
+        p.link(s2, q2)
+        p.link(q2, merge)
+        p.link(merge, sink)
+        s1.push_buffer(TensorBuffer(
+            tensors=[np.array([1, 2], np.float32)], pts=0))
+        s2.push_buffer(TensorBuffer(
+            tensors=[np.array([3, 4], np.float32)], pts=0))
+        s1.end_of_stream()
+        s2.end_of_stream()
+        p.run(timeout=10)
+        np.testing.assert_array_equal(sink.results[0].np(0), [1, 2, 3, 4])
+        assert sink.caps.first().get("dimensions") == "4"
+
+    def test_split_segments(self):
+        p = Pipeline()
+        src = AppSrc("src", caps=tcaps("5", "float32"))
+        split = TensorSplit("s", tensorseg="2,3", option=0)
+        o1, o2 = TensorSink("o1"), TensorSink("o2")
+        p.add(src, split, o1, o2)
+        p.link(src, split, o1)
+        p.link(split, o2)
+        src.push_buffer(TensorBuffer(
+            tensors=[np.array([1, 2, 3, 4, 5], np.float32)], pts=0))
+        src.end_of_stream()
+        p.run(timeout=10)
+        np.testing.assert_array_equal(o1.results[0].np(0), [1, 2])
+        np.testing.assert_array_equal(o2.results[0].np(0), [3, 4, 5])
+
+
+class TestAggregator:
+    def test_tumbling_window(self):
+        agg = TensorAggregator("a", **{"frames-out": 2})
+        bufs = [TensorBuffer(tensors=[np.full(3, i, np.float32)],
+                             pts=i * 100) for i in range(4)]
+        sink = run_chain(tcaps("3", "float32"), agg, bufs)
+        assert len(sink.results) == 2
+        assert sink.results[0].np(0).shape == (2, 3)
+        np.testing.assert_array_equal(sink.results[0].np(0)[0],
+                                      np.zeros(3))
+        np.testing.assert_array_equal(sink.results[1].np(0)[1],
+                                      np.full(3, 3))
+
+    def test_sliding_window(self):
+        agg = TensorAggregator("a", **{"frames-out": 2, "frames-flush": 1})
+        bufs = [TensorBuffer(tensors=[np.full(2, i, np.float32)],
+                             pts=i * 100) for i in range(3)]
+        sink = run_chain(tcaps("2", "float32"), agg, bufs)
+        assert len(sink.results) == 2  # windows [0,1], [1,2]
+        np.testing.assert_array_equal(sink.results[1].np(0)[0],
+                                      np.full(2, 1))
+
+    def test_concat_along_dim(self):
+        # reference example: 300:300 ×2frames → 300:600 along dim 1
+        agg = TensorAggregator("a", **{"frames-out": 2, "frames-dim": 1})
+        bufs = [TensorBuffer(tensors=[np.ones((4, 3), np.float32) * i],
+                             pts=i) for i in range(2)]
+        sink = run_chain(tcaps("3:4", "float32"), agg, bufs)
+        assert sink.results[0].np(0).shape == (8, 3)
+        assert sink.caps.first().get("dimensions") == "3:8"
+
+
+class TestTensorIf:
+    def test_average_routing_two_pads(self):
+        p = Pipeline()
+        src = AppSrc("src", caps=tcaps("2", "float32"))
+        tif = TensorIf("if", **{"compared-value": "tensor-average",
+                                "operator": "ge", "supplied-value": "5",
+                                "else": "passthrough"})
+        then_sink, else_sink = TensorSink("then"), TensorSink("else")
+        p.add(src, tif, then_sink, else_sink)
+        p.link(src, tif, then_sink)
+        p.link(tif, else_sink)
+        src.push_buffer(TensorBuffer(
+            tensors=[np.array([10, 10], np.float32)], pts=0))
+        src.push_buffer(TensorBuffer(
+            tensors=[np.array([1, 1], np.float32)], pts=1))
+        src.end_of_stream()
+        p.run(timeout=10)
+        assert len(then_sink.results) == 1
+        assert len(else_sink.results) == 1
+        assert then_sink.results[0].np(0)[0] == 10
+
+    def test_skip_behavior(self):
+        tif = TensorIf("if", **{"compared-value": "tensor-average",
+                                "operator": "gt", "supplied-value": "100",
+                                "then": "passthrough", "else": "skip"})
+        bufs = [TensorBuffer(tensors=[np.full(2, v, np.float32)], pts=i)
+                for i, v in enumerate([200, 5, 300])]
+        sink = run_chain(tcaps("2", "float32"), tif, bufs)
+        assert len(sink.results) == 2
+
+    def test_fill_zero(self):
+        tif = TensorIf("if", **{"compared-value": "tensor-average",
+                                "operator": "gt", "supplied-value": "100",
+                                "then": "passthrough", "else": "fill-zero"})
+        bufs = [TensorBuffer(tensors=[np.full(2, 5, np.float32)], pts=0)]
+        sink = run_chain(tcaps("2", "float32"), tif, bufs)
+        np.testing.assert_array_equal(sink.results[0].np(0), [0, 0])
+
+    def test_custom_condition(self):
+        register_if_custom("odd_pts", lambda buf: (buf.pts or 0) % 2)
+        tif = TensorIf("if", **{"compared-value": "custom",
+                                "compared-value-option": "odd_pts",
+                                "operator": "eq", "supplied-value": "1",
+                                "then": "passthrough", "else": "skip"})
+        bufs = [TensorBuffer(tensors=[np.zeros(1, np.float32)], pts=i)
+                for i in range(4)]
+        sink = run_chain(tcaps("1", "float32"), tif, bufs)
+        assert len(sink.results) == 2
+
+
+class TestRate:
+    def test_downsample(self):
+        from nnstreamer_tpu.elements import TensorRate
+
+        rate = TensorRate("r", framerate="15/1")
+        bufs = [TensorBuffer(tensors=[np.zeros(1, np.float32)],
+                             pts=i * 33_333_333, duration=33_333_333)
+                for i in range(10)]
+        sink = run_chain(tcaps("1", "float32"), rate, bufs)
+        assert 4 <= len(sink.results) <= 6  # ~half of 10
+        assert sink.caps.first().get("framerate").numerator == 15
+        assert rate.dropped > 0
+
+
+class TestSparse:
+    def test_round_trip(self):
+        p = parse_launch(
+            "videotestsrc num-buffers=2 pattern=checkers ! "
+            "video/x-raw,format=GRAY8,width=16,height=16 ! "
+            "tensor_converter ! tensor_sparse_enc ! tensor_sparse_dec ! "
+            "tensor_sink name=out")
+        p.run(timeout=10)
+        out = p.get("out").results
+        assert out[0].np(0).shape == (16, 16, 1)
+
+    def test_sparse_saves_bytes(self):
+        from nnstreamer_tpu.elements.sparse import (sparse_decode,
+                                                    sparse_encode)
+
+        arr = np.zeros((100,), np.float32)
+        arr[3] = 7
+        blob = sparse_encode(arr)
+        assert len(blob) < arr.nbytes
+        back = sparse_decode(blob)
+        np.testing.assert_array_equal(back, arr)
+
+
+class TestCrop:
+    def test_crop_regions(self):
+        from nnstreamer_tpu.elements import TensorCrop
+        from nnstreamer_tpu.tensor import TensorFormat
+
+        p = Pipeline()
+        raw = AppSrc("raw", caps=tcaps("3:8:8", "uint8"))
+        info = AppSrc("info", caps=tcaps("4:1", "int32"))
+        crop = TensorCrop("c")
+        sink = TensorSink("out")
+        p.add(raw, info, crop, sink)
+        raw.src_pad.link(crop.sink_pads[0])
+        info.src_pad.link(crop.sink_pads[1])
+        p.link(crop, sink)
+        frame = np.arange(8 * 8 * 3, dtype=np.uint8).reshape(8, 8, 3)
+        raw.push_buffer(TensorBuffer(tensors=[frame], pts=0))
+        info.push_buffer(TensorBuffer(
+            tensors=[np.array([[2, 1, 4, 3]], np.int32)], pts=0))
+        raw.end_of_stream()
+        info.end_of_stream()
+        p.run(timeout=10)
+        out = p.get("out").results[0]
+        assert out.np(0).shape == (3, 4, 3)  # h=3, w=4
+        np.testing.assert_array_equal(out.np(0), frame[1:4, 2:6])
+
+
+class TestRepo:
+    def test_repo_loop(self):
+        from nnstreamer_tpu.elements.repo import repo
+
+        repo.clear()
+        p1 = parse_launch(
+            "videotestsrc num-buffers=3 ! "
+            "video/x-raw,format=GRAY8,width=4,height=4 ! tensor_converter ! "
+            "tensor_reposink slot-index=7")
+        p2 = parse_launch(
+            "tensor_reposrc slot-index=7 ! tensor_sink name=out")
+        p1.play()
+        p2.play()
+        p2.wait(timeout=10)
+        p1.wait(timeout=10)
+        p1.stop()
+        p2.stop()
+        assert len(p2.get("out").results) == 3
+
+
+class TestDataRepoSrc:
+    def test_reads_frames(self, tmp_path):
+        data = np.arange(12, dtype=np.float32).tobytes()
+        f = tmp_path / "data.raw"
+        f.write_bytes(data)
+        p = parse_launch(
+            f"datareposrc location={f} input-dim=4 input-type=float32 "
+            "epochs=2 ! tensor_sink name=out")
+        p.run(timeout=10)
+        out = p.get("out").results
+        assert len(out) == 6  # 3 frames × 2 epochs
+        np.testing.assert_array_equal(out[0].np(0), [0, 1, 2, 3])
+        np.testing.assert_array_equal(out[5].np(0), [8, 9, 10, 11])

@@ -1,0 +1,233 @@
+"""Filter framework tests: backends, single API, registry, stats.
+
+Models the reference's per-backend conformance suite
+(tests/nnstreamer_filter_extensions_common/unittest_tizen_template.cc.in:
+open/close, invoke, invalid-arg behavior) and single-invoke tests
+(tests/nnstreamer_filter_single/).
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.filter import (Accelerator, FilterError, FilterSingle,
+                                   detect_framework, find_filter,
+                                   list_filters, shared_models)
+from nnstreamer_tpu.filter.backends import (register_custom_easy,
+                                            unregister_custom_easy)
+from nnstreamer_tpu.tensor import TensorsInfo
+
+
+class TestRegistry:
+    def test_builtin_backends(self):
+        for name in ("xla", "custom", "custom-easy", "dummy", "python"):
+            assert name in list_filters()
+
+    def test_find_unknown(self):
+        with pytest.raises(KeyError):
+            find_filter("tensorrt")
+
+    def test_accelerator_parse(self):
+        assert Accelerator.parse("true:tpu") == [Accelerator.TPU]
+        assert Accelerator.parse("true:tpu,cpu") == [Accelerator.TPU,
+                                                     Accelerator.CPU]
+        assert Accelerator.parse("false") == [Accelerator.NONE]
+        assert Accelerator.parse(None) == [Accelerator.AUTO]
+        assert Accelerator.parse("true:bogus") == [Accelerator.AUTO]
+
+    def test_auto_detect(self):
+        assert detect_framework("mobilenet_v2") == "xla"
+        assert detect_framework(lambda ins: ins) == "custom"
+        with pytest.raises(FilterError):
+            detect_framework("no_such_model_anywhere")
+
+
+class _Passthrough:
+    """Scaffold custom filter (reference
+    tests/nnstreamer_example/custom_example_passthrough)."""
+
+    def __init__(self, dims="4", types="float32"):
+        self.info = TensorsInfo.from_strings(dims, types)
+
+    def get_input_info(self):
+        return self.info
+
+    def get_output_info(self):
+        return self.info
+
+    def invoke(self, inputs):
+        return inputs
+
+
+class TestCustomBackends:
+    def test_custom_object(self):
+        s = FilterSingle(framework="custom", model=_Passthrough())
+        with s:
+            out, = s.invoke([np.arange(4, dtype=np.float32)])
+            np.testing.assert_array_equal(out, [0, 1, 2, 3])
+
+    def test_custom_bare_callable(self):
+        info = TensorsInfo.from_strings("4", "float32")
+        s = FilterSingle(framework="custom",
+                         model=lambda ins: [ins[0] * 3],
+                         input_info=info, output_info=info)
+        with s:
+            out, = s.invoke([np.ones(4, np.float32)])
+            assert out.sum() == 12
+
+    def test_custom_easy_lifecycle(self):
+        info = TensorsInfo.from_strings("2", "float32")
+        register_custom_easy("neg", lambda ins: [-ins[0]], info, info)
+        try:
+            s = FilterSingle(framework="custom-easy", model="neg")
+            with s:
+                out, = s.invoke([np.array([1, -2], np.float32)])
+                np.testing.assert_array_equal(out, [-1, 2])
+        finally:
+            unregister_custom_easy("neg")
+
+    def test_dummy_backend(self):
+        s = FilterSingle(framework="dummy",
+                         input_info=TensorsInfo.from_strings("3:4", "uint8"),
+                         output_info=TensorsInfo.from_strings("5", "float32"))
+        with s:
+            out, = s.invoke([np.zeros((4, 3), np.uint8)])
+            assert out.shape == (5,)
+            assert out.dtype == np.float32
+
+    def test_invoke_shape_validation(self):
+        s = FilterSingle(framework="custom", model=_Passthrough())
+        with s:
+            with pytest.raises(FilterError):
+                s.invoke([np.zeros(5, np.float32)])  # wrong shape
+            with pytest.raises(FilterError):
+                s.invoke([])  # wrong count
+
+    def test_python_script_backend(self, tmp_path):
+        script = tmp_path / "scaler.py"
+        script.write_text(
+            "import numpy as np\n"
+            "class CustomFilter:\n"
+            "    def getInputDim(self):\n"
+            "        return [((4,), 'float32')]\n"
+            "    def getOutputDim(self):\n"
+            "        return [((4,), 'float32')]\n"
+            "    def invoke(self, inputs):\n"
+            "        return [inputs[0] * 2]\n")
+        s = FilterSingle(framework="python", model=str(script))
+        with s:
+            out, = s.invoke([np.ones(4, np.float32)])
+            assert out.sum() == 8
+        # auto-detect by .py extension
+        assert detect_framework(str(script)) == "python"
+
+
+class TestSharedModel:
+    def test_shared_key_reuses_backend(self):
+        info = TensorsInfo.from_strings("2", "float32")
+        opened = []
+        register_custom_easy("shared_fn",
+                             lambda ins: [ins[0]], info, info)
+        try:
+            a = FilterSingle(framework="custom-easy", model="shared_fn",
+                             shared_key="k1")
+            b = FilterSingle(framework="custom-easy", model="shared_fn",
+                             shared_key="k1")
+            a.start()
+            b.start()
+            assert a.fw is b.fw
+            a.stop()
+            assert b.fw.opened  # still alive for b
+            b.stop()
+        finally:
+            unregister_custom_easy("shared_fn")
+            shared_models.clear()
+
+
+class TestFilterElement:
+    def test_pipeline_with_dummy(self):
+        p = parse_launch(
+            "videotestsrc num-buffers=4 ! "
+            "video/x-raw,format=RGB,width=8,height=8,framerate=30/1 ! "
+            "tensor_converter ! "
+            "tensor_filter framework=dummy input-dim=3:8:8 input-type=uint8 "
+            "output-dim=7 output-type=float32 name=f ! tensor_sink name=out")
+        p.run(timeout=15)
+        out = p.get("out")
+        assert len(out.results) == 4
+        assert out.results[0].np(0).shape == (7,)
+        assert p.get("f").latency >= 0
+        cfg = out.caps.first()
+        assert cfg.get("dimensions") == "7"
+
+    def test_input_combination(self):
+        info = TensorsInfo.from_strings("4", "float32")
+        register_custom_easy("pick_second", lambda ins: [ins[0] + 1],
+                             info, info)
+        try:
+            from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+            from nnstreamer_tpu.elements import TensorFilter, TensorSink
+            from nnstreamer_tpu.tensor import TensorBuffer
+
+            p = Pipeline()
+            src = AppSrc("src", caps=(
+                "other/tensors,format=static,num_tensors=2,dimensions=8.4,"
+                "types=float32.float32,framerate=30/1"))
+            f = TensorFilter("f", framework="custom-easy",
+                             model="pick_second",
+                             **{"input-combination": "1"})
+            sink = TensorSink("out")
+            p.add(src, f, sink)
+            p.link(src, f, sink)
+            src.push_buffer(TensorBuffer(tensors=[
+                np.zeros(8, np.float32), np.full(4, 5, np.float32)], pts=0))
+            src.end_of_stream()
+            p.run(timeout=10)
+            np.testing.assert_array_equal(sink.results[0].np(0),
+                                          np.full(4, 6, np.float32))
+        finally:
+            unregister_custom_easy("pick_second")
+
+    def test_output_combination_passthrough(self):
+        info = TensorsInfo.from_strings("4", "float32")
+        register_custom_easy("sum1", lambda ins: [ins[0] * 0 + 1], info, info)
+        try:
+            from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+            from nnstreamer_tpu.elements import TensorFilter, TensorSink
+            from nnstreamer_tpu.tensor import TensorBuffer
+
+            p = Pipeline()
+            src = AppSrc("src", caps=(
+                "other/tensors,format=static,num_tensors=1,dimensions=4,"
+                "types=float32,framerate=30/1"))
+            f = TensorFilter("f", framework="custom-easy", model="sum1",
+                             **{"output-combination": "0/0"})
+            sink = TensorSink("out")
+            p.add(src, f, sink)
+            p.link(src, f, sink)
+            src.push_buffer(TensorBuffer(
+                tensors=[np.full(4, 7, np.float32)], pts=0))
+            src.end_of_stream()
+            p.run(timeout=10)
+            res = sink.results[0]
+            assert res.num_tensors == 2
+            np.testing.assert_array_equal(res.np(0), np.full(4, 7, np.float32))
+            np.testing.assert_array_equal(res.np(1), np.ones(4, np.float32))
+        finally:
+            unregister_custom_easy("sum1")
+
+
+@pytest.mark.slow
+class TestXLABackend:
+    def test_mobilenet_single(self):
+        s = FilterSingle(framework="xla", model="mobilenet_v2",
+                         custom="input_size:32")
+        with s:
+            frame = np.random.default_rng(0).integers(
+                0, 255, (32, 32, 3), dtype=np.uint8)
+            out, = s.invoke([frame])
+            assert out.shape == (1001,)
+            assert out.dtype == np.float32
+            # deterministic across invokes
+            out2, = s.invoke([frame])
+            np.testing.assert_allclose(out, out2)
