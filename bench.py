@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Flagship benchmark: MobileNetV2 224×224 image-labeling pipeline.
+
+Reproduces BASELINE.md config 1 (the reference's gst-launch MobileNetV2
+image-labeling pipeline, north star ≥30 fps end-to-end on TPU v5e-1):
+videotestsrc → tensor_converter → tensor_filter(xla, MobileNetV2 bf16)
+→ tensor_decoder(image_labeling) → tensor_sink, measured end-to-end on the
+default JAX device (TPU when present).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": fps, "unit": "fps", "vs_baseline": fps/30}
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+import numpy as np  # noqa: E402
+
+N_FRAMES = 150
+BASELINE_FPS = 30.0  # north-star target (BASELINE.json)
+
+
+def main() -> None:
+    import jax
+
+    from nnstreamer_tpu import parse_launch
+
+    device = jax.devices()[0]
+    on_tpu = device.platform != "cpu"
+    dtype_prop = "" if on_tpu else ",dtype:float32"
+
+    p = parse_launch(
+        f"videotestsrc num-buffers={N_FRAMES} pattern=random ! "
+        "video/x-raw,format=RGB,width=224,height=224,framerate=120/1 ! "
+        "tensor_converter ! "
+        "tensor_filter framework=xla model=mobilenet_v2"
+        f" custom=seed:0{dtype_prop} name=f ! "
+        "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+
+    stamps = []
+    p.get("out").connect("new-data", lambda buf: stamps.append(
+        time.monotonic()))
+    try:
+        p.play()
+        p.wait(timeout=1200)
+        n = len(stamps)
+        if n < 2:
+            raise SystemExit("benchmark produced no frames")
+        # skip the first frames (pipeline ramp) for steady-state fps
+        skip = min(10, n // 5)
+        span = stamps[-1] - stamps[skip]
+        fps = (n - 1 - skip) / span if span > 0 else 0.0
+
+        # p50 sync-invoke latency on the still-open backend
+        fw = p.get("f").fw
+        frame = np.random.default_rng(0).integers(
+            0, 255, (224, 224, 3), dtype=np.uint8)
+        lats = []
+        for _ in range(30):
+            t0 = time.monotonic()
+            jax.block_until_ready(fw.invoke([frame]))
+            lats.append((time.monotonic() - t0) * 1000)
+        lats.sort()
+        p50_ms = lats[len(lats) // 2]
+    finally:
+        p.stop()
+
+    print(json.dumps({
+        "metric": "mobilenet_v2_224_image_labeling_e2e_fps",
+        "value": round(fps, 2),
+        "unit": "fps",
+        "vs_baseline": round(fps / BASELINE_FPS, 3),
+        "p50_invoke_ms": round(p50_ms, 3),
+        "device": str(device),
+        "frames": n,
+    }))
+
+
+if __name__ == "__main__":
+    main()

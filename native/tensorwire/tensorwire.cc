@@ -1,0 +1,145 @@
+// tensorwire: native kernels for the stream runtime's host-side hot paths.
+//
+// TPU-native parity with the reference's native runtime pieces (SURVEY.md):
+// the reference implements its transform SIMD kernels in ORC
+// (gst/nnstreamer/elements/nnstreamer-orc.orc), its stride-unpadding video
+// memcpy in C (gsttensor_converter.c:1062-1107), and its sparse codec in C
+// (gsttensor_sparseutil.c).  Here the equivalents are C++17, exported with a
+// plain C ABI consumed via ctypes (no pybind11 in the image).
+//
+// Build: make -C native  (produces libnnstw.so)
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Sparse codec (COO: values[nnz] ++ u32 flat indices[nnz])
+// Parity: gsttensor_sparseutil.c encode :120-180 / decode :31-62.
+// ---------------------------------------------------------------------------
+
+// Count nonzero elements of a flat typed array.  elem_kind: 0=u8 1=i8 2=u16
+// 3=i16 4=u32 5=i32 6=u64 7=i64 8=f32 9=f64 10=f16/bf16 (2-byte raw).
+static inline bool is_zero(const uint8_t *p, int kind) {
+  switch (kind) {
+    case 8: { float v; std::memcpy(&v, p, 4); return v == 0.0f; }
+    case 9: { double v; std::memcpy(&v, p, 8); return v == 0.0; }
+    default: break;
+  }
+  return false;  // handled generically below
+}
+
+size_t tw_sparse_count(const uint8_t *data, size_t n, size_t esz, int kind) {
+  size_t nnz = 0;
+  if (kind == 8 || kind == 9) {
+    for (size_t i = 0; i < n; ++i)
+      if (!is_zero(data + i * esz, kind)) ++nnz;
+    return nnz;
+  }
+  // integer / raw-bytes dtypes: zero means all bytes zero
+  for (size_t i = 0; i < n; ++i) {
+    const uint8_t *p = data + i * esz;
+    bool z = true;
+    for (size_t b = 0; b < esz; ++b)
+      if (p[b]) { z = false; break; }
+    if (!z) ++nnz;
+  }
+  return nnz;
+}
+
+// Gather nonzero values + indices.  Caller allocates values (nnz*esz) and
+// indices (nnz*4) from tw_sparse_count's answer.  Returns nnz written.
+size_t tw_sparse_gather(const uint8_t *data, size_t n, size_t esz, int kind,
+                        uint8_t *values, uint32_t *indices) {
+  size_t w = 0;
+  for (size_t i = 0; i < n; ++i) {
+    const uint8_t *p = data + i * esz;
+    bool nz;
+    if (kind == 8) { float v; std::memcpy(&v, p, 4); nz = (v != 0.0f); }
+    else if (kind == 9) { double v; std::memcpy(&v, p, 8); nz = (v != 0.0); }
+    else {
+      nz = false;
+      for (size_t b = 0; b < esz; ++b)
+        if (p[b]) { nz = true; break; }
+    }
+    if (nz) {
+      std::memcpy(values + w * esz, p, esz);
+      indices[w] = static_cast<uint32_t>(i);
+      ++w;
+    }
+  }
+  return w;
+}
+
+// Scatter values back into a zeroed dense buffer.
+void tw_sparse_scatter(const uint8_t *values, const uint32_t *indices,
+                       size_t nnz, size_t esz, uint8_t *dense,
+                       size_t dense_elems) {
+  for (size_t i = 0; i < nnz; ++i) {
+    const uint32_t idx = indices[i];
+    if (idx < dense_elems)
+      std::memcpy(dense + static_cast<size_t>(idx) * esz,
+                  values + i * esz, esz);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Video repack (converter hot path)
+// Parity: stride-unpadding memcpy gsttensor_converter.c:1062-1107 and the
+// BGRx/GRAY8 media handling of the converter's video branch.
+// ---------------------------------------------------------------------------
+
+// Copy a strided image into a dense buffer (drop per-row padding).
+void tw_unstride(const uint8_t *src, size_t src_stride, uint8_t *dst,
+                 size_t row_bytes, size_t rows) {
+  for (size_t r = 0; r < rows; ++r)
+    std::memcpy(dst + r * row_bytes, src + r * src_stride, row_bytes);
+}
+
+// BGRx (4 bytes/px) → RGB (3 bytes/px).
+void tw_bgrx_to_rgb(const uint8_t *src, uint8_t *dst, size_t pixels) {
+  for (size_t i = 0; i < pixels; ++i) {
+    dst[i * 3 + 0] = src[i * 4 + 2];
+    dst[i * 3 + 1] = src[i * 4 + 1];
+    dst[i * 3 + 2] = src[i * 4 + 0];
+  }
+}
+
+// GRAY8 → RGB triple.
+void tw_gray_to_rgb(const uint8_t *src, uint8_t *dst, size_t pixels) {
+  for (size_t i = 0; i < pixels; ++i) {
+    dst[i * 3] = dst[i * 3 + 1] = dst[i * 3 + 2] = src[i];
+  }
+}
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli, software table) — frame integrity for the query wire
+// protocol (role of transport checksums in the reference's edge transport).
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_table[256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k)
+      c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t tw_crc32c(const uint8_t *data, size_t n, uint32_t seed) {
+  if (!crc_init_done) crc_init();
+  uint32_t c = ~seed;
+  for (size_t i = 0; i < n; ++i)
+    c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return ~c;
+}
+
+int tw_abi_version() { return 1; }
+
+}  // extern "C"

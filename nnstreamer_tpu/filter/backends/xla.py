@@ -47,7 +47,8 @@ def _enable_compilation_cache() -> None:
 
     cache_dir = os.environ.get(
         "NNS_TPU_COMPILE_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "nnstreamer_tpu_xla"))
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     f"nnstreamer_tpu_xla-{jax.default_backend()}"))
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

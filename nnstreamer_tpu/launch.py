@@ -1,0 +1,58 @@
+"""Command-line pipeline launcher (gst-launch-1.0 role).
+
+Usage::
+
+    python -m nnstreamer_tpu.launch "videotestsrc num-buffers=10 ! \
+        video/x-raw,format=RGB,width=224,height=224 ! tensor_converter ! \
+        tensor_filter framework=xla model=mobilenet_v2 ! \
+        tensor_decoder mode=image_labeling ! tensor_sink name=out" \
+        [--timeout SECONDS] [--print-sink NAME]
+
+The reference's entire user surface is gst-launch strings; this gives the
+TPU framework the same front door.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="nns-launch",
+                                 description="Run a pipeline description")
+    ap.add_argument("pipeline", help="pipeline launch string")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--print-sink", default=None,
+                    help="tensor_sink name whose outputs to print")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    from . import parse_launch
+
+    t0 = time.time()
+    try:
+        p = parse_launch(args.pipeline)
+        if args.print_sink:
+            sink = p.get(args.print_sink)
+            sink.connect("new-data", _print_buffer)
+        p.run(timeout=args.timeout)
+    except Exception as exc:  # noqa: BLE001
+        print(f"ERROR: {exc}", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"pipeline finished in {time.time() - t0:.2f}s",
+              file=sys.stderr)
+    return 0
+
+
+def _print_buffer(buf) -> None:
+    desc = buf.extra.get("label")
+    if desc is None:
+        desc = ", ".join(str(getattr(t, "shape", "?")) for t in buf.tensors)
+    print(f"pts={buf.pts} {desc}")
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,208 @@
+"""ctypes bindings for the native tensorwire library (libnnstw.so).
+
+The native layer mirrors the reference's C hot paths (ORC transform
+kernels, converter stride memcpy, sparse codec — see
+native/tensorwire/tensorwire.cc for the file-level mapping).  Every entry
+point has a numpy fallback so the framework works without the toolchain;
+``available()`` reports which path is active.
+
+The library is built on demand (``make -C native``) the first time it's
+requested, then cached.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libnnstw.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_building: Optional[threading.Thread] = None
+
+# dtype kind codes shared with tensorwire.cc
+_KIND = {"float32": 8, "float64": 9}
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Load libnnstw.so if present; if absent, kick off a BACKGROUND build
+    and serve the numpy fallback meanwhile (a first-use build must not
+    stall a streaming hot path)."""
+    global _lib, _tried, _building
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            if _building is None:
+                _building = threading.Thread(target=_build, daemon=True,
+                                             name="nnstw-build")
+                _building.start()
+            if _building.is_alive():
+                return None  # fallback while the compile runs
+            if not os.path.exists(_SO_PATH):
+                _tried = True  # build finished and failed
+                return None
+        _tried = True
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        if lib.tw_abi_version() != 1:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.tw_sparse_count.restype = ctypes.c_size_t
+        lib.tw_sparse_count.argtypes = [u8p, ctypes.c_size_t,
+                                        ctypes.c_size_t, ctypes.c_int]
+        lib.tw_sparse_gather.restype = ctypes.c_size_t
+        lib.tw_sparse_gather.argtypes = [u8p, ctypes.c_size_t,
+                                         ctypes.c_size_t, ctypes.c_int,
+                                         u8p, u32p]
+        lib.tw_sparse_scatter.argtypes = [u8p, u32p, ctypes.c_size_t,
+                                          ctypes.c_size_t, u8p,
+                                          ctypes.c_size_t]
+        lib.tw_unstride.argtypes = [u8p, ctypes.c_size_t, u8p,
+                                    ctypes.c_size_t, ctypes.c_size_t]
+        lib.tw_bgrx_to_rgb.argtypes = [u8p, u8p, ctypes.c_size_t]
+        lib.tw_gray_to_rgb.argtypes = [u8p, u8p, ctypes.c_size_t]
+        lib.tw_crc32c.restype = ctypes.c_uint32
+        lib.tw_crc32c.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint32]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """Explicit probe: waits for an in-flight background build (hot-path
+    callers never come through here — they just get the fallback)."""
+    lib = _load()
+    if lib is None and _building is not None and _building.is_alive():
+        _building.join(timeout=120)
+        lib = _load()
+    return lib is not None
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def sparse_gather(arr: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Return (values, uint32 flat indices) of nonzero elements."""
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    lib = _load()
+    if lib is None:
+        idx = np.flatnonzero(flat).astype(np.uint32)
+        return flat[idx], idx
+    kind = _KIND.get(flat.dtype.name, 0)
+    esz = flat.dtype.itemsize
+    nnz = lib.tw_sparse_count(_u8(flat.view(np.uint8)), flat.size, esz, kind)
+    values = np.empty(nnz, dtype=flat.dtype)
+    indices = np.empty(nnz, dtype=np.uint32)
+    lib.tw_sparse_gather(_u8(flat.view(np.uint8)), flat.size, esz, kind,
+                         _u8(values.view(np.uint8)),
+                         indices.ctypes.data_as(
+                             ctypes.POINTER(ctypes.c_uint32)))
+    return values, indices
+
+
+def sparse_scatter(values: np.ndarray, indices: np.ndarray,
+                   n_elems: int) -> np.ndarray:
+    """Dense flat array from (values, indices)."""
+    lib = _load()
+    dense = np.zeros(n_elems, dtype=values.dtype)
+    if lib is None:
+        dense[indices] = values
+        return dense
+    lib.tw_sparse_scatter(_u8(np.ascontiguousarray(values).view(np.uint8)),
+                          np.ascontiguousarray(indices).ctypes.data_as(
+                              ctypes.POINTER(ctypes.c_uint32)),
+                          len(values), values.dtype.itemsize,
+                          _u8(dense.view(np.uint8)), n_elems)
+    return dense
+
+
+def bgrx_to_rgb(frame: np.ndarray) -> np.ndarray:
+    """(H, W, 4) BGRx → (H, W, 3) RGB."""
+    h, w = frame.shape[:2]
+    lib = _load()
+    if lib is None:
+        return frame[..., [2, 1, 0]].copy()
+    src = np.ascontiguousarray(frame)
+    dst = np.empty((h, w, 3), np.uint8)
+    lib.tw_bgrx_to_rgb(_u8(src), _u8(dst), h * w)
+    return dst
+
+
+def gray_to_rgb(frame: np.ndarray) -> np.ndarray:
+    """(H, W, 1) GRAY8 → (H, W, 3) RGB."""
+    h, w = frame.shape[:2]
+    lib = _load()
+    src = np.ascontiguousarray(frame)
+    if lib is None:
+        return np.repeat(src.reshape(h, w, 1), 3, axis=2)
+    dst = np.empty((h, w, 3), np.uint8)
+    lib.tw_gray_to_rgb(_u8(src), _u8(dst), h * w)
+    return dst
+
+
+def unstride(src: np.ndarray, src_stride: int, row_bytes: int,
+             rows: int) -> np.ndarray:
+    """Drop per-row padding from a strided image buffer."""
+    flat = np.ascontiguousarray(src).reshape(-1).view(np.uint8)
+    lib = _load()
+    if lib is None:
+        out = np.empty(rows * row_bytes, np.uint8)
+        for r in range(rows):
+            out[r * row_bytes:(r + 1) * row_bytes] = \
+                flat[r * src_stride:r * src_stride + row_bytes]
+        return out
+    dst = np.empty(rows * row_bytes, np.uint8)
+    lib.tw_unstride(_u8(flat), src_stride, _u8(dst), row_bytes, rows)
+    return dst
+
+
+_CRC32C_TABLE: Optional[np.ndarray] = None
+
+
+def _crc32c_table() -> np.ndarray:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = np.empty(256, np.uint32)
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if (c & 1) else (c >> 1)
+            table[i] = c
+        _CRC32C_TABLE = table
+    return _CRC32C_TABLE
+
+
+def crc32c(data: bytes, seed: int = 0) -> int:
+    """CRC-32C (Castagnoli) — the SAME polynomial on both paths so mixed
+    native/fallback hosts agree on checksums."""
+    lib = _load()
+    if lib is None:
+        table = _crc32c_table()
+        c = ~seed & 0xFFFFFFFF
+        for b in data:
+            c = int(table[(c ^ b) & 0xFF]) ^ (c >> 8)
+        return (~c) & 0xFFFFFFFF
+    arr = np.frombuffer(data, np.uint8)
+    return int(lib.tw_crc32c(_u8(arr), len(data), seed))

@@ -1,0 +1,84 @@
+"""Device mesh construction for multi-chip execution.
+
+Net-new TPU capability (SURVEY.md §2.7: the reference has no DP/TP/SP/EP —
+its parallelism is pipeline-threading plus among-device offload; this module
+supplies the missing scale story the TPU-native way): a named
+``jax.sharding.Mesh`` over all addressable devices, with axes
+
+- ``dp``  — data parallel (batch)
+- ``sp``  — sequence/context parallel (ring attention rides this axis)
+- ``tp``  — tensor/model parallel (megatron-style sharded matmuls)
+- ``ep``  — expert parallel (MoE all_to_all)
+
+Axis sizes are factorized from the device count; unused axes get size 1 so
+the same jitted program runs from 1 chip to a full slice.  On multi-host
+deployments the mesh spans hosts (jax.devices() is global) and XLA routes
+collectives over ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DEFAULT_AXES = ("dp", "sp", "tp", "ep")
+
+
+def factorize(n: int, num_axes: int) -> Tuple[int, ...]:
+    """Greedy power-of-two-ish factorization of ``n`` across axes,
+    biased toward dp first (dp gets the largest factor)."""
+    sizes = [1] * num_axes
+    i = 0
+    remaining = n
+    # assign factors round-robin, largest prime factors first
+    factors: List[int] = []
+    d = 2
+    while d * d <= remaining:
+        while remaining % d == 0:
+            factors.append(d)
+            remaining //= d
+        d += 1
+    if remaining > 1:
+        factors.append(remaining)
+    for f in sorted(factors, reverse=True):
+        sizes[i % num_axes] *= f
+        i += 1
+    return tuple(sizes)
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_sizes: Optional[Dict[str, int]] = None,
+              axes: Sequence[str] = DEFAULT_AXES,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh.
+
+    - ``axis_sizes``: explicit {axis: size}; missing axes get size 1;
+      product must equal the device count.
+    - otherwise sizes are auto-factorized over ``axes`` with unused axes
+      collapsed to 1: for n=8 → dp=2, sp=2, tp=2, ep=1.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if axis_sizes:
+        sizes = tuple(int(axis_sizes.get(a, 1)) for a in axes)
+        prod = int(np.prod(sizes))
+        if prod != n:
+            raise ValueError(f"axis sizes {dict(zip(axes, sizes))} "
+                             f"multiply to {prod}, have {n} devices")
+    else:
+        # auto: spread over dp/sp/tp, keep ep=1 unless explicitly requested
+        auto_axes = [a for a in axes if a != "ep"] or list(axes)
+        auto = factorize(n, len(auto_axes))
+        lookup = dict(zip(auto_axes, auto))
+        sizes = tuple(lookup.get(a, 1) for a in axes)
+    grid = np.asarray(devs, dtype=object).reshape(sizes)
+    return Mesh(grid, tuple(axes))
+
+
+def mesh_info(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

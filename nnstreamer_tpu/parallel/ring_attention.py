@@ -1,0 +1,96 @@
+"""Ring attention: exact attention over sequence shards on a mesh axis.
+
+Net-new, first-class long-context capability (absent from the reference —
+SURVEY.md §5 "Long-context / sequence parallelism: Absent"): each device
+holds a sequence block; K/V blocks rotate around the ring via
+``jax.lax.ppermute`` while a flash-style streaming softmax (running max +
+running sum) accumulates exact attention — memory per device stays
+O(T_local²) independent of ring size, and the K/V transfer for step i+1
+overlaps with compute for step i (XLA schedules the ppermute async on ICI).
+
+Use inside ``jax.shard_map`` with a mesh axis carrying the sequence
+dimension (``sp``), e.g. through
+:func:`nnstreamer_tpu.parallel.train_step.make_train_step`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str = "sp", causal: bool = False
+                   ) -> jnp.ndarray:
+    """Exact multi-head attention over a ring of sequence shards.
+
+    Args (per-device views inside shard_map):
+      q, k, v: (T_local, n_heads, head_dim)
+      axis_name: mesh axis carrying the sequence shards
+      causal: apply causal masking using global positions
+
+    Returns: (T_local, n_heads, head_dim) attention output.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    t_local, n_heads, head_dim = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+
+    q_pos = my_idx * t_local + jnp.arange(t_local)  # global query positions
+
+    def block(carry, step):
+        k_blk, v_blk, acc, row_max, row_sum = carry
+        # source block index: the block we hold at `step` originated at
+        # device (my_idx - step) mod n
+        src = (my_idx - step) % n
+        k_pos = src * t_local + jnp.arange(t_local)
+        # scores: (heads, Tq, Tk) in f32 for stable softmax accumulation
+        s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                       k_blk.astype(jnp.float32)) * scale
+        if causal:
+            mask = k_pos[None, None, :] > q_pos[None, :, None]
+            s = jnp.where(mask, -jnp.inf, s)
+        blk_max = jnp.max(s, axis=-1)                      # (h, Tq)
+        new_max = jnp.maximum(row_max, blk_max)
+        # guard fully-masked rows (all -inf)
+        safe_max = jnp.where(jnp.isfinite(new_max), new_max, 0.0)
+        p = jnp.exp(s - safe_max[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.exp(jnp.where(jnp.isfinite(row_max),
+                                 row_max - safe_max, -jnp.inf))
+        corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "hqk,khd->hqd", p, v_blk.astype(jnp.float32))
+        row_sum = row_sum * corr + jnp.sum(p, axis=-1)
+        # rotate K/V to the next device on the ring
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, acc, new_max, row_sum), None
+
+    acc0 = jnp.zeros((n_heads, t_local, head_dim), jnp.float32)
+    max0 = jnp.full((n_heads, t_local), -jnp.inf, jnp.float32)
+    sum0 = jnp.zeros((n_heads, t_local), jnp.float32)
+    (_, _, acc, _, row_sum), _ = jax.lax.scan(
+        block, (k, v, acc0, max0, sum0), jnp.arange(n))
+    out = acc / jnp.maximum(row_sum[..., None], 1e-20)
+    return jnp.transpose(out, (1, 0, 2)).astype(q.dtype)  # (Tq, h, d)
+
+
+def local_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False) -> jnp.ndarray:
+    """Single-device reference attention (same signature, no ring) — used
+    by tests to validate ring_attention numerically."""
+    t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        pos = jnp.arange(t)
+        s = jnp.where(pos[None, None, :] > pos[None, :, None], -jnp.inf, s)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

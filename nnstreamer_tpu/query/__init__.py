@@ -1,0 +1,14 @@
+"""Distributed query layer (L5): offload tensor streams between hosts."""
+
+from .client import QueryConnection, TensorQueryClient
+from .protocol import (Message, decode_tensors, encode_tensors, recv_msg,
+                       send_msg)
+from .server import (QueryServer, TensorQueryServerSink, TensorQueryServerSrc,
+                     get_server, shutdown_server)
+
+__all__ = [
+    "QueryConnection", "TensorQueryClient", "QueryServer",
+    "TensorQueryServerSrc", "TensorQueryServerSink", "get_server",
+    "shutdown_server", "Message", "encode_tensors", "decode_tensors",
+    "send_msg", "recv_msg",
+]

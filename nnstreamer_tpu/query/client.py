@@ -1,0 +1,181 @@
+"""Tensor query client: offload frames to a remote serving pipeline.
+
+Parity with gst/nnstreamer/tensor_query/tensor_query_client.c: chain sends
+the frame over the transport, blocks on an async queue for the answer
+(:656-743), with reconnect/retry (:368-380,728-732) and a caps handshake
+over the same channel (:512-559).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import socket
+import threading
+import time
+from typing import Optional
+
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import tensors_template_caps
+from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_REPLY,
+                       decode_tensors, encode_tensors, recv_msg, send_msg)
+
+
+class QueryConnection:
+    """Socket + reader thread + reply queue, with reconnect."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 max_retries: int = 3):
+        self.host, self.port = host, port
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.replies: _queue.Queue = _queue.Queue()
+        self.server_caps: Optional[str] = None
+        self._sock: Optional[socket.socket] = None
+        self._reader: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._seq = 0
+
+    def connect(self) -> None:
+        last_err: Optional[Exception] = None
+        for attempt in range(self.max_retries):
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout)
+                sock.settimeout(None)
+                self._sock = sock
+                self._stop.clear()
+                self._reader = threading.Thread(
+                    target=self._read_loop, daemon=True, name="query-reader")
+                self._reader.start()
+                # caps handshake
+                send_msg(sock, Message(T_HELLO))
+                return
+            except OSError as exc:
+                last_err = exc
+                time.sleep(0.2 * (attempt + 1))
+        raise ConnectionError(
+            f"cannot connect to {self.host}:{self.port}: {last_err}")
+
+    def _read_loop(self) -> None:
+        sock = self._sock
+        while not self._stop.is_set():
+            msg = recv_msg(sock)
+            if msg is None:
+                self.replies.put(None)  # signal disconnect
+                return
+            if msg.type == T_HELLO:
+                self.server_caps = msg.payload.decode()
+            elif msg.type == T_REPLY:
+                self.replies.put(msg)
+
+    def query(self, buf: TensorBuffer) -> Optional[TensorBuffer]:
+        """Send one frame, await ITS reply (matched by seq; stale replies
+        from timed-out requests are discarded), reconnecting once."""
+        self._seq += 1
+        seq = self._seq
+        msg = Message(T_DATA, seq=seq, pts=buf.pts or 0,
+                      payload=encode_tensors(buf))
+        for attempt in (0, 1):
+            try:
+                send_msg(self._sock, msg)
+            except (OSError, AttributeError):
+                if attempt:
+                    raise
+                self._reconnect()
+                continue
+            reply = self._await_reply(seq)
+            if reply is None:  # disconnected mid-wait → retry once
+                if attempt:
+                    raise ConnectionError("server closed connection")
+                self._reconnect()
+                continue
+            out = buf.with_tensors(decode_tensors(reply.payload))
+            out.pts = reply.pts
+            return out
+        return None
+
+    def _await_reply(self, seq: int) -> Optional[Message]:
+        import time as _time
+
+        deadline = _time.monotonic() + self.timeout
+        while True:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"no reply within {self.timeout}s")
+            try:
+                reply = self.replies.get(timeout=remaining)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"no reply within {self.timeout}s") from None
+            if reply is None or reply.seq == seq:
+                return reply
+            # stale reply from an earlier timed-out request: discard
+
+    def _reconnect(self) -> None:
+        self.close(send_bye=False)
+        # drop anything queued by the dying reader (incl. its None sentinel)
+        while True:
+            try:
+                self.replies.get_nowait()
+            except _queue.Empty:
+                break
+        self.connect()
+
+    def close(self, send_bye: bool = True) -> None:
+        self._stop.set()
+        sock = self._sock
+        if sock is not None:
+            if send_bye:
+                try:
+                    send_msg(sock, Message(T_BYE))
+                except OSError:
+                    pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._sock = None
+
+
+@register_element
+class TensorQueryClient(Element):
+    FACTORY = "tensor_query_client"
+    PROPERTIES = {
+        "host": ("127.0.0.1", "server host"),
+        "port": (0, "server port"),
+        "timeout": (10.0, "reply timeout seconds"),
+        "max-retries": (3, "connect retries"),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+        self.add_src_pad(tensors_template_caps(), "src")
+
+    def start(self):
+        self.conn = QueryConnection(str(self.host), int(self.port),
+                                    float(self.timeout),
+                                    int(self.max_retries))
+        self.conn.connect()
+
+    def stop(self):
+        conn = getattr(self, "conn", None)
+        if conn is not None:
+            conn.close()
+
+    def set_caps(self, pad, caps):
+        # announce the server's answer caps when it advertised them,
+        # else assume passthrough shape
+        sc = self.conn.server_caps
+        if sc:
+            self.announce_src_caps(Caps.from_string(sc))
+        else:
+            super().set_caps(pad, caps)
+
+    def chain(self, pad, buf):
+        out = self.conn.query(buf)
+        if out is None:
+            return FlowReturn.ERROR
+        return self.push(out)

@@ -1,0 +1,254 @@
+"""Edge pub/sub: topic-based tensor stream bridging between pipelines/hosts.
+
+Parity with the reference's edge elements (gst/edge/edge_sink.c /
+edge_src.c over libnnstreamer-edge: create handle / set_info(HOST, PORT,
+TOPIC, CAPS) / start / connect / send, SURVEY.md §2.7) and the broker role
+of its MQTT path — but self-contained: :class:`EdgeBroker` is an in-process
+TCP broker (no external mosquitto), and pub/sub frames reuse the query wire
+protocol with the topic carried in HELLO.
+
+A publisher pipeline ends in ``edge_sink``; subscriber pipelines start with
+``edge_src`` pointed at the same broker host/port/topic.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.graph import Source
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import tensors_template_caps
+from .protocol import (Message, T_BYE, T_DATA, T_HELLO, decode_tensors,
+                       encode_tensors, recv_msg, send_msg)
+
+
+class EdgeBroker:
+    """Topic broker: HELLO payload = ``pub:<topic>[|caps]`` or
+    ``sub:<topic>``; DATA from publishers fan out to all matching
+    subscribers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(32)
+        self._subs: Dict[str, Set[socket.socket]] = {}
+        self._topic_caps: Dict[str, str] = {}
+        # per-subscriber-socket send locks: concurrent publishers must not
+        # interleave partial frames on one subscriber stream
+        self._send_locks: Dict[socket.socket, threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="edge-broker").start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        role, topic = None, None
+        try:
+            while not self._stop.is_set():
+                msg = recv_msg(conn)
+                if msg is None or msg.type == T_BYE:
+                    break
+                if msg.type == T_HELLO:
+                    spec = msg.payload.decode()
+                    role, _, rest = spec.partition(":")
+                    topic, _, caps = rest.partition("|")
+                    if role == "sub":
+                        with self._lock:
+                            self._subs.setdefault(topic, set()).add(conn)
+                            self._send_locks[conn] = threading.Lock()
+                        # send retained caps for the topic
+                        send_msg(conn, Message(T_HELLO, payload=(
+                            self._topic_caps.get(topic, "").encode())))
+                    elif role == "pub" and caps:
+                        with self._lock:
+                            self._topic_caps[topic] = caps
+                elif msg.type == T_DATA and role == "pub":
+                    self._fanout(topic, msg)
+        finally:
+            if role == "sub" and topic is not None:
+                with self._lock:
+                    self._subs.get(topic, set()).discard(conn)
+                    self._send_locks.pop(conn, None)
+            conn.close()
+
+    def _fanout(self, topic: str, msg: Message) -> None:
+        with self._lock:
+            subs = [(s, self._send_locks.get(s)) for s in
+                    self._subs.get(topic, ())]
+        for s, slock in subs:
+            try:
+                if slock is None:
+                    send_msg(s, msg)
+                else:
+                    with slock:
+                        send_msg(s, msg)
+            except OSError:
+                with self._lock:
+                    self._subs.get(topic, set()).discard(s)
+                    self._send_locks.pop(s, None)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+_BROKERS: Dict[int, EdgeBroker] = {}
+_BROKERS_LOCK = threading.Lock()
+
+
+def get_broker(port: int = 0, host: str = "127.0.0.1") -> EdgeBroker:
+    """Start (or reuse) an in-process broker."""
+    with _BROKERS_LOCK:
+        if port and port in _BROKERS:
+            return _BROKERS[port]
+        broker = EdgeBroker(host, port)
+        _BROKERS[broker.port] = broker
+        return broker
+
+
+def shutdown_brokers() -> None:
+    with _BROKERS_LOCK:
+        for b in _BROKERS.values():
+            b.close()
+        _BROKERS.clear()
+
+
+@register_element
+class EdgeSink(Element):
+    """Publish the stream to a broker topic (edge_sink role)."""
+
+    FACTORY = "edge_sink"
+    PROPERTIES = {
+        "host": ("127.0.0.1", "broker host"),
+        "port": (0, "broker port"),
+        "topic": ("default", ""),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+
+    def start(self):
+        self._sock = socket.create_connection(
+            (str(self.host), int(self.port)), timeout=10)
+        self._caps_sent = False
+
+    def stop(self):
+        try:
+            send_msg(self._sock, Message(T_BYE))
+            self._sock.close()
+        except OSError:
+            pass
+
+    def set_caps(self, pad, caps):
+        send_msg(self._sock, Message(T_HELLO, payload=(
+            f"pub:{self.topic}|{caps}").encode()))
+        self._caps_sent = True
+
+    def chain(self, pad, buf):
+        if not self._caps_sent:
+            send_msg(self._sock, Message(T_HELLO,
+                                         payload=f"pub:{self.topic}".encode()))
+            self._caps_sent = True
+        send_msg(self._sock, Message(T_DATA, pts=buf.pts or 0,
+                                     payload=encode_tensors(buf)))
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            self.post_eos_reached()
+
+
+@register_element
+class EdgeSrc(Source):
+    """Subscribe to a broker topic (edge_src role)."""
+
+    FACTORY = "edge_src"
+    PROPERTIES = {
+        "host": ("127.0.0.1", "broker host"),
+        "port": (0, "broker port"),
+        "topic": ("default", ""),
+        "caps": (None, "override caps (else retained topic caps)"),
+        "num-buffers": (-1, "stop after N buffers, -1 unlimited"),
+    }
+
+    def _make_pads(self):
+        self.add_src_pad(tensors_template_caps(), "src")
+
+    def start(self):
+        self._sock = socket.create_connection(
+            (str(self.host), int(self.port)), timeout=10)
+        send_msg(self._sock, Message(T_HELLO,
+                                     payload=f"sub:{self.topic}".encode()))
+        self._fifo: _queue.Queue = _queue.Queue()
+        self._retained_caps: Optional[str] = None
+        self._caps_evt = threading.Event()
+        self._count = 0
+        threading.Thread(target=self._read_loop, daemon=True,
+                         name=f"edge-src:{self.name}").start()
+
+    def stop(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        super()._halt()
+
+    def _read_loop(self) -> None:
+        while True:
+            msg = recv_msg(self._sock)
+            if msg is None:
+                self._fifo.put(None)
+                return
+            if msg.type == T_HELLO:
+                self._retained_caps = msg.payload.decode() or None
+                self._caps_evt.set()
+            elif msg.type == T_DATA:
+                buf = TensorBuffer(tensors=decode_tensors(msg.payload),
+                                   pts=msg.pts)
+                self._fifo.put(buf)
+
+    def negotiate(self) -> Caps:
+        if self.caps:
+            c = self.caps
+            return Caps.from_string(c) if isinstance(c, str) else c
+        self._caps_evt.wait(timeout=10)
+        if self._retained_caps:
+            return Caps.from_string(self._retained_caps)
+        raise ValueError(f"{self.name}: no caps known for topic "
+                         f"{self.topic!r}; set the caps property")
+
+    def create(self) -> Optional[TensorBuffer]:
+        n = int(self.num_buffers)
+        if n >= 0 and self._count >= n:
+            return None
+        while not self._halted.is_set():
+            try:
+                item = self._fifo.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            if item is not None:
+                self._count += 1
+            return item
+        return None

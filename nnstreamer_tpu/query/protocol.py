@@ -1,0 +1,109 @@
+"""Tensor wire protocol for among-device streams.
+
+The transport role of libnnstreamer-edge (reference:
+gst/nnstreamer/tensor_query/tensor_query_common.h — TCP default, caps
+exchanged as strings; mqtt header layout gst/mqtt/mqttcommon.h:29-61).
+TPU-native framing: length-prefixed messages over a stream socket; each DATA
+frame carries pts + client id + N tensors, every tensor prefixed with the
+framework's 128-byte meta header (nnstreamer_tpu.tensor.meta), so both
+static and flexible streams ride the same format.
+
+Message layout (little endian):
+  u32 magic 'NNSQ' | u8 type | u64 client_id | u64 seq | i64 pts
+  | u32 payload_len | payload
+Types: 1=HELLO (payload = caps string utf8), 2=DATA, 3=REPLY, 4=BYE,
+5=ERROR (payload = message).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..tensor.buffer import TensorBuffer
+from ..tensor.info import TensorInfo
+from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
+
+MAGIC = 0x4E4E5351  # 'NNSQ'
+HEADER = struct.Struct("<IBQQqI")
+
+T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR = 1, 2, 3, 4, 5
+
+
+@dataclasses.dataclass
+class Message:
+    type: int
+    client_id: int = 0
+    seq: int = 0
+    pts: int = 0
+    payload: bytes = b""
+
+
+def pack(msg: Message) -> bytes:
+    return HEADER.pack(MAGIC, msg.type, msg.client_id, msg.seq,
+                       msg.pts, len(msg.payload)) + msg.payload
+
+
+def encode_tensors(buf: TensorBuffer) -> bytes:
+    """Serialize all tensors with per-tensor meta headers."""
+    parts = [struct.pack("<I", buf.num_tensors)]
+    for i in range(buf.num_tensors):
+        arr = buf.np(i)
+        meta = TensorMetaInfo.from_info(TensorInfo.from_np(arr))
+        parts.append(meta.to_bytes())
+        parts.append(np.ascontiguousarray(arr).tobytes())
+    return b"".join(parts)
+
+
+def decode_tensors(payload: bytes) -> List[np.ndarray]:
+    (n,) = struct.unpack_from("<I", payload, 0)
+    off = 4
+    tensors = []
+    for _ in range(n):
+        meta = TensorMetaInfo.from_bytes(payload[off:off + META_HEADER_SIZE])
+        off += META_HEADER_SIZE
+        size = meta.data_size
+        raw = np.frombuffer(payload, np.uint8, count=size, offset=off)
+        off += size
+        from ..tensor.types import dim_to_np_shape
+
+        tensors.append(raw.view(meta.dtype.np_dtype)
+                       .reshape(dim_to_np_shape(meta.dims)))
+    return tensors
+
+
+def send_msg(sock: socket.socket, msg: Message) -> None:
+    sock.sendall(pack(msg))
+
+
+def recv_msg(sock: socket.socket) -> Optional[Message]:
+    hdr = _recv_exact(sock, HEADER.size)
+    if hdr is None:
+        return None
+    magic, typ, cid, seq, pts, plen = HEADER.unpack(hdr)
+    if magic != MAGIC:
+        raise ValueError(f"bad magic 0x{magic:08x}")
+    payload = _recv_exact(sock, plen) if plen else b""
+    if plen and payload is None:
+        return None
+    return Message(type=typ, client_id=cid, seq=seq, pts=pts,
+                   payload=payload or b"")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            chunk = sock.recv(n - got)
+        except (ConnectionResetError, OSError):
+            return None
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)

@@ -1,0 +1,206 @@
+"""Tensor query server: serve pipeline inference to remote clients.
+
+Parity with the reference server trio (SURVEY.md §2.7):
+- gst/nnstreamer/tensor_query/tensor_query_serversrc.c (receive → queue →
+  push into the serving pipeline)
+- tensor_query_serversink.c (send answers matched by client id meta)
+- tensor_query_server.c (shared server-data table pairing src/sink by id)
+
+The transport thread owns the sockets; client identity rides in
+``buf.extra["query_client_id"]`` (the role of GstMeta in
+gst/nnstreamer/tensor_meta.c).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.graph import Source
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import tensors_template_caps
+from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_REPLY,
+                       decode_tensors, encode_tensors, recv_msg, send_msg)
+
+
+class QueryServer:
+    """Accepts clients, queues incoming frames, routes replies by client id.
+
+    The shared table (reference tensor_query_server.c:76-238) pairs the
+    serversrc and serversink elements of one serving pipeline.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(16)
+        self.incoming: _queue.Queue = _queue.Queue()
+        self._clients: Dict[int, socket.socket] = {}
+        self._caps_str: Optional[str] = None
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="query-accept")
+        self._accept_thread.start()
+
+    def set_caps_string(self, caps: str) -> None:
+        self._caps_str = caps
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            with self._lock:
+                cid = self._next_id
+                self._next_id += 1
+                self._clients[cid] = conn
+            threading.Thread(target=self._client_loop, args=(cid, conn),
+                             daemon=True, name=f"query-client-{cid}").start()
+
+    def _client_loop(self, cid: int, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = recv_msg(conn)
+                if msg is None or msg.type == T_BYE:
+                    break
+                if msg.type == T_HELLO:
+                    # capability handshake: reply with server caps string
+                    send_msg(conn, Message(T_HELLO, client_id=cid,
+                                           payload=(self._caps_str or "")
+                                           .encode()))
+                    continue
+                if msg.type == T_DATA:
+                    buf = TensorBuffer(tensors=decode_tensors(msg.payload),
+                                       pts=msg.pts)
+                    buf.extra["query_client_id"] = cid
+                    buf.extra["query_seq"] = msg.seq
+                    self.incoming.put(buf)
+        finally:
+            with self._lock:
+                self._clients.pop(cid, None)
+            conn.close()
+
+    def reply(self, buf: TensorBuffer) -> bool:
+        cid = buf.extra.get("query_client_id")
+        with self._lock:
+            conn = self._clients.get(cid)
+        if conn is None:
+            return False
+        msg = Message(T_REPLY, client_id=cid,
+                      seq=buf.extra.get("query_seq", 0),
+                      pts=buf.pts or 0, payload=encode_tensors(buf))
+        try:
+            send_msg(conn, msg)
+            return True
+        except OSError:
+            return False
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for conn in self._clients.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._clients.clear()
+
+
+#: server table: id → QueryServer (pairs serversrc/serversink)
+_SERVERS: Dict[int, QueryServer] = {}
+_SERVERS_LOCK = threading.Lock()
+
+
+def get_server(server_id: int, host: str = "127.0.0.1",
+               port: int = 0) -> QueryServer:
+    with _SERVERS_LOCK:
+        if server_id not in _SERVERS:
+            _SERVERS[server_id] = QueryServer(host, port)
+        return _SERVERS[server_id]
+
+
+def shutdown_server(server_id: int) -> None:
+    with _SERVERS_LOCK:
+        srv = _SERVERS.pop(server_id, None)
+    if srv is not None:
+        srv.close()
+
+
+@register_element
+class TensorQueryServerSrc(Source):
+    """Receives client frames and pushes them into the serving pipeline."""
+
+    FACTORY = "tensor_query_serversrc"
+    PROPERTIES = {
+        "host": ("127.0.0.1", ""),
+        "port": (0, "0 = ephemeral"),
+        "id": (0, "server table id"),
+        "caps": (None, "caps announced for received tensors"),
+    }
+
+    def _make_pads(self):
+        self.add_src_pad(tensors_template_caps(), "src")
+
+    def start(self):
+        self.server = get_server(int(self.id), str(self.host),
+                                 int(self.port))
+        if self.caps:
+            self.server.set_caps_string(str(self.caps))
+
+    @property
+    def bound_port(self) -> int:
+        return self.server.port
+
+    def negotiate(self) -> Caps:
+        if not self.caps:
+            raise ValueError(f"{self.name}: caps property required")
+        c = self.caps
+        return Caps.from_string(c) if isinstance(c, str) else c
+
+    def create(self) -> Optional[TensorBuffer]:
+        while not self._halted.is_set():
+            try:
+                return self.server.incoming.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+        return None
+
+
+@register_element
+class TensorQueryServerSink(Element):
+    """Sends pipeline results back to the originating client."""
+
+    FACTORY = "tensor_query_serversink"
+    PROPERTIES = {"id": (0, "server table id")}
+
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+
+    def start(self):
+        self.server = get_server(int(self.id))
+
+    def set_caps(self, pad, caps):
+        pass
+
+    def chain(self, pad, buf):
+        self.server.reply(buf)
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            self.post_eos_reached()

@@ -1,0 +1,94 @@
+"""Layered configuration system.
+
+Parity with the reference conf system (gst/nnstreamer/nnstreamer_conf.c:
+/etc/nnstreamer.ini + NNSTREAMER_CONF env override + env-var path
+overrides + per-group custom values + framework priority for auto-detect):
+
+1. defaults
+2. ini file: ``/etc/nnstreamer_tpu.ini`` then ``NNS_TPU_CONF`` override
+3. environment: ``NNS_TPU_<GROUP>_<KEY>``
+
+Groups mirror the reference's: [common], [filter], [decoder], [converter],
+plus per-framework groups like [xla].
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+import threading
+from typing import Dict, List, Optional
+
+DEFAULT_CONF_PATH = "/etc/nnstreamer_tpu.ini"
+CONF_ENV = "NNS_TPU_CONF"
+
+_DEFAULTS: Dict[str, Dict[str, str]] = {
+    "common": {},
+    "filter": {
+        # reference framework_priority_* (nnstreamer_conf.c): auto-detect
+        # resolution order
+        "framework_priority": "xla,python,custom",
+    },
+    "xla": {
+        "compile_cache": "",
+    },
+}
+
+
+class Conf:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._loaded = False
+        self._values: Dict[str, Dict[str, str]] = {}
+
+    def _load_locked(self) -> None:
+        if self._loaded:
+            return
+        values = {g: dict(kv) for g, kv in _DEFAULTS.items()}
+        paths = [DEFAULT_CONF_PATH]
+        env_path = os.environ.get(CONF_ENV)
+        if env_path:
+            paths.append(env_path)
+        parser = configparser.ConfigParser()
+        parser.read([p for p in paths if p and os.path.exists(p)])
+        for section in parser.sections():
+            values.setdefault(section.lower(), {}).update(
+                {k.lower(): v for k, v in parser.items(section)})
+        self._values = values
+        self._loaded = True
+
+    def reload(self) -> None:
+        with self._lock:
+            self._loaded = False
+            self._load_locked()
+
+    def get(self, group: str, key: str,
+            default: Optional[str] = None) -> Optional[str]:
+        """Env override > ini > defaults (reference nnsconf_get_custom_value
+        semantics)."""
+        env = os.environ.get(f"NNS_TPU_{group.upper()}_{key.upper()}")
+        if env is not None:
+            return env
+        with self._lock:
+            self._load_locked()
+            return self._values.get(group.lower(), {}).get(key.lower(),
+                                                           default)
+
+    def get_bool(self, group: str, key: str, default: bool = False) -> bool:
+        v = self.get(group, key)
+        if v is None:
+            return default
+        return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+    def framework_priority(self) -> List[str]:
+        raw = self.get("filter", "framework_priority") or ""
+        return [p.strip() for p in raw.split(",") if p.strip()]
+
+    def dump(self) -> Dict[str, Dict[str, str]]:
+        """Introspection (reference nnsconf_dump)."""
+        with self._lock:
+            self._load_locked()
+            return {g: dict(kv) for g, kv in self._values.items()}
+
+
+conf = Conf()

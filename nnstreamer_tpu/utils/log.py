@@ -1,0 +1,24 @@
+"""Logging with backtrace support.
+
+Parity with the reference logging layer (gst/nnstreamer/nnstreamer_log.h:
+ml_logi/w/e/d macros + ml_loge_stacktrace): standard logging channel
+``nnstreamer_tpu`` plus an error-with-backtrace helper.
+"""
+
+from __future__ import annotations
+
+import logging
+import traceback
+
+logger = logging.getLogger("nnstreamer_tpu")
+
+ml_logd = logger.debug
+ml_logi = logger.info
+ml_logw = logger.warning
+ml_loge = logger.error
+
+
+def ml_loge_stacktrace(msg: str, *args) -> None:
+    """Error + formatted python stack (reference _backtrace_to_string)."""
+    stack = "".join(traceback.format_stack()[:-1])
+    logger.error(msg + "\nBacktrace:\n%s", *args, stack)

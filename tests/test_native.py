@@ -1,0 +1,70 @@
+"""Native tensorwire library tests (builds libnnstw.so via make)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_native():
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+
+
+class TestSparseNative:
+    def test_gather_scatter_f32(self):
+        arr = np.zeros(1000, np.float32)
+        arr[[3, 500, 999]] = [1.5, -2.0, 7.0]
+        vals, idx = native.sparse_gather(arr)
+        np.testing.assert_array_equal(idx, [3, 500, 999])
+        np.testing.assert_array_equal(vals, [1.5, -2.0, 7.0])
+        back = native.sparse_scatter(vals, idx, 1000)
+        np.testing.assert_array_equal(back, arr)
+
+    def test_gather_uint8(self):
+        arr = np.zeros(64, np.uint8)
+        arr[10] = 255
+        vals, idx = native.sparse_gather(arr)
+        assert list(idx) == [10]
+        assert list(vals) == [255]
+
+    def test_matches_numpy_random(self):
+        rng = np.random.default_rng(0)
+        arr = (rng.random(5000) < 0.05).astype(np.float32) * \
+            rng.standard_normal(5000).astype(np.float32)
+        vals, idx = native.sparse_gather(arr)
+        np.testing.assert_array_equal(idx, np.flatnonzero(arr))
+        np.testing.assert_array_equal(vals, arr[arr != 0])
+
+
+class TestVideoNative:
+    def test_bgrx_to_rgb(self):
+        frame = np.zeros((2, 2, 4), np.uint8)
+        frame[0, 0] = [10, 20, 30, 255]  # B G R x
+        out = native.bgrx_to_rgb(frame)
+        assert out.shape == (2, 2, 3)
+        assert list(out[0, 0]) == [30, 20, 10]
+
+    def test_gray_to_rgb(self):
+        frame = np.array([[[7]]], np.uint8)
+        out = native.gray_to_rgb(frame)
+        assert list(out[0, 0]) == [7, 7, 7]
+
+    def test_unstride(self):
+        # 2 rows of 6 bytes padded to stride 8
+        src = np.arange(16, dtype=np.uint8)
+        out = native.unstride(src, 8, 6, 2)
+        np.testing.assert_array_equal(
+            out, [0, 1, 2, 3, 4, 5, 8, 9, 10, 11, 12, 13])
+
+
+class TestCRC:
+    def test_crc32c_known_vector(self):
+        # RFC 3720 test vector: 32 bytes of zeros → 0x8A9136AA
+        assert native.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+    def test_crc_changes(self):
+        a = native.crc32c(b"hello")
+        b = native.crc32c(b"hellp")
+        assert a != b
