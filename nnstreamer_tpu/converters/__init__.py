@@ -55,4 +55,4 @@ def list_converters():
 
 
 def _ensure_loaded() -> None:
-    from . import flexbuf  # noqa: F401
+    from . import flexbuf, protobuf  # noqa: F401

@@ -55,5 +55,5 @@ def list_decoders():
 
 
 def _ensure_loaded() -> None:
-    from . import (boundingbox, directvideo, imagelabel, imagesegment,  # noqa: F401
-                   pose)
+    from . import (boundingbox, directvideo, font, imagelabel,  # noqa: F401
+                   imagesegment, pose, serialize)

@@ -15,6 +15,7 @@ from . import repo  # noqa: F401
 from . import sink  # noqa: F401
 from . import sparse  # noqa: F401
 from . import src  # noqa: F401
+from . import srciio  # noqa: F401
 from . import tensor_if  # noqa: F401
 from . import trainer  # noqa: F401
 from . import transform  # noqa: F401
@@ -34,6 +35,7 @@ from .repo import TensorRepoSink, TensorRepoSrc
 from .sink import FakeSink, FileSink, TensorSink
 from .sparse import TensorSparseDec, TensorSparseEnc
 from .src import AudioTestSrc, VideoTestSrc
+from .srciio import TensorSrcIIO
 from .tensor_if import TensorIf, register_if_custom
 from .trainer import (JaxTrainer, TensorTrainer, TrainerFramework,
                       find_trainer, register_trainer)
@@ -47,5 +49,5 @@ __all__ = [
     "TensorRate", "TensorRepoSink", "TensorRepoSrc", "TensorSparseEnc",
     "TensorSparseDec", "TensorDebug", "Join", "TensorCrop", "DataRepoSrc",
     "TensorTrainer", "JaxTrainer", "TrainerFramework", "find_trainer",
-    "register_trainer",
+    "register_trainer", "TensorSrcIIO",
 ]

@@ -87,6 +87,14 @@ class XLAFilter(FilterFramework):
             # bf16 is MXU-native on TPU but emulated (slow) on CPU hosts.
             custom["dtype"] = "float32"
         self._model = get_model(model_name, custom)
+        ckpt_path = custom.get("checkpoint")
+        if ckpt_path:
+            # restore pretrained params (orbax; the role of loading the
+            # reference's .tflite/.pb weight files)
+            from ...models.registry import restore_params
+
+            self._model.params = restore_params(self._model.params,
+                                                ckpt_path)
         self._params_dev = jax.device_put(self._model.params, self._device)
         self._jitted = jax.jit(self._model.forward)
         # Warm-up compile so frame 1 is steady-state (the reference's
@@ -155,9 +163,12 @@ class XLAFilter(FilterFramework):
                     input_info=props.input_info, output_info=props.output_info,
                     accelerators=props.accelerators, custom_properties=merged,
                     shared_key=props.shared_key)
-            from ...models.registry import get_model
+            from ...models.registry import get_model, restore_params
 
             new_model = get_model(str(props.model), props.custom_properties)
+            ckpt = props.custom_properties.get("checkpoint")
+            if ckpt:
+                new_model.params = restore_params(new_model.params, ckpt)
             new_params = jax.device_put(new_model.params, self._device)
             self._model, self._params_dev = new_model, new_params
             self.props = props

@@ -111,9 +111,17 @@ def build_mobilenet_v2(custom_props: Dict[str, str]) -> Model:
     variables = module.init(jax.random.PRNGKey(seed),
                             jnp.zeros((1, size, size, 3), dtype))
 
+    use_pallas = custom_props.get("use_pallas", "0") in ("1", "true")
+
     def forward(variables, frame):
-        """frame: uint8 (H, W, 3) — preprocessing fused into the graph."""
-        x = frame.astype(dtype) * (1.0 / 127.5) - 1.0
+        """frame: uint8 (H, W, 3) — preprocessing fused into the graph
+        (optionally as a Pallas VMEM kernel, ``use_pallas:1``)."""
+        if use_pallas:
+            from ..ops.preprocess import normalize_frame
+
+            x = normalize_frame(frame, dtype=dtype)
+        else:
+            x = frame.astype(dtype) * (1.0 / 127.5) - 1.0
         logits = module.apply(variables, x[None])
         return (logits[0],)
 

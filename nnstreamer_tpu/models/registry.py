@@ -44,6 +44,28 @@ def register_model(name: str):
     return deco
 
 
+def save_checkpoint(model: Model, path: str) -> None:
+    """Persist model params as an orbax checkpoint (the framework's model
+    artifact format — the role of the reference's .tflite/.pb model files)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(os.path.abspath(path), model.params)
+    ckpt.wait_until_finished()
+
+
+def restore_params(template, path: str):
+    """Restore params matching ``template``'s structure from orbax."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    ckpt = ocp.StandardCheckpointer()
+    return ckpt.restore(os.path.abspath(path), target=template)
+
+
 def _ensure_loaded() -> None:
     from . import mobilenet_v2, ssd, deeplab_v3, posenet  # noqa: F401
 

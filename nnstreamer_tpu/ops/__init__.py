@@ -1,0 +1,5 @@
+"""TPU ops: pallas kernels + jitted primitives for stream hot paths."""
+
+from .preprocess import normalize_frame, normalize_frame_reference
+
+__all__ = ["normalize_frame", "normalize_frame_reference"]

@@ -1,0 +1,63 @@
+"""Fused frame preprocessing as a Pallas TPU kernel.
+
+The converter→filter seam's per-frame math (uint8 media → scaled/shifted
+model dtype; the role the reference gives ORC SIMD in tensor_transform's
+``typecast + arithmetic`` chains, gsttensor_transform.c:463-533) expressed
+as a single VMEM-resident Pallas kernel: one pass, no intermediate f32
+buffer in HBM.
+
+XLA already fuses `x.astype(bf16) * a + b` well, so this kernel is mostly
+a template for heavier fused stages (quantized preprocessing, layout
+swizzles); the XLA backend uses it when ``use_pallas:1`` is set.  On CPU
+(tests) the kernel runs in interpret mode and is validated against the
+jnp reference implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_LANES = 128
+_SUBLANES = 8
+_BLOCK = _LANES * _SUBLANES
+
+
+def normalize_frame_reference(frame, scale: float = 1.0 / 127.5,
+                              shift: float = -1.0,
+                              dtype=jnp.bfloat16):
+    """jnp reference: y = frame * scale + shift, cast to dtype."""
+    return (frame.astype(jnp.float32) * scale + shift).astype(dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "shift", "dtype"))
+def normalize_frame(frame, scale: float = 1.0 / 127.5, shift: float = -1.0,
+                    dtype=jnp.bfloat16):
+    """Pallas kernel: flatten → pad to (8,128) tiles → fused scale/shift/
+    cast in VMEM → original shape."""
+    from jax.experimental import pallas as pl
+
+    shape = frame.shape
+    n = frame.size
+    pad = (-n) % _BLOCK
+    flat = frame.reshape(-1)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), frame.dtype)])
+    tiled = flat.reshape(-1, _LANES)  # (rows, 128), rows % 8 == 0
+
+    def kernel(in_ref, out_ref):
+        x = in_ref[:].astype(jnp.float32)
+        out_ref[:] = (x * scale + shift).astype(out_ref.dtype)
+
+    interpret = jax.default_backend() == "cpu"
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(tiled.shape, dtype),
+        interpret=interpret,
+    )(tiled)
+    out = out.reshape(-1)
+    if pad:
+        out = out[:n]
+    return out.reshape(shape)

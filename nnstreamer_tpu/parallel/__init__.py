@@ -1,5 +1,6 @@
 """Distributed/multi-chip layer: mesh, sharding, ring attention, training."""
 
+from . import multihost
 from .mesh import DEFAULT_AXES, factorize, make_mesh, mesh_info
 from .ring_attention import local_attention, ring_attention
 from .train_step import (StreamFormerConfig, init_params, make_data_sharding,
@@ -8,5 +9,5 @@ from .train_step import (StreamFormerConfig, init_params, make_data_sharding,
 __all__ = [
     "make_mesh", "mesh_info", "factorize", "DEFAULT_AXES",
     "ring_attention", "local_attention", "StreamFormerConfig",
-    "init_params", "make_train_step", "make_data_sharding",
+    "init_params", "make_train_step", "make_data_sharding", "multihost",
 ]

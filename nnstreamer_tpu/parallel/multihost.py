@@ -1,0 +1,62 @@
+"""Multi-host initialization: scale the mesh across TPU hosts over DCN.
+
+The reference's inter-host story is stream transport (nnstreamer-edge /
+gRPC, SURVEY.md §2.7).  The TPU-native equivalent for *compute* is a global
+mesh: every host runs the same program, `jax.distributed.initialize` wires
+the processes into one runtime, `jax.devices()` becomes the global device
+list, and the same `make_mesh`/`make_train_step` code runs unchanged — XLA
+routes collectives over ICI within a slice and DCN across slices.  (Stream
+transport between pipelines remains `nnstreamer_tpu.query`.)
+
+Typical launch (one command per host)::
+
+    from nnstreamer_tpu.parallel import multihost, make_mesh
+    multihost.initialize(coordinator="10.0.0.1:8476",
+                         num_processes=4, process_id=HOST_INDEX)
+    mesh = make_mesh()          # spans all hosts' devices
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the cross-host runtime.  On Cloud TPU the arguments are
+    auto-detected from the metadata server when omitted; explicit values
+    support bring-your-own clusters (reference role: nnstreamer-edge
+    host/port wiring)."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_info() -> dict:
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

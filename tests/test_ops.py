@@ -1,0 +1,32 @@
+"""Pallas op tests (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu.ops import normalize_frame, normalize_frame_reference
+
+
+class TestNormalizeFrame:
+    @pytest.mark.parametrize("shape", [(224, 224, 3), (8, 128), (17,),
+                                       (5, 7, 3)])
+    def test_matches_reference(self, shape):
+        rng = np.random.default_rng(0)
+        frame = rng.integers(0, 256, shape).astype(np.uint8)
+        out = np.asarray(normalize_frame(frame, dtype=jnp.float32))
+        ref = np.asarray(normalize_frame_reference(frame, dtype=jnp.float32))
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+        assert out.shape == shape
+
+    def test_range(self):
+        frame = np.array([[0, 255] * 64] * 8, np.uint8)
+        out = np.asarray(normalize_frame(frame, dtype=jnp.float32))
+        assert out.min() == -1.0
+        assert abs(out.max() - 1.0) < 1e-2
+
+    def test_custom_scale_shift(self):
+        frame = np.full((8, 128), 10, np.uint8)
+        out = np.asarray(normalize_frame(frame, scale=2.0, shift=1.0,
+                                         dtype=jnp.float32))
+        np.testing.assert_allclose(out, np.full((8, 128), 21.0))

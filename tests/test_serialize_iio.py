@@ -1,0 +1,161 @@
+"""Serialization decoders/converters, font decoder, IIO source, checkpoint
+restore."""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+from nnstreamer_tpu.elements import TensorDecoder, TensorSink
+from nnstreamer_tpu.tensor import TensorBuffer
+
+
+def tcaps(dims, types, n=1, rate="30/1"):
+    return (f"other/tensors,format=static,num_tensors={n},dimensions={dims},"
+            f"types={types},framerate={rate}")
+
+
+def decode_one(caps, props, tensors):
+    p = Pipeline()
+    src = AppSrc("src", caps=caps)
+    dec = TensorDecoder("d", **props)
+    sink = TensorSink("out")
+    p.add(src, dec, sink)
+    p.link(src, dec, sink)
+    src.push_buffer(TensorBuffer(tensors=tensors, pts=7))
+    src.end_of_stream()
+    p.run(timeout=10)
+    return sink
+
+
+class TestProtobufRoundTrip:
+    def test_encode_decode(self):
+        from nnstreamer_tpu.decoders.serialize import (decode_tensors_proto,
+                                                       encode_tensors_proto)
+
+        buf = TensorBuffer(tensors=[
+            np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.array([9, 8], np.int64)], pts=42)
+        blob = encode_tensors_proto(buf)
+        back = decode_tensors_proto(blob)
+        assert len(back) == 2
+        np.testing.assert_array_equal(back[0], buf.np(0))
+        np.testing.assert_array_equal(back[1], buf.np(1))
+
+    def test_pipeline_protobuf_loop(self):
+        """decoder → converter round trip through a launch pipeline."""
+        sink = decode_one(tcaps("4", "float32"), {"mode": "protobuf"},
+                          [np.array([1, 2, 3, 4], np.float32)])
+        blob = sink.results[0].np(0)
+        assert blob.dtype == np.uint8
+        # feed the blob through the protobuf converter
+        from nnstreamer_tpu.converters import find_converter
+
+        conv = find_converter("protobuf")
+        out = conv.convert(TensorBuffer(tensors=[blob]))
+        np.testing.assert_array_equal(out.np(0), [1, 2, 3, 4])
+
+
+class TestFlexbufDecoder:
+    def test_round_trip_via_converter(self):
+        sink = decode_one(tcaps("3:2", "float32"), {"mode": "flexbuf"},
+                          [np.arange(6, dtype=np.float32).reshape(2, 3)])
+        blob = sink.results[0].np(0)
+        from nnstreamer_tpu.converters import find_converter
+
+        conv = find_converter("flexbuf")
+        out = conv.convert(TensorBuffer(tensors=[blob]))
+        np.testing.assert_array_equal(
+            out.np(0), np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+class TestFontDecoder:
+    def test_renders_text(self):
+        text = np.frombuffer(b"AB 12", dtype=np.uint8)
+        sink = decode_one(tcaps("5", "uint8"),
+                          {"mode": "font", "option1": "64:16"}, [text])
+        out = sink.results[0]
+        assert out.extra["text"] == "AB 12"
+        canvas = out.np(0)
+        assert canvas.shape == (16, 64, 1)
+        assert canvas.max() == 255
+
+
+class TestPythonScriptDecoder:
+    def test_script_decode(self, tmp_path):
+        script = tmp_path / "dec.py"
+        script.write_text(
+            "import numpy as np\n"
+            "class CustomDecoder:\n"
+            "    def get_out_caps(self, config):\n"
+            "        return 'application/octet-stream,framerate=0/1'\n"
+            "    def decode(self, tensors, config):\n"
+            "        return tensors[0][::-1]\n")
+        sink = decode_one(tcaps("4", "uint8"),
+                          {"mode": "python3", "option1": str(script)},
+                          [np.array([1, 2, 3, 4], np.uint8)])
+        np.testing.assert_array_equal(sink.results[0].np(0), [4, 3, 2, 1])
+
+
+@pytest.fixture
+def fake_iio_tree(tmp_path):
+    """Simulated sysfs IIO tree (the reference's unittest_src_iio.cc
+    strategy)."""
+    dev = tmp_path / "iio:device0"
+    dev.mkdir()
+    (dev / "name").write_text("test-accel\n")
+    for i, val in enumerate([100, -50, 25]):
+        (dev / f"in_accel{i}_raw").write_text(f"{val}\n")
+        (dev / f"in_accel{i}_scale").write_text("0.5\n")
+        (dev / f"in_accel{i}_offset").write_text("10\n")
+    return tmp_path
+
+
+class TestSrcIIO:
+    def test_reads_scaled_channels(self, fake_iio_tree):
+        p = parse_launch(
+            f"tensor_src_iio device=test-accel base-dir={fake_iio_tree} "
+            "frequency=100 num-buffers=3 ! tensor_sink name=out")
+        p.run(timeout=10)
+        out = p.get("out").results
+        assert len(out) == 3
+        # (raw + offset) * scale
+        np.testing.assert_allclose(out[0].np(0), [55.0, -20.0, 17.5])
+        st = p.get("out").caps.first()
+        assert st.get("dimensions") == "3"
+
+    def test_missing_device_errors(self, fake_iio_tree):
+        from nnstreamer_tpu.pipeline import PipelineError
+
+        p = parse_launch(
+            f"tensor_src_iio device=nope base-dir={fake_iio_tree} "
+            "num-buffers=1 ! tensor_sink")
+        with pytest.raises(PipelineError):
+            p.run(timeout=5)
+
+
+@pytest.mark.slow
+class TestCheckpointRestore:
+    def test_save_restore_changes_outputs(self, tmp_path):
+        from nnstreamer_tpu.filter import FilterSingle
+        from nnstreamer_tpu.models.registry import (get_model,
+                                                    save_checkpoint)
+
+        # save a seed-1 model's params, then serve seed-0 with restore →
+        # outputs must match the seed-1 model
+        m1 = get_model("mobilenet_v2",
+                       {"seed": "1", "input_size": "32", "dtype": "float32"})
+        ckpt = tmp_path / "ckpt"
+        save_checkpoint(m1, str(ckpt))
+        frame = np.random.default_rng(0).integers(
+            0, 255, (32, 32, 3), dtype=np.uint8)
+        with FilterSingle(framework="xla", model="mobilenet_v2",
+                          custom=f"input_size:32,seed:1") as ref:
+            want, = ref.invoke([frame])
+        with FilterSingle(framework="xla", model="mobilenet_v2",
+                          custom=f"input_size:32,seed:0,checkpoint:{ckpt}"
+                          ) as restored:
+            got, = restored.invoke([frame])
+        np.testing.assert_allclose(got, want, atol=1e-5)
