@@ -5,9 +5,12 @@ gst/nnstreamer/nnstreamer_subplugin.c:116)."""
 from .custom import (CustomEasyFilter, CustomFilter, DummyFilter,
                      register_custom_easy, unregister_custom_easy)
 from .python import PythonFilter
+from .pytorch import PyTorchFilter
+from .tflite import TFLiteFilter
 from .xla import XLAFilter
 
 __all__ = [
     "XLAFilter", "CustomFilter", "CustomEasyFilter", "DummyFilter",
-    "PythonFilter", "register_custom_easy", "unregister_custom_easy",
+    "PythonFilter", "TFLiteFilter", "PyTorchFilter",
+    "register_custom_easy", "unregister_custom_easy",
 ]

@@ -1,0 +1,119 @@
+"""PyTorch (TorchScript) filter backend.
+
+Parity with the reference pytorch subplugin
+(ext/nnstreamer/tensor_filter/tensor_filter_pytorch.cc, SURVEY.md §2.4):
+loads a TorchScript ``.pt`` file and invokes it per buffer.  Like the
+reference, the model file carries no input meta, so the caller must supply
+``input_info`` (the element's ``input`` / ``inputtype`` properties);
+output meta is discovered by probing the model with zeros at open — the
+same contract as the reference's ``getModelInfo`` path.
+
+This backend runs on the **host CPU** (torch-cpu is what the image ships);
+it exists for interop parity — the TPU execution paths are the xla and
+tensorflow-lite backends.  ``accelerator=true:tpu`` is therefore refused,
+mirroring the reference refusing GPU without ``enable_use_gpu``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ...tensor.info import TensorInfo, TensorsInfo
+from ..framework import (Accelerator, FilterError, FilterFramework,
+                         FilterProperties, FilterStatistics, register_filter)
+
+
+@register_filter
+class PyTorchFilter(FilterFramework):
+    """``framework=pytorch``: TorchScript model on host CPU."""
+
+    NAME = "pytorch"
+    SUPPORTED_ACCELERATORS = (Accelerator.CPU,)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._module = None
+        self._in_info: Optional[TensorsInfo] = None
+        self._out_info: Optional[TensorsInfo] = None
+        self.stats = FilterStatistics()
+
+    # -- lifecycle -----------------------------------------------------------
+    def open(self, props: FilterProperties) -> None:
+        try:
+            import torch
+        except ImportError as e:  # pragma: no cover
+            raise FilterError(f"pytorch backend unavailable: {e}")
+
+        path = str(props.model)
+        if not os.path.isfile(path):
+            raise FilterError(f"pytorch: model file not found: {path}")
+        if props.input_info is None or not props.input_info.is_valid():
+            raise FilterError(
+                "pytorch: input_info required (TorchScript files carry no "
+                "input meta; set the input/inputtype properties — reference "
+                "tensor_filter_pytorch.cc contract)")
+        try:
+            self._module = torch.jit.load(path, map_location="cpu")
+        except Exception as e:
+            raise FilterError(f"pytorch: cannot load {path}: {e}")
+        self._module.eval()
+        self._in_info = props.input_info.copy()
+        # probe with zeros to learn output meta (and fail fast on shape
+        # mismatch, like the reference's first invoke)
+        zeros = [np.zeros(i.np_shape, i.np_dtype) for i in self._in_info]
+        outs = self._run(zeros)
+        probed = TensorsInfo([TensorInfo.from_np(o) for o in outs])
+        if props.output_info is not None and props.output_info.is_valid():
+            if not props.output_info.is_equal(probed):
+                raise FilterError(
+                    f"pytorch: declared output {props.output_info} != "
+                    f"model output {probed}")
+            self._out_info = props.output_info.copy()
+        else:
+            self._out_info = probed
+        super().open(props)
+
+    def close(self) -> None:
+        self._module = None
+        super().close()
+
+    # -- model meta ----------------------------------------------------------
+    def get_model_info(self) -> Tuple[TensorsInfo, TensorsInfo]:
+        if self._module is None:
+            raise FilterError("pytorch: not opened")
+        return self._in_info, self._out_info
+
+    def set_input_info(self, in_info: TensorsInfo) -> Tuple[TensorsInfo, TensorsInfo]:
+        """Re-probe with new input shapes (reference SET_INPUT_INFO)."""
+        zeros = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
+        outs = self._run(zeros)
+        self._in_info = in_info.copy()
+        self._out_info = TensorsInfo([TensorInfo.from_np(o) for o in outs])
+        return self._in_info, self._out_info
+
+    # -- hot path ------------------------------------------------------------
+    def _run(self, inputs: List[Any]) -> List[np.ndarray]:
+        import torch
+
+        tins = [torch.from_numpy(np.ascontiguousarray(x)) for x in inputs]
+        with torch.no_grad():
+            out = self._module(*tins)
+        if isinstance(out, (tuple, list)):
+            outs = list(out)
+        else:
+            outs = [out]
+        return [o.detach().cpu().numpy() for o in outs]
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        t0 = time.monotonic_ns()
+        outs = self._run([np.asarray(x) for x in inputs])
+        self.stats.record(time.monotonic_ns() - t0)
+        return outs
+
+    @classmethod
+    def handles_model(cls, model: Any) -> bool:
+        return isinstance(model, str) and model.endswith((".pt", ".pth"))

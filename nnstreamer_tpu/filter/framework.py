@@ -200,7 +200,7 @@ _FILTERS: Dict[str, Type[FilterFramework]] = {}
 
 #: auto-detect priority, mirrors ini ``framework_priority_*``
 #: (reference nnstreamer_conf.c framework_priority handling)
-_AUTO_PRIORITY: List[str] = ["xla", "python", "custom"]
+_AUTO_PRIORITY: List[str] = ["xla", "tensorflow-lite", "python", "custom"]
 
 
 def register_filter(cls: Type[FilterFramework]) -> Type[FilterFramework]:

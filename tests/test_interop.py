@@ -1,0 +1,222 @@
+"""Interop backend tests: flatbuffer runtime, tflite loader, pytorch loader.
+
+Models the reference's per-backend suites
+(tests/nnstreamer_filter_tensorflow2_lite/, tests/nnstreamer_filter_pytorch/
+runTest.sh).  Tests that need the reference model-zoo fixtures
+(tests/test_models/models/*) are gated on that tree existing.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.filter.framework import (FilterError, FilterProperties,
+                                             detect_framework, open_backend)
+from nnstreamer_tpu.tensor import TensorsInfo
+from nnstreamer_tpu.utils import flatbuf as fb
+
+REF_MODELS = "/root/reference/tests/test_models/models"
+needs_ref = pytest.mark.skipif(not os.path.isdir(REF_MODELS),
+                               reason="reference model zoo not present")
+
+
+class TestFlatbufRuntime:
+    def test_scalar_defaults_and_fields(self):
+        b = fb.Builder()
+        b.start_table()
+        b.add_scalar(0, "int32", 5)
+        b.add_scalar(1, "int32", 0)          # default → omitted
+        b.add_scalar(2, "float32", -1.5)
+        off = b.end_table()
+        buf = b.finish(off)
+        t = fb.root(buf)
+        assert t.scalar(0, "int32") == 5
+        assert not t.has(1)
+        assert t.scalar(1, "int32", default=9) == 9
+        assert t.scalar(2, "float32") == -1.5
+
+    def test_nested_tables_vectors_strings(self):
+        b = fb.Builder()
+        s = b.string("naranja")
+        inner_offs = []
+        for v in (1, 2, 3):
+            b.start_table()
+            b.add_scalar(0, "int64", v * 1000)
+            inner_offs.append(b.end_table())
+        tv = b.offset_vector(inner_offs)
+        data = b.bytes_vector(bytes(range(16)))
+        dims = b.scalar_vector("uint32", [3, 224, 224, 1])
+        b.start_table()
+        b.add_offset(0, s)
+        b.add_offset(1, tv)
+        b.add_offset(2, data)
+        b.add_offset(3, dims)
+        root_off = b.end_table()
+        buf = b.finish(root_off, identifier="NNST")
+        t = fb.root(buf, expect_identifier="NNST")
+        assert t.string(0) == "naranja"
+        assert [x.scalar(0, "int64") for x in t.table_vector(1)] == \
+            [1000, 2000, 3000]
+        assert t.bytes_vector(2) == bytes(range(16))
+        assert t.scalar_vector(3, "uint32") == [3, 224, 224, 1]
+
+    def test_identifier_mismatch(self):
+        b = fb.Builder()
+        b.start_table()
+        off = b.end_table()
+        buf = b.finish(off, identifier="AAAA")
+        with pytest.raises(ValueError):
+            fb.root(buf, expect_identifier="BBBB")
+
+    def test_alignment_of_scalars(self):
+        # int64 fields must land 8-aligned in the final buffer
+        b = fb.Builder()
+        b.start_table()
+        b.add_scalar(0, "uint8", 7)
+        b.add_scalar(1, "int64", 2 ** 40)
+        off = b.end_table()
+        buf = b.finish(off)
+        t = fb.root(buf)
+        assert t.scalar(1, "int64") == 2 ** 40
+        assert t._field_pos(1) % 8 == 0
+
+
+class TestTFLiteParser:
+    @needs_ref
+    def test_parse_mobilenet_structure(self):
+        from nnstreamer_tpu.filter.backends.tflite import parse_tflite
+
+        path = os.path.join(REF_MODELS, "mobilenet_v2_1.0_224_quant.tflite")
+        with open(path, "rb") as f:
+            g = parse_tflite(f.read())
+        assert len(g.tensors) == 173 and len(g.ops) == 65
+        tin = g.tensors[g.inputs[0]]
+        assert tin.shape == (1, 224, 224, 3)
+        assert tin.np_dtype == np.uint8 and tin.quantized
+        tout = g.tensors[g.outputs[0]]
+        assert tout.shape == (1, 1001)
+
+    @needs_ref
+    def test_add_model_invoke(self):
+        props = FilterProperties(framework="tensorflow-lite",
+                                 model=os.path.join(REF_MODELS, "add.tflite"))
+        fw = open_backend(props)
+        try:
+            ii, oi = fw.get_model_info()
+            assert str(ii[0].dtype) == "float32"
+            x = np.full(ii[0].np_shape, 3.5, np.float32)
+            out = np.asarray(fw.invoke([x])[0])
+            # reference ssat: add.tflite computes x + 2
+            assert np.allclose(out, 5.5)
+        finally:
+            fw.close()
+
+    @needs_ref
+    def test_auto_detect_by_extension(self):
+        path = os.path.join(REF_MODELS, "add.tflite")
+        assert detect_framework(path) == "tensorflow-lite"
+
+    def test_missing_file(self):
+        props = FilterProperties(framework="tensorflow-lite",
+                                 model="/no/such/model.tflite")
+        with pytest.raises(FilterError):
+            open_backend(props)
+
+    @needs_ref
+    @pytest.mark.slow
+    def test_mobilenet_quant_orange(self):
+        """Golden semantics: the reference ssat suite classifies orange.png
+        as 'orange' (tests/nnstreamer_filter_tensorflow2_lite/runTest.sh)."""
+        PIL = pytest.importorskip("PIL.Image")
+        img = PIL.open(
+            "/root/reference/tests/test_models/data/orange.png").convert(
+            "RGB").resize((224, 224))
+        x = np.asarray(img, np.uint8)[None]
+        props = FilterProperties(
+            framework="tensorflow2-lite",
+            model=os.path.join(REF_MODELS,
+                               "mobilenet_v2_1.0_224_quant.tflite"))
+        fw = open_backend(props)
+        try:
+            out = np.asarray(fw.invoke([x])[0]).reshape(-1)
+            assert out.dtype == np.uint8 and out.shape == (1001,)
+            assert out.argmax() == 951   # 'orange' (1001-class labels.txt)
+        finally:
+            fw.close()
+
+
+class TestOpLoweringOracles:
+    """Numeric cross-checks of tricky op lowerings against torch."""
+
+    def test_transpose_conv_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        from nnstreamer_tpu.filter.backends.tflite import _transpose_conv
+
+        class _Opts:   # padding=VALID(1), stride 2x2
+            @staticmethod
+            def scalar(fid, kind, default=0):
+                return {0: 1, 1: 2, 2: 2}.get(fid, default)
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(1, 5, 5, 3)).astype(np.float32)
+        w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)  # OHWI
+        want = torch.nn.functional.conv_transpose2d(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)),
+            # torch weight layout (in, out, kh, kw)
+            torch.from_numpy(w.transpose(3, 0, 1, 2)),
+            stride=2).numpy().transpose(0, 2, 3, 1)
+        out_shape = np.asarray(want.shape, np.int32)
+        got = np.asarray(_transpose_conv(
+            [None, w, x], _Opts(), {0: out_shape}))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_strided_slice_rejects_new_axis_mask(self):
+        from nnstreamer_tpu.filter.backends.tflite import _strided_slice
+
+        class _Opts:
+            @staticmethod
+            def scalar(fid, kind, default=0):
+                return 1 if fid == 3 else 0   # new_axis_mask
+
+        with pytest.raises(FilterError, match="new_axis"):
+            _strided_slice([np.zeros((2, 2), np.float32)], _Opts(),
+                           {1: np.zeros(2, np.int32),
+                            2: np.ones(2, np.int32),
+                            3: np.ones(2, np.int32)})
+
+
+class TestPyTorchBackend:
+    @needs_ref
+    def test_two_input_two_output(self):
+        path = os.path.join(REF_MODELS,
+                            "sample_3x4_two_input_two_output.pt")
+        props = FilterProperties(
+            framework="pytorch", model=path,
+            input_info=TensorsInfo.from_strings("3:4,3:4",
+                                                "float32,float32"))
+        fw = open_backend(props)
+        try:
+            ii, oi = fw.get_model_info()
+            assert len(ii) == 2 and len(oi) == 2
+            x = np.ones((4, 3), np.float32)
+            h = np.full((4, 3), 2.0, np.float32)
+            o1, o2 = fw.invoke([x, h])
+            # traced model: (x + 1, h + 2)
+            assert np.allclose(o1, 2.0) and np.allclose(o2, 4.0)
+        finally:
+            fw.close()
+
+    @needs_ref
+    def test_requires_input_info(self):
+        path = os.path.join(REF_MODELS,
+                            "sample_3x4_two_input_two_output.pt")
+        with pytest.raises(FilterError, match="input_info"):
+            open_backend(FilterProperties(framework="pytorch", model=path))
+
+    @needs_ref
+    def test_auto_detect(self):
+        path = os.path.join(REF_MODELS,
+                            "sample_3x4_two_input_two_output.pt")
+        assert detect_framework(path) == "pytorch"
