@@ -155,6 +155,27 @@ def decode_tensors_proto(blob: bytes) -> List[np.ndarray]:
 
 
 @register_decoder
+class FlatbufDecoder(Decoder):
+    """``mode=flatbuf``: frame → one finished ``Tensors`` flatbuffer
+    (schema nnstreamer.fbs; reference tensordec-flatbuf.cc), built with the
+    in-tree flatbuffer runtime — no flatbuffers library required."""
+
+    MODE = "flatbuf"
+
+    def get_out_caps(self, config: TensorsConfig) -> Caps:
+        return Caps([Structure("other/flatbuf-tensor", {
+            "framerate": config.rate or Fraction(0, 1)})])
+
+    def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
+        from ..utils.tensor_flatbuf import encode_tensors
+
+        arrays = [buf.np(i) for i in range(buf.num_tensors)]
+        names = [i.name for i in config.info] if config.info else None
+        blob = encode_tensors(arrays, rate=config.rate, names=names)
+        return buf.with_tensors([np.frombuffer(blob, np.uint8)])
+
+
+@register_decoder
 class ProtobufDecoder(Decoder):
     MODE = "protobuf"
 

@@ -252,6 +252,15 @@ class _Lowerer:
         if handler is None:
             name = op.custom_code or f"builtin#{op.code}"
             raise FilterError(f"tflite: unsupported op {name}")
+        # shape-like operands must be graph constants; a computed shape
+        # means a genuinely dynamic model — fail by name, not deep in a
+        # handler with a None
+        for pos in _STATIC_OPERANDS.get(op.code, ()):
+            if (pos < len(op.inputs) and op.inputs[pos] >= 0
+                    and op.inputs[pos] not in self.static):
+                raise FilterError(
+                    f"tflite: op builtin#{op.code} operand {pos} is "
+                    "dynamic (non-constant shape/axis) — unsupported")
         ins = [self._val(env, i) for i in op.inputs]
         statics = {pos: self.static.get(op.inputs[pos])
                    for pos in _STATIC_OPERANDS.get(op.code, ())
@@ -481,12 +490,51 @@ def _slice_op(ins, opts, statics):
 
 
 def _resize(method: str):
+    """RESIZE_BILINEAR (flags: align_corners@2, half_pixel_centers@3) /
+    RESIZE_NEAREST_NEIGHBOR (align_corners@0, half_pixel_centers@1).
+    All three tflite sampling grids are honored: legacy ``i*scale`` (both
+    flags false), half-pixel, and align-corners."""
+    ac_f, hp_f = (2, 3) if method == "bilinear" else (0, 1)
+
+    def coords(out_len, in_len, align, half):
+        import jax.numpy as jnp
+
+        i = jnp.arange(out_len, dtype=jnp.float32)
+        if align and out_len > 1:
+            return i * (in_len - 1) / (out_len - 1)
+        if half:
+            return (i + 0.5) * in_len / out_len - 0.5
+        return i * in_len / out_len
+
     def run(ins, opts, statics):
-        import jax
+        import jax.numpy as jnp
 
         x = ins[0]
-        h, w = (int(v) for v in statics[1])
-        return jax.image.resize(x, (x.shape[0], h, w, x.shape[3]), method)
+        h2, w2 = (int(v) for v in statics[1])
+        n, h, w, c = x.shape
+        align = bool(opts.scalar(ac_f, "bool", False)) if opts else False
+        half = bool(opts.scalar(hp_f, "bool", False)) if opts else False
+        ys = coords(h2, h, align, half)
+        xs = coords(w2, w, align, half)
+        if method == "nearest":
+            # tflite: round under align_corners/half-pixel, floor otherwise
+            yi = jnp.round(ys) if (align or half) else jnp.floor(ys)
+            xi = jnp.round(xs) if (align or half) else jnp.floor(xs)
+            yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            return x[:, yi][:, :, xi]
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = jnp.clip(ys, 0, h - 1) - y0      # (h2,)
+        wx = jnp.clip(xs, 0, w - 1) - x0      # (w2,)
+        top = (x[:, y0][:, :, x0] * (1 - wx)[None, None, :, None]
+               + x[:, y0][:, :, x1] * wx[None, None, :, None])
+        bot = (x[:, y1][:, :, x0] * (1 - wx)[None, None, :, None]
+               + x[:, y1][:, :, x1] * wx[None, None, :, None])
+        return top * (1 - wy)[None, :, None, None] \
+            + bot * wy[None, :, None, None]
     return run
 
 

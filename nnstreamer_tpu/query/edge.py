@@ -17,6 +17,7 @@ import queue as _queue
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..pipeline.caps import Caps
@@ -74,13 +75,22 @@ class EdgeBroker:
                     if role == "sub":
                         with self._lock:
                             self._subs.setdefault(topic, set()).add(conn)
-                            self._send_locks[conn] = threading.Lock()
-                        # send retained caps for the topic
-                        send_msg(conn, Message(T_HELLO, payload=(
-                            self._topic_caps.get(topic, "").encode())))
+                            slock = self._send_locks[conn] = threading.Lock()
+                            retained = self._topic_caps.get(topic, "")
+                        if retained:   # retained caps, if already known;
+                            # under the send lock — a concurrent publisher
+                            # fan-out must not interleave frames
+                            with slock:
+                                send_msg(conn, Message(
+                                    T_HELLO, payload=retained.encode()))
                     elif role == "pub" and caps:
                         with self._lock:
                             self._topic_caps[topic] = caps
+                        # push caps to subscribers that arrived first
+                        # (MQTT retained-message semantics; closes the
+                        # sub-before-pub startup race)
+                        self._fanout(topic, Message(T_HELLO,
+                                                    payload=caps.encode()))
                 elif msg.type == T_DATA and role == "pub":
                     self._fanout(topic, msg)
         finally:
@@ -144,15 +154,27 @@ class EdgeSink(Element):
         "host": ("127.0.0.1", "broker host"),
         "port": (0, "broker port"),
         "topic": ("default", ""),
+        "ntp-host": (None, "NTP server(s) for epoch alignment, comma-sep "
+                           "(default: local wall clock)"),
     }
 
     def _make_pads(self):
         self.add_sink_pad(tensors_template_caps(), "sink")
 
     def start(self):
+        from ..utils.ntp import WallClockSync
+
         self._sock = socket.create_connection(
             (str(self.host), int(self.port)), timeout=10)
         self._caps_sent = False
+        # stream-origin epoch: wall clock (NTP-aligned when ntp-host set) at
+        # start, when running-time 0 ≈ now — the reference mqttsink's
+        # base_time_epoch (mqttsink.c, synchronization-in-mqtt-elements.md)
+        hosts = ([h.strip() for h in str(self.ntp_host).split(",")]
+                 if self.ntp_host else None)
+        sync = WallClockSync(hosts=hosts) if hosts else None
+        self._base_epoch_us = (sync.now_us() if sync
+                               else time.time_ns() // 1000)
 
     def stop(self):
         try:
@@ -172,6 +194,7 @@ class EdgeSink(Element):
                                          payload=f"pub:{self.topic}".encode()))
             self._caps_sent = True
         send_msg(self._sock, Message(T_DATA, pts=buf.pts or 0,
+                                     epoch_us=self._base_epoch_us,
                                      payload=encode_tensors(buf)))
         return FlowReturn.OK
 
@@ -191,12 +214,24 @@ class EdgeSrc(Source):
         "topic": ("default", ""),
         "caps": (None, "override caps (else retained topic caps)"),
         "num-buffers": (-1, "stop after N buffers, -1 unlimited"),
+        "sync-pts": (False, "re-base incoming PTS onto this host's clock "
+                            "using the sender's embedded epoch"),
+        "ntp-host": (None, "NTP server(s) for epoch alignment, comma-sep"),
     }
 
     def _make_pads(self):
         self.add_src_pad(tensors_template_caps(), "src")
 
     def start(self):
+        from ..utils.ntp import WallClockSync
+
+        # own stream-origin epoch, for re-basing sender PTS (the receiver
+        # half of the reference's NTP-based mqtt timestamp alignment)
+        hosts = ([h.strip() for h in str(self.ntp_host).split(",")]
+                 if self.ntp_host else None)
+        sync = WallClockSync(hosts=hosts) if hosts else None
+        self._base_epoch_us = (sync.now_us() if sync
+                               else time.time_ns() // 1000)
         self._sock = socket.create_connection(
             (str(self.host), int(self.port)), timeout=10)
         send_msg(self._sock, Message(T_HELLO,
@@ -222,11 +257,17 @@ class EdgeSrc(Source):
                 self._fifo.put(None)
                 return
             if msg.type == T_HELLO:
-                self._retained_caps = msg.payload.decode() or None
-                self._caps_evt.set()
+                if msg.payload:
+                    self._retained_caps = msg.payload.decode()
+                    self._caps_evt.set()
             elif msg.type == T_DATA:
+                pts = msg.pts
+                if self.sync_pts and msg.epoch_us:
+                    # sender running-time → this host's running time:
+                    # shift by the epoch difference (µs → ns)
+                    pts = msg.pts + (msg.epoch_us - self._base_epoch_us) * 1000
                 buf = TensorBuffer(tensors=decode_tensors(msg.payload),
-                                   pts=msg.pts)
+                                   pts=pts)
                 self._fifo.put(buf)
 
     def negotiate(self) -> Caps:

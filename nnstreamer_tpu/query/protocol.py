@@ -10,7 +10,11 @@ static and flexible streams ride the same format.
 
 Message layout (little endian):
   u32 magic 'NNSQ' | u8 type | u64 client_id | u64 seq | i64 pts
-  | u32 payload_len | payload
+  | i64 epoch_us | u32 payload_len | payload
+``epoch_us`` is the sender's stream-origin wall clock (NTP-aligned unix
+epoch µs, 0 = unknown) — the role of the reference mqtt header's
+``base_time_epoch`` (gst/mqtt/mqttcommon.h:54) that lets a receiving
+pipeline re-base PTS from another device onto its own clock.
 Types: 1=HELLO (payload = caps string utf8), 2=DATA, 3=REPLY, 4=BYE,
 5=ERROR (payload = message).
 """
@@ -29,7 +33,7 @@ from ..tensor.info import TensorInfo
 from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 
 MAGIC = 0x4E4E5351  # 'NNSQ'
-HEADER = struct.Struct("<IBQQqI")
+HEADER = struct.Struct("<IBQQqqI")
 
 T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR = 1, 2, 3, 4, 5
 
@@ -40,12 +44,13 @@ class Message:
     client_id: int = 0
     seq: int = 0
     pts: int = 0
+    epoch_us: int = 0
     payload: bytes = b""
 
 
 def pack(msg: Message) -> bytes:
     return HEADER.pack(MAGIC, msg.type, msg.client_id, msg.seq,
-                       msg.pts, len(msg.payload)) + msg.payload
+                       msg.pts, msg.epoch_us, len(msg.payload)) + msg.payload
 
 
 def encode_tensors(buf: TensorBuffer) -> bytes:
@@ -84,14 +89,14 @@ def recv_msg(sock: socket.socket) -> Optional[Message]:
     hdr = _recv_exact(sock, HEADER.size)
     if hdr is None:
         return None
-    magic, typ, cid, seq, pts, plen = HEADER.unpack(hdr)
+    magic, typ, cid, seq, pts, epoch, plen = HEADER.unpack(hdr)
     if magic != MAGIC:
         raise ValueError(f"bad magic 0x{magic:08x}")
     payload = _recv_exact(sock, plen) if plen else b""
     if plen and payload is None:
         return None
     return Message(type=typ, client_id=cid, seq=seq, pts=pts,
-                   payload=payload or b"")
+                   epoch_us=epoch, payload=payload or b"")
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

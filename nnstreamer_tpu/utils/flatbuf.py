@@ -99,6 +99,13 @@ class Table:
         p = self._indirect(field_id)
         return Table(self.buf, p) if p else None
 
+    def struct(self, field_id: int, fmt: str) -> Optional[Tuple[Any, ...]]:
+        """Inline struct field unpacked with ``fmt`` (e.g. ``"<ii"``)."""
+        p = self._field_pos(field_id)
+        if not p:
+            return None
+        return struct.unpack_from(fmt, self.buf, p)
+
     # -- vectors -------------------------------------------------------------
     def _vector(self, field_id: int) -> Tuple[int, int]:
         """(element-0 position, length); (0, 0) when absent."""
@@ -251,6 +258,14 @@ class Builder:
             return
         self._current.append((field_id, 1, offset, ""))
 
+    def add_struct(self, field_id: int, fmt: str,
+                   values: Sequence[Any], align: int = 4) -> None:
+        """Inline struct field: packed with ``fmt``, ``align`` = largest
+        member size (structs are stored in-place in the table)."""
+        assert self._current is not None
+        self._current.append(
+            (field_id, 2, (struct.pack(fmt, *values), align), ""))
+
     def end_table(self) -> int:
         assert self._current is not None
         fields = self._current
@@ -260,9 +275,14 @@ class Builder:
         slots: dict = {}   # field_id -> (end-offset of field start, size)
         for field_id, is_off, value, kind in sorted(
                 fields, key=lambda f: -f[0]):
-            if is_off:
+            if is_off == 1:
                 self._push_u32_rel(value)
                 size = 4
+            elif is_off == 2:
+                raw, align = value
+                size = len(raw)
+                self._prep(align, size)
+                self._push(raw)
             else:
                 fmt, size = _SCALAR_FMT[kind]
                 self._prep(size, size)

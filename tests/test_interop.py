@@ -172,6 +172,58 @@ class TestOpLoweringOracles:
         assert got.shape == want.shape
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
+    @staticmethod
+    def _resize_opts(method, align=False, half=False):
+        ac_f, hp_f = (2, 3) if method == "bilinear" else (0, 1)
+
+        class _Opts:
+            @staticmethod
+            def scalar(fid, kind, default=0):
+                if fid == ac_f:
+                    return align
+                if fid == hp_f:
+                    return half
+                return default
+        return _Opts()
+
+    def test_resize_bilinear_half_pixel_matches_jax_image(self):
+        import jax
+        from nnstreamer_tpu.filter.backends.tflite import _resize
+
+        x = np.random.default_rng(3).normal(
+            size=(1, 4, 4, 2)).astype(np.float32)
+        got = np.asarray(_resize("bilinear")(
+            [x], self._resize_opts("bilinear", half=True),
+            {1: np.array([7, 9], np.int32)}))
+        want = np.asarray(jax.image.resize(x, (1, 7, 9, 2), "bilinear"))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_resize_bilinear_align_corners_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        from nnstreamer_tpu.filter.backends.tflite import _resize
+
+        x = np.random.default_rng(4).normal(
+            size=(1, 5, 5, 3)).astype(np.float32)
+        got = np.asarray(_resize("bilinear")(
+            [x], self._resize_opts("bilinear", align=True),
+            {1: np.array([8, 8], np.int32)}))
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)), size=(8, 8),
+            mode="bilinear", align_corners=True
+        ).numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_resize_nearest_legacy_grid(self):
+        from nnstreamer_tpu.filter.backends.tflite import _resize
+
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        got = np.asarray(_resize("nearest")(
+            [x], self._resize_opts("nearest"),
+            {1: np.array([2, 2], np.int32)}))
+        # legacy grid: src = floor(i * in/out) → rows/cols 0 and 2
+        want = x[:, [0, 2]][:, :, [0, 2]]
+        np.testing.assert_array_equal(got, want)
+
     def test_strided_slice_rejects_new_axis_mask(self):
         from nnstreamer_tpu.filter.backends.tflite import _strided_slice
 

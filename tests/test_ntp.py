@@ -1,0 +1,163 @@
+"""NTP util + cross-device PTS alignment tests.
+
+Hermetic mocked-NTP strategy per the reference
+(tests/gstreamer_mqtt/unittest_ntp_util_mock.cc gmocks the socket layer);
+here the query callable is injected.
+"""
+
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.utils.ntp import (NTP_TIMESTAMP_DELTA, NTPError,
+                                      WallClockSync, get_epoch_us,
+                                      parse_xmit_epoch_us)
+
+
+def fake_response(unix_sec: float) -> bytes:
+    """Craft a 48-byte SNTP response whose xmit timestamp is unix_sec."""
+    ntp_sec = int(unix_sec) + NTP_TIMESTAMP_DELTA
+    frac = int((unix_sec % 1.0) * (1 << 32))
+    resp = bytearray(48)
+    struct.pack_into(">II", resp, 40, ntp_sec, frac)
+    return bytes(resp)
+
+
+class TestSNTP:
+    def test_parse_xmit_epoch(self):
+        got = parse_xmit_epoch_us(fake_response(1_700_000_000.5))
+        assert got == 1_700_000_000_500_000
+
+    def test_parse_rejects_short_and_zero(self):
+        with pytest.raises(NTPError):
+            parse_xmit_epoch_us(b"\x00" * 12)
+        with pytest.raises(NTPError):
+            parse_xmit_epoch_us(b"\x00" * 48)   # zero xmit timestamp
+
+    def test_get_epoch_us_fallback_order(self):
+        calls = []
+
+        def query(host, port, packet, timeout):
+            calls.append(host)
+            # client packet: LI=0 VN=4 mode=3
+            assert packet[0] == 0x23 and len(packet) == 48
+            if host == "bad":
+                raise OSError("unreachable")
+            return fake_response(123.0)
+
+        got = get_epoch_us(["bad", "good"], [123, 123], _query=query)
+        assert got == 123_000_000
+        assert calls == ["bad", "good"]
+
+    def test_get_epoch_us_all_fail(self):
+        def query(host, port, packet, timeout):
+            raise OSError("nope")
+
+        with pytest.raises(NTPError):
+            get_epoch_us(["a", "b"], _query=query)
+
+
+class TestWallClockSync:
+    def test_offset_applied(self):
+        local = [5_000_000]      # local clock says 5s
+
+        def query(host, port, packet, timeout):
+            return fake_response(12.0)   # NTP says 12s
+
+        sync = WallClockSync(hosts=["x"], _query=query,
+                             _local_us=lambda: local[0])
+        assert sync.now_us() == 12_000_000
+        assert sync.offset_us() == 7_000_000
+        assert sync.synced
+        local[0] += 1_000_000    # local advances 1s; offset cached
+        assert sync.now_us() == 13_000_000
+
+    def test_fallback_to_local(self):
+        def query(host, port, packet, timeout):
+            raise OSError("zero egress")
+
+        sync = WallClockSync(hosts=["x"], _query=query,
+                             _local_us=lambda: 42_000_000)
+        assert sync.now_us() == 42_000_000
+        assert not sync.synced
+
+
+class TestEdgePTSRebase:
+    def test_sync_pts_shifts_by_epoch_delta(self):
+        """Two 'hosts' with skewed stream origins: the subscriber re-bases
+        the publisher's PTS onto its own clock (the reference's
+        synchronization-in-mqtt-elements.md behavior)."""
+        from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+        from nnstreamer_tpu.elements import TensorSink
+        from nnstreamer_tpu.query.edge import EdgeSink, EdgeSrc, get_broker
+        from nnstreamer_tpu.tensor import TensorBuffer
+
+        broker = get_broker()
+        caps = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+                "types=float32,framerate=0/1")
+
+        pub = Pipeline()
+        src = AppSrc("src", caps=caps)
+        esink = EdgeSink("es", port=broker.port, topic="t-sync")
+        pub.add(src, esink)
+        pub.link(src, esink)
+
+        sub = Pipeline()
+        esrc = EdgeSrc("er", port=broker.port, topic="t-sync",
+                       **{"num-buffers": 1, "sync-pts": True})
+        tsink = TensorSink("out")
+        sub.add(esrc, tsink)
+        sub.link(esrc, tsink)
+
+        pub.play()
+        # force known epochs AFTER start computed them
+        esink._base_epoch_us = 2_000_000      # sender origin: t=2s
+        sub.play()
+        esrc._base_epoch_us = 500_000         # receiver origin: t=0.5s
+        src.push_buffer(TensorBuffer(
+            tensors=[np.zeros(4, np.float32)], pts=100_000_000))  # 0.1s
+        src.end_of_stream()
+        sub.wait(timeout=10)
+        pub.stop()
+        sub.stop()
+        assert len(tsink.results) == 1
+        # 0.1s + (2s - 0.5s) = 1.6s in receiver running time
+        assert tsink.results[0].pts == 1_600_000_000
+
+    def test_subscriber_before_publisher(self):
+        """A subscriber that connects before any publisher must block in
+        negotiation until the publisher announces caps (broker pushes
+        retained caps — MQTT retained-message semantics), not fail."""
+        from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+        from nnstreamer_tpu.elements import TensorSink
+        from nnstreamer_tpu.query.edge import EdgeSink, EdgeSrc, get_broker
+        from nnstreamer_tpu.tensor import TensorBuffer
+
+        broker = get_broker()
+        caps = ("other/tensors,format=static,num_tensors=1,dimensions=2,"
+                "types=int32,framerate=0/1")
+
+        sub = Pipeline()
+        esrc = EdgeSrc("er2", port=broker.port, topic="t-late",
+                       **{"num-buffers": 1})
+        tsink = TensorSink("out2")
+        sub.add(esrc, tsink)
+        sub.link(esrc, tsink)
+        sub.play()                      # subscriber first
+
+        pub = Pipeline()
+        src = AppSrc("src2", caps=caps)
+        esink = EdgeSink("es2", port=broker.port, topic="t-late")
+        pub.add(src, esink)
+        pub.link(src, esink)
+        pub.play()
+        src.push_buffer(TensorBuffer(
+            tensors=[np.array([7, 9], np.int32)], pts=0))
+        src.end_of_stream()
+        sub.wait(timeout=10)
+        pub.stop()
+        sub.stop()
+        assert len(tsink.results) == 1
+        np.testing.assert_array_equal(tsink.results[0].np(0), [7, 9])

@@ -71,6 +71,46 @@ class TestFlexbufDecoder:
             out.np(0), np.arange(6, dtype=np.float32).reshape(2, 3))
 
 
+class TestFlatbufRoundTrip:
+    def test_codec_round_trip(self):
+        from fractions import Fraction
+
+        from nnstreamer_tpu.utils.tensor_flatbuf import (decode_tensors,
+                                                         encode_tensors)
+
+        arrays = [np.arange(12, dtype=np.float32).reshape(3, 4),
+                  np.array([9, 8], np.int64),
+                  np.arange(6, dtype=np.uint8).reshape(1, 2, 3)]
+        blob = encode_tensors(arrays, rate=Fraction(30, 1),
+                              names=["a", None, "c"])
+        back, rate, names = decode_tensors(blob)
+        assert rate == Fraction(30, 1)
+        assert names == ["a", None, "c"]
+        for got, want in zip(back, arrays):
+            np.testing.assert_array_equal(got, want)
+            assert got.dtype == want.dtype
+
+    def test_rejects_unsupported_dtype(self):
+        from nnstreamer_tpu.utils.tensor_flatbuf import encode_tensors
+
+        with pytest.raises(ValueError, match="Tensor_type"):
+            encode_tensors([np.zeros(2, np.float16)])
+
+    def test_pipeline_flatbuf_loop(self):
+        """decoder → converter round trip through a launch pipeline
+        (reference: tensordec-flatbuf.cc ↔ tensor_converter_flatbuf.cc)."""
+        sink = decode_one(tcaps("3:2", "float32"), {"mode": "flatbuf"},
+                          [np.arange(6, dtype=np.float32).reshape(2, 3)])
+        blob = sink.results[0].np(0)
+        assert blob.dtype == np.uint8
+        from nnstreamer_tpu.converters import find_converter
+
+        conv = find_converter("flatbuf")
+        out = conv.convert(TensorBuffer(tensors=[blob]))
+        np.testing.assert_array_equal(
+            out.np(0), np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
 class TestFontDecoder:
     def test_renders_text(self):
         text = np.frombuffer(b"AB 12", dtype=np.uint8)
