@@ -38,6 +38,10 @@ def main() -> None:
         "tensor_converter ! "
         "tensor_filter framework=xla model=mobilenet_v2"
         f" custom=seed:0{dtype_prop} name=f ! "
+        # queue = thread boundary: the decoder's host fetch of frame N
+        # overlaps the dispatch + async d2h copy of frames N+1..N+8, so the
+        # tunnel RTT is paid once, not per frame
+        "queue max-size-buffers=8 ! "
         "tensor_decoder mode=image_labeling ! tensor_sink name=out")
 
     stamps = []

@@ -147,6 +147,13 @@ _STATIC_OPERANDS: Dict[int, Sequence[int]] = {
     67: (0,),        # TRANSPOSE_CONV output_shape
 }
 
+# operands whose handler can recover from a non-constant tensor via the op's
+# options (real tflite supports these too): RESHAPE falls back to
+# ReshapeOptions.new_shape when operand 1 is computed
+_STATIC_FALLBACK: Dict[int, Sequence[int]] = {
+    22: (1,),
+}
+
 
 def _const_array(g: _Graph, idx: int) -> Optional[np.ndarray]:
     """Materialize tensor ``idx`` from its buffer, or None if activation."""
@@ -255,9 +262,11 @@ class _Lowerer:
         # shape-like operands must be graph constants; a computed shape
         # means a genuinely dynamic model — fail by name, not deep in a
         # handler with a None
+        fallback = _STATIC_FALLBACK.get(op.code, ())
         for pos in _STATIC_OPERANDS.get(op.code, ()):
             if (pos < len(op.inputs) and op.inputs[pos] >= 0
-                    and op.inputs[pos] not in self.static):
+                    and op.inputs[pos] not in self.static
+                    and not (pos in fallback and op.options is not None)):
                 raise FilterError(
                     f"tflite: op builtin#{op.code} operand {pos} is "
                     "dynamic (non-constant shape/axis) — unsupported")
@@ -514,15 +523,27 @@ def _resize(method: str):
         n, h, w, c = x.shape
         align = bool(opts.scalar(ac_f, "bool", False)) if opts else False
         half = bool(opts.scalar(hp_f, "bool", False)) if opts else False
+        if method == "nearest":
+            # tflite resize_nearest_neighbor.cc source-index selection:
+            #   half_pixel_centers: floor((i + 0.5) * in / out)
+            #   align_corners: std::round(i * (in-1)/(out-1)) — half away
+            #     from zero, NOT jnp.round's half-to-even
+            #   default: floor(i * in / out)
+            def nn_idx(out_len, in_len):
+                i = jnp.arange(out_len, dtype=jnp.float32)
+                if half:
+                    v = jnp.floor((i + 0.5) * in_len / out_len)
+                elif align and out_len > 1:
+                    v = jnp.floor(i * (in_len - 1) / (out_len - 1) + 0.5)
+                else:
+                    v = jnp.floor(i * in_len / out_len)
+                return jnp.clip(v, 0, in_len - 1).astype(jnp.int32)
+
+            yi = nn_idx(h2, h)
+            xi = nn_idx(w2, w)
+            return x[:, yi][:, :, xi]
         ys = coords(h2, h, align, half)
         xs = coords(w2, w, align, half)
-        if method == "nearest":
-            # tflite: round under align_corners/half-pixel, floor otherwise
-            yi = jnp.round(ys) if (align or half) else jnp.floor(ys)
-            xi = jnp.round(xs) if (align or half) else jnp.floor(xs)
-            yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
-            xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
-            return x[:, yi][:, :, xi]
         y0 = jnp.clip(jnp.floor(ys), 0, h - 1).astype(jnp.int32)
         x0 = jnp.clip(jnp.floor(xs), 0, w - 1).astype(jnp.int32)
         y1 = jnp.minimum(y0 + 1, h - 1)

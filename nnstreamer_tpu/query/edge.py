@@ -17,7 +17,6 @@ import queue as _queue
 import socket
 import struct
 import threading
-import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..pipeline.caps import Caps
@@ -77,12 +76,16 @@ class EdgeBroker:
                             self._subs.setdefault(topic, set()).add(conn)
                             slock = self._send_locks[conn] = threading.Lock()
                             retained = self._topic_caps.get(topic, "")
-                        if retained:   # retained caps, if already known;
-                            # under the send lock — a concurrent publisher
-                            # fan-out must not interleave frames
-                            with slock:
-                                send_msg(conn, Message(
-                                    T_HELLO, payload=retained.encode()))
+                            # retained caps must go out while still holding
+                            # the broker lock: a publisher must take this
+                            # lock to record new caps B before fanning B out,
+                            # so it cannot overtake the retained send — the
+                            # subscriber always sees retained-then-B, never
+                            # B-then-stale-retained
+                            if retained:
+                                with slock:
+                                    send_msg(conn, Message(
+                                        T_HELLO, payload=retained.encode()))
                     elif role == "pub" and caps:
                         with self._lock:
                             self._topic_caps[topic] = caps
@@ -162,7 +165,7 @@ class EdgeSink(Element):
         self.add_sink_pad(tensors_template_caps(), "sink")
 
     def start(self):
-        from ..utils.ntp import WallClockSync
+        from ..utils.ntp import stream_origin_epoch_us
 
         self._sock = socket.create_connection(
             (str(self.host), int(self.port)), timeout=10)
@@ -170,11 +173,7 @@ class EdgeSink(Element):
         # stream-origin epoch: wall clock (NTP-aligned when ntp-host set) at
         # start, when running-time 0 ≈ now — the reference mqttsink's
         # base_time_epoch (mqttsink.c, synchronization-in-mqtt-elements.md)
-        hosts = ([h.strip() for h in str(self.ntp_host).split(",")]
-                 if self.ntp_host else None)
-        sync = WallClockSync(hosts=hosts) if hosts else None
-        self._base_epoch_us = (sync.now_us() if sync
-                               else time.time_ns() // 1000)
+        self._base_epoch_us = stream_origin_epoch_us(self.ntp_host, self.name)
 
     def stop(self):
         try:
@@ -223,15 +222,11 @@ class EdgeSrc(Source):
         self.add_src_pad(tensors_template_caps(), "src")
 
     def start(self):
-        from ..utils.ntp import WallClockSync
+        from ..utils.ntp import stream_origin_epoch_us
 
         # own stream-origin epoch, for re-basing sender PTS (the receiver
         # half of the reference's NTP-based mqtt timestamp alignment)
-        hosts = ([h.strip() for h in str(self.ntp_host).split(",")]
-                 if self.ntp_host else None)
-        sync = WallClockSync(hosts=hosts) if hosts else None
-        self._base_epoch_us = (sync.now_us() if sync
-                               else time.time_ns() // 1000)
+        self._base_epoch_us = stream_origin_epoch_us(self.ntp_host, self.name)
         self._sock = socket.create_connection(
             (str(self.host), int(self.port)), timeout=10)
         send_msg(self._sock, Message(T_HELLO,

@@ -32,7 +32,10 @@ from ..tensor.buffer import TensorBuffer
 from ..tensor.info import TensorInfo
 from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 
-MAGIC = 0x4E4E5351  # 'NNSQ'
+# Wire revision 2 ('NNSR'): the header gained epoch_us ('NNSQ' was <IBQQqI).
+# The magic doubles as the version stamp — a peer speaking another revision
+# fails immediately with "bad magic" instead of desynchronizing the stream.
+MAGIC = 0x4E4E5352  # 'NNSR'
 HEADER = struct.Struct("<IBQQqqI")
 
 T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR = 1, 2, 3, 4, 5

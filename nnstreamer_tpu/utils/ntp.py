@@ -125,3 +125,27 @@ class WallClockSync:
         with self._lock:
             self._maybe_refresh()
             return self._local_us() + self._offset_us
+
+
+def stream_origin_epoch_us(ntp_host, element_name: str = "edge") -> int:
+    """Stream-origin wall-clock epoch (µs) for edge elements.
+
+    Shared by edge_sink/edge_src start(): parses the ``ntp-host`` property
+    (comma-separated servers, None → local clock), queries via
+    :class:`WallClockSync`, and — when NTP was explicitly requested but no
+    server answered — warns loudly instead of silently using the local
+    clock, since unaligned epochs corrupt cross-device PTS re-basing.
+    """
+    from .log import ml_logw
+
+    if not ntp_host:
+        return time.time_ns() // 1000
+    hosts = [h.strip() for h in str(ntp_host).split(",") if h.strip()]
+    sync = WallClockSync(hosts=hosts)
+    epoch = sync.now_us()
+    if not sync.synced:
+        ml_logw("%s: ntp-host=%s set but no NTP server answered — "
+                "falling back to the LOCAL clock; cross-device PTS "
+                "alignment will be off by this host's clock error",
+                element_name, ntp_host)
+    return epoch

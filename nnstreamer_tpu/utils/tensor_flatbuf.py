@@ -80,8 +80,18 @@ def decode_tensors(blob: bytes) -> Tuple[List[np.ndarray],
         if type_id >= _NNS_END:
             raise ValueError(f"flatbuf: bad Tensor_type {type_id}")
         dtype = np.dtype(_NNS_TYPES[type_id])
-        dims = tt.scalar_vector(2, "uint32")
-        shape = tuple(reversed([d for d in dims if d > 0])) or (1,)
+        raw = tt.scalar_vector(2, "uint32")
+        dims = [d for d in raw if d > 0]
+        # Reference writers serialize all NNS_TENSOR_RANK_LIMIT entries
+        # (tensordec-flatbuf.cc:127): unfilled slots are 0 when the info was
+        # default-initialized (util_impl.c:131) but 1 when parsed from a
+        # dim string (:951).  A full-rank-limit vector (8, or legacy 4) is
+        # therefore padded — strip the trailing 1s (= outermost unit dims).
+        # Our own encoder writes exact-rank vectors, which stay lossless.
+        if len(raw) in (4, 8):
+            while len(dims) > 1 and dims[-1] == 1:
+                dims.pop()
+        shape = tuple(reversed(dims)) or (1,)
         data = tt.bytes_vector(3)
         arrays.append(np.frombuffer(data, dtype).reshape(shape))
         names.append(tt.string(0))

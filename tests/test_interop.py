@@ -224,6 +224,35 @@ class TestOpLoweringOracles:
         want = x[:, [0, 2]][:, :, [0, 2]]
         np.testing.assert_array_equal(got, want)
 
+    def test_resize_nearest_half_pixel_matches_tflite(self):
+        from nnstreamer_tpu.filter.backends.tflite import _resize
+
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        got = np.asarray(_resize("nearest")(
+            [x], self._resize_opts("nearest", half=True),
+            {1: np.array([2, 2], np.int32)}))
+        # tflite half_pixel_centers: src = floor((i+0.5)*in/out) → 1 and 3
+        want = x[:, [1, 3]][:, :, [1, 3]]
+        np.testing.assert_array_equal(got, want)
+
+    def test_resize_nearest_align_corners_rounds_half_away(self):
+        from nnstreamer_tpu.filter.backends.tflite import _resize
+
+        x = np.arange(25, dtype=np.float32).reshape(1, 5, 5, 1)
+        got = np.asarray(_resize("nearest")(
+            [x], self._resize_opts("nearest", align=True),
+            {1: np.array([3, 3], np.int32)}))
+        # align_corners 5→3: i*(4/2) = 0,2,4 exactly; and for 4→3 the
+        # midpoint i=1 gives 1.5 which std::round takes UP (half away)
+        want = x[:, [0, 2, 4]][:, :, [0, 2, 4]]
+        np.testing.assert_array_equal(got, want)
+        x2 = np.arange(4, dtype=np.float32).reshape(1, 1, 4, 1)
+        got2 = np.asarray(_resize("nearest")(
+            [x2], self._resize_opts("nearest", align=True),
+            {1: np.array([1, 3], np.int32)}))
+        want2 = x2[:, :, [0, 2, 3]]
+        np.testing.assert_array_equal(got2, want2)
+
     def test_strided_slice_rejects_new_axis_mask(self):
         from nnstreamer_tpu.filter.backends.tflite import _strided_slice
 

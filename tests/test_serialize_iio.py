@@ -90,6 +90,35 @@ class TestFlatbufRoundTrip:
             np.testing.assert_array_equal(got, want)
             assert got.dtype == want.dtype
 
+    def test_decode_strips_reference_rank_padding(self):
+        """Reference flatbuf writers serialize all 8 (legacy 4) dim slots,
+        1-padded when the info came from a parsed dim string
+        (tensordec-flatbuf.cc:127, util_impl.c:951) — a (4,3) tensor
+        arrives as dimension=[3,4,1,1,1,1,1,1] and must not grow unit
+        dims on decode."""
+        from nnstreamer_tpu.utils import flatbuf as fb
+        from nnstreamer_tpu.utils.tensor_flatbuf import decode_tensors
+
+        arr = np.arange(12, dtype=np.float32).reshape(4, 3)
+        for pad, padlen in ((1, 8), (1, 4), (0, 8)):
+            b = fb.Builder()
+            dim_off = b.scalar_vector(
+                "uint32", [3, 4] + [pad] * (padlen - 2))
+            data_off = b.bytes_vector(arr.tobytes())
+            b.start_table()
+            b.add_scalar(1, "int32", 7, default=10)   # float32
+            b.add_offset(2, dim_off)
+            b.add_offset(3, data_off)
+            t_off = b.end_table()
+            vec_off = b.offset_vector([t_off])
+            b.start_table()
+            b.add_scalar(0, "int32", 1)
+            b.add_offset(2, vec_off)
+            blob = b.finish(b.end_table())
+            back, _, _ = decode_tensors(blob)
+            assert back[0].shape == (4, 3), (pad, padlen, back[0].shape)
+            np.testing.assert_array_equal(back[0], arr)
+
     def test_rejects_unsupported_dtype(self):
         from nnstreamer_tpu.utils.tensor_flatbuf import encode_tensors
 
