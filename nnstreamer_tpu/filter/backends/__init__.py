@@ -6,11 +6,12 @@ from .custom import (CustomEasyFilter, CustomFilter, DummyFilter,
                      register_custom_easy, unregister_custom_easy)
 from .python import PythonFilter
 from .pytorch import PyTorchFilter
+from .tensorflow import TensorFlowFilter
 from .tflite import TFLiteFilter
 from .xla import XLAFilter
 
 __all__ = [
     "XLAFilter", "CustomFilter", "CustomEasyFilter", "DummyFilter",
-    "PythonFilter", "TFLiteFilter", "PyTorchFilter",
+    "PythonFilter", "TFLiteFilter", "PyTorchFilter", "TensorFlowFilter",
     "register_custom_easy", "unregister_custom_easy",
 ]

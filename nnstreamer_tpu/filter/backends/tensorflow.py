@@ -1,0 +1,693 @@
+"""TensorFlow frozen-GraphDef filter backend (dependency-free).
+
+Parity with the reference tensorflow subplugin
+(ext/nnstreamer/tensor_filter/tensor_filter_tensorflow.cc, SURVEY.md §2.4),
+re-designed TPU-first: instead of linking the TF C API and calling
+``TF_SessionRun`` on the host, the ``.pb`` GraphDef is parsed with the
+in-tree protobuf wire reader (``utils/protowire.py`` — the image ships no
+tensorflow or protobuf runtime), every node is lowered to jax/lax, and the
+whole graph jits into ONE fused XLA executable with the frozen weights
+resident in HBM.  Same loader philosophy as the tflite backend
+(``tflite.py``): the model file format is an interop surface, the execution
+engine is XLA.
+
+Contract (mirrors the reference's property requirements):
+
+- input/output selection: custom properties ``inputname=a,b`` /
+  ``outputname=y`` (reference inputname/outputname properties); defaults:
+  all ``Placeholder`` nodes in graph order → inputs, terminal nodes (no
+  consumer) → outputs.
+- input meta: taken from ``input_info`` when given, else derived from the
+  Placeholder ``shape`` attr when fully defined (the reference requires
+  explicit input dims; we accept either).
+- output meta is probed with a zero invoke at open.
+
+Static-shape discipline: shape-like operands (Reshape dims, axes, perms,
+paddings, slice bounds) must resolve to graph constants — a computed shape
+is a genuinely dynamic model and is rejected by name, exactly like the
+tflite loader.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...tensor.info import TensorInfo, TensorsInfo
+from ...utils.protowire import (fields_dict, first, packed_or_repeated_varints,
+                                repeated, to_signed64)
+from ..framework import (Accelerator, FilterError, FilterFramework,
+                         FilterProperties, FilterStatistics, register_filter,
+                         start_output_transfers)
+
+# -- GraphDef schema field numbers (tensorflow/core/framework/*.proto) -------
+
+#: DataType enum → numpy (types.proto)
+_DTYPES = {1: "float32", 2: "float64", 3: "int32", 4: "uint8", 5: "int16",
+           6: "int8", 9: "int64", 10: "bool", 14: "bfloat16", 17: "uint16",
+           19: "float16", 22: "uint32", 23: "uint64"}
+
+
+class _Node:
+    __slots__ = ("name", "op", "inputs", "attrs", "const")
+
+    def __init__(self, name: str, op: str, inputs: List[str],
+                 attrs: Dict[str, Any]):
+        self.name, self.op, self.inputs, self.attrs = name, op, inputs, attrs
+        self.const: Optional[np.ndarray] = None
+
+
+def _parse_shape(buf: bytes) -> Optional[Tuple[int, ...]]:
+    """TensorShapeProto → tuple, or None when unknown_rank/partial."""
+    d = fields_dict(buf)
+    if first(d, 3, 0):          # unknown_rank
+        return None
+    dims = []
+    for dim in repeated(d, 2):
+        size = to_signed64(first(fields_dict(dim), 1, 0) or 0)
+        if size < 0:
+            return None
+        dims.append(size)
+    return tuple(dims)
+
+
+def _parse_tensor(buf: bytes) -> np.ndarray:
+    """TensorProto → numpy (tensor.proto field numbers)."""
+    d = fields_dict(buf)
+    dt = first(d, 1, 0)
+    if dt not in _DTYPES:
+        raise FilterError(f"tensorflow: unsupported TensorProto dtype {dt}")
+    dtype = np.dtype(_DTYPES[dt])
+    shape_buf = first(d, 2)
+    shape = _parse_shape(shape_buf) if shape_buf is not None else ()
+    if shape is None:
+        raise FilterError("tensorflow: TensorProto with unknown shape")
+    content = first(d, 4)
+    if content:
+        arr = np.frombuffer(content, dtype)
+    else:
+        # typed repeated value fields
+        if dt == 1:
+            from ...utils.protowire import packed_or_repeated_fixed32
+            vals = packed_or_repeated_fixed32(d.get(5, []), "<f")
+        elif dt == 3:
+            vals = [to_signed64(v) for v in
+                    packed_or_repeated_varints(d.get(7, []))]
+        elif dt == 9:
+            vals = [to_signed64(v) for v in
+                    packed_or_repeated_varints(d.get(10, []))]
+        elif dt == 10:
+            vals = packed_or_repeated_varints(d.get(11, []))
+        else:
+            vals = []
+        arr = np.array(vals, dtype)
+    n = int(np.prod(shape)) if shape else 1
+    if arr.size == 1 and n > 1:
+        arr = np.full(n, arr[0], dtype)     # splat single value
+    if arr.size != n:
+        raise FilterError(
+            f"tensorflow: TensorProto size {arr.size} != shape {shape}")
+    return arr.reshape(shape)
+
+
+def _parse_attr(buf: bytes) -> Any:
+    """AttrValue → python value (attr_value.proto)."""
+    d = fields_dict(buf)
+    if 2 in d:
+        return first(d, 2)                              # s: bytes
+    if 3 in d:
+        return to_signed64(first(d, 3))                 # i
+    if 4 in d:
+        import struct
+        return struct.unpack("<f", struct.pack("<I", first(d, 4)))[0]  # f
+    if 5 in d:
+        return bool(first(d, 5))                        # b
+    if 6 in d:
+        return int(first(d, 6))                         # type enum
+    if 7 in d:
+        return _parse_shape(first(d, 7))                # shape
+    if 8 in d:
+        return _parse_tensor(first(d, 8))               # tensor
+    if 1 in d:                                          # list(...)
+        ld = fields_dict(first(d, 1))
+        if 3 in ld:
+            return [to_signed64(v)
+                    for v in packed_or_repeated_varints(ld.get(3, []))]
+        if 4 in ld:
+            from ...utils.protowire import packed_or_repeated_fixed32
+            return packed_or_repeated_fixed32(ld.get(4, []), "<f")
+        if 2 in ld:
+            return repeated(ld, 2)
+        if 6 in ld:
+            return packed_or_repeated_varints(ld.get(6, []))
+        return []
+    return None
+
+
+def parse_graphdef(data: bytes) -> Dict[str, _Node]:
+    """GraphDef wire bytes → name → node (graph.proto: node = field 1)."""
+    nodes: Dict[str, _Node] = {}
+    for nd in repeated(fields_dict(data), 1):
+        d = fields_dict(nd)
+        name = (first(d, 1, b"") or b"").decode()
+        op = (first(d, 2, b"") or b"").decode()
+        inputs = [x.decode() for x in repeated(d, 3)]
+        attrs: Dict[str, Any] = {}
+        for entry in repeated(d, 5):       # map<string, AttrValue>
+            ed = fields_dict(entry)
+            key = (first(ed, 1, b"") or b"").decode()
+            val = first(ed, 2)
+            attrs[key] = _parse_attr(val) if val is not None else None
+        node = _Node(name, op, inputs, attrs)
+        if op == "Const":
+            v = attrs.get("value")
+            if not isinstance(v, np.ndarray):
+                raise FilterError(f"tensorflow: Const {name} has no value")
+            node.const = v
+        nodes[name] = node
+    if not nodes:
+        raise FilterError("tensorflow: empty GraphDef")
+    return nodes
+
+
+def _split_ref(ref: str) -> Tuple[str, int]:
+    if ":" in ref:
+        name, _, idx = ref.rpartition(":")
+        return name, int(idx)
+    return ref, 0
+
+
+# -- op lowering -------------------------------------------------------------
+
+class _Ctx:
+    """Per-trace evaluation context handed to op handlers."""
+
+    def __init__(self, graph: "TFGraph", env: Dict[str, Any]):
+        self.graph = graph
+        self.env = env
+
+    def val(self, ref: str):
+        name, idx = _split_ref(ref)
+        return self.env[f"{name}:{idx}"]
+
+    def static(self, ref: str) -> np.ndarray:
+        """Resolve a shape-like operand to a graph constant (through
+        Identity), or fail by name — same policy as the tflite loader."""
+        name, _ = _split_ref(ref)
+        node = self.graph.nodes.get(name)
+        seen = set()
+        while node is not None and node.op in ("Identity", "StopGradient") \
+                and node.name not in seen:
+            seen.add(node.name)
+            nxt, _ = _split_ref(node.inputs[0])
+            node = self.graph.nodes.get(nxt)
+        if node is None or node.const is None:
+            raise FilterError(
+                f"tensorflow: operand {ref} must be a graph constant "
+                "(computed shapes/axes are dynamic — unsupported)")
+        return node.const
+
+
+def _data_inputs(node: _Node) -> List[str]:
+    return [i for i in node.inputs if not i.startswith("^")]
+
+
+def _require_nhwc(node: _Node) -> None:
+    """Lowerings assume NHWC (TF's CPU default); fail NCHW graphs by name
+    instead of producing silently wrong layouts."""
+    df = node.attrs.get("data_format")
+    if df and df != b"NHWC":
+        raise FilterError(
+            f"tensorflow: {node.op} node {node.name} has "
+            f"data_format={df!r}; only NHWC graphs are supported")
+
+
+def _nhwc_conv(x, w, strides, padding, dilations=(1, 1),
+               feature_group_count=1):
+    from jax import lax
+
+    return lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, feature_group_count=feature_group_count,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _pool(x, node, reducer, init):
+    from jax import lax
+
+    _require_nhwc(node)
+    ks = node.attrs.get("ksize") or [1, 1, 1, 1]
+    st = node.attrs.get("strides") or [1, 1, 1, 1]
+    pad = (node.attrs.get("padding") or b"VALID").decode()
+    return lax.reduce_window(x, init, reducer, tuple(int(k) for k in ks),
+                             tuple(int(s) for s in st), pad)
+
+
+def _binop(fn):
+    return lambda node, ins, ctx: fn(ins[0], ins[1])
+
+
+def _unary(fn):
+    return lambda node, ins, ctx: fn(ins[0])
+
+
+def _matmul(node, ins, ctx):
+    import jax.numpy as jnp
+
+    a, b = ins[0], ins[1]
+    if node.attrs.get("transpose_a"):
+        a = a.T
+    if node.attrs.get("transpose_b"):
+        b = b.T
+    return jnp.matmul(a, b)
+
+
+def _conv2d(node, ins, ctx):
+    _require_nhwc(node)
+    st = node.attrs.get("strides") or [1, 1, 1, 1]
+    dl = node.attrs.get("dilations") or [1, 1, 1, 1]
+    pad = (node.attrs.get("padding") or b"VALID").decode()
+    return _nhwc_conv(ins[0], ins[1], (int(st[1]), int(st[2])), pad,
+                      (int(dl[1]), int(dl[2])))
+
+
+def _depthwise(node, ins, ctx):
+    import jax.numpy as jnp
+
+    _require_nhwc(node)
+    st = node.attrs.get("strides") or [1, 1, 1, 1]
+    pad = (node.attrs.get("padding") or b"VALID").decode()
+    w = ins[1]                       # TF layout [H, W, C, M]
+    h, wd, c, m = w.shape
+    w = jnp.reshape(w, (h, wd, 1, c * m))
+    return _nhwc_conv(ins[0], w, (int(st[1]), int(st[2])), pad,
+                      feature_group_count=c)
+
+
+def _bias_add(node, ins, ctx):
+    import jax.numpy as jnp
+
+    _require_nhwc(node)       # NCHW would need the bias on axis 1
+    return jnp.add(ins[0], ins[1])
+
+
+def _fused_bn(node, ins, ctx):
+    import jax.numpy as jnp
+
+    _require_nhwc(node)
+    x, scale, offset, mean, var = ins[:5]
+    eps = float(node.attrs.get("epsilon") or 1e-3)
+    inv = scale * (1.0 / jnp.sqrt(var + eps))
+    return x * inv + (offset - mean * inv)
+
+
+def _reshape(node, ins, ctx):
+    shape = [int(v) for v in
+             np.asarray(ctx.static(_data_inputs(node)[1])).reshape(-1)]
+    return ins[0].reshape(shape)
+
+
+def _mean_like(jnp_fn):
+    def run(node, ins, ctx):
+        axes = tuple(int(v) for v in
+                     np.asarray(ctx.static(_data_inputs(node)[1])).reshape(-1))
+        keep = bool(node.attrs.get("keep_dims") or
+                    node.attrs.get("keepdims"))
+        return jnp_fn(ins[0], axis=axes, keepdims=keep)
+    return run
+
+
+def _concat(node, ins, ctx):
+    import jax.numpy as jnp
+
+    refs = _data_inputs(node)
+    axis = int(np.asarray(ctx.static(refs[-1])).reshape(-1)[0])
+    return jnp.concatenate(ins[:-1], axis=axis)
+
+
+def _concat_v1(node, ins, ctx):
+    """TF1 Concat takes the axis as its FIRST input (ConcatV2: last)."""
+    import jax.numpy as jnp
+
+    refs = _data_inputs(node)
+    axis = int(np.asarray(ctx.static(refs[0])).reshape(-1)[0])
+    return jnp.concatenate(ins[1:], axis=axis)
+
+
+def _pad(node, ins, ctx):
+    import jax.numpy as jnp
+
+    pads = np.asarray(ctx.static(_data_inputs(node)[1]))
+    cval = ins[2] if len(ins) > 2 else 0
+    return jnp.pad(ins[0], [(int(a), int(b)) for a, b in pads],
+                   constant_values=cval)
+
+
+def _softmax(node, ins, ctx):
+    import jax.nn
+
+    return jax.nn.softmax(ins[0], axis=-1)
+
+
+def _argmax(node, ins, ctx):
+    import jax.numpy as jnp
+
+    axis = int(np.asarray(ctx.static(_data_inputs(node)[1])).reshape(-1)[0])
+    out_t = node.attrs.get("output_type") or 9
+    return jnp.argmax(ins[0], axis=axis).astype(_DTYPES.get(out_t, "int64"))
+
+
+def _squeeze(node, ins, ctx):
+    import jax.numpy as jnp
+
+    dims = node.attrs.get("squeeze_dims") or node.attrs.get("axis")
+    axes = tuple(int(d) for d in dims) if dims else None
+    return jnp.squeeze(ins[0], axis=axes)
+
+
+def _expand_dims(node, ins, ctx):
+    import jax.numpy as jnp
+
+    axis = int(np.asarray(ctx.static(_data_inputs(node)[1])).reshape(-1)[0])
+    return jnp.expand_dims(ins[0], axis)
+
+
+def _transpose(node, ins, ctx):
+    perm = [int(v) for v in
+            np.asarray(ctx.static(_data_inputs(node)[1])).reshape(-1)]
+    return ins[0].transpose(perm)
+
+
+def _pack(node, ins, ctx):
+    import jax.numpy as jnp
+
+    return jnp.stack(ins, axis=int(node.attrs.get("axis") or 0))
+
+
+def _shape(node, ins, ctx):
+    import jax.numpy as jnp
+
+    return jnp.array(ins[0].shape, dtype="int32")
+
+
+def _cast(node, ins, ctx):
+    dt = node.attrs.get("DstT") or 1
+    return ins[0].astype(_DTYPES.get(dt, "float32"))
+
+
+def _strided_slice(node, ins, ctx):
+    refs = _data_inputs(node)
+    begin = np.asarray(ctx.static(refs[1])).reshape(-1)
+    end = np.asarray(ctx.static(refs[2])).reshape(-1)
+    strides = np.asarray(ctx.static(refs[3])).reshape(-1)
+    bm = int(node.attrs.get("begin_mask") or 0)
+    em = int(node.attrs.get("end_mask") or 0)
+    sm = int(node.attrs.get("shrink_axis_mask") or 0)
+    if node.attrs.get("new_axis_mask") or node.attrs.get("ellipsis_mask"):
+        raise FilterError(
+            "tensorflow: StridedSlice new_axis/ellipsis masks unsupported")
+    x = ins[0]
+    idx = []
+    for i in range(len(begin)):
+        if sm & (1 << i):
+            idx.append(int(begin[i]))
+            continue
+        b = None if bm & (1 << i) else int(begin[i])
+        e = None if em & (1 << i) else int(end[i])
+        idx.append(slice(b, e, int(strides[i])))
+    return x[tuple(idx)]
+
+
+def _make_ops() -> Dict[str, Callable]:
+    import jax
+    import jax.numpy as jnp
+
+    ident = lambda node, ins, ctx: ins[0]  # noqa: E731
+    return {
+        "Identity": ident, "StopGradient": ident, "PreventGradient": ident,
+        "CheckNumerics": ident, "PlaceholderWithDefault": ident,
+        "Add": _binop(jnp.add), "AddV2": _binop(jnp.add),
+        "BiasAdd": _bias_add,
+        "Sub": _binop(jnp.subtract), "Mul": _binop(jnp.multiply),
+        "RealDiv": _binop(jnp.divide), "Div": _binop(jnp.divide),
+        "Maximum": _binop(jnp.maximum), "Minimum": _binop(jnp.minimum),
+        "SquaredDifference": _binop(lambda a, b: (a - b) ** 2),
+        "Pow": _binop(jnp.power),
+        "MatMul": _matmul, "BatchMatMul": _binop(jnp.matmul),
+        "BatchMatMulV2": _binop(jnp.matmul),
+        "Conv2D": _conv2d, "DepthwiseConv2dNative": _depthwise,
+        "FusedBatchNorm": _fused_bn, "FusedBatchNormV2": _fused_bn,
+        "FusedBatchNormV3": _fused_bn,
+        "MaxPool": lambda node, ins, ctx: _pool(
+            ins[0], node, jax.lax.max, -jnp.inf),
+        "AvgPool": _avgpool,
+        "Relu": _unary(jax.nn.relu),
+        "Relu6": _unary(lambda x: jnp.clip(x, 0, 6)),
+        "LeakyRelu": lambda node, ins, ctx: jax.nn.leaky_relu(
+            ins[0], float(node.attrs.get("alpha") or 0.2)),
+        "Elu": _unary(jax.nn.elu), "Selu": _unary(jax.nn.selu),
+        "Sigmoid": _unary(jax.nn.sigmoid), "Tanh": _unary(jnp.tanh),
+        "Softmax": _softmax,
+        "Rsqrt": _unary(jax.lax.rsqrt), "Sqrt": _unary(jnp.sqrt),
+        "Square": _unary(jnp.square), "Exp": _unary(jnp.exp),
+        "Log": _unary(jnp.log), "Neg": _unary(jnp.negative),
+        "Abs": _unary(jnp.abs), "Floor": _unary(jnp.floor),
+        "Round": _unary(jnp.round),
+        "Reshape": _reshape, "Squeeze": _squeeze,
+        "ExpandDims": _expand_dims, "Transpose": _transpose,
+        "Pack": _pack, "ConcatV2": _concat, "Concat": _concat_v1,
+        "Pad": _pad, "PadV2": _pad,
+        "Mean": _mean_like(jnp.mean), "Sum": _mean_like(jnp.sum),
+        "Max": _mean_like(jnp.max), "Min": _mean_like(jnp.min),
+        "ArgMax": _argmax, "Shape": _shape, "Cast": _cast,
+        "StridedSlice": _strided_slice,
+    }
+
+
+def _avgpool(node, ins, ctx):
+    import jax.numpy as jnp
+
+    summed = _pool(ins[0], node, lambda a, b: a + b, 0.0)
+    ones = jnp.ones_like(ins[0])
+    count = _pool(ones, node, lambda a, b: a + b, 0.0)
+    return summed / count
+
+
+_OPS: Optional[Dict[str, Callable]] = None
+
+
+class TFGraph:
+    """Parsed + lowered frozen graph."""
+
+    def __init__(self, data: bytes):
+        self.nodes = parse_graphdef(data)
+        self.order = list(self.nodes)            # GraphDef is in def order
+
+    def placeholders(self) -> List[_Node]:
+        return [self.nodes[n] for n in self.order
+                if self.nodes[n].op in ("Placeholder",
+                                        "PlaceholderWithDefault")]
+
+    def terminals(self) -> List[_Node]:
+        consumed = set()
+        for n in self.nodes.values():
+            for ref in _data_inputs(n):
+                consumed.add(_split_ref(ref)[0])
+        return [self.nodes[n] for n in self.order
+                if n not in consumed and self.nodes[n].op != "Const"]
+
+    def topo_order(self, output_names: Sequence[str]) -> List[_Node]:
+        """Iterative topological order of the subgraph feeding the outputs
+        (no recursion — frozen graphs can be thousands of nodes deep)."""
+        order: List[_Node] = []
+        state: Dict[str, int] = {}               # 1 = visiting, 2 = done
+        stack = [(n, False) for n in reversed(list(output_names))]
+        while stack:
+            name, processed = stack.pop()
+            if processed:
+                state[name] = 2
+                order.append(self.nodes[name])
+                continue
+            if state.get(name) == 2:
+                continue
+            if state.get(name) == 1:
+                raise FilterError(f"tensorflow: graph cycle at {name}")
+            if name not in self.nodes:
+                raise FilterError(f"tensorflow: missing node {name}")
+            state[name] = 1
+            stack.append((name, True))
+            for ref in _data_inputs(self.nodes[name]):
+                dep = _split_ref(ref)[0]
+                if state.get(dep) != 2:
+                    stack.append((dep, False))
+        return order
+
+    def build(self, input_names: Sequence[str],
+              output_refs: Sequence[str]) -> Callable:
+        """Return fn(consts_dict, *inputs) → [outputs] for jax.jit."""
+        global _OPS
+        if _OPS is None:
+            _OPS = _make_ops()
+        ops = _OPS
+        plan = [n for n in self.topo_order(
+            [_split_ref(r)[0] for r in output_refs])
+            if n.name not in input_names]
+        inputs = list(input_names)
+
+        def fn(consts: Dict[str, Any], *xs):
+            env: Dict[str, Any] = {}
+            for name, x in zip(inputs, xs):
+                env[f"{name}:0"] = x
+            ctx = _Ctx(self, env)
+            for node in plan:
+                if node.op == "Const":
+                    env[f"{node.name}:0"] = consts[node.name]
+                    continue
+                handler = ops.get(node.op)
+                if handler is None:
+                    raise FilterError(
+                        f"tensorflow: unsupported op {node.op} "
+                        f"(node {node.name})")
+                ins = [ctx.val(r) for r in _data_inputs(node)]
+                out = handler(node, ins, ctx)
+                if isinstance(out, (list, tuple)):
+                    for i, o in enumerate(out):
+                        env[f"{node.name}:{i}"] = o
+                else:
+                    env[f"{node.name}:0"] = out
+            return [ctx.val(r) for r in output_refs]
+        return fn
+
+
+@register_filter
+class TensorFlowFilter(FilterFramework):
+    """``framework=tensorflow``: frozen .pb GraphDef compiled to XLA."""
+
+    NAME = "tensorflow"
+    SUPPORTED_ACCELERATORS = (Accelerator.TPU, Accelerator.CPU)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._graph: Optional[TFGraph] = None
+        self._jitted = None
+        self._consts_dev = None
+        self._in_info: Optional[TensorsInfo] = None
+        self._out_info: Optional[TensorsInfo] = None
+        self.stats = FilterStatistics()
+
+    # -- lifecycle -----------------------------------------------------------
+    def open(self, props: FilterProperties) -> None:
+        import jax
+
+        path = str(props.model)
+        if not os.path.isfile(path):
+            raise FilterError(f"tensorflow: model file not found: {path}")
+        with open(path, "rb") as f:
+            graph = TFGraph(f.read())
+
+        custom = props.custom_properties
+        # inputname entries address placeholder NODES: strip a ':idx'
+        # suffix (outputname keeps/normalizes it, since outputs are refs)
+        in_names = [_split_ref(s)[0] for s in
+                    (custom.get("inputname") or "").split(",") if s]
+        out_names = [s for s in
+                     (custom.get("outputname") or "").split(",") if s]
+        if not in_names:
+            in_names = [n.name for n in graph.placeholders()]
+        if not in_names:
+            raise FilterError("tensorflow: no Placeholder inputs found; "
+                              "set custom=inputname:...")
+        if not out_names:
+            out_names = [n.name for n in graph.terminals()]
+        if not out_names:
+            raise FilterError("tensorflow: no terminal outputs found; "
+                              "set custom=outputname:...")
+        out_refs = [r if ":" in r else f"{r}:0" for r in out_names]
+
+        # input meta: declared > placeholder shape attr
+        if props.input_info is not None and props.input_info.is_valid():
+            in_info = props.input_info.copy()
+            if in_info.num_tensors != len(in_names):
+                raise FilterError(
+                    f"tensorflow: {len(in_names)} graph inputs but "
+                    f"input_info has {in_info.num_tensors}")
+        else:
+            infos = []
+            for name in in_names:
+                node = graph.nodes.get(name)
+                if node is None:
+                    raise FilterError(f"tensorflow: no node {name}")
+                shape = node.attrs.get("shape")
+                dt = node.attrs.get("dtype") or 1
+                if not shape or any(s <= 0 for s in shape):
+                    raise FilterError(
+                        f"tensorflow: input {name} has undefined shape "
+                        f"{shape}; declare input_info (reference requires "
+                        "explicit input dims too)")
+                infos.append(TensorInfo.from_np(
+                    np.zeros(shape, _DTYPES.get(dt, "float32")), name=name))
+            in_info = TensorsInfo(infos)
+
+        fn = graph.build(in_names, out_refs)
+        consts = {n.name: n.const for n in graph.nodes.values()
+                  if n.const is not None}
+        device = self._pick_device(props.accelerators)
+        self._consts_dev = jax.device_put(consts, device)
+        self._jitted = jax.jit(fn)
+        self._graph = graph
+
+        zeros = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
+        with jax.default_device(device):
+            outs = self._jitted(self._consts_dev, *zeros)
+        jax.block_until_ready(outs)
+        probed = TensorsInfo([TensorInfo.from_np(np.asarray(o), name=r)
+                              for o, r in zip(outs, out_refs)])
+        if props.output_info is not None and props.output_info.is_valid():
+            if not props.output_info.is_equal(probed):
+                raise FilterError(
+                    f"tensorflow: declared output {props.output_info} != "
+                    f"graph output {probed}")
+            self._out_info = props.output_info.copy()
+        else:
+            self._out_info = probed
+        self._in_info = in_info
+        self._device = device
+        super().open(props)
+
+    @staticmethod
+    def _pick_device(accelerators):
+        import jax
+
+        if accelerators and accelerators[0] is Accelerator.CPU:
+            return jax.devices("cpu")[0]
+        return jax.devices()[0]
+
+    def close(self) -> None:
+        self._graph = None
+        self._jitted = None
+        self._consts_dev = None
+        super().close()
+
+    # -- model meta ----------------------------------------------------------
+    def get_model_info(self) -> Tuple[TensorsInfo, TensorsInfo]:
+        if self._graph is None:
+            raise FilterError("tensorflow: not opened")
+        return self._in_info, self._out_info
+
+    # -- hot path ------------------------------------------------------------
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        import jax
+
+        t0 = time.monotonic_ns()
+        with jax.default_device(self._device):
+            outs = self._jitted(self._consts_dev, *inputs)
+        start_output_transfers(outs)
+        self.stats.record(time.monotonic_ns() - t0)
+        return list(outs)
+
+    @classmethod
+    def handles_model(cls, model: Any) -> bool:
+        return isinstance(model, str) and model.endswith(".pb")

@@ -34,7 +34,8 @@ from ...tensor.info import TensorInfo, TensorsInfo
 from ...tensor.types import TensorType, np_shape_to_dim
 from ...utils import flatbuf as fb
 from ..framework import (Accelerator, FilterError, FilterFramework,
-                         FilterProperties, FilterStatistics, register_filter)
+                         FilterProperties, FilterStatistics, register_filter,
+                         start_output_transfers)
 
 # -- tflite schema constants (schema.fbs v3) --------------------------------
 
@@ -782,6 +783,7 @@ class TFLiteFilter(FilterFramework):
     def invoke(self, inputs: List[Any]) -> List[Any]:
         t0 = time.monotonic_ns()
         outs = list(self._invoke_device(inputs))
+        start_output_transfers(outs)
         for i, cast in enumerate(self._out_casts):
             if cast is not None:
                 outs[i] = np.asarray(outs[i]).astype(cast)

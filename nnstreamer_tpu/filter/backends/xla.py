@@ -28,7 +28,8 @@ import numpy as np
 
 from ...tensor.info import TensorsInfo
 from ..framework import (Accelerator, FilterError, FilterFramework,
-                         FilterProperties, FilterStatistics, register_filter)
+                         FilterProperties, FilterStatistics, register_filter,
+                         start_output_transfers)
 
 
 _cache_enabled = False
@@ -142,17 +143,7 @@ class XLAFilter(FilterFramework):
     def invoke(self, inputs: List[Any]) -> List[Any]:
         t0 = time.monotonic_ns()
         outs = self._invoke_device(inputs)
-        # Start the device→host copy of every output now, without blocking:
-        # downstream (decoder/sink) materializes with np.asarray later, by
-        # which time the bytes are already on the host.  On tunneled devices
-        # the per-transfer RTT dwarfs MobileNet exec time, so overlapping
-        # transfers with subsequent dispatches is what keeps frames pipelined
-        # (the TPU analogue of the reference's zero-copy output discipline).
-        for o in outs:
-            try:
-                o.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                break
+        start_output_transfers(outs)
         self.stats.record(time.monotonic_ns() - t0)
         return list(outs)
 

@@ -327,6 +327,23 @@ def close_backend(fw: Optional[FilterFramework],
         fw.close()
 
 
+def start_output_transfers(outs) -> None:
+    """Begin device→host copies of invoke outputs without blocking.
+
+    Downstream (decoder/sink) materializes with np.asarray later, by which
+    time the bytes are already on the host.  On tunneled devices the
+    per-transfer RTT dwarfs small-model exec time, so overlapping transfers
+    with subsequent dispatches is what keeps frames pipelined — the TPU
+    analogue of the reference's zero-copy output discipline
+    (tensor_filter.c:631-894).  No-op for host (numpy) outputs.
+    """
+    for o in outs:
+        try:
+            o.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            break
+
+
 # ---------------------------------------------------------------------------
 # statistics (reference: GstTensorFilterStatistics tensor_filter_common.h:80-91)
 # ---------------------------------------------------------------------------

@@ -9,7 +9,7 @@ framework's 128-byte meta header (nnstreamer_tpu.tensor.meta), so both
 static and flexible streams ride the same format.
 
 Message layout (little endian):
-  u32 magic 'NNSQ' | u8 type | u64 client_id | u64 seq | i64 pts
+  u32 magic 'NNSR' | u8 type | u64 client_id | u64 seq | i64 pts
   | i64 epoch_us | u32 payload_len | payload
 ``epoch_us`` is the sender's stream-origin wall clock (NTP-aligned unix
 epoch µs, 0 = unknown) — the role of the reference mqtt header's

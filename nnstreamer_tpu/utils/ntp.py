@@ -141,6 +141,10 @@ def stream_origin_epoch_us(ntp_host, element_name: str = "edge") -> int:
     if not ntp_host:
         return time.time_ns() // 1000
     hosts = [h.strip() for h in str(ntp_host).split(",") if h.strip()]
+    if not hosts:
+        # degenerate value like "," — local clock, NOT the default public
+        # pool (get_epoch_us would substitute DEFAULT_HOSTS for [])
+        return time.time_ns() // 1000
     sync = WallClockSync(hosts=hosts)
     epoch = sync.now_us()
     if not sync.synced:

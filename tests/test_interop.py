@@ -301,3 +301,213 @@ class TestPyTorchBackend:
         path = os.path.join(REF_MODELS,
                             "sample_3x4_two_input_two_output.pt")
         assert detect_framework(path) == "pytorch"
+
+
+# -- TensorFlow GraphDef backend ---------------------------------------------
+
+def _pb_varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _pb_tag(field, wire):
+    return _pb_varint((field << 3) | wire)
+
+
+def _pb_len(field, payload):
+    return _pb_tag(field, 2) + _pb_varint(len(payload)) + payload
+
+
+def _pb_shape(dims):
+    body = b""
+    for d in dims:
+        body += _pb_len(2, _pb_tag(1, 0) + _pb_varint(d))
+    return body
+
+
+_PB_DT = {"float32": 1, "int32": 3, "int64": 9, "bool": 10}
+
+
+def _pb_tensor(arr):
+    arr = np.ascontiguousarray(arr)
+    body = _pb_tag(1, 0) + _pb_varint(_PB_DT[arr.dtype.name])
+    body += _pb_len(2, _pb_shape(arr.shape))
+    body += _pb_len(4, arr.tobytes())
+    return body
+
+
+def _pb_attr(kind, value):
+    import struct
+    if kind == "type":
+        return _pb_tag(6, 0) + _pb_varint(value)
+    if kind == "shape":
+        return _pb_len(7, _pb_shape(value))
+    if kind == "tensor":
+        return _pb_len(8, _pb_tensor(value))
+    if kind == "s":
+        return _pb_len(2, value)
+    if kind == "i":
+        return _pb_tag(3, 0) + _pb_varint(value)
+    if kind == "b":
+        return _pb_tag(5, 0) + _pb_varint(1 if value else 0)
+    if kind == "f":
+        return _pb_tag(4, 5) + struct.pack("<f", value)
+    if kind == "ilist":
+        body = b"".join(_pb_tag(3, 0) + _pb_varint(v) for v in value)
+        return _pb_len(1, body)
+    raise AssertionError(kind)
+
+
+def _pb_node(name, op, inputs=(), **attrs):
+    body = _pb_len(1, name.encode()) + _pb_len(2, op.encode())
+    for i in inputs:
+        body += _pb_len(3, i.encode())
+    for key, (kind, value) in attrs.items():
+        entry = _pb_len(1, key.encode()) + _pb_len(2, _pb_attr(kind, value))
+        body += _pb_len(5, entry)
+    return body
+
+
+def _pb_graph(*nodes):
+    return b"".join(_pb_len(1, n) for n in nodes)
+
+
+class TestTensorFlowBackend:
+    """GraphDef loader vs torch oracle + reference model-zoo interop
+    (reference suite: tests/nnstreamer_filter_tensorflow/runTest.sh)."""
+
+    def _open_graph(self, blob, tmp_path, input_info=None, custom=None):
+        path = os.path.join(str(tmp_path), "g.pb")
+        with open(path, "wb") as f:
+            f.write(blob)
+        return open_backend(FilterProperties(
+            framework="tensorflow", model=path, input_info=input_info,
+            custom_properties=custom or {}))
+
+    def test_conv_relu_pool_dense_matches_torch(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(7)
+        w = rng.normal(size=(3, 3, 2, 4)).astype(np.float32)   # HWIO
+        b = rng.normal(size=(4,)).astype(np.float32)
+        dense = rng.normal(size=(4 * 4 * 4, 5)).astype(np.float32)
+        blob = _pb_graph(
+            _pb_node("x", "Placeholder", dtype=("type", 1),
+                     shape=("shape", (1, 8, 8, 2))),
+            _pb_node("w", "Const", value=("tensor", w), dtype=("type", 1)),
+            _pb_node("b", "Const", value=("tensor", b), dtype=("type", 1)),
+            _pb_node("wd", "Const", value=("tensor", dense),
+                     dtype=("type", 1)),
+            _pb_node("rs", "Const", value=("tensor",
+                                           np.array([1, 64], np.int32)),
+                     dtype=("type", 3)),
+            _pb_node("conv", "Conv2D", ["x", "w"],
+                     strides=("ilist", [1, 1, 1, 1]), padding=("s", b"SAME")),
+            _pb_node("bias", "BiasAdd", ["conv", "b"]),
+            _pb_node("relu", "Relu", ["bias"]),
+            _pb_node("pool", "MaxPool", ["relu"],
+                     ksize=("ilist", [1, 2, 2, 1]),
+                     strides=("ilist", [1, 2, 2, 1]),
+                     padding=("s", b"VALID")),
+            _pb_node("flat", "Reshape", ["pool", "rs"]),
+            _pb_node("fc", "MatMul", ["flat", "wd"]),
+            _pb_node("prob", "Softmax", ["fc"]),
+        )
+        fw = self._open_graph(blob, tmp_path)
+        try:
+            x = rng.normal(size=(1, 8, 8, 2)).astype(np.float32)
+            got = np.asarray(fw.invoke([x])[0])
+        finally:
+            fw.close()
+        tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+        tw = torch.from_numpy(w.transpose(3, 2, 0, 1))
+        y = torch.nn.functional.conv2d(tx, tw, torch.from_numpy(b),
+                                       padding="same").relu()
+        y = torch.nn.functional.max_pool2d(y, 2)
+        # TF flatten order is NHWC
+        y = y.permute(0, 2, 3, 1).reshape(1, 64)
+        y = torch.softmax(y @ torch.from_numpy(dense), dim=-1)
+        np.testing.assert_allclose(got, y.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_depthwise_batchnorm_mean(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(8)
+        w = rng.normal(size=(3, 3, 3, 1)).astype(np.float32)   # HWCM
+        scale = rng.normal(size=(3,)).astype(np.float32)
+        offset = rng.normal(size=(3,)).astype(np.float32)
+        mean = rng.normal(size=(3,)).astype(np.float32)
+        var = rng.random(3).astype(np.float32) + 0.5
+        blob = _pb_graph(
+            _pb_node("x", "Placeholder", dtype=("type", 1),
+                     shape=("shape", (1, 6, 6, 3))),
+            _pb_node("w", "Const", value=("tensor", w), dtype=("type", 1)),
+            _pb_node("sc", "Const", value=("tensor", scale),
+                     dtype=("type", 1)),
+            _pb_node("of", "Const", value=("tensor", offset),
+                     dtype=("type", 1)),
+            _pb_node("mu", "Const", value=("tensor", mean),
+                     dtype=("type", 1)),
+            _pb_node("va", "Const", value=("tensor", var),
+                     dtype=("type", 1)),
+            _pb_node("ax", "Const", value=("tensor",
+                                           np.array([1, 2], np.int32)),
+                     dtype=("type", 3)),
+            _pb_node("dw", "DepthwiseConv2dNative", ["x", "w"],
+                     strides=("ilist", [1, 1, 1, 1]),
+                     padding=("s", b"SAME")),
+            _pb_node("bn", "FusedBatchNormV3", ["dw", "sc", "of", "mu", "va"],
+                     epsilon=("f", 1e-3)),
+            _pb_node("gap", "Mean", ["bn", "ax"], keep_dims=("b", False)),
+        )
+        fw = self._open_graph(blob, tmp_path)
+        try:
+            x = rng.normal(size=(1, 6, 6, 3)).astype(np.float32)
+            got = np.asarray(fw.invoke([x])[0])
+        finally:
+            fw.close()
+        tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+        tw = torch.from_numpy(w.transpose(2, 3, 0, 1))  # C,M,H,W
+        y = torch.nn.functional.conv2d(tx, tw, padding="same", groups=3)
+        y = torch.nn.functional.batch_norm(
+            y, torch.from_numpy(mean), torch.from_numpy(var),
+            torch.from_numpy(scale), torch.from_numpy(offset), eps=1e-3)
+        y = y.mean(dim=(2, 3))
+        np.testing.assert_allclose(got, y.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_dynamic_shape_rejected(self, tmp_path):
+        blob = _pb_graph(
+            _pb_node("x", "Placeholder", dtype=("type", 1),
+                     shape=("shape", (1, 4))),
+            _pb_node("sh", "Shape", ["x"]),
+            _pb_node("y", "Reshape", ["x", "sh"]),
+        )
+        with pytest.raises(FilterError, match="constant"):
+            self._open_graph(blob, tmp_path)
+
+    @needs_ref
+    def test_mnist_pb(self):
+        from nnstreamer_tpu.tensor.info import TensorInfo
+
+        ii = TensorsInfo([TensorInfo.from_np(np.zeros((1, 784),
+                                                      np.float32))])
+        fw = open_backend(FilterProperties(
+            framework="tensorflow",
+            model=os.path.join(REF_MODELS, "mnist.pb"), input_info=ii))
+        try:
+            _, oi = fw.get_model_info()
+            assert oi[0].np_shape == (1, 10)
+            out = np.asarray(fw.invoke(
+                [np.random.default_rng(0).random((1, 784),
+                                                 np.float32)])[0])
+            assert abs(out.sum() - 1.0) < 1e-4     # softmax
+        finally:
+            fw.close()
+
+    @needs_ref
+    def test_auto_detect_pb(self):
+        assert detect_framework(
+            os.path.join(REF_MODELS, "mnist.pb")) == "tensorflow"

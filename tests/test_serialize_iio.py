@@ -90,6 +90,20 @@ class TestFlatbufRoundTrip:
             np.testing.assert_array_equal(got, want)
             assert got.dtype == want.dtype
 
+    def test_round_trip_keeps_leading_unit_dims(self):
+        """(1,2,3,4) — the common batch-1 NHWC case — must survive our own
+        encode/decode exactly (rank extension field), even though the wire
+        dims are 1-padded to the rank limit for reference readers."""
+        from nnstreamer_tpu.utils.tensor_flatbuf import (decode_tensors,
+                                                         encode_tensors)
+
+        for shape in ((1, 2, 3, 4), (1, 1, 5), (2, 1), (1,)):
+            arr = np.arange(int(np.prod(shape)),
+                            dtype=np.float32).reshape(shape)
+            back, _, _ = decode_tensors(encode_tensors([arr]))
+            assert back[0].shape == shape, (shape, back[0].shape)
+            np.testing.assert_array_equal(back[0], arr)
+
     def test_decode_strips_reference_rank_padding(self):
         """Reference flatbuf writers serialize all 8 (legacy 4) dim slots,
         1-padded when the info came from a parsed dim string
