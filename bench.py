@@ -1,18 +1,24 @@
 #!/usr/bin/env python
-"""Flagship benchmark: MobileNetV2 224×224 image-labeling pipeline.
+"""Benchmarks for the BASELINE.md configs on the default JAX device.
 
-Reproduces BASELINE.md config 1 (the reference's gst-launch MobileNetV2
-image-labeling pipeline, north star ≥30 fps end-to-end on TPU v5e-1):
-videotestsrc → tensor_converter → tensor_filter(xla, MobileNetV2 bf16)
-→ tensor_decoder(image_labeling) → tensor_sink, measured end-to-end on the
-default JAX device (TPU when present).
-
-Prints ONE JSON line:
+Default (driver contract): the flagship MobileNetV2 224×224 image-labeling
+pipeline (BASELINE config 1, north star ≥30 fps on TPU v5e-1) — prints ONE
+JSON line:
   {"metric": ..., "value": fps, "unit": "fps", "vs_baseline": fps/30}
+
+All five BASELINE.json configs are available:
+  python bench.py                      # flagship (config 1)
+  python bench.py --config ssd         # SSD-MobileNetV2 + bounding_boxes
+  python bench.py --config deeplab     # DeepLabV3 + image_segment
+  python bench.py --config posenet     # PoseNet + pose_estimation
+  python bench.py --config edge        # distributed edge_sink → edge_src
+  python bench.py --all                # every config, one JSON line each
 """
 
+import argparse
 import json
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
@@ -23,64 +29,167 @@ N_FRAMES = 150
 BASELINE_FPS = 30.0  # north-star target (BASELINE.json)
 
 
+def _measure(pipeline, sink_name: str, timeout: float = 1200,
+             feeders=()):
+    """Run a pipeline (plus optional feeder pipelines), return
+    steady-state fps from sink timestamps."""
+    stamps = []
+    pipeline.get(sink_name).connect(
+        "new-data", lambda buf: stamps.append(time.monotonic()))
+    pipeline.play()
+    for f in feeders:
+        f.play()
+    for f in feeders:
+        f.wait(timeout=timeout)
+    pipeline.wait(timeout=timeout)
+    n = len(stamps)
+    if n < 2:
+        raise SystemExit("benchmark produced no frames")
+    skip = min(10, n // 5)           # skip pipeline ramp
+    span = stamps[-1] - stamps[skip]
+    return ((n - 1 - skip) / span if span > 0 else 0.0), n
+
+
+def _model_pipeline(model: str, size: int, decoder: str, dtype_prop: str,
+                    decoder_opts: str = "") -> str:
+    from nnstreamer_tpu import parse_launch
+
+    return parse_launch(
+        f"videotestsrc num-buffers={N_FRAMES} pattern=random ! "
+        f"video/x-raw,format=RGB,width={size},height={size},"
+        "framerate=120/1 ! "
+        "tensor_converter ! "
+        f"tensor_filter framework=xla model={model}"
+        f" custom=seed:0{dtype_prop} name=f ! "
+        # queue = thread boundary: the decoder's host fetch of frame N
+        # overlaps the dispatch + async d2h copy of frames N+1..N+8, so
+        # device-transfer RTT is paid once, not per frame
+        "queue max-size-buffers=8 ! "
+        f"tensor_decoder mode={decoder} {decoder_opts} ! "
+        "tensor_sink name=out")
+
+
+def _invoke_p50(fw, size: int) -> float:
+    import jax
+
+    frame = np.random.default_rng(0).integers(
+        0, 255, (size, size, 3), dtype=np.uint8)
+    lats = []
+    for _ in range(30):
+        t0 = time.monotonic()
+        jax.block_until_ready(fw.invoke([frame]))
+        lats.append((time.monotonic() - t0) * 1000)
+    lats.sort()
+    return lats[len(lats) // 2]
+
+
+def bench_model(name: str, model: str, size: int, decoder: str,
+                dtype_prop: str, decoder_opts: str = "") -> dict:
+    p = _model_pipeline(model, size, decoder, dtype_prop, decoder_opts)
+    try:
+        fps, n = _measure(p, "out")
+        p50 = _invoke_p50(p.get("f").fw, size)
+    finally:
+        p.stop()
+    return {"metric": name, "value": round(fps, 2), "unit": "fps",
+            "vs_baseline": round(fps / BASELINE_FPS, 3),
+            "p50_invoke_ms": round(p50, 3), "frames": n}
+
+
+def bench_edge(dtype_prop: str) -> dict:
+    """BASELINE config 5: distributed pipeline over the edge transport
+    (sender and receiver as two pipelines through the TCP broker — the
+    localhost twin of the reference's 2-host query/edge tests)."""
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.query.edge import get_broker
+
+    broker = get_broker()
+    try:
+        recv = parse_launch(
+            f"edge_src port={broker.port} topic=bench "
+            f"num-buffers={N_FRAMES} ! "
+            "tensor_filter framework=xla model=mobilenet_v2"
+            f" custom=seed:0{dtype_prop} name=f ! "
+            "queue max-size-buffers=8 ! "
+            "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+        send = parse_launch(
+            f"videotestsrc num-buffers={N_FRAMES} pattern=random ! "
+            "video/x-raw,format=RGB,width=224,height=224,framerate=120/1 ! "
+            "tensor_converter ! "
+            f"edge_sink port={broker.port} topic=bench")
+        try:
+            fps, n = _measure(recv, "out", feeders=(send,))
+        finally:
+            send.stop()
+            recv.stop()
+    finally:
+        broker.close()
+    return {"metric": "mobilenet_v2_edge_distributed_e2e_fps",
+            "value": round(fps, 2), "unit": "fps",
+            "vs_baseline": round(fps / BASELINE_FPS, 3), "frames": n}
+
+
+def _ssd_priors_file(n_anchors: int) -> str:
+    """Synthetic box priors (cy cx h w rows × n_anchors) for the
+    mobilenet-ssd decode scheme."""
+    rng = np.random.default_rng(0)
+    cy = rng.random(n_anchors)
+    cx = rng.random(n_anchors)
+    hw = np.full(n_anchors, 0.2)
+    f = tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False)
+    for row in (cy, cx, hw, hw):
+        f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+    f.close()
+    return f.name
+
+
 def main() -> None:
     import jax
 
-    from nnstreamer_tpu import parse_launch
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="mobilenet",
+                    choices=("mobilenet", "ssd", "deeplab", "posenet",
+                             "edge"))
+    ap.add_argument("--all", action="store_true")
+    args = ap.parse_args()
 
     device = jax.devices()[0]
     on_tpu = device.platform != "cpu"
     dtype_prop = "" if on_tpu else ",dtype:float32"
 
-    p = parse_launch(
-        f"videotestsrc num-buffers={N_FRAMES} pattern=random ! "
-        "video/x-raw,format=RGB,width=224,height=224,framerate=120/1 ! "
-        "tensor_converter ! "
-        "tensor_filter framework=xla model=mobilenet_v2"
-        f" custom=seed:0{dtype_prop} name=f ! "
-        # queue = thread boundary: the decoder's host fetch of frame N
-        # overlaps the dispatch + async d2h copy of frames N+1..N+8, so the
-        # tunnel RTT is paid once, not per frame
-        "queue max-size-buffers=8 ! "
-        "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+    def run(config: str) -> dict:
+        if config == "mobilenet":
+            return bench_model("mobilenet_v2_224_image_labeling_e2e_fps",
+                               "mobilenet_v2", 224, "image_labeling",
+                               dtype_prop)
+        if config == "ssd":
+            from nnstreamer_tpu.models.registry import get_model
 
-    stamps = []
-    p.get("out").connect("new-data", lambda buf: stamps.append(
-        time.monotonic()))
-    try:
-        p.play()
-        p.wait(timeout=1200)
-        n = len(stamps)
-        if n < 2:
-            raise SystemExit("benchmark produced no frames")
-        # skip the first frames (pipeline ramp) for steady-state fps
-        skip = min(10, n // 5)
-        span = stamps[-1] - stamps[skip]
-        fps = (n - 1 - skip) / span if span > 0 else 0.0
+            n_anchors = get_model(
+                "ssd_mobilenet_v2", {"seed": "0"}).out_info[0].np_shape[0]
+            priors = _ssd_priors_file(n_anchors)
+            return bench_model(
+                "ssd_mobilenet_v2_300_bounding_boxes_e2e_fps",
+                "ssd_mobilenet_v2", 300, "bounding_boxes", dtype_prop,
+                f"option1=mobilenet-ssd option3={priors} "
+                "option4=300:300 option5=300:300")
+        if config == "deeplab":
+            return bench_model("deeplab_v3_257_image_segment_e2e_fps",
+                               "deeplab_v3", 257, "image_segment",
+                               dtype_prop)
+        if config == "posenet":
+            return bench_model(
+                "posenet_257_pose_estimation_e2e_fps", "posenet", 257,
+                "pose_estimation", dtype_prop,
+                "option1=257:257 option2=257:257")
+        return bench_edge(dtype_prop)
 
-        # p50 sync-invoke latency on the still-open backend
-        fw = p.get("f").fw
-        frame = np.random.default_rng(0).integers(
-            0, 255, (224, 224, 3), dtype=np.uint8)
-        lats = []
-        for _ in range(30):
-            t0 = time.monotonic()
-            jax.block_until_ready(fw.invoke([frame]))
-            lats.append((time.monotonic() - t0) * 1000)
-        lats.sort()
-        p50_ms = lats[len(lats) // 2]
-    finally:
-        p.stop()
-
-    print(json.dumps({
-        "metric": "mobilenet_v2_224_image_labeling_e2e_fps",
-        "value": round(fps, 2),
-        "unit": "fps",
-        "vs_baseline": round(fps / BASELINE_FPS, 3),
-        "p50_invoke_ms": round(p50_ms, 3),
-        "device": str(device),
-        "frames": n,
-    }))
+    configs = (("mobilenet", "ssd", "deeplab", "posenet", "edge")
+               if args.all else (args.config,))
+    for config in configs:
+        result = run(config)
+        result["device"] = str(device)
+        print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -30,6 +30,16 @@ class Decoder:
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
         raise NotImplementedError
 
+    def device_reduce_spec(self, config: TensorsConfig):
+        """Optional reduction pushdown (net-new, TPU-native — no reference
+        counterpart): return ``(fn, reduced_info)`` where ``fn(outputs)``
+        is a pure jax function shrinking the upstream filter's outputs on
+        device, and ``reduced_info`` is the resulting TensorsInfo, or None.
+        ``decode`` must accept BOTH the raw and the reduced form (detected
+        by shape/count), because buffers in flight when the pushdown lands
+        still carry the raw layout."""
+        return None
+
 
 _DECODERS: Dict[str, Type[Decoder]] = {}
 

@@ -44,9 +44,31 @@ class ImageLabelDecoder(Decoder):
             "format": "utf8",
             "framerate": config.rate or Fraction(0, 1)})])
 
+    def device_reduce_spec(self, config: TensorsConfig):
+        """Pushdown: argmax on device, fetch ONE int32 instead of the whole
+        score vector (1001 floats for MobileNet)."""
+        if config.info.num_tensors != 1:
+            return None
+        info = config.info[0]
+        if int(np.prod(info.np_shape)) <= 1:    # already reduced
+            return None
+        import jax.numpy as jnp
+
+        from ..tensor.info import TensorInfo, TensorsInfo
+        from ..tensor.types import TensorType
+
+        def fn(outs):
+            return [jnp.argmax(outs[0].reshape(-1)).astype(
+                jnp.int32).reshape(1)]
+
+        return fn, TensorsInfo([TensorInfo(TensorType.INT32, (1,))])
+
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
         scores = buf.np(0)
-        idx = int(np.argmax(scores))
+        if scores.size == 1 and scores.dtype == np.int32:
+            idx = int(scores.reshape(-1)[0])    # reduced on device
+        else:
+            idx = int(np.argmax(scores))
         label = (self.labels[idx] if self.labels and idx < len(self.labels)
                  else str(idx))
         out = buf.with_tensors(

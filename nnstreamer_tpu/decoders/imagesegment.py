@@ -24,6 +24,21 @@ _COLORS = np.array(
         for i in range(1, 64)],
     dtype=np.uint8)
 
+_ARGMAX_JIT = None
+
+
+def _device_argmax():
+    """Jitted class-axis argmax, compiled once per shape (jax caches by
+    input signature)."""
+    global _ARGMAX_JIT
+    if _ARGMAX_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        _ARGMAX_JIT = jax.jit(
+            lambda x: jnp.argmax(x, axis=-1).astype(jnp.int32))
+    return _ARGMAX_JIT
+
 
 @register_decoder
 class ImageSegmentDecoder(Decoder):
@@ -37,21 +52,49 @@ class ImageSegmentDecoder(Decoder):
             self.scheme = value
 
     def get_out_caps(self, config: TensorsConfig) -> Caps:
-        dims = config.info[0].dims
-        if self.scheme == "argmax":
-            w, h = (tuple(dims) + (1, 1))[:2]
+        dims = tuple(config.info[0].dims)
+        if self.scheme == "argmax" or len(dims) == 2:
+            # pre-argmaxed map — native scheme or device-reduced pushdown
+            w, h = (dims + (1, 1))[:2]
         else:
-            _, w, h = (tuple(dims) + (1, 1, 1))[:3]
+            _, w, h = (dims + (1, 1, 1))[:3]
         return Caps([Structure("video/x-raw", {
             "format": "RGBA", "width": w, "height": h,
             "framerate": config.rate or Fraction(0, 1)})])
 
+    def device_reduce_spec(self, config: TensorsConfig):
+        """Pushdown: class-axis argmax on device — DeepLab-257 fetches a
+        260 KB int map instead of the 5.5 MB float score volume."""
+        if self.scheme == "argmax" or config.info.num_tensors != 1:
+            return None
+        shape = config.info[0].np_shape
+        if len(shape) != 3:                     # already reduced
+            return None
+        import jax.numpy as jnp
+
+        from ..tensor.info import TensorInfo, TensorsInfo
+        from ..tensor.types import TensorType, np_shape_to_dim
+
+        def fn(outs):
+            return [jnp.argmax(outs[0], axis=-1).astype(jnp.int32)]
+
+        reduced = TensorsInfo([TensorInfo(TensorType.INT32,
+                                          np_shape_to_dim(shape[:2]))])
+        return fn, reduced
+
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
-        arr = buf.np(0)
-        if self.scheme == "argmax":
-            classes = arr.astype(np.int32)
+        raw = buf.tensors[0]
+        if self.scheme == "argmax" or len(raw.shape) == 2:
+            # native pre-argmaxed scheme, or the device-reduced pushdown
+            # form (filter already argmaxed on device)
+            classes = buf.np(0).astype(np.int32)
+        elif not isinstance(raw, np.ndarray):
+            # device buffer without pushdown (e.g. no upstream filter
+            # handled the event): jitted device argmax, one program —
+            # avoids fetching the full score volume
+            classes = np.asarray(_device_argmax()(raw))
         else:
-            classes = arr.argmax(axis=-1).astype(np.int32)  # (H, W)
+            classes = buf.np(0).argmax(axis=-1).astype(np.int32)  # (H, W)
         rgba = _COLORS[classes % len(_COLORS)]
         out = buf.with_tensors([rgba])
         out.extra["class_map"] = classes

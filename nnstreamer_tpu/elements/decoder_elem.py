@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from ..decoders import find_decoder
 from ..pipeline.caps import Caps
-from ..pipeline.element import Element, FlowReturn
+from ..pipeline.element import CustomEvent, Element, FlowReturn
 from ..pipeline.registry import register_element
 from ..tensor.caps_util import config_from_caps, tensors_template_caps
 
@@ -56,6 +56,17 @@ class TensorDecoder(Element):
     def set_caps(self, pad, caps):
         self._config = config_from_caps(caps)
         if self._decoder is not None:
+            spec = self._decoder.device_reduce_spec(self._config)
+            if spec is not None:
+                fn, reduced = spec
+                ev = CustomEvent("nns/device-reduce",
+                                 {"fn": fn, "out_info": reduced})
+                if pad.push_upstream_event(ev):
+                    # the filter re-announced reduced caps; that nested
+                    # set_caps cascade (where device_reduce_spec returns
+                    # None on the already-reduced config) completed the
+                    # negotiation — nothing more to announce here
+                    return
             self.announce_src_caps(self._decoder.get_out_caps(self._config))
         else:
             from ..pipeline.caps import Structure

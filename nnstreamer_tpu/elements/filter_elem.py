@@ -145,6 +145,31 @@ class TensorFilter(Element):
         return self.push(buf.with_tensors(out_tensors))
 
     # -- events --------------------------------------------------------------
+    def on_upstream_event(self, pad, event):
+        if isinstance(event, CustomEvent) and \
+                event.name == "nns/device-reduce":
+            # Reduction pushdown from a downstream decoder: fuse its pure
+            # device reduction into the backend executable and re-announce
+            # the (smaller) output caps.  The new caps travel in-band, so
+            # buffers already in flight keep the old shape and decoders
+            # dispatch on actual tensor shapes.
+            fn = event.data["fn"]
+            out_info = event.data["out_info"]
+            if self._out_comb is not None:
+                # output-combination re-indexes/mixes the model outputs
+                # AFTER invoke; a reduction computed against the combined
+                # view cannot be fused onto the raw outputs
+                return False
+            if not self.fw.set_postprocess(fn):
+                return False
+            self._out_config = TensorsConfig(info=out_info,
+                                             rate=self._in_config.rate)
+            from ..tensor.caps_util import caps_from_config
+
+            self.announce_src_caps(caps_from_config(self._out_config))
+            return True
+        return super().on_upstream_event(pad, event)
+
     def on_event(self, pad, event):
         if isinstance(event, CustomEvent) and \
                 event.name == "tensor_filter_update_model":

@@ -147,6 +147,20 @@ class XLAFilter(FilterFramework):
         self.stats.record(time.monotonic_ns() - t0)
         return list(outs)
 
+    def set_postprocess(self, fn) -> bool:
+        """Compose a decoder-pushed reduction into the jitted forward: one
+        fused executable, so the reduced (small) outputs are what get the
+        async d2h copies — the big intermediate never crosses the wire."""
+        import jax
+
+        model_fwd = self._model.forward
+
+        def fused(params, *xs):
+            return tuple(fn(list(model_fwd(params, *xs))))
+
+        self._jitted = jax.jit(fused)
+        return True
+
     # -- events --------------------------------------------------------------
     def handle_event(self, name: str, data: Optional[Dict[str, Any]] = None) -> None:
         if name == "reload_model":

@@ -166,6 +166,15 @@ class FilterFramework:
     def invoke(self, inputs: List[Any]) -> List[Any]:
         raise NotImplementedError
 
+    def set_postprocess(self, fn) -> bool:
+        """Fuse a pure reduction ``fn(outputs) -> outputs`` into the
+        backend's executable (reduction pushdown: a downstream decoder asks
+        the filter to shrink outputs ON DEVICE before the host fetch —
+        net-new TPU-native optimization, no reference counterpart; the
+        stream analogue of XLA fusing a consumer into a producer).
+        Return False when the backend cannot compose device functions."""
+        return False
+
     # -- events --------------------------------------------------------------
     def handle_event(self, name: str, data: Optional[Dict[str, Any]] = None) -> None:
         """RELOAD_MODEL / CUSTOM_PROP / SET_ACCELERATOR (reference

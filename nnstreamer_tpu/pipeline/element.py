@@ -130,6 +130,15 @@ class Pad:
         if self.peer is not None:
             self.peer.element._event_entry(self.peer, event)
 
+    # -- upstream events (called on sink pads) -------------------------------
+    def push_upstream_event(self, event: Event) -> bool:
+        """Send an event upstream from a sink pad (the GStreamer
+        upstream-event role: QoS, reconfigure).  Delivered synchronously;
+        returns True when some upstream element handled it."""
+        if self.direction is not PadDirection.SINK or self.peer is None:
+            return False
+        return self.peer.element._upstream_event_entry(self.peer, event)
+
     def peer_allowed_caps(self) -> Caps:
         """Downstream CAPS query (GStreamer gst_pad_peer_query_caps role):
         what would the peer accept?  Passthrough elements forward the query
@@ -264,6 +273,36 @@ class Element:
         """Default: forward events (incl. EOS) to all src pads."""
         for sp in self.src_pads:
             sp.push_event(event)
+
+    def _upstream_event_entry(self, src_pad: Pad, event: Event) -> bool:
+        try:
+            return bool(self.on_upstream_event(src_pad, event))
+        except Exception as exc:  # noqa: BLE001
+            if self.pipeline is not None:
+                self.pipeline.post_error(self, exc)
+                return False
+            raise
+
+    #: May data-affecting upstream events (nns/device-reduce) pass through
+    #: this element?  Only true for elements that forward buffers
+    #: untouched to a SINGLE consumer (queue).  A tee/demux must refuse:
+    #: fusing one branch's reduction into the producer would corrupt every
+    #: other branch.
+    UPSTREAM_TRANSPARENT = False
+
+    def on_upstream_event(self, pad: Pad, event: Event) -> bool:
+        """Handle an event travelling upstream (arrives on a SRC pad).
+        Default: propagate further upstream through every sink pad until
+        someone handles it; events that change the data contract only
+        cross elements declaring UPSTREAM_TRANSPARENT."""
+        if isinstance(event, CustomEvent) \
+                and event.name == "nns/device-reduce" \
+                and not self.UPSTREAM_TRANSPARENT:
+            return False
+        for sp in self.sink_pads:
+            if sp.push_upstream_event(event):
+                return True
+        return False
 
     def get_allowed_caps(self, sink_pad: Pad) -> Caps:
         """Answer a downstream caps query on ``sink_pad``.  Default: the pad

@@ -207,6 +207,7 @@ class Queue(Element):
 
     FACTORY = "queue"
     PROPERTIES = {"max-size-buffers": (16, "queue capacity")}
+    UPSTREAM_TRANSPARENT = True    # buffers pass untouched, one consumer
 
     def _make_pads(self):
         self.add_sink_pad(Caps.any(), "sink")

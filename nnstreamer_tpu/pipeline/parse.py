@@ -12,7 +12,10 @@ This parser accepts the same shape of syntax::
 
 Supported: element factories with ``key=value`` properties, ``!`` links,
 caps-filter segments (a bare caps string between ``!``), ``name=`` element
-naming, branch references ``name. ! ...`` (tee/demux fan-out).
+naming, and gst-launch's multi-chain grammar — whitespace without ``!``
+starts a new chain, ``name. ! ...`` branches from an element (tee/demux
+fan-out), ``... ! name.`` links into one (mux/merge fan-in), with forward
+references allowed.
 """
 
 from __future__ import annotations
@@ -75,40 +78,119 @@ def _coerce(value: str):
     return value
 
 
+def _is_prop(tok: str) -> bool:
+    """``key=value`` tokens attach to the preceding element head."""
+    k, eq, _ = tok.partition("=")
+    return bool(eq) and "/" not in k and not k.endswith(".")
+
+
+def iter_launch_ops(description: str):
+    """Tokenize a launch string into grammar operations — the single
+    tokenizer shared by :func:`parse_launch` and tools/pbtxt_pipeline.py.
+
+    Yields tuples:
+      ``("link",)``                  — a ``!``
+      ``("ref", name)``              — a ``name.`` branch/sink reference
+      ``("caps", caps_string)``      — a caps-filter segment
+      ``("element", head, props, name)`` — an element with properties
+    """
+    tokens = shlex.split(description)
+    i = 0
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok == "!":
+            yield ("link",)
+        elif tok.endswith(".") and "=" not in tok:
+            yield ("ref", tok[:-1])
+        elif "/" in tok and "=" not in tok.split(",")[0]:
+            # caps filter — gst-launch allows spaces after commas
+            # ("video/x-raw, format=RGB, width=224"): join follow-on
+            # fragments until the next '!' into one caps string
+            parts = [tok]
+            while tok.endswith(",") and i + 1 < len(tokens) \
+                    and tokens[i + 1] != "!":
+                i += 1
+                tok = tokens[i]
+                parts.append(tok)
+            yield ("caps", "".join(parts))
+        else:
+            head = tok
+            props = []
+            name = None
+            while i + 1 < len(tokens) and _is_prop(tokens[i + 1]):
+                k, _, v = tokens[i + 1].partition("=")
+                if k == "name":
+                    name = v
+                else:
+                    props.append((k, v))
+                i += 1
+            yield ("element", head, props, name)
+        i += 1
+
+
+class _ForwardRef:
+    """A ``name.`` branch-from reference to an element named later in the
+    line (gst-launch allows both directions)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+
 def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
-    """Build a :class:`Pipeline` from a launch string."""
+    """Build a :class:`Pipeline` from a launch string.
+
+    Implements gst-launch's chain grammar: elements join with ``!``;
+    whitespace without ``!`` ends a chain and starts a new one, so tee
+    fan-out / mux fan-in read exactly like the reference pipelines::
+
+        ... ! tee name=t ! tensor_sink name=a  t. ! tensor_sink name=b
+        appsrc name=s1 ! mux.  appsrc name=s2 ! mux.  tensor_mux name=mux ! ...
+
+    A trailing ``name.`` links the chain INTO that element (requesting a
+    sink pad); a leading ``name.`` branches FROM it.  References may point
+    forward — both directions resolve after all elements are created.
+    """
     p = pipeline or Pipeline()
-    # split into segments on '!'
-    segments = [s.strip() for s in description.split("!")]
-    prev: Optional[Element] = None
-    for seg in segments:
-        if not seg:
-            raise ValueError("empty segment in launch string")
-        tokens = shlex.split(seg)
-        head = tokens[0]
-        # branch reference: "name."
-        if head.endswith(".") and len(tokens) == 1:
-            prev = p.get(head[:-1])
+    prev = None                    # Element | _ForwardRef | None
+    linked = False                 # saw '!' since the previous element
+    into_refs: List[tuple] = []    # (src_el, sink_name): '... ! name.'
+    from_refs: List[tuple] = []    # (src_name, sink_el): 'name. ! ...'
+    for op in iter_launch_ops(description):
+        kind = op[0]
+        if kind == "link":
+            if prev is None:
+                raise ValueError("launch string: '!' with nothing upstream")
+            linked = True
             continue
-        # caps filter: token containing '/' before any '=' (media type)
-        if "/" in head and "=" not in head.split(",")[0]:
-            el = CapsFilter(None, caps=Caps.from_string(seg.replace(" ", "")))
-            p.add(el)
-            if prev is not None:
-                p.link(prev, el)
-            prev = el
+        if kind == "ref":
+            name = op[1]
+            if linked:             # chain INTO named element (sink ref)
+                if isinstance(prev, _ForwardRef):
+                    raise ValueError(
+                        "launch string: cannot link two bare references")
+                into_refs.append((prev, name))
+                prev, linked = None, False
+            else:                  # branch FROM named element
+                prev = _ForwardRef(name)
             continue
-        props = {}
-        name = None
-        for tok in tokens[1:]:
-            k, _, v = tok.partition("=")
-            if k == "name":
-                name = v
+        if kind == "caps":
+            el = p.add(CapsFilter(None, caps=Caps.from_string(op[1])))
+        else:
+            _, head, props, name = op
+            el = p.add(make_element(
+                head, name, **{k: _coerce(v) for k, v in props}))
+        if linked:
+            if isinstance(prev, _ForwardRef):
+                from_refs.append((prev.name, el))
             else:
-                props[k] = _coerce(v)
-        el = make_element(head, name, **props)
-        p.add(el)
-        if prev is not None:
-            p.link(prev, el)
-        prev = el
+                p.link(prev, el)
+        prev, linked = el, False
+    if linked:
+        raise ValueError("launch string ends with '!'")
+    for src_name, sink_el in from_refs:
+        p.link(p.get(src_name), sink_el)
+    for src_el, sink_name in into_refs:
+        p.link(src_el, p.get(sink_name))
     return p

@@ -44,6 +44,58 @@ class TestParseLaunch:
         with pytest.raises(KeyError):
             parse_launch("nosuchelement ! fakesink")
 
+    def test_multi_chain_tee_fanout(self):
+        """gst-launch chain grammar: whitespace separates chains, 'name.'
+        branches from a tee (the reference SSAT scripts' standard idiom)."""
+        p = parse_launch(
+            "videotestsrc num-buffers=2 ! "
+            "video/x-raw,format=GRAY8,width=4,height=4,framerate=0/1 ! "
+            "tensor_converter ! tee name=t ! tensor_sink name=a  "
+            "t. ! tensor_sink name=b")
+        p.run(timeout=10)
+        assert len(p.get("a").results) == 2
+        assert len(p.get("b").results) == 2
+
+    def test_caps_with_spaces(self):
+        """gst-launch allows 'video/x-raw, format=RGB, width=16' spacing."""
+        p = parse_launch(
+            "videotestsrc num-buffers=1 ! "
+            "video/x-raw, format=RGB, width=16, height=8, framerate=30/1 ! "
+            "tensor_converter ! tensor_sink name=out")
+        p.run(timeout=10)
+        assert p.get("out").results[0].np(0).shape == (8, 16, 3)
+
+    def test_forward_branch_reference(self):
+        """'t. ! ...' may appear before the chain that names t."""
+        p = parse_launch(
+            "t. ! tensor_sink name=b  "
+            "videotestsrc num-buffers=2 ! "
+            "video/x-raw,format=GRAY8,width=4,height=4,framerate=0/1 ! "
+            "tensor_converter ! tee name=t ! tensor_sink name=a")
+        p.run(timeout=10)
+        assert len(p.get("a").results) == 2
+        assert len(p.get("b").results) == 2
+
+    def test_multi_chain_mux_fanin_forward_ref(self):
+        """'... ! name.' links into a later-named element (fan-in)."""
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        caps = ("other/tensors,format=static,num_tensors=1,"
+                "dimensions=4,types=float32,framerate=0/1")
+        p = parse_launch(
+            f"appsrc caps={caps} name=s1 ! m.  "
+            f"appsrc caps={caps} name=s2 ! m.  "
+            "tensor_mux name=m ! tensor_sink name=out")
+        p.play()
+        for nm in ("s1", "s2"):
+            p.get(nm).push_buffer(TensorBuffer(
+                tensors=[np.arange(4, dtype=np.float32)], pts=0))
+            p.get(nm).end_of_stream()
+        p.wait(timeout=10)
+        p.stop()
+        assert len(p.get("out").results) == 1
+        assert p.get("out").results[0].num_tensors == 2
+
     def test_factories_present(self):
         fs = list_factories()
         for name in ("tensor_converter", "tensor_filter", "tensor_decoder",

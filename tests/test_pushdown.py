@@ -1,0 +1,188 @@
+"""Reduction pushdown: decoders fuse device-side reductions into the
+upstream filter's executable via the new upstream-event path.
+
+Net-new TPU-native optimization (no reference counterpart): the decoder's
+argmax/top-class step runs inside the filter's jitted program, so only the
+reduced result crosses device→host.  These tests run on the CPU JAX
+backend with a tiny registered model."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models.registry import _MODELS, Model, register_model
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsInfo
+from nnstreamer_tpu.tensor.types import TensorType
+
+
+@pytest.fixture()
+def tiny_classifier():
+    """8-class 'classifier' whose logits equal a fixed weight row dot the
+    input — deterministic argmax."""
+    import jax.numpy as jnp
+
+    w = np.zeros((4, 8), np.float32)
+    w[0, 5] = 1.0      # input[0] drives class 5
+
+    def build(custom):
+        def forward(params, x):
+            return (jnp.asarray(x, jnp.float32) @ params,)
+
+        return Model(name="tiny_cls", forward=forward, params=w,
+                     in_info=TensorsInfo([TensorInfo(TensorType.FLOAT32,
+                                                     (4,))]),
+                     out_info=TensorsInfo([TensorInfo(TensorType.FLOAT32,
+                                                      (8,))]))
+
+    register_model("tiny_cls")(build)
+    yield
+    _MODELS.pop("tiny_cls", None)
+
+
+def _run(pipeline, feeds):
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    got = []
+    pipeline.get("out").connect("new-data", lambda b: got.append(b))
+    pipeline.play()
+    src = pipeline.get("in")
+    for arr in feeds:
+        src.push_buffer(TensorBuffer(tensors=[arr]))
+    src.end_of_stream()
+    pipeline.wait(timeout=60)
+    pipeline.stop()
+    return got
+
+
+CAPS = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+        "types=float32,framerate=0/1")
+
+
+class TestPushdown:
+    def test_imagelabel_pushdown_fuses_argmax(self, tiny_classifier):
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_cls name=f ! "
+            "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+        x = np.array([3.0, 0, 0, 0], np.float32)
+        got = _run(p, [x, x])
+        assert len(got) == 2
+        assert got[0].extra["index"] == 5
+        # the filter's src caps must be the REDUCED form (one int32), i.e.
+        # the argmax ran inside the filter's executable
+        fcaps = p.get("f").src_pad.caps.first()
+        assert fcaps.get("types") == "int32"
+        assert fcaps.get("dimensions") == "1"
+
+    def test_pushdown_through_queue(self, tiny_classifier):
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_cls name=f ! "
+            "queue ! tensor_decoder mode=image_labeling ! "
+            "tensor_sink name=out")
+        x = np.array([1.0, 0, 0, 0], np.float32)
+        got = _run(p, [x])
+        assert got[0].extra["index"] == 5
+
+    def test_no_pushdown_for_host_backend(self, tiny_classifier):
+        """custom-easy cannot compose device fns: the event is refused and
+        the decoder keeps the host argmax path."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+
+        ii = TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))])
+        oi = TensorsInfo([TensorInfo(TensorType.FLOAT32, (8,))])
+
+        def fn(inputs):
+            out = np.zeros(8, np.float32)
+            out[2] = 1.0
+            return [out]
+
+        register_custom_easy("pushdown-host", fn, ii, oi)
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS} name=in ! "
+                "tensor_filter framework=custom-easy model=pushdown-host "
+                "name=f ! "
+                "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+            got = _run(p, [np.zeros(4, np.float32)])
+            assert got[0].extra["index"] == 2
+            fcaps = p.get("f").src_pad.caps.first()
+            assert fcaps.get("types") == "float32"   # NOT reduced
+        finally:
+            unregister_custom_easy("pushdown-host")
+
+    def test_tee_blocks_pushdown(self, tiny_classifier):
+        """A tee must refuse device-reduce: fusing one branch's reduction
+        would corrupt the other branches' data."""
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_cls name=f ! "
+            "tee name=t ! tensor_decoder mode=image_labeling ! "
+            "tensor_sink name=out  "
+            "t. ! tensor_sink name=raw")
+        x = np.array([2.0, 0, 0, 0], np.float32)
+        got = _run(p, [x])
+        assert got[0].extra["index"] == 5
+        # the raw branch still receives the FULL score vector
+        raw = p.get("raw").results[0].np(0)
+        assert raw.shape == (8,) and raw.dtype == np.float32
+        fcaps = p.get("f").src_pad.caps.first()
+        assert fcaps.get("types") == "float32"   # NOT reduced
+
+    def test_output_combination_blocks_pushdown(self, tiny_classifier):
+        """output-combination re-indexes outputs post-invoke; the filter
+        must refuse to fuse a reduction computed on the combined view."""
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_cls "
+            "output-combination=/0 name=f ! "
+            "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+        x = np.array([4.0, 0, 0, 0], np.float32)
+        got = _run(p, [x])
+        assert got[0].extra["index"] == 5        # host argmax fallback
+        fcaps = p.get("f").src_pad.caps.first()
+        assert fcaps.get("types") == "float32"   # NOT reduced
+
+    def test_segment_pushdown_shapes(self, tiny_classifier):
+        """image_segment reduce: (H, W, C) scores → (H, W) int map."""
+        import jax.numpy as jnp
+
+        w = np.zeros((4, 8), np.float32)
+
+        def build(custom):
+            def forward(params, x):
+                base = jnp.zeros((6, 5, 3), jnp.float32)
+                return (base.at[:3, :, 1].set(1.0).at[3:, :, 2].set(2.0),)
+
+            return Model(
+                name="tiny_seg", forward=forward, params=w,
+                in_info=TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))]),
+                out_info=TensorsInfo([TensorInfo(TensorType.FLOAT32,
+                                                 (3, 5, 6))]))
+
+        register_model("tiny_seg")(build)
+        try:
+            from nnstreamer_tpu import parse_launch
+
+            p = parse_launch(
+                f"appsrc caps={CAPS} name=in ! "
+                "tensor_filter framework=xla model=tiny_seg name=f ! "
+                "tensor_decoder mode=image_segment ! tensor_sink name=out")
+            got = _run(p, [np.zeros(4, np.float32)])
+            cmap = got[0].extra["class_map"]
+            assert cmap.shape == (6, 5)
+            assert (cmap[:3] == 1).all() and (cmap[3:] == 2).all()
+            fcaps = p.get("f").src_pad.caps.first()
+            assert fcaps.get("types") == "int32"
+            assert fcaps.get("dimensions") == "5:6"
+        finally:
+            _MODELS.pop("tiny_seg", None)

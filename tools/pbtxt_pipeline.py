@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Launch-string ↔ pbtxt pipeline-description converter.
+
+Role parity with the reference's prototxt converter
+(tools/development/gstPrototxt.py + tools/development/parser/): a pipeline
+can be described as a protobuf-text node graph and converted to a runnable
+launch string, and back.  The node-message layout mirrors that tool's
+model (element/name/properties + explicit edges); pads beyond the default
+are expressed with the same ``name.`` branch references the launch syntax
+uses.
+
+  node {
+    name: "f0"
+    element: "tensor_filter"
+    property { key: "framework" value: "xla" }
+    property { key: "model" value: "mobilenet_v2" }
+    input: "c0"
+  }
+
+Usage:
+  python tools/pbtxt_pipeline.py to-pbtxt   "videotestsrc ! tensor_sink"
+  python tools/pbtxt_pipeline.py to-launch  pipeline.pbtxt
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import shlex
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+class Node:
+    def __init__(self, name: str, element: str,
+                 props: Optional[List[Tuple[str, str]]] = None):
+        self.name = name
+        self.element = element
+        self.props = props or []
+        self.inputs: List[str] = []
+
+
+def parse_launch_text(description: str) -> List[Node]:
+    """Launch string → textual node graph (no elements instantiated).
+
+    Uses the runtime's own tokenizer (pipeline/parse.py
+    ``iter_launch_ops``) so the converter and the actual parser can never
+    drift on grammar: '!' joins, bare whitespace starts a new chain,
+    'name.' is a branch-from (chain head) or link-into (after '!')
+    reference, and both directions may be forward references."""
+    from nnstreamer_tpu.pipeline.parse import iter_launch_ops
+
+    nodes: List[Node] = []
+    by_name: Dict[str, Node] = {}
+    into_refs: List[Tuple[Node, str]] = []
+    from_refs: List[Tuple[str, Node]] = []
+    gen = 0
+    prev = None                # Node | str (forward branch ref) | None
+    linked = False
+    for op in iter_launch_ops(description):
+        kind = op[0]
+        if kind == "link":
+            if prev is None:
+                raise ValueError("'!' with nothing upstream")
+            linked = True
+            continue
+        if kind == "ref":
+            name = op[1]
+            if linked:
+                if isinstance(prev, str):
+                    raise ValueError("cannot link two bare references")
+                into_refs.append((prev, name))
+                prev, linked = None, False
+            else:
+                prev = name
+            continue
+        if kind == "caps":
+            node = Node(f"__caps{gen}", "capsfilter", [("caps", op[1])])
+            gen += 1
+        else:
+            _, head, props, name = op
+            if name is None:
+                name = f"__id{gen}"
+                gen += 1
+            node = Node(name, head, list(props))
+        if node.name in by_name:
+            raise ValueError(f"duplicate element name {node.name!r}")
+        by_name[node.name] = node
+        nodes.append(node)
+        if linked:
+            if isinstance(prev, str):
+                from_refs.append((prev, node))
+            else:
+                node.inputs.append(prev.name)
+        prev, linked = node, False
+    for src_name, sink in from_refs:
+        if src_name not in by_name:
+            raise ValueError(f"unknown reference {src_name!r}")
+        sink.inputs.insert(0, src_name)
+    for src, sink_name in into_refs:
+        if sink_name not in by_name:
+            raise ValueError(f"unknown reference {sink_name!r}")
+        by_name[sink_name].inputs.append(src.name)
+    return nodes
+
+
+def to_pbtxt(nodes: List[Node]) -> str:
+    out = []
+    for n in nodes:
+        lines = [f'  name: "{n.name}"', f'  element: "{n.element}"']
+        for k, v in n.props:
+            lines.append(
+                f'  property {{ key: "{k}" value: "{v}" }}')
+        for i in n.inputs:
+            lines.append(f'  input: "{i}"')
+        out.append("node {\n" + "\n".join(lines) + "\n}")
+    return "\n".join(out) + "\n"
+
+
+_NODE_RE = re.compile(r"node\s*\{")
+_FIELD_RE = re.compile(r'(\w+)\s*:\s*"([^"]*)"')
+_PROP_RE = re.compile(
+    r'property\s*\{\s*key:\s*"([^"]*)"\s*value:\s*"([^"]*)"\s*\}')
+
+
+def parse_pbtxt(text: str) -> List[Node]:
+    nodes: List[Node] = []
+    pos = 0
+    while True:
+        m = _NODE_RE.search(text, pos)
+        if not m:
+            break
+        depth, i = 1, m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        body = text[m.end():i - 1]
+        pos = i
+        props = _PROP_RE.findall(body)
+        scrubbed = _PROP_RE.sub("", body)
+        fields: Dict[str, List[str]] = {}
+        for k, v in _FIELD_RE.findall(scrubbed):
+            fields.setdefault(k, []).append(v)
+        if "element" not in fields:
+            raise ValueError("pbtxt node without element field")
+        n = Node(fields.get("name", [f"__id{len(nodes)}"])[0],
+                 fields["element"][0], list(props))
+        n.inputs = fields.get("input", [])
+        nodes.append(n)
+    if not nodes:
+        raise ValueError("no node {...} blocks found")
+    return nodes
+
+
+def to_launch(nodes: List[Node]) -> str:
+    """Emit a launch string; linear chains join with '!', fan-out/fan-in
+    use named branch references."""
+    by_name = {n.name: n for n in nodes}
+    consumers: Dict[str, int] = {}
+    for n in nodes:
+        for i in n.inputs:
+            if i not in by_name:
+                raise ValueError(f"unknown input {i!r}")
+            consumers[i] = consumers.get(i, 0) + 1
+
+    def fmt(n: Node, with_name: bool) -> str:
+        if n.element == "capsfilter" and n.props and n.props[0][0] == "caps":
+            return n.props[0][1]
+        parts = [n.element]
+        if with_name or not n.name.startswith("__"):
+            parts.append(f"name={n.name}")
+        for k, v in n.props:
+            v = shlex.quote(str(v))
+            parts.append(f"{k}={v}")
+        return " ".join(parts)
+
+    emitted = set()
+    chains: List[str] = []
+    # chain heads: nodes with no inputs, or whose upstream fans out, or
+    # with multiple inputs (join after the first)
+    for n in nodes:
+        if n.name in emitted:
+            continue
+        if n.inputs and consumers.get(n.inputs[0], 0) == 1 \
+                and len(n.inputs) == 1:
+            continue                       # will be emitted mid-chain
+        segs = []
+        if n.inputs:                       # fan-out branch / extra joins
+            segs.append(f"{n.inputs[0]}.")
+        cur: Optional[Node] = n
+        while cur is not None and cur.name not in emitted:
+            needs_name = consumers.get(cur.name, 0) > 1 or any(
+                cur.name in m.inputs[1:] for m in nodes)
+            segs.append(fmt(cur, needs_name))
+            emitted.add(cur.name)
+            nxt = [m for m in nodes
+                   if m.inputs and m.inputs[0] == cur.name
+                   and m.name not in emitted and len(m.inputs) == 1]
+            cur = nxt[0] if consumers.get(cur.name, 0) == 1 and nxt else None
+        chains.append(" ! ".join(segs))
+    # remaining (multi-input joins referenced via extra inputs)
+    for n in nodes:
+        for extra in n.inputs[1:]:
+            chains.append(f"{extra}. ! {n.name}.")
+    return "  ".join(chains)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("command", choices=("to-pbtxt", "to-launch"))
+    ap.add_argument("source", help="launch string | pbtxt file (or '-')")
+    args = ap.parse_args(argv)
+    if args.command == "to-pbtxt":
+        sys.stdout.write(to_pbtxt(parse_launch_text(args.source)))
+        return 0
+    text = (sys.stdin.read() if args.source == "-"
+            else open(args.source, encoding="utf-8").read())
+    print(to_launch(parse_pbtxt(text)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
