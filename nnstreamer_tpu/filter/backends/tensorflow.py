@@ -101,12 +101,28 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
                     packed_or_repeated_varints(d.get(10, []))]
         elif dt == 10:
             vals = packed_or_repeated_varints(d.get(11, []))
+        elif dt == 2:
+            from ...utils.protowire import packed_or_repeated_fixed64
+            vals = packed_or_repeated_fixed64(d.get(6, []), "<d")
+        elif dt in (14, 19):
+            # half_val (field 13): varints holding the 16-bit patterns of
+            # DT_BFLOAT16 / DT_HALF values
+            bits = packed_or_repeated_varints(d.get(13, []))
+            arr16 = np.array(bits, np.uint16)
+            vals = None
+            arr = arr16.view(dtype)
         else:
             vals = []
-        arr = np.array(vals, dtype)
+        if vals is not None:
+            arr = np.array(vals, dtype)
+        n = int(np.prod(shape)) if shape else 1
+        if 0 < arr.size < n:
+            # TF repeats the LAST listed value to fill the shape (a single
+            # value is the common splat case of the same rule).  Applies to
+            # the typed *_val lists ONLY — tensor_content must be full-size.
+            arr = np.concatenate([arr,
+                                  np.full(n - arr.size, arr[-1], dtype)])
     n = int(np.prod(shape)) if shape else 1
-    if arr.size == 1 and n > 1:
-        arr = np.full(n, arr[0], dtype)     # splat single value
     if arr.size != n:
         raise FilterError(
             f"tensorflow: TensorProto size {arr.size} != shape {shape}")

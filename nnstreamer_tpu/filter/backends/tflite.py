@@ -525,19 +525,19 @@ def _resize(method: str):
         align = bool(opts.scalar(ac_f, "bool", False)) if opts else False
         half = bool(opts.scalar(hp_f, "bool", False)) if opts else False
         if method == "nearest":
-            # tflite resize_nearest_neighbor.cc source-index selection:
-            #   half_pixel_centers: floor((i + 0.5) * in / out)
-            #   align_corners: std::round(i * (in-1)/(out-1)) — half away
-            #     from zero, NOT jnp.round's half-to-even
-            #   default: floor(i * in / out)
+            # tflite GetNearestNeighbor (reference_ops resize kernel): the
+            # SCALE is chosen by align_corners, the +0.5 OFFSET by
+            # half_pixel_centers, and round-vs-floor by align_corners —
+            # the two flags compose (both set → round((i+0.5)*(in-1)/(out-1)),
+            # half away from zero, NOT jnp.round's half-to-even).
             def nn_idx(out_len, in_len):
                 i = jnp.arange(out_len, dtype=jnp.float32)
-                if half:
-                    v = jnp.floor((i + 0.5) * in_len / out_len)
-                elif align and out_len > 1:
-                    v = jnp.floor(i * (in_len - 1) / (out_len - 1) + 0.5)
+                if align and out_len > 1:
+                    scale = (in_len - 1) / (out_len - 1)
                 else:
-                    v = jnp.floor(i * in_len / out_len)
+                    scale = in_len / out_len
+                v = (i + (0.5 if half else 0.0)) * scale
+                v = jnp.floor(v + 0.5) if align else jnp.floor(v)
                 return jnp.clip(v, 0, in_len - 1).astype(jnp.int32)
 
             yi = nn_idx(h2, h)

@@ -173,6 +173,10 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
                 into_refs.append((prev, name))
                 prev, linked = None, False
             else:                  # branch FROM named element
+                if isinstance(prev, _ForwardRef):
+                    raise ValueError(
+                        f"launch string: reference '{prev.name}.' is never "
+                        f"linked (followed by '{name}.' without '!')")
                 prev = _ForwardRef(name)
             continue
         if kind == "caps":
@@ -186,9 +190,16 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
                 from_refs.append((prev.name, el))
             else:
                 p.link(prev, el)
+        elif isinstance(prev, _ForwardRef):
+            raise ValueError(
+                f"launch string: reference '{prev.name}.' is never linked "
+                f"(followed by an element without '!')")
         prev, linked = el, False
     if linked:
         raise ValueError("launch string ends with '!'")
+    if isinstance(prev, _ForwardRef):
+        raise ValueError(f"launch string: trailing reference '{prev.name}.'"
+                         " is never linked")
     for src_name, sink_el in from_refs:
         p.link(p.get(src_name), sink_el)
     for src_el, sink_name in into_refs:

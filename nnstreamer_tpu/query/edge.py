@@ -76,16 +76,25 @@ class EdgeBroker:
                             self._subs.setdefault(topic, set()).add(conn)
                             slock = self._send_locks[conn] = threading.Lock()
                             retained = self._topic_caps.get(topic, "")
-                            # retained caps must go out while still holding
-                            # the broker lock: a publisher must take this
-                            # lock to record new caps B before fanning B out,
-                            # so it cannot overtake the retained send — the
-                            # subscriber always sees retained-then-B, never
-                            # B-then-stale-retained
+                            # Take this conn's send lock before releasing the
+                            # broker lock: a publisher recording new caps B
+                            # snapshots subscribers under the broker lock and
+                            # then needs this send lock, so it cannot overtake
+                            # the retained send — the subscriber always sees
+                            # retained-then-B.  The broker lock itself is NOT
+                            # held across send_msg: a subscriber with a full
+                            # TCP send buffer stalls only its own stream, not
+                            # every topic/publisher.
                             if retained:
-                                with slock:
-                                    send_msg(conn, Message(
-                                        T_HELLO, payload=retained.encode()))
+                                slock.acquire()
+                        if retained:
+                            try:
+                                send_msg(conn, Message(
+                                    T_HELLO, payload=retained.encode()))
+                            except OSError:
+                                break
+                            finally:
+                                slock.release()
                     elif role == "pub" and caps:
                         with self._lock:
                             self._topic_caps[topic] = caps

@@ -104,6 +104,20 @@ def packed_or_repeated_varints(entries: List[Tuple[int, Value]]) -> List[int]:
     return out
 
 
+def packed_or_repeated_fixed64(entries: List[Tuple[int, Value]],
+                               fmt: str = "<d") -> List:
+    out: List = []
+    for wire, v in entries:
+        if wire == 1:
+            out.append(struct.unpack(fmt, struct.pack("<Q", v))[0])
+        elif wire == 2:
+            n = len(v) // 8        # type: ignore[arg-type]
+            out.extend(struct.unpack(f"<{n}{fmt[-1]}", v))
+        else:
+            raise ValueError("protowire: bad wire type for fixed64 list")
+    return out
+
+
 def packed_or_repeated_fixed32(entries: List[Tuple[int, Value]],
                                fmt: str = "<f") -> List:
     out: List = []
