@@ -23,7 +23,7 @@ import numpy as np
 from ..pipeline.caps import ANY_FRAMERATE, Caps, Structure
 from ..pipeline.element import CapsEvent, Element, FlowReturn
 from ..pipeline.registry import register_element
-from ..tensor.buffer import TensorBuffer
+from ..tensor.buffer import TensorBuffer, frames_to_ns
 from ..tensor.caps_util import caps_from_config, flexible_tensors_caps
 from ..tensor.info import TensorInfo, TensorsConfig, TensorsInfo
 from ..tensor.meta import TensorMetaInfo
@@ -35,6 +35,52 @@ _AUDIO_TYPES = {"S8": TensorType.INT8, "U8": TensorType.UINT8,
                 "S16LE": TensorType.INT16, "U16LE": TensorType.UINT16,
                 "S32LE": TensorType.INT32, "U32LE": TensorType.UINT32,
                 "F32LE": TensorType.FLOAT32, "F64LE": TensorType.FLOAT64}
+
+
+class _Adapter:
+    """Byte-FIFO accumulate/split across buffer boundaries — the GstAdapter
+    role in the reference's chunk/merge path (gsttensor_converter.c:783,
+    1110-1154): incoming buffers of ARBITRARY size are re-chunked into
+    exact frame multiples, with the remainder carried to the next buffer."""
+
+    def __init__(self) -> None:
+        self._chunks: List[np.ndarray] = []   # 1-D uint8 views
+        self.available = 0
+
+    def push(self, raw: np.ndarray) -> None:
+        if raw.nbytes:
+            self._chunks.append(raw)
+            self.available += raw.nbytes
+
+    def take(self, n: int) -> np.ndarray:
+        assert n <= self.available
+        out = np.empty(n, np.uint8)
+        filled = 0
+        while filled < n:
+            c = self._chunks[0]
+            m = min(n - filled, c.nbytes)
+            out[filled:filled + m] = c[:m]
+            if m == c.nbytes:
+                self._chunks.pop(0)
+            else:
+                self._chunks[0] = c[m:]
+            filled += m
+        self.available -= n
+        return out
+
+    def compact(self) -> None:
+        """Own the carried remainder: pushed chunks are zero-copy VIEWS of
+        producer arrays, valid only within the chain call that pushed them —
+        a producer reusing its scratch buffer would otherwise corrupt bytes
+        still queued here.  Call at the end of each chain call."""
+        if len(self._chunks) == 1:
+            self._chunks[0] = self._chunks[0].copy()
+        elif self._chunks:
+            self._chunks = [np.concatenate(self._chunks)]
+
+    def clear(self) -> None:
+        self._chunks.clear()
+        self.available = 0
 
 
 @register_element
@@ -65,6 +111,9 @@ class TensorConverter(Element):
         self._out_config: Optional[TensorsConfig] = None
         self._media: Optional[str] = None
         self._custom = None
+        self._adapter = _Adapter()
+        self._base_pts: Optional[int] = None   # PTS of adapter head
+        self._emitted_frames = 0               # frames since _base_pts
         mode = self.mode
         if mode:
             kind, _, name = str(mode).partition(":")
@@ -96,14 +145,32 @@ class TensorConverter(Element):
             if dtype is None:
                 raise ValueError(f"unsupported audio format {fmt}")
             ch = int(st.get("channels", 1))
+            srate = st.get("rate")
             self._audio_dtype = dtype
-            # per-buffer sample count varies; negotiated lazily on first buf
             self._audio_channels = ch
-            self._audio_rate = rate if isinstance(rate, Fraction) else None
+            self._audio_srate = int(srate) if srate else 0
+            if fpt > 1:
+                # explicit frames-per-tensor: announce NOW, adapter
+                # re-chunks arbitrary incoming buffer sizes (reference
+                # gsttensor_converter.c:1110-1113 frames_in = buf/frame +
+                # adapter accumulate/split at :783)
+                out_rate = (Fraction(self._audio_srate, fpt)
+                            if self._audio_srate else Fraction(0, 1))
+                cfg = TensorsConfig(
+                    info=TensorsInfo([TensorInfo(dtype, (ch, fpt))]),
+                    rate=out_rate)
+                self._announce(cfg)
+                return
+            # fpt=1: frames-per-buffer fixed by the FIRST buffer's sample
+            # count; later buffers of different size are re-chunked by the
+            # adapter to that negotiated count
             self._out_config = None
             return  # announce on first buffer
         elif st.name == "text/x-raw":
             dim = dim_parse(str(self.input_dim)) if self.input_dim else (256,)
+            self._text_frame_dims = dim
+            if fpt > 1:
+                dim = dim + (fpt,)
             cfg = TensorsConfig(
                 info=TensorsInfo([TensorInfo(TensorType.UINT8, dim)]),
                 rate=rate if isinstance(rate, Fraction) else Fraction(0, 1))
@@ -111,10 +178,12 @@ class TensorConverter(Element):
             if not self.input_dim or not self.input_type:
                 raise ValueError(
                     "octet stream requires input-dim and input-type")
+            dim = dim_parse(str(self.input_dim))
+            if fpt > 1:
+                dim = dim + (fpt,)
             cfg = TensorsConfig(
                 info=TensorsInfo([TensorInfo(
-                    TensorType.from_string(str(self.input_type)),
-                    dim_parse(str(self.input_dim)))]),
+                    TensorType.from_string(str(self.input_type)), dim)]),
                 rate=rate if isinstance(rate, Fraction) else Fraction(0, 1))
         elif st.name == "other/tensors":  # flexible → static promotion
             self._out_config = None
@@ -137,8 +206,10 @@ class TensorConverter(Element):
             return self._chain_video(buf)
         if media == "audio/x-raw":
             return self._chain_audio(buf)
-        if media in ("text/x-raw", "application/octet-stream"):
-            return self._chain_bytes(buf)
+        if media == "text/x-raw":
+            return self._chain_text(buf)
+        if media == "application/octet-stream":
+            return self._chain_octet(buf)
         if media == "other/tensors":
             return self._chain_flex(buf)
         raise RuntimeError(f"no caps negotiated on {self.name}")
@@ -161,26 +232,125 @@ class TensorConverter(Element):
         self._pending_pts = None
         return self.push(out)
 
-    def _chain_audio(self, buf: TensorBuffer) -> FlowReturn:
-        samples = buf.np(0)
-        if self._out_config is None:
-            dims = np_shape_to_dim(samples.shape)
-            cfg = TensorsConfig(
-                info=TensorsInfo([TensorInfo(self._audio_dtype, dims)]),
-                rate=self._audio_rate or Fraction(0, 1))
-            self._announce(cfg)
-        return self.push(buf.with_tensors([samples]))
+    def _rebase_pts(self, buf: TensorBuffer) -> None:
+        """Re-anchor the synthesized-PTS timeline on an upstream timestamp
+        when the adapter is at a frame boundary and the buffer carries a
+        valid PTS; a PTS-less buffer continues the running timeline
+        (reference _gst_tensor_converter_chain_timestamp :783)."""
+        if self._adapter.available == 0 and buf.pts is not None:
+            self._base_pts = buf.pts
+            self._emitted_frames = 0
+        elif self._base_pts is None:
+            self._base_pts = 0
 
-    def _chain_bytes(self, buf: TensorBuffer) -> FlowReturn:
+    def _chain_audio(self, buf: TensorBuffer) -> FlowReturn:
+        ch = self._audio_channels
+        samples = np.asarray(buf.np(0))
+        if samples.ndim == 1:
+            samples = samples.reshape(-1, ch)
+        if self._out_config is None:
+            # fpt=1: the FIRST buffer's sample count fixes frames/tensor
+            n = samples.shape[0]
+            out_rate = (Fraction(self._audio_srate, n)
+                        if self._audio_srate and n else Fraction(0, 1))
+            cfg = TensorsConfig(
+                info=TensorsInfo([TensorInfo(self._audio_dtype,
+                                             np_shape_to_dim(samples.shape))]),
+                rate=out_rate)
+            self._announce(cfg)
         info = self._out_config.info[0]
+        frames_out = info.np_shape[0]
+        out_bytes = info.size
+        srate = self._audio_srate
+        self._rebase_pts(buf)
+
+        def stamp(fallback_pts, fallback_dur):
+            if srate and self.set_timestamp:
+                pts = self._base_pts + frames_to_ns(
+                    self._emitted_frames, srate, 1)
+                dur = frames_to_ns(frames_out, srate, 1)
+            else:
+                pts, dur = fallback_pts, fallback_dur
+            self._emitted_frames += frames_out
+            return pts, dur
+
+        # fast path: adapter empty and the buffer is exactly one tensor —
+        # zero-copy, but it still advances the synthesized timeline so a
+        # later adapter-path buffer continues instead of restarting at base
+        if (self._adapter.available == 0
+                and samples.shape == info.np_shape):
+            pts, dur = stamp(buf.pts, buf.duration)
+            out = buf.with_tensors([samples])
+            out.pts, out.duration = pts, dur
+            return self.push(out)
+        self._adapter.push(
+            np.ascontiguousarray(samples).reshape(-1).view(np.uint8))
+        ret = FlowReturn.OK
+        while self._adapter.available >= out_bytes:
+            arr = (self._adapter.take(out_bytes)
+                   .view(info.np_dtype).reshape(info.np_shape))
+            pts, dur = stamp(buf.pts, buf.duration)
+            ret = self.push(TensorBuffer(tensors=[arr], pts=pts,
+                                         duration=dur,
+                                         extra=dict(buf.extra)))
+            if ret is FlowReturn.ERROR:
+                return ret
+        self._adapter.compact()
+        return ret
+
+    def _chain_text(self, buf: TensorBuffer) -> FlowReturn:
+        """Each text buffer is ONE frame, padded/clipped to the frame size
+        (reference :1114-1143); frames-per-tensor>1 stacks N frames."""
+        frame_dims = self._text_frame_dims
+        frame_size = int(np.prod(frame_dims))
         raw = np.asarray(buf.np(0)).reshape(-1).view(np.uint8)
-        want = info.size
-        if raw.nbytes < want:  # pad (reference text pad/clip :1114-1143)
+        if raw.nbytes < frame_size:
             raw = np.concatenate(
-                [raw, np.zeros(want - raw.nbytes, np.uint8)])
-        raw = raw[:want]
-        arr = raw.view(info.np_dtype).reshape(info.np_shape)
-        return self.push(buf.with_tensors([arr]))
+                [raw, np.zeros(frame_size - raw.nbytes, np.uint8)])
+        frame = raw[:frame_size].reshape(tuple(reversed(frame_dims)))
+        fpt = int(self.frames_per_tensor)
+        if fpt <= 1:
+            return self.push(buf.with_tensors([frame]))
+        self._pending.append(frame)
+        if self._pending_pts is None:
+            self._pending_pts = buf.pts
+        if len(self._pending) < fpt:
+            return FlowReturn.OK
+        stacked = np.stack(self._pending, axis=0)
+        self._pending = []
+        out = TensorBuffer(tensors=[stacked], pts=self._pending_pts,
+                           duration=(buf.duration or 0) * fpt,
+                           extra=dict(buf.extra))
+        self._pending_pts = None
+        return self.push(out)
+
+    def _chain_octet(self, buf: TensorBuffer) -> FlowReturn:
+        """Static chunking (reference :1144-1154): arbitrary buffer sizes
+        are re-chunked to exact tensor multiples via the adapter — a big
+        buffer yields several tensors, small ones accumulate."""
+        info = self._out_config.info[0]
+        out_bytes = info.size
+        self._rebase_pts(buf)
+        self._adapter.push(np.asarray(buf.np(0)).reshape(-1).view(np.uint8))
+        rate = self._out_config.rate
+        ret = FlowReturn.OK
+        while self._adapter.available >= out_bytes:
+            arr = (self._adapter.take(out_bytes)
+                   .view(info.np_dtype).reshape(info.np_shape))
+            if rate and self.set_timestamp:
+                pts = self._base_pts + frames_to_ns(
+                    self._emitted_frames, rate.numerator, rate.denominator)
+                dur = frames_to_ns(1, rate.numerator, rate.denominator)
+            else:
+                pts, dur = buf.pts, buf.duration
+            self._emitted_frames += 1
+            ret = self.push(TensorBuffer(tensors=[arr], pts=pts,
+                                         duration=dur,
+                                         extra=dict(buf.extra)))
+            if ret is FlowReturn.ERROR:
+                return ret
+        self._adapter.compact()
+        return ret
 
     def _chain_flex(self, buf: TensorBuffer) -> FlowReturn:
         """Flexible → static promotion: first buffer's meta fixes the config

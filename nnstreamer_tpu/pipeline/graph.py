@@ -107,6 +107,15 @@ class Pipeline:
                 if p.peer is None:
                     raise RuntimeError(f"unlinked pad {p.full_name}")
 
+    def query_latency(self) -> "tuple[int, Dict[str, int]]":
+        """Pipeline LATENCY query (reference: GStreamer latency query with
+        tensor_filter injecting its invoke latency, tensor_filter.c:
+        1313-1377): returns (total_ns, {element_name: ns}) summing every
+        element's reported contribution."""
+        per = {el.name: el.report_latency() for el in self.elements}
+        per = {k: v for k, v in per.items() if v > 0}
+        return sum(per.values()), per
+
     def post_error(self, element: Element, exc: BaseException) -> None:
         with self._cv:
             if self._error is None:
@@ -277,8 +286,12 @@ class Queue(Element):
 
 @register_element
 class Tee(Element):
-    """1→N branch duplicator (GStreamer ``tee`` role).  Buffers are shared,
-    not copied — downstream must not mutate in place (same contract as
+    """1→N branch duplicator (GStreamer ``tee`` role).  Tensor PAYLOADS are
+    shared, never copied — each branch gets a fresh :class:`TensorBuffer`
+    wrapper (so per-buffer ``extra``/meta mutations stay branch-local, the
+    GstBuffer-writability analogue) holding the same array handles, so no
+    tensor bytes are duplicated and device arrays stay on device.
+    Downstream must not mutate tensor data in place (same contract as
     GstBuffer refcount sharing)."""
 
     FACTORY = "tee"

@@ -74,6 +74,9 @@ class TensorBuffer:
                             duration=self.duration, extra=dict(self.extra))
 
     def copy(self) -> "TensorBuffer":
+        """Shallow copy: a new wrapper with independent ``extra``/``metas``
+        containers but the SAME tensor payload handles — no tensor bytes are
+        copied, and device arrays stay on device."""
         return TensorBuffer(tensors=list(self.tensors), pts=self.pts,
                             duration=self.duration,
                             metas=list(self.metas) if self.metas else None,

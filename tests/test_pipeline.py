@@ -214,3 +214,109 @@ class TestAudioSrc:
         out = p.get("out").results
         assert out[0].np(0).shape == (256, 2)
         assert out[0].np(0).dtype == np.int16
+
+    def test_audio_frames_per_tensor_rechunks(self):
+        """Adapter accumulate/split (reference gsttensor_converter.c:783,
+        1110-1113): 4 buffers of 300 samples re-chunk into 6 tensors of
+        200 frames with synthesized PTS at the sample rate."""
+        p = parse_launch(
+            "audiotestsrc num-buffers=4 samplesperbuffer=300 ! "
+            "audio/x-raw,format=S16LE,channels=2,rate=8000 ! "
+            "tensor_converter frames-per-tensor=200 ! tensor_sink name=out")
+        p.run(timeout=10)
+        out = p.get("out").results
+        assert len(out) == 6
+        assert all(b.np(0).shape == (200, 2) for b in out)
+        step = 200 * 1_000_000_000 // 8000      # 25 ms
+        assert [b.pts for b in out] == [i * step for i in range(6)]
+        # no samples lost or duplicated across chunk boundaries
+        ref = parse_launch(
+            "audiotestsrc num-buffers=4 samplesperbuffer=300 ! "
+            "audio/x-raw,format=S16LE,channels=2,rate=8000 ! "
+            "tensor_converter ! tensor_sink name=out")
+        ref.run(timeout=10)
+        got = np.concatenate([b.np(0) for b in out])
+        want = np.concatenate([b.np(0) for b in ref.get("out").results])
+        np.testing.assert_array_equal(got, want[:len(got)])
+
+    def test_audio_variable_buffer_rechunks_to_first(self):
+        """A different-sized SECOND buffer re-chunks to the negotiated
+        first-buffer frame count instead of erroring (round-1 weak #8)."""
+        from nnstreamer_tpu.elements import TensorConverter, TensorSink
+
+        p = Pipeline()
+        src = AppSrc("src", caps="audio/x-raw,format=S16LE,channels=1,"
+                                 "rate=1000")
+        conv, sink = TensorConverter("c"), TensorSink("out")
+        p.add(src, conv, sink)
+        p.link(src, conv, sink)
+        data = np.arange(260, dtype=np.int16)
+        src.push_buffer(TensorBuffer(tensors=[data[:100]], pts=0))
+        src.push_buffer(TensorBuffer(tensors=[data[100:160]], pts=None))
+        src.push_buffer(TensorBuffer(tensors=[data[160:260]], pts=None))
+        src.end_of_stream()
+        p.run(timeout=10)
+        out = sink.results
+        assert [b.np(0).shape for b in out] == [(100, 1), (100, 1)]
+        np.testing.assert_array_equal(
+            np.concatenate([b.np(0).reshape(-1) for b in out]), data[:200])
+
+
+class TestOctetChunking:
+    def test_octet_rechunks_arbitrary_buffers(self):
+        from nnstreamer_tpu.elements import TensorConverter, TensorSink
+
+        p = Pipeline()
+        src = AppSrc("src", caps="application/octet-stream,framerate=10/1")
+        conv = TensorConverter("c", **{"input-dim": "4",
+                                       "input-type": "uint8"})
+        sink = TensorSink("out")
+        p.add(src, conv, sink)
+        p.link(src, conv, sink)
+        data = np.arange(22, dtype=np.uint8)
+        src.push_buffer(TensorBuffer(tensors=[data[:10]], pts=0))
+        src.push_buffer(TensorBuffer(tensors=[data[10:16]], pts=None))
+        src.push_buffer(TensorBuffer(tensors=[data[16:22]], pts=None))
+        src.end_of_stream()
+        p.run(timeout=10)
+        out = sink.results
+        assert len(out) == 5                     # 22 bytes → 5×4 (2 dropped)
+        np.testing.assert_array_equal(
+            np.concatenate([b.np(0) for b in out]), data[:20])
+        # PTS synthesized from the announced 10/1 rate
+        assert [b.pts for b in out] == [i * 100_000_000 for i in range(5)]
+
+    def test_adapter_owns_carried_remainder(self):
+        """compact() must copy carried views: a producer reusing its scratch
+        array between chain calls cannot corrupt queued bytes."""
+        from nnstreamer_tpu.elements.converter import _Adapter
+
+        a = _Adapter()
+        scratch = np.arange(10, dtype=np.uint8)
+        a.push(scratch)
+        assert bytes(a.take(4)) == bytes(range(4))
+        a.compact()
+        scratch[:] = 99                      # producer reuses its buffer
+        assert bytes(a.take(6)) == bytes(range(4, 10))
+
+    def test_text_frames_per_tensor_stacks(self):
+        from nnstreamer_tpu.elements import TensorConverter, TensorSink
+
+        p = Pipeline()
+        src = AppSrc("src", caps="text/x-raw")
+        conv = TensorConverter("c", **{"input-dim": "8",
+                                       "frames-per-tensor": 2})
+        sink = TensorSink("out")
+        p.add(src, conv, sink)
+        p.link(src, conv, sink)
+        for i, text in enumerate((b"hi", b"world!!!", b"xyz", b"q")):
+            src.push_buffer(TensorBuffer(
+                tensors=[np.frombuffer(text, np.uint8)], pts=i))
+        src.end_of_stream()
+        p.run(timeout=10)
+        out = sink.results
+        assert len(out) == 2
+        assert out[0].np(0).shape == (2, 8)
+        assert bytes(out[0].np(0)[0][:2]) == b"hi"
+        assert bytes(out[0].np(0)[1]) == b"world!!!"
+        assert bytes(out[1].np(0)[0][:3]) == b"xyz"
