@@ -24,7 +24,7 @@ from typing import List, Optional
 from ..filter.framework import (Accelerator, FilterError, FilterProperties,
                                 close_backend, open_backend)
 from ..pipeline.caps import Caps
-from ..pipeline.element import CustomEvent, Element, FlowReturn
+from ..pipeline.element import CustomEvent, Element, FlowReturn, QoSEvent
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import caps_from_config, static_tensors_caps
@@ -80,6 +80,9 @@ class TensorFilter(Element):
         self._props = props
         self.stats = getattr(self.fw, "stats", None)
         self._in_comb = _parse_combination(self.input_combination)
+        self._throttle_ns = 0          # QoS-driven drop interval
+        self._last_kept_pts: Optional[int] = None
+        self.dropped = 0               # frames throttle-dropped
         self._out_comb = None
         if self.output_combination not in (None, ""):
             ins, _, outs = str(self.output_combination).partition("/")
@@ -127,6 +130,16 @@ class TensorFilter(Element):
         fw = self.fw
         if fw is None or not fw.opened:
             raise RuntimeError(f"{self.name}: not started")
+        # QoS throttle-drop (reference :609): after a downstream QoS event,
+        # drop frames arriving faster than the reported consumption rate
+        if self._throttle_ns and buf.pts is not None:
+            last = self._last_kept_pts
+            if last is not None and buf.pts - last < self._throttle_ns:
+                self.dropped += 1
+                return FlowReturn.DROPPED
+            self._last_kept_pts = buf.pts
+        elif buf.pts is not None:
+            self._last_kept_pts = buf.pts
         # per-buffer validation against negotiated meta (reference :557-626)
         in_info = self._in_config.info
         if buf.num_tensors != in_info.num_tensors:
@@ -146,6 +159,28 @@ class TensorFilter(Element):
 
     # -- events --------------------------------------------------------------
     def on_upstream_event(self, pad, event):
+        if isinstance(event, QoSEvent):
+            # Reference src_event QOS handling (:1454-1485): derive a
+            # throttling interval from the reported slowdown and the
+            # stream's frame cadence; a catch-up report (jitter <= 0)
+            # clears it.  Also auto-enables latency accounting.
+            if event.jitter_ns <= 0:
+                self._throttle_ns = 0
+            else:
+                rate = getattr(self, "_in_config", None)
+                rate = rate.rate if rate is not None else None
+                if rate and rate > 0:
+                    frame_ns = (1_000_000_000 * rate.denominator
+                                // rate.numerator)
+                else:
+                    frame_ns = max(event.jitter_ns, 1)
+                self._throttle_ns = int(frame_ns * max(1.0,
+                                                       event.proportion))
+                self.latency_report = True
+            # keep propagating so upstream adapters (tensor_rate, sources)
+            # can throttle too — the filter is a participant, not the owner
+            super().on_upstream_event(pad, event)
+            return True
         if isinstance(event, CustomEvent) and \
                 event.name == "nns/device-reduce":
             # Reduction pushdown from a downstream decoder: fuse its pure
@@ -178,6 +213,14 @@ class TensorFilter(Element):
             self.fw.handle_event("reload_model", event.data)
             return  # consumed, like the reference custom-event sink
         super().on_event(pad, event)
+
+    def report_latency(self) -> int:
+        """LATENCY-query contribution: rolling average invoke latency in ns
+        when latency-report is on (reference tensor_filter.c:1313-1377)."""
+        if not self.latency_report:
+            return 0
+        lat_us = self.latency
+        return lat_us * 1000 if lat_us > 0 else 0
 
     # -- stats readout (reference readable props :2163-2171) -----------------
     @property

@@ -10,7 +10,7 @@ from __future__ import annotations
 from fractions import Fraction
 
 from ..pipeline.caps import Caps, Structure
-from ..pipeline.element import Element, FlowReturn
+from ..pipeline.element import Element, FlowReturn, QoSEvent
 from ..pipeline.registry import register_element
 from ..tensor.buffer import SECOND, TensorBuffer
 from ..tensor.caps_util import caps_from_config, config_from_caps, \
@@ -34,9 +34,28 @@ class TensorRate(Element):
         if self.framerate in (None, ""):
             raise ValueError(f"{self.name}: framerate required")
         self._target = Fraction(str(self.framerate))
+        self._qos_proportion = 1.0     # downstream slowdown (QoS feedback)
         self._next_pts = 0
         self.dropped = 0
         self.duplicated = 0
+
+    def on_upstream_event(self, pad, event):
+        """Close the QoS loop: a downstream slowdown report lowers the
+        EFFECTIVE output rate (open-loop target ÷ proportion); a catch-up
+        report (jitter <= 0) restores the configured rate.  The event still
+        propagates upstream so producers can throttle too."""
+        if isinstance(event, QoSEvent):
+            self._qos_proportion = (1.0 if event.jitter_ns <= 0
+                                    else max(1.0, event.proportion))
+            super().on_upstream_event(pad, event)
+            return True
+        return super().on_upstream_event(pad, event)
+
+    @property
+    def effective_rate(self) -> Fraction:
+        p = self._qos_proportion
+        return self._target if p <= 1.0 else self._target / Fraction(
+            int(p * 1000), 1000)
 
     def set_caps(self, pad, caps):
         cfg = config_from_caps(caps)
@@ -44,7 +63,8 @@ class TensorRate(Element):
         self.announce_src_caps(caps_from_config(cfg))
 
     def chain(self, pad, buf):
-        interval = SECOND * self._target.denominator // self._target.numerator
+        eff = self.effective_rate
+        interval = SECOND * eff.denominator // eff.numerator
         pts = buf.pts or 0
         if pts + (buf.duration or 0) < self._next_pts:
             self.dropped += 1

@@ -9,14 +9,16 @@ output (tests/nnstreamer_sink/unittest_sink.cc).
 from __future__ import annotations
 
 import threading
+import time
+from fractions import Fraction
 from typing import Callable, List, Optional
 
 import numpy as np
 
 from ..pipeline.caps import Caps
-from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.element import Element, EOSEvent, FlowReturn, QoSEvent
 from ..pipeline.registry import register_element
-from ..tensor.buffer import TensorBuffer
+from ..tensor.buffer import SECOND, TensorBuffer
 
 
 @register_element
@@ -27,6 +29,8 @@ class TensorSink(Element):
         "sync": (False, "no-op (no wall-clock sync yet)"),
         "collect": (True, "keep buffers in .results"),
         "max-results": (0, "cap on retained buffers, 0 = unlimited"),
+        "qos": (False, "emit upstream QoS events when consuming slower "
+                       "than the stream's frame duration"),
     }
 
     def __init__(self, name=None, **props):
@@ -35,6 +39,7 @@ class TensorSink(Element):
         self.results: List[TensorBuffer] = []
         self._caps: Optional[Caps] = None
         self._eos = threading.Event()
+        self._qos_late = False
 
     def _make_pads(self):
         self.add_sink_pad(Caps.any(), "sink")
@@ -52,7 +57,17 @@ class TensorSink(Element):
     def caps(self) -> Optional[Caps]:
         return self._caps
 
+    def _frame_duration_ns(self, buf) -> int:
+        if buf.duration:
+            return int(buf.duration)
+        if self._caps is not None:
+            rate = self._caps.first().get("framerate")
+            if isinstance(rate, Fraction) and rate > 0:
+                return SECOND * rate.denominator // rate.numerator
+        return 0
+
     def chain(self, pad, buf):
+        t0 = time.monotonic_ns() if self.qos else 0
         if self.collect:
             self.results.append(buf)
             cap = int(self.max_results)
@@ -61,6 +76,26 @@ class TensorSink(Element):
         if self.emit_signal:
             for cb in self._callbacks:
                 cb(buf)
+        if self.qos:
+            # QoS feedback loop (reference wires real-time sinks' QoS events
+            # to tensor_filter throttling, tensor_filter.c:1454-1485): when
+            # consuming this buffer took longer than one frame duration,
+            # tell upstream how far behind we are.  When a previously-slow
+            # consumer catches up, send ONE catch-up event (jitter <= 0) so
+            # upstream throttles can clear — without it a single transient
+            # stall would throttle the stream forever.
+            proc = time.monotonic_ns() - t0
+            dur = self._frame_duration_ns(buf)
+            if dur and proc > dur:
+                self._qos_late = True
+                pad.push_upstream_event(QoSEvent(
+                    timestamp=buf.pts, jitter_ns=proc - dur,
+                    proportion=proc / dur))
+            elif dur and self._qos_late:
+                self._qos_late = False
+                pad.push_upstream_event(QoSEvent(
+                    timestamp=buf.pts, jitter_ns=proc - dur,
+                    proportion=max(proc / dur, 1e-3)))
         return FlowReturn.OK
 
     def on_event(self, pad, event):

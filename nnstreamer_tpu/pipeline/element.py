@@ -71,6 +71,25 @@ class CustomEvent(Event):
         return f"CustomEvent({self.name})"
 
 
+class QoSEvent(Event):
+    """Upstream QoS feedback (GStreamer GST_EVENT_QOS role): a downstream
+    consumer reports it cannot keep up.  ``timestamp`` is the PTS of the
+    late buffer, ``jitter_ns`` > 0 how late it ran, ``proportion`` the
+    observed slowdown ratio (1.0 = real-time, 2.0 = consuming at half
+    speed).  tensor_filter consumes these to throttle-drop (reference
+    tensor_filter.c:609,1454-1485); tensor_rate adapts its target rate."""
+
+    def __init__(self, timestamp: Optional[int], jitter_ns: int,
+                 proportion: float = 1.0):
+        self.timestamp = timestamp
+        self.jitter_ns = jitter_ns
+        self.proportion = proportion
+
+    def __repr__(self):
+        return (f"QoSEvent(ts={self.timestamp} jitter={self.jitter_ns} "
+                f"proportion={self.proportion:.2f})")
+
+
 class PadDirection(enum.Enum):
     SRC = "src"
     SINK = "sink"
@@ -309,6 +328,12 @@ class Element:
         template (transform elements accept their template regardless of what
         they output).  Passthrough elements should forward downstream."""
         return sink_pad.template
+
+    def report_latency(self) -> int:
+        """This element's contribution to a pipeline LATENCY query, in ns
+        (reference: tensor_filter injects its rolling invoke latency when
+        latency-report=1, tensor_filter.c:1313-1377).  Default: 0."""
+        return 0
 
     # -- helpers -------------------------------------------------------------
     def announce_src_caps(self, caps: Caps, pad: Optional[Pad] = None) -> None:

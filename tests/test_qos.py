@@ -1,0 +1,160 @@
+"""QoS feedback loop + LATENCY aggregation.
+
+Models the reference behavior of tensor_filter.c:609 (throttle-drop on QoS
+delay), :1454-1485 (QoS src_event → throttling delay) and :1313-1377
+(invoke latency injected into the pipeline LATENCY query).
+"""
+
+import time
+
+import numpy as np
+
+from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+from nnstreamer_tpu.pipeline.element import QoSEvent
+from nnstreamer_tpu.elements import TensorFilter, TensorRate, TensorSink
+
+
+def tcaps(dims="3:8:8", types="uint8", rate="200/1"):
+    return (f"other/tensors,format=static,num_tensors=1,dimensions={dims},"
+            f"types={types},framerate={rate}")
+
+
+def make_pipeline(slow_cb_ns=0, qos=True):
+    p = Pipeline()
+    src = AppSrc("src", caps=tcaps())
+    filt = TensorFilter("f", framework="dummy",
+                        **{"input-dim": "3:8:8", "input-type": "uint8",
+                           "output-dim": "3:8:8", "output-type": "uint8"})
+    sink = TensorSink("out", qos=qos)
+    if slow_cb_ns:
+        sink.connect("new-data",
+                     lambda buf: time.sleep(slow_cb_ns / 1e9))
+    p.add(src, filt, sink)
+    p.link(src, filt, sink)
+    return p, src, filt, sink
+
+
+class TestQoSThrottle:
+    def test_slow_sink_triggers_frame_drops(self):
+        """A consumer 4x slower than the stream rate must cause the filter
+        to throttle-drop; every frame still flowing, none lost silently."""
+        dur = 5_000_000                      # 5 ms frames (200 fps)
+        p, src, filt, sink = make_pipeline(slow_cb_ns=4 * dur)
+        frame = np.zeros((8, 8, 3), np.uint8)
+        for i in range(30):
+            from nnstreamer_tpu.tensor import TensorBuffer
+
+            src.push_buffer(TensorBuffer(tensors=[frame], pts=i * dur,
+                                         duration=dur))
+        src.end_of_stream()
+        p.run(timeout=30)
+        assert filt.dropped > 0
+        assert len(sink.results) + filt.dropped == 30
+        # QoS auto-enabled latency accounting (reference :1454-1476)
+        assert filt.latency_report
+
+    def test_transient_stall_recovers(self):
+        """One slow stretch must not throttle the stream forever: the sink
+        emits a catch-up QoS event once it's fast again and the filter
+        clears its throttle."""
+        dur = 5_000_000
+        p = Pipeline()
+        src = AppSrc("src", caps=tcaps())
+        filt = TensorFilter("f", framework="dummy",
+                            **{"input-dim": "3:8:8", "input-type": "uint8",
+                               "output-dim": "3:8:8",
+                               "output-type": "uint8"})
+        sink = TensorSink("out", qos=True)
+        seen = []
+
+        def cb(buf):
+            seen.append(buf.pts)
+            if len(seen) <= 3:
+                time.sleep(4 * dur / 1e9)   # slow start, then fast
+
+        sink.connect("new-data", cb)
+        p.add(src, filt, sink)
+        p.link(src, filt, sink)
+        from nnstreamer_tpu.tensor import TensorBuffer
+
+        frame = np.zeros((8, 8, 3), np.uint8)
+        for i in range(40):
+            src.push_buffer(TensorBuffer(tensors=[frame], pts=i * dur,
+                                         duration=dur))
+        src.end_of_stream()
+        p.run(timeout=30)
+        assert filt.dropped > 0                  # stall caused drops
+        assert filt._throttle_ns == 0            # ...but throttle cleared
+        # after recovery the tail of the stream flows undropped
+        assert len(sink.results) >= 40 - filt.dropped
+
+    def test_no_qos_no_drops(self):
+        dur = 5_000_000
+        p, src, filt, sink = make_pipeline(slow_cb_ns=4 * dur, qos=False)
+        frame = np.zeros((8, 8, 3), np.uint8)
+        from nnstreamer_tpu.tensor import TensorBuffer
+
+        for i in range(10):
+            src.push_buffer(TensorBuffer(tensors=[frame], pts=i * dur,
+                                         duration=dur))
+        src.end_of_stream()
+        p.run(timeout=30)
+        assert filt.dropped == 0
+        assert len(sink.results) == 10
+
+    def test_catchup_clears_throttle(self):
+        p, src, filt, sink = make_pipeline()
+        filt.start()
+        filt._in_config = None
+        filt.on_upstream_event(
+            filt.src_pad, QoSEvent(timestamp=0, jitter_ns=10_000_000,
+                                   proportion=2.0))
+        assert filt._throttle_ns > 0
+        filt.on_upstream_event(
+            filt.src_pad, QoSEvent(timestamp=0, jitter_ns=-1))
+        assert filt._throttle_ns == 0
+        filt.stop()
+
+
+class TestLatencyQuery:
+    def test_pipeline_latency_sums_filter_invoke(self):
+        dur = 5_000_000
+        p, src, filt, sink = make_pipeline(qos=False)
+        filt.set_property("latency-report", True)
+        frame = np.zeros((8, 8, 3), np.uint8)
+        from nnstreamer_tpu.tensor import TensorBuffer
+
+        for i in range(5):
+            src.push_buffer(TensorBuffer(tensors=[frame], pts=i * dur,
+                                         duration=dur))
+        src.end_of_stream()
+        p.run(timeout=30)
+        total, per = p.query_latency()
+        assert total > 0
+        assert "f" in per and per["f"] == total
+
+    def test_latency_zero_without_report(self):
+        p, src, filt, sink = make_pipeline(qos=False)
+        from nnstreamer_tpu.tensor import TensorBuffer
+
+        src.push_buffer(TensorBuffer(
+            tensors=[np.zeros((8, 8, 3), np.uint8)], pts=0))
+        src.end_of_stream()
+        p.run(timeout=30)
+        total, per = p.query_latency()
+        assert total == 0 and per == {}
+
+
+class TestRateAdaptation:
+    def test_qos_lowers_effective_rate(self):
+        r = TensorRate("r", framerate="100/1")
+        r.start()
+        from fractions import Fraction
+
+        assert r.effective_rate == Fraction(100, 1)
+        r.on_upstream_event(r.src_pad, QoSEvent(timestamp=0,
+                                                jitter_ns=1_000_000,
+                                                proportion=2.0))
+        assert r.effective_rate == Fraction(50, 1)
+        r.on_upstream_event(r.src_pad, QoSEvent(timestamp=0, jitter_ns=0))
+        assert r.effective_rate == Fraction(100, 1)
