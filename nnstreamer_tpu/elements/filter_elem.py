@@ -172,6 +172,11 @@ class TensorFilter(Element):
                 if rate and rate > 0:
                     frame_ns = (1_000_000_000 * rate.denominator
                                 // rate.numerator)
+                elif event.proportion > 1.0:
+                    # jitter = dur·(proportion-1) at the reporter, so the
+                    # frame duration is recoverable even without caps rate
+                    frame_ns = max(
+                        int(event.jitter_ns / (event.proportion - 1.0)), 1)
                 else:
                     frame_ns = max(event.jitter_ns, 1)
                 self._throttle_ns = int(frame_ns * max(1.0,

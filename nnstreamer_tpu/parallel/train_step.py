@@ -9,9 +9,9 @@ target of the driver's multi-chip dryrun:
 - **sp**: sequence sharded, attention runs as ring attention (exact) with
   K/V rotating on ICI
 - **tp**: attention heads + MLP hidden megatron-sharded, activations psum'd
-- **ep**: MoE experts sharded (dense-gated MoE: every ep shard computes its
-  experts' gated contribution, combined by psum — switch-style token
-  routing is a later round)
+- **ep**: MoE experts sharded, switch-style top-1 ROUTED: each token runs
+  exactly one expert (capacity-capped), with the Switch load-balance aux
+  loss — compute scales with tokens, not with experts
 
 Everything is a single ``jax.shard_map``-ped, jitted step: params enter
 device-resident with per-leaf PartitionSpecs, the step never leaves the
@@ -42,6 +42,8 @@ class StreamFormerConfig:
     mlp: int = 512
     layers: int = 2
     experts: int = 2          # MoE experts (sharded over ep)
+    capacity_factor: float = 1.25  # per-expert token capacity multiplier
+    aux_coef: float = 0.01    # Switch load-balance aux loss weight
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16
     lr: float = 1e-3
@@ -100,6 +102,59 @@ def init_params(cfg: StreamFormerConfig, seed: int = 0) -> Dict[str, Any]:
     return params
 
 
+def _moe_switch(y, lyr, cfg: StreamFormerConfig):
+    """Switch-Transformer top-1 routed MoE over the ep axis.
+
+    Activations are replicated over ep (data rides dp/sp), so routing needs
+    NO all-to-all: every ep shard sees all local tokens, gathers only those
+    routed to ITS experts into a dense (E_local, capacity, D) block — a
+    static-shaped, MXU-friendly batched matmul — and the psum over ep
+    scatters expert outputs back to the token stream.  Tokens over an
+    expert's capacity are dropped (residual passes them through), the
+    standard Switch capacity-factor contract.
+
+    Returns (moe_out (B,T,D), aux) where aux is the Switch load-balance
+    loss E * Σ_e f_e·P_e computed over the GLOBAL (dp,sp) token set.
+    """
+    b, t, d = y.shape
+    n = b * t
+    e = cfg.experts
+    tokens = y.reshape(n, d)
+    logits = jnp.einsum("nd,de->ne", tokens.astype(jnp.float32),
+                        lyr["gate"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)          # (N, E) f32
+    exp_idx = jnp.argmax(probs, axis=-1)             # (N,)
+    gate_val = jnp.max(probs, axis=-1)               # (N,)
+    onehot = jax.nn.one_hot(exp_idx, e, dtype=jnp.float32)
+    cap = max(1, int(np.ceil(n / e * cfg.capacity_factor)))  # static
+    pos = jnp.cumsum(onehot, axis=0) * onehot        # 1-based slot / expert
+    disp = onehot * (pos <= cap)                     # capacity-capped
+    pos0 = jnp.clip(pos - 1, 0, cap - 1).astype(jnp.int32)
+    e_local = lyr["we1"].shape[0]
+    ep_idx = jax.lax.axis_index("ep")
+    disp_l = jax.lax.dynamic_slice_in_dim(disp, ep_idx * e_local,
+                                          e_local, axis=1)
+    pos_l = jax.lax.dynamic_slice_in_dim(pos0, ep_idx * e_local,
+                                         e_local, axis=1)
+    # (N, E_local, C): token→(expert, capacity-slot) dispatch tensor
+    slot = (jax.nn.one_hot(pos_l, cap, dtype=cfg.dtype)
+            * disp_l[..., None].astype(cfg.dtype))
+    xe = jnp.einsum("nec,nd->ecd", slot, tokens.astype(cfg.dtype))
+    he = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe,
+                                lyr["we1"].astype(cfg.dtype)))
+    oe = jnp.einsum("ecf,efd->ecd", he, lyr["we2"].astype(cfg.dtype))
+    out = (jnp.einsum("nec,ecd->nd", slot, oe)
+           * gate_val.astype(cfg.dtype)[:, None])
+    out = jax.lax.psum(out, "ep")
+    # load-balance aux (Switch eq. 4): fraction routed × mean router prob,
+    # over the global token set so every device agrees on the value
+    f_sum = jax.lax.psum(jnp.sum(onehot, axis=0), ("dp", "sp"))
+    p_sum = jax.lax.psum(jnp.sum(probs, axis=0), ("dp", "sp"))
+    n_tot = jax.lax.psum(jnp.float32(n), ("dp", "sp"))
+    aux = e * jnp.sum((f_sum / n_tot) * (p_sum / n_tot))
+    return out.reshape(b, t, d), aux
+
+
 def _ln(x, scale):
     mu = jnp.mean(x, -1, keepdims=True)
     var = jnp.var(x, -1, keepdims=True)
@@ -118,6 +173,7 @@ def _forward_local(params, tokens, cfg: StreamFormerConfig):
     pos = sp_idx * t + jnp.arange(t)
     x = params["embed"][tokens] + params["pos"][pos][None]
     x = x.astype(cfg.dtype)
+    aux = jnp.float32(0)
     for lyr in params["layers"]:
         # -- attention (tp shards heads, sp ring over sequence) -------------
         y = _ln(x.astype(jnp.float32), lyr["ln1"]).astype(cfg.dtype)
@@ -136,34 +192,23 @@ def _forward_local(params, tokens, cfg: StreamFormerConfig):
                                        lyr["w1"].astype(cfg.dtype)))
         m = jnp.einsum("btf,fd->btd", hcore, lyr["w2"].astype(cfg.dtype))
         m = jax.lax.psum(m, "tp")
-        # -- MoE (dense-gated, experts sharded over ep) --------------------
-        gates = jax.nn.softmax(
-            jnp.einsum("btd,de->bte", y, lyr["gate"].astype(cfg.dtype))
-            .astype(jnp.float32), axis=-1)
-        e_local = lyr["we1"].shape[0]
-        ep_idx = jax.lax.axis_index("ep")
-        gsel = jax.lax.dynamic_slice_in_dim(
-            gates, ep_idx * e_local, e_local, axis=2)
-        hexp = jax.nn.gelu(jnp.einsum("btd,edf->btef", y,
-                                      lyr["we1"].astype(cfg.dtype)))
-        moe = jnp.einsum("btef,efd,bte->btd", hexp,
-                         lyr["we2"].astype(cfg.dtype),
-                         gsel.astype(cfg.dtype))
-        moe = jax.lax.psum(moe, "ep")
+        # -- MoE (switch-routed top-1, experts sharded over ep) ------------
+        moe, aux_l = _moe_switch(y, lyr, cfg)
+        aux = aux + aux_l
         x = x + m + moe
     x = _ln(x.astype(jnp.float32), params["ln_f"])
     logits = jnp.einsum("btd,dv->btv", x, params["head"])
-    return logits  # f32 (B_local, T_local, V)
+    return logits, aux / max(1, len(params["layers"]))
 
 
 def _loss_local(params, tokens, labels, cfg):
-    logits = _forward_local(params, tokens, cfg)
+    logits, aux = _forward_local(params, tokens, cfg)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
     # global mean over (dp, sp)-sharded tokens
     s = jax.lax.psum(jnp.sum(nll), ("dp", "sp"))
     n = jax.lax.psum(nll.size, ("dp", "sp"))
-    return s / n
+    return s / n + cfg.aux_coef * aux
 
 
 def make_train_step(mesh: Mesh, cfg: Optional[StreamFormerConfig] = None,

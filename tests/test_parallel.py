@@ -93,3 +93,108 @@ class TestTrainStep:
                                  jax.device_put(tokens, sh),
                                  jax.device_put(labels, sh))
         assert np.isfinite(float(loss))
+
+    def test_routed_moe_loss_decreases_with_ep2(self, jax_cpu_devices):
+        """VERDICT round-2 criterion: routed-MoE loss decreases over steps
+        on the 8-CPU mesh with the ep axis actually sharded (ep=2)."""
+        mesh = make_mesh(8, axis_sizes={"dp": 1, "sp": 2, "tp": 2, "ep": 2})
+        cfg = StreamFormerConfig(vocab=64, dim=32, heads=4, head_dim=8,
+                                 mlp=64, layers=1, experts=4, max_seq=64,
+                                 lr=3e-3)
+        step, params, opt, _ = make_train_step(mesh, cfg)
+        rng = np.random.default_rng(2)
+        tokens = rng.integers(0, 64, (2, 32)).astype(np.int32)
+        labels = np.roll(tokens, -1, axis=1).astype(np.int32)
+        sh = make_data_sharding(mesh)
+        tokens = jax.device_put(tokens, sh)
+        labels = jax.device_put(labels, sh)
+        losses = []
+        for _ in range(6):
+            params, opt, loss = step(params, opt, tokens, labels)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_ep2_matches_ep1(self, jax_cpu_devices):
+        """Expert parallelism is an implementation detail: the same model on
+        an ep=2 mesh must produce (numerically close to) the ep=1 loss."""
+        cfg = StreamFormerConfig(vocab=32, dim=16, heads=2, head_dim=8,
+                                 mlp=32, layers=1, experts=2, max_seq=32)
+        rng = np.random.default_rng(3)
+        tokens = rng.integers(0, 32, (2, 16)).astype(np.int32)
+        labels = np.roll(tokens, -1, 1).astype(np.int32)
+        losses = {}
+        for ep in (1, 2):
+            mesh = make_mesh(4, axis_sizes={"dp": 2, "sp": 1,
+                                            "tp": 2 // ep, "ep": ep})
+            step, params, opt, _ = make_train_step(mesh, cfg)
+            sh = make_data_sharding(mesh)
+            _, _, loss = step(params, opt, jax.device_put(tokens, sh),
+                              jax.device_put(labels, sh))
+            losses[ep] = float(loss)
+        assert abs(losses[1] - losses[2]) < 5e-2, losses
+
+    def test_switch_aux_loss_balanced_vs_skewed(self, jax_cpu_devices):
+        """The load-balance aux is ~1 for a uniform router and grows when
+        routing collapses onto one expert (Switch Transformer eq. 4)."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.parallel.train_step import (StreamFormerConfig,
+                                                        _moe_switch)
+
+        cfg = StreamFormerConfig(dim=8, experts=4, capacity_factor=2.0)
+        n, d, e = 64, 8, 4
+        rng = np.random.default_rng(0)
+        y = rng.standard_normal((2, n, d)).astype(np.float32)
+
+        def run(gate, yy=None):
+            lyr = {"gate": jnp.asarray(gate, jnp.float32),
+                   "we1": jnp.asarray(
+                       rng.standard_normal((e, d, 16)), jnp.float32) * 0.02,
+                   "we2": jnp.asarray(
+                       rng.standard_normal((e, 16, d)), jnp.float32) * 0.02}
+            fn = jax.shard_map(
+                lambda a: _moe_switch(a, lyr, cfg)[1],
+                mesh=make_mesh(8, axis_sizes={"dp": 2, "sp": 2, "tp": 2,
+                                              "ep": 1}),
+                in_specs=jax.sharding.PartitionSpec("dp", "sp"),
+                out_specs=jax.sharding.PartitionSpec(),
+                check_vma=False)
+            return float(fn(y if yy is None else yy))
+
+        aux_uniform = run(np.zeros((d, e)))          # uniform router
+        skew = np.zeros((d, e))
+        skew[:, 0] = 100.0                           # everything → expert 0
+        aux_skewed = run(skew, np.abs(y))            # positive features
+        assert abs(aux_uniform - 1.0) < 0.35, aux_uniform
+        assert aux_skewed > 2.0, aux_skewed
+
+    def test_capacity_drops_overflow_tokens(self, jax_cpu_devices):
+        """Tokens past an expert's capacity get ZERO MoE output (residual
+        carries them), never garbage."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.parallel.train_step import (StreamFormerConfig,
+                                                        _moe_switch)
+
+        cfg = StreamFormerConfig(dim=4, experts=2, capacity_factor=0.25,
+                                 dtype=jnp.float32)
+        n, d, e = 16, 4, 2
+        rng = np.random.default_rng(0)
+        y = np.abs(rng.standard_normal((1, n, d))).astype(np.float32)
+        skew = np.zeros((d, e))
+        skew[:, 0] = 100.0                           # all → expert 0
+        lyr = {"gate": jnp.asarray(skew, jnp.float32),
+               "we1": jnp.ones((e, d, 8), jnp.float32),
+               "we2": jnp.ones((e, 8, d), jnp.float32)}
+        fn = jax.shard_map(
+            lambda yy: _moe_switch(yy, lyr, cfg)[0],
+            mesh=make_mesh(8, axis_sizes={"dp": 1, "sp": 1, "tp": 1,
+                                          "ep": 1},
+                           devices=jax.devices()[:1]),
+            in_specs=jax.sharding.PartitionSpec("dp", "sp"),
+            out_specs=jax.sharding.PartitionSpec("dp", "sp"),
+            check_vma=False)
+        out = np.asarray(fn(y))[0]
+        # capacity = ceil(16/2*0.25) = 2 → exactly 2 tokens served
+        served = np.count_nonzero(np.abs(out).sum(-1) > 1e-9)
+        assert served == 2, served

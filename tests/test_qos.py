@@ -85,8 +85,10 @@ class TestQoSThrottle:
         p.run(timeout=30)
         assert filt.dropped > 0                  # stall caused drops
         assert filt._throttle_ns == 0            # ...but throttle cleared
-        # after recovery the tail of the stream flows undropped
-        assert len(sink.results) >= 40 - filt.dropped
+        # after recovery the TAIL flows undropped: the last 8 frames all
+        # reach the sink consecutively
+        tail = [b.pts for b in sink.results][-8:]
+        assert tail == [i * dur for i in range(32, 40)], tail
 
     def test_no_qos_no_drops(self):
         dur = 5_000_000
