@@ -43,7 +43,11 @@ sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
 import numpy as np  # noqa: E402
 
-N_FRAMES = int(os.environ.get("NNS_TPU_BENCH_FRAMES", "150"))
+#: streaming micro-batch for tensor_filter (1 = per-frame dispatch);
+#: coalesces frames into one device invoke, double-buffered (round-3 path)
+STREAM_BATCH = int(os.environ.get("NNS_TPU_BENCH_BATCH", "32"))
+N_FRAMES = int(os.environ.get("NNS_TPU_BENCH_FRAMES",
+                              "1920" if STREAM_BATCH > 1 else "150"))
 BASELINE_FPS = 30.0  # north-star target (BASELINE.json)
 BATCH = 64           # vmap-batched invoke mode
 # bf16 peak of one TPU v5e chip, for MFU; other platforms: no MFU claim.
@@ -79,7 +83,9 @@ def _measure(pipeline, sink_name: str, timeout: float = 1200,
     n = len(stamps)
     if n < 2:
         raise SystemExit("benchmark produced no frames")
-    skip = min(10, n // 5)           # skip pipeline ramp
+    # skip pipeline ramp: with micro-batching the first couple of batches
+    # carry the double-buffer fill, so skip at least two batches' worth
+    skip = min(max(10, 2 * STREAM_BATCH), n // 3)
     span = stamps[-1] - stamps[skip]
     return ((n - 1 - skip) / span if span > 0 else 0.0), n
 
@@ -94,11 +100,10 @@ def _model_pipeline(model: str, size: int, decoder: str, dtype_prop: str,
         "framerate=120/1 ! "
         "tensor_converter ! "
         f"tensor_filter framework=xla model={model}"
-        f" custom=seed:0{dtype_prop} name=f ! "
-        # queue = thread boundary: the decoder's host fetch of frame N
-        # overlaps the dispatch + async d2h copy of frames N+1..N+8, so
-        # device-transfer RTT is paid once, not per frame
-        "queue max-size-buffers=8 ! "
+        f" custom=seed:0{dtype_prop} batch={STREAM_BATCH} name=f ! "
+        # queue = thread boundary: decoding a pushed batch overlaps the
+        # dispatch + async d2h of the next batch (double-buffered filter)
+        f"queue max-size-buffers={max(8, 2 * STREAM_BATCH)} ! "
         f"tensor_decoder mode={decoder} {decoder_opts} ! "
         "tensor_sink name=out")
 
@@ -166,12 +171,31 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
                 emit=None) -> dict:
     p = _model_pipeline(model_name, size, decoder, dtype_prop, decoder_opts)
     try:
-        fps, n = _measure(p, "out")
+        fps1, n = _measure(p, "out")
+    finally:
+        p.stop()
+    if emit is not None:
+        # provisional line: a deadline kill during the stability pass must
+        # not lose run 1's measured number (_parse_result takes the LAST
+        # parsed line, so the enriched line below supersedes this one)
+        emit({"metric": name, "value": round(fps1, 2), "unit": "fps",
+              "vs_baseline": round(fps1 / BASELINE_FPS, 3),
+              "fps_run1": round(fps1, 2), "frames": n,
+              "stream_batch": STREAM_BATCH, "note": "run1-only"})
+    # stability pass: a second full pipeline run (fresh elements, warm
+    # XLA compile cache) — round-2's number swung 1.9x between runs, so
+    # both runs are recorded and the SLOWER one is the headline value
+    p = _model_pipeline(model_name, size, decoder, dtype_prop, decoder_opts)
+    try:
+        fps2, _ = _measure(p, "out")
+        fps = min(fps1, fps2)
         fw = p.get("f").fw
         p50 = _invoke_p50(fw, size)
         out = {"metric": name, "value": round(fps, 2), "unit": "fps",
                "vs_baseline": round(fps / BASELINE_FPS, 3),
-               "p50_invoke_ms": round(p50, 3), "frames": n}
+               "fps_run1": round(fps1, 2), "fps_run2": round(fps2, 2),
+               "p50_invoke_ms": round(p50, 3), "frames": n,
+               "stream_batch": STREAM_BATCH}
         if emit is not None:
             # flush the core number NOW: the optional extras below re-jit
             # (cost analysis, vmap batch) and could blow the parent's
@@ -215,8 +239,8 @@ def bench_edge(dtype_prop: str) -> dict:
             f"edge_src port={broker.port} topic=bench "
             f"num-buffers={N_FRAMES} ! "
             "tensor_filter framework=xla model=mobilenet_v2"
-            f" custom=seed:0{dtype_prop} name=f ! "
-            "queue max-size-buffers=8 ! "
+            f" custom=seed:0{dtype_prop} batch={STREAM_BATCH} name=f ! "
+            f"queue max-size-buffers={max(8, 2 * STREAM_BATCH)} ! "
             "tensor_decoder mode=image_labeling ! tensor_sink name=out")
         send = parse_launch(
             f"videotestsrc num-buffers={N_FRAMES} pattern=random ! "
@@ -259,6 +283,11 @@ def run_child(config: str) -> dict:
     device = jax.devices()[0]
     on_tpu = device.platform != "cpu"
     dtype_prop = "" if on_tpu else ",dtype:float32"
+    if not on_tpu and "NNS_TPU_BENCH_FRAMES" not in os.environ:
+        # host-CPU convs are ~100x slower; keep the smoke run inside the
+        # deadline (the TPU frame count stays the measured default)
+        global N_FRAMES
+        N_FRAMES = 200
 
     def emit(core: dict) -> None:
         print(json.dumps(dict(core, device=str(device))), flush=True)

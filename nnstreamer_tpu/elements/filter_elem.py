@@ -55,6 +55,8 @@ class TensorFilter(Element):
         "shared-tensor-filter-key": (None, "share backend across instances"),
         "is-updatable": (False, "allow model-update events"),
         "latency-report": (False, "report invoke latency"),
+        "batch": (1, "micro-batch N frames into one device invoke "
+                     "(latency/throughput trade; backend-gated)"),
     }
 
     def _make_pads(self):
@@ -88,6 +90,17 @@ class TensorFilter(Element):
             ins, _, outs = str(self.output_combination).partition("/")
             self._out_comb = (_parse_combination(ins) or [],
                               _parse_combination(outs) or [])
+        # micro-batching state (double-buffered: one batch collecting, one
+        # dispatched-in-flight — see FilterFramework.invoke_batched)
+        self._batch = max(1, int(self.batch or 1))
+        if self._batch > 1 and not getattr(self.fw, "SUPPORTS_BATCHING",
+                                           False):
+            self._batch = 1
+        self._pending: list = []        # per-frame input lists, collecting
+        self._pending_bufs: list = []
+        self._inflight = None           # (bufs, handle) dispatched batch
+        if self._batch > 1:
+            self.fw.warmup_batched(self._batch)
 
     def stop(self):
         close_backend(getattr(self, "fw", None), self._props)
@@ -97,6 +110,7 @@ class TensorFilter(Element):
     def set_caps(self, pad, caps):
         from ..tensor.caps_util import config_from_caps
 
+        self._drain_batches()   # renegotiation must not reorder frames
         in_cfg = config_from_caps(caps)
         model_in, model_out = self.fw.get_model_info()
         expect = model_in
@@ -149,13 +163,54 @@ class TensorFilter(Element):
         tensors = buf.tensors
         if self._in_comb is not None:
             tensors = [tensors[i] for i in self._in_comb]
+        if self._batch > 1:
+            self._pending.append(list(tensors))
+            self._pending_bufs.append(buf)
+            if len(self._pending) >= self._batch:
+                return self._dispatch_pending()
+            return FlowReturn.OK
         outs = fw.invoke(list(tensors))
+        return self._push_result(buf, outs)
+
+    def _push_result(self, buf: TensorBuffer, outs) -> FlowReturn:
         out_tensors = outs
         if self._out_comb is not None:
             ins, sel = self._out_comb
             out_tensors = [buf.tensors[i] for i in ins] + \
                           [outs[i] for i in sel]
         return self.push(buf.with_tensors(out_tensors))
+
+    # -- micro-batching ------------------------------------------------------
+    def _dispatch_pending(self) -> FlowReturn:
+        """Dispatch the collecting batch, then push the PREVIOUS batch's
+        results (its d2h copies overlapped this batch's collection)."""
+        handle = self.fw.invoke_batched(self._pending, self._batch)
+        prev, self._inflight = self._inflight, (self._pending_bufs, handle)
+        self._pending, self._pending_bufs = [], []
+        if prev is not None:
+            return self._push_inflight(prev)
+        return FlowReturn.OK
+
+    def _push_inflight(self, inflight) -> FlowReturn:
+        bufs, handle = inflight
+        ret = FlowReturn.OK
+        for buf, outs in zip(bufs, handle.wait()):
+            r = self._push_result(buf, list(outs))
+            if r is FlowReturn.ERROR:
+                return r
+            ret = r
+        return ret
+
+    def _drain_batches(self) -> None:
+        """Flush the collecting partial batch and the in-flight batch, in
+        stream order (EOS, renegotiation, model swap)."""
+        if self._batch <= 1:
+            return
+        if self._pending:
+            self._dispatch_pending()
+        if self._inflight is not None:
+            inflight, self._inflight = self._inflight, None
+            self._push_inflight(inflight)
 
     # -- events --------------------------------------------------------------
     def on_upstream_event(self, pad, event):
@@ -195,6 +250,7 @@ class TensorFilter(Element):
             # dispatch on actual tensor shapes.
             fn = event.data["fn"]
             out_info = event.data["out_info"]
+            self._drain_batches()  # old executable's frames go out first
             if self._out_comb is not None:
                 # output-combination re-indexes/mixes the model outputs
                 # AFTER invoke; a reduction computed against the combined
@@ -211,10 +267,15 @@ class TensorFilter(Element):
         return super().on_upstream_event(pad, event)
 
     def on_event(self, pad, event):
+        from ..pipeline.element import EOSEvent
+
+        if isinstance(event, EOSEvent):
+            self._drain_batches()
         if isinstance(event, CustomEvent) and \
                 event.name == "tensor_filter_update_model":
             if not self.is_updatable:
                 raise RuntimeError(f"{self.name}: not is-updatable")
+            self._drain_batches()  # frames of the old model flush first
             self.fw.handle_event("reload_model", event.data)
             return  # consumed, like the reference custom-event sink
         super().on_event(pad, event)

@@ -1,0 +1,168 @@
+"""Shared jit-execution engine for device backends.
+
+Any backend whose model is (pure jittable ``forward(params, *inputs)``,
+params pytree) gets the identical hot-path discipline the XLA backend
+pioneered — params resident in HBM, one compiled executable, async
+dispatch, micro-batched invoke via vmap — by mixing this in and calling
+:meth:`_setup_exec` at open.  Used by the xla, tensorflow-lite,
+tensorflow, and pytorch backends; the TPU analogue of the reference
+sharing ``tensor_filter_common`` invoke plumbing across subplugins.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List
+
+import numpy as np
+
+from ..framework import Accelerator, FilterError, start_output_transfers
+
+
+class BatchHandle:
+    """An in-flight batched invoke: batched device outputs + frame count.
+
+    ``wait()`` materializes each batched output on host ONCE (the async
+    copies were started at dispatch) and hands back zero-copy numpy views
+    per frame.
+    """
+
+    def __init__(self, outs, n: int) -> None:
+        self._outs = outs
+        self._n = n
+
+    def wait(self) -> List[List[np.ndarray]]:
+        mats = [np.asarray(o) for o in self._outs]
+        return [[m[i] for m in mats] for i in range(self._n)]
+
+
+class CastingHandle:
+    """Wraps a :class:`BatchHandle`, applying per-output host dtype casts
+    at wait() (declared-int64 outputs come back int32 when jax x64 is
+    off)."""
+
+    def __init__(self, inner: BatchHandle, casts) -> None:
+        self._inner = inner
+        self._casts = casts
+
+    def wait(self) -> List[List[np.ndarray]]:
+        return [[o if c is None else np.asarray(o).astype(c)
+                 for o, c in zip(frame, self._casts)]
+                for frame in self._inner.wait()]
+
+
+class JitExecMixin:
+    """Execution engine over ``self._forward_fn`` / ``self._params_dev`` /
+    ``self._device`` (set by :meth:`_setup_exec`)."""
+
+    SUPPORTS_BATCHING = True
+
+    def _setup_exec(self, forward_fn, params, device, warmup_inputs=None):
+        """Compile + stage: params → HBM, jit the forward, optional warm-up
+        invoke so frame 1 is steady state.  Returns the warm-up outputs
+        (callers probe output meta from them — no second device trip)."""
+        import jax
+
+        self._device = device
+        self._forward_fn = forward_fn
+        self._params_dev = jax.device_put(params, device)
+        self._jitted = jax.jit(forward_fn)
+        self._vjit = None
+        if warmup_inputs is None:
+            return None
+        outs = self._invoke_device(warmup_inputs)
+        jax.block_until_ready(outs)
+        return outs
+
+    def _teardown_exec(self) -> None:
+        self._jitted = None
+        self._vjit = None
+        self._forward_fn = None
+        self._params_dev = None
+
+    @staticmethod
+    def _pick_device(accelerators):
+        import jax
+
+        want = accelerators[0] if accelerators else Accelerator.AUTO
+        if want is Accelerator.CPU:
+            return jax.devices("cpu")[0]
+        if want is Accelerator.TPU:
+            tpus = [d for d in jax.devices() if d.platform != "cpu"]
+            if not tpus:
+                raise FilterError("accelerator=true:tpu but no TPU device")
+            return tpus[0]
+        # AUTO/DEFAULT: first device (TPU when present)
+        return jax.devices()[0]
+
+    # -- hot path ------------------------------------------------------------
+    def _invoke_device(self, inputs: List[Any]):
+        import jax
+
+        with jax.default_device(self._device):
+            return self._jitted(self._params_dev, *inputs)
+
+    def invoke(self, inputs: List[Any]) -> List[Any]:
+        t0 = time.monotonic_ns()
+        outs = self._invoke_device(inputs)
+        start_output_transfers(outs)
+        self.stats.record(time.monotonic_ns() - t0)
+        return list(outs)
+
+    def invoke_batched(self, frames, bucket: int):
+        """One h2d stage + one dispatch + one d2h stream for up to
+        ``bucket`` frames: the per-dispatch RTT is paid once per batch
+        instead of once per frame.  Short batches are padded by repeating
+        the last frame (sliced away in wait()), so exactly one executable
+        shape ever compiles."""
+        import jax
+
+        n = len(frames)
+        stacked = []
+        for k in range(len(frames[0])):
+            arrs = [np.asarray(f[k]) for f in frames]
+            if n < bucket:
+                arrs = arrs + [arrs[-1]] * (bucket - n)
+            stacked.append(np.stack(arrs))
+        t0 = time.monotonic_ns()
+        outs = self._dispatch_batched(stacked)
+        self.stats.record(time.monotonic_ns() - t0)
+        return BatchHandle(list(outs), n)
+
+    def _dispatch_batched(self, stacked):
+        import jax
+
+        if self._vjit is None:
+            n_in = len(stacked)
+            self._vjit = jax.jit(jax.vmap(self._forward_fn,
+                                          in_axes=(None,) + (0,) * n_in))
+        with jax.default_device(self._device):
+            outs = self._vjit(self._params_dev, *stacked)
+        start_output_transfers(outs)
+        return outs
+
+    def warmup_batched(self, bucket: int) -> None:
+        """Pre-compile the batched executable — outside the statistics
+        (compile time would dominate the last-10 latency average)."""
+        import jax
+
+        in_info, _ = self.get_model_info()
+        zeros = [np.zeros((bucket,) + i.np_shape, i.np_dtype)
+                 for i in in_info]
+        jax.block_until_ready(self._dispatch_batched(zeros))
+
+    def set_postprocess(self, fn) -> bool:
+        """Compose a decoder-pushed reduction into the jitted forward: one
+        fused executable, so the reduced (small) outputs are what get the
+        async d2h copies — the big intermediate never crosses the wire."""
+        import jax
+
+        base_fwd = self._forward_fn
+
+        def fused(params, *xs):
+            return tuple(fn(list(base_fwd(params, *xs))))
+
+        self._forward_fn = fused
+        self._jitted = jax.jit(fused)
+        self._vjit = None  # rebuild the batched executable around the fusion
+        return True

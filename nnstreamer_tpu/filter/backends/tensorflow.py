@@ -40,8 +40,8 @@ from ...tensor.info import TensorInfo, TensorsInfo
 from ...utils.protowire import (fields_dict, first, packed_or_repeated_varints,
                                 repeated, to_signed64)
 from ..framework import (Accelerator, FilterError, FilterFramework,
-                         FilterProperties, FilterStatistics, register_filter,
-                         start_output_transfers)
+                         FilterProperties, FilterStatistics, register_filter)
+from ._jitexec import JitExecMixin
 
 # -- GraphDef schema field numbers (tensorflow/core/framework/*.proto) -------
 
@@ -579,7 +579,7 @@ class TFGraph:
 
 
 @register_filter
-class TensorFlowFilter(FilterFramework):
+class TensorFlowFilter(JitExecMixin, FilterFramework):
     """``framework=tensorflow``: frozen .pb GraphDef compiled to XLA."""
 
     NAME = "tensorflow"
@@ -589,7 +589,6 @@ class TensorFlowFilter(FilterFramework):
         super().__init__()
         self._graph: Optional[TFGraph] = None
         self._jitted = None
-        self._consts_dev = None
         self._in_info: Optional[TensorsInfo] = None
         self._out_info: Optional[TensorsInfo] = None
         self.stats = FilterStatistics()
@@ -651,14 +650,10 @@ class TensorFlowFilter(FilterFramework):
         consts = {n.name: n.const for n in graph.nodes.values()
                   if n.const is not None}
         device = self._pick_device(props.accelerators)
-        self._consts_dev = jax.device_put(consts, device)
-        self._jitted = jax.jit(fn)
         self._graph = graph
 
         zeros = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
-        with jax.default_device(device):
-            outs = self._jitted(self._consts_dev, *zeros)
-        jax.block_until_ready(outs)
+        outs = self._setup_exec(fn, consts, device, warmup_inputs=zeros)
         probed = TensorsInfo([TensorInfo.from_np(np.asarray(o), name=r)
                               for o, r in zip(outs, out_refs)])
         if props.output_info is not None and props.output_info.is_valid():
@@ -670,21 +665,11 @@ class TensorFlowFilter(FilterFramework):
         else:
             self._out_info = probed
         self._in_info = in_info
-        self._device = device
         super().open(props)
-
-    @staticmethod
-    def _pick_device(accelerators):
-        import jax
-
-        if accelerators and accelerators[0] is Accelerator.CPU:
-            return jax.devices("cpu")[0]
-        return jax.devices()[0]
 
     def close(self) -> None:
         self._graph = None
-        self._jitted = None
-        self._consts_dev = None
+        self._teardown_exec()
         super().close()
 
     # -- model meta ----------------------------------------------------------
@@ -692,17 +677,6 @@ class TensorFlowFilter(FilterFramework):
         if self._graph is None:
             raise FilterError("tensorflow: not opened")
         return self._in_info, self._out_info
-
-    # -- hot path ------------------------------------------------------------
-    def invoke(self, inputs: List[Any]) -> List[Any]:
-        import jax
-
-        t0 = time.monotonic_ns()
-        with jax.default_device(self._device):
-            outs = self._jitted(self._consts_dev, *inputs)
-        start_output_transfers(outs)
-        self.stats.record(time.monotonic_ns() - t0)
-        return list(outs)
 
     @classmethod
     def handles_model(cls, model: Any) -> bool:

@@ -34,8 +34,8 @@ from ...tensor.info import TensorInfo, TensorsInfo
 from ...tensor.types import TensorType, np_shape_to_dim
 from ...utils import flatbuf as fb
 from ..framework import (Accelerator, FilterError, FilterFramework,
-                         FilterProperties, FilterStatistics, register_filter,
-                         start_output_transfers)
+                         FilterProperties, FilterStatistics, register_filter)
+from ._jitexec import CastingHandle, JitExecMixin
 
 # -- tflite schema constants (schema.fbs v3) --------------------------------
 
@@ -700,7 +700,7 @@ _OP_HANDLERS: Dict[int, Callable] = {}
 # -- the filter backend -----------------------------------------------------
 
 @register_filter
-class TFLiteFilter(FilterFramework):
+class TFLiteFilter(JitExecMixin, FilterFramework):
     """``framework=tensorflow-lite``: run a ``.tflite`` file via XLA.
 
     Mirrors the reference TFLiteCore open/invoke/getModelInfo lifecycle
@@ -719,13 +719,13 @@ class TFLiteFilter(FilterFramework):
         self._jitted = None
         self._params_dev = None
         self._device = None
+        self._vjit = None
+        self._forward_fn = None
         self._out_casts: List[Optional[Any]] = []
         self.stats = FilterStatistics()
 
     # -- lifecycle -----------------------------------------------------------
     def open(self, props: FilterProperties) -> None:
-        import jax
-
         path = str(props.model)
         if not os.path.isfile(path):
             raise FilterError(f"tflite: model file not found: {path}")
@@ -734,14 +734,13 @@ class TFLiteFilter(FilterFramework):
         with open(path, "rb") as f:
             self._graph = parse_tflite(f.read())
         self._lower = _Lowerer(self._graph)
-        self._device = self._pick_device(props.accelerators)
-        self._params_dev = jax.device_put(self._lower.params, self._device)
-        self._jitted = jax.jit(self._lower.forward)
         # warm-up compile so frame 1 is steady-state (reference builds the
         # interpreter + applies delegates at open)
         in_info, out_info = self.get_model_info()
         zeros = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
-        outs = jax.block_until_ready(self._invoke_device(zeros))
+        outs = self._setup_exec(self._lower.forward, self._lower.params,
+                                self._pick_device(props.accelerators),
+                                warmup_inputs=zeros)
         # declared int64 outputs (e.g. ARG_MAX) come back int32 when jax
         # x64 is off — record per-output host casts so invoke() honors the
         # declared meta downstream relies on
@@ -750,14 +749,9 @@ class TFLiteFilter(FilterFramework):
             for o, oi in zip(outs, out_info)]
         super().open(props)
 
-    @staticmethod
-    def _pick_device(accelerators):
-        from .xla import XLAFilter
-
-        return XLAFilter._pick_device(accelerators)
-
     def close(self) -> None:
-        self._graph = self._lower = self._jitted = self._params_dev = None
+        self._graph = self._lower = None
+        self._teardown_exec()
         super().close()
 
     # -- model meta ----------------------------------------------------------
@@ -774,21 +768,26 @@ class TFLiteFilter(FilterFramework):
                 TensorsInfo([self._spec_info(i) for i in self._graph.outputs]))
 
     # -- hot path ------------------------------------------------------------
-    def _invoke_device(self, inputs: List[Any]):
-        import jax
-
-        with jax.default_device(self._device):
-            return self._jitted(self._params_dev, *inputs)
-
     def invoke(self, inputs: List[Any]) -> List[Any]:
-        t0 = time.monotonic_ns()
-        outs = list(self._invoke_device(inputs))
-        start_output_transfers(outs)
+        outs = JitExecMixin.invoke(self, inputs)
         for i, cast in enumerate(self._out_casts):
             if cast is not None:
                 outs[i] = np.asarray(outs[i]).astype(cast)
-        self.stats.record(time.monotonic_ns() - t0)
         return outs
+
+    def invoke_batched(self, frames, bucket: int):
+        handle = JitExecMixin.invoke_batched(self, frames, bucket)
+        if any(c is not None for c in self._out_casts):
+            return CastingHandle(handle, self._out_casts)
+        return handle
+
+    def set_postprocess(self, fn) -> bool:
+        if not JitExecMixin.set_postprocess(self, fn):
+            return False
+        # the fused reduction defines its own output meta; the model's
+        # per-output casts no longer apply
+        self._out_casts = []
+        return True
 
     @classmethod
     def handles_model(cls, model: Any) -> bool:

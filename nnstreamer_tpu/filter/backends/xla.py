@@ -21,15 +21,14 @@ one-alloc rules (tensor_filter.c:631-894):
 
 from __future__ import annotations
 
-import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
 from ...tensor.info import TensorsInfo
 from ..framework import (Accelerator, FilterError, FilterFramework,
-                         FilterProperties, FilterStatistics, register_filter,
-                         start_output_transfers)
+                         FilterProperties, FilterStatistics, register_filter)
+from ._jitexec import JitExecMixin
 
 
 _cache_enabled = False
@@ -59,16 +58,19 @@ def _enable_compilation_cache() -> None:
 
 
 @register_filter
-class XLAFilter(FilterFramework):
+class XLAFilter(JitExecMixin, FilterFramework):
     """``framework=xla``: serve a registry model via jit-compiled XLA."""
 
     NAME = "xla"
     SUPPORTED_ACCELERATORS = (Accelerator.TPU, Accelerator.CPU)
+    SUPPORTS_BATCHING = True
 
     def __init__(self) -> None:
         super().__init__()
         self._model = None
         self._jitted = None
+        self._vjit = None
+        self._forward_fn = None
         self._params_dev = None
         self._device = None
         self.stats = FilterStatistics()
@@ -96,35 +98,18 @@ class XLAFilter(FilterFramework):
 
             self._model.params = restore_params(self._model.params,
                                                 ckpt_path)
-        self._params_dev = jax.device_put(self._model.params, self._device)
-        self._jitted = jax.jit(self._model.forward)
-        # Warm-up compile so frame 1 is steady-state (the reference's
-        # equivalent is engine build at open, tensor_filter_tensorrt.cc:343).
+        # Warm-up compile at open so frame 1 is steady-state (the
+        # reference's equivalent is engine build at open,
+        # tensor_filter_tensorrt.cc:343).
         zeros = [np.zeros(i.np_shape, i.np_dtype)
                  for i in self._model.in_info]
-        outs = self._invoke_device(zeros)
-        jax.block_until_ready(outs)
+        self._setup_exec(self._model.forward, self._model.params,
+                         self._device, warmup_inputs=zeros)
         super().open(props)
-
-    @staticmethod
-    def _pick_device(accelerators):
-        import jax
-
-        want = accelerators[0] if accelerators else Accelerator.AUTO
-        if want is Accelerator.CPU:
-            return jax.devices("cpu")[0]
-        if want is Accelerator.TPU:
-            tpus = [d for d in jax.devices() if d.platform != "cpu"]
-            if not tpus:
-                raise FilterError("accelerator=true:tpu but no TPU device")
-            return tpus[0]
-        # AUTO/DEFAULT: first device (TPU when present)
-        return jax.devices()[0]
 
     def close(self) -> None:
         self._model = None
-        self._jitted = None
-        self._params_dev = None
+        self._teardown_exec()
         super().close()
 
     # -- model meta ----------------------------------------------------------
@@ -132,34 +117,6 @@ class XLAFilter(FilterFramework):
         if self._model is None:
             raise FilterError("xla: not opened")
         return self._model.in_info, self._model.out_info
-
-    # -- hot path ------------------------------------------------------------
-    def _invoke_device(self, inputs: List[Any]):
-        import jax
-
-        with jax.default_device(self._device):
-            return self._jitted(self._params_dev, *inputs)
-
-    def invoke(self, inputs: List[Any]) -> List[Any]:
-        t0 = time.monotonic_ns()
-        outs = self._invoke_device(inputs)
-        start_output_transfers(outs)
-        self.stats.record(time.monotonic_ns() - t0)
-        return list(outs)
-
-    def set_postprocess(self, fn) -> bool:
-        """Compose a decoder-pushed reduction into the jitted forward: one
-        fused executable, so the reduced (small) outputs are what get the
-        async d2h copies — the big intermediate never crosses the wire."""
-        import jax
-
-        model_fwd = self._model.forward
-
-        def fused(params, *xs):
-            return tuple(fn(list(model_fwd(params, *xs))))
-
-        self._jitted = jax.jit(fused)
-        return True
 
     # -- events --------------------------------------------------------------
     def handle_event(self, name: str, data: Optional[Dict[str, Any]] = None) -> None:

@@ -137,6 +137,9 @@ class FilterFramework:
     NAME: str = ""
     #: hardware this backend can run on, best first
     SUPPORTED_ACCELERATORS: Sequence[Accelerator] = (Accelerator.CPU,)
+    #: True when :meth:`invoke_batched` coalesces frames into one device
+    #: dispatch (tensor_filter's ``batch`` property gates on this)
+    SUPPORTS_BATCHING: bool = False
 
     def __init__(self) -> None:
         self.props: Optional[FilterProperties] = None
@@ -165,6 +168,28 @@ class FilterFramework:
     # -- hot path ------------------------------------------------------------
     def invoke(self, inputs: List[Any]) -> List[Any]:
         raise NotImplementedError
+
+    def invoke_batched(self, frames: List[List[Any]], bucket: int):
+        """Dispatch ONE device invocation covering ``len(frames)`` frames
+        (each a per-frame input list), padded up to the fixed ``bucket``
+        batch size so steady state uses a single compiled executable.
+
+        Returns a handle with ``wait() -> List[List[np.ndarray]]`` (one
+        output list per input frame, padding sliced away).  The dispatch
+        itself must not block on device completion — tensor_filter
+        double-buffers: it only ``wait()``s a batch after the NEXT one has
+        been dispatched, so h2d/compute/d2h of consecutive batches overlap.
+
+        This is the micro-batching answer to the per-frame dispatch RTT
+        that bounds streaming throughput on remote/tunneled devices; the
+        reference's per-buffer hot loop (tensor_filter.c:631-894) has no
+        analogue because its backends are on-host.
+        """
+        raise FilterError(f"{self.NAME}: batched invoke not supported")
+
+    def warmup_batched(self, bucket: int) -> None:
+        """Pre-compile the batched executable for ``bucket`` so frame 1 of
+        the stream is steady state (same role as the open-time warm-up)."""
 
     def set_postprocess(self, fn) -> bool:
         """Fuse a pure reduction ``fn(outputs) -> outputs`` into the

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from ..tensor.info import TensorInfo, TensorsInfo
 from ..tensor.types import TensorType
 from .mobilenet_v2 import _ConvBN, _InvertedResidual, _INVERTED_RESIDUAL_CFG
-from .registry import Model, register_model
+from .registry import Model, host_init, register_model
 
 NUM_SEG_CLASSES = 21  # PASCAL VOC, same as the tflite fixture
 
@@ -56,8 +56,8 @@ def build_deeplab_v3(custom_props: Dict[str, str]) -> Model:
     size = int(custom_props.get("input_size", 257))
     dtype = jnp.dtype(custom_props.get("dtype", "bfloat16"))
     module = _DeepLabV3(dtype=dtype)
-    variables = module.init(jax.random.PRNGKey(seed),
-                            jnp.zeros((size, size, 3), dtype))
+    variables = host_init(lambda: module.init(
+        jax.random.PRNGKey(seed), jnp.zeros((size, size, 3), dtype)))
 
     def forward(variables, frame):
         x = frame.astype(dtype) * (1.0 / 127.5) - 1.0

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from ..tensor.info import TensorInfo, TensorsInfo
 from ..tensor.types import TensorType
-from .registry import Model, register_model
+from .registry import Model, host_init, register_model
 
 # (expansion t, out channels c, repeats n, stride s) — standard V2 config
 _INVERTED_RESIDUAL_CFG: Sequence[Tuple[int, int, int, int]] = (
@@ -108,8 +108,8 @@ def build_mobilenet_v2(custom_props: Dict[str, str]) -> Model:
     # bf16 is MXU-native on TPU; on CPU (tests) f32 avoids emulated-bf16 convs
     dtype = jnp.dtype(custom_props.get("dtype", "bfloat16"))
     module = MobileNetV2(num_classes=num_classes, dtype=dtype)
-    variables = module.init(jax.random.PRNGKey(seed),
-                            jnp.zeros((1, size, size, 3), dtype))
+    variables = host_init(lambda: module.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, size, size, 3), dtype)))
 
     use_pallas = custom_props.get("use_pallas", "0") in ("1", "true")
 

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from ..tensor.info import TensorInfo, TensorsInfo
 from ..tensor.types import TensorType
 from .mobilenet_v2 import _ConvBN, _InvertedResidual, _INVERTED_RESIDUAL_CFG
-from .registry import Model, register_model
+from .registry import Model, host_init, register_model
 
 NUM_KEYPOINTS = 17  # COCO
 
@@ -46,8 +46,8 @@ def build_posenet(custom_props: Dict[str, str]) -> Model:
     size = int(custom_props.get("input_size", 257))
     dtype = jnp.dtype(custom_props.get("dtype", "bfloat16"))
     module = _PoseNet(dtype=dtype)
-    variables = module.init(jax.random.PRNGKey(seed),
-                            jnp.zeros((size, size, 3), dtype))
+    variables = host_init(lambda: module.init(
+        jax.random.PRNGKey(seed), jnp.zeros((size, size, 3), dtype)))
     out_hw = jax.eval_shape(
         lambda v, x: module.apply(v, x), variables,
         jax.ShapeDtypeStruct((size, size, 3), dtype))[0].shape[:2]

@@ -44,6 +44,28 @@ def register_model(name: str):
     return deco
 
 
+def host_init(fn: Callable[[], Any]) -> Any:
+    """Run a model-building computation (flax ``module.init`` etc.) on the
+    host CPU device.
+
+    Eager init on the default accelerator dispatches each of the model's
+    hundreds of parameter/batch-norm ops separately, each paying its own
+    tiny XLA compile plus a device round trip — on a tunneled TPU that is
+    minutes of wall clock before the serving graph's single real compile
+    even starts.  Params are moved to the serving device exactly once, at
+    backend open (filter/backends/xla.py device_put), so nothing is lost by
+    initializing on host.
+    """
+    import jax
+
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:  # cpu platform masked out (e.g. JAX_PLATFORMS=tpu)
+        return fn()
+    with jax.default_device(cpu):
+        return fn()
+
+
 def save_checkpoint(model: Model, path: str) -> None:
     """Persist model params as an orbax checkpoint (the framework's model
     artifact format — the role of the reference's .tflite/.pb model files)."""

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from ..tensor.info import TensorInfo, TensorsInfo
 from ..tensor.types import TensorType
 from .mobilenet_v2 import _ConvBN, _InvertedResidual, _INVERTED_RESIDUAL_CFG
-from .registry import Model, register_model
+from .registry import Model, host_init, register_model
 
 NUM_ANCHORS = 1917
 NUM_CLASSES = 91
@@ -69,8 +69,8 @@ def build_ssd_mobilenet_v2(custom_props: Dict[str, str]) -> Model:
     size = int(custom_props.get("input_size", 300))
     dtype = jnp.dtype(custom_props.get("dtype", "bfloat16"))
     module = _SSDBackboneHeads(dtype=dtype)
-    variables = module.init(jax.random.PRNGKey(seed),
-                            jnp.zeros((size, size, 3), dtype))
+    variables = host_init(lambda: module.init(
+        jax.random.PRNGKey(seed), jnp.zeros((size, size, 3), dtype)))
     # Count actual anchors from a traced run (depends on input size).
     n_anchors = jax.eval_shape(
         lambda v, x: module.apply(v, x), variables,

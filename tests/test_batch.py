@@ -1,0 +1,168 @@
+"""Micro-batched tensor_filter invoke path.
+
+The ``batch`` property coalesces N frames into ONE device dispatch
+(double-buffered, so batch k's d2h overlaps batch k+1's collection) — the
+answer to per-frame dispatch RTT bounding streaming throughput on
+remote/tunneled devices.  The reference's hot loop is strictly
+one-buffer-one-invoke (tensor_filter.c:631-894); this is a TPU-native
+extension, so correctness parity is against the batch=1 path itself:
+identical outputs, order, timestamps, and EOS semantics.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models.registry import _MODELS, Model, register_model
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsInfo
+from nnstreamer_tpu.tensor.types import TensorType
+
+
+@pytest.fixture()
+def tiny_model():
+    import jax.numpy as jnp
+
+    w = np.arange(32, dtype=np.float32).reshape(4, 8)
+
+    def build(custom):
+        def forward(params, x):
+            return (jnp.asarray(x, jnp.float32) @ params,)
+
+        return Model(name="tiny_batch", forward=forward, params=w,
+                     in_info=TensorsInfo([TensorInfo(TensorType.FLOAT32,
+                                                     (4,))]),
+                     out_info=TensorsInfo([TensorInfo(TensorType.FLOAT32,
+                                                      (8,))]))
+
+    register_model("tiny_batch")(build)
+    yield w
+    _MODELS.pop("tiny_batch", None)
+
+
+CAPS = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+        "types=float32,framerate=0/1")
+
+
+def _run(pipeline, feeds, pts=None):
+    got = []
+    pipeline.get("out").connect("new-data", lambda b: got.append(b))
+    pipeline.play()
+    src = pipeline.get("in")
+    for i, arr in enumerate(feeds):
+        ts = pts[i] if pts is not None else None
+        src.push_buffer(TensorBuffer(tensors=[arr], pts=ts))
+    src.end_of_stream()
+    pipeline.wait(timeout=60)
+    pipeline.stop()
+    return got
+
+
+def _feeds(n):
+    rng = np.random.default_rng(7)
+    return [rng.standard_normal(4).astype(np.float32) for _ in range(n)]
+
+
+class TestBatchedInvoke:
+    def _launch(self, batch):
+        from nnstreamer_tpu import parse_launch
+
+        return parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            f"tensor_filter framework=xla model=tiny_batch batch={batch} "
+            "name=f ! tensor_sink name=out")
+
+    @pytest.mark.parametrize("n,batch", [
+        (12, 4),   # exact multiple: 3 full batches
+        (10, 4),   # EOS flush pads the 2-frame remainder
+        (3, 4),    # stream shorter than one batch
+        (7, 16),   # batch larger than whole stream
+    ])
+    def test_matches_unbatched_and_preserves_order(self, tiny_model, n,
+                                                   batch):
+        feeds = _feeds(n)
+        pts = [i * 1000 for i in range(n)]
+        ref = _run(self._launch(1), feeds, pts)
+        got = _run(self._launch(batch), feeds, pts)
+        assert len(got) == len(ref) == n
+        for i, (r, g) in enumerate(zip(ref, got)):
+            assert g.pts == r.pts == i * 1000
+            np.testing.assert_allclose(g.np(0), r.np(0), rtol=1e-5)
+
+    def test_double_buffering_defers_exactly_one_batch(self, tiny_model):
+        """Batch k is pushed only when batch k+1 dispatches (or at EOS)."""
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_batch batch=4 name=f ! "
+            "tensor_sink name=out")
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b))
+        p.play()
+        src = p.get("in")
+        feeds = _feeds(8)
+        for arr in feeds[:4]:
+            src.push_buffer(TensorBuffer(tensors=[arr]))
+        # first full batch dispatched but held in flight — nothing pushed yet
+        import time
+
+        f = p.get("f")
+        deadline = time.monotonic() + 10
+        while f._inflight is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert f._inflight is not None and len(got) == 0
+        for arr in feeds[4:]:
+            src.push_buffer(TensorBuffer(tensors=[arr]))
+        src.end_of_stream()
+        p.wait(timeout=60)
+        p.stop()
+        assert len(got) == 8
+
+    def test_batched_with_output_combination(self, tiny_model):
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_batch batch=4 "
+            "output-combination=0/0 name=f ! tensor_sink name=out")
+        feeds = _feeds(6)
+        got = _run(p, feeds)
+        assert len(got) == 6
+        w = np.arange(32, dtype=np.float32).reshape(4, 8)
+        for f_in, g in zip(feeds, got):
+            assert g.num_tensors == 2
+            np.testing.assert_allclose(g.np(0), f_in, rtol=1e-6)
+            np.testing.assert_allclose(g.np(1), f_in @ w, rtol=1e-5)
+
+    def test_batch_ignored_for_nonbatching_backend(self, tiny_model):
+        """Backends without SUPPORTS_BATCHING silently fall back to the
+        per-frame path (reference behavior: unknown perf props are inert)."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.filter.backends.custom import DummyFilter
+
+        assert not DummyFilter.SUPPORTS_BATCHING
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=dummy model=passthrough batch=4 "
+            "input-dim=4 input-type=float32 output-dim=4 "
+            "output-type=float32 name=f ! tensor_sink name=out")
+        feeds = _feeds(5)
+        got = _run(p, feeds)
+        assert p.get("f")._batch == 1
+        assert len(got) == 5
+
+    def test_batched_pushdown_fusion(self, tiny_model):
+        """Device-reduce pushdown composes with batching: the vmapped
+        executable includes the fused reduction after the event."""
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_batch batch=4 name=f ! "
+            "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+        feeds = [np.eye(4, dtype=np.float32)[i % 4] for i in range(9)]
+        got = _run(p, feeds)
+        assert len(got) == 9
+        w = np.arange(32, dtype=np.float32).reshape(4, 8)
+        for f_in, g in zip(feeds, got):
+            assert g.extra["index"] == int(np.argmax(f_in @ w))

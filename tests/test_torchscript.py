@@ -1,0 +1,240 @@
+"""TorchScript → XLA lowering (filter/torchscript.py + pytorch backend).
+
+The reference runs .pt files through the libtorch interpreter
+(tensor_filter_pytorch.cc); here the frozen graph is compiled to jax/lax
+and served on the XLA device path.  Every numeric test is an oracle test:
+the lowered executable must match eager torch on the same inputs.
+
+The reference zoo's pytorch_lenet5.pt is legacy-format (unloadable by any
+current torch), so LeNet5 is re-scripted fresh with the same architecture;
+the loadable zoo samples are exercised directly.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from nnstreamer_tpu.filter.framework import (FilterProperties,  # noqa: E402
+                                             open_backend)
+from nnstreamer_tpu.tensor.info import TensorsInfo  # noqa: E402
+
+REF_MODELS = "/root/reference/tests/test_models/models"
+needs_ref = pytest.mark.skipif(not os.path.isdir(REF_MODELS),
+                               reason="reference checkout not present")
+
+
+def _lower(module, example_inputs):
+    import jax
+
+    from nnstreamer_tpu.filter.torchscript import lower_torchscript
+
+    scripted = torch.jit.trace(module.eval(),
+                               [torch.from_numpy(x) for x in example_inputs])
+    fn, params = lower_torchscript(scripted, len(example_inputs))
+    got = jax.jit(fn)(params, *example_inputs)
+    with torch.no_grad():
+        want = module(*[torch.from_numpy(x) for x in example_inputs])
+    want = want if isinstance(want, (tuple, list)) else (want,)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w.numpy(),
+                                   rtol=2e-4, atol=2e-5)
+    return fn, params
+
+
+class LeNet5(torch.nn.Module):
+    """Same architecture as the reference fixture pytorch_lenet5.pt
+    (28x28 gray in, 10 logits out)."""
+
+    def __init__(self):
+        super().__init__()
+        self.c1 = torch.nn.Conv2d(1, 6, 5, padding=2)
+        self.c2 = torch.nn.Conv2d(6, 16, 5)
+        self.f1 = torch.nn.Linear(16 * 5 * 5, 120)
+        self.f2 = torch.nn.Linear(120, 84)
+        self.f3 = torch.nn.Linear(84, 10)
+
+    def forward(self, x):
+        x = torch.nn.functional.max_pool2d(torch.relu(self.c1(x)), 2)
+        x = torch.nn.functional.max_pool2d(torch.relu(self.c2(x)), 2)
+        x = torch.flatten(x, 1)
+        x = torch.relu(self.f1(x))
+        x = torch.relu(self.f2(x))
+        return self.f3(x)
+
+
+class TestLoweringOracle:
+    def test_lenet5(self):
+        torch.manual_seed(0)
+        x = np.random.default_rng(0).standard_normal(
+            (1, 1, 28, 28)).astype(np.float32)
+        _lower(LeNet5(), [x])
+
+    def test_bn_pool_cat_resize(self):
+        class M(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.conv = torch.nn.Conv2d(3, 8, 3, stride=2, padding=1)
+                self.bn = torch.nn.BatchNorm2d(8)
+
+            def forward(self, x):
+                y = torch.nn.functional.relu6(self.bn(self.conv(x)))
+                y = torch.nn.functional.avg_pool2d(y, 2)
+                z = torch.nn.functional.interpolate(
+                    y, size=(8, 8), mode="bilinear", align_corners=True)
+                w = torch.nn.functional.interpolate(
+                    y, size=(8, 8), mode="nearest")
+                return torch.cat([z, w], dim=1).mean(dim=(2, 3))
+
+        torch.manual_seed(1)
+        m = M().eval()
+        x = np.random.default_rng(1).standard_normal(
+            (1, 3, 16, 16)).astype(np.float32)
+        _lower(m, [x])
+
+    def test_elementwise_and_linear_family(self):
+        class M(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = torch.nn.Linear(8, 4)
+
+            def forward(self, a, b):
+                y = self.lin(a * 2.0 + b) - b[:, :4]
+                y = torch.sigmoid(y) * torch.tanh(y)
+                return torch.softmax(y, dim=-1)
+
+        torch.manual_seed(2)
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((2, 8)).astype(np.float32)
+        b = rng.standard_normal((2, 8)).astype(np.float32)
+        _lower(M().eval(), [a, b])
+
+    def test_unsupported_op_raises(self):
+        from nnstreamer_tpu.filter.torchscript import (UnsupportedTorchOp,
+                                                       lower_torchscript)
+
+        class M(torch.nn.Module):
+            def forward(self, x):
+                return torch.fft.fft(x).real
+
+        scripted = torch.jit.script(M().eval())
+        with pytest.raises(UnsupportedTorchOp):
+            lower_torchscript(scripted, 1)
+
+
+class TestPyTorchBackendXLA:
+    def _open(self, path, in_info, **custom):
+        props = FilterProperties(
+            framework="pytorch", model=path,
+            input_info=TensorsInfo.from_strings(*in_info),
+            custom_properties=custom)
+        return open_backend(props), props
+
+    def test_lenet5_runs_on_xla_device_path(self, tmp_path):
+        torch.manual_seed(0)
+        m = LeNet5().eval()
+        x = np.random.default_rng(3).standard_normal(
+            (1, 1, 28, 28)).astype(np.float32)
+        path = str(tmp_path / "lenet5.pt")
+        torch.jit.trace(m, torch.from_numpy(x)).save(path)
+        fw, _ = self._open(path, ("28:28:1:1", "float32"))
+        try:
+            assert fw.executor == "xla"          # the device path, asserted
+            assert fw.SUPPORTS_BATCHING
+            (got,) = fw.invoke([x])
+            with torch.no_grad():
+                want = m(torch.from_numpy(x)).numpy()
+            np.testing.assert_allclose(np.asarray(got), want,
+                                       rtol=2e-4, atol=2e-5)
+            # batched path agrees with oracle too
+            frames = [[x], [x * 0.5], [x * -1.0]]
+            res = fw.invoke_batched(frames, 4).wait()
+            for f, out in zip(frames, res):
+                with torch.no_grad():
+                    want = m(torch.from_numpy(f[0])).numpy()
+                np.testing.assert_allclose(out[0], want,
+                                           rtol=2e-4, atol=2e-5)
+        finally:
+            fw.close()
+
+    @needs_ref
+    def test_zoo_sample_lowers_to_xla(self):
+        path = os.path.join(REF_MODELS,
+                            "sample_3x4_two_input_two_output.pt")
+        fw, _ = self._open(path, ("3:4,3:4", "float32,float32"))
+        try:
+            assert fw.executor == "xla"
+            x = np.ones((4, 3), np.float32)
+            h = np.full((4, 3), 2.0, np.float32)
+            o1, o2 = fw.invoke([x, h])
+            assert np.allclose(np.asarray(o1), 2.0)
+            assert np.allclose(np.asarray(o2), 4.0)
+        finally:
+            fw.close()
+
+    @needs_ref
+    def test_zoo_sample_4x4x4x4x4(self):
+        path = os.path.join(REF_MODELS,
+                            "sample_4x4x4x4x4_two_input_one_output.pt")
+        fw, _ = self._open(
+            path, ("4:4:4:4:4,4:4:4:4:4", "float32,float32"))
+        try:
+            assert fw.executor == "xla"
+            rng = np.random.default_rng(4)
+            x = rng.standard_normal((4,) * 5).astype(np.float32)
+            y = rng.standard_normal((4,) * 5).astype(np.float32)
+            (o,) = fw.invoke([x, y])
+            np.testing.assert_allclose(np.asarray(o), x + y, rtol=1e-6)
+        finally:
+            fw.close()
+
+    def test_executor_torch_forces_host(self, tmp_path):
+        torch.manual_seed(0)
+        m = LeNet5().eval()
+        x = torch.zeros(1, 1, 28, 28)
+        path = str(tmp_path / "lenet5.pt")
+        torch.jit.trace(m, x).save(path)
+        fw, _ = self._open(path, ("28:28:1:1", "float32"),
+                           executor="torch")
+        try:
+            assert fw.executor == "torch-host"
+            assert not fw.SUPPORTS_BATCHING
+        finally:
+            fw.close()
+
+    def test_unlowerable_graph_falls_back_to_host(self, tmp_path):
+        class M(torch.nn.Module):
+            def forward(self, x):
+                return torch.fft.fft(x).real
+
+        scripted = torch.jit.script(M().eval())
+        path = str(tmp_path / "fft.pt")
+        scripted.save(path)
+        fw, _ = self._open(path, ("8", "float32"))
+        try:
+            assert fw.executor == "torch-host"
+            x = np.arange(8, dtype=np.float32)
+            (got,) = fw.invoke([x])
+            want = np.fft.fft(x).real.astype(np.float32)
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        finally:
+            fw.close()
+
+    def test_tpu_demand_with_unlowerable_graph_fails_loudly(self, tmp_path):
+        from nnstreamer_tpu.filter.framework import Accelerator, FilterError
+
+        class M(torch.nn.Module):
+            def forward(self, x):
+                return torch.fft.fft(x).real
+
+        path = str(tmp_path / "fft.pt")
+        torch.jit.script(M().eval()).save(path)
+        props = FilterProperties(
+            framework="pytorch", model=path,
+            input_info=TensorsInfo.from_strings("8", "float32"),
+            accelerators=[Accelerator.TPU])
+        with pytest.raises(FilterError, match="does not lower"):
+            open_backend(props)
