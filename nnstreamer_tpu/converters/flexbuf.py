@@ -14,7 +14,7 @@ import numpy as np
 
 from ..pipeline.caps import Caps, Structure
 from ..tensor.buffer import TensorBuffer
-from ..tensor.info import TensorsConfig, TensorsInfo, TensorInfo
+from ..tensor.info import TensorsConfig
 from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 from . import Converter, register_converter
 

@@ -6,16 +6,26 @@ mobilenet-ssd, grid decode for yolov5), thresholds, NMS, and draws
 rectangles into a transparent RGBA canvas sized by option4.
 
 Options (mirroring the reference's option1..5):
-  1: scheme — ``mobilenet-ssd`` | ``yolov5`` | ``raw`` (pre-decoded
-     [ymin,xmin,ymax,xmax] normalized boxes)
+  1: scheme — ``mobilenet-ssd`` (alias ``tflite-ssd``) |
+     ``mobilenet-ssd-postprocess`` (alias ``tf-ssd``) |
+     ``ov-person-detection`` | ``ov-face-detection`` | ``yolov5`` |
+     ``mp-palm-detection`` | ``raw`` (pre-decoded
+     [class,score,ymin,xmin,ymax,xmax] rows — net-new convenience)
   2: label file path
-  3: box-priors file (mobilenet-ssd; 4 lines × N anchors, as the reference's
-     box_priors.txt)
+  3: per-scheme parameters — mobilenet-ssd: box-priors file (4 lines ×
+     N anchors, the reference's box_priors.txt);
+     mobilenet-ssd-postprocess: ``loc:cls:score:num,threshold%`` tensor
+     mapping (defaults 3:1:2:0, reference :387-391);
+     mp-palm-detection: ``num_layers:min_scale:max_scale:offset_x:
+     offset_y:stride0:...`` anchor-generation params (defaults
+     4:1.0:1.0:0.5:0.5:8:16:16:16, reference :407-416)
   4: output video size ``W:H``
   5: model input size ``W:H``
+  6: score threshold (net-new; reference hardcodes per scheme)
 
-Divergence noted: the reference composites label-text sprites; here boxes
-are drawn as 2px outlines and the structured detections ride in
+Boxes draw as 2px outlines; when a label file is supplied, label-text
+sprites composite above each box (reference draw() "2. Write Labels",
+via the shared rasterfont module).  Structured detections also ride in
 ``extra["objects"]`` (class/score/box) for programmatic consumers.
 """
 
@@ -112,24 +122,36 @@ def nms(objs: List[DetectedObject], iou_thresh: float = NMS_IOU
 class BoundingBoxDecoder(Decoder):
     MODE = "bounding_boxes"
 
+    #: reference scheme aliases (bb_modes table, tensordec-boundingbox.c)
+    ALIASES = {"tflite-ssd": "mobilenet-ssd",
+               "tf-ssd": "mobilenet-ssd-postprocess",
+               "ov-face-detection": "ov-person-detection"}
+
     def __init__(self) -> None:
         self.scheme = "mobilenet-ssd"
         self.labels: Optional[List[str]] = None
         self.priors: Optional[np.ndarray] = None  # (4, N)
         self.out_w, self.out_h = 640, 480
         self.in_w, self.in_h = 300, 300
-        self.threshold = DEFAULT_THRESHOLD
+        self.threshold: Optional[float] = None
+        # mobilenet-ssd-postprocess tensor mapping (reference defaults
+        # :387-391: locations=3 classes=1 scores=2 num=0)
+        self.pp_mapping = (3, 1, 2, 0)
+        self.pp_threshold = 0.0
+        # mp-palm-detection anchor generation params (reference :407-416)
+        self.palm_layers = 4
+        self.palm_scales = (1.0, 1.0)
+        self.palm_offsets = (0.5, 0.5)
+        self.palm_strides = (8, 16, 16, 16)
+        self._palm_anchors: Optional[np.ndarray] = None
 
     def set_option(self, index: int, value: str) -> None:
         if index == 1:
-            self.scheme = value
+            self.scheme = self.ALIASES.get(value, value)
         elif index == 2 and value:
             self.labels = load_labels(value)
         elif index == 3 and value:
-            with open(value, encoding="utf-8") as f:
-                rows = [np.array([float(x) for x in line.split()])
-                        for line in f if line.strip()]
-            self.priors = np.stack(rows[:4], axis=0)
+            self._set_scheme_params(value)
         elif index == 4 and value:
             w, _, h = value.partition(":")
             self.out_w, self.out_h = int(w), int(h)
@@ -138,6 +160,38 @@ class BoundingBoxDecoder(Decoder):
             self.in_w, self.in_h = int(w), int(h)
         elif index == 6 and value:
             self.threshold = float(value)
+
+    def _set_scheme_params(self, value: str) -> None:
+        """option3 is scheme-specific (reference _setOption_mode)."""
+        if self.scheme == "mobilenet-ssd-postprocess":
+            mapping, _, thr = value.partition(",")
+            idxs = [int(x) for x in mapping.split(":") if x != ""][:4]
+            if idxs:
+                pp = list(self.pp_mapping)
+                pp[:len(idxs)] = idxs
+                self.pp_mapping = tuple(pp)
+            if thr:
+                self.pp_threshold = float(thr) / 100.0
+        elif self.scheme == "mp-palm-detection":
+            vals = [float(x) for x in value.split(":") if x != ""]
+            if len(vals) >= 1:
+                self.palm_layers = int(vals[0])
+            if len(vals) >= 3:
+                self.palm_scales = (vals[1], vals[2])
+            if len(vals) >= 5:
+                self.palm_offsets = (vals[3], vals[4])
+            if len(vals) >= 6:
+                self.palm_strides = tuple(int(v) for v in vals[5:])
+            self._palm_anchors = None
+        else:
+            with open(value, encoding="utf-8") as f:
+                rows = [np.array([float(x) for x in line.split()])
+                        for line in f if line.strip()]
+            self.priors = np.stack(rows[:4], axis=0)
+
+    def _threshold(self, default: float) -> float:
+        """option6 override, else the reference's per-scheme default."""
+        return self.threshold if self.threshold is not None else default
 
     def get_out_caps(self, config: TensorsConfig) -> Caps:
         return Caps([Structure("video/x-raw", {
@@ -197,12 +251,124 @@ class BoundingBoxDecoder(Decoder):
             ymax, xmax = cy + h / 2, cx + w / 2
         else:
             ymin, xmin, ymax, xmax = boxes.T
-        sel = _cap_candidates(sc >= self.threshold, sc)
+        sel = _cap_candidates(sc >= self._threshold(DEFAULT_THRESHOLD), sc)
         return [DetectedObject(int(c), float(s), float(y0), float(x0),
                                float(y1), float(x1))
                 for c, s, y0, x0, y1, x1 in zip(
                     cls[sel], sc[sel], ymin[sel], xmin[sel],
                     ymax[sel], xmax[sel])]
+
+    def _decode_ssd_postprocess(self, buf: TensorBuffer
+                                ) -> List[DetectedObject]:
+        """mobilenet-ssd-postprocess: the model already decoded + NMSed;
+        tensors are (locations [N,4] ymin,xmin,ymax,xmax, classes [N],
+        scores [N], num [1]) indexed by the option3 mapping (reference
+        _get_objects_mobilenet_ssd_pp, tensordec-boundingbox.c:1309)."""
+        loc_i, cls_i, sc_i, num_i = self.pp_mapping
+        if buf.num_tensors <= max(self.pp_mapping):
+            # graphs without the num tensor: treat every row as a candidate
+            loc_i, cls_i, sc_i = loc_i % buf.num_tensors, \
+                cls_i % buf.num_tensors, sc_i % buf.num_tensors
+            num = None
+        else:
+            num = int(np.asarray(buf.np(num_i)).reshape(-1)[0])
+        boxes = buf.np(loc_i).reshape(-1, buf.np(loc_i).shape[-1])
+        classes = np.asarray(buf.np(cls_i)).reshape(-1)
+        scores = np.asarray(buf.np(sc_i)).reshape(-1)
+        n = len(scores) if num is None else min(num, len(scores))
+        thr = self._threshold(self.pp_threshold)
+        out = []
+        for d in range(n):
+            if scores[d] < thr:
+                continue
+            y0, x0, y1, x1 = (float(np.clip(boxes[d, k], 0.0, 1.0))
+                              for k in range(4))
+            out.append(DetectedObject(int(classes[d]), float(scores[d]),
+                                      y0, x0, y1, x1))
+        return out
+
+    # reference OV_PERSON_DETECTION_CONF_THRESHOLD (:129)
+    OV_THRESHOLD = 0.8
+    OV_MAX = 200  # reference OV_PERSON_DETECTION_MAX (:126)
+
+    def _decode_ov_person(self, buf: TensorBuffer) -> List[DetectedObject]:
+        """ov-person/face-detection: one tensor of 7-float rows
+        (image_id, label, conf, xmin, ymin, xmax, ymax), terminated by
+        image_id < 0 (reference _get_persons_ov)."""
+        rows = np.asarray(buf.np(0)).reshape(-1, 7)[:self.OV_MAX]
+        thr = self._threshold(self.OV_THRESHOLD)
+        out = []
+        for row in rows:
+            if row[0] < 0:
+                break
+            if row[2] < thr:
+                continue
+            x0, y0, x1, y1 = (float(v) for v in row[3:7])
+            # reference reports prob=1 and class_id=-1 (no label lookup)
+            out.append(DetectedObject(-1, 1.0, y0, x0, y1, x1))
+        return out
+
+    # mp-palm-detection fixed model geometry (reference :134-136)
+    PALM_INPUT = 192
+    PALM_THRESHOLD = 0.5
+
+    def _palm_anchor_table(self) -> np.ndarray:
+        """SSD anchor generation for the 192×192 palm model (reference
+        _mp_palm_detection_generate_anchors): per layer-group two unit
+        aspect ratios with interpolated scales, centers on the feature
+        grid.  Returns (N, 4) rows (y_center, x_center, h, w)."""
+        if self._palm_anchors is not None:
+            return self._palm_anchors
+        num = self.palm_layers
+        mn, mx = self.palm_scales
+        off_x, off_y = self.palm_offsets
+        strides = list(self.palm_strides)[:num]
+
+        def scale(i):
+            if num == 1:
+                return (mn + mx) * 0.5
+            return mn + (mx - mn) * i / (num - 1.0)
+
+        anchors = []
+        layer_id = 0
+        while layer_id < num:
+            hw = []
+            last = layer_id
+            while last < num and strides[last] == strides[layer_id]:
+                hw.append((scale(last), scale(last)))
+                hw.append((scale(last + 1), scale(last + 1)))
+                last += 1
+            fm = int(np.ceil(self.PALM_INPUT / strides[layer_id]))
+            for y in range(fm):
+                for x in range(fm):
+                    for h, w in hw:
+                        anchors.append(((y + off_y) / fm, (x + off_x) / fm,
+                                        h, w))
+            layer_id = last
+        self._palm_anchors = np.array(anchors, dtype=np.float32)
+        return self._palm_anchors
+
+    def _decode_mp_palm(self, buf: TensorBuffer) -> List[DetectedObject]:
+        """mp-palm-detection: tensors (boxes [N,18], scores [N]); box rows
+        are (y, x, h, w, 7×2 keypoints) in input pixels relative to the
+        anchor (reference _get_objects_mp_palm_detection)."""
+        boxes = np.asarray(buf.np(0)).reshape(-1, buf.np(0).shape[-1])
+        scores = np.asarray(buf.np(1)).reshape(-1).astype(np.float64)
+        anchors = self._palm_anchor_table()
+        n = min(len(boxes), len(scores), len(anchors))
+        sc = 1.0 / (1.0 + np.exp(-np.clip(scores[:n], -100.0, 100.0)))
+        thr = self._threshold(self.PALM_THRESHOLD)
+        out = []
+        for d in np.nonzero(sc >= thr)[0]:
+            ay, ax, ah, aw = anchors[d]
+            yc = boxes[d, 0] / self.in_h * ah + ay
+            xc = boxes[d, 1] / self.in_w * aw + ax
+            h = boxes[d, 2] / self.in_h * ah
+            w = boxes[d, 3] / self.in_w * aw
+            out.append(DetectedObject(0, float(sc[d]), float(yc - h / 2),
+                                      float(xc - w / 2), float(yc + h / 2),
+                                      float(xc + w / 2)))
+        return out
 
     def _decode_yolov5(self, buf: TensorBuffer) -> List[DetectedObject]:
         pred = buf.np(0)  # (N, 5+C): cx,cy,w,h,obj,cls...
@@ -210,7 +376,7 @@ class BoundingBoxDecoder(Decoder):
         cls_scores = pred[:, 5:] * obj[:, None]
         cls = cls_scores.argmax(axis=1)
         sc = cls_scores[np.arange(len(cls)), cls]
-        sel = _cap_candidates(sc >= self.threshold, sc)
+        sel = _cap_candidates(sc >= self._threshold(DEFAULT_THRESHOLD), sc)
         cx, cy = pred[sel, 0] / self.in_w, pred[sel, 1] / self.in_h
         w, h = pred[sel, 2] / self.in_w, pred[sel, 3] / self.in_h
         return [DetectedObject(int(c), float(s), float(y - hh / 2),
@@ -221,22 +387,28 @@ class BoundingBoxDecoder(Decoder):
     def _decode_raw(self, buf: TensorBuffer) -> List[DetectedObject]:
         boxes = buf.np(0)    # (N, 6): class, score, ymin,xmin,ymax,xmax
         out = []
+        thr = self._threshold(DEFAULT_THRESHOLD)
         for row in boxes:
-            if row[1] >= self.threshold:
+            if row[1] >= thr:
                 out.append(DetectedObject(int(row[0]), float(row[1]),
                                           *map(float, row[2:6])))
         return out
 
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
         if self.scheme == "mobilenet-ssd":
-            objs = self._decode_mobilenet_ssd(buf)
+            objs = nms(self._decode_mobilenet_ssd(buf))
+        elif self.scheme == "mobilenet-ssd-postprocess":
+            objs = self._decode_ssd_postprocess(buf)  # model already NMSed
+        elif self.scheme == "ov-person-detection":
+            objs = self._decode_ov_person(buf)        # model already NMSed
         elif self.scheme == "yolov5":
-            objs = self._decode_yolov5(buf)
+            objs = nms(self._decode_yolov5(buf))
+        elif self.scheme == "mp-palm-detection":
+            objs = nms(self._decode_mp_palm(buf))
         elif self.scheme == "raw":
-            objs = self._decode_raw(buf)
+            objs = nms(self._decode_raw(buf))
         else:
             raise ValueError(f"unknown bounding-box scheme {self.scheme!r}")
-        objs = nms(objs)
         if self.labels:
             for o in objs:
                 if 0 <= o.class_id < len(self.labels):
@@ -260,3 +432,9 @@ class BoundingBoxDecoder(Decoder):
         canvas[max(y1 - t + 1, 0):y1 + 1, x0:x1 + 1] = color
         canvas[y0:y1 + 1, x0:x0 + t] = color
         canvas[y0:y1 + 1, max(x1 - t + 1, 0):x1 + 1] = color
+        if o.label:
+            # label sprite above the box (reference draw() "2. Write
+            # Labels": one glyph-height above, clipped to the canvas)
+            from .rasterfont import GLYPH_H, composite_label
+
+            composite_label(canvas, o.label, x0, y0 - GLYPH_H - 1, color)

@@ -1,7 +1,8 @@
 """font decoder: byte stream → rendered text video.
 
 Parity with ext/nnstreamer/tensor_decoder/tensordec-font.c (ASCII sprite
-text rendering into video frames).  A built-in 5×7 bitmap font renders the
+text rendering into video frames).  The shared 5×7 raster font
+(:mod:`.rasterfont`, also used for bounding-box label sprites) renders the
 incoming bytes (interpreted as ASCII) into a GRAY8 video frame.
 """
 
@@ -15,31 +16,7 @@ from ..pipeline.caps import Caps, Structure
 from ..tensor.buffer import TensorBuffer
 from ..tensor.info import TensorsConfig
 from . import Decoder, register_decoder
-
-# 5x7 font for printable subset; missing glyphs render as filled box
-_GLYPHS = {
-    "0": ["01110", "10001", "10011", "10101", "11001", "10001", "01110"],
-    "1": ["00100", "01100", "00100", "00100", "00100", "00100", "01110"],
-    "2": ["01110", "10001", "00001", "00010", "00100", "01000", "11111"],
-    "3": ["11110", "00001", "00001", "01110", "00001", "00001", "11110"],
-    "4": ["00010", "00110", "01010", "10010", "11111", "00010", "00010"],
-    "5": ["11111", "10000", "11110", "00001", "00001", "10001", "01110"],
-    "6": ["00110", "01000", "10000", "11110", "10001", "10001", "01110"],
-    "7": ["11111", "00001", "00010", "00100", "01000", "01000", "01000"],
-    "8": ["01110", "10001", "10001", "01110", "10001", "10001", "01110"],
-    "9": ["01110", "10001", "10001", "01111", "00001", "00010", "01100"],
-    "A": ["01110", "10001", "10001", "11111", "10001", "10001", "10001"],
-    "B": ["11110", "10001", "10001", "11110", "10001", "10001", "11110"],
-    "C": ["01110", "10001", "10000", "10000", "10000", "10001", "01110"],
-    "D": ["11110", "10001", "10001", "10001", "10001", "10001", "11110"],
-    "E": ["11111", "10000", "10000", "11110", "10000", "10000", "11111"],
-    "F": ["11111", "10000", "10000", "11110", "10000", "10000", "10000"],
-    " ": ["00000", "00000", "00000", "00000", "00000", "00000", "00000"],
-    ".": ["00000", "00000", "00000", "00000", "00000", "00110", "00110"],
-    "-": ["00000", "00000", "00000", "11111", "00000", "00000", "00000"],
-    ":": ["00000", "00110", "00110", "00000", "00110", "00110", "00000"],
-}
-_UNKNOWN = ["11111"] * 7
+from .rasterfont import composite_label
 
 
 @register_decoder
@@ -63,16 +40,7 @@ class FontDecoder(Decoder):
         text = bytes(np.ascontiguousarray(buf.np(0)).reshape(-1)
                      .view(np.uint8)).decode("ascii", errors="replace")
         canvas = np.zeros((self.out_h, self.out_w, 1), np.uint8)
-        x = 2
-        for ch in text.upper():
-            glyph = _GLYPHS.get(ch, _UNKNOWN)
-            if x + 6 >= self.out_w:
-                break
-            for r, row in enumerate(glyph):
-                for c, bit in enumerate(row):
-                    if bit == "1" and 2 + r < self.out_h:
-                        canvas[2 + r, x + c, 0] = 255
-            x += 6
+        composite_label(canvas, text, 2, 2, (255,))
         out = buf.with_tensors([canvas])
         out.extra["text"] = text
         return out

@@ -9,7 +9,7 @@ option3 = optional label (keypoint-name) file, option4 = score threshold.
 from __future__ import annotations
 
 from fractions import Fraction
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 

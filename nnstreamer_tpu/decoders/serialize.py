@@ -13,7 +13,6 @@ without requiring the protobuf runtime.
 
 from __future__ import annotations
 
-import struct
 from fractions import Fraction
 from typing import List, Optional
 
@@ -148,6 +147,13 @@ def decode_tensors_proto(blob: bytes) -> List[np.ndarray]:
                         l2, t_off = read_varint(payload, t_off)
                         if f2 == 4:
                             data = payload[t_off:t_off + l2]
+                        elif f2 == 3:
+                            # proto3 packs repeated uint32 by default (the
+                            # reference's C++ protobuf emits this form)
+                            p_off, p_end = t_off, t_off + l2
+                            while p_off < p_end:
+                                v, p_off = read_varint(payload, p_off)
+                                dims.append(v)
                         t_off += l2
                 shape = tuple(reversed(dims))
                 tensors.append(np.frombuffer(data, dtype).reshape(shape))

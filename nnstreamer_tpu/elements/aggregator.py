@@ -13,7 +13,6 @@ one batched MXU-friendly tensor.
 
 from __future__ import annotations
 
-from fractions import Fraction
 from typing import List
 
 import numpy as np

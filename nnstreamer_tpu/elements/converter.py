@@ -20,16 +20,15 @@ from typing import List, Optional
 
 import numpy as np
 
-from ..pipeline.caps import ANY_FRAMERATE, Caps, Structure
-from ..pipeline.element import CapsEvent, Element, FlowReturn
+from ..pipeline.caps import Caps, Structure
+from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer, frames_to_ns
 from ..tensor.caps_util import caps_from_config, flexible_tensors_caps
 from ..tensor.info import TensorInfo, TensorsConfig, TensorsInfo
 from ..tensor.meta import TensorMetaInfo
-from ..tensor.types import (TensorFormat, TensorType, dim_parse,
-                            np_shape_to_dim)
-from .src import VIDEO_FORMATS, _CHANNELS, video_template_caps
+from ..tensor.types import TensorType, dim_parse, np_shape_to_dim
+from .src import _CHANNELS, video_template_caps
 
 _AUDIO_TYPES = {"S8": TensorType.INT8, "U8": TensorType.UINT8,
                 "S16LE": TensorType.INT16, "U16LE": TensorType.UINT16,

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from ..decoders import find_decoder
 from ..pipeline.caps import Caps
-from ..pipeline.element import CustomEvent, Element, FlowReturn
+from ..pipeline.element import CustomEvent, Element
 from ..pipeline.registry import register_element
 from ..tensor.caps_util import config_from_caps, tensors_template_caps
 

@@ -23,13 +23,11 @@ from typing import List, Optional
 
 from ..filter.framework import (Accelerator, FilterError, FilterProperties,
                                 close_backend, open_backend)
-from ..pipeline.caps import Caps
 from ..pipeline.element import CustomEvent, Element, FlowReturn, QoSEvent
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import caps_from_config, static_tensors_caps
 from ..tensor.info import TensorsConfig, TensorsInfo
-from ..tensor.types import np_shape_to_dim
 
 
 def _parse_combination(s) -> Optional[List[int]]:
@@ -203,14 +201,21 @@ class TensorFilter(Element):
 
     def _drain_batches(self) -> None:
         """Flush the collecting partial batch and the in-flight batch, in
-        stream order (EOS, renegotiation, model swap)."""
+        stream order (EOS, renegotiation, model swap).  A downstream ERROR
+        raises so the event path posts a pipeline error, matching the
+        per-frame path's propagation."""
         if self._batch <= 1:
             return
+        ret = FlowReturn.OK
         if self._pending:
-            self._dispatch_pending()
+            ret = self._dispatch_pending()
         if self._inflight is not None:
             inflight, self._inflight = self._inflight, None
-            self._push_inflight(inflight)
+            r = self._push_inflight(inflight)
+            ret = r if r is FlowReturn.ERROR else ret
+        if ret is FlowReturn.ERROR:
+            raise RuntimeError(
+                f"{self.name}: downstream error while draining batches")
 
     # -- events --------------------------------------------------------------
     def on_upstream_event(self, pad, event):

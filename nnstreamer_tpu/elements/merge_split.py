@@ -8,11 +8,10 @@ gsttensor_split.c (one tensor → N streams sliced by ``tensorseg``).
 from __future__ import annotations
 
 from fractions import Fraction
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 
-from ..pipeline.caps import Caps
 from ..pipeline.clock import CollectPads, SyncMode
 from ..pipeline.element import CapsEvent, Element, EOSEvent, FlowReturn, Pad
 from ..pipeline.registry import register_element
@@ -20,7 +19,6 @@ from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import (caps_from_config, config_from_caps,
                                 static_tensors_caps)
 from ..tensor.info import TensorInfo, TensorsConfig, TensorsInfo
-from ..tensor.types import dim_parse
 
 
 @register_element

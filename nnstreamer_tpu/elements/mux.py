@@ -11,7 +11,6 @@ from __future__ import annotations
 from fractions import Fraction
 from typing import Dict, List, Optional
 
-from ..pipeline.caps import Caps
 from ..pipeline.clock import CollectPads, SyncMode
 from ..pipeline.element import CapsEvent, Element, EOSEvent, FlowReturn, Pad
 from ..pipeline.registry import register_element

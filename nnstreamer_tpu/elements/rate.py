@@ -9,10 +9,9 @@ from __future__ import annotations
 
 from fractions import Fraction
 
-from ..pipeline.caps import Caps, Structure
 from ..pipeline.element import Element, FlowReturn, QoSEvent
 from ..pipeline.registry import register_element
-from ..tensor.buffer import SECOND, TensorBuffer
+from ..tensor.buffer import SECOND
 from ..tensor.caps_util import caps_from_config, config_from_caps, \
     tensors_template_caps
 

@@ -11,13 +11,11 @@ indices — same information, one word per element.)
 from __future__ import annotations
 
 from fractions import Fraction
-from typing import List
 
 import numpy as np
 
-from ..pipeline.element import Element, FlowReturn
+from ..pipeline.element import Element
 from ..pipeline.registry import register_element
-from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import (caps_from_config, config_from_caps,
                                 static_tensors_caps)
 from ..tensor.info import TensorInfo, TensorsConfig

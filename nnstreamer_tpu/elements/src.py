@@ -14,7 +14,6 @@ from typing import Optional
 import numpy as np
 
 from ..pipeline.caps import ANY_FRAMERATE, Caps, FractionRange, IntRange, Structure
-from ..pipeline.element import FlowReturn
 from ..pipeline.graph import Source
 from ..pipeline.registry import register_element
 from ..tensor.buffer import SECOND, TensorBuffer

@@ -10,12 +10,11 @@ src_1 = else when both linked).
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import Callable, Optional
 
 import numpy as np
 
-from ..pipeline.caps import Caps
-from ..pipeline.element import Element, EOSEvent, FlowReturn, Pad
+from ..pipeline.element import Element, FlowReturn, Pad
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import static_tensors_caps

@@ -19,9 +19,8 @@ from typing import Any, Dict, List, Optional, Tuple, Type
 
 import numpy as np
 
-from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.element import Element, EOSEvent
 from ..pipeline.registry import register_element
-from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import tensors_template_caps
 
 

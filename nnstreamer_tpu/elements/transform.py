@@ -16,19 +16,17 @@ host numpy is used otherwise.  ``acceleration=false`` forces numpy.
 from __future__ import annotations
 
 import re
-from fractions import Fraction
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-from ..pipeline.caps import Caps
 from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import (caps_from_config, config_from_caps,
                                 static_tensors_caps)
 from ..tensor.info import TensorInfo, TensorsConfig, TensorsInfo
-from ..tensor.types import TensorType, dim_parse
+from ..tensor.types import TensorType
 
 
 def _xp(arr):

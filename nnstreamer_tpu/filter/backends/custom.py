@@ -19,7 +19,7 @@ Parity with the reference's custom filter family (SURVEY.md §2.2):
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Tuple
 
 import numpy as np
 

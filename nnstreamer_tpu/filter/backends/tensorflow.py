@@ -31,7 +31,6 @@ tflite loader.
 from __future__ import annotations
 
 import os
-import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np

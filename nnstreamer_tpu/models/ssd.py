@@ -12,7 +12,7 @@ TPU-first: one fused XLA graph from uint8 frame to both heads, bf16 convs.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import flax.linen as nn
 import jax

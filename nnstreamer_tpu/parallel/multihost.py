@@ -18,7 +18,6 @@ Typical launch (one command per host)::
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 _initialized = False

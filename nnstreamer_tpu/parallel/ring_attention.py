@@ -15,8 +15,6 @@ dimension (``sp``), e.g. through
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import enum
 import threading
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional
 
 from ..tensor.buffer import TensorBuffer
 from .caps import Caps

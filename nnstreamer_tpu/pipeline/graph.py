@@ -12,12 +12,11 @@ from __future__ import annotations
 
 import threading
 import queue as _queue
-from typing import Any, Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from ..tensor.buffer import TensorBuffer
 from .caps import Caps
-from .element import (CapsEvent, Element, EOSEvent, Event, FlowReturn, Pad,
-                      PadDirection)
+from .element import CapsEvent, Element, EOSEvent, FlowReturn, Pad
 from .registry import register_element
 
 

@@ -24,7 +24,7 @@ import shlex
 from typing import List, Optional
 
 from .caps import Caps
-from .element import CapsEvent, Element, FlowReturn
+from .element import CapsEvent, Element
 from .graph import Pipeline
 from .registry import make_element, register_element
 

@@ -15,7 +15,7 @@ import time
 from typing import Optional
 
 from ..pipeline.caps import Caps
-from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import tensors_template_caps

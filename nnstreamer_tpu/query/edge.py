@@ -15,9 +15,8 @@ from __future__ import annotations
 
 import queue as _queue
 import socket
-import struct
 import threading
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Optional, Set
 
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn

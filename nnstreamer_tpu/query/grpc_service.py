@@ -1,0 +1,372 @@
+"""gRPC tensor service: the reference's canonical RPC transport.
+
+Parity with ``ext/nnstreamer/extra/nnstreamer_grpc_common.cc`` (418) /
+``…_grpc_protobuf.cc`` (522) / ``…_grpc_flatbuf.cc`` (564) and the
+``tensor_src_grpc`` / ``tensor_sink_grpc`` elements
+(ext/nnstreamer/tensor_source/tensor_src_grpc.c:71-89,
+tensor_sink/tensor_sink_grpc.c): a real HTTP/2 gRPC ``TensorService``
+with the reference's two streaming RPCs
+
+    rpc SendTensors (stream Tensors) returns (Empty)   // client → server
+    rpc RecvTensors (Empty) returns (stream Tensors)   // server → client
+
+over either IDL (``idl=protobuf`` → ``nnstreamer.proto`` wire messages via
+the in-tree protowire codec; ``idl=flatbuf`` → ``nnstreamer.fbs`` wire via
+the in-tree flatbuffer runtime).  Messages are (de)serialized by our own
+codecs and handed to grpcio as raw bytes, so the frames on the wire are
+byte-compatible with the reference service (oracle-tested against
+protoc-generated bindings in tests/test_grpc.py).
+
+Like the reference, BOTH elements can run as gRPC server or client
+(``server=true/false``): a src in server mode accepts SendTensors pushes;
+a src in client mode dials out and pulls RecvTensors; and vice versa for
+the sink.  This gives all four pairings of the reference
+(src/server, src/client, sink/server, sink/client).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from fractions import Fraction
+from typing import List, Optional
+
+import numpy as np
+
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.graph import Source
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import caps_from_config, tensors_template_caps
+from ..tensor.info import TensorInfo, TensorsConfig, TensorsInfo
+from ..utils.log import logger
+
+_EOS = object()  # in-queue end-of-stream sentinel
+
+
+def _method(idl: str, rpc: str) -> str:
+    pkg = "nnstreamer.flatbuf" if idl == "flatbuf" else "nnstreamer.protobuf"
+    return f"/{pkg}.TensorService/{rpc}"
+
+
+class _Codec:
+    """IDL-selected encode/decode of one stream frame."""
+
+    def __init__(self, idl: str) -> None:
+        if idl not in ("protobuf", "flatbuf"):
+            raise ValueError(f"grpc: unknown idl {idl!r} "
+                             "(protobuf|flatbuf, reference grpc_common.cc)")
+        self.idl = idl
+
+    def encode(self, buf: TensorBuffer,
+               rate: Optional[Fraction]) -> bytes:
+        if self.idl == "flatbuf":
+            from ..utils.tensor_flatbuf import encode_tensors
+
+            return encode_tensors([buf.np(i) for i in
+                                   range(buf.num_tensors)], rate=rate)
+        from ..decoders.serialize import encode_tensors_proto
+
+        return encode_tensors_proto(buf, rate=rate)
+
+    def decode(self, blob: bytes) -> List[np.ndarray]:
+        if self.idl == "flatbuf":
+            from ..utils.tensor_flatbuf import decode_tensors
+
+            arrays, _rate, _names = decode_tensors(blob)
+            return arrays
+        from ..decoders.serialize import decode_tensors_proto
+
+        return decode_tensors_proto(blob)
+
+
+class _BytesService:
+    """Generic TensorService endpoint speaking raw bytes (our codecs own
+    the message layer).  ``recv_q`` collects frames pushed by remote
+    SendTensors callers; RecvTensors streams frames from per-subscriber
+    queues fed by :meth:`publish`."""
+
+    def __init__(self, idl: str) -> None:
+        self.idl = idl
+        self.recv_q: _queue.Queue = _queue.Queue()
+        self._subs: List[_queue.Queue] = []
+        self._lock = threading.Lock()
+
+    # -- rpc implementations -------------------------------------------------
+    def _send_tensors(self, request_iterator, context):
+        for blob in request_iterator:
+            self.recv_q.put(blob)
+        return b""  # google.protobuf.Empty
+
+    def _recv_tensors(self, request, context):
+        q: _queue.Queue = _queue.Queue()
+        with self._lock:
+            self._subs.append(q)
+        try:
+            while True:
+                item = q.get()
+                if item is _EOS:
+                    return
+                yield item
+        finally:
+            with self._lock:
+                if q in self._subs:
+                    self._subs.remove(q)
+
+    # -- publisher side ------------------------------------------------------
+    def publish(self, blob: bytes) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for q in subs:
+            q.put(blob)
+
+    def finish(self) -> None:
+        with self._lock:
+            subs = list(self._subs)
+        for q in subs:
+            q.put(_EOS)
+
+    def handler(self):
+        import grpc
+
+        send = grpc.stream_unary_rpc_method_handler(self._send_tensors)
+        recv = grpc.unary_stream_rpc_method_handler(self._recv_tensors)
+        table = {_method(self.idl, "SendTensors"): send,
+                 _method(self.idl, "RecvTensors"): recv}
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                return table.get(details.method)
+
+        return _Handler()
+
+
+class GrpcTensorServer:
+    """Hosts a TensorService on an insecure HTTP/2 port."""
+
+    def __init__(self, host: str, port: int, idl: str) -> None:
+        import grpc
+        from concurrent import futures
+
+        self.service = _BytesService(idl)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((self.service.handler(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise RuntimeError(f"grpc: cannot bind {host}:{port}")
+        self._server.start()
+
+    def close(self) -> None:
+        self.service.finish()
+        self._server.stop(grace=1.0)
+
+
+class GrpcTensorClient:
+    """Dials a remote TensorService."""
+
+    def __init__(self, host: str, port: int, idl: str) -> None:
+        import grpc
+
+        self.idl = idl
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._send = self._channel.stream_unary(
+            _method(idl, "SendTensors"))
+        self._recv = self._channel.unary_stream(
+            _method(idl, "RecvTensors"))
+
+    def send_stream(self, blob_iterator) -> None:
+        """Blocking client-streaming SendTensors call."""
+        self._send(blob_iterator)
+
+    def recv_stream(self):
+        """Server-streaming RecvTensors call: yields raw frames."""
+        return self._recv(b"")
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def _config_from_arrays(arrays: List[np.ndarray]) -> TensorsConfig:
+    return TensorsConfig(
+        info=TensorsInfo([TensorInfo.from_np(a) for a in arrays]),
+        rate=Fraction(0, 1))
+
+
+@register_element
+class GrpcTensorSrc(Source):
+    """``tensor_src_grpc``: receive tensor frames over gRPC.
+
+    server=true (default, reference default too): host the service; remote
+    peers push via SendTensors.  server=false: dial ``host:port`` and pull
+    the RecvTensors stream.  Output caps come from the ``caps`` property or
+    are derived from the first received frame's dims/types.
+    """
+
+    FACTORY = "tensor_src_grpc"
+    PROPERTIES = {
+        "host": ("localhost", "bind/dial host"),
+        "port": (55115, "bind/dial port (0 = ephemeral when serving)"),
+        "server": (True, "host the service (else dial as client)"),
+        "idl": ("protobuf", "message IDL: protobuf|flatbuf"),
+        "caps": (None, "override out caps (else derived from first frame)"),
+        "num-buffers": (-1, "stop after N buffers, -1 unlimited"),
+    }
+
+    def _make_pads(self):
+        self.add_src_pad(tensors_template_caps(), "src")
+
+    def start(self):
+        self._codec = _Codec(str(self.idl))
+        self._count = 0
+        self._first: Optional[List[np.ndarray]] = None
+        if self.server:
+            self._grpc_server = GrpcTensorServer(str(self.host),
+                                                 int(self.port), self._codec.idl)
+            self.port = self._grpc_server.port  # readable ephemeral port
+            self._fifo = self._grpc_server.service.recv_q
+            self._client = None
+        else:
+            self._grpc_server = None
+            self._client = GrpcTensorClient(str(self.host), int(self.port),
+                                            self._codec.idl)
+            self._fifo = _queue.Queue()
+            threading.Thread(target=self._pull_loop, daemon=True,
+                             name=f"grpc-src:{self.name}").start()
+
+    def _pull_loop(self) -> None:
+        try:
+            for blob in self._client.recv_stream():
+                self._fifo.put(blob)
+        except Exception as e:  # noqa: BLE001 - stream end/teardown
+            logger.debug("grpc src %s: recv stream ended: %r", self.name, e)
+        self._fifo.put(_EOS)
+
+    def stop(self):
+        if self._grpc_server is not None:
+            self._grpc_server.close()
+        if self._client is not None:
+            self._client.close()
+        super()._halt()
+
+    def _next_blob(self):
+        while not self._halted.is_set():
+            try:
+                return self._fifo.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+        return _EOS
+
+    def negotiate(self) -> Caps:
+        if self.caps:
+            c = self.caps
+            return Caps.from_string(c) if isinstance(c, str) else c
+        blob = self._next_blob()
+        if blob is _EOS:
+            raise ValueError(f"{self.name}: stream closed before first "
+                             "frame; cannot derive caps")
+        self._first = self._codec.decode(blob)
+        return caps_from_config(_config_from_arrays(self._first))
+
+    def create(self) -> Optional[TensorBuffer]:
+        n = int(self.num_buffers)
+        if n >= 0 and self._count >= n:
+            return None
+        if self._first is not None:
+            arrays, self._first = self._first, None
+        else:
+            blob = self._next_blob()
+            if blob is _EOS:
+                return None
+            arrays = self._codec.decode(blob)
+        self._count += 1
+        return TensorBuffer(tensors=arrays)
+
+
+@register_element
+class GrpcTensorSink(Element):
+    """``tensor_sink_grpc``: send the stream over gRPC.
+
+    server=true: host the service; remote peers pull via RecvTensors.
+    server=false (reference sink default): dial and push via SendTensors.
+    """
+
+    FACTORY = "tensor_sink_grpc"
+    PROPERTIES = {
+        "host": ("localhost", "bind/dial host"),
+        "port": (55115, "bind/dial port (0 = ephemeral when serving)"),
+        "server": (False, "host the service (else dial as client)"),
+        "idl": ("protobuf", "message IDL: protobuf|flatbuf"),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+
+    def start(self):
+        self._codec = _Codec(str(self.idl))
+        self._rate: Optional[Fraction] = None
+        if self.server:
+            self._grpc_server = GrpcTensorServer(str(self.host),
+                                                 int(self.port), self._codec.idl)
+            self.port = self._grpc_server.port
+            self._client = None
+            self._sendq = None
+            self._send_thread = None
+        else:
+            self._grpc_server = None
+            self._client = GrpcTensorClient(str(self.host), int(self.port),
+                                            self._codec.idl)
+            self._sendq: _queue.Queue = _queue.Queue()
+            self._send_thread = threading.Thread(
+                target=self._send_loop, daemon=True,
+                name=f"grpc-sink:{self.name}")
+            self._send_thread.start()
+
+    def _send_loop(self) -> None:
+        def gen():
+            while True:
+                item = self._sendq.get()
+                if item is _EOS:
+                    return
+                yield item
+        try:
+            self._client.send_stream(gen())
+        except Exception as e:  # noqa: BLE001 - peer gone at teardown
+            logger.warning("grpc sink %s: send stream failed: %r",
+                           self.name, e)
+
+    def stop(self):
+        if self._sendq is not None:
+            self._sendq.put(_EOS)
+        if self._send_thread is not None:
+            self._send_thread.join(timeout=10)
+        if self._grpc_server is not None:
+            self._grpc_server.close()
+        if self._client is not None:
+            self._client.close()
+
+    def set_caps(self, pad, caps):
+        from ..tensor.caps_util import config_from_caps
+
+        self._rate = config_from_caps(caps).rate
+
+    def chain(self, pad, buf):
+        blob = self._codec.encode(buf, self._rate)
+        if self._grpc_server is not None:
+            self._grpc_server.service.publish(blob)
+        else:
+            self._sendq.put(blob)
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            if self._sendq is not None:
+                self._sendq.put(_EOS)
+                if self._send_thread is not None:
+                    self._send_thread.join(timeout=10)
+                    self._send_thread = None
+                self._sendq = None
+            elif self._grpc_server is not None:
+                self._grpc_server.service.finish()
+            self.post_eos_reached()

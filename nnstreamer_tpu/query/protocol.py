@@ -24,7 +24,7 @@ from __future__ import annotations
 import dataclasses
 import socket
 import struct
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 

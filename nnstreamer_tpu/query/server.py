@@ -16,7 +16,7 @@ from __future__ import annotations
 import queue as _queue
 import socket
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn

@@ -9,7 +9,6 @@ formats; ``format`` selects static/flexible/sparse.
 from __future__ import annotations
 
 from fractions import Fraction
-from typing import Optional
 
 from ..pipeline.caps import ANY_FRAMERATE, Caps, Structure
 from .info import TensorsConfig, TensorsInfo

@@ -9,7 +9,6 @@ variants.
 
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 

@@ -15,7 +15,7 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import enum
-from typing import Optional, Sequence, Tuple, Union
+from typing import Sequence, Tuple
 
 import numpy as np
 

@@ -15,7 +15,7 @@ import socket
 import struct
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 #: seconds between the NTP era (1900) and the unix epoch (1970)
 NTP_TIMESTAMP_DELTA = 2_208_988_800

@@ -1,0 +1,162 @@
+"""Bounding-box scheme breadth + label sprites.
+
+Parity with tensordec-boundingbox.c's full scheme table (:148-191):
+mobilenet-ssd-postprocess (tensor-mapped, model-NMSed), ov-person/
+face-detection (7-float rows, image_id terminator, 0.8 threshold),
+mp-palm-detection (generated SSD anchors, sigmoid scores), scheme
+aliases, and label-sprite compositing (draw() "2. Write Labels").
+"""
+
+import numpy as np
+
+from nnstreamer_tpu.decoders.boundingbox import BoundingBoxDecoder
+from tests.test_decoders import decode_one, tcaps
+
+
+class TestSsdPostprocess:
+    def _tensors(self):
+        # reference default mapping: locations=3 classes=1 scores=2 num=0
+        num = np.array([2.0], np.float32)
+        classes = np.array([7, 3, 0], np.float32)
+        scores = np.array([0.9, 0.2, 0.0], np.float32)
+        boxes = np.array([[0.1, 0.2, 0.5, 0.6],
+                          [0.0, 0.0, 1.0, 1.0],
+                          [0, 0, 0, 0]], np.float32)
+        return [num, classes, scores, boxes]
+
+    def test_default_mapping_and_num_terminator(self):
+        sink = decode_one(
+            tcaps("1.3.3.4:3", "float32.float32.float32.float32", n=4),
+            {"mode": "bounding_boxes",
+             "option1": "mobilenet-ssd-postprocess",
+             "option3": ",50", "option4": "100:100"},
+            self._tensors())
+        objs = sink.results[0].extra["objects"]
+        # row 1 below 50% threshold, row 2 beyond num=2: only row 0 stays
+        assert len(objs) == 1
+        o = objs[0]
+        assert o.class_id == 7 and abs(o.score - 0.9) < 1e-6
+        assert (abs(o.ymin - 0.1) < 1e-6 and abs(o.xmin - 0.2) < 1e-6
+                and abs(o.ymax - 0.5) < 1e-6 and abs(o.xmax - 0.6) < 1e-6)
+
+    def test_explicit_tensor_mapping(self):
+        # scrambled order declared via option3 loc:cls:score:num
+        num = np.array([1.0], np.float32)
+        classes = np.array([4.0], np.float32)
+        scores = np.array([0.8], np.float32)
+        boxes = np.array([[0.2, 0.3, 0.7, 0.9]], np.float32)
+        sink = decode_one(
+            tcaps("4:1.1.3.3", "float32.float32.float32.float32", n=4),
+            {"mode": "bounding_boxes",
+             "option1": "mobilenet-ssd-postprocess",
+             "option3": "0:2:3:1"},
+            [boxes, num, classes, scores])
+        objs = sink.results[0].extra["objects"]
+        assert len(objs) == 1 and objs[0].class_id == 4
+
+    def test_tf_ssd_alias(self):
+        d = BoundingBoxDecoder()
+        d.set_option(1, "tf-ssd")
+        assert d.scheme == "mobilenet-ssd-postprocess"
+        d.set_option(1, "tflite-ssd")
+        assert d.scheme == "mobilenet-ssd"
+
+
+class TestOvPersonDetection:
+    def test_rows_terminator_and_threshold(self):
+        rows = np.zeros((200, 7), np.float32)
+        # row 0: confident person
+        rows[0] = [0, 1, 0.95, 0.1, 0.2, 0.4, 0.6]  # id,label,conf,x0,y0,x1,y1
+        # row 1: below the reference 0.8 threshold
+        rows[1] = [0, 1, 0.5, 0.0, 0.0, 1.0, 1.0]
+        # row 2: negative image_id terminates scanning
+        rows[2] = [-1, 0, 0, 0, 0, 0, 0]
+        rows[3] = [0, 1, 0.99, 0.0, 0.0, 1.0, 1.0]  # must NOT be seen
+        sink = decode_one(
+            tcaps("7:200", "float32"),
+            {"mode": "bounding_boxes", "option1": "ov-person-detection",
+             "option4": "64:64"},
+            [rows])
+        objs = sink.results[0].extra["objects"]
+        assert len(objs) == 1
+        o = objs[0]
+        assert (abs(o.xmin - 0.1) < 1e-6 and abs(o.ymin - 0.2) < 1e-6
+                and abs(o.xmax - 0.4) < 1e-6 and abs(o.ymax - 0.6) < 1e-6)
+
+    def test_ov_face_alias(self):
+        d = BoundingBoxDecoder()
+        d.set_option(1, "ov-face-detection")
+        assert d.scheme == "ov-person-detection"
+
+
+class TestMpPalmDetection:
+    def test_anchor_count_matches_reference_geometry(self):
+        """192/8=24 grid ×2 anchors + 192/16=12 grid ×6 anchors = 2016
+        (reference MP_PALM_DETECTION_DETECTION_MAX)."""
+        d = BoundingBoxDecoder()
+        d.set_option(1, "mp-palm-detection")
+        anchors = d._palm_anchor_table()
+        assert anchors.shape == (2016, 4)
+        # default scales 1.0 → all anchor h/w are 1.0
+        assert np.allclose(anchors[:, 2:], 1.0)
+
+    def test_decode_sigmoid_and_anchor_offset(self):
+        d = BoundingBoxDecoder()
+        d.set_option(1, "mp-palm-detection")
+        anchors = d._palm_anchor_table()
+        n = len(anchors)
+        boxes = np.zeros((n, 18), np.float32)
+        scores = np.full(n, -10.0, np.float32)  # sigmoid ≈ 0 everywhere
+        k = 100
+        scores[k] = 10.0                         # sigmoid ≈ 1
+        # box at anchor center, 48px (=0.25 of 192) square
+        boxes[k] = [0, 0, 48, 48] + [0] * 14
+        sink = decode_one(
+            tcaps("18:2016.2016:1", "float32.float32", n=2),
+            {"mode": "bounding_boxes", "option1": "mp-palm-detection",
+             "option5": "192:192", "option4": "64:64"},
+            [boxes, scores])
+        objs = sink.results[0].extra["objects"]
+        assert len(objs) == 1
+        o = objs[0]
+        ay, ax = anchors[k, 0], anchors[k, 1]
+        assert abs((o.ymin + o.ymax) / 2 - ay) < 1e-5
+        assert abs((o.xmin + o.xmax) / 2 - ax) < 1e-5
+        assert abs((o.ymax - o.ymin) - 0.25) < 1e-5
+
+
+class TestLabelSprites:
+    def test_label_text_composites_above_box(self, tmp_path):
+        labels = tmp_path / "labels.txt"
+        labels.write_text("zero\none\ntwo\n")
+        rows = np.array([[1, 0.9, 0.5, 0.25, 0.9, 0.75]], np.float32)
+        sink = decode_one(
+            tcaps("6:1", "float32"),
+            {"mode": "bounding_boxes", "option1": "raw",
+             "option2": str(labels), "option4": "64:64"},
+            [rows])
+        out = sink.results[0]
+        assert out.extra["objects"][0].label == "one"
+        canvas = out.np(0)
+        box_top = int(0.5 * 64)
+        sprite_band = canvas[box_top - 8:box_top - 1, 16:16 + 6 * 3]
+        assert sprite_band.any(), "label sprite pixels must be composited"
+        # sprite uses the box color
+        colored = sprite_band[sprite_band[..., 3] > 0]
+        assert colored.size and (colored == canvas[box_top, 20]).all()
+
+    def test_sprite_clips_at_canvas_edge(self):
+        from nnstreamer_tpu.decoders.rasterfont import composite_label
+
+        canvas = np.zeros((10, 10, 4), np.uint8)
+        composite_label(canvas, "WWWWW", 5, -3, (255, 0, 0, 255))
+        assert canvas.any()            # partially drawn
+        assert canvas.shape == (10, 10, 4)
+
+    def test_render_full_charset(self):
+        from nnstreamer_tpu.decoders.rasterfont import render_text
+
+        txt = "the quick brown fox 0123456789 JUMPS!?"
+        bm = render_text(txt)
+        assert bm.shape == (7, 6 * len(txt))
+        assert bm.any()
