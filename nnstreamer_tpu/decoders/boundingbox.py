@@ -266,16 +266,16 @@ class BoundingBoxDecoder(Decoder):
         _get_objects_mobilenet_ssd_pp, tensordec-boundingbox.c:1309)."""
         loc_i, cls_i, sc_i, num_i = self.pp_mapping
         if buf.num_tensors <= max(self.pp_mapping):
-            # graphs without the num tensor: treat every row as a candidate
-            loc_i, cls_i, sc_i = loc_i % buf.num_tensors, \
-                cls_i % buf.num_tensors, sc_i % buf.num_tensors
-            num = None
-        else:
-            num = int(np.asarray(buf.np(num_i)).reshape(-1)[0])
+            # reference validates MOBILENET_SSD_PP_MAX_TENSORS=4 up front
+            raise ValueError(
+                f"mobilenet-ssd-postprocess: tensor mapping "
+                f"{self.pp_mapping} needs {max(self.pp_mapping) + 1} "
+                f"tensors, buffer has {buf.num_tensors} (set option3)")
+        num = int(np.asarray(buf.np(num_i)).reshape(-1)[0])
         boxes = buf.np(loc_i).reshape(-1, buf.np(loc_i).shape[-1])
         classes = np.asarray(buf.np(cls_i)).reshape(-1)
         scores = np.asarray(buf.np(sc_i)).reshape(-1)
-        n = len(scores) if num is None else min(num, len(scores))
+        n = min(num, len(scores))
         thr = self._threshold(self.pp_threshold)
         out = []
         for d in range(n):

@@ -53,7 +53,12 @@ class ImageSegmentDecoder(Decoder):
 
     def get_out_caps(self, config: TensorsConfig) -> Caps:
         dims = tuple(config.info[0].dims)
-        if self.scheme == "argmax" or len(dims) == 2:
+        # dims are innermost-first; drop OUTERMOST unit dims (trailing
+        # here) — the batch-dim analogue of decode()'s stripping
+        while len(dims) > 2 and dims[-1] == 1:
+            dims = dims[:-1]
+        is_classmap = np.dtype(config.info[0].np_dtype).kind in "iu"
+        if self.scheme == "argmax" or is_classmap or len(dims) == 2:
             # pre-argmaxed map — native scheme or device-reduced pushdown
             w, h = (dims + (1, 1))[:2]
         else:
@@ -84,7 +89,18 @@ class ImageSegmentDecoder(Decoder):
 
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
         raw = buf.tensors[0]
-        if self.scheme == "argmax" or len(raw.shape) == 2:
+        # strip leading batch/unit dims (real tflite graphs emit
+        # (1, H, W, C); reference dims are 1-padded the same way).  An
+        # integer tensor is an already-argmaxed class map — native
+        # pre-argmaxed schemes and the device-reduced pushdown form both
+        # produce one — so it strips down to (H, W).
+        is_classmap = np.issubdtype(np.dtype(raw.dtype), np.integer)
+        floor = 2 if is_classmap else 3
+        while len(raw.shape) > floor and raw.shape[0] == 1:
+            raw = raw[0]
+        if raw is not buf.tensors[0]:
+            buf = buf.with_tensors([raw] + list(buf.tensors[1:]))
+        if self.scheme == "argmax" or is_classmap or len(raw.shape) == 2:
             # native pre-argmaxed scheme, or the device-reduced pushdown
             # form (filter already argmaxed on device)
             classes = buf.np(0).astype(np.int32)

@@ -241,12 +241,16 @@ class TensorSrcIIO(Source):
                           str(cap))
         self._write_sysfs(os.path.join(self._dev_dir, "buffer", "enable"),
                           "1")
-        # 4. open the chardev
+        # 4. open the chardev — on failure disable the buffer again so the
+        # kernel is not left capturing (a retry would then hit EBUSY on
+        # the channel-enable writes)
         dev_name = os.path.basename(self._dev_dir)
         path = os.path.join(str(self.dev_dir), dev_name)
         try:
             self._chardev = open(path, "rb", buffering=0)
         except OSError as e:
+            self._write_sysfs(os.path.join(self._dev_dir, "buffer",
+                                           "enable"), "0")
             raise ValueError(f"{self.name}: cannot open chardev {path}: "
                              f"{e}") from e
         # packed frame layout: channels at storage-size alignment, in

@@ -124,7 +124,6 @@ class TestTFLiteParser:
             open_backend(props)
 
     @needs_ref
-    @pytest.mark.slow
     def test_mobilenet_quant_orange(self):
         """Golden semantics: the reference ssat suite classifies orange.png
         as 'orange' (tests/nnstreamer_filter_tensorflow2_lite/runTest.sh)."""

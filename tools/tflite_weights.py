@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Import real pretrained weights from a .tflite file into a registry model.
+
+The reference ships real model artifacts (tests/test_models/models/
+mobilenet_v2_1.0_224_quant.tflite) and serves them through the tflite
+interpreter; this tool closes the same gap for the XLA-registry models:
+it dequantizes the tflite weights (per-channel where quantized), maps them
+by tensor NAME onto the flax parameter tree, and writes an orbax
+checkpoint the xla backend restores via ``custom=checkpoint:<path>``.
+
+Folded-BN handling: the quant tflite has BatchNorm folded into conv
+weights + bias, while the flax model keeps explicit inference-mode BN.
+Each BN is therefore set to identity-with-bias — scale=1, mean=0,
+var=1-eps (so 1/sqrt(var+eps) == 1), bias=the tflite folded bias — which
+reproduces conv+bias exactly.
+
+Usage:
+  python tools/tflite_weights.py mobilenet_v2 \
+      /root/reference/tests/test_models/models/mobilenet_v2_1.0_224_quant.tflite \
+      /tmp/mobilenet_v2_ckpt
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+BN_EPS = 1e-5  # flax nn.BatchNorm default
+
+
+def _named_weights(path: str) -> Dict[str, np.ndarray]:
+    """tensor-name → dequantized float32 array for every const tensor."""
+    from nnstreamer_tpu.filter.backends.tflite import (_const_array,
+                                                       _dequant, parse_tflite)
+
+    with open(path, "rb") as f:
+        g = parse_tflite(f.read())
+    out: Dict[str, np.ndarray] = {}
+    for idx, spec in enumerate(g.tensors):
+        arr = _const_array(g, idx)
+        if arr is None:
+            continue
+        if spec.quantized:
+            arr = _dequant(arr, spec)
+        out[spec.name] = np.asarray(arr, np.float32)
+    return out
+
+
+def _bn_identity(bias: np.ndarray):
+    """(scale, bias, mean, var) making BN compute ``x + bias`` exactly."""
+    n = bias.shape[0]
+    return (np.ones(n, np.float32), bias.astype(np.float32),
+            np.zeros(n, np.float32), np.full(n, 1.0 - BN_EPS, np.float32))
+
+
+def mobilenet_v2_params_from_tflite(path: str):
+    """Map mobilenet_v2_1.0_224_quant.tflite weights onto the flax
+    MobileNetV2 tree (models/mobilenet_v2.py)."""
+    w = _named_weights(path)
+    params: Dict = {}
+    stats: Dict = {}
+
+    def conv_bn(dst: str, weight_name: str, bias_name: str,
+                depthwise: bool) -> None:
+        kernel = w[weight_name]
+        if depthwise:   # tflite (1, kh, kw, C) -> flax (kh, kw, 1, C)
+            kernel = kernel.transpose(1, 2, 0, 3)
+        else:           # tflite OHWI -> flax HWIO
+            kernel = kernel.transpose(1, 2, 3, 0)
+        scale, bias, mean, var = _bn_identity(w[bias_name])
+        node = params
+        snode = stats
+        parts = dst.split("/")
+        for p in parts:
+            node = node.setdefault(p, {})
+            snode = snode.setdefault(p, {})
+        node["Conv_0"] = {"kernel": kernel}
+        node["BatchNorm_0"] = {"scale": scale, "bias": bias}
+        snode["BatchNorm_0"] = {"mean": mean, "var": var}
+
+    def project_bn(dst: str, weight_name: str, bias_name: str) -> None:
+        """project conv + its BN live directly on the block node."""
+        kernel = w[weight_name].transpose(1, 2, 3, 0)
+        scale, bias, mean, var = _bn_identity(w[bias_name])
+        node = params.setdefault(dst, {})
+        snode = stats.setdefault(dst, {})
+        node["Conv_0"] = {"kernel": kernel}
+        node["BatchNorm_0"] = {"scale": scale, "bias": bias}
+        snode["BatchNorm_0"] = {"mean": mean, "var": var}
+
+    W = "weights_quant/FakeQuantWithMinMaxVars"
+    # stem
+    conv_bn("_ConvBN_0", f"MobilenetV2/Conv/{W}",
+            "MobilenetV2/Conv/Conv2D_Fold_bias", depthwise=False)
+    # block 0 (no expand: depthwise is the block's _ConvBN_0)
+    conv_bn("_InvertedResidual_0/_ConvBN_0",
+            f"MobilenetV2/expanded_conv/depthwise/{W}",
+            "MobilenetV2/expanded_conv/depthwise/depthwise_Fold_bias",
+            depthwise=True)
+    project_bn("_InvertedResidual_0",
+               f"MobilenetV2/expanded_conv/project/{W}",
+               "MobilenetV2/expanded_conv/project/Conv2D_Fold_bias")
+    # blocks 1..16
+    for i in range(1, 17):
+        pre = f"MobilenetV2/expanded_conv_{i}"
+        conv_bn(f"_InvertedResidual_{i}/_ConvBN_0", f"{pre}/expand/{W}",
+                f"{pre}/expand/Conv2D_Fold_bias", depthwise=False)
+        conv_bn(f"_InvertedResidual_{i}/_ConvBN_1", f"{pre}/depthwise/{W}",
+                f"{pre}/depthwise/depthwise_Fold_bias", depthwise=True)
+        project_bn(f"_InvertedResidual_{i}", f"{pre}/project/{W}",
+                   f"{pre}/project/Conv2D_Fold_bias")
+    # head
+    conv_bn("_ConvBN_1", f"MobilenetV2/Conv_1/{W}",
+            "MobilenetV2/Conv_1/Conv2D_Fold_bias", depthwise=False)
+    # logits: 1x1 conv (1001,1,1,1280) -> Dense (1280, 1001)
+    lk = [k for k in w if "Logits" in k and w[k].ndim == 4]
+    lb = [k for k in w if "Logits" in k and "bias" in k and w[k].ndim == 1]
+    if len(lk) != 1 or len(lb) != 1:
+        raise ValueError(f"cannot identify logits tensors: {lk} {lb}")
+    params["Dense_0"] = {
+        "kernel": w[lk[0]].reshape(w[lk[0]].shape[0], -1).T,
+        "bias": w[lb[0]],
+    }
+    return {"params": params, "batch_stats": stats}
+
+
+_IMPORTERS = {"mobilenet_v2": mobilenet_v2_params_from_tflite}
+
+
+def import_weights(model_name: str, tflite_path: str, out_path: str) -> None:
+    import jax
+
+    from nnstreamer_tpu.models.registry import get_model, save_checkpoint
+
+    if model_name not in _IMPORTERS:
+        raise SystemExit(f"no tflite importer for {model_name!r} "
+                         f"(have: {sorted(_IMPORTERS)})")
+    new = _IMPORTERS[model_name](tflite_path)
+    model = get_model(model_name, {"dtype": "float32"})
+    # structural check: imported tree must match the model's exactly
+    ref_paths = {jax.tree_util.keystr(p): v.shape for p, v in
+                 jax.tree_util.tree_flatten_with_path(model.params)[0]}
+    new_paths = {jax.tree_util.keystr(p): np.asarray(v).shape for p, v in
+                 jax.tree_util.tree_flatten_with_path(new)[0]}
+    if ref_paths != new_paths:
+        missing = set(ref_paths) - set(new_paths)
+        extra = set(new_paths) - set(ref_paths)
+        shapes = {k for k in set(ref_paths) & set(new_paths)
+                  if ref_paths[k] != new_paths[k]}
+        raise SystemExit(f"tree mismatch: missing={sorted(missing)[:5]} "
+                         f"extra={sorted(extra)[:5]} "
+                         f"shape-diff={sorted(shapes)[:5]}")
+    model.params = new
+    save_checkpoint(model, out_path)
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 4:
+        raise SystemExit(__doc__)
+    import_weights(sys.argv[1], sys.argv[2], sys.argv[3])
