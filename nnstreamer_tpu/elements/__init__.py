@@ -22,6 +22,7 @@ from . import transform  # noqa: F401
 from ..query import client as _query_client  # noqa: F401
 from ..query import edge as _query_edge  # noqa: F401
 from ..query import grpc_service as _query_grpc  # noqa: F401
+from ..query import mqtt as _query_mqtt  # noqa: F401
 from ..query import server as _query_server  # noqa: F401
 
 from .aggregator import TensorAggregator

@@ -73,6 +73,10 @@ class TensorAggregator(Element):
         else:
             self._dim = int(self.frames_dim)
             self._axis_new = False
+            if self._dim >= len(dims):
+                # reference dims are 1-padded to the rank limit, so any
+                # frames-dim up to rank 8 is addressable
+                dims = dims + [1] * (self._dim + 1 - len(dims))
             per_buf = dims[self._dim]
             dims[self._dim] = per_buf * fout // max(fin, 1)
         rate = cfg.rate
@@ -93,8 +97,11 @@ class TensorAggregator(Element):
         if self._axis_new:
             merged = np.stack(self._window[:need], axis=0)
         else:
-            axis = self._window[0].ndim - 1 - self._dim
-            merged = np.concatenate(self._window[:need], axis=axis)
+            frames = [f.reshape((1,) * (self._dim + 1 - f.ndim) + f.shape)
+                      if f.ndim <= self._dim else f
+                      for f in self._window[:need]]
+            axis = frames[0].ndim - 1 - self._dim
+            merged = np.concatenate(frames, axis=axis)
         out = TensorBuffer(tensors=[merged], pts=self._pts[0],
                            duration=buf.duration)
         self._window = self._window[self._hop_bufs:]

@@ -1,0 +1,162 @@
+"""MQTT pub/sub elements (query/mqtt.py).
+
+Parity with gst/mqtt/mqttsink.c + mqttsrc.c: standard MQTT 3.1.1 wire
+(in-tree client + localhost broker, the reference's check_broker.sh
+strategy), the exact 1024-byte GstMQTTMessageHdr layout
+(mqttcommon.h:29-61), caps propagation through the header's caps string,
+and base-time-epoch PTS re-basing.
+"""
+
+import struct
+import time
+
+import numpy as np
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.query.mqtt import (CLOCK_NONE, HDR_LEN, MAX_CAPS_LEN,
+                                       MqttBroker, MqttClient, get_mqtt_broker,
+                                       pack_header, unpack_header)
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+CAPS = ("other/tensors,format=static,num_tensors=2,dimensions=4:3.2,"
+        "types=float32.uint8,framerate=30/1")
+
+
+class TestHeaderLayout:
+    def test_exact_reference_offsets(self):
+        """Field offsets of GstMQTTMessageHdr with natural C alignment:
+        num_mems@0, size_mems@8, base@136, sent@144, duration@152,
+        dts@160, pts@168, caps@176, total 1024."""
+        hdr = pack_header([100, 200], 111, 222, 5, None, 777, "caps!")
+        assert len(hdr) == HDR_LEN == 1024
+        assert struct.unpack_from("<I", hdr, 0)[0] == 2
+        assert struct.unpack_from("<Q", hdr, 8)[0] == 100
+        assert struct.unpack_from("<Q", hdr, 16)[0] == 200
+        assert struct.unpack_from("<q", hdr, 136)[0] == 111
+        assert struct.unpack_from("<q", hdr, 144)[0] == 222
+        assert struct.unpack_from("<Q", hdr, 152)[0] == 5
+        assert struct.unpack_from("<Q", hdr, 160)[0] == CLOCK_NONE
+        assert struct.unpack_from("<Q", hdr, 168)[0] == 777
+        assert hdr[176:176 + 5] == b"caps!"
+        assert 176 + MAX_CAPS_LEN <= 1024
+
+    def test_round_trip(self):
+        hdr = pack_header([1, 2, 3], -5, 6, None, 7, None, "x" * 100)
+        sizes, base, sent, dur, dts, pts, caps = unpack_header(hdr)
+        assert sizes == [1, 2, 3] and base == -5 and sent == 6
+        assert dur is None and dts == 7 and pts is None
+        assert caps == "x" * 100
+
+
+class TestWireProtocol:
+    def test_pub_sub_through_broker(self):
+        broker = MqttBroker()
+        try:
+            sub = MqttClient(broker.host, broker.port, "sub1")
+            sub.subscribe("t/1")
+            pub = MqttClient(broker.host, broker.port, "pub1")
+            pub.publish("t/1", b"hello")
+            pub.publish("t/other", b"nope")
+            pub.publish("t/1", b"world")
+            assert sub.recv_publish() == ("t/1", b"hello")
+            assert sub.recv_publish() == ("t/1", b"world")
+            pub.close()
+            sub.close()
+        finally:
+            broker.close()
+
+
+class TestElements:
+    def test_sink_to_src_round_trip(self):
+        broker = get_mqtt_broker()
+        rx = parse_launch(
+            f"mqttsrc port={broker.port} sub-topic=bench num-buffers=3 "
+            "name=rx ! tensor_sink name=out")
+        got = []
+        rx.get("out").connect("new-data", lambda b: got.append(b))
+        rx.play()
+        time.sleep(0.2)      # subscriber in place before publishes
+        tx = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            f"mqttsink port={broker.port} pub-topic=bench")
+        tx.play()
+        rng = np.random.default_rng(3)
+        frames = [(rng.standard_normal((3, 4)).astype(np.float32),
+                   rng.integers(0, 255, (2,), dtype=np.uint8))
+                  for _ in range(3)]
+        for a, b in frames:
+            tx.get("in").push_buffer(TensorBuffer(tensors=[a, b],
+                                                  pts=1000))
+        tx.get("in").end_of_stream()
+        rx.wait(timeout=30)
+        tx.wait(timeout=30)
+        rx.stop()
+        tx.stop()
+        assert len(got) == 3
+        for (a, b), out in zip(frames, got):
+            assert out.num_tensors == 2
+            np.testing.assert_allclose(out.np(0), a)
+            np.testing.assert_array_equal(out.np(1), b)
+        # caps traveled in the header's caps string
+        st = rx.get("rx").src_pad.caps.first()
+        assert st.get("types") == "float32.uint8"
+
+    def test_sync_pts_rebase(self):
+        broker = get_mqtt_broker()
+        rx = parse_launch(
+            f"mqttsrc port={broker.port} sub-topic=ts num-buffers=1 "
+            "sync-pts=true name=rx ! tensor_sink name=out")
+        got = []
+        rx.get("out").connect("new-data", lambda b: got.append(b))
+        rx.play()
+        time.sleep(0.2)
+        caps1 = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+                 "types=float32,framerate=0/1")
+        tx = parse_launch(
+            f"appsrc caps={caps1} name=in ! "
+            f"mqttsink port={broker.port} pub-topic=ts")
+        tx.play()
+        tx.get("in").push_buffer(
+            TensorBuffer(tensors=[np.zeros(4, np.float32)], pts=10_000_000))
+        tx.get("in").end_of_stream()
+        rx.wait(timeout=30)
+        tx.stop()
+        rx.stop()
+        # both sides share the wall clock, so the re-based PTS stays within
+        # clock-skew distance of the original (the alignment contract)
+        assert got and abs(got[0].pts - 10_000_000) < 5_000_000_000
+
+
+class TestQoS1Interop:
+    def test_qos1_publish_downgraded_cleanly(self):
+        """External QoS-1 publishers (mosquitto_pub -q 1 style) get a
+        PUBACK and subscribers receive the payload WITHOUT the packet-id
+        bytes leaking in."""
+        import socket
+        import struct as st
+
+        from nnstreamer_tpu.query.mqtt import (MqttBroker, MqttClient,
+                                               _mqtt_str, _read_packet,
+                                               _remaining_len)
+
+        broker = MqttBroker()
+        try:
+            sub = MqttClient(broker.host, broker.port, "s")
+            sub.subscribe("q")
+            raw = socket.create_connection((broker.host, broker.port))
+            var = _mqtt_str("MQTT") + bytes([4, 2]) + st.pack(">H", 0)
+            pay = _mqtt_str("ext")
+            raw.sendall(bytes([0x10]) + _remaining_len(len(var) + len(pay))
+                        + var + pay)
+            assert _read_packet(raw)[0] >> 4 == 2  # CONNACK
+            # QoS-1 PUBLISH: topic + packet-id 0x1234 + payload
+            body = _mqtt_str("q") + st.pack(">H", 0x1234) + b"payload!"
+            raw.sendall(bytes([0x32]) + _remaining_len(len(body)) + body)
+            ptype, ack = _read_packet(raw)
+            assert ptype >> 4 == 4                 # PUBACK
+            assert st.unpack(">H", ack)[0] == 0x1234
+            assert sub.recv_publish() == ("q", b"payload!")
+            raw.close()
+            sub.close()
+        finally:
+            broker.close()
