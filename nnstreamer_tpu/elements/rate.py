@@ -52,9 +52,15 @@ class TensorRate(Element):
 
     @property
     def effective_rate(self) -> Fraction:
+        """QoS-adapted output rate: target / proportion.  The proportion
+        is quantized to millesimals for an exact Fraction — reports in
+        (1.0, 1.001) round DOWN to no-op, which is below any actionable
+        slowdown (the reference's integer-ns throttling interval
+        quantizes harder)."""
         p = self._qos_proportion
-        return self._target if p <= 1.0 else self._target / Fraction(
-            int(p * 1000), 1000)
+        quant = Fraction(int(p * 1000), 1000)
+        return self._target if p <= 1.0 or quant <= 1 \
+            else self._target / quant
 
     def set_caps(self, pad, caps):
         cfg = config_from_caps(caps)

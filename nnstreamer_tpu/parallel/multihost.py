@@ -25,16 +25,24 @@ _initialized = False
 
 def initialize(coordinator: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None,
+               _backend=None) -> None:
     """Join the cross-host runtime.  On Cloud TPU the arguments are
     auto-detected from the metadata server when omitted; explicit values
     support bring-your-own clusters (reference role: nnstreamer-edge
-    host/port wiring)."""
+    host/port wiring).
+
+    ``_backend`` is a test seam: a callable standing in for
+    ``jax.distributed.initialize`` (which cannot run single-host), so the
+    argument plumbing is coverable without a cluster.
+    """
     global _initialized
     if _initialized:
         return
-    import jax
+    if _backend is None:
+        import jax
 
+        _backend = jax.distributed.initialize
     kwargs = {}
     if coordinator is not None:
         kwargs["coordinator_address"] = coordinator
@@ -42,7 +50,7 @@ def initialize(coordinator: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+    _backend(**kwargs)
     _initialized = True
 
 

@@ -158,13 +158,28 @@ def shutdown_brokers() -> None:
 
 @register_element
 class EdgeSink(Element):
-    """Publish the stream to a broker topic (edge_sink role)."""
+    """Publish the stream to a broker topic (edge_sink role).
+
+    ``connect-type`` mirrors libnnstreamer-edge's transports
+    (tensor_query_common.h:33-34): ``tcp`` (default) talks straight to
+    the TCP broker; ``hybrid`` additionally advertises the broker's
+    ``host:port`` as a RETAINED MQTT message on ``nns/edge/<topic>`` so
+    subscribers discover the data channel via the MQTT broker and then
+    stream over TCP — the reference's MQTT-hybrid control/data split
+    (Documentation/component-description.md:158-163)."""
 
     FACTORY = "edge_sink"
     PROPERTIES = {
         "host": ("127.0.0.1", "broker host"),
         "port": (0, "broker port"),
         "topic": ("default", ""),
+        "connect-type": ("tcp", "tcp | hybrid (MQTT discovery + TCP data)"),
+        "mqtt-host": ("127.0.0.1", "MQTT broker host (connect-type=hybrid)"),
+        "mqtt-port": (1883, "MQTT broker port (connect-type=hybrid)"),
+        "advertise-host": (None, "externally reachable address published "
+                                 "in the hybrid discovery record (default: "
+                                 "the host property — loopback only "
+                                 "reaches same-host subscribers)"),
         "ntp-host": (None, "NTP server(s) for epoch alignment, comma-sep "
                            "(default: local wall clock)"),
     }
@@ -182,8 +197,21 @@ class EdgeSink(Element):
         # start, when running-time 0 ≈ now — the reference mqttsink's
         # base_time_epoch (mqttsink.c, synchronization-in-mqtt-elements.md)
         self._base_epoch_us = stream_origin_epoch_us(self.ntp_host, self.name)
+        self._mqtt = None
+        if str(self.connect_type) == "hybrid":
+            from .mqtt import MqttClient
+
+            self._mqtt = MqttClient(str(self.mqtt_host),
+                                    int(self.mqtt_port),
+                                    f"nns-edge-sink-{self.name}")
+            adv = str(self.advertise_host or self.host)
+            self._mqtt.publish(
+                f"nns/edge/{self.topic}",
+                f"{adv}:{int(self.port)}".encode(), retain=True)
 
     def stop(self):
+        if self._mqtt is not None:
+            self._mqtt.close()
         try:
             send_msg(self._sock, Message(T_BYE))
             self._sock.close()
@@ -212,13 +240,21 @@ class EdgeSink(Element):
 
 @register_element
 class EdgeSrc(Source):
-    """Subscribe to a broker topic (edge_src role)."""
+    """Subscribe to a broker topic (edge_src role).
+
+    ``connect-type=hybrid`` discovers the TCP broker's address from the
+    RETAINED MQTT record a hybrid edge_sink published on
+    ``nns/edge/<topic>`` — the subscriber then needs only the MQTT
+    broker's address (the reference's MQTT-hybrid discovery)."""
 
     FACTORY = "edge_src"
     PROPERTIES = {
         "host": ("127.0.0.1", "broker host"),
         "port": (0, "broker port"),
         "topic": ("default", ""),
+        "connect-type": ("tcp", "tcp | hybrid (MQTT discovery + TCP data)"),
+        "mqtt-host": ("127.0.0.1", "MQTT broker host (connect-type=hybrid)"),
+        "mqtt-port": (1883, "MQTT broker port (connect-type=hybrid)"),
         "caps": (None, "override caps (else retained topic caps)"),
         "num-buffers": (-1, "stop after N buffers, -1 unlimited"),
         "sync-pts": (False, "re-base incoming PTS onto this host's clock "
@@ -229,12 +265,36 @@ class EdgeSrc(Source):
     def _make_pads(self):
         self.add_src_pad(tensors_template_caps(), "src")
 
+    def _discover_hybrid(self) -> None:
+        """Resolve host/port from the retained MQTT discovery record."""
+        from .mqtt import MqttClient
+
+        client = MqttClient(str(self.mqtt_host), int(self.mqtt_port),
+                            f"nns-edge-src-{self.name}")
+        try:
+            client.subscribe(f"nns/edge/{self.topic}")
+            # bound the wait: with no retained record the broker sends
+            # nothing (mirrors the TCP path's 10 s connect timeout)
+            client._sock.settimeout(10)
+            got = client.recv_publish()
+            if got is None:
+                raise ValueError(
+                    f"{self.name}: no retained discovery record on "
+                    f"nns/edge/{self.topic}")
+            addr = got[1].decode()
+            host, _, port = addr.rpartition(":")
+            self.host, self.port = host, int(port)
+        finally:
+            client.close()
+
     def start(self):
         from ..utils.ntp import stream_origin_epoch_us
 
         # own stream-origin epoch, for re-basing sender PTS (the receiver
         # half of the reference's NTP-based mqtt timestamp alignment)
         self._base_epoch_us = stream_origin_epoch_us(self.ntp_host, self.name)
+        if str(self.connect_type) == "hybrid":
+            self._discover_hybrid()
         self._sock = socket.create_connection(
             (str(self.host), int(self.port)), timeout=10)
         send_msg(self._sock, Message(T_HELLO,

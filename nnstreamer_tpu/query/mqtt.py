@@ -160,10 +160,11 @@ class MqttClient:
             off += 2
         return topic, pid, data[off:]
 
-    def publish(self, topic: str, payload: bytes) -> None:
+    def publish(self, topic: str, payload: bytes,
+                retain: bool = False) -> None:
         var = _mqtt_str(topic)   # QoS 0: no packet id
         with self._lock:
-            self._sock.sendall(bytes([0x30])
+            self._sock.sendall(bytes([0x31 if retain else 0x30])
                                + _remaining_len(len(var) + len(payload))
                                + var + payload)
 
@@ -233,6 +234,7 @@ class MqttBroker:
         self._sock.listen(16)
         self._subs: Dict[str, Set[socket.socket]] = {}
         self._locks: Dict[socket.socket, threading.Lock] = {}
+        self._retained: Dict[str, bytes] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         threading.Thread(target=self._accept, daemon=True,
@@ -266,14 +268,35 @@ class MqttBroker:
                     tlen = struct.unpack(">H", data[2:4])[0]
                     topic = data[4:4 + tlen].decode()
                     topics.append(topic)
+                    # take this conn's send lock BEFORE releasing the
+                    # broker lock: a concurrent publisher snapshots the
+                    # new subscriber and then needs the send lock, so it
+                    # cannot interleave with (or overtake) the
+                    # SUBACK+retained writes (same handoff as
+                    # edge.EdgeBroker)
                     with self._lock:
                         self._subs.setdefault(topic, set()).add(conn)
-                    conn.sendall(bytes([0x90, 3]) + pid + bytes([0]))
+                        retained = self._retained.get(topic)
+                        slock = self._locks.get(conn)
+                        if slock is not None:
+                            slock.acquire()
+                    try:
+                        conn.sendall(bytes([0x90, 3]) + pid + bytes([0]))
+                        if retained is not None:
+                            body = _mqtt_str(topic) + retained
+                            conn.sendall(bytes([0x31])
+                                         + _remaining_len(len(body)) + body)
+                    finally:
+                        if slock is not None:
+                            slock.release()
                 elif code == 3:     # PUBLISH → fan out (downgraded to QoS 0)
                     topic, pid, body = MqttClient._split_publish(ptype, data)
                     if pid is not None:   # QoS-1 sender needs a PUBACK
                         conn.sendall(bytes([0x40, 2])
                                      + struct.pack(">H", pid))
+                    if ptype & 0x01:      # retain flag
+                        with self._lock:
+                            self._retained[topic] = body
                     out = _mqtt_str(topic) + body
                     with self._lock:
                         subs = [(s, self._locks.get(s))

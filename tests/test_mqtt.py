@@ -160,3 +160,60 @@ class TestQoS1Interop:
             sub.close()
         finally:
             broker.close()
+
+
+class TestEdgeHybrid:
+    def test_hybrid_discovery_then_tcp_stream(self):
+        """connect-type=hybrid: edge_sink advertises the TCP broker via a
+        retained MQTT record; edge_src discovers it knowing only the MQTT
+        broker (libnnstreamer-edge HYBRID semantics)."""
+        import time
+
+        from nnstreamer_tpu.query.edge import get_broker
+        from nnstreamer_tpu.query.mqtt import get_mqtt_broker
+
+        tcp = get_broker()
+        mq = get_mqtt_broker()
+        caps1 = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+                 "types=float32,framerate=0/1")
+        tx = parse_launch(
+            f"appsrc caps={caps1} name=in ! "
+            f"edge_sink host=127.0.0.1 port={tcp.port} topic=hy "
+            f"connect-type=hybrid mqtt-port={mq.port}")
+        tx.play()
+        time.sleep(0.2)
+        # src is given ONLY the MQTT broker address
+        rx = parse_launch(
+            f"edge_src topic=hy connect-type=hybrid mqtt-port={mq.port} "
+            "num-buffers=2 name=rx ! tensor_sink name=out")
+        got = []
+        rx.get("out").connect("new-data", lambda b: got.append(b))
+        rx.play()
+        time.sleep(0.2)
+        for i in range(2):
+            tx.get("in").push_buffer(
+                TensorBuffer(tensors=[np.full(4, float(i), np.float32)]))
+        tx.get("in").end_of_stream()
+        rx.wait(timeout=30)
+        tx.wait(timeout=30)
+        rx.stop()
+        tx.stop()
+        assert rx.get("rx").port == tcp.port  # discovered, not configured
+        assert len(got) == 2
+        np.testing.assert_allclose(got[1].np(0), [1, 1, 1, 1])
+
+    def test_retained_message_for_late_subscriber(self):
+        from nnstreamer_tpu.query.mqtt import MqttBroker, MqttClient
+
+        broker = MqttBroker()
+        try:
+            pub = MqttClient(broker.host, broker.port, "p")
+            pub.publish("r/1", b"state", retain=True)
+            time.sleep(0.1)
+            sub = MqttClient(broker.host, broker.port, "s")
+            sub.subscribe("r/1")   # subscribes AFTER the publish
+            assert sub.recv_publish() == ("r/1", b"state")
+            pub.close()
+            sub.close()
+        finally:
+            broker.close()

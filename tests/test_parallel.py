@@ -198,3 +198,37 @@ class TestTrainStep:
         # capacity = ceil(16/2*0.25) = 2 → exactly 2 tokens served
         served = np.count_nonzero(np.abs(out).sum(-1) > 1e-9)
         assert served == 2, served
+
+
+class TestMultihostPlumbing:
+    def test_initialize_arg_plumbing_via_backend_seam(self):
+        """jax.distributed.initialize cannot run single-host; the seam
+        verifies the coordinator/process wiring and the idempotence
+        guard."""
+        import nnstreamer_tpu.parallel.multihost as mh
+
+        calls = []
+        old = mh._initialized
+        mh._initialized = False
+        try:
+            mh.initialize(coordinator="10.0.0.1:8476", num_processes=4,
+                          process_id=2, _backend=lambda **kw: calls.append(kw))
+            assert calls == [{"coordinator_address": "10.0.0.1:8476",
+                              "num_processes": 4, "process_id": 2}]
+            assert mh.is_initialized()
+            mh.initialize(_backend=lambda **kw: calls.append(kw))
+            assert len(calls) == 1          # second call is a no-op
+        finally:
+            mh._initialized = old
+
+    def test_initialize_auto_detect_passes_no_args(self):
+        import nnstreamer_tpu.parallel.multihost as mh
+
+        calls = []
+        old = mh._initialized
+        mh._initialized = False
+        try:
+            mh.initialize(_backend=lambda **kw: calls.append(kw))
+            assert calls == [{}]            # Cloud TPU metadata auto-detect
+        finally:
+            mh._initialized = old
