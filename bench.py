@@ -53,6 +53,9 @@ BATCH = 64           # vmap-batched invoke mode
 # bf16 peak of one TPU v5e chip, for MFU; other platforms: no MFU claim.
 PEAK_FLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12,
               "v4": 275e12, "v6e": 918e12}
+# HBM bandwidth (bytes/s) per chip, for the roofline bound
+PEAK_BW = {"v5e": 819e9, "v5litepod": 819e9, "v5p": 2765e9,
+           "v4": 1228e9, "v6e": 1640e9}
 
 CONFIG_METRICS = {
     "mobilenet": "mobilenet_v2_224_image_labeling_e2e_fps",
@@ -122,9 +125,9 @@ def _invoke_p50(fw, size: int) -> float:
     return lats[len(lats) // 2]
 
 
-def _model_flops(model, device) -> float:
-    """Per-frame forward FLOPs from XLA cost analysis (0.0 if the backend
-    doesn't expose it, e.g. some remote-compile paths)."""
+def _model_cost(model, device):
+    """Per-frame (flops, bytes_accessed) from XLA cost analysis
+    ((0, 0) if the backend doesn't expose it)."""
     import jax
 
     try:
@@ -133,9 +136,21 @@ def _model_flops(model, device) -> float:
         cost = lowered.compile().cost_analysis()
         if isinstance(cost, list):  # older jax returns [dict]
             cost = cost[0] if cost else {}
-        return float(cost.get("flops", 0.0)) if cost else 0.0
+        if not cost:
+            return 0.0, 0.0
+        return (float(cost.get("flops", 0.0)),
+                float(cost.get("bytes accessed", 0.0)))
     except Exception:
-        return 0.0
+        return 0.0, 0.0
+
+
+def _peak_bw(device) -> float:
+    kind = (getattr(device, "device_kind", "") or "").lower().replace(" ", "")
+    for key, bw in PEAK_BW.items():
+        if key in kind:
+            return bw
+    plat = getattr(device, "platform", "")
+    return PEAK_BW["v5e"] if plat == "tpu" else 0.0
 
 
 def _peak_flops(device) -> float:
@@ -205,8 +220,9 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
             emit(out)
         model = fw._model
         device = fw._device
-        flops = _model_flops(model, device)
+        flops, bytes_acc = _model_cost(model, device)
         peak = _peak_flops(device)
+        bw = _peak_bw(device)
         bfps = 0.0
         try:
             bfps = _batched_fps(model, device, size)
@@ -220,6 +236,18 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
             out["mfu_stream"] = round(fps * flops / peak, 6)
             if bfps:
                 out["mfu_batched"] = round(bfps * flops / peak, 6)
+        if bytes_acc and peak and bw:
+            # roofline: per-frame arithmetic intensity vs the machine
+            # balance decides the bound; the implied fps ceiling is the
+            # binding resource's rate (single frame, no batching)
+            intensity = flops / bytes_acc
+            balance = peak / bw
+            out["bytes_per_frame"] = round(bytes_acc)
+            out["arith_intensity"] = round(intensity, 2)
+            out["roofline_bound"] = ("memory" if intensity < balance
+                                     else "compute")
+            out["roofline_fps"] = round(min(peak / flops,
+                                            bw / bytes_acc), 1)
     if bfps:
         out["batched_fps"] = round(bfps, 2)
         out["batch"] = BATCH

@@ -1,0 +1,155 @@
+// Native dataset reader: the framework's data-loader (gstdatareposrc.c
+// role, reimplemented as a native IO engine instead of whole-file reads).
+//
+// Design: a background prefetch thread fills a ring of frame-sized slots
+// with pread(2) while the pipeline consumes — file IO overlaps pipeline
+// compute, bounded memory (capacity * frame_bytes) regardless of dataset
+// size, sequential access hinted to the kernel via posix_fadvise.
+// Exposed through a C ABI for ctypes (no pybind11 in the image); consumed
+// by nnstreamer_tpu/native.py RepoReader with a Python mmap fallback.
+
+#include <cerrno>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Reader {
+  int fd = -1;
+  size_t frame_bytes = 0;
+  long num_frames = 0;
+  int capacity = 0;
+  long next_read = 0;      // next frame index the prefetcher fetches
+  long next_serve = 0;     // next frame index next() hands out
+  bool eof_wrap = false;   // wrap at end (multi-epoch streaming)
+  bool stop = false;
+  std::vector<uint8_t> ring;       // capacity * frame_bytes
+  std::vector<long> slot_frame;    // frame index held by each slot (-1 empty)
+  std::vector<int8_t> slot_err;    // per-slot IO failure flag
+  std::mutex mu;
+  std::condition_variable cv_can_read;
+  std::condition_variable cv_can_serve;
+  std::thread worker;
+
+  void prefetch_loop() {
+    for (;;) {
+      long frame;
+      int slot;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_can_read.wait(lk, [&] {
+          return stop ||
+                 (next_read < next_serve + capacity &&
+                  (eof_wrap || next_read < num_frames));
+        });
+        if (stop) return;
+        if (!eof_wrap && next_read >= num_frames) return;
+        frame = next_read++;
+        slot = static_cast<int>(frame % capacity);
+      }
+      const long idx = frame % num_frames;
+      size_t off = 0;
+      bool failed = false;
+      uint8_t *dst = ring.data() + static_cast<size_t>(slot) * frame_bytes;
+      while (off < frame_bytes) {
+        ssize_t r = pread(fd, dst + off, frame_bytes - off,
+                          static_cast<off_t>(idx) * frame_bytes + off);
+        if (r <= 0) {
+          if (r < 0 && errno == EINTR) continue;
+          failed = true;  // truncated file / IO error: flag, don't fake
+          break;
+        }
+        off += static_cast<size_t>(r);
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        slot_err[slot] = failed ? 1 : 0;
+        slot_frame[slot] = frame;
+      }
+      cv_can_serve.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Open a frame dataset.  capacity = prefetch ring depth; wrap != 0 keeps
+// reading modulo num_frames (multi-epoch).  Returns nullptr on error.
+void *tw_reader_open(const char *path, size_t frame_bytes, int capacity,
+                     int wrap) {
+  if (frame_bytes == 0 || capacity <= 0) return nullptr;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  off_t size = lseek(fd, 0, SEEK_END);
+  if (size < static_cast<off_t>(frame_bytes)) {
+    close(fd);
+    return nullptr;
+  }
+#ifdef POSIX_FADV_SEQUENTIAL
+  posix_fadvise(fd, 0, 0, POSIX_FADV_SEQUENTIAL);
+#endif
+  auto *r = new Reader();
+  r->fd = fd;
+  r->frame_bytes = frame_bytes;
+  r->num_frames = static_cast<long>(size / frame_bytes);
+  r->capacity = capacity;
+  r->eof_wrap = wrap != 0;
+  r->ring.resize(static_cast<size_t>(capacity) * frame_bytes);
+  r->slot_frame.assign(capacity, -1);
+  r->slot_err.assign(capacity, 0);
+  r->worker = std::thread(&Reader::prefetch_loop, r);
+  return r;
+}
+
+long tw_reader_frames(void *h) {
+  return h ? static_cast<Reader *>(h)->num_frames : -1;
+}
+
+// Copy the next frame into dst.  Returns the global frame index served
+// (epoch * num_frames + i when wrapping), -1 at end of a non-wrapping
+// stream, or -2 when the frame's read failed (truncated file/IO error).
+long tw_reader_next(void *h, uint8_t *dst) {
+  auto *r = static_cast<Reader *>(h);
+  long frame;
+  int slot;
+  bool failed;
+  {
+    std::unique_lock<std::mutex> lk(r->mu);
+    if (!r->eof_wrap && r->next_serve >= r->num_frames) return -1;
+    frame = r->next_serve;
+    slot = static_cast<int>(frame % r->capacity);
+    r->cv_can_serve.wait(lk, [&] { return r->slot_frame[slot] == frame; });
+    failed = r->slot_err[slot] != 0;
+    if (!failed)
+      std::memcpy(dst,
+                  r->ring.data() + static_cast<size_t>(slot) * r->frame_bytes,
+                  r->frame_bytes);
+    r->slot_frame[slot] = -1;
+    r->next_serve++;
+  }
+  r->cv_can_read.notify_one();
+  return failed ? -2 : frame;
+}
+
+void tw_reader_close(void *h) {
+  auto *r = static_cast<Reader *>(h);
+  if (!r) return;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->stop = true;
+  }
+  r->cv_can_read.notify_all();
+  if (r->worker.joinable()) r->worker.join();
+  close(r->fd);
+  delete r;
+}
+
+}  // extern "C"

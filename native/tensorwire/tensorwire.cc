@@ -140,6 +140,6 @@ uint32_t tw_crc32c(const uint8_t *data, size_t n, uint32_t seed) {
   return ~c;
 }
 
-int tw_abi_version() { return 1; }
+int tw_abi_version() { return 2; }  // 2 = +reader (reader.cc)
 
 }  // extern "C"

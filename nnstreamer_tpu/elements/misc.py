@@ -192,13 +192,24 @@ class DataRepoSrc(Source):
         self._infos = TensorsInfo(
             [TensorInfo(t, d) for t, d in zip(types, dims)])
         self._frame_bytes = self._infos.total_size()
-        self._data = open(str(self.location), "rb").read()
-        n = len(self._data) // self._frame_bytes
-        if n == 0:
-            raise ValueError(f"{self.name}: file smaller than one frame")
-        self._num_frames = n
-        self._cursor = 0
-        self._epoch = 0
+        # native prefetching reader (tensorwire reader.cc): file IO
+        # overlaps pipeline compute with bounded memory; Python mmap
+        # fallback without the .so
+        from ..native import RepoReader
+
+        try:
+            self._reader = RepoReader(str(self.location),
+                                      self._frame_bytes, capacity=8,
+                                      wrap=True)
+        except ValueError as e:
+            raise ValueError(f"{self.name}: {e}") from e
+        self._num_frames = self._reader.num_frames
+
+    def stop(self):
+        if getattr(self, "_reader", None) is not None:
+            self._reader.close()
+            self._reader = None
+        super().stop()
 
     def negotiate(self) -> Caps:
         cfg = TensorsConfig(info=self._infos,
@@ -206,21 +217,15 @@ class DataRepoSrc(Source):
         return caps_from_config(cfg)
 
     def create(self) -> Optional[TensorBuffer]:
-        if self._epoch >= int(self.epochs):
+        total = int(self.epochs) * self._num_frames
+        got = self._reader.next_frame()
+        if got is None or got[0] >= total:
             return None
-        off = self._cursor * self._frame_bytes
-        chunk = self._data[off:off + self._frame_bytes]
+        index, chunk = got
         tensors = []
         pos = 0
         for info in self._infos:
             raw = np.frombuffer(chunk, np.uint8, count=info.size, offset=pos)
             tensors.append(raw.view(info.np_dtype).reshape(info.np_shape))
             pos += info.size
-        buf = TensorBuffer(tensors=tensors,
-                           pts=(self._epoch * self._num_frames
-                                + self._cursor) * SECOND // 30)
-        self._cursor += 1
-        if self._cursor >= self._num_frames:
-            self._cursor = 0
-            self._epoch += 1
-        return buf
+        return TensorBuffer(tensors=tensors, pts=index * SECOND // 30)

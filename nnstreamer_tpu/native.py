@@ -65,7 +65,9 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:
             return None
-        if lib.tw_abi_version() != 1:
+        if lib.tw_abi_version() not in (1, 2):
+            # unknown future ABI: fall back rather than call with wrong
+            # signatures (1 = original kernels, 2 = +reader)
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u32p = ctypes.POINTER(ctypes.c_uint32)
@@ -206,3 +208,90 @@ def crc32c(data: bytes, seed: int = 0) -> int:
         return (~c) & 0xFFFFFFFF
     arr = np.frombuffer(data, np.uint8)
     return int(lib.tw_crc32c(_u8(arr), len(data), seed))
+
+
+# ---------------------------------------------------------------------------
+# Native dataset reader (data-loader role: gstdatareposrc.c reimplemented as
+# a native IO engine — background pread prefetch ring, bounded memory).
+# Python mmap fallback keeps behavior identical without the .so.
+# ---------------------------------------------------------------------------
+
+class RepoReader:
+    """Sequential frame reader over a binary dataset file.
+
+    ``next_frame()`` returns (global_frame_index, bytes) — the index keeps
+    counting across epochs when ``wrap`` — or None at the end of a
+    non-wrapping stream.
+    """
+
+    def __init__(self, path: str, frame_bytes: int, capacity: int = 8,
+                 wrap: bool = False) -> None:
+        self.frame_bytes = frame_bytes
+        self._native = None
+        self._mm = None
+        self._served = 0
+        self._wrap = wrap
+        lib = _load()
+        if lib is not None and hasattr(lib, "tw_reader_open"):
+            lib.tw_reader_open.restype = ctypes.c_void_p
+            lib.tw_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                           ctypes.c_int, ctypes.c_int]
+            lib.tw_reader_frames.restype = ctypes.c_long
+            lib.tw_reader_frames.argtypes = [ctypes.c_void_p]
+            lib.tw_reader_next.restype = ctypes.c_long
+            lib.tw_reader_next.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8)]
+            lib.tw_reader_close.argtypes = [ctypes.c_void_p]
+            h = lib.tw_reader_open(path.encode(), frame_bytes,
+                                   int(capacity), int(wrap))
+            if h:
+                self._native = (lib, h)
+                self.num_frames = int(lib.tw_reader_frames(h))
+                return
+        # fallback: mmap (bounded memory too, readahead by the kernel)
+        import mmap
+
+        f = open(path, "rb")
+        self._mm = (f, mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ))
+        self.num_frames = len(self._mm[1]) // frame_bytes
+        if self.num_frames == 0:
+            self.close()
+            raise ValueError(f"{path}: smaller than one frame")
+
+    @property
+    def is_native(self) -> bool:
+        return self._native is not None
+
+    def next_frame(self):
+        """(global_frame_index, uint8 ndarray) — exactly one copy out of
+        the ring/page cache per frame on either path."""
+        if self._native is not None:
+            lib, h = self._native
+            dst = np.empty(self.frame_bytes, np.uint8)
+            idx = lib.tw_reader_next(h, _u8(dst))
+            if idx == -2:
+                raise IOError(f"native reader: IO error at frame "
+                              f"{self._served}")
+            if idx < 0:
+                return None
+            self._served += 1
+            return int(idx), dst
+        if not self._wrap and self._served >= self.num_frames:
+            return None
+        idx = self._served
+        pos = (idx % self.num_frames) * self.frame_bytes
+        self._served += 1
+        # mm[a:b] copies out of the page cache; frombuffer wraps it
+        # zero-copy (a view of the mmap itself would block mm.close())
+        return idx, np.frombuffer(self._mm[1][pos:pos + self.frame_bytes],
+                                  np.uint8)
+
+    def close(self) -> None:
+        if self._native is not None:
+            lib, h = self._native
+            lib.tw_reader_close(h)
+            self._native = None
+        if self._mm is not None:
+            self._mm[1].close()
+            self._mm[0].close()
+            self._mm = None

@@ -211,6 +211,13 @@ class EdgeSink(Element):
 
     def stop(self):
         if self._mqtt is not None:
+            try:
+                # clear the retained discovery record so late subscribers
+                # get the clean "no record" error, not a dead address
+                self._mqtt.publish(f"nns/edge/{self.topic}", b"",
+                                   retain=True)
+            except OSError:
+                pass
             self._mqtt.close()
         try:
             send_msg(self._sock, Message(T_BYE))
@@ -277,7 +284,7 @@ class EdgeSrc(Source):
             # nothing (mirrors the TCP path's 10 s connect timeout)
             client._sock.settimeout(10)
             got = client.recv_publish()
-            if got is None:
+            if got is None or not got[1]:
                 raise ValueError(
                     f"{self.name}: no retained discovery record on "
                     f"nns/edge/{self.topic}")

@@ -296,7 +296,12 @@ class MqttBroker:
                                      + struct.pack(">H", pid))
                     if ptype & 0x01:      # retain flag
                         with self._lock:
-                            self._retained[topic] = body
+                            if body:
+                                self._retained[topic] = body
+                            else:
+                                # MQTT 3.1.1: empty retained payload
+                                # CLEARS the retained message
+                                self._retained.pop(topic, None)
                     out = _mqtt_str(topic) + body
                     with self._lock:
                         subs = [(s, self._locks.get(s))

@@ -68,3 +68,69 @@ class TestCRC:
         a = native.crc32c(b"hello")
         b = native.crc32c(b"hellp")
         assert a != b
+
+
+class TestRepoReader:
+    def _file(self, tmp_path, n_frames, frame_bytes):
+        import numpy as np
+
+        data = np.arange(n_frames * frame_bytes, dtype=np.uint8).tobytes()
+        p = tmp_path / "frames.dat"
+        p.write_bytes(data)
+        return str(p)
+
+    def test_native_and_fallback_agree(self, tmp_path):
+        from nnstreamer_tpu import native
+
+        path = self._file(tmp_path, 6, 16)
+        r1 = native.RepoReader(path, 16, capacity=3)
+        seq1 = []
+        while (x := r1.next_frame()) is not None:
+            seq1.append((x[0], x[1].tobytes()))
+        r1.close()
+        # force the mmap fallback by hiding the native lib
+        old = native._lib
+        native._lib, native._tried = None, True
+        try:
+            r2 = native.RepoReader(path, 16, capacity=3)
+            assert not r2.is_native
+            seq2 = []
+            while (x := r2.next_frame()) is not None:
+                seq2.append((x[0], x[1].tobytes()))
+            r2.close()
+        finally:
+            native._lib, native._tried = old, old is not None
+        assert seq1 == seq2
+        assert [i for i, _ in seq1] == list(range(6))
+
+    def test_wrap_counts_epochs(self, tmp_path):
+        from nnstreamer_tpu.native import RepoReader
+
+        path = self._file(tmp_path, 4, 8)
+        r = RepoReader(path, 8, capacity=2, wrap=True)
+        frames = [(i, a.tobytes()) for i, a in
+                  (r.next_frame() for _ in range(10))]
+        r.close()
+        assert [i for i, _ in frames] == list(range(10))
+        # epoch 2 replays epoch 1's bytes
+        assert frames[4][1] == frames[0][1]
+        assert frames[9][1] == frames[1][1]
+
+    def test_datareposrc_uses_reader(self, tmp_path):
+        import numpy as np
+
+        from nnstreamer_tpu import parse_launch
+
+        data = np.arange(3 * 4, dtype=np.float32)
+        p = tmp_path / "d.dat"
+        p.write_bytes(data.tobytes())
+        pl = parse_launch(
+            f"datareposrc location={p} input-dim=4 input-type=float32 "
+            "epochs=2 ! tensor_sink name=out")
+        got = []
+        pl.get("out").connect("new-data", lambda b: got.append(b))
+        pl.run(timeout=30)
+        assert len(got) == 6
+        np.testing.assert_allclose(got[0].np(0), data[:4])
+        np.testing.assert_allclose(got[3].np(0), data[:4])  # epoch 2
+        np.testing.assert_allclose(got[5].np(0), data[8:])
