@@ -145,22 +145,22 @@ def _model_cost(model, device):
 
 
 def _peak_bw(device) -> float:
+    return _peak_lookup(device, PEAK_BW)
+
+
+def _peak_lookup(device, table) -> float:
+    """Per-chip peak from a {kind-substring: value} table; unknown TPU
+    kinds assume v5e, non-TPU platforms make no claim."""
     kind = (getattr(device, "device_kind", "") or "").lower().replace(" ", "")
-    for key, bw in PEAK_BW.items():
-        if key in kind:
-            return bw
-    plat = getattr(device, "platform", "")
-    return PEAK_BW["v5e"] if plat == "tpu" else 0.0
-
-
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "") or ""
-    kind = kind.lower().replace(" ", "")
-    for key, peak in PEAK_FLOPS.items():
+    for key, peak in table.items():
         if key in kind:
             return peak
     plat = getattr(device, "platform", "")
-    return PEAK_FLOPS["v5e"] if plat == "tpu" else 0.0
+    return table["v5e"] if plat == "tpu" else 0.0
+
+
+def _peak_flops(device) -> float:
+    return _peak_lookup(device, PEAK_FLOPS)
 
 
 def _batched_fps(model, device, size: int) -> float:

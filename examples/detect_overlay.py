@@ -1,0 +1,67 @@
+"""Object detection with a drawn overlay (BASELINE config 2).
+
+SSD-MobileNetV2 → bounding_boxes decoder (box-prior decode, NMS, label
+sprites) → RGBA overlay written to /tmp/overlay.rgba.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# honor JAX_PLATFORMS even when a sitecustomize pre-selects the TPU
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from nnstreamer_tpu import parse_launch  # noqa: E402
+from nnstreamer_tpu.models.registry import get_model  # noqa: E402
+
+
+def priors_file(n: int) -> str:
+    """Synthetic box priors (a real deployment loads the model's
+    box_priors.txt, reference tests/test_models/data)."""
+    rng = np.random.default_rng(0)
+    f = tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False)
+    for row in (rng.random(n), rng.random(n),
+                np.full(n, 0.2), np.full(n, 0.2)):
+        f.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+    f.close()
+    return f.name
+
+
+def main() -> None:
+    n_anchors = get_model("ssd_mobilenet_v2",
+                          {"seed": "0"}).out_info[0].np_shape[0]
+    labels = tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False)
+    labels.write("\n".join(f"class{i}" for i in range(91)))
+    labels.close()
+    p = parse_launch(
+        "videotestsrc num-buffers=8 pattern=random ! "
+        "video/x-raw,format=RGB,width=300,height=300,framerate=30/1 ! "
+        "tensor_converter ! "
+        "tensor_filter framework=xla model=ssd_mobilenet_v2 custom=seed:0 ! "
+        "tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
+        f"option2={labels.name} option3={priors_file(n_anchors)} "
+        "option4=640:480 option5=300:300 option6=0.3 ! "
+        "tensor_sink name=out")
+    frames = []
+    p.get("out").connect("new-data", lambda b: frames.append(b))
+    p.run(timeout=600)
+    overlay = frames[-1].np(0)
+    out = "/tmp/overlay.rgba"
+    overlay.tofile(out)
+    objs = frames[-1].extra["objects"]
+    print(f"{len(frames)} frames; last frame: {len(objs)} detections "
+          f"→ {out} ({overlay.shape})")
+    for o in objs[:5]:
+        print(f"  {o.label or o.class_id}: score={o.score:.2f} "
+              f"box=({o.ymin:.2f},{o.xmin:.2f},{o.ymax:.2f},{o.xmax:.2f})")
+
+
+if __name__ == "__main__":
+    main()

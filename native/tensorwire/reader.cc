@@ -29,12 +29,14 @@ struct Reader {
   long next_serve = 0;     // next frame index next() hands out
   bool eof_wrap = false;   // wrap at end (multi-epoch streaming)
   bool stop = false;
+  int consumers = 0;       // threads inside tw_reader_next (close waits)
   std::vector<uint8_t> ring;       // capacity * frame_bytes
   std::vector<long> slot_frame;    // frame index held by each slot (-1 empty)
   std::vector<int8_t> slot_err;    // per-slot IO failure flag
   std::mutex mu;
   std::condition_variable cv_can_read;
   std::condition_variable cv_can_serve;
+  std::condition_variable cv_idle;
   std::thread worker;
 
   void prefetch_loop() {
@@ -123,10 +125,19 @@ long tw_reader_next(void *h, uint8_t *dst) {
   bool failed;
   {
     std::unique_lock<std::mutex> lk(r->mu);
+    if (r->stop) return -1;
     if (!r->eof_wrap && r->next_serve >= r->num_frames) return -1;
+    r->consumers++;
     frame = r->next_serve;
     slot = static_cast<int>(frame % r->capacity);
-    r->cv_can_serve.wait(lk, [&] { return r->slot_frame[slot] == frame; });
+    r->cv_can_serve.wait(
+        lk, [&] { return r->stop || r->slot_frame[slot] == frame; });
+    if (r->stop) {
+      // closing: unblock without touching the ring
+      r->consumers--;
+      r->cv_idle.notify_all();
+      return -1;
+    }
     failed = r->slot_err[slot] != 0;
     if (!failed)
       std::memcpy(dst,
@@ -134,6 +145,8 @@ long tw_reader_next(void *h, uint8_t *dst) {
                   r->frame_bytes);
     r->slot_frame[slot] = -1;
     r->next_serve++;
+    r->consumers--;
+    r->cv_idle.notify_all();
   }
   r->cv_can_read.notify_one();
   return failed ? -2 : frame;
@@ -147,7 +160,14 @@ void tw_reader_close(void *h) {
     r->stop = true;
   }
   r->cv_can_read.notify_all();
+  r->cv_can_serve.notify_all();
   if (r->worker.joinable()) r->worker.join();
+  {
+    // wait until every consumer blocked in tw_reader_next has woken,
+    // observed stop, and left — only then is delete safe
+    std::unique_lock<std::mutex> lk(r->mu);
+    r->cv_idle.wait(lk, [&] { return r->consumers == 0; });
+  }
   close(r->fd);
   delete r;
 }

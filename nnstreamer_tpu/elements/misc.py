@@ -217,8 +217,11 @@ class DataRepoSrc(Source):
         return caps_from_config(cfg)
 
     def create(self) -> Optional[TensorBuffer]:
+        reader = self._reader     # local ref: stop() may null the attr
+        if reader is None:
+            return None
         total = int(self.epochs) * self._num_frames
-        got = self._reader.next_frame()
+        got = reader.next_frame()
         if got is None or got[0] >= total:
             return None
         index, chunk = got

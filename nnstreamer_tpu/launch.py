@@ -1,4 +1,5 @@
-"""Command-line pipeline launcher (gst-launch-1.0 role).
+"""Command-line pipeline launcher (gst-launch-1.0 role) + element
+inspector (gst-inspect-1.0 role).
 
 Usage::
 
@@ -8,8 +9,11 @@ Usage::
         tensor_decoder mode=image_labeling ! tensor_sink name=out" \
         [--timeout SECONDS] [--print-sink NAME]
 
-The reference's entire user surface is gst-launch strings; this gives the
-TPU framework the same front door.
+    python -m nnstreamer_tpu.launch --inspect              # all factories
+    python -m nnstreamer_tpu.launch --inspect tensor_filter
+
+The reference's entire user surface is gst-launch strings + gst-inspect;
+this gives the TPU framework the same front door.
 """
 
 from __future__ import annotations
@@ -19,15 +23,57 @@ import sys
 import time
 
 
+def inspect(name=None, out=None) -> int:
+    """List element factories / one factory's properties
+    (gst-inspect-1.0 role: the reference user's discovery tool)."""
+    import inspect as _inspect
+
+    out = out or sys.stdout
+    from .pipeline.registry import element_factory, list_factories
+
+    if name:
+        try:
+            cls = element_factory(name)
+        except KeyError as e:
+            print(f"ERROR: {e}", file=sys.stderr)
+            return 1
+        doc = _inspect.cleandoc(cls.__doc__) if cls.__doc__ else ""
+        print(f"Factory: {name}\n", file=out)
+        if doc:
+            print(doc + "\n", file=out)
+        props = getattr(cls, "PROPERTIES", {})
+        if props:
+            print("Properties:", file=out)
+            for key, spec in sorted(props.items()):
+                default, desc = (spec if isinstance(spec, tuple)
+                                 else (spec, ""))
+                print(f"  {key:<24} default={default!r}  {desc}", file=out)
+        return 0
+    for fac in sorted(list_factories()):
+        cls = element_factory(fac)
+        first = (cls.__doc__ or "").strip().partition("\n")[0]
+        print(f"{fac:<24} {first}", file=out)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="nns-launch",
                                  description="Run a pipeline description")
-    ap.add_argument("pipeline", help="pipeline launch string")
+    ap.add_argument("pipeline", nargs="?", help="pipeline launch string")
+    ap.add_argument("--inspect", nargs="?", const="", default=None,
+                    metavar="FACTORY",
+                    help="list element factories (or one factory's "
+                         "properties) instead of running a pipeline")
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--print-sink", default=None,
                     help="tensor_sink name whose outputs to print")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.inspect is not None:
+        return inspect(args.inspect or args.pipeline)
+    if not args.pipeline:
+        ap.error("pipeline launch string required (or use --inspect)")
 
     from . import parse_launch
 

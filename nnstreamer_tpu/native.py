@@ -50,7 +50,16 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _tried:
             return _lib
-        if not os.path.exists(_SO_PATH):
+        stale = False
+        if os.path.exists(_SO_PATH):
+            try:
+                so_m = os.path.getmtime(_SO_PATH)
+                src_dir = os.path.join(_NATIVE_DIR, "tensorwire")
+                stale = any(os.path.getmtime(os.path.join(src_dir, f)) > so_m
+                            for f in os.listdir(src_dir))
+            except OSError:
+                stale = False
+        if not os.path.exists(_SO_PATH) or stale:
             if _building is None:
                 _building = threading.Thread(target=_build, daemon=True,
                                              name="nnstw-build")
@@ -60,6 +69,7 @@ def _load() -> Optional[ctypes.CDLL]:
             if not os.path.exists(_SO_PATH):
                 _tried = True  # build finished and failed
                 return None
+            # rebuild finished: fall through and load the fresh .so
         _tried = True
         try:
             lib = ctypes.CDLL(_SO_PATH)
@@ -252,8 +262,13 @@ class RepoReader:
         import mmap
 
         f = open(path, "rb")
-        self._mm = (f, mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ))
-        self.num_frames = len(self._mm[1]) // frame_bytes
+        try:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:          # zero-byte file cannot be mapped
+            f.close()
+            raise ValueError(f"{path}: smaller than one frame") from None
+        self._mm = (f, mm)
+        self.num_frames = len(mm) // frame_bytes
         if self.num_frames == 0:
             self.close()
             raise ValueError(f"{path}: smaller than one frame")
