@@ -38,9 +38,10 @@ def make_dataset(path: str, n: int = 64) -> None:
 
 
 def main() -> None:
-    data = tempfile.mktemp(suffix=".dat")
+    with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+        data = f.name
     make_dataset(data)
-    ckpt = tempfile.mkdtemp() + "/model"
+    ckpt = os.path.join(tempfile.mkdtemp(), "model")
     p = parse_launch(
         f"datareposrc location={data} input-dim=8,4 "
         "input-type=float32,float32 epochs=2 ! "

@@ -34,11 +34,20 @@ _KIND = {"float32": 8, "float64": 9}
 
 
 def _build() -> bool:
+    """Build to a process-unique name, then atomically rename into place:
+    concurrent builders (pytest -n, parallel pipelines) each produce a
+    whole .so and the last rename wins — never a torn file."""
+    tmp = f"libnnstw.so.tmp.{os.getpid()}"
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
+        subprocess.run(["make", "-C", _NATIVE_DIR, f"TARGET={tmp}"],
+                       check=True, capture_output=True, timeout=120)
+        os.replace(os.path.join(_NATIVE_DIR, tmp), _SO_PATH)
         return True
     except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(os.path.join(_NATIVE_DIR, tmp))
+        except OSError:
+            pass
         return False
 
 

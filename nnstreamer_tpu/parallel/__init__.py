@@ -1,13 +1,16 @@
-"""Distributed/multi-chip layer: mesh, sharding, ring attention, training."""
+"""Distributed/multi-chip layer: mesh, sharding, sequence parallelism
+(ring + Ulysses all-to-all), training."""
 
 from . import multihost
 from .mesh import DEFAULT_AXES, factorize, make_mesh, mesh_info
 from .ring_attention import local_attention, ring_attention
 from .train_step import (StreamFormerConfig, init_params, make_data_sharding,
                          make_train_step)
+from .ulysses import ulysses_attention
 
 __all__ = [
     "make_mesh", "mesh_info", "factorize", "DEFAULT_AXES",
-    "ring_attention", "local_attention", "StreamFormerConfig",
-    "init_params", "make_train_step", "make_data_sharding", "multihost",
+    "ring_attention", "local_attention", "ulysses_attention",
+    "StreamFormerConfig", "init_params", "make_train_step",
+    "make_data_sharding", "multihost",
 ]

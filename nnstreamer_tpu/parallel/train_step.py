@@ -46,6 +46,9 @@ class StreamFormerConfig:
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16
     lr: float = 1e-3
+    #: long-context strategy over the sp axis: "ring" (K/V ppermute ring,
+    #: any head count) or "ulysses" (head<->seq all-to-all, heads % sp == 0)
+    seq_parallel: str = "ring"
 
 
 def _param_specs(cfg: StreamFormerConfig) -> Dict[str, Any]:
@@ -179,9 +182,18 @@ def _forward_local(params, tokens, cfg: StreamFormerConfig):
         qkv = jnp.einsum("btd,dchn->btchn", y,
                          lyr["wqkv"].astype(cfg.dtype))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.seq_parallel == "ulysses":
+            from .ulysses import ulysses_attention
+
+            seq_attn = ulysses_attention
+        elif cfg.seq_parallel == "ring":
+            seq_attn = ring_attention
+        else:
+            raise ValueError(
+                f"seq_parallel={cfg.seq_parallel!r}: ring | ulysses")
         attn = jax.vmap(
-            lambda qq, kk, vv: ring_attention(qq, kk, vv, "sp",
-                                              causal=True))(q, k, v)
+            lambda qq, kk, vv: seq_attn(qq, kk, vv, "sp",
+                                        causal=True))(q, k, v)
         o = jnp.einsum("bthn,hnd->btd", attn, lyr["wo"].astype(cfg.dtype))
         o = jax.lax.psum(o, "tp")  # combine head shards
         x = x + o

@@ -61,6 +61,88 @@ class TestRingAttention:
         self._run_ring(2, 16, causal=True)
 
 
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism: exact-match oracle vs local
+    attention, and the heads-divisibility contract."""
+
+    def _run(self, n_sp, t_total, causal, heads=4, dim=8):
+        from nnstreamer_tpu.parallel import ulysses_attention
+
+        devs = jax.devices()[:n_sp]
+        mesh = Mesh(np.array(devs).reshape(n_sp), ("sp",))
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal((t_total, heads, dim)).astype(np.float32)
+        k = rng.standard_normal((t_total, heads, dim)).astype(np.float32)
+        v = rng.standard_normal((t_total, heads, dim)).astype(np.float32)
+        fn = jax.jit(jax.shard_map(
+            lambda a, b, c: ulysses_attention(a, b, c, "sp", causal=causal),
+            mesh=mesh, in_specs=(P("sp"), P("sp"), P("sp")),
+            out_specs=P("sp"), check_vma=False))
+        out = np.asarray(fn(q, k, v))
+        ref = np.asarray(local_attention(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), causal=causal))
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+    def test_matches_local_full(self, jax_cpu_devices):
+        self._run(4, 32, causal=False)
+
+    def test_matches_local_causal(self, jax_cpu_devices):
+        self._run(4, 32, causal=True)
+
+    def test_matches_ring(self, jax_cpu_devices):
+        """Both strategies are exact, so they agree with each other."""
+        from nnstreamer_tpu.parallel import ulysses_attention
+
+        devs = jax.devices()[:4]
+        mesh = Mesh(np.array(devs).reshape(4), ("sp",))
+        rng = np.random.default_rng(2)
+        q, k, v = (rng.standard_normal((32, 4, 8)).astype(np.float32)
+                   for _ in range(3))
+        mk = lambda f: jax.jit(jax.shard_map(  # noqa: E731
+            lambda a, b, c: f(a, b, c, "sp", causal=True),
+            mesh=mesh, in_specs=(P("sp"),) * 3, out_specs=P("sp"),
+            check_vma=False))
+        np.testing.assert_allclose(np.asarray(mk(ulysses_attention)(q, k, v)),
+                                   np.asarray(mk(ring_attention)(q, k, v)),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_rejects_uneven_heads(self, jax_cpu_devices):
+        from nnstreamer_tpu.parallel import ulysses_attention
+
+        devs = jax.devices()[:4]
+        mesh = Mesh(np.array(devs).reshape(4), ("sp",))
+        q = np.zeros((32, 3, 8), np.float32)  # 3 heads, |sp| = 4
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.jit(jax.shard_map(
+                lambda a, b, c: ulysses_attention(a, b, c, "sp"),
+                mesh=mesh, in_specs=(P("sp"),) * 3, out_specs=P("sp"),
+                check_vma=False))(q, q, q)
+
+    def test_train_step_with_ulysses(self, jax_cpu_devices):
+        """The full sharded training step runs with seq_parallel=ulysses
+        over sp=2 and the loss decreases."""
+        from nnstreamer_tpu.parallel import (StreamFormerConfig, make_mesh,
+                                             make_data_sharding,
+                                             make_train_step)
+
+        mesh = make_mesh(4, axis_sizes={"dp": 1, "sp": 2, "tp": 2, "ep": 1})
+        cfg = StreamFormerConfig(vocab=32, dim=16, heads=4, head_dim=4,
+                                 mlp=32, layers=1, experts=2, max_seq=32,
+                                 seq_parallel="ulysses")
+        step, params, opt, _ = make_train_step(mesh, cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab, (2, 32)).astype(np.int32)
+        labels = np.roll(tokens, -1, axis=1).astype(np.int32)
+        sh = make_data_sharding(mesh)
+        tokens = jax.device_put(tokens, sh)
+        labels = jax.device_put(labels, sh)
+        losses = []
+        for _ in range(8):
+            params, opt, loss = step(params, opt, tokens, labels)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+
 class TestTrainStep:
     def test_loss_decreases_8dev(self, jax_cpu_devices):
         mesh = make_mesh(8, axis_sizes={"dp": 2, "sp": 2, "tp": 2, "ep": 1})
