@@ -217,7 +217,6 @@ class TestFilterElement:
             unregister_custom_easy("sum1")
 
 
-@pytest.mark.slow
 class TestXLABackend:
     def test_mobilenet_single(self):
         s = FilterSingle(framework="xla", model="mobilenet_v2",

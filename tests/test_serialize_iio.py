@@ -219,7 +219,6 @@ class TestSrcIIO:
             p.run(timeout=5)
 
 
-@pytest.mark.slow
 class TestCheckpointRestore:
     def test_save_restore_changes_outputs(self, tmp_path):
         from nnstreamer_tpu.filter import FilterSingle
