@@ -9,12 +9,16 @@ framework's 128-byte meta header (nnstreamer_tpu.tensor.meta), so both
 static and flexible streams ride the same format.
 
 Message layout (little endian):
-  u32 magic 'NNSR' | u8 type | u64 client_id | u64 seq | i64 pts
-  | i64 epoch_us | u32 payload_len | payload
+  u32 magic 'NNSS' | u8 type | u64 client_id | u64 seq | i64 pts
+  | i64 epoch_us | u32 payload_crc | u32 payload_len | payload
 ``epoch_us`` is the sender's stream-origin wall clock (NTP-aligned unix
 epoch µs, 0 = unknown) — the role of the reference mqtt header's
 ``base_time_epoch`` (gst/mqtt/mqttcommon.h:54) that lets a receiving
 pipeline re-base PTS from another device onto its own clock.
+``payload_crc`` is CRC-32C of the payload when the sender has the native
+tensorwire kernels (0 = unchecked — the pure-Python CRC would serialize
+the hot path); receivers verify only nonzero values, so mixed
+native/fallback hosts interoperate.
 Types: 1=HELLO (payload = caps string utf8), 2=DATA, 3=REPLY, 4=BYE,
 5=ERROR (payload = message).
 """
@@ -32,13 +36,44 @@ from ..tensor.buffer import TensorBuffer
 from ..tensor.info import TensorInfo
 from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 
-# Wire revision 2 ('NNSR'): the header gained epoch_us ('NNSQ' was <IBQQqI).
-# The magic doubles as the version stamp — a peer speaking another revision
-# fails immediately with "bad magic" instead of desynchronizing the stream.
-MAGIC = 0x4E4E5352  # 'NNSR'
-HEADER = struct.Struct("<IBQQqqI")
+# Wire revision 3 ('NNSS'): + payload_crc ('NNSR' lacked it, 'NNSQ' also
+# lacked epoch_us).  The magic doubles as the version stamp — a peer
+# speaking another revision fails immediately with "bad magic" instead of
+# desynchronizing the stream.
+MAGIC = 0x4E4E5353  # 'NNSS'
+HEADER = struct.Struct("<IBQQqqII")
 
 T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR = 1, 2, 3, 4, 5
+
+
+_CRC_FN = None  # resolved once: callable | False (unavailable)
+
+
+def _crc_fn():
+    """Native CRC-32C, resolved once so the per-message hot path is
+    lock-free afterwards.  While a background build of the native lib is
+    still running this returns None without caching, so CRC kicks in as
+    soon as the build lands."""
+    global _CRC_FN
+    if _CRC_FN is not None:
+        return _CRC_FN or None
+    from .. import native
+
+    lib = native._load()
+    if lib is not None:
+        _CRC_FN = native.crc32c
+        return _CRC_FN
+    if native._tried:   # definitively unavailable (build failed/absent)
+        _CRC_FN = False
+    return None
+
+
+def _payload_crc(payload: bytes) -> int:
+    """CRC-32C via the native kernels; 0 (= unchecked) without them."""
+    fn = _crc_fn() if payload else None
+    if fn is None:
+        return 0
+    return fn(payload) or 1  # reserve 0 for "absent"
 
 
 @dataclasses.dataclass
@@ -53,7 +88,8 @@ class Message:
 
 def pack(msg: Message) -> bytes:
     return HEADER.pack(MAGIC, msg.type, msg.client_id, msg.seq,
-                       msg.pts, msg.epoch_us, len(msg.payload)) + msg.payload
+                       msg.pts, msg.epoch_us, _payload_crc(msg.payload),
+                       len(msg.payload)) + msg.payload
 
 
 def encode_tensors(buf: TensorBuffer) -> bytes:
@@ -92,12 +128,20 @@ def recv_msg(sock: socket.socket) -> Optional[Message]:
     hdr = _recv_exact(sock, HEADER.size)
     if hdr is None:
         return None
-    magic, typ, cid, seq, pts, epoch, plen = HEADER.unpack(hdr)
+    magic, typ, cid, seq, pts, epoch, crc, plen = HEADER.unpack(hdr)
     if magic != MAGIC:
         raise ValueError(f"bad magic 0x{magic:08x}")
     payload = _recv_exact(sock, plen) if plen else b""
     if plen and payload is None:
         return None
+    if crc and payload:
+        fn = _crc_fn()
+        if fn is not None:
+            got = fn(payload) or 1
+            if got != crc:
+                raise ValueError(
+                    f"payload CRC mismatch: frame seq={seq} declared "
+                    f"0x{crc:08x}, computed 0x{got:08x} (corrupt stream)")
     return Message(type=typ, client_id=cid, seq=seq, pts=pts,
                    epoch_us=epoch, payload=payload or b"")
 

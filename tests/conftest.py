@@ -31,17 +31,3 @@ def jax_cpu_devices():
     return devs
 
 
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "slow: XLA-compile-heavy tests (run with -m slow)")
-
-
-def pytest_collection_modifyitems(config, items):
-    import pytest as _pytest
-
-    if config.getoption("-m"):
-        return
-    skip = _pytest.mark.skip(reason="slow; run with -m slow")
-    for item in items:
-        if "slow" in item.keywords:
-            item.add_marker(skip)

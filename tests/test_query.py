@@ -166,3 +166,51 @@ class TestEdgePubSub:
                                           np.full(4, 2, np.float32))
         finally:
             broker.close()
+
+
+class TestWireCrc:
+    def test_crc_detects_corruption(self):
+        """Wire rev 3: a corrupted payload is rejected at recv, not parsed
+        into garbage tensors (native CRC-32C; integrity role of transport
+        checksums)."""
+        import socket as _socket
+        import threading
+
+        import pytest as _pytest
+
+        from nnstreamer_tpu import native
+        from nnstreamer_tpu.query.protocol import (Message, T_DATA, pack,
+                                                   recv_msg)
+
+        if not native.available():   # waits for an in-flight build
+            _pytest.skip("native kernels unavailable")
+        msg = Message(T_DATA, seq=5, payload=b"x" * 64)
+        wire = bytearray(pack(msg))
+        wire[-1] ^= 0xFF            # flip one payload byte
+        a, b = _socket.socketpair()
+        threading.Thread(target=lambda: (a.sendall(bytes(wire)),
+                                         a.close())).start()
+        with _pytest.raises(ValueError, match="CRC mismatch"):
+            recv_msg(b)
+        b.close()
+
+    def test_zero_crc_means_unchecked(self):
+        import socket as _socket
+        import struct as _struct
+        import threading
+
+        from nnstreamer_tpu.query.protocol import (HEADER, MAGIC, Message,
+                                                   T_DATA, pack, recv_msg)
+
+        msg = Message(T_DATA, payload=b"hello")
+        wire = bytearray(pack(msg))
+        # zero the crc field (offset: magic4+type1+cid8+seq8+pts8+epoch8)
+        _struct.pack_into("<I", wire, 37, 0)
+        wire[-1] ^= 0xFF            # corrupt — but crc=0 disables the check
+        a, b = _socket.socketpair()
+        threading.Thread(target=lambda: (a.sendall(bytes(wire)),
+                                         a.close())).start()
+        got = recv_msg(b)
+        assert got is not None and got.payload != b"hello"
+        b.close()
+        assert HEADER.size == 45 and MAGIC == 0x4E4E5353
