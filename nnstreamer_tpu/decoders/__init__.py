@@ -67,3 +67,13 @@ def list_decoders():
 def _ensure_loaded() -> None:
     from . import (boundingbox, directvideo, font, imagelabel,  # noqa: F401
                    imagesegment, pose, serialize)
+
+
+def squeeze_leading(arr, want_ndim: int):
+    """Strip leading unit (batch) dims down to ``want_ndim`` — real
+    tflite/pb graphs emit (1, ...) outputs while reference dims are
+    1-padded the same way.  Plain indexing, so device arrays stay lazy
+    slices (no host sync)."""
+    while arr is not None and arr.ndim > want_ndim and arr.shape[0] == 1:
+        arr = arr[0]
+    return arr

@@ -40,7 +40,7 @@ import numpy as np
 from ..pipeline.caps import Caps, Structure
 from ..tensor.buffer import TensorBuffer
 from ..tensor.info import TensorsConfig
-from . import Decoder, register_decoder
+from . import Decoder, register_decoder, squeeze_leading
 from .imagelabel import load_labels
 
 DEFAULT_THRESHOLD = 0.5
@@ -228,18 +228,19 @@ class BoundingBoxDecoder(Decoder):
         return fn, reduced
 
     def _decode_mobilenet_ssd(self, buf: TensorBuffer) -> List[DetectedObject]:
-        boxes = buf.np(0)    # (N, 4)
+        boxes = squeeze_leading(buf.np(0), 2)    # (N, 4)
         if buf.num_tensors == 3:
             # device-reduced pushdown form: (boxes, class, score)
-            cls = buf.np(1)
-            sc = buf.np(2)
+            cls = np.asarray(buf.np(1)).reshape(-1)
+            sc = np.asarray(buf.np(2)).reshape(-1)
         elif not isinstance(buf.tensors[1], np.ndarray):
             # device buffer without pushdown: one jitted reduction program
-            cls_dev, sc_dev = _device_topcls()(buf.tensors[1])
+            t = squeeze_leading(buf.tensors[1], 2)
+            cls_dev, sc_dev = _device_topcls()(t)
             cls = np.asarray(cls_dev)
             sc = np.asarray(sc_dev)
         else:
-            scores = buf.np(1)   # (N, C)
+            scores = squeeze_leading(buf.np(1), 2)   # (N, C)
             cls = scores[:, 1:].argmax(axis=1) + 1  # skip background 0
             sc = scores[np.arange(len(cls)), cls]
         if self.priors is not None:
@@ -371,7 +372,7 @@ class BoundingBoxDecoder(Decoder):
         return out
 
     def _decode_yolov5(self, buf: TensorBuffer) -> List[DetectedObject]:
-        pred = buf.np(0)  # (N, 5+C): cx,cy,w,h,obj,cls...
+        pred = squeeze_leading(buf.np(0), 2)  # (N, 5+C): cx,cy,w,h,obj...
         obj = pred[:, 4]
         cls_scores = pred[:, 5:] * obj[:, None]
         cls = cls_scores.argmax(axis=1)
@@ -385,7 +386,7 @@ class BoundingBoxDecoder(Decoder):
                 for c, s, x, y, ww, hh in zip(cls[sel], sc[sel], cx, cy, w, h)]
 
     def _decode_raw(self, buf: TensorBuffer) -> List[DetectedObject]:
-        boxes = buf.np(0)    # (N, 6): class, score, ymin,xmin,ymax,xmax
+        boxes = squeeze_leading(buf.np(0), 2)   # (N, 6): cls,score,y0,x0,y1,x1
         out = []
         thr = self._threshold(DEFAULT_THRESHOLD)
         for row in boxes:

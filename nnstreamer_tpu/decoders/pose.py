@@ -16,7 +16,7 @@ import numpy as np
 from ..pipeline.caps import Caps, Structure
 from ..tensor.buffer import TensorBuffer
 from ..tensor.info import TensorsConfig
-from . import Decoder, register_decoder
+from . import Decoder, register_decoder, squeeze_leading
 
 # COCO skeleton edges (17 keypoints)
 _EDGES = [(0, 1), (0, 2), (1, 3), (2, 4), (5, 6), (5, 7), (7, 9), (6, 8),
@@ -49,8 +49,9 @@ class PoseDecoder(Decoder):
             "framerate": config.rate or Fraction(0, 1)})])
 
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
-        heat = buf.np(0)            # (H', W', K)
-        offsets = buf.np(1) if buf.num_tensors > 1 else None  # (H',W',2K)
+        heat = squeeze_leading(buf.np(0), 3)             # (H', W', K)
+        offsets = squeeze_leading(
+            buf.np(1) if buf.num_tensors > 1 else None, 3)  # (H',W',2K)
         hh, ww, k = heat.shape
         kps: List[Tuple[float, float, float]] = []  # (x, y, score) normalized
         for i in range(k):

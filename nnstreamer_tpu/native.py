@@ -215,6 +215,21 @@ def _crc32c_table() -> np.ndarray:
     return _CRC32C_TABLE
 
 
+def crc32c_fn():
+    """Return a lock-free CRC-32C callable bound to the loaded native lib,
+    or None when the lib is unavailable (callers fall back / skip).  The
+    per-call path touches no module locks — resolve once, use per frame."""
+    lib = _load()
+    if lib is None:
+        return None
+
+    def _fn(data: bytes, seed: int = 0) -> int:
+        arr = np.frombuffer(data, np.uint8)
+        return int(lib.tw_crc32c(_u8(arr), len(data), seed))
+
+    return _fn
+
+
 def crc32c(data: bytes, seed: int = 0) -> int:
     """CRC-32C (Castagnoli) — the SAME polynomial on both paths so mixed
     native/fallback hosts agree on checksums."""

@@ -62,7 +62,13 @@ class QueryConnection:
     def _read_loop(self) -> None:
         sock = self._sock
         while not self._stop.is_set():
-            msg = recv_msg(sock)
+            try:
+                msg = recv_msg(sock)
+            except ValueError as e:   # bad magic / CRC: stream corrupt
+                from ..utils.log import logger
+
+                logger.error("query client: corrupt stream: %s", e)
+                msg = None
             if msg is None:
                 self.replies.put(None)  # signal disconnect
                 return

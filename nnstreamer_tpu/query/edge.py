@@ -63,7 +63,10 @@ class EdgeBroker:
         role, topic = None, None
         try:
             while not self._stop.is_set():
-                msg = recv_msg(conn)
+                try:
+                    msg = recv_msg(conn)
+                except ValueError:   # bad magic / CRC: drop the connection
+                    break
                 if msg is None or msg.type == T_BYE:
                     break
                 if msg.type == T_HELLO:
@@ -322,7 +325,14 @@ class EdgeSrc(Source):
 
     def _read_loop(self) -> None:
         while True:
-            msg = recv_msg(self._sock)
+            try:
+                msg = recv_msg(self._sock)
+            except ValueError as e:   # bad magic / CRC: stream corrupt
+                from ..utils.log import logger
+
+                logger.error("edge src %s: corrupt stream: %s",
+                             self.name, e)
+                msg = None
             if msg is None:
                 self._fifo.put(None)
                 return

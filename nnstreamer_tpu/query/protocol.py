@@ -59,10 +59,10 @@ def _crc_fn():
         return _CRC_FN or None
     from .. import native
 
-    lib = native._load()
-    if lib is not None:
-        _CRC_FN = native.crc32c
-        return _CRC_FN
+    fn = native.crc32c_fn()   # closure over the loaded lib: no locks/frame
+    if fn is not None:
+        _CRC_FN = fn
+        return fn
     if native._tried:   # definitively unavailable (build failed/absent)
         _CRC_FN = False
     return None

@@ -71,7 +71,10 @@ class QueryServer:
     def _client_loop(self, cid: int, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
-                msg = recv_msg(conn)
+                try:
+                    msg = recv_msg(conn)
+                except ValueError:   # bad magic / CRC: drop the connection
+                    break
                 if msg is None or msg.type == T_BYE:
                     break
                 if msg.type == T_HELLO:

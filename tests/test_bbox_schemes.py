@@ -160,3 +160,57 @@ class TestLabelSprites:
         bm = render_text(txt)
         assert bm.shape == (7, 6 * len(txt))
         assert bm.any()
+
+
+class TestBatchDimRobustness:
+    """Real tflite/pb graphs emit (1, ...) batched outputs; every scheme
+    must strip them (the lesson the real-deeplab golden taught
+    image_segment)."""
+
+    def test_mobilenet_ssd_batched_tensors(self, tmp_path):
+        priors = tmp_path / "priors.txt"
+        priors.write_text("0.5 0.5\n0.5 0.5\n1.0 1.0\n1.0 1.0\n")
+        boxes = np.zeros((1, 2, 4), np.float32)       # leading batch dim
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 0, 2] = 0.95
+        sink = decode_one(
+            tcaps("4:2:1.3:2:1", "float32.float32", n=2),
+            {"mode": "bounding_boxes", "option1": "mobilenet-ssd",
+             "option3": str(priors)},
+            [boxes, scores])
+        objs = sink.results[0].extra["objects"]
+        assert len(objs) == 1 and objs[0].class_id == 2
+
+    def test_yolov5_batched(self):
+        pred = np.array([[[32, 32, 32, 32, 1.0, 0.1, 0.9]]], np.float32)
+        sink = decode_one(
+            tcaps("7:1:1", "float32"),
+            {"mode": "bounding_boxes", "option1": "yolov5",
+             "option5": "64:64"},
+            [pred])
+        assert len(sink.results[0].extra["objects"]) == 1
+
+    def test_pose_batched(self):
+        from tests.test_decoders import decode_one as d1
+
+        heat = np.zeros((1, 9, 9, 17), np.float32)
+        heat[0, 4, 4, :] = 1.0
+        offs = np.zeros((1, 9, 9, 34), np.float32)
+        sink = d1(
+            tcaps("17:9:9:1.34:9:9:1", "float32.float32", n=2),
+            {"mode": "pose_estimation", "option1": "64:64",
+             "option2": "257:257"},
+            [heat, offs])
+        kps = sink.results[0].extra["keypoints"]
+        assert len(kps) == 17
+        assert all(abs(x - 0.5) < 0.05 and abs(y - 0.5) < 0.05
+                   for x, y, s in kps)
+
+
+    def test_raw_batched(self):
+        rows = np.array([[[1, 0.9, 0.25, 0.25, 0.75, 0.75]]], np.float32)
+        sink = decode_one(
+            tcaps("6:1:1", "float32"),
+            {"mode": "bounding_boxes", "option1": "raw"},
+            [rows])
+        assert len(sink.results[0].extra["objects"]) == 1
