@@ -15,7 +15,7 @@ import numpy as np
 from ..pipeline.caps import Caps, Structure
 from ..tensor.buffer import TensorBuffer
 from ..tensor.info import TensorsConfig
-from . import Decoder, register_decoder
+from . import Decoder, register_decoder, squeeze_leading
 
 # 21-class VOC-ish color map, RGBA
 _COLORS = np.array(
@@ -95,9 +95,7 @@ class ImageSegmentDecoder(Decoder):
         # pre-argmaxed schemes and the device-reduced pushdown form both
         # produce one — so it strips down to (H, W).
         is_classmap = np.issubdtype(np.dtype(raw.dtype), np.integer)
-        floor = 2 if is_classmap else 3
-        while len(raw.shape) > floor and raw.shape[0] == 1:
-            raw = raw[0]
+        raw = squeeze_leading(raw, 2 if is_classmap else 3)
         if raw is not buf.tensors[0]:
             buf = buf.with_tensors([raw] + list(buf.tensors[1:]))
         if self.scheme == "argmax" or is_classmap or len(raw.shape) == 2:

@@ -195,6 +195,12 @@ class EdgeSink(Element):
 
         self._sock = socket.create_connection(
             (str(self.host), int(self.port)), timeout=10)
+        # publisher sockets only SEND: keep a bounded (long) send timeout
+        # so a wedged broker/subscriber surfaces as a pipeline error
+        # instead of hanging chain() forever (a timed-out partial send
+        # would desync the stream, but the error tears the connection
+        # down anyway)
+        self._sock.settimeout(30.0)
         self._caps_sent = False
         # stream-origin epoch: wall clock (NTP-aligned when ntp-host set) at
         # start, when running-time 0 ≈ now — the reference mqttsink's
@@ -307,6 +313,12 @@ class EdgeSrc(Source):
             self._discover_hybrid()
         self._sock = socket.create_connection(
             (str(self.host), int(self.port)), timeout=10)
+        # the connect timeout must NOT persist as an idle-read timeout: a
+        # subscriber legitimately sits idle until the first publish (e.g.
+        # while a downstream model compiles), and _recv_exact would treat
+        # the timeout as EOF, silently killing the subscription — the
+        # round-2 edge-bench deadline failure
+        self._sock.settimeout(None)
         send_msg(self._sock, Message(T_HELLO,
                                      payload=f"sub:{self.topic}".encode()))
         self._fifo: _queue.Queue = _queue.Queue()

@@ -214,3 +214,49 @@ class TestWireCrc:
         assert got is not None and got.payload != b"hello"
         b.close()
         assert HEADER.size == 45 and MAGIC == 0x4E4E5353
+
+
+class TestEdgeIdleSubscription:
+    def test_subscriber_survives_idle_before_first_publish(self):
+        """The connect timeout must not persist as an idle-read timeout:
+        a subscriber that waits longer than the connect timeout for its
+        first frame (e.g. while a model compiles downstream) must still
+        receive — the round-2/3 edge-bench failure mode.  The 10s
+        connect timeout is shrunk to 0.2s so the idle window really
+        exceeds it: with the bug present the read loop dies and the caps
+        never arrive, regardless of HOW the fix is implemented."""
+        import socket as _socket
+        import time as _time
+
+        import nnstreamer_tpu.query.edge as edge_mod
+        from nnstreamer_tpu.query.edge import EdgeSrc, get_broker
+
+        broker = get_broker()
+        real_cc = _socket.create_connection
+
+        def shrunk(addr, timeout=None, **kw):
+            return real_cc(addr, timeout=0.2 if timeout else timeout, **kw)
+
+        orig = edge_mod.socket.create_connection
+        edge_mod.socket.create_connection = shrunk
+        try:
+            src = EdgeSrc("idle", port=broker.port, topic="idle-t")
+            src.start()
+        finally:
+            edge_mod.socket.create_connection = orig
+        try:
+            _time.sleep(0.6)      # idle well past the (shrunk) timeout
+            pub = _socket.create_connection((broker.host, broker.port))
+            from nnstreamer_tpu.query.protocol import (Message, T_HELLO,
+                                                       send_msg)
+
+            send_msg(pub, Message(T_HELLO,
+                                  payload=b"pub:idle-t|other/tensors,"
+                                          b"format=static,num_tensors=1,"
+                                          b"dimensions=4,types=float32,"
+                                          b"framerate=0/1"))
+            assert src._caps_evt.wait(timeout=5), \
+                "subscription died during idle (persistent read timeout?)"
+            pub.close()
+        finally:
+            src.stop()
