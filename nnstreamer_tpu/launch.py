@@ -68,12 +68,19 @@ def main(argv=None) -> int:
     ap.add_argument("--print-sink", default=None,
                     help="tensor_sink name whose outputs to print")
     ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("--stats", action="store_true",
+                    help="print the pipeline LATENCY query result at EOS "
+                         "(per-element invoke latency contributions)")
     args = ap.parse_args(argv)
 
     if args.inspect is not None:
         return inspect(args.inspect or args.pipeline)
     if not args.pipeline:
         ap.error("pipeline launch string required (or use --inspect)")
+
+    from .utils.platform import honor_jax_platforms
+
+    honor_jax_platforms()
 
     from . import parse_launch
 
@@ -83,7 +90,22 @@ def main(argv=None) -> int:
         if args.print_sink:
             sink = p.get(args.print_sink)
             sink.connect("new-data", _print_buffer)
-        p.run(timeout=args.timeout)
+        if args.stats:
+            for el in p.elements:
+                if hasattr(el, "latency_report"):
+                    el.latency_report = True
+        try:
+            p.play()
+            p.wait(args.timeout)
+            if args.stats:
+                total, per = p.query_latency()
+                for name, ns in sorted(per.items()):
+                    print(f"latency {name}: {ns / 1e6:.3f} ms",
+                          file=sys.stderr)
+                print(f"latency total: {total / 1e6:.3f} ms",
+                      file=sys.stderr)
+        finally:
+            p.stop()
     except Exception as exc:  # noqa: BLE001
         print(f"ERROR: {exc}", file=sys.stderr)
         return 1

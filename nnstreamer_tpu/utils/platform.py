@@ -1,0 +1,21 @@
+"""Platform selection helper.
+
+The tunneled-TPU image ships a sitecustomize that pre-selects the TPU
+backend; the jax config update is the authoritative override (env vars
+alone lose).  Shared by the CLI — the standalone examples/ scripts inline
+the same three lines by design (they advertise copy-paste runnability).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms() -> None:
+    """Make the JAX_PLATFORMS env var win over any sitecustomize
+    pre-selection.  Call before the first jax device/backend use."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
