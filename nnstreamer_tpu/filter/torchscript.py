@@ -380,8 +380,126 @@ def _make_handlers() -> Dict[str, Callable]:
         "prim::NumToTensor": lambda a: jnp.asarray(a[0]),
         "aten::flatten_dense_tensors": lambda a: jnp.concatenate(
             [t.reshape(-1) for t in a[0]]),
+        "aten::embedding": lambda a: jnp.take(a[0], a[1].astype(jnp.int32),
+                                              axis=0),
+        "aten::chunk": lambda a: _chunk(a[0], int(a[1]),
+                                        int(a[2]) if len(a) > 2 else 0),
+        "aten::split": lambda a: _split(a[0], a[1],
+                                        int(a[2]) if len(a) > 2 else 0),
+        "aten::split_with_sizes": lambda a: _split(
+            a[0], a[1], int(a[2]) if len(a) > 2 else 0),
+        "aten::unbind": lambda a: [jnp.take(a[0], i,
+                                            axis=int(a[1]) if len(a) > 1
+                                            else 0)
+                                   for i in range(
+                                       a[0].shape[int(a[1])
+                                                  if len(a) > 1 else 0])],
+        "aten::where": lambda a: jnp.where(a[0], a[1], a[2]),
+        "aten::masked_fill": lambda a: jnp.where(a[1], a[2], a[0]),
+        "aten::masked_fill_": lambda a: jnp.where(a[1], a[2], a[0]),
+        "aten::eq": lambda a: a[0] == a[1],
+        "aten::ne": lambda a: a[0] != a[1],
+        "aten::lt": lambda a: a[0] < a[1],
+        "aten::gt": lambda a: a[0] > a[1],
+        "aten::le": lambda a: a[0] <= a[1],
+        "aten::ge": lambda a: a[0] >= a[1],
+        "aten::group_norm": lambda a: _group_norm(*a[:5]),
+        "aten::instance_norm": _instance_norm,
+        "aten::erf": lambda a: jax.scipy.special.erf(a[0]),
+        "aten::floor": lambda a: jnp.floor(a[0]),
+        "aten::ceil": lambda a: jnp.ceil(a[0]),
+        "aten::round": lambda a: jnp.round(a[0]),
+        "aten::sin": lambda a: jnp.sin(a[0]),
+        "aten::cos": lambda a: jnp.cos(a[0]),
+        "aten::tril": lambda a: jnp.tril(a[0], int(a[1]) if len(a) > 1
+                                         else 0),
+        "aten::triu": lambda a: jnp.triu(a[0], int(a[1]) if len(a) > 1
+                                         else 0),
+        "aten::cumsum": lambda a: jnp.cumsum(a[0], axis=int(a[1])),
+        "aten::repeat": lambda a: jnp.tile(a[0], tuple(int(d)
+                                                       for d in a[1])),
+        "aten::narrow": lambda a: _narrow(a[0], int(a[1]), int(a[2]),
+                                          int(a[3])),
+        "aten::index_select": lambda a: jnp.take(
+            a[0], a[2].astype(jnp.int32), axis=int(a[1])),
+        "aten::gather": lambda a: jnp.take_along_axis(
+            a[0], a[2].astype(jnp.int32), axis=int(a[1])),
+        "aten::leaky_relu": lambda a: jax.nn.leaky_relu(
+            a[0], a[1] if len(a) > 1 else 0.01),
+        "aten::leaky_relu_": lambda a: jax.nn.leaky_relu(
+            a[0], a[1] if len(a) > 1 else 0.01),
+        "aten::elu": lambda a: jax.nn.elu(a[0], a[1] if len(a) > 1
+                                          else 1.0),
+        "aten::hardsigmoid": lambda a: jnp.clip(a[0] / 6.0 + 0.5, 0, 1),
+        "aten::hardswish": lambda a: a[0] * jnp.clip(a[0] / 6.0 + 0.5,
+                                                     0, 1),
+        "aten::hardswish_": lambda a: a[0] * jnp.clip(a[0] / 6.0 + 0.5,
+                                                      0, 1),
     }
     return h
+
+
+def _narrow(x, dim: int, start: int, length: int):
+    from jax import lax
+
+    if start < 0:                 # torch narrow: negative start wraps
+        start += x.shape[dim]
+    return lax.slice_in_dim(x, start, start + length, axis=dim)
+
+
+def _chunk(x, n: int, dim: int):
+    # torch chunk: ceil-sized chunks
+    return _chunk_even(x, -(-x.shape[dim] // n), dim)
+
+
+def _chunk_even(x, step: int, dim: int):
+    from jax import lax
+
+    size = x.shape[dim]
+    return [lax.slice_in_dim(x, i, min(i + step, size), axis=dim)
+            for i in range(0, size, step)]
+
+
+def _split(x, sizes, dim: int):
+    from jax import lax
+
+    if isinstance(sizes, (int, np.integer)):
+        return _chunk_even(x, int(sizes), dim)
+    out, off = [], 0
+    for s in sizes:
+        out.append(lax.slice_in_dim(x, off, off + int(s), axis=dim))
+        off += int(s)
+    return out
+
+
+def _group_norm(x, num_groups, w, b, eps):
+    import jax.numpy as jnp
+
+    n, c = x.shape[0], x.shape[1]
+    g = int(num_groups)
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mu = jnp.mean(xg, axes, keepdims=True)
+    var = jnp.mean((xg - mu) ** 2, axes, keepdims=True)
+    y = ((xg - mu) / jnp.sqrt(var + eps)).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if w is not None:
+        y = y * w.reshape(shape)
+    if b is not None:
+        y = y + b.reshape(shape)
+    return y
+
+
+def _instance_norm(a):
+    """aten::instance_norm(input, weight, bias, running_mean, running_var,
+    use_input_stats, momentum, eps, cudnn)."""
+    x, w, b, rm, rv = a[:5]
+    use_input_stats = bool(a[5]) if len(a) > 5 else True
+    eps = float(a[7]) if len(a) > 7 and a[7] is not None else 1e-5
+    if not use_input_stats and rm is not None:
+        return _batch_norm(x, w, b, rm, rv, False, 0.0, eps)
+    # eval instance norm without tracked stats: per-(N,C) spatial stats
+    return _group_norm(x, x.shape[1], w, b, eps)
 
 
 def _max_pool2d(args):

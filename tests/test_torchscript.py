@@ -238,3 +238,104 @@ class TestPyTorchBackendXLA:
             accelerators=[Accelerator.TPU])
         with pytest.raises(FilterError, match="does not lower"):
             open_backend(props)
+
+
+class TestWidenedOpCoverage:
+    """Oracle tests for the round-3 op additions: each scripted module
+    must match eager torch on the XLA lowering."""
+
+    def test_embedding_masked_where(self):
+        class M(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.emb = torch.nn.Embedding(16, 8)
+
+            def forward(self, idx, mask):
+                x = self.emb(idx)
+                x = x.masked_fill(mask.unsqueeze(-1), 0.0)
+                return torch.where(x > 0, x, x * 0.1)
+
+        torch.manual_seed(3)
+        m = M().eval()
+        idx = np.array([[1, 5, 9, 2]], np.int64)
+        mask = np.array([[False, True, False, False]])
+        import jax
+
+        from nnstreamer_tpu.filter.torchscript import lower_torchscript
+
+        scripted = torch.jit.trace(
+            m, (torch.from_numpy(idx), torch.from_numpy(mask)))
+        fn, params = lower_torchscript(scripted, 2)
+        got = jax.jit(fn)(params, idx, mask)
+        with torch.no_grad():
+            want = m(torch.from_numpy(idx), torch.from_numpy(mask))
+        np.testing.assert_allclose(np.asarray(got[0]), want.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_chunk_split_cat(self):
+        class M(torch.nn.Module):
+            def forward(self, x):
+                a, b = torch.chunk(x, 2, dim=1)
+                c, d, e = torch.split(x, [2, 3, 3], dim=1)
+                return torch.cat([a * 2, b, c, d, e], dim=1)
+
+        m = M().eval()
+        x = np.random.default_rng(5).standard_normal((2, 8)).astype(
+            np.float32)
+        _lower(m, [x])
+
+    def test_norms_and_activations(self):
+        class M(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.gn = torch.nn.GroupNorm(2, 8)
+                self.inorm = torch.nn.InstanceNorm2d(8, affine=True)
+
+            def forward(self, x):
+                y = self.gn(x)
+                z = self.inorm(x)
+                return (torch.nn.functional.hardswish(y)
+                        + torch.nn.functional.leaky_relu(z, 0.2)
+                        + torch.special.erf(x).tril())
+
+        torch.manual_seed(4)
+        m = M().eval()
+        x = np.random.default_rng(6).standard_normal(
+            (1, 8, 4, 4)).astype(np.float32)
+        _lower(m, [x])
+
+    def test_gather_index_cumsum_repeat(self):
+        class M(torch.nn.Module):
+            def forward(self, x, idx):
+                g = torch.gather(x, 1, idx)
+                s = torch.index_select(x, 1, idx[0])
+                return g.cumsum(1) + s.repeat(1, 2)[:, :s.shape[1]]
+
+        m = M().eval()
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((2, 6)).astype(np.float32)
+        idx = np.array([[0, 2, 4, 1, 3, 5], [5, 4, 3, 2, 1, 0]], np.int64)
+        import jax
+
+        from nnstreamer_tpu.filter.torchscript import lower_torchscript
+
+        scripted = torch.jit.trace(m, (torch.from_numpy(x),
+                                       torch.from_numpy(idx)))
+        fn, params = lower_torchscript(scripted, 2)
+        got = jax.jit(fn)(params, x, idx)
+        with torch.no_grad():
+            want = m(torch.from_numpy(x), torch.from_numpy(idx))
+        np.testing.assert_allclose(np.asarray(got[0]), want.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestNarrowNegativeStart:
+    def test_narrow_wraps_negative_start(self):
+        class M(torch.nn.Module):
+            def forward(self, x):
+                return torch.narrow(x, 0, -2, 2) * 2 + torch.narrow(x, 0, 1, 2)
+
+        m = M().eval()
+        x = np.random.default_rng(8).standard_normal((5, 6)).astype(
+            np.float32)
+        _lower(m, [x])
