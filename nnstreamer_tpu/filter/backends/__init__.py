@@ -2,6 +2,7 @@
 (the in-process analogue of subplugin .so discovery,
 gst/nnstreamer/nnstreamer_subplugin.c:116)."""
 
+from .caffe2 import Caffe2Filter
 from .custom import (CustomEasyFilter, CustomFilter, DummyFilter,
                      register_custom_easy, unregister_custom_easy)
 from .python import PythonFilter
@@ -11,7 +12,8 @@ from .tflite import TFLiteFilter
 from .xla import XLAFilter
 
 __all__ = [
-    "XLAFilter", "CustomFilter", "CustomEasyFilter", "DummyFilter",
+    "XLAFilter", "Caffe2Filter", "CustomFilter", "CustomEasyFilter",
+    "DummyFilter",
     "PythonFilter", "TFLiteFilter", "PyTorchFilter", "TensorFlowFilter",
     "register_custom_easy", "unregister_custom_easy",
 ]

@@ -679,4 +679,10 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
 
     @classmethod
     def handles_model(cls, model: Any) -> bool:
-        return isinstance(model, str) and model.endswith(".pb")
+        if not isinstance(model, str) or not model.endswith(".pb"):
+            return False
+        # a comma pair of .pb files is a caffe2 NetDef bundle, not a
+        # GraphDef; a comma elsewhere in the path is still ours
+        parts = [p.strip() for p in model.split(",") if p.strip()]
+        return not (len(parts) == 2 and all(p.endswith(".pb")
+                                            for p in parts))
