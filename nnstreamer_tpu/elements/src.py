@@ -8,6 +8,7 @@ negotiated format/rate, honoring downstream caps constraints (capsfilter).
 
 from __future__ import annotations
 
+import os
 from fractions import Fraction
 from typing import Optional
 
@@ -183,3 +184,56 @@ class AudioTestSrc(Source):
         buf = TensorBuffer(tensors=[samples], pts=pts, duration=dur)
         self._count += 1
         return buf
+
+
+@register_element
+class FileSrc(Source):
+    """Reads a file and pushes its bytes downstream (GStreamer filesrc
+    role).  The reference's ssat pipelines open nearly every golden input
+    this way (e.g. tests/nnstreamer_filter_caffe2/runTest.sh:
+    ``filesrc location=data/5 blocksize=-1 ! application/octet-stream ! …``).
+
+    Caps are whatever downstream will accept (a caps string right after the
+    element types the bytes, exactly like the reference pipelines);
+    ``blocksize=-1`` pushes the whole file as ONE buffer, otherwise the
+    file streams in ``blocksize``-byte chunks (GstBaseSrc default 4096).
+    """
+
+    FACTORY = "filesrc"
+    PROPERTIES = {
+        "location": (None, "path of the file to read"),
+        "blocksize": (4096, "bytes per buffer; -1 = whole file at once"),
+    }
+
+    def _make_pads(self):
+        self.add_src_pad(Caps.any(), "src")
+
+    def start(self):
+        if not self.location:
+            raise ValueError(f"{self.name}: location required")
+        path = str(self.location)
+        if not os.path.isfile(path):
+            raise FileNotFoundError(f"{self.name}: no such file: {path}")
+        self._f = open(path, "rb")
+
+    def stop(self):
+        f = getattr(self, "_f", None)
+        if f is not None and not f.closed:
+            f.close()
+
+    def negotiate(self) -> Caps:
+        allowed = self.src_pad.peer_allowed_caps()
+        if allowed.is_empty():
+            raise ValueError(f"{self.name}: cannot negotiate with downstream")
+        if allowed.is_any():
+            # no constraint downstream (e.g. fakesink): raw bytes
+            return Caps([Structure("application/octet-stream", {})])
+        return allowed.fixate()
+
+    def create(self) -> Optional[TensorBuffer]:
+        size = int(self.blocksize)
+        chunk = self._f.read() if size < 0 else self._f.read(size)
+        if not chunk:
+            return None
+        return TensorBuffer(
+            tensors=[np.frombuffer(chunk, np.uint8)], pts=0)

@@ -305,6 +305,31 @@ def test_reference_resnet_cifar_golden():
 
 
 @pytest.mark.skipif(not HAVE_REF, reason="reference models not present")
+def test_reference_ssat_pipeline_mirror():
+    """The reference ssat line end-to-end: filesrc location=data/5
+    blocksize=-1 ! application/octet-stream ! tensor_converter
+    input-dim=32:32:3:1 input-type=float32 ! tensor_filter framework=caffe2
+    ... ! sink; checkLabel.py asserts argmax == 5."""
+    from nnstreamer_tpu import parse_launch
+
+    got = []
+    p = parse_launch(
+        f"filesrc location={REF_DATA}/5 blocksize=-1 ! "
+        "application/octet-stream ! "
+        "tensor_converter input-dim=32:32:3:1 input-type=float32 ! "
+        "tensor_filter framework=caffe2 "
+        f"model={REF_MODELS}/caffe2_init_net.pb,{REF_MODELS}/caffe2_predict_net.pb "
+        "input-dim=32:32:3:1 input-type=float32 "
+        "output-dim=10:1 output-type=float32 "
+        "custom=inputname:data,outputname:softmax ! tensor_sink name=out")
+    p.get("out").connect("new-data", lambda b: got.append(
+        np.asarray(b.tensors[0]).ravel().view(np.float32).copy()))
+    p.run(timeout=120)
+    assert len(got) == 1
+    assert int(got[0].argmax()) == 5
+
+
+@pytest.mark.skipif(not HAVE_REF, reason="reference models not present")
 def test_reference_model_either_file_order():
     model = (f"{REF_MODELS}/caffe2_predict_net.pb,"
              f"{REF_MODELS}/caffe2_init_net.pb")

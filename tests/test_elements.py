@@ -399,3 +399,49 @@ class TestDataRepoSrc:
         assert len(out) == 6  # 3 frames × 2 epochs
         np.testing.assert_array_equal(out[0].np(0), [0, 1, 2, 3])
         np.testing.assert_array_equal(out[5].np(0), [8, 9, 10, 11])
+
+
+class TestFileSrc:
+    """filesrc: the reference ssat pipelines' standard golden-input feed."""
+
+    def test_whole_file_single_buffer(self, tmp_path):
+        payload = bytes(range(256)) * 4
+        p = tmp_path / "blob.bin"
+        p.write_bytes(payload)
+        got = []
+        pipe = parse_launch(
+            f"filesrc location={p} blocksize=-1 ! application/octet-stream ! "
+            "tensor_converter input-dim=1024 input-type=uint8 ! "
+            "tensor_sink name=out")
+        pipe.get("out").connect(
+            "new-data", lambda b: got.append(np.asarray(b.tensors[0]).copy()))
+        pipe.run(timeout=30)
+        assert len(got) == 1
+        np.testing.assert_array_equal(
+            got[0].ravel(), np.frombuffer(payload, np.uint8))
+
+    def test_chunked_read(self, tmp_path):
+        payload = bytes(1024)
+        p = tmp_path / "blob.bin"
+        p.write_bytes(payload)
+        got = []
+        pipe = parse_launch(
+            f"filesrc location={p} blocksize=256 ! application/octet-stream ! "
+            "tensor_converter input-dim=256 input-type=uint8 ! "
+            "tensor_sink name=out")
+        pipe.get("out").connect("new-data", lambda b: got.append(1))
+        pipe.run(timeout=30)
+        assert len(got) == 4
+
+    def test_missing_file_errors(self, tmp_path):
+        pipe = parse_launch(
+            f"filesrc location={tmp_path}/nope ! application/octet-stream ! "
+            "tensor_converter input-dim=4 input-type=uint8 ! fakesink")
+        with pytest.raises(Exception, match="no such file"):
+            pipe.run(timeout=30)
+
+    def test_unconstrained_downstream_gets_octet_caps(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        p.write_bytes(bytes(16))
+        pipe = parse_launch(f"filesrc location={p} blocksize=-1 ! fakesink")
+        pipe.run(timeout=30)  # must not raise: ANY downstream -> raw bytes
