@@ -47,7 +47,8 @@ import numpy as np  # noqa: E402
 #: coalesces frames into one device invoke, double-buffered (round-3 path)
 STREAM_BATCH = int(os.environ.get("NNS_TPU_BENCH_BATCH", "32"))
 N_FRAMES = int(os.environ.get("NNS_TPU_BENCH_FRAMES",
-                              "1920" if STREAM_BATCH > 1 else "150"))
+                              str(max(1920, 30 * STREAM_BATCH))
+                              if STREAM_BATCH > 1 else "150"))
 BASELINE_FPS = 30.0  # north-star target (BASELINE.json)
 BATCH = 64           # vmap-batched invoke mode
 # bf16 peak of one TPU v5e chip, for MFU; other platforms: no MFU claim.
@@ -383,10 +384,12 @@ def _parse_result(stdout: str):
 
 
 def orchestrate(config: str, cpu: bool, deadline: float,
-                retries: int) -> dict:
+                retries: int, stream_batch: int = 0) -> dict:
     env = dict(os.environ)
     if cpu:
         env["JAX_PLATFORMS"] = "cpu"
+    if stream_batch:
+        env["NNS_TPU_BENCH_BATCH"] = str(stream_batch)
     cmd = [sys.executable, os.path.abspath(__file__),
            "--_child", "--config", config]
     errors = []
@@ -432,11 +435,25 @@ def main() -> None:
         help="hard per-attempt wall-clock limit (seconds)")
     ap.add_argument("--retries", type=int, default=int(
         os.environ.get("NNS_TPU_BENCH_RETRIES", "2")))
+    ap.add_argument("--sweep-batch", default=None,
+                    help="comma list of stream micro-batch sizes; benches "
+                         "--config once per size (batch-tuning mode)")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args._child:
         print(json.dumps(run_child(args.config)), flush=True)
+        return
+
+    if args.sweep_batch:
+        sizes = [int(v) for v in args.sweep_batch.split(",") if v]
+        if any(b < 1 for b in sizes):
+            ap.error("--sweep-batch sizes must be >= 1")
+        for b in sizes:
+            result = orchestrate(args.config, args.cpu, args.deadline,
+                                 args.retries, stream_batch=b)
+            result["stream_batch"] = b
+            print(json.dumps(result), flush=True)
         return
 
     configs = tuple(CONFIG_METRICS) if args.all else (args.config,)

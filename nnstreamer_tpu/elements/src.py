@@ -235,5 +235,7 @@ class FileSrc(Source):
         chunk = self._f.read() if size < 0 else self._f.read(size)
         if not chunk:
             return None
-        return TensorBuffer(
-            tensors=[np.frombuffer(chunk, np.uint8)], pts=0)
+        # no pts: file bytes carry no timeline (GStreamer filesrc leaves
+        # timestamps unset too — stamping 0 would make QoS throttling and
+        # tensor_rate collapse all chunks onto one instant)
+        return TensorBuffer(tensors=[np.frombuffer(chunk, np.uint8)])

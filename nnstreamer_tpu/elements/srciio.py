@@ -172,10 +172,21 @@ class TensorSrcIIO(Source):
 
     @staticmethod
     def _read_float(path: str, default: float) -> float:
+        """Missing file → default (IIO semantics: absent *_scale means raw
+        units).  A PRESENT but malformed file is a broken device tree —
+        warn instead of silently normalizing with the default."""
         try:
             with open(path) as f:
-                return float(f.read().strip())
-        except (OSError, ValueError):
+                text = f.read().strip()
+        except OSError:
+            return default
+        try:
+            return float(text)
+        except ValueError:
+            from ..utils.log import ml_logw
+
+            ml_logw("srciio: malformed sysfs float %s=%r; using %s",
+                    path, text, default)
             return default
 
     def _write_sysfs(self, path: str, value: str) -> bool:

@@ -341,8 +341,9 @@ class _Symbol:
         if not self.heads:
             self.heads = [len(self.nodes) - 1]
         for node in self.nodes:
-            # older symbol files use "param" instead of "attrs"
-            node.setdefault("attrs", node.get("param", {}))
+            # attribute key renamed across mxnet eras: param → attr → attrs
+            node.setdefault("attrs",
+                            node.get("attr", node.get("param", {})))
 
     def null_names(self) -> List[str]:
         return [n["name"] for n in self.nodes if n["op"] == "null"]
@@ -440,6 +441,9 @@ class MXNetFilter(JitExecMixin, FilterFramework):
                 f"{in_info.num_tensors}")
 
         fn = sym.build(in_names, out_names)
+        # no dead HBM residency: only graph-referenced weights go on device
+        wanted = set(sym.null_names()) - set(in_names)
+        params = {k: v for k, v in params.items() if k in wanted}
         device = self._pick_device(props.accelerators)
         self._sym = sym
 

@@ -89,6 +89,13 @@ class XLAFilter(JitExecMixin, FilterFramework):
         if "dtype" not in custom and self._device.platform == "cpu":
             # bf16 is MXU-native on TPU but emulated (slow) on CPU hosts.
             custom["dtype"] = "float32"
+        from ...models.registry import has_model
+
+        if not has_model(model_name):
+            from ...models.registry import list_models
+
+            raise FilterError(f"xla: unknown model {model_name!r}; "
+                              f"known: {list_models()}")
         self._model = get_model(model_name, custom)
         ckpt_path = custom.get("checkpoint")
         if ckpt_path:

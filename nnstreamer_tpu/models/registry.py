@@ -92,6 +92,11 @@ def _ensure_loaded() -> None:
     from . import mobilenet_v2, ssd, deeplab_v3, posenet  # noqa: F401
 
 
+def has_model(name: str) -> bool:
+    _ensure_loaded()
+    return name in _MODELS
+
+
 def get_model(name: str, custom_props: Optional[Dict[str, str]] = None) -> Model:
     _ensure_loaded()
     if name not in _MODELS:

@@ -1,0 +1,226 @@
+"""One conformance suite stamped over every filter backend.
+
+The reference generates an identical gtest suite per filter subplugin from
+tests/nnstreamer_filter_extensions_common/unittest_tizen_template.cc.in
+(open/close, invoke, invalid-model behavior) — this is the same idea as a
+pytest parametrization: every backend must honor the shared
+FilterFramework lifecycle contract regardless of its model format.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.filter.framework import (FilterError, FilterProperties)
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsInfo
+from nnstreamer_tpu.tensor.types import TensorType
+
+REF_MODELS = "/root/reference/tests/test_models/models"
+HAVE_REF = os.path.isdir(REF_MODELS)
+
+
+def _info(*specs):
+    return TensorsInfo([TensorInfo(name=n, dtype=TensorType.from_string(d),
+                                   dims=dims)
+                        for n, d, dims in specs])
+
+
+# ---------------------------------------------------------------------------
+# one tiny valid model per backend
+# ---------------------------------------------------------------------------
+
+def _case_xla(tmp_path):
+    from nnstreamer_tpu.models.registry import Model, register_model
+
+    name = "conformance_tiny"
+
+    @register_model(name)
+    def _build(custom_props):
+        w = np.eye(4, dtype=np.float32) * 3.0
+
+        def forward(params, x):
+            return (x @ params["w"],)
+
+        io = _info(("x", "float32", (4, 1)))
+        oo = _info(("y", "float32", (4, 1)))
+        return Model(name=name, forward=forward, params={"w": w},
+                     in_info=io, out_info=oo)
+
+    return FilterProperties(framework="xla", model=name)
+
+
+def _case_tflite(tmp_path):
+    if not HAVE_REF:
+        pytest.skip("reference models not present")
+    return FilterProperties(framework="tensorflow-lite",
+                            model=os.path.join(REF_MODELS, "add.tflite"))
+
+
+def _case_tensorflow(tmp_path):
+    if not HAVE_REF:
+        pytest.skip("reference models not present")
+    return FilterProperties(
+        framework="tensorflow",
+        model=os.path.join(REF_MODELS, "mnist.pb"),
+        input_info=_info(("x", "float32", (784, 1))))
+
+
+def _case_pytorch(tmp_path):
+    torch = pytest.importorskip("torch")
+    mod = torch.jit.script(torch.nn.Linear(4, 2))
+    path = str(tmp_path / "tiny.pt")
+    mod.save(path)
+    return FilterProperties(framework="pytorch", model=path,
+                            input_info=_info(("x", "float32", (4, 1))))
+
+
+def _case_caffe2(tmp_path):
+    from test_caffe2 import _fill, _netdef, _op
+
+    ip = tmp_path / "init_net.pb"
+    pp = tmp_path / "predict_net.pb"
+    ip.write_bytes(_netdef("init", [
+        _fill("w", (2, 4), np.arange(8, dtype=np.float32))]))
+    pp.write_bytes(_netdef("pred", [
+        _op("FC", ["data", "w"], ["y"])], external_input=["data", "w"]))
+    return FilterProperties(model=f"{ip},{pp}", framework="caffe2",
+                            input_info=_info(("data", "float32", (4, 1))))
+
+
+def _case_mxnet(tmp_path):
+    from nnstreamer_tpu.filter.backends.mxnet import save_params
+
+    nodes = [
+        {"op": "null", "name": "data", "attrs": {}, "inputs": []},
+        {"op": "null", "name": "w", "attrs": {}, "inputs": []},
+        {"op": "FullyConnected", "name": "fc",
+         "attrs": {"num_hidden": "2", "no_bias": "True"},
+         "inputs": [[0, 0, 0], [1, 0, 0]]},
+    ]
+    (tmp_path / "tiny.json").write_text(json.dumps(
+        {"nodes": nodes, "arg_nodes": [], "heads": [[2, 0, 0]]}))
+    save_params(str(tmp_path / "tiny.params"),
+                {"w": np.ones((2, 4), np.float32)})
+    return FilterProperties(framework="mxnet",
+                            model=str(tmp_path / "tiny.json"),
+                            input_info=_info(("data", "float32", (4, 1))))
+
+
+def _case_python(tmp_path):
+    script = tmp_path / "passthrough.py"
+    script.write_text(
+        "import numpy as np\n"
+        "class CustomFilter:\n"
+        "    def getInputDim(self):\n"
+        "        return [((4, 1), 'float32')]\n"
+        "    def getOutputDim(self):\n"
+        "        return [((4, 1), 'float32')]\n"
+        "    def invoke(self, inputs):\n"
+        "        return [inputs[0]]\n")
+    return FilterProperties(framework="python", model=str(script))
+
+
+def _case_custom_easy(tmp_path):
+    from nnstreamer_tpu.filter.backends.custom import (
+        register_custom_easy, unregister_custom_easy)
+
+    name = "conformance_easy"
+    try:
+        unregister_custom_easy(name)
+    except Exception:
+        pass
+    register_custom_easy(
+        name, lambda ins: [np.asarray(ins[0]) * 2.0],
+        _info(("x", "float32", (4, 1))), _info(("y", "float32", (4, 1))))
+    return FilterProperties(framework="custom-easy", model=name)
+
+
+def _case_dummy(tmp_path):
+    return FilterProperties(
+        framework="dummy",
+        input_info=_info(("x", "float32", (4, 1))),
+        output_info=_info(("y", "float32", (4, 1))))
+
+
+CASES = {
+    "xla": _case_xla,
+    "tensorflow-lite": _case_tflite,
+    "tensorflow": _case_tensorflow,
+    "pytorch": _case_pytorch,
+    "caffe2": _case_caffe2,
+    "mxnet": _case_mxnet,
+    "python": _case_python,
+    "custom-easy": _case_custom_easy,
+    "custom-dummy": _case_dummy,
+}
+
+
+def _make(tmp_path, backend):
+    from nnstreamer_tpu.filter.framework import find_filter
+
+    props = CASES[backend](tmp_path)
+    cls = find_filter(props.framework)
+    return cls(), props
+
+
+@pytest.fixture(params=sorted(CASES))
+def backend(request):
+    return request.param
+
+
+class TestBackendConformance:
+    def test_lifecycle_and_invoke(self, tmp_path, backend):
+        fw, props = _make(tmp_path, backend)
+        fw.open(props)
+        try:
+            in_info, out_info = fw.get_model_info()
+            assert in_info.num_tensors >= 1 and out_info.num_tensors >= 1
+            assert in_info.is_valid() and out_info.is_valid()
+            zeros = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
+            outs = fw.invoke(zeros)
+            assert len(outs) == out_info.num_tensors
+            for o, oi in zip(outs, out_info):
+                assert np.asarray(o).shape == oi.np_shape
+        finally:
+            fw.close()
+
+    def test_reopen_after_close(self, tmp_path, backend):
+        fw, props = _make(tmp_path, backend)
+        fw.open(props)
+        fw.close()
+        fw.close()  # idempotent
+        fw.open(props)
+        try:
+            in_info, _ = fw.get_model_info()
+            fw.invoke([np.zeros(i.np_shape, i.np_dtype) for i in in_info])
+        finally:
+            fw.close()
+
+    def test_model_info_before_open_errors(self, tmp_path, backend):
+        fw, _ = _make(tmp_path, backend)
+        with pytest.raises((FilterError, Exception)):
+            in_info, out_info = fw.get_model_info()
+            # backends without open-state may legitimately answer only
+            # when a model name is preloaded; an empty answer is a failure
+            assert in_info is not None and in_info.num_tensors >= 1
+
+    def test_invalid_model_errors(self, tmp_path, backend):
+        if backend == "custom-dummy":
+            # dummy takes no model; its invalid-arg contract is missing io
+            fw2 = _make(tmp_path, backend)[0]
+            with pytest.raises(FilterError):
+                fw2.open(FilterProperties(framework="dummy"))
+            return
+        fw, props = _make(tmp_path, backend)
+        if backend in ("custom-easy", "xla"):
+            bad_model = "no-such-registered-model"
+        else:
+            bad_model = str(tmp_path / "nope.model")
+        import dataclasses
+
+        bad = dataclasses.replace(props, model=bad_model)
+        fw2 = type(fw)()
+        with pytest.raises(FilterError):
+            fw2.open(bad)

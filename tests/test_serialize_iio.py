@@ -209,6 +209,30 @@ class TestSrcIIO:
         st = p.get("out").caps.first()
         assert st.get("dimensions") == "3"
 
+
+    def test_malformed_scale_warns_not_silent(self, fake_iio_tree):
+        import logging
+
+        (fake_iio_tree / "iio:device0" / "in_accel0_scale").write_text(
+            "garbage\n")
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        log = logging.getLogger("nnstreamer_tpu")
+        log.addHandler(handler)
+        try:
+            p = parse_launch(
+                f"tensor_src_iio device=test-accel base-dir={fake_iio_tree} "
+                "frequency=100 num-buffers=1 ! tensor_sink name=out")
+            p.run(timeout=10)
+        finally:
+            log.removeHandler(handler)
+        assert any("malformed sysfs float" in r.getMessage()
+                   for r in records)
+        # falls back to scale=1.0 for the broken channel only
+        np.testing.assert_allclose(
+            p.get("out").results[0].np(0), [110.0, -20.0, 17.5])
+
     def test_missing_device_errors(self, fake_iio_tree):
         from nnstreamer_tpu.pipeline import PipelineError
 
