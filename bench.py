@@ -99,7 +99,7 @@ def _model_pipeline(model: str, size: int, decoder: str, dtype_prop: str,
     from nnstreamer_tpu import parse_launch
 
     return parse_launch(
-        f"videotestsrc num-buffers={N_FRAMES} pattern=random ! "
+        f"videotestsrc num-buffers={N_FRAMES} pattern=random cache-frames=64 ! "
         f"video/x-raw,format=RGB,width={size},height={size},"
         "framerate=120/1 ! "
         "tensor_converter ! "
@@ -272,7 +272,7 @@ def bench_edge(dtype_prop: str) -> dict:
             f"queue max-size-buffers={max(8, 2 * STREAM_BATCH)} ! "
             "tensor_decoder mode=image_labeling ! tensor_sink name=out")
         send = parse_launch(
-            f"videotestsrc num-buffers={N_FRAMES} pattern=random ! "
+            f"videotestsrc num-buffers={N_FRAMES} pattern=random cache-frames=64 ! "
             "video/x-raw,format=RGB,width=224,height=224,framerate=120/1 ! "
             "tensor_converter ! "
             f"edge_sink port={broker.port} topic=bench")

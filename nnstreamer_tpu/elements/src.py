@@ -46,6 +46,9 @@ class VideoTestSrc(Source):
         "pattern": ("smpte", "smpte|gradient|checkers|random|solid"),
         "foreground-color": (0xFFFFFF, "solid pattern RGB"),
         "seed": (42, "random pattern seed"),
+        "cache-frames": (0, "pre-render N distinct frames and cycle them "
+                            "(0 = render every frame); removes source "
+                            "render cost from throughput measurements"),
     }
 
     def _make_pads(self):
@@ -54,6 +57,7 @@ class VideoTestSrc(Source):
     def start(self):
         self._count = 0
         self._rng = np.random.default_rng(int(self.seed))
+        self._cache: Optional[list] = None
 
     def negotiate(self) -> Caps:
         allowed = self.src_pad.peer_allowed_caps()
@@ -86,7 +90,20 @@ class VideoTestSrc(Source):
         n = int(self.num_buffers)
         if n >= 0 and self._count >= n:
             return None
-        frame = self._render(self._count)
+        k = int(self.cache_frames)
+        if k > 0:
+            if self._cache is None:
+                self._cache = []
+                for i in range(k):
+                    f = self._render(i)
+                    # the same object is re-emitted every cycle: freeze it
+                    # so an in-place mutation downstream raises instead of
+                    # silently corrupting later cycles
+                    f.flags.writeable = False
+                    self._cache.append(f)
+            frame = self._cache[self._count % k]
+        else:
+            frame = self._render(self._count)
         rate = self._rate or Fraction(30, 1)
         dur = SECOND * rate.denominator // max(rate.numerator, 1)
         buf = TensorBuffer(tensors=[frame], pts=self._count * dur,

@@ -445,3 +445,20 @@ class TestFileSrc:
         p.write_bytes(bytes(16))
         pipe = parse_launch(f"filesrc location={p} blocksize=-1 ! fakesink")
         pipe.run(timeout=30)  # must not raise: ANY downstream -> raw bytes
+
+
+class TestVideoTestSrcCache:
+    def test_cache_cycles_distinct_frames(self):
+        got = []
+        p = parse_launch(
+            "videotestsrc num-buffers=6 pattern=random cache-frames=3 ! "
+            "video/x-raw,format=RGB,width=8,height=8,framerate=30/1 ! "
+            "tensor_converter ! tensor_sink name=out")
+        p.get("out").connect(
+            "new-data", lambda b: got.append(np.asarray(b.tensors[0]).copy()))
+        p.run(timeout=30)
+        assert len(got) == 6
+        # frame k repeats frame k-3; adjacent cached frames still differ
+        np.testing.assert_array_equal(got[0], got[3])
+        np.testing.assert_array_equal(got[2], got[5])
+        assert not np.array_equal(got[0], got[1])
