@@ -7,6 +7,7 @@ from . import aggregator  # noqa: F401
 from . import converter  # noqa: F401
 from . import decoder_elem  # noqa: F401
 from . import filter_elem  # noqa: F401
+from . import mediadec  # noqa: F401
 from . import merge_split  # noqa: F401
 from . import misc  # noqa: F401
 from . import mux  # noqa: F401

@@ -1,0 +1,118 @@
+"""Media decode elements: pngdec, pnmdec, wavparse.
+
+The reference's golden pipelines put GStreamer media plugins in front of
+``tensor_converter`` (``filesrc ! pngdec ! videoconvert …``,
+``filesrc ! wavparse …`` — e.g. tests/nnstreamer_filter_tensorflow2_lite/
+runTest.sh, tests/nnstreamer_converter/).  These elements fill the same
+slots with the in-tree decoders (utils/mediadec.py — stdlib zlib, no
+PIL/libpng/libsndfile):
+
+- ``pngdec`` / ``pnmdec``: accumulate the upstream byte stream until EOS
+  (images arrive as one or more filesrc chunks), decode, announce
+  ``video/x-raw`` caps (RGB or GRAY8 — alpha dropped, the role
+  ``videoconvert`` plays in the reference pipelines), push ONE frame.
+- ``wavparse``: accumulate until EOS, parse the RIFF container, announce
+  ``audio/x-raw`` caps (S16LE/U8/F32LE/S32LE at the file's rate/channels),
+  push the sample payload as one buffer (downstream tensor_converter
+  re-chunks via frames-per-tensor).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Optional
+
+import numpy as np
+
+from ..pipeline.caps import Caps, Structure
+from ..pipeline.element import CapsEvent, Element, EOSEvent, FlowReturn
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..utils.mediadec import decode_png, decode_pnm, parse_wav
+
+
+class _AccumulatingDecoder(Element):
+    """Shared base: buffer bytes until EOS, then decode-and-push."""
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._chunks: list = []
+
+    def _make_pads(self):
+        self.add_sink_pad(Caps.any(), "sink")
+        self.add_src_pad(Caps.any(), "src")
+
+    def start(self):
+        self._chunks = []
+
+    def set_caps(self, pad, caps):
+        pass  # output caps depend on the decoded header; announced at EOS
+
+    def chain(self, pad, buf):
+        for i in range(buf.num_tensors):
+            self._chunks.append(
+                np.ascontiguousarray(buf.np(i)).tobytes())
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            data = b"".join(self._chunks)
+            self._chunks = []
+            if data:
+                self._decode_and_push(data)
+            self.src_pad.push_event(EOSEvent())
+            return True
+        return super().on_event(pad, event)
+
+    def _decode_and_push(self, data: bytes) -> None:
+        raise NotImplementedError
+
+
+def _push_image(el: _AccumulatingDecoder, img: np.ndarray) -> None:
+    h, w, ch = img.shape
+    fmt = "GRAY8" if ch == 1 else "RGB"
+    el.src_pad.push_event(CapsEvent(Caps([Structure("video/x-raw", {
+        "format": fmt, "width": w, "height": h,
+        "framerate": Fraction(0, 1)})])))
+    el.push(TensorBuffer(tensors=[img], pts=0))
+
+
+@register_element
+class PngDec(_AccumulatingDecoder):
+    """``pngdec``: PNG byte stream → one video/x-raw frame."""
+
+    FACTORY = "pngdec"
+    PROPERTIES = {}
+
+    def _decode_and_push(self, data: bytes) -> None:
+        _push_image(self, decode_png(data))
+
+
+@register_element
+class PnmDec(_AccumulatingDecoder):
+    """``pnmdec``: binary PGM/PPM byte stream → one video/x-raw frame."""
+
+    FACTORY = "pnmdec"
+    PROPERTIES = {}
+
+    def _decode_and_push(self, data: bytes) -> None:
+        _push_image(self, decode_pnm(data))
+
+
+_WAV_FORMATS = {np.dtype(np.int16): "S16LE", np.dtype(np.uint8): "U8",
+                np.dtype(np.float32): "F32LE", np.dtype(np.int32): "S32LE"}
+
+
+@register_element
+class WavParse(_AccumulatingDecoder):
+    """``wavparse``: RIFF/WAVE byte stream → audio/x-raw samples."""
+
+    FACTORY = "wavparse"
+    PROPERTIES = {}
+
+    def _decode_and_push(self, data: bytes) -> None:
+        samples, rate = parse_wav(data)
+        self.src_pad.push_event(CapsEvent(Caps([Structure("audio/x-raw", {
+            "format": _WAV_FORMATS[samples.dtype],
+            "channels": samples.shape[1], "rate": rate})])))
+        self.push(TensorBuffer(tensors=[samples], pts=0))

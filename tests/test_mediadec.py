@@ -1,0 +1,157 @@
+"""Media decoders: PNG/PNM/WAV against PIL & stdlib-wave oracles, and the
+reference-shaped ``filesrc ! pngdec ! tensor_converter`` pipeline."""
+
+import io
+import os
+import struct
+import wave
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.utils.mediadec import decode_png, decode_pnm, parse_wav
+
+REF_DATA = "/root/reference/tests/test_models/data"
+HAVE_REF = os.path.isdir(REF_DATA)
+PIL = None  # imported lazily by the PIL-oracle tests
+
+
+def _pil():
+    return pytest.importorskip("PIL.Image")
+
+
+# ---------------------------------------------------------------------------
+# decoders vs oracles
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not HAVE_REF, reason="reference data not present")
+@pytest.mark.parametrize("name", ["orange.png", "9.png"])
+def test_png_matches_pil(name):
+    data = open(os.path.join(REF_DATA, name), "rb").read()
+    img = decode_png(data)
+    PIL = _pil()
+    ref = np.asarray(PIL.open(io.BytesIO(data)).convert(
+        "L" if img.shape[2] == 1 else "RGB"))
+    if ref.ndim == 2:
+        ref = ref[..., None]
+    np.testing.assert_array_equal(img, ref)
+
+
+def test_png_synthetic_all_filters():
+    """PIL-encoded PNGs exercise Sub/Up/Average/Paeth filters on random
+    content; decode must match exactly."""
+    PIL = _pil()
+    rng = np.random.default_rng(0)
+    for shape, mode in [((13, 7, 3), "RGB"), ((8, 9, 1), "L")]:
+        arr = rng.integers(0, 256, shape, dtype=np.uint8)
+        im = PIL.fromarray(arr.squeeze() if mode == "L" else arr, mode)
+        buf = io.BytesIO()
+        im.save(buf, "PNG")
+        out = decode_png(buf.getvalue())
+        np.testing.assert_array_equal(out, arr.reshape(shape))
+
+
+def test_png_rgba_drops_alpha():
+    PIL = _pil()
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 256, (6, 5, 4), dtype=np.uint8)
+    buf = io.BytesIO()
+    PIL.fromarray(arr, "RGBA").save(buf, "PNG")
+    np.testing.assert_array_equal(decode_png(buf.getvalue()), arr[..., :3])
+
+
+def test_png_rejects_bad_signature():
+    with pytest.raises(ValueError, match="signature"):
+        decode_png(b"not a png")
+
+
+@pytest.mark.skipif(not HAVE_REF, reason="reference data not present")
+@pytest.mark.parametrize("name", ["1.pgm", "9.pgm"])
+def test_pgm_reference_fixtures(name):
+    PIL = _pil()
+    img = decode_pnm(open(os.path.join(REF_DATA, name), "rb").read())
+    ref = np.asarray(PIL.open(os.path.join(REF_DATA, name)))
+    np.testing.assert_array_equal(img[..., 0], ref)
+
+
+def test_ppm_roundtrip():
+    rng = np.random.default_rng(2)
+    arr = rng.integers(0, 256, (4, 6, 3), dtype=np.uint8)
+    data = b"P6\n# comment\n6 4\n255\n" + arr.tobytes()
+    np.testing.assert_array_equal(decode_pnm(data), arr)
+
+
+@pytest.mark.skipif(not HAVE_REF, reason="reference data not present")
+def test_wav_reference_fixture():
+    data = open(os.path.join(REF_DATA, "yes.wav"), "rb").read()
+    samples, rate = parse_wav(data)
+    with wave.open(io.BytesIO(data)) as w:
+        assert rate == w.getframerate()
+        assert samples.shape == (w.getnframes(), w.getnchannels())
+        ref = np.frombuffer(w.readframes(w.getnframes()), np.int16)
+    np.testing.assert_array_equal(samples.ravel(), ref)
+
+
+def test_wav_float32():
+    pcm = np.linspace(-1, 1, 32, dtype=np.float32)
+    body = pcm.tobytes()
+    hdr = b"RIFF" + struct.pack("<I", 36 + len(body)) + b"WAVE"
+    fmt = b"fmt " + struct.pack("<IHHIIHH", 16, 3, 1, 8000, 32000, 4, 32)
+    data = hdr + fmt + b"data" + struct.pack("<I", len(body)) + body
+    samples, rate = parse_wav(data)
+    assert rate == 8000
+    np.testing.assert_allclose(samples.ravel(), pcm)
+
+
+# ---------------------------------------------------------------------------
+# elements in pipelines (the reference ssat shape)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not HAVE_REF, reason="reference data not present")
+def test_filesrc_pngdec_converter_pipeline():
+    from nnstreamer_tpu import parse_launch
+
+    PIL = _pil()
+    got = []
+    p = parse_launch(
+        f"filesrc location={REF_DATA}/orange.png blocksize=4096 ! "
+        "pngdec ! tensor_converter ! tensor_sink name=out")
+    p.get("out").connect("new-data", lambda b: got.append(
+        np.asarray(b.tensors[0]).copy()))
+    p.run(timeout=60)
+    assert len(got) == 1
+    ref = np.asarray(PIL.open(os.path.join(REF_DATA, "orange.png"))
+                     .convert("RGB"))
+    np.testing.assert_array_equal(got[0].reshape(ref.shape), ref)
+
+
+@pytest.mark.skipif(not HAVE_REF, reason="reference data not present")
+def test_filesrc_wavparse_converter_pipeline():
+    from nnstreamer_tpu import parse_launch
+
+    got = []
+    p = parse_launch(
+        f"filesrc location={REF_DATA}/yes.wav blocksize=-1 ! "
+        "wavparse ! tensor_converter frames-per-tensor=1600 ! "
+        "tensor_sink name=out")
+    p.get("out").connect("new-data", lambda b: got.append(b))
+    p.run(timeout=60)
+    with wave.open(os.path.join(REF_DATA, "yes.wav")) as w:
+        assert len(got) == w.getnframes() // 1600
+
+
+@pytest.mark.skipif(not HAVE_REF, reason="reference data not present")
+def test_pgm_pipeline_gray():
+    from nnstreamer_tpu import parse_launch
+
+    PIL = _pil()
+    got = []
+    p = parse_launch(
+        f"filesrc location={REF_DATA}/9.pgm blocksize=-1 ! "
+        "pnmdec ! tensor_converter ! tensor_sink name=out")
+    p.get("out").connect("new-data", lambda b: got.append(
+        np.asarray(b.tensors[0]).copy()))
+    p.run(timeout=60)
+    assert len(got) == 1
+    ref = np.asarray(PIL.open(os.path.join(REF_DATA, "9.pgm")))
+    assert got[0].size == ref.size

@@ -78,6 +78,27 @@ class TestRealMobileNetOnXLAPath:
         assert got[0].extra["index"] == 951
         assert got[0].extra["label"] == "orange"
 
+    def test_orange_golden_from_file_no_pil(self, mobilenet_ckpt):
+        """The reference ssat pipeline shape verbatim — file in, label out,
+        every stage in-tree (filesrc ! pngdec ! tensor_converter !
+        tensor_filter ! tensor_decoder), no PIL anywhere."""
+        from nnstreamer_tpu import parse_launch
+
+        labels = "/root/reference/tests/test_models/labels/labels.txt"
+        png = os.path.join(REF_DATA, "orange.png")
+        p = parse_launch(
+            f"filesrc location={png} blocksize=-1 ! pngdec ! "
+            "tensor_converter ! "
+            "tensor_filter framework=xla model=mobilenet_v2 "
+            f"custom=checkpoint:{mobilenet_ckpt},dtype:float32 ! "
+            f"tensor_decoder mode=image_labeling option1={labels} ! "
+            "tensor_sink name=out")
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b))
+        p.run(timeout=300)
+        assert len(got) == 1
+        assert got[0].extra["label"] == "orange"
+
     def test_importer_rejects_wrong_model(self):
         from tflite_weights import import_weights
 
