@@ -40,6 +40,8 @@ class TensorMerge(Element):
         return self.add_sink_pad(static_tensors_caps())
 
     def start(self):
+        import threading
+
         if str(self.mode) != "linear":
             raise ValueError(f"{self.name}: unsupported mode {self.mode}")
         self._dim = int(self.option)
@@ -48,6 +50,8 @@ class TensorMerge(Element):
         self._pad_index = {p.name: i for i, p in enumerate(self.sink_pads)}
         self._pad_configs: Dict[int, TensorsConfig] = {}
         self._announced = False
+        self._sent_eos = False
+        self._eos_lock = threading.Lock()
 
     def set_caps(self, pad, caps):
         idx = self._pad_index[pad.name]
@@ -74,10 +78,23 @@ class TensorMerge(Element):
 
     def chain(self, pad, buf):
         idx = self._pad_index[pad.name]
+        if self._sent_eos:
+            return FlowReturn.EOS
         frame_set = self._collect.push(idx, buf)
         if frame_set is None:
             return FlowReturn.OK
-        return self.push(self._combine(frame_set))
+        ret = self.push(self._combine(frame_set))
+        if self._collect.exhausted():
+            self._send_eos_once()
+            return FlowReturn.EOS
+        return ret
+
+    def _send_eos_once(self) -> None:
+        with self._eos_lock:
+            if self._sent_eos:
+                return
+            self._sent_eos = True
+        self.src_pad.push_event(EOSEvent())
 
     def _combine(self, frame_set: List[TensorBuffer]) -> TensorBuffer:
         arrays = [b.np(0) for b in frame_set]
@@ -91,9 +108,7 @@ class TensorMerge(Element):
     def on_event(self, pad, event):
         if isinstance(event, EOSEvent):
             if self._collect.set_eos(self._pad_index[pad.name]):
-                for fs in self._collect.flush_remaining():
-                    self.push(self._combine(fs))
-                self.src_pad.push_event(EOSEvent())
+                self._send_eos_once()
             return
         if self._pad_index[pad.name] == 0:
             super().on_event(pad, event)

@@ -35,6 +35,8 @@ class TensorMux(Element):
         return self.add_sink_pad(static_tensors_caps())
 
     def start(self):
+        import threading
+
         dur = None
         base_pad = 0
         if self.sync_option:
@@ -49,6 +51,8 @@ class TensorMux(Element):
         self._pad_index = {p.name: i for i, p in enumerate(self.sink_pads)}
         self._pad_configs: Dict[int, TensorsConfig] = {}
         self._announced = False
+        self._sent_eos = False
+        self._eos_lock = threading.Lock()
 
     # -- negotiation: src caps = concatenation of all sink infos -------------
     def set_caps(self, pad, caps):
@@ -65,10 +69,25 @@ class TensorMux(Element):
 
     def chain(self, pad, buf):
         idx = self._pad_index[pad.name]
+        if self._sent_eos:
+            return FlowReturn.EOS
         frame_set = self._collect.push(idx, buf)
         if frame_set is None:
             return FlowReturn.OK
-        return self.push(self._combine(frame_set))
+        ret = self.push(self._combine(frame_set))
+        # an EOS'd pad may just have drained: the stream ends now
+        # (reference is_eos re-check per collect, gsttensor_mux.c:505-513)
+        if self._collect.exhausted():
+            self._send_eos_once()
+            return FlowReturn.EOS
+        return ret
+
+    def _send_eos_once(self) -> None:
+        with self._eos_lock:
+            if self._sent_eos:
+                return
+            self._sent_eos = True
+        self.src_pad.push_event(EOSEvent())
 
     def _combine(self, frame_set: List[TensorBuffer]) -> TensorBuffer:
         tensors = []
@@ -82,9 +101,7 @@ class TensorMux(Element):
         if isinstance(event, EOSEvent):
             idx = self._pad_index[pad.name]
             if self._collect.set_eos(idx):
-                for fs in self._collect.flush_remaining():
-                    self.push(self._combine(fs))
-                self.src_pad.push_event(EOSEvent())
+                self._send_eos_once()
             return
         # forward non-EOS events once (from pad 0 only, to avoid duplicates)
         if self._pad_index[pad.name] == 0:

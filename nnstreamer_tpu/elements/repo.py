@@ -77,25 +77,51 @@ class TensorRepoSrc(Source):
     PROPERTIES = {"slot-index": (0, "repository slot"),
                   "caps": (None, "caps to announce (else slot caps)")}
 
+    def start(self):
+        # first create() emits a zero dummy buffer (reference
+        # gsttensor_reposrc.c:287-337): a recurrent cycle's state source
+        # must produce frame 0 before the loop has written anything
+        self._ini = False
+
     def _make_pads(self):
         self.add_src_pad(tensors_template_caps(), "src")
 
     def negotiate(self) -> Caps:
         if self.caps is not None:
             c = self.caps
-            return Caps.from_string(c) if isinstance(c, str) else c
+            caps = Caps.from_string(c) if isinstance(c, str) else c
+            self._neg_caps = caps
+            return caps
         # wait briefly for the writer to register caps
         import time
 
         for _ in range(100):
             c = repo.get_caps(int(self.slot_index))
             if c is not None:
+                self._neg_caps = c
                 return c
             time.sleep(0.02)
         raise RuntimeError(f"{self.name}: no caps in slot {self.slot_index}")
 
+    def _dummy_buffer(self) -> Optional[TensorBuffer]:
+        from ..tensor.caps_util import config_from_caps
+
+        try:
+            import numpy as np
+
+            cfg = config_from_caps(self._neg_caps)
+            zeros = [np.zeros(i.np_shape, i.np_dtype) for i in cfg.info]
+            return TensorBuffer(tensors=zeros, pts=0)
+        except Exception:
+            return None  # flexible/unparseable caps: wait for real data
+
     def create(self) -> Optional[TensorBuffer]:
         q = repo.slot(int(self.slot_index))
+        if not getattr(self, "_ini", True):
+            self._ini = True
+            dummy = self._dummy_buffer()
+            if dummy is not None:
+                return dummy
         while not self._halted.is_set():
             try:
                 item = q.get(timeout=0.1)

@@ -71,10 +71,38 @@ class CollectPads:
             return self._collect_locked()
 
     def set_eos(self, pad_index: int) -> bool:
-        """Mark a pad EOS; returns True when all pads are EOS."""
+        """Mark a pad EOS; returns True when collection is exhausted.
+
+        Reference semantics (gst_tensor_time_sync_buffer_from_collectpad
+        sets is_eos, nnstreamer_plugin_api_impl.c): the mux ends as soon
+        as ANY pad is EOS with nothing queued — no complete set can ever
+        form again.  (All-pads-EOS would deadlock recurrent topologies:
+        the tensor_reposrc state branch only ends AFTER the mux ends,
+        tests/nnstreamer_repo_rnn.)"""
         with self._lock:
             self._eos[pad_index] = True
-            return all(self._eos.values())
+            return self._exhausted_locked()
+
+    def exhausted(self) -> bool:
+        """True when an EOS pad's FIFO has drained — re-checked after each
+        collect so the mux ends once the tail is flushed."""
+        with self._lock:
+            return self._exhausted_locked()
+
+    def _exhausted_locked(self) -> bool:
+        # a pad blocks collection forever iff it is EOS with nothing
+        # queued AND the sync mode cannot substitute for it: NOSYNC/
+        # SLOWEST need every pad's queue; BASEPAD/REFRESH reuse
+        # ``_latest`` for non-base pads (so those only block when no
+        # buffer was EVER seen)
+        for i in range(self.num_pads):
+            if not (self._eos[i] and not self._fifos[i]):
+                continue
+            if self.mode in (SyncMode.NOSYNC, SyncMode.SLOWEST):
+                return True
+            if i == self.base_pad or self._latest[i] is None:
+                return True
+        return False
 
     def _collect_locked(self) -> Optional[List[TensorBuffer]]:
         mode = self.mode

@@ -68,6 +68,50 @@ class Pipeline:
             sink = self._pick_sink_pad(b)
             src.link(sink)
 
+    def link_pads(self, a: Element, src_pad: Optional[str],
+                  b: Element, sink_pad: Optional[str]) -> None:
+        """Link with explicitly named pads (gst-launch ``mux.sink_1``
+        syntax); ``None`` falls back to first-free/request.  Named pads
+        resolve FIRST so a bad name fails before any free pad is
+        requested."""
+        src = sink = None
+        if src_pad:
+            src = self._named_pad(a, src_pad, a.src_pads,
+                                  a.request_src_pad)
+        if sink_pad:
+            sink = self._named_pad(b, sink_pad, b.sink_pads,
+                                   b.request_sink_pad)
+        if src is None:
+            src = self._pick_src_pad(a)
+        if sink is None:
+            sink = self._pick_sink_pad(b)
+        src.link(sink)
+
+    @staticmethod
+    def _named_pad(el: Element, name: str, pads, request) -> Pad:
+        import re
+
+        for p in pads:
+            if p.name == name:
+                if p.peer is not None:
+                    raise ValueError(f"{el.name}.{name} is already linked")
+                return p
+        # request pads are created on demand in sequence (sink_0, sink_1,
+        # …): only request up to the asked-for index, and only when the
+        # name fits the scheme — a typo must not spray orphan pads
+        m = re.fullmatch(r"(?:sink|src)_(\d+)", name)
+        if m is None:
+            raise ValueError(f"{el.name}: no pad named {name!r}")
+        want = int(m.group(1))
+        try:
+            while len(pads) <= want:
+                p = request()
+                if p.name == name:
+                    return p
+        except NotImplementedError:
+            pass  # static-pad element: fall through to the ValueError
+        raise ValueError(f"{el.name}: no pad named {name!r}")
+
     @staticmethod
     def _pick_src_pad(el: Element) -> Pad:
         for p in el.src_pads:

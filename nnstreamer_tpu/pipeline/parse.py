@@ -101,7 +101,12 @@ def iter_launch_ops(description: str):
         if tok == "!":
             yield ("link",)
         elif tok.endswith(".") and "=" not in tok:
-            yield ("ref", tok[:-1])
+            yield ("ref", tok[:-1], None)
+        elif ("." in tok and "=" not in tok and "/" not in tok
+              and not tok.replace(".", "").isdigit()):
+            # gst-launch named-pad reference: ``mux.sink_0``
+            el_name, _, pad_name = tok.partition(".")
+            yield ("ref", el_name, pad_name)
         elif "/" in tok and "=" not in tok.split(",")[0]:
             # caps filter — gst-launch allows spaces after commas
             # ("video/x-raw, format=RGB, width=224"): join follow-on
@@ -129,13 +134,14 @@ def iter_launch_ops(description: str):
 
 
 class _ForwardRef:
-    """A ``name.`` branch-from reference to an element named later in the
-    line (gst-launch allows both directions)."""
+    """A ``name.`` / ``name.pad`` branch-from reference to an element named
+    later in the line (gst-launch allows both directions)."""
 
-    __slots__ = ("name",)
+    __slots__ = ("name", "pad")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, pad: Optional[str] = None):
         self.name = name
+        self.pad = pad
 
 
 def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
@@ -155,8 +161,9 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
     p = pipeline or Pipeline()
     prev = None                    # Element | _ForwardRef | None
     linked = False                 # saw '!' since the previous element
-    into_refs: List[tuple] = []    # (src_el, sink_name): '... ! name.'
-    from_refs: List[tuple] = []    # (src_name, sink_el): 'name. ! ...'
+    into_refs: List[tuple] = []    # (src_el, sink_name, pad): '... ! name.'
+    from_refs: List[tuple] = []    # (src_name, pad, sink_el): 'name. ! ...'
+    ref_refs: List[tuple] = []     # 'a.src_0 ! b.sink_1' (both by name)
     for op in iter_launch_ops(description):
         kind = op[0]
         if kind == "link":
@@ -165,19 +172,20 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
             linked = True
             continue
         if kind == "ref":
-            name = op[1]
+            name, pad = op[1], op[2]
             if linked:             # chain INTO named element (sink ref)
                 if isinstance(prev, _ForwardRef):
-                    raise ValueError(
-                        "launch string: cannot link two bare references")
-                into_refs.append((prev, name))
+                    # 'a.src_0 ! b.sink_1': both ends by reference
+                    ref_refs.append((prev.name, prev.pad, name, pad))
+                else:
+                    into_refs.append((prev, name, pad))
                 prev, linked = None, False
             else:                  # branch FROM named element
                 if isinstance(prev, _ForwardRef):
                     raise ValueError(
                         f"launch string: reference '{prev.name}.' is never "
                         f"linked (followed by '{name}.' without '!')")
-                prev = _ForwardRef(name)
+                prev = _ForwardRef(name, pad)
             continue
         if kind == "caps":
             el = p.add(CapsFilter(None, caps=Caps.from_string(op[1])))
@@ -187,7 +195,7 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
                 head, name, **{k: _coerce(v) for k, v in props}))
         if linked:
             if isinstance(prev, _ForwardRef):
-                from_refs.append((prev.name, el))
+                from_refs.append((prev.name, prev.pad, el))
             else:
                 p.link(prev, el)
         elif isinstance(prev, _ForwardRef):
@@ -200,8 +208,10 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
     if isinstance(prev, _ForwardRef):
         raise ValueError(f"launch string: trailing reference '{prev.name}.'"
                          " is never linked")
-    for src_name, sink_el in from_refs:
-        p.link(p.get(src_name), sink_el)
-    for src_el, sink_name in into_refs:
-        p.link(src_el, p.get(sink_name))
+    for src_name, src_pad, sink_el in from_refs:
+        p.link_pads(p.get(src_name), src_pad, sink_el, None)
+    for src_el, sink_name, sink_pad in into_refs:
+        p.link_pads(src_el, None, p.get(sink_name), sink_pad)
+    for src_name, src_pad, sink_name, sink_pad in ref_refs:
+        p.link_pads(p.get(src_name), src_pad, p.get(sink_name), sink_pad)
     return p

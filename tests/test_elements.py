@@ -383,7 +383,11 @@ class TestRepo:
         p1.wait(timeout=10)
         p1.stop()
         p2.stop()
-        assert len(p2.get("out").results) == 3
+        # 3 relayed + the reposrc bootstrap dummy (reference
+        # gsttensor_reposrc.c:287-337 always emits a zero frame first)
+        out = p2.get("out").results
+        assert len(out) == 4
+        assert not np.asarray(out[0].np(0)).any()
 
 
 class TestDataRepoSrc:
@@ -462,3 +466,132 @@ class TestVideoTestSrcCache:
         np.testing.assert_array_equal(got[0], got[3])
         np.testing.assert_array_equal(got[2], got[5])
         assert not np.array_equal(got[0], got[1])
+
+
+class TestRepoRecurrentCycle:
+    def test_rnn_style_feedback_loop(self):
+        """Mirror of tests/nnstreamer_repo_rnn/runTest.sh: input and
+        recurrent state meet in a mux, a custom filter computes the new
+        state, a tee feeds it back through reposink -> reposrc.  Here the
+        'RNN' is state' = state + input, so sink k sees k+1 (inputs are
+        ones, state starts at the reposrc bootstrap zero)."""
+        import numpy as np
+
+        from nnstreamer_tpu.elements.repo import repo
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+        from nnstreamer_tpu.tensor.info import TensorInfo, TensorsInfo
+        from nnstreamer_tpu.tensor.types import TensorType
+
+        repo.clear()
+        info = TensorsInfo([TensorInfo(dtype=TensorType.FLOAT32, dims=(4,))])
+        pair = TensorsInfo([TensorInfo(dtype=TensorType.FLOAT32, dims=(4,)),
+                            TensorInfo(dtype=TensorType.FLOAT32, dims=(4,))])
+        try:
+            unregister_custom_easy("add_state")
+        except Exception:
+            pass
+        register_custom_easy(
+            "add_state",
+            lambda ins: [np.asarray(ins[0], np.float32)
+                         + np.asarray(ins[1], np.float32)],
+            pair, info)
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        caps = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+                "types=float32,framerate=0/1")
+        p = parse_launch(
+            "tensor_mux name=mux sync-mode=nosync ! "
+            "tensor_filter framework=custom-easy model=add_state ! "
+            "tee name=t ! queue ! tensor_reposink slot-index=3 "
+            f"appsrc name=in caps={caps} ! mux.sink_0 "
+            f"tensor_reposrc slot-index=3 caps={caps} ! mux.sink_1 "
+            "t. ! queue ! tensor_sink name=out")
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(
+            np.asarray(b.tensors[0]).ravel().copy()))
+        p.play()
+        for _ in range(5):
+            p.get("in").push_buffer(
+                TensorBuffer(tensors=[np.ones(4, np.float32)]))
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        p.stop()
+        assert len(got) == 5
+        for k, arr in enumerate(got):
+            np.testing.assert_allclose(arr, np.full(4, k + 1.0))
+
+
+class TestMuxEosSemantics:
+    def test_refresh_mode_continues_after_nonbase_eos(self):
+        """sync-mode=refresh: a finished side pad must NOT end the stream —
+        its latest buffer keeps being reused (reference refresh policy)."""
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        caps = ("other/tensors,format=static,num_tensors=1,dimensions=2,"
+                "types=float32,framerate=0/1")
+        p = parse_launch(
+            "tensor_mux name=mux sync-mode=refresh ! tensor_sink name=out "
+            f"appsrc name=a caps={caps} ! mux.sink_0 "
+            f"appsrc name=b caps={caps} ! mux.sink_1")
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(
+            [np.asarray(t).ravel().copy() for t in b.tensors]))
+        p.play()
+        # side pad delivers once, then EOS
+        p.get("b").push_buffer(
+            TensorBuffer(tensors=[np.full(2, 7.0, np.float32)], pts=0))
+        p.get("b").end_of_stream()
+        import time
+        time.sleep(0.1)
+        for i in range(3):
+            p.get("a").push_buffer(TensorBuffer(
+                tensors=[np.full(2, float(i), np.float32)], pts=i))
+        p.get("a").end_of_stream()
+        p.wait(timeout=30)
+        p.stop()
+        assert len(got) == 3
+        for i, pair in enumerate(got):
+            np.testing.assert_allclose(pair[0], [i, i])
+            np.testing.assert_allclose(pair[1], [7.0, 7.0])  # reused
+
+    def test_mux_start_resets_eos_state(self):
+        p = parse_launch("appsrc name=a ! tensor_mux name=mux ! fakesink")
+        mux = p.get("mux")
+        mux.start()
+        mux._sent_eos = True
+        mux.start()  # restart must clear the terminal state
+        assert mux._sent_eos is False
+
+    def test_named_pad_typo_is_loud_and_clean(self):
+        with pytest.raises(ValueError, match="no pad named"):
+            parse_launch("appsrc name=a ! tensor_mux name=mux ! fakesink "
+                         "a2. ! mux.sinko_1 appsrc name=a2")
+        # typo must not have sprayed request pads on a fresh mux
+        p = parse_launch("appsrc name=a ! tensor_mux name=mux ! fakesink")
+        with pytest.raises(ValueError, match="no pad named"):
+            p.link_pads(p.get("a"), None, p.get("mux"), "sinkz")
+        assert len(p.get("mux").sink_pads) == 1
+
+    def test_ref_to_ref_link(self):
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        caps = ("other/tensors,format=static,num_tensors=2,dimensions=2.2,"
+                "types=float32.float32,framerate=0/1")
+        p = parse_launch(
+            f"appsrc name=a caps={caps} ! tensor_demux name=d "
+            "tensor_mux name=mux ! tensor_sink name=out "
+            "d.src_1 ! mux.sink_0 "
+            "d.src_0 ! mux.sink_1")
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(
+            [float(np.asarray(t).ravel()[0]) for t in b.tensors]))
+        p.play()
+        p.get("a").push_buffer(TensorBuffer(tensors=[
+            np.full(2, 1.0, np.float32), np.full(2, 2.0, np.float32)]))
+        p.get("a").end_of_stream()
+        p.wait(timeout=30)
+        p.stop()
+        assert got == [[2.0, 1.0]]  # demux outputs crossed into the mux
