@@ -109,6 +109,12 @@ class TensorMerge(Element):
         if isinstance(event, EOSEvent):
             if self._collect.set_eos(self._pad_index[pad.name]):
                 self._send_eos_once()
+            else:
+                leftover = self._collect.finalize()
+                if leftover is not None:
+                    for fs in leftover:
+                        self.push(self._combine(fs))
+                    self._send_eos_once()
             return
         if self._pad_index[pad.name] == 0:
             super().on_event(pad, event)

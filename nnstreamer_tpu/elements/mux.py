@@ -102,6 +102,14 @@ class TensorMux(Element):
             idx = self._pad_index[pad.name]
             if self._collect.set_eos(idx):
                 self._send_eos_once()
+            else:
+                # all pads EOS but not exhausted (basepad/refresh base
+                # backlog): drain what the policy can still form, then end
+                leftover = self._collect.finalize()
+                if leftover is not None:
+                    for fs in leftover:
+                        self.push(self._combine(fs))
+                    self._send_eos_once()
             return
         # forward non-EOS events once (from pad 0 only, to avoid duplicates)
         if self._pad_index[pad.name] == 0:

@@ -160,11 +160,18 @@ class CollectPads:
             return out
         raise AssertionError(mode)
 
-    def flush_remaining(self) -> List[List[TensorBuffer]]:
-        """At EOS drain complete frame-sets still queued (nosync only)."""
-        frames = []
+    def finalize(self) -> Optional[List[List[TensorBuffer]]]:
+        """Once EVERY pad is EOS, drain whatever frame-sets the sync policy
+        can still form (BASEPAD/REFRESH keep emitting a base backlog from
+        ``_latest``) and return them; ``None`` while any pad is still live.
+        Collection is push-driven, so without this a base-pad backlog at
+        all-EOS would strand the mux with no EOS ever sent."""
         with self._lock:
-            while all(self._fifos[i] for i in range(self.num_pads)):
-                frames.append([self._fifos[i].pop(0)
-                               for i in range(self.num_pads)])
-        return frames
+            if not all(self._eos.values()):
+                return None
+            frames = []
+            while True:
+                fs = self._collect_locked()
+                if fs is None:
+                    return frames
+                frames.append(fs)

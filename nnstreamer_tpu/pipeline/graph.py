@@ -148,7 +148,10 @@ class Pipeline:
         for el in self.elements:
             for p in el.sink_pads + el.src_pads:
                 if p.peer is None:
-                    raise RuntimeError(f"unlinked pad {p.full_name}")
+                    raise RuntimeError(
+                        f"unlinked pad {p.full_name} (request pads are "
+                        "created sequentially: naming sink_N also creates "
+                        "sink_0..sink_N-1, which must all be linked)")
 
     def query_latency(self) -> "tuple[int, Dict[str, int]]":
         """Pipeline LATENCY query (reference: GStreamer latency query with

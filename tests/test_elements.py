@@ -595,3 +595,33 @@ class TestMuxEosSemantics:
         p.wait(timeout=30)
         p.stop()
         assert got == [[2.0, 1.0]]  # demux outputs crossed into the mux
+
+    def test_refresh_all_eos_drains_base_backlog(self):
+        """Base pad ends with queued buffers (side pad produced once): the
+        backlog must flush using the side pad's latest, then EOS — not
+        hang (collection is push-driven)."""
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+        import time
+
+        caps = ("other/tensors,format=static,num_tensors=1,dimensions=2,"
+                "types=float32,framerate=0/1")
+        p = parse_launch(
+            "tensor_mux name=mux sync-mode=refresh ! tensor_sink name=out "
+            f"appsrc name=a caps={caps} ! mux.sink_0 "
+            f"appsrc name=b caps={caps} ! mux.sink_1")
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(1))
+        p.play()
+        # base backlogs 3 buffers while the side pad has produced nothing
+        for i in range(3):
+            p.get("a").push_buffer(TensorBuffer(
+                tensors=[np.full(2, float(i), np.float32)], pts=i))
+        time.sleep(0.1)
+        p.get("b").push_buffer(
+            TensorBuffer(tensors=[np.full(2, 9.0, np.float32)], pts=0))
+        p.get("b").end_of_stream()
+        time.sleep(0.1)
+        p.get("a").end_of_stream()
+        p.wait(timeout=15)
+        p.stop()
+        assert len(got) == 3  # b1 on side push, b2+b3 drained at all-EOS
