@@ -281,7 +281,18 @@ class TensorFilter(Element):
             if not self.is_updatable:
                 raise RuntimeError(f"{self.name}: not is-updatable")
             self._drain_batches()  # frames of the old model flush first
-            self.fw.handle_event("reload_model", event.data)
+            try:
+                self.fw.handle_event("reload_model", event.data)
+            except Exception as exc:  # noqa: BLE001
+                # a rejected reload keeps the old model serving — log and
+                # keep streaming instead of erroring the pipeline (unless
+                # the backend could not be restored at all)
+                from ..utils.log import ml_logw
+
+                if not self.fw.opened:
+                    raise
+                ml_logw("%s: model reload rejected, keeping old model: %s",
+                        self.name, exc)
             return  # consumed, like the reference custom-event sink
         super().on_event(pad, event)
 

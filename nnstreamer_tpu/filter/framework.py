@@ -203,9 +203,56 @@ class FilterFramework:
     # -- events --------------------------------------------------------------
     def handle_event(self, name: str, data: Optional[Dict[str, Any]] = None) -> None:
         """RELOAD_MODEL / CUSTOM_PROP / SET_ACCELERATOR (reference
-        eventHandler, nnstreamer_plugin_api_filter.h:201-262)."""
+        eventHandler, nnstreamer_plugin_api_filter.h:201-262).
+
+        The default RELOAD_MODEL rebuilds the backend from a new model
+        path by close+open (the reference reload-by-replace contract,
+        tests/nnstreamer_filter_reload; the new model must keep the same
+        tensor interface).  The element drains in-flight batches before
+        delivering the event, and chain/event delivery is serialized per
+        sink pad, so no invoke observes a half-swapped backend.  Backends
+        with a cheaper hot path (xla: params-only swap) override this."""
         if name == "reload_model":
-            raise FilterError(f"{self.NAME}: model reload not supported")
+            new_model = (data or {}).get("model")
+            if not new_model:
+                raise FilterError(
+                    f"{self.NAME}: reload_model needs data={{'model': path}}")
+            if self.props is not None and self.props.shared_key:
+                # a close/open swap under a shared backend would yank the
+                # model from every other element sharing it mid-invoke
+                raise FilterError(
+                    f"{self.NAME}: reload of a shared-tensor-filter-key "
+                    "backend is not supported by the generic path")
+            old = self.props
+            old_info = self.get_model_info()
+            props = dataclasses.replace(old, model=new_model)
+
+            def rollback(cause: Exception):
+                try:
+                    self.open(old)
+                except Exception as exc:  # noqa: BLE001
+                    raise FilterError(
+                        f"{self.NAME}: reload failed ({cause}) AND the "
+                        f"previous model could not be restored ({exc}); "
+                        "backend is closed") from cause
+
+            self.close()
+            try:
+                self.open(props)
+            except Exception as exc:  # noqa: BLE001
+                # restore the previous model: reload must not kill the
+                # stream on a bad replacement (reference keeps the old)
+                rollback(exc)
+                raise
+            new_in, new_out = self.get_model_info()
+            if not new_in.is_equal(old_info[0]) or \
+                    not new_out.is_equal(old_info[1]):
+                self.close()
+                err = FilterError(
+                    f"{self.NAME}: reload model changes the tensor "
+                    "interface (reference requires identical io)")
+                rollback(err)
+                raise err
 
     @classmethod
     def check_availability(cls, accelerators: Sequence[Accelerator]) -> bool:

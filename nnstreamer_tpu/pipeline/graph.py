@@ -16,7 +16,8 @@ from typing import Dict, List, Optional
 
 from ..tensor.buffer import TensorBuffer
 from .caps import Caps
-from .element import CapsEvent, Element, EOSEvent, FlowReturn, Pad
+from .element import (CapsEvent, Element, EOSEvent, Event,
+                      FlowReturn, Pad)
 from .registry import register_element
 
 
@@ -382,6 +383,13 @@ class AppSrc(Source):
     def push_buffer(self, buf: TensorBuffer) -> None:
         self._fifo.put(buf)
 
+    def push_event(self, event: Event) -> None:
+        """Queue a downstream event IN-BAND: it is delivered from the
+        streaming thread in arrival order with the buffers (how GStreamer
+        apps send e.g. tensor_filter_update_model through appsrc — the
+        serialization guarantees no frame races the event)."""
+        self._fifo.put(event)
+
     def end_of_stream(self) -> None:
         self._fifo.put(None)
 
@@ -396,7 +404,11 @@ class AppSrc(Source):
     def create(self) -> Optional[TensorBuffer]:
         while not self._halted.is_set():
             try:
-                return self._fifo.get(timeout=0.1)
+                item = self._fifo.get(timeout=0.1)
             except _queue.Empty:
                 continue
+            if isinstance(item, Event):
+                self.src_pad.push_event(item)
+                continue
+            return item
         return None

@@ -339,3 +339,101 @@ def test_reference_model_either_file_order():
         input_info=_info(("data", "float32", (32, 32, 3, 1)))))
     assert f.get_model_info()[1][0].np_shape == (1, 10)
     f.close()
+
+
+def test_model_reload_midstream(tmp_path):
+    """Mirror of tests/nnstreamer_filter_reload: swap the model file
+    mid-stream via the tensor_filter_update_model custom event; outputs
+    flip to the new weights, same tensor interface, stream continues."""
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.pipeline.element import CustomEvent
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    def make_pair(subdir, scale):
+        d = tmp_path / subdir
+        d.mkdir()
+        w = np.eye(4, dtype=np.float32) * scale
+        (d / "init_net.pb").write_bytes(_netdef("init", [
+            _fill("w", (4, 4), w.ravel())]))
+        (d / "predict_net.pb").write_bytes(_netdef("pred", [
+            _op("FC", ["data", "w"], ["y"])], external_input=["data", "w"]))
+        return f"{d}/init_net.pb,{d}/predict_net.pb"
+
+    model_a = make_pair("a", 2.0)
+    model_b = make_pair("b", 5.0)
+    got = []
+    caps = ("other/tensors,format=static,num_tensors=1,dimensions=4:1,"
+            "types=float32,framerate=0/1")
+    p = parse_launch(
+        f"appsrc name=src caps={caps} ! "
+        f"tensor_filter framework=caffe2 model={model_a} name=f "
+        "is-updatable=true "
+        "input-dim=4:1 input-type=float32 ! tensor_sink name=out")
+    p.get("out").connect("new-data", lambda b: got.append(
+        float(np.asarray(b.tensors[0]).ravel()[0])))
+    p.play()
+    ones = np.ones((1, 4), np.float32)
+    p.get("src").push_buffer(TensorBuffer(tensors=[ones]))
+    # in-band: the swap event rides the stream between the two frames
+    p.get("src").push_event(
+        CustomEvent("tensor_filter_update_model", {"model": model_b}))
+    p.get("src").push_buffer(TensorBuffer(tensors=[ones]))
+    p.get("src").end_of_stream()
+    p.wait(timeout=60)
+    p.stop()
+    assert got == [2.0, 5.0], got
+
+
+def test_model_reload_bad_replacement_keeps_old(tmp_path):
+    from nnstreamer_tpu.filter.framework import FilterError
+
+    w = np.eye(3, dtype=np.float32)
+    (tmp_path / "init_net.pb").write_bytes(_netdef("init", [
+        _fill("w", (3, 3), w.ravel())]))
+    (tmp_path / "predict_net.pb").write_bytes(_netdef("pred", [
+        _op("FC", ["data", "w"], ["y"])], external_input=["data", "w"]))
+    model = f"{tmp_path}/init_net.pb,{tmp_path}/predict_net.pb"
+    fw = Caffe2Filter()
+    fw.open(FilterProperties(
+        model=model, input_info=_info(("data", "float32", (3, 1)))))
+    with pytest.raises(FilterError):
+        fw.handle_event("reload_model", {"model": "/nope/a.pb,/nope/b.pb"})
+    # the old model still serves
+    out = np.asarray(fw.invoke([np.ones((1, 3), np.float32)])[0])
+    np.testing.assert_allclose(out, np.ones((1, 3)))
+    fw.close()
+
+
+def test_reload_rejected_stream_survives(tmp_path):
+    """A bad in-band reload is logged and dropped; the stream keeps
+    serving the OLD model to EOS (the element must not error out)."""
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.pipeline.element import CustomEvent
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    w = np.eye(4, dtype=np.float32) * 3.0
+    (tmp_path / "init_net.pb").write_bytes(_netdef("init", [
+        _fill("w", (4, 4), w.ravel())]))
+    (tmp_path / "predict_net.pb").write_bytes(_netdef("pred", [
+        _op("FC", ["data", "w"], ["y"])], external_input=["data", "w"]))
+    model = f"{tmp_path}/init_net.pb,{tmp_path}/predict_net.pb"
+    got = []
+    caps = ("other/tensors,format=static,num_tensors=1,dimensions=4:1,"
+            "types=float32,framerate=0/1")
+    p = parse_launch(
+        f"appsrc name=src caps={caps} ! "
+        f"tensor_filter framework=caffe2 model={model} name=f "
+        "is-updatable=true input-dim=4:1 input-type=float32 ! "
+        "tensor_sink name=out")
+    p.get("out").connect("new-data", lambda b: got.append(
+        float(np.asarray(b.tensors[0]).ravel()[0])))
+    p.play()
+    ones = np.ones((1, 4), np.float32)
+    p.get("src").push_buffer(TensorBuffer(tensors=[ones]))
+    p.get("src").push_event(CustomEvent(
+        "tensor_filter_update_model", {"model": "/nope/a.pb,/nope/b.pb"}))
+    p.get("src").push_buffer(TensorBuffer(tensors=[ones]))
+    p.get("src").end_of_stream()
+    p.wait(timeout=60)
+    p.stop()
+    assert got == [3.0, 3.0]  # both frames served by the old model
