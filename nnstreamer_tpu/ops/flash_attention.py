@@ -1,0 +1,215 @@
+"""Pallas flash attention: the TPU kernel for exact local attention.
+
+The framework's long-context strategies (parallel/ring_attention.py,
+parallel/ulysses.py) reduce global attention to per-device LOCAL attention
+over full or blockwise sequences.  This module provides that local core as
+a hand-written Pallas TPU kernel (per /opt/skills/guides/pallas_guide.md):
+
+- **streaming softmax**: grid dimension 2 walks K/V in ``block_k`` tiles;
+  running max / sum / accumulator live in VMEM scratch that persists
+  across the (sequential) innermost grid dimension — VMEM holds
+  O(block_q·d + block_q·block_k + block_k·d), never O(T²) scores and
+  never the full K/V;
+- **MXU-shaped**: both matmuls (Q·Kᵀ and P·V) run as ``dot_general`` with
+  f32 accumulation on bf16/f32 inputs; tiles default to 128 to match the
+  MXU systolic array;
+- **differentiable**: a ``jax.custom_vjp`` pairs the flash forward with an
+  exact recompute backward (standard attention gradients in jnp) so
+  training steps (train_step.py's ``value_and_grad``) work — backward
+  materializes one (T_q, T_kv) score matrix, the usual
+  recompute-checkpoint trade.
+
+``interpret=True`` runs the same kernel on CPU (tests validate it against
+the naive oracle); on non-TPU platforms callers should prefer the jnp
+reference path for speed (`flash_attention` is correct everywhere but the
+interpreter is slow).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, max_ref, sum_ref, *,
+            n_k_blocks: int, causal: bool, q_offset: int, k_offset: int,
+            scale: float):
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    q = q_ref[0].astype(jnp.float32)           # (bq, d)
+    block_q, d = q.shape
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        max_ref[...] = jnp.full_like(max_ref, _NEG_INF)
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+
+    k_blk = k_ref[0].astype(jnp.float32)       # (bk, d)
+    v_blk = v_ref[0].astype(jnp.float32)
+    block_k = k_blk.shape[0]
+
+    def _accumulate():
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = (q_offset + iq * block_q
+                     + jax.lax.iota(jnp.int32, block_q))
+            k_idx = (k_offset + j * block_k
+                     + jax.lax.iota(jnp.int32, block_k))
+            s = jnp.where(k_idx[None, :] > q_idx[:, None], _NEG_INF, s)
+        row_max = max_ref[:, 0]
+        row_sum = sum_ref[:, 0]
+        blk_max = jnp.max(s, axis=-1)
+        new_max = jnp.maximum(row_max, blk_max)
+        safe_max = jnp.where(jnp.isfinite(new_max), new_max, 0.0)
+        p = jnp.exp(s - safe_max[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.exp(jnp.where(jnp.isfinite(row_max),
+                                 row_max - safe_max, _NEG_INF))
+        corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        max_ref[:, 0] = new_max
+        sum_ref[:, 0] = row_sum * corr + jnp.sum(p, axis=-1)
+
+    if causal:
+        # causal block skip: a K block strictly in THIS q-block's future
+        # is all-masked — skip both matmuls (the standard flash
+        # optimization; ~half the inner-grid work for self-attention)
+        live = (k_offset + j * block_k
+                <= q_offset + (iq + 1) * block_q - 1)
+        pl.when(live)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(j == n_k_blocks - 1)
+    def _finalize():
+        denom = jnp.maximum(sum_ref[:, 0], 1e-20)
+        o_ref[0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   q_offset: int, k_offset: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t_q, h, d = q.shape
+    t_kv = k.shape[0]
+    block_q = min(block_q, t_q)
+    while t_q % block_q:
+        block_q //= 2
+    block_k = min(block_k, t_kv)
+    while t_kv % block_k:
+        block_k //= 2
+    n_k_blocks = t_kv // block_k
+
+    qh = jnp.transpose(q, (1, 0, 2))   # (H, Tq, D)
+    kh = jnp.transpose(k, (1, 0, 2))
+    vh = jnp.transpose(v, (1, 0, 2))
+    scale = 1.0 / float(d) ** 0.5
+
+    kern = functools.partial(_kernel, n_k_blocks=n_k_blocks, causal=causal,
+                             q_offset=q_offset, k_offset=k_offset,
+                             scale=scale)
+    out = pl.pallas_call(
+        kern,
+        grid=(h, t_q // block_q, n_k_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda hh, qq, kk: (hh, qq, 0)),
+            pl.BlockSpec((1, block_k, d), lambda hh, qq, kk: (hh, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda hh, qq, kk: (hh, kk, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda hh, qq, kk: (hh, qq, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, t_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.transpose(out, (1, 0, 2))
+
+
+def _naive_grads(q, k, v, do, causal, q_offset, k_offset):
+    """Exact attention gradients by recompute (one (Tq,Tkv) score matrix
+    per head — the standard flash-backward checkpoint trade)."""
+    t_q, h, d = q.shape
+    t_kv = k.shape[0]
+    scale = 1.0 / float(d) ** 0.5
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("qhd,khd->hqk", qf, kf) * scale
+    if causal:
+        q_idx = q_offset + jnp.arange(t_q)
+        k_idx = k_offset + jnp.arange(t_kv)
+        s = jnp.where(k_idx[None, None, :] > q_idx[None, :, None],
+                      _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)        # fully-masked rows
+    dv = jnp.einsum("hqk,qhd->khd", p, dof)
+    dp = jnp.einsum("qhd,khd->hqk", dof, vf)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("hqk,khd->qhd", ds, kf) * scale
+    dk = jnp.einsum("hqk,qhd->khd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, block_q, block_k, q_offset, k_offset,
+           interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, q_offset,
+                          k_offset, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, q_offset, k_offset,
+               interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_k, q_offset,
+                         k_offset, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
+               res, do):
+    q, k, v = res
+    return _naive_grads(q, k, v, do, causal, q_offset, k_offset)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, q_offset: int = 0,
+                    k_offset: int = 0,
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Exact attention via the Pallas streaming-softmax kernel.
+
+    Args:
+      q: (T_q, H, D); k, v: (T_kv, H, D) — same layout as
+        :func:`parallel.ring_attention.local_attention`.
+      causal: mask ``k_pos > q_pos`` using global positions
+        ``q_offset + i`` / ``k_offset + j`` (offsets let blockwise callers
+        keep global causality).
+      interpret: force the Pallas interpreter (CPU); default: interpret
+        off on TPU, on elsewhere.
+
+    Differentiable (custom VJP: flash forward, exact recompute backward).
+    Tile sizes shrink automatically to divide the sequence lengths.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, block_q, block_k, q_offset, k_offset,
+                  interpret)

@@ -28,13 +28,16 @@ from .ring_attention import local_attention
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                      axis_name: str = "sp", causal: bool = False
-                      ) -> jnp.ndarray:
+                      axis_name: str = "sp", causal: bool = False,
+                      flash: "bool | None" = None) -> jnp.ndarray:
     """Exact attention over sequence shards via head↔sequence all-to-all.
 
     Args (per-device views inside shard_map):
       q, k, v: (T_local, n_heads, head_dim); n_heads must divide by the
       axis size.
+      flash: run the local core as the Pallas streaming-softmax kernel
+        (ops/flash_attention.py) — default: on TPU only (the interpreter
+        is slow on CPU; numerics are oracle-tested identical).
 
     Returns: (T_local, n_heads, head_dim).
     """
@@ -52,6 +55,19 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # scatter heads, gather sequence: (T_local, H, D) -> (T_global, H/n, D)
     qg, kg, vg = (a2a(x, 1, 0) for x in (q, k, v))
     # the full sequence is local now, so plain causal attention is exact
-    out = local_attention(qg, kg, vg, causal=causal)
+    if flash is None:
+        # auto keys off the ACTUAL placement, not just the process
+        # default: a jax.default_device(cpu) pin on a TPU host must not
+        # select the Mosaic kernel
+        dev = getattr(jax.config, "jax_default_device", None)
+        platform = (getattr(dev, "platform", None)
+                    or jax.default_backend())
+        flash = platform == "tpu"
+    if flash:
+        from ..ops.flash_attention import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal=causal)
+    else:
+        out = local_attention(qg, kg, vg, causal=causal)
     # inverse: scatter sequence, gather heads
     return a2a(out, 0, 1)
