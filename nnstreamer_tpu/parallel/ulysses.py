@@ -60,8 +60,11 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         # default: a jax.default_device(cpu) pin on a TPU host must not
         # select the Mosaic kernel
         dev = getattr(jax.config, "jax_default_device", None)
-        platform = (getattr(dev, "platform", None)
-                    or jax.default_backend())
+        if isinstance(dev, str):           # e.g. JAX_DEFAULT_DEVICE=cpu
+            platform = dev.split(":")[0]
+        else:
+            platform = (getattr(dev, "platform", None)
+                        or jax.default_backend())
         flash = platform == "tpu"
     if flash:
         from ..ops.flash_attention import flash_attention
