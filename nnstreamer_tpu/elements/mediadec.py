@@ -20,7 +20,6 @@ PIL/libpng/libsndfile):
 from __future__ import annotations
 
 from fractions import Fraction
-from typing import Optional
 
 import numpy as np
 

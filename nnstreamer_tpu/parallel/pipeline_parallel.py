@@ -28,7 +28,6 @@ than the 8-way CI mesh can host).
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Dict, Optional, Tuple
 
 import jax
