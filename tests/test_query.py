@@ -301,3 +301,53 @@ class TestWireFuzz:
         finally:
             b.close()
             t.join(timeout=10)
+
+
+CHILD_QUERY_CLIENT = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+port = int(sys.argv[1])
+caps = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+        "types=float32,framerate=0/1")
+p = parse_launch(f"appsrc name=src caps={caps} ! "
+                 f"tensor_query_client port={port} timeout=15 ! "
+                 "tensor_sink name=out")
+got = []
+p.get("out").connect("new-data", lambda b: got.append(
+    np.asarray(b.tensors[0]).ravel().copy()))
+p.play()
+for i in range(4):
+    p.get("src").push_buffer(
+        TensorBuffer(tensors=[np.full(4, float(i), np.float32)], pts=i))
+p.get("src").end_of_stream()
+p.wait(timeout=30)
+p.stop()
+assert len(got) == 4, got
+for i, arr in enumerate(got):
+    assert (arr == 2.0 * i).all(), (i, arr)
+print("CHILD_OK")
+"""
+
+
+class TestQueryTwoProcess:
+    def test_offload_across_processes(self, serving_pipeline):
+        """Client pipeline in a CHILD process offloads to this process's
+        server over TCP — the reference's gstTestBackground strategy
+        (tests/nnstreamer_edge/query/runTest.sh: server and client as
+        separate gst-launch processes on localhost)."""
+        import os
+        import subprocess
+        import sys as _sys
+
+        _, port = serving_pipeline
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [_sys.executable, "-c", CHILD_QUERY_CLIENT, str(port)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "CHILD_OK" in proc.stdout
