@@ -164,7 +164,7 @@ def _peak_flops(device) -> float:
     return _peak_lookup(device, PEAK_FLOPS)
 
 
-def _batched_fps(model, device, size: int) -> float:
+def _batched_fps(model, device, size: int, batch: int = BATCH) -> float:
     """vmap-batched invoke throughput (frames/sec): the MXU-utilization
     number the one-frame-per-dispatch streaming path can't show."""
     import jax
@@ -172,14 +172,14 @@ def _batched_fps(model, device, size: int) -> float:
     batched = jax.jit(jax.vmap(model.forward, in_axes=(None, 0)))
     params = jax.device_put(model.params, device)
     frames = np.random.default_rng(0).integers(
-        0, 255, (BATCH, size, size, 3), dtype=np.uint8)
+        0, 255, (batch, size, size, 3), dtype=np.uint8)
     frames = jax.device_put(frames, device)
     jax.block_until_ready(batched(params, frames))  # compile
     reps, t0 = 5, time.monotonic()
     for _ in range(reps):
         out = batched(params, frames)
     jax.block_until_ready(out)
-    return reps * BATCH / (time.monotonic() - t0)
+    return reps * batch / (time.monotonic() - t0)
 
 
 def bench_model(name: str, model_name: str, size: int, decoder: str,
@@ -224,9 +224,13 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
         flops, bytes_acc = _model_cost(model, device)
         peak = _peak_flops(device)
         bw = _peak_bw(device)
-        bfps = 0.0
+        bfps = bfps_big = 0.0
         try:
             bfps = _batched_fps(model, device, size)
+            if device.platform != "cpu":
+                # a second point for the batch-tuning curve (TPU only —
+                # batch-256 convs take minutes on host CPU)
+                bfps_big = _batched_fps(model, device, size, batch=256)
         except Exception:
             pass
     finally:
@@ -252,6 +256,10 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
     if bfps:
         out["batched_fps"] = round(bfps, 2)
         out["batch"] = BATCH
+    if bfps_big:
+        out["batched_fps_256"] = round(bfps_big, 2)
+        if flops and peak:
+            out["mfu_batched_256"] = round(bfps_big * flops / peak, 6)
     return out
 
 

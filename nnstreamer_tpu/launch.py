@@ -71,6 +71,9 @@ def main(argv=None) -> int:
     ap.add_argument("--stats", action="store_true",
                     help="print the pipeline LATENCY query result at EOS "
                          "(per-element invoke latency contributions)")
+    ap.add_argument("--trace", action="store_true",
+                    help="record per-element proctime/framerate (GstShark "
+                         "tracer role) and print the report at EOS")
     args = ap.parse_args(argv)
 
     if args.inspect is not None:
@@ -94,6 +97,7 @@ def main(argv=None) -> int:
             for el in p.elements:
                 if hasattr(el, "latency_report"):
                     el.latency_report = True
+        tracer = p.enable_tracing() if args.trace else None
         try:
             p.play()
             p.wait(args.timeout)
@@ -106,6 +110,13 @@ def main(argv=None) -> int:
                       file=sys.stderr)
         finally:
             p.stop()
+            if tracer is not None:
+                # print even on timeout/error: bounded profiling of a
+                # live pipeline is exactly the --trace --timeout use case
+                import json as _json
+
+                print(_json.dumps({"trace": tracer.report()}, indent=2),
+                      file=sys.stderr)
     except Exception as exc:  # noqa: BLE001
         print(f"ERROR: {exc}", file=sys.stderr)
         return 1

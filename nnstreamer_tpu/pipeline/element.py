@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import enum
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ..tensor.buffer import TensorBuffer
@@ -256,8 +257,16 @@ class Element:
 
     # -- dataflow entries (called by pads) -----------------------------------
     def _chain_entry(self, pad: Pad, buf: TensorBuffer) -> FlowReturn:
+        tracer = (self.pipeline.tracer
+                  if self.pipeline is not None else None)
         try:
-            return self.chain(pad, buf)
+            if tracer is None:
+                return self.chain(pad, buf)
+            tracer.enter()
+            try:
+                return self.chain(pad, buf)
+            finally:
+                tracer.exit(self.name)
         except Exception as exc:  # noqa: BLE001 - becomes pipeline error
             if self.pipeline is not None:
                 self.pipeline.post_error(self, exc)

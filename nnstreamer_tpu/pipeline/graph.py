@@ -42,6 +42,7 @@ class Pipeline:
 
     def __init__(self, name: str = "pipeline"):
         self.name = name
+        self.tracer = None          # set by enable_tracing()
         self.elements: List[Element] = []
         self._by_name: Dict[str, Element] = {}
         self._error: Optional[PipelineError] = None
@@ -153,6 +154,16 @@ class Pipeline:
                         f"unlinked pad {p.full_name} (request pads are "
                         "created sequentially: naming sink_N also creates "
                         "sink_0..sink_N-1, which must all be linked)")
+
+    def enable_tracing(self):
+        """Attach a dataflow tracer (proctime/framerate per element — the
+        GstShark tracer role, tools/tracing/README.md).  Returns the
+        :class:`~nnstreamer_tpu.pipeline.tracing.Tracer`; call
+        ``tracer.report()`` after the run."""
+        from .tracing import Tracer
+
+        self.tracer = Tracer()
+        return self.tracer
 
     def query_latency(self) -> "tuple[int, Dict[str, int]]":
         """Pipeline LATENCY query (reference: GStreamer latency query with

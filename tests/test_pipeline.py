@@ -320,3 +320,54 @@ class TestOctetChunking:
         assert bytes(out[0].np(0)[0][:2]) == b"hi"
         assert bytes(out[0].np(0)[1]) == b"world!!!"
         assert bytes(out[1].np(0)[0][:3]) == b"xyz"
+
+
+class TestTracing:
+    def test_proctime_framerate_report(self):
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            "videotestsrc num-buffers=16 pattern=gradient ! "
+            "video/x-raw,format=GRAY8,width=16,height=16,framerate=30/1 ! "
+            "tensor_converter ! tensor_transform mode=typecast "
+            "option=float32 ! tensor_sink name=out")
+        tracer = p.enable_tracing()
+        p.run(timeout=30)
+        rep = tracer.report()
+        # every chaining element appears with 16 buffers and real timings
+        for name, st in rep.items():
+            assert st["buffers"] == 16, (name, st)
+            assert st["proctime_ms"] >= 0.0
+            assert st["proctime_avg_us"] > 0.0
+        assert any("tensor_transform" in n for n in rep)
+        assert any("tensor_sink" in n or "out" == n for n in rep)
+
+    def test_no_tracer_no_report(self):
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            "videotestsrc num-buffers=2 ! "
+            "video/x-raw,format=GRAY8,width=8,height=8,framerate=30/1 ! "
+            "tensor_converter ! tensor_sink")
+        p.run(timeout=30)  # tracer off: nothing recorded, no overhead path
+        assert p.tracer is None
+
+    def test_proctime_is_self_time_not_downstream(self):
+        """A deliberately slow SINK must not inflate the upstream
+        converter's proctime (synchronous push subtraction)."""
+        import time as _time
+
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            "videotestsrc num-buffers=8 ! "
+            "video/x-raw,format=GRAY8,width=8,height=8,framerate=30/1 ! "
+            "tensor_converter name=conv ! tensor_sink name=out")
+        p.get("out").connect("new-data", lambda b: _time.sleep(0.01))
+        tracer = p.enable_tracing()
+        p.run(timeout=30)
+        rep = tracer.report()
+        sink = rep["out"]
+        conv = rep["conv"]
+        assert sink["proctime_avg_us"] > 9000       # the sleep lives here
+        assert conv["proctime_avg_us"] < 5000, conv  # not charged upstream
