@@ -89,7 +89,8 @@ def restore_params(template, path: str):
 
 
 def _ensure_loaded() -> None:
-    from . import mobilenet_v2, ssd, deeplab_v3, posenet  # noqa: F401
+    from . import (mobilenet_v2, ssd, deeplab_v3, posenet,  # noqa: F401
+                   streamformer_lm)  # noqa: F401
 
 
 def has_model(name: str) -> bool:

@@ -1,0 +1,226 @@
+"""StreamFormer LM serving: full-sequence forward + KV-cache decoding.
+
+The training side lives in parallel/train_step.py (sharded over
+dp/sp/tp/ep).  This module is the single-device SERVING path for the same
+parameter tree: a full-sequence forward for pipeline use (registry model
+``streamformer_lm`` → ``tensor_filter framework=xla``), and an
+incremental decode step with a static-shape KV cache for token streaming
+— `lax`-friendly (fixed ``max_seq`` cache, position index, one
+``dynamic_update_slice`` per layer), so the whole generate loop is ONE
+compiled ``lax.scan``.
+
+Consistency contract (tested): decoding token-by-token through the cache
+reproduces the full-sequence forward's logits at every position, and the
+full forward matches the training forward (shard_map on a 1-device mesh)
+— params trained with make_train_step serve unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.train_step import (StreamFormerConfig, _ln,
+                                   init_params)
+
+
+def _moe_dense(y, lyr, cfg: StreamFormerConfig):
+    """Top-1 routed MoE for serving: per-token expert selection with a
+    dense einsum over ALL experts masked to the chosen one (E is small;
+    no capacity cap at serving — every token runs its expert)."""
+    gate = jnp.einsum("...d,de->...e", y.astype(jnp.float32),
+                      lyr["gate"].astype(jnp.float32))
+    probs = jax.nn.softmax(gate, axis=-1)
+    choice = jnp.argmax(probs, axis=-1)                      # (...,)
+    onehot = jax.nn.one_hot(choice, cfg.experts, dtype=y.dtype)
+    scale = jnp.take_along_axis(probs, choice[..., None],
+                                axis=-1)[..., 0].astype(y.dtype)
+    h = jax.nn.gelu(jnp.einsum("...d,edf->...ef", y,
+                               lyr["we1"].astype(y.dtype)))
+    out = jnp.einsum("...ef,efd->...ed", h, lyr["we2"].astype(y.dtype))
+    picked = jnp.einsum("...ed,...e->...d", out, onehot)
+    return picked * scale[..., None]
+
+
+def forward_logits(params: Dict[str, Any], tokens: jnp.ndarray,
+                   cfg: StreamFormerConfig) -> jnp.ndarray:
+    """Full-sequence forward: tokens (T,) int32 → logits (T, vocab).
+    Same math as the training forward (single device, causal)."""
+    t = tokens.shape[0]
+    pos = jnp.arange(t)
+    x = (params["embed"][tokens] + params["pos"][pos]).astype(cfg.dtype)
+    for lyr in params["layers"]:
+        y = _ln(x.astype(jnp.float32), lyr["ln1"]).astype(cfg.dtype)
+        qkv = jnp.einsum("td,dchn->tchn", y, lyr["wqkv"].astype(cfg.dtype))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+        s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        mask = jnp.arange(t)[None, None, :] > jnp.arange(t)[None, :, None]
+        s = jnp.where(mask, -jnp.inf, s)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+        o = jnp.einsum("qhd,hdn->qn", attn.astype(cfg.dtype),
+                       lyr["wo"].astype(cfg.dtype))
+        x = x + o
+        y = _ln(x.astype(jnp.float32), lyr["ln2"]).astype(cfg.dtype)
+        m = jnp.einsum("td,df->tf", y, lyr["w1"].astype(cfg.dtype))
+        m = jnp.einsum("tf,fd->td", jax.nn.gelu(m),
+                       lyr["w2"].astype(cfg.dtype))
+        x = x + m + _moe_dense(y, lyr, cfg)
+    x = _ln(x.astype(jnp.float32), params["ln_f"])
+    return jnp.einsum("td,dv->tv", x, params["head"])
+
+
+def init_cache(cfg: StreamFormerConfig) -> Dict[str, jnp.ndarray]:
+    """Static-shape KV cache: (layers, max_seq, heads, head_dim)."""
+    L = cfg.layers
+    shape = (L, cfg.max_seq, cfg.heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def decode_step(params: Dict[str, Any], cache: Dict[str, jnp.ndarray],
+                token: jnp.ndarray, cfg: StreamFormerConfig
+                ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One incremental step: token () int32 → (logits (vocab,), cache').
+
+    Attention runs the single query against the cache prefix; positions
+    beyond ``cache['pos']`` are masked, so the cache array's static
+    ``max_seq`` shape never leaks into the math."""
+    pos = cache["pos"]
+    x = (params["embed"][token] + params["pos"][pos]).astype(cfg.dtype)
+    new_k = cache["k"]
+    new_v = cache["v"]
+    valid = jnp.arange(cfg.max_seq) <= pos                 # causal prefix
+    for li, lyr in enumerate(params["layers"]):
+        y = _ln(x.astype(jnp.float32), lyr["ln1"]).astype(cfg.dtype)
+        qkv = jnp.einsum("d,dchn->chn", y, lyr["wqkv"].astype(cfg.dtype))
+        q, k, v = qkv[0], qkv[1], qkv[2]                   # (H, Dh)
+        new_k = jax.lax.dynamic_update_slice(
+            new_k, k[None, None], (li, pos, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(
+            new_v, v[None, None], (li, pos, 0, 0))
+        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+        s = jnp.einsum("hd,thd->ht", q.astype(jnp.float32),
+                       new_k[li].astype(jnp.float32)) * scale
+        s = jnp.where(valid[None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("ht,thd->hd", p,
+                          new_v[li].astype(jnp.float32))
+        o = jnp.einsum("hd,hdn->n", attn.astype(cfg.dtype),
+                       lyr["wo"].astype(cfg.dtype))
+        x = x + o
+        y = _ln(x.astype(jnp.float32), lyr["ln2"]).astype(cfg.dtype)
+        m = jnp.einsum("d,df->f", y, lyr["w1"].astype(cfg.dtype))
+        m = jnp.einsum("f,fd->d", jax.nn.gelu(m),
+                       lyr["w2"].astype(cfg.dtype))
+        x = x + m + _moe_dense(y, lyr, cfg)
+    x = _ln(x.astype(jnp.float32), params["ln_f"])
+    logits = jnp.einsum("d,dv->v", x, params["head"])
+    return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
+
+
+#: compiled generate programs keyed by (cfg fields, lengths, temperature)
+_RUN_CACHE: Dict[tuple, Any] = {}
+
+
+def _compiled_run(cfg: StreamFormerConfig, n_prompt: int, n_tokens: int,
+                  temperature: float):
+    key = (tuple(sorted(vars(cfg).items(), key=lambda kv: kv[0],
+                        )).__repr__(), n_prompt, n_tokens, temperature)
+    fn = _RUN_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    @jax.jit
+    def run(params, prompt_toks, rng_key):
+        cache = init_cache(cfg)
+
+        def prefill(carry, tok):
+            cache = carry
+            logits, cache = decode_step(params, cache, tok, cfg)
+            return cache, logits
+
+        cache, logits_seq = jax.lax.scan(prefill, cache, prompt_toks)
+        last_logits = logits_seq[-1]
+
+        def step(carry, _):
+            cache, logits, rng_key = carry
+            if temperature > 0:
+                rng_key, sub = jax.random.split(rng_key)
+                tok = jax.random.categorical(sub, logits / temperature)
+            else:
+                tok = jnp.argmax(logits)
+            tok = tok.astype(jnp.int32)
+            new_logits, cache = decode_step(params, cache, tok, cfg)
+            return (cache, new_logits, rng_key), tok
+
+        _, toks = jax.lax.scan(step, (cache, last_logits, rng_key),
+                               None, length=n_tokens)
+        return toks
+
+    _RUN_CACHE[key] = run
+    return run
+
+
+def generate(params: Dict[str, Any], cfg: StreamFormerConfig,
+             prompt: np.ndarray, n_tokens: int,
+             temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+    """Greedy (temperature 0) or sampled continuation, fully device-side
+    (prefill scan + decode scan); compiled programs are cached per
+    (config, lengths, temperature) so repeat calls skip XLA."""
+    prompt_arr = jnp.asarray(prompt, jnp.int32)
+    total = prompt_arr.shape[0] + n_tokens
+    if total > cfg.max_seq:
+        raise ValueError(
+            f"prompt ({prompt_arr.shape[0]}) + n_tokens ({n_tokens}) = "
+            f"{total} exceeds max_seq={cfg.max_seq}: the KV cache would "
+            "clamp positions and silently corrupt the continuation")
+    run = _compiled_run(cfg, prompt_arr.shape[0], n_tokens, temperature)
+    return np.asarray(run(params, prompt_arr, jax.random.PRNGKey(seed)))
+
+
+def _build_registry_model(custom_props):
+    """``framework=xla model=streamformer_lm``: full-sequence next-token
+    logits as a pipeline filter — tokens in (T,) int32, logits out
+    (T, vocab) float32."""
+    from .registry import Model, host_init
+    from ..tensor.info import TensorInfo, TensorsInfo
+    from ..tensor.types import TensorType
+
+    seed = int(custom_props.get("seed", 0))
+    seq = int(custom_props.get("seq", 64))
+    cfg = StreamFormerConfig(
+        vocab=int(custom_props.get("vocab", 256)),
+        dim=int(custom_props.get("dim", 128)),
+        heads=int(custom_props.get("heads", 8)),
+        head_dim=int(custom_props.get("head_dim", 16)),
+        mlp=int(custom_props.get("mlp", 512)),
+        layers=int(custom_props.get("layers", 2)),
+        experts=int(custom_props.get("experts", 2)),
+        max_seq=max(seq, 64),
+        dtype=jnp.dtype(custom_props.get("dtype", "bfloat16")))
+    params = host_init(lambda: init_params(cfg, seed))
+
+    def forward(params, tokens):
+        return (forward_logits(params, tokens, cfg).astype(jnp.float32),)
+
+    in_info = TensorsInfo([TensorInfo(TensorType.INT32, (seq,))])
+    out_info = TensorsInfo([TensorInfo(TensorType.FLOAT32,
+                                       (cfg.vocab, seq))])
+    return Model(name="streamformer_lm", forward=forward, params=params,
+                 in_info=in_info, out_info=out_info)
+
+
+def _register():
+    from .registry import register_model
+
+    register_model("streamformer_lm")(_build_registry_model)
+
+
+_register()
