@@ -479,7 +479,32 @@ def _make_ops() -> Dict[str, Callable]:
         "Max": _mean_like(jnp.max), "Min": _mean_like(jnp.min),
         "ArgMax": _argmax, "Shape": _shape, "Cast": _cast,
         "StridedSlice": _strided_slice,
+        "AudioSpectrogram": _audio_spectrogram, "Mfcc": _mfcc,
     }
+
+
+def _audio_spectrogram(node, ins, ctx):
+    from ...ops.audio import audio_spectrogram
+
+    return audio_spectrogram(
+        ins[0], int(node.attrs["window_size"]), int(node.attrs["stride"]),
+        bool(node.attrs.get("magnitude_squared")))
+
+
+def _mfcc(node, ins, ctx):
+    """TF Mfcc.  The mel filterbank matrix is rate-dependent and built
+    host-side, so the sample rate must be STATIC: the graph-level
+    ``audio_rate`` (stamped by the DecodeWav hoist from the declared
+    stream) wins, else 16 kHz (the speech-command default)."""
+    from ...ops.audio import mfcc
+
+    rate = float(getattr(ctx.graph, "audio_rate", 16000.0))
+    return mfcc(
+        ins[0], rate,
+        channel_count=int(node.attrs.get("filterbank_channel_count", 40)),
+        lower_limit=float(node.attrs.get("lower_frequency_limit", 20.0)),
+        upper_limit=float(node.attrs.get("upper_frequency_limit", 4000.0)),
+        dct_count=int(node.attrs.get("dct_coefficient_count", 13)))
 
 
 def _avgpool(node, ins, ctx):
@@ -547,15 +572,32 @@ class TFGraph:
         if _OPS is None:
             _OPS = _make_ops()
         ops = _OPS
+        supplied = {_split_ref(r)[0] for r in input_names}
+        # reachable-from-outputs, STOPPING at supplied nodes: the subgraph
+        # above a supplied node (e.g. the string Placeholder feeding a
+        # hoisted DecodeWav) must not enter the plan
+        reachable = set()
+        stack = [_split_ref(r)[0] for r in output_refs]
+        while stack:
+            name = stack.pop()
+            if name in reachable or name in supplied:
+                continue
+            reachable.add(name)
+            node = self.nodes.get(name)
+            if node is not None:
+                stack.extend(_split_ref(r)[0]
+                             for r in _data_inputs(node))
         plan = [n for n in self.topo_order(
             [_split_ref(r)[0] for r in output_refs])
-            if n.name not in input_names]
+            if n.name in reachable]
         inputs = list(input_names)
 
         def fn(consts: Dict[str, Any], *xs):
             env: Dict[str, Any] = {}
             for name, x in zip(inputs, xs):
-                env[f"{name}:0"] = x
+                # inputs may be explicit refs ("node:1") — the DecodeWav
+                # hoist feeds both of that node's outputs directly
+                env[name if ":" in name else f"{name}:0"] = x
             ctx = _Ctx(self, env)
             for node in plan:
                 if node.op == "Const":
@@ -577,6 +619,47 @@ class TFGraph:
         return fn
 
 
+def _make_wav_pre(desired_samples: int, desired_channels: int,
+                  static_rate: int):
+    """Host preprocessing for the DecodeWav hoist: raw wav-file bytes →
+    [audio float32 (samples, channels) in [-1, 1), rate int32] with TF's
+    trim/pad-to-desired semantics."""
+    from ...utils.mediadec import parse_wav
+
+    def pre(inputs):
+        raw = np.ascontiguousarray(np.asarray(inputs[0])).tobytes()
+        samples, rate = parse_wav(raw)
+        if rate != static_rate:
+            raise FilterError(
+                f"tensorflow: wav sample rate {rate} != the rate the "
+                f"Mfcc filterbank was built for ({static_rate}); set "
+                "custom=audio_rate:<hz> to match the stream")
+        if samples.dtype == np.int16:
+            audio = samples.astype(np.float32) / 32768.0
+        elif samples.dtype == np.uint8:
+            audio = (samples.astype(np.float32) - 128.0) / 128.0
+        else:
+            audio = samples.astype(np.float32)
+        if desired_channels:
+            if audio.shape[1] > desired_channels:
+                audio = audio[:, :desired_channels]
+            elif audio.shape[1] < desired_channels:
+                # TF DecodeWav repeats the last channel to fill
+                pad = np.repeat(audio[:, -1:],
+                                desired_channels - audio.shape[1], axis=1)
+                audio = np.concatenate([audio, pad], axis=1)
+        if desired_samples:
+            n = audio.shape[0]
+            if n >= desired_samples:
+                audio = audio[:desired_samples]
+            else:
+                audio = np.pad(audio,
+                               ((0, desired_samples - n), (0, 0)))
+        return [audio, np.int32(rate)]
+
+    return pre
+
+
 @register_filter
 class TensorFlowFilter(JitExecMixin, FilterFramework):
     """``framework=tensorflow``: frozen .pb GraphDef compiled to XLA."""
@@ -587,6 +670,7 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
     def __init__(self) -> None:
         super().__init__()
         self._graph: Optional[TFGraph] = None
+        self._host_pre = None
         self._jitted = None
         self._in_info: Optional[TensorsInfo] = None
         self._out_info: Optional[TensorsInfo] = None
@@ -645,13 +729,46 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
                     np.zeros(shape, _DTYPES.get(dt, "float32")), name=name))
             in_info = TensorsInfo(infos)
 
-        fn = graph.build(in_names, out_refs)
+        # DecodeWav hoist: byte parsing cannot trace, so when the (single)
+        # input is a string Placeholder feeding DecodeWav, the wav decode
+        # runs HOST-SIDE per frame and the jitted graph starts at the
+        # decoded (audio, rate) pair (reference parity: the TF runtime's
+        # DecodeWav is host work too).
+        self._host_pre = None
+        self._wav_shape = None
+        build_in = list(in_names)
+        warm = None
+        # the Mfcc filterbank is rate-dependent and built at trace time:
+        # honor custom=audio_rate for ANY graph containing Mfcc
+        rate = int(custom.get("audio_rate", "16000"))
+        graph.audio_rate = float(rate)
+        if len(in_names) == 1:
+            decode = next(
+                (n for n in graph.nodes.values() if n.op == "DecodeWav"
+                 and _split_ref(n.inputs[0])[0] == in_names[0]), None)
+            if decode is not None:
+                want_n = int(decode.attrs.get("desired_samples") or 0) \
+                    or int(custom.get("audio_samples", "0"))
+                want_c = int(decode.attrs.get("desired_channels") or 1)
+                if want_n <= 0:
+                    raise FilterError(
+                        "tensorflow: DecodeWav without desired_samples "
+                        "is dynamically shaped under XLA; set "
+                        "custom=audio_samples:<n> to pin the length")
+                self._host_pre = _make_wav_pre(want_n, want_c, rate)
+                self._wav_shape = (want_n, want_c)
+                build_in = [f"{decode.name}:0", f"{decode.name}:1"]
+                warm = [np.zeros((want_n, want_c), np.float32),
+                        np.int32(rate)]
+
+        fn = graph.build(build_in, out_refs)
         consts = {n.name: n.const for n in graph.nodes.values()
                   if n.const is not None}
         device = self._pick_device(props.accelerators)
         self._graph = graph
 
-        zeros = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
+        zeros = warm if warm is not None else [
+            np.zeros(i.np_shape, i.np_dtype) for i in in_info]
         outs = self._setup_exec(fn, consts, device, warmup_inputs=zeros)
         probed = TensorsInfo([TensorInfo.from_np(np.asarray(o), name=r)
                               for o, r in zip(outs, out_refs)])
@@ -668,8 +785,31 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
 
     def close(self) -> None:
         self._graph = None
+        self._host_pre = None
         self._teardown_exec()
         super().close()
+
+    # -- hot path: host preprocessing (DecodeWav hoist) ----------------------
+    def invoke(self, inputs):
+        if self._host_pre is not None:
+            inputs = self._host_pre(inputs)
+        return super().invoke(inputs)
+
+    def invoke_batched(self, frames, bucket: int):
+        if self._host_pre is not None:
+            frames = [self._host_pre(f) for f in frames]
+        return super().invoke_batched(frames, bucket)
+
+    def warmup_batched(self, bucket: int) -> None:
+        if self._host_pre is None:
+            return super().warmup_batched(bucket)
+        # batched warmup with DECODED shapes, not the byte-blob info
+        import jax
+
+        n, c = self._wav_shape
+        zeros = [np.zeros((bucket, n, c), np.float32),
+                 np.zeros((bucket,), np.int32)]
+        jax.block_until_ready(self._dispatch_batched(zeros))
 
     # -- model meta ----------------------------------------------------------
     def get_model_info(self) -> Tuple[TensorsInfo, TensorsInfo]:

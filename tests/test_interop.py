@@ -510,3 +510,81 @@ class TestTensorFlowBackend:
     def test_auto_detect_pb(self):
         assert detect_framework(
             os.path.join(REF_MODELS, "mnist.pb")) == "tensorflow"
+
+
+@needs_ref
+class TestSpeechCommandGolden:
+    """Mirror of tests/nnstreamer_filter_tensorflow/runTest.sh case 3:
+    yes.wav (raw FILE bytes as int16 1:16022) through
+    conv_actions_frozen.pb — DecodeWav hoisted to a host pre-step,
+    AudioSpectrogram (Hann STFT) + Mfcc (TF mel filterbank + DCT) lowered
+    into the XLA graph.  checkLabel.py expects argmax == 2 ('yes')."""
+
+    MODEL = os.path.join(REF_MODELS, "conv_actions_frozen.pb")
+    WAV = os.path.join(REF_MODELS, "..", "data", "yes.wav")
+
+    def test_backend_golden(self):
+        from nnstreamer_tpu.tensor.info import TensorInfo
+        from nnstreamer_tpu.tensor.types import TensorType
+
+        ii = TensorsInfo([TensorInfo(TensorType.INT16, (1, 16022))])
+        fw = open_backend(FilterProperties(
+            framework="tensorflow", model=self.MODEL, input_info=ii,
+            custom_properties={"inputname": "wav_data",
+                               "outputname": "labels_softmax"}))
+        try:
+            blob = np.frombuffer(open(self.WAV, "rb").read(),
+                                 np.int16).reshape(16022, 1)
+            out = np.asarray(fw.invoke([blob])[0]).ravel()
+            assert out.shape == (12,)
+            assert abs(out.sum() - 1.0) < 1e-3
+            assert int(out.argmax()) == 2      # 'yes'
+            assert out[2] > 0.5                # confident, like the ref run
+        finally:
+            fw.close()
+
+    def test_ssat_pipeline_mirror(self):
+        """The reference launch line end-to-end: filesrc ! octet !
+        tensor_converter int16 ! tensor_filter tensorflow ! sink."""
+        from nnstreamer_tpu import parse_launch
+
+        got = []
+        p = parse_launch(
+            f"filesrc location={self.WAV} blocksize=-1 ! "
+            "application/octet-stream ! "
+            "tensor_converter input-dim=1:16022 input-type=int16 ! "
+            f"tensor_filter framework=tensorflow model={self.MODEL} "
+            "input-dim=1:16022 input-type=int16 "
+            "output-dim=12:1 output-type=float32 "
+            "custom=inputname:wav_data,outputname:labels_softmax ! "
+            "tensor_sink name=out")
+        p.get("out").connect("new-data", lambda b: got.append(
+            np.asarray(b.tensors[0]).ravel().copy()))
+        p.run(timeout=120)
+        assert len(got) == 1
+        assert int(got[0].argmax()) == 2
+
+    def test_wrong_rate_is_loud(self, tmp_path):
+        import struct
+
+        from nnstreamer_tpu.tensor.info import TensorInfo
+        from nnstreamer_tpu.tensor.types import TensorType
+
+        # 8 kHz wav: the Mfcc filterbank was built for 16 kHz -> error
+        pcm = np.zeros(16000, np.int16).tobytes()
+        hdr = (b"RIFF" + struct.pack("<I", 36 + len(pcm)) + b"WAVE"
+               + b"fmt " + struct.pack("<IHHIIHH", 16, 1, 1, 8000,
+                                       16000, 2, 16)
+               + b"data" + struct.pack("<I", len(pcm)))
+        blob = np.frombuffer(hdr + pcm, np.uint8)
+        n = blob.size // 2
+        ii = TensorsInfo([TensorInfo(TensorType.INT16, (1, n))])
+        fw = open_backend(FilterProperties(
+            framework="tensorflow", model=self.MODEL, input_info=ii,
+            custom_properties={"inputname": "wav_data",
+                               "outputname": "labels_softmax"}))
+        try:
+            with pytest.raises(FilterError, match="sample rate"):
+                fw.invoke([blob[:n * 2].view(np.int16).reshape(n, 1)])
+        finally:
+            fw.close()
