@@ -5,6 +5,7 @@ gst/nnstreamer/nnstreamer_subplugin.c:116)."""
 from .caffe2 import Caffe2Filter
 from .custom import (CustomEasyFilter, CustomFilter, DummyFilter,
                      register_custom_easy, unregister_custom_easy)
+from .lua import LuaFilter
 from .mxnet import MXNetFilter
 from .python import PythonFilter
 from .pytorch import PyTorchFilter
@@ -14,7 +15,7 @@ from .xla import XLAFilter
 
 __all__ = [
     "XLAFilter", "Caffe2Filter", "CustomFilter", "CustomEasyFilter",
-    "DummyFilter", "MXNetFilter",
+    "DummyFilter", "LuaFilter", "MXNetFilter",
     "PythonFilter", "TFLiteFilter", "PyTorchFilter", "TensorFlowFilter",
     "register_custom_easy", "unregister_custom_easy",
 ]

@@ -1,0 +1,669 @@
+"""minilua: a small Lua interpreter for script filters.
+
+The reference's lua subplugin embeds liblua to run user scripts as stream
+filters (ext/nnstreamer/tensor_filter/tensor_filter_lua.cc, 591 LoC; the
+fixture scripts are tests/test_models/models/passthrough.lua and
+scaler.lua).  This image has no Lua runtime, so the TPU framework ships
+its own interpreter for the Lua subset those filters use — written from
+the Lua 5.x reference manual, not from any Lua implementation:
+
+statements   assignment (incl. table fields), local, function defs,
+             numeric for, while, if/elseif/else, return, break, calls
+expressions  precedence-climbing: or/and, comparisons, .., + -, * / %,
+             unary - not #, ^, calls, table constructors, field/index
+values       numbers (int/float), strings, booleans, nil, 1-based tables
+stdlib       math.floor/ceil/abs/min/max/sqrt/huge, #, print
+
+Execution compiles the AST to Python closures once (scripts run a
+nested-loop body per frame — ~1M interpreted ops for the reference's
+640×480 scaler — so a tree-walk per eval would be too slow).  Host
+integration: callers inject globals (e.g. ``input_tensor``) and read
+globals back (``inputTensorsInfo``); numpy-backed objects implementing
+``__getitem__``/``__setitem__`` work as 1-based tensor proxies.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class LuaError(RuntimeError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+
+_KEYWORDS = {"and", "break", "do", "else", "elseif", "end", "false", "for",
+             "function", "if", "local", "nil", "not", "or", "return",
+             "then", "true", "while"}
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*)
+  | (?P<num>\d+\.\d*|\.\d+|\d+)
+  | (?P<name>[A-Za-z_]\w*)
+  | (?P<str>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+  | (?P<op>\.\.|==|~=|<=|>=|[-+*/%^#<>=(){}\[\],;.:])
+""", re.VERBOSE)
+
+
+def _lex(src: str) -> List[Tuple[str, Any]]:
+    toks: List[Tuple[str, Any]] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise LuaError(f"lua: bad character {src[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        text = m.group()
+        if kind in ("ws", "comment"):
+            continue
+        if kind == "num":
+            toks.append(("num", float(text) if "." in text else int(text)))
+        elif kind == "name":
+            toks.append((text, text) if text in _KEYWORDS
+                        else ("name", text))
+        elif kind == "str":
+            body = text[1:-1]
+            toks.append(("str", re.sub(
+                r"\\(.)",
+                lambda m: {"n": "\n", "t": "\t", "r": "\r",
+                           "a": "\a", "0": "\0"}.get(m.group(1),
+                                                       m.group(1)),
+                body)))
+        else:
+            toks.append((text, text))
+    toks.append(("<eof>", None))
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# runtime values
+# ---------------------------------------------------------------------------
+
+class LuaTable:
+    """1-based table: array part + hash part in one dict."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: Optional[Dict[Any, Any]] = None):
+        self.data = data or {}
+
+    def get(self, key):
+        if isinstance(key, float) and key.is_integer():
+            key = int(key)
+        return self.data.get(key)
+
+    def set(self, key, value):
+        if isinstance(key, float) and key.is_integer():
+            key = int(key)
+        self.data[key] = value
+
+    def length(self) -> int:
+        n = 0
+        while (n + 1) in self.data:
+            n += 1
+        return n
+
+
+class Env:
+    """Variable scope: per-call locals over the shared globals table.
+
+    Lua semantics: reads fall through locals → globals; PLAIN assignment
+    writes the local if one exists, else the GLOBAL; ``local`` and loop
+    control variables write locals explicitly.  The top-level chunk uses
+    the globals table as its locals."""
+
+    __slots__ = ("locals", "globals")
+
+    def __init__(self, locals_: Dict[str, Any], globals_: Dict[str, Any]):
+        self.locals = locals_
+        self.globals = globals_
+
+    def get(self, name: str):
+        L = self.locals
+        if name in L:
+            return L[name]
+        return self.globals.get(name)
+
+    def set(self, name: str, value) -> None:
+        if name in self.locals:
+            self.locals[name] = value
+        else:
+            self.globals[name] = value
+
+    def set_local(self, name: str, value) -> None:
+        self.locals[name] = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+def _truthy(v) -> bool:
+    return v is not None and v is not False
+
+
+def _index(obj, key):
+    if isinstance(obj, LuaTable):
+        return obj.get(key)
+    if hasattr(obj, "__getitem__"):
+        if isinstance(key, float) and key.is_integer():
+            key = int(key)
+        return obj[key]
+    raise LuaError(f"lua: cannot index {type(obj).__name__}")
+
+
+def _setindex(obj, key, value):
+    if isinstance(obj, LuaTable):
+        obj.set(key, value)
+        return
+    if hasattr(obj, "__setitem__"):
+        if isinstance(key, float) and key.is_integer():
+            key = int(key)
+        obj[key] = value
+        return
+    raise LuaError(f"lua: cannot index-assign {type(obj).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# parser + closure compiler
+# ---------------------------------------------------------------------------
+
+class _Parser:
+    """Recursive-descent parser emitting Python closures.
+
+    Compiled expressions are ``fn(env) -> value``; statements are
+    ``fn(env) -> None``; ``env`` is the variable scope (function calls
+    get a fresh child scope falling back to globals — sufficient for the
+    script-filter subset, which uses globals + loop locals)."""
+
+    def __init__(self, toks: List[Tuple[str, Any]]):
+        self.toks = toks
+        self.i = 0
+
+    # -- token helpers -------------------------------------------------------
+    def peek(self) -> str:
+        return self.toks[self.i][0]
+
+    def next(self) -> Tuple[str, Any]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, kind: str) -> Any:
+        k, v = self.next()
+        if k != kind:
+            raise LuaError(f"lua: expected {kind!r}, got {k!r}")
+        return v
+
+    def accept(self, kind: str) -> bool:
+        if self.peek() == kind:
+            self.i += 1
+            return True
+        return False
+
+    # -- chunk / block -------------------------------------------------------
+    def parse_chunk(self) -> Callable[[Dict], None]:
+        body = self.block(("<eof>",))
+        self.expect("<eof>")
+        return body
+
+    def block(self, stops: Tuple[str, ...]) -> Callable[[Dict], None]:
+        stmts: List[Callable] = []
+        while self.peek() not in stops:
+            st = self.statement()
+            if st is not None:
+                stmts.append(st)
+
+        def run(env):
+            for st in stmts:
+                st(env)
+        return run
+
+    # -- statements ----------------------------------------------------------
+    def statement(self) -> Optional[Callable]:
+        k = self.peek()
+        if k == ";":
+            self.next()
+            return None
+        if k == "local":
+            self.next()
+            names = [self.expect("name")]
+            while self.accept(","):
+                names.append(self.expect("name"))
+            exprs = []
+            if self.accept("="):
+                exprs = self.exprlist()
+
+            def local_stmt(env, names=names, exprs=exprs):
+                for i, n in enumerate(names):
+                    env.set_local(n, exprs[i](env) if i < len(exprs)
+                                  else None)
+            return local_stmt
+        if k == "function":
+            self.next()
+            name = self.expect("name")
+            fn = self.function_body()
+
+            def fndef(env, name=name, fn=fn):
+                env.set(name, fn(env))
+            return fndef
+        if k == "for":
+            return self.for_stmt()
+        if k == "while":
+            self.next()
+            cond = self.expr()
+            self.expect("do")
+            body = self.block(("end",))
+            self.expect("end")
+
+            def while_stmt(env, cond=cond, body=body):
+                while _truthy(cond(env)):
+                    try:
+                        body(env)
+                    except _Break:
+                        break
+            return while_stmt
+        if k == "if":
+            return self.if_stmt()
+        if k == "return":
+            self.next()
+            expr = None
+            if self.peek() not in ("end", "else", "elseif", "<eof>"):
+                expr = self.expr()
+
+            def ret(env, expr=expr):
+                raise _Return(expr(env) if expr else None)
+            return ret
+        if k == "break":
+            self.next()
+
+            def brk(env):
+                raise _Break()
+            return brk
+        return self.expr_or_assign()
+
+    def for_stmt(self) -> Callable:
+        self.next()
+        var = self.expect("name")
+        self.expect("=")
+        start = self.expr()
+        self.expect(",")
+        stop = self.expr()
+        step = None
+        if self.accept(","):
+            step = self.expr()
+        self.expect("do")
+        body = self.block(("end",))
+        self.expect("end")
+
+        _MISSING = object()
+
+        def run(env, var=var, start=start, stop=stop, step=step,
+                body=body, _MISSING=_MISSING):
+            i = start(env)
+            limit = stop(env)
+            inc = step(env) if step else 1
+            if inc == 0:
+                raise LuaError("lua: for step is zero")
+            saved = env.locals.get(var, _MISSING)
+            try:
+                while (i <= limit) if inc > 0 else (i >= limit):
+                    env.set_local(var, i)
+                    try:
+                        body(env)
+                    except _Break:
+                        break
+                    i += inc
+            finally:
+                # the control variable is a fresh local scoped to the
+                # loop (Lua manual §3.3.5) — restore the outer binding
+                if saved is _MISSING:
+                    env.locals.pop(var, None)
+                else:
+                    env.locals[var] = saved
+        return run
+
+    def if_stmt(self) -> Callable:
+        self.next()
+        arms: List[Tuple[Optional[Callable], Callable]] = []
+        cond = self.expr()
+        self.expect("then")
+        arms.append((cond, self.block(("elseif", "else", "end"))))
+        while self.peek() == "elseif":
+            self.next()
+            c = self.expr()
+            self.expect("then")
+            arms.append((c, self.block(("elseif", "else", "end"))))
+        if self.accept("else"):
+            arms.append((None, self.block(("end",))))
+        self.expect("end")
+
+        def run(env, arms=arms):
+            for cond, body in arms:
+                if cond is None or _truthy(cond(env)):
+                    body(env)
+                    return
+        return run
+
+    def expr_or_assign(self) -> Callable:
+        target = self.suffixed()
+        if self.peek() in ("=", ","):
+            targets = [target]
+            while self.accept(","):
+                targets.append(self.suffixed())
+            self.expect("=")
+            exprs = self.exprlist()
+            setters = []
+            for t in targets:
+                if t[0] == "name":
+                    setters.append(("name", t[1]))
+                elif t[0] == "index":
+                    setters.append(("index", t[1], t[2]))
+                else:
+                    raise LuaError("lua: cannot assign to expression")
+
+            def assign(env, setters=setters, exprs=exprs):
+                vals = [e(env) for e in exprs]
+                for i, s in enumerate(setters):
+                    v = vals[i] if i < len(vals) else None
+                    if s[0] == "name":
+                        env.set(s[1], v)
+                    else:
+                        _setindex(s[1](env), s[2](env), v)
+            return assign
+        # bare expression statement (function call)
+        fn = self.finish_expr_from_suffixed(target)
+
+        def run(env, fn=fn):
+            fn(env)
+        return run
+
+    # -- functions -----------------------------------------------------------
+    def function_body(self) -> Callable:
+        self.expect("(")
+        params: List[str] = []
+        if self.peek() != ")":
+            params.append(self.expect("name"))
+            while self.accept(","):
+                params.append(self.expect("name"))
+        self.expect(")")
+        body = self.block(("end",))
+        self.expect("end")
+
+        def make(defenv, params=params, body=body):
+            g = defenv.globals
+
+            def call(*args):
+                env = Env({}, g)
+                for i, p in enumerate(params):
+                    env.set_local(p, args[i] if i < len(args) else None)
+                try:
+                    body(env)
+                except _Return as r:
+                    return r.value
+                return None
+            return call
+        return make
+
+    # -- expressions (precedence climbing) -----------------------------------
+    #: precedence levels, loosest first; or/and get short-circuit
+    #: handling inline in expr(), everything else dispatches via _BINFN
+    _BINOPS = [
+        {"or"}, {"and"},
+        {"<", ">", "<=", ">=", "==", "~="},
+        {".."}, {"+", "-"}, {"*", "/", "%"},
+    ]
+
+    def exprlist(self) -> List[Callable]:
+        out = [self.expr()]
+        while self.accept(","):
+            out.append(self.expr())
+        return out
+
+    def expr(self, level: int = 0) -> Callable:
+        if level >= len(self._BINOPS):
+            return self.unary()
+        ops = self._BINOPS[level]
+        left = self.expr(level + 1)
+        while self.peek() in ops:
+            op = self.next()[0]
+            right = self.expr(level + 1)
+            if op == "or":
+                left = (lambda a, b: lambda env:
+                        (lambda v: v if _truthy(v) else b(env))(a(env))
+                        )(left, right)
+            elif op == "and":
+                left = (lambda a, b: lambda env:
+                        (lambda v: b(env) if _truthy(v) else v)(a(env))
+                        )(left, right)
+            else:
+                fn = _BINFN[op]
+                left = (lambda a, b, fn=fn: lambda env: fn(a(env), b(env))
+                        )(left, right)
+        return left
+
+    def unary(self) -> Callable:
+        if self.accept("-"):
+            operand = self.unary()
+            return lambda env: -operand(env)
+        if self.accept("not"):
+            operand = self.unary()
+            return lambda env: not _truthy(operand(env))
+        if self.accept("#"):
+            operand = self.unary()
+
+            def length(env):
+                v = operand(env)
+                if isinstance(v, LuaTable):
+                    return v.length()
+                if isinstance(v, str):
+                    return len(v)
+                try:
+                    return len(v)
+                except TypeError:
+                    raise LuaError("lua: # of non-table")
+            return length
+        return self.power()
+
+    def power(self) -> Callable:
+        base = self.finish_expr_from_suffixed(self.suffixed())
+        if self.accept("^"):
+            exp = self.unary()       # right associative, binds over unary
+            return lambda env: base(env) ** exp(env)
+        return base
+
+    # -- primary/suffixed expressions ---------------------------------------
+    def suffixed(self):
+        """Parse primary + suffixes, returning a tagged node so assignment
+        targets can be distinguished: ('name', n) | ('index', objfn,
+        keyfn) | ('expr', fn)."""
+        k, v = self.next()
+        if k == "num" or k == "str":
+            node = ("expr", lambda env, v=v: v)
+        elif k == "true":
+            node = ("expr", lambda env: True)
+        elif k == "false":
+            node = ("expr", lambda env: False)
+        elif k == "nil":
+            node = ("expr", lambda env: None)
+        elif k == "name":
+            node = ("name", v)
+        elif k == "(":
+            inner = self.expr()
+            self.expect(")")
+            node = ("expr", inner)
+        elif k == "{":
+            node = ("expr", self.table_constructor())
+        elif k == "function":
+            fn = self.function_body()
+            node = ("expr", lambda env, fn=fn: fn(env))
+        else:
+            raise LuaError(f"lua: unexpected token {k!r}")
+
+        while True:
+            p = self.peek()
+            if p == ".":
+                self.next()
+                field = self.expect("name")
+                objfn = self.node_value(node)
+                node = ("index", objfn, lambda env, f=field: f)
+            elif p == "[":
+                self.next()
+                key = self.expr()
+                self.expect("]")
+                node = ("index", self.node_value(node), key)
+            elif p == "(":
+                self.next()
+                args: List[Callable] = []
+                if self.peek() != ")":
+                    args = self.exprlist()
+                self.expect(")")
+                fnv = self.node_value(node)
+
+                def call(env, fnv=fnv, args=tuple(args)):
+                    f = fnv(env)
+                    if f is None:
+                        raise LuaError("lua: call of nil")
+                    return f(*[a(env) for a in args])
+                node = ("expr", call)
+            else:
+                return node
+
+    def node_value(self, node) -> Callable:
+        if node[0] == "name":
+            name = node[1]
+
+            def load(env, name=name):
+                return env.get(name)
+            return load
+        if node[0] == "index":
+            objfn, keyfn = node[1], node[2]
+            return lambda env: _index(objfn(env), keyfn(env))
+        return node[1]
+
+    def finish_expr_from_suffixed(self, node) -> Callable:
+        return self.node_value(node)
+
+    def table_constructor(self) -> Callable:
+        items: List[Tuple[Optional[Any], Callable]] = []
+        while not self.accept("}"):
+            if self.peek() == "name" and self.toks[self.i + 1][0] == "=":
+                key = self.expect("name")
+                self.expect("=")
+                items.append((key, self.expr()))
+            elif self.accept("["):
+                key_expr = self.expr()
+                self.expect("]")
+                self.expect("=")
+                items.append((key_expr, self.expr()))
+            else:
+                items.append((None, self.expr()))
+            if not (self.accept(",") or self.accept(";")):
+                self.expect("}")
+                break
+
+        def build(env, items=items):
+            t = LuaTable()
+            n = 0
+            for key, vexpr in items:
+                v = vexpr(env)
+                if key is None:
+                    n += 1
+                    t.set(n, v)
+                elif callable(key):
+                    t.set(key(env), v)
+                else:
+                    t.set(key, v)
+            return t
+        return build
+
+
+def _lua_str(v) -> str:
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if v is None:
+        return "nil"
+    return str(v)
+
+
+def _arith(name, fn):
+    def op(a, b):
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            raise LuaError(f"lua: arithmetic ({name}) on non-number")
+        return fn(a, b)
+    return op
+
+
+_BINFN: Dict[str, Callable] = {
+    "+": _arith("+", lambda a, b: a + b),
+    "-": _arith("-", lambda a, b: a - b),
+    "*": _arith("*", lambda a, b: a * b),
+    "/": _arith("/", lambda a, b: a / b),
+    "%": _arith("%", lambda a, b: a - math.floor(a / b) * b),
+    "<": lambda a, b: a < b, ">": lambda a, b: a > b,
+    "<=": lambda a, b: a <= b, ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b, "~=": lambda a, b: a != b,
+    "..": lambda a, b: _lua_str(a) + _lua_str(b),
+}
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def _make_math() -> LuaTable:
+    return LuaTable({
+        "floor": lambda x: float(math.floor(x)),
+        "ceil": lambda x: float(math.ceil(x)),
+        "abs": abs, "sqrt": math.sqrt,
+        "min": min, "max": max, "huge": math.inf,
+    })
+
+
+class LuaState:
+    """A loaded script: globals table + compiled chunk."""
+
+    def __init__(self, source: str,
+                 host_globals: Optional[Dict[str, Any]] = None):
+        self.globals: Dict[str, Any] = {
+            "math": _make_math(),
+            "print": lambda *a: print("[lua]", *[_lua_str(x) for x in a]),
+        }
+        if host_globals:
+            self.globals.update(host_globals)
+        chunk = _Parser(_lex(source)).parse_chunk()
+        try:
+            # the top-level chunk's locals ARE the globals table
+            chunk(Env(self.globals, self.globals))
+        except _Return:
+            pass                      # chunks may end with `return`
+        except _Break:
+            raise LuaError("lua: break outside a loop")
+
+    def get(self, name: str):
+        return self.globals.get(name)
+
+    def set(self, name: str, value) -> None:
+        self.globals[name] = value
+
+    def call(self, name: str, *args):
+        fn = self.globals.get(name)
+        if fn is None:
+            raise LuaError(f"lua: no function {name!r}")
+        return fn(*args)

@@ -137,6 +137,19 @@ def _case_custom_easy(tmp_path):
     return FilterProperties(framework="custom-easy", model=name)
 
 
+def _case_lua(tmp_path):
+    script = tmp_path / "pass.lua"
+    script.write_text(
+        "inputTensorsInfo = {num=1, dim={{4, 1}}, type={'float32'}}\n"
+        "outputTensorsInfo = {num=1, dim={{4, 1}}, type={'float32'}}\n"
+        "function nnstreamer_invoke()\n"
+        "  input = input_tensor(1)\n"
+        "  output = output_tensor(1)\n"
+        "  for i=1,4 do output[i] = input[i] end\n"
+        "end\n")
+    return FilterProperties(framework="lua", model=str(script))
+
+
 def _case_dummy(tmp_path):
     return FilterProperties(
         framework="dummy",
@@ -152,6 +165,7 @@ CASES = {
     "caffe2": _case_caffe2,
     "mxnet": _case_mxnet,
     "python": _case_python,
+    "lua": _case_lua,
     "custom-easy": _case_custom_easy,
     "custom-dummy": _case_dummy,
 }
@@ -217,7 +231,7 @@ class TestBackendConformance:
         if backend in ("custom-easy", "xla"):
             bad_model = "no-such-registered-model"
         else:
-            bad_model = str(tmp_path / "nope.model")
+            bad_model = str(tmp_path / ("nope.lua" if backend == "lua" else "nope.model"))
         import dataclasses
 
         bad = dataclasses.replace(props, model=bad_model)

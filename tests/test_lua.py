@@ -1,0 +1,161 @@
+"""Lua script filter: the in-tree minilua interpreter running the
+reference's own fixture scripts (passthrough.lua, scaler.lua)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.filter.framework import (FilterError, FilterProperties,
+                                             detect_framework, open_backend)
+from nnstreamer_tpu.utils.minilua import LuaError, LuaState, LuaTable
+
+REF_MODELS = "/root/reference/tests/test_models/models"
+HAVE_REF = os.path.isfile(os.path.join(REF_MODELS, "passthrough.lua"))
+
+
+# ---------------------------------------------------------------------------
+# interpreter semantics
+# ---------------------------------------------------------------------------
+
+class TestMiniLua:
+    def test_tables_arith_and_calls(self):
+        st = LuaState("""
+            t = { num = 2, dim = {{3, 4}, {5}}, s = "hi" }
+            x = t.dim[1][2] + t["num"] * 10   -- 4 + 20
+            y = math.floor(7 / 2) + 2 ^ 3     -- 3 + 8
+            z = "a" .. 1 .. true
+        """)
+        assert st.get("x") == 24
+        assert st.get("y") == 11.0
+        assert st.get("z") == "a1true"
+
+    def test_control_flow(self):
+        st = LuaState("""
+            total = 0
+            for i = 1, 10, 2 do total = total + i end     -- 1+3+5+7+9
+            n = 0
+            while n < 4 do n = n + 1 if n == 3 then break end end
+            if total > 20 then kind = "big" elseif total > 10 then
+                kind = "mid" else kind = "small" end
+            function add(a, b) return a + b end
+            s = add(total, n)
+        """)
+        assert st.get("total") == 25
+        assert st.get("n") == 3
+        assert st.get("kind") == "big"
+        assert st.get("s") == 28
+
+    def test_functions_see_current_globals(self):
+        st = LuaState("function f() return g() end")
+        st.set("g", lambda: 42)
+        assert st.call("f") == 42
+
+    def test_locals_and_length(self):
+        st = LuaState("""
+            local a = {10, 20, 30}
+            n = #a
+            s = #"hello"
+        """)
+        assert st.get("n") == 3
+        assert st.get("s") == 5
+
+    def test_errors_are_loud(self):
+        with pytest.raises(LuaError):
+            LuaState("x = 'a' + 1")
+        with pytest.raises(LuaError):
+            LuaState("f()")  # call of nil
+
+
+# ---------------------------------------------------------------------------
+# the backend on the reference fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not HAVE_REF, reason="reference scripts not present")
+class TestLuaFilter:
+    def test_passthrough_golden(self):
+        fw = open_backend(FilterProperties(
+            framework="lua",
+            model=os.path.join(REF_MODELS, "passthrough.lua")))
+        try:
+            in_info, out_info = fw.get_model_info()
+            assert in_info[0].dims == (3, 640, 480, 1)
+            assert str(in_info[0].dtype) == "uint8"
+            x = (np.arange(3 * 640 * 480) % 251).astype(np.uint8)
+            x = x.reshape(in_info[0].np_shape)
+            out = np.asarray(fw.invoke([x])[0])
+            np.testing.assert_array_equal(out.reshape(-1), x.reshape(-1))
+        finally:
+            fw.close()
+
+    def test_scaler_golden(self):
+        """scaler.lua: 640x480 -> 320x240 nearest-neighbor subsample."""
+        fw = open_backend(FilterProperties(
+            framework="lua",
+            model=os.path.join(REF_MODELS, "scaler.lua")))
+        try:
+            in_info, out_info = fw.get_model_info()
+            assert out_info[0].dims == (3, 320, 240, 1)
+            rng = np.random.default_rng(0)
+            x = rng.integers(0, 255, in_info[0].np_shape).astype(np.uint8)
+            out = np.asarray(fw.invoke([x])[0]).reshape(240, 320, 3)
+            img = x.reshape(480, 640, 3)
+            ref = img[(np.arange(240) * 2)][:, (np.arange(320) * 2)]
+            np.testing.assert_array_equal(out, ref)
+        finally:
+            fw.close()
+
+    def test_autodetect(self):
+        assert detect_framework(
+            os.path.join(REF_MODELS, "passthrough.lua")) == "lua"
+
+    def test_missing_invoke_is_loud(self, tmp_path):
+        p = tmp_path / "bad.lua"
+        p.write_text("inputTensorsInfo = {num=1, dim={{2}}, type={'uint8'}}\n"
+                     "outputTensorsInfo = {num=1, dim={{2}}, type={'uint8'}}")
+        with pytest.raises(FilterError, match="nnstreamer_invoke"):
+            open_backend(FilterProperties(framework="lua", model=str(p)))
+
+
+class TestMiniLuaSemantics:
+    def test_function_global_assignment_persists(self):
+        st = LuaState("count = 0\n"
+                      "function tick() count = count + 1 end")
+        st.call("tick")
+        st.call("tick")
+        assert st.get("count") == 2
+
+    def test_for_var_is_loop_local(self):
+        st = LuaState("i = 100\nfor i = 1, 3 do end\nafter = i")
+        assert st.get("after") == 100
+
+    def test_string_escapes(self):
+        st = LuaState(r's = "a\nb\tc"')
+        assert st.get("s") == "a\nb\tc"
+
+    def test_chunk_level_return_ok(self):
+        st = LuaState("x = 5\nreturn")
+        assert st.get("x") == 5
+
+    def test_locals_stay_local_in_functions(self):
+        st = LuaState("g = 1\n"
+                      "function f() local g = 99 end\n")
+        st.call("f")
+        assert st.get("g") == 1
+
+
+@pytest.mark.skipif(not HAVE_REF, reason="reference scripts not present")
+def test_script_runtime_fault_is_filter_error():
+    import numpy as np  # noqa: F811
+
+    from nnstreamer_tpu.filter.backends.lua import LuaFilter
+
+    fw = open_backend(FilterProperties(
+        framework="lua",
+        model=os.path.join(REF_MODELS, "passthrough.lua")))
+    try:
+        # wrong-size input: the script indexes past the end
+        with pytest.raises(FilterError, match="invoke error"):
+            fw.invoke([np.zeros(10, np.uint8)])
+    finally:
+        fw.close()
