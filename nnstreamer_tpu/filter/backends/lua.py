@@ -88,12 +88,20 @@ class LuaFilter(FilterFramework):
             source = f.read()
         try:
             state = LuaState(source)
-        except LuaError as exc:
+        except FilterError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - scripts can raise raw
+            # python errors too (TypeError from bad operands, ...)
             raise FilterError(f"lua: script error: {exc}") from exc
-        self._in_info = _info_from_table(state.get("inputTensorsInfo"),
-                                         "inputTensorsInfo")
-        self._out_info = _info_from_table(state.get("outputTensorsInfo"),
-                                          "outputTensorsInfo")
+        try:
+            self._in_info = _info_from_table(state.get("inputTensorsInfo"),
+                                             "inputTensorsInfo")
+            self._out_info = _info_from_table(
+                state.get("outputTensorsInfo"), "outputTensorsInfo")
+        except FilterError:
+            raise
+        except Exception as exc:  # noqa: BLE001
+            raise FilterError(f"lua: bad tensors info: {exc}") from exc
         if state.get("nnstreamer_invoke") is None:
             raise FilterError("lua: script defines no nnstreamer_invoke()")
         self._state = state

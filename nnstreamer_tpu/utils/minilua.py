@@ -44,7 +44,8 @@ _KEYWORDS = {"and", "break", "do", "else", "elseif", "end", "false", "for",
 _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+)
   | (?P<comment>--[^\n]*)
-  | (?P<num>\d+\.\d*|\.\d+|\d+)
+  | (?P<num>0[xX][0-9a-fA-F]+
+          |(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
   | (?P<name>[A-Za-z_]\w*)
   | (?P<str>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
   | (?P<op>\.\.|==|~=|<=|>=|[-+*/%^#<>=(){}\[\],;.:])
@@ -64,7 +65,12 @@ def _lex(src: str) -> List[Tuple[str, Any]]:
         if kind in ("ws", "comment"):
             continue
         if kind == "num":
-            toks.append(("num", float(text) if "." in text else int(text)))
+            if text[:2] in ("0x", "0X"):
+                toks.append(("num", int(text, 16)))
+            elif "." in text or "e" in text or "E" in text:
+                toks.append(("num", float(text)))
+            else:
+                toks.append(("num", int(text)))
         elif kind == "name":
             toks.append((text, text) if text in _KEYWORDS
                         else ("name", text))

@@ -159,3 +159,17 @@ def test_script_runtime_fault_is_filter_error():
             fw.invoke([np.zeros(10, np.uint8)])
     finally:
         fw.close()
+
+
+def test_scientific_and_hex_literals():
+    st = LuaState("a = 1e3\nb = 2.5e-1\nc = 0x10")
+    assert st.get("a") == 1000.0
+    assert st.get("b") == 0.25
+    assert st.get("c") == 16
+
+
+def test_open_errors_become_filter_errors(tmp_path):
+    p = tmp_path / "bad.lua"
+    p.write_text("x = -'a'")
+    with pytest.raises(FilterError, match="script error"):
+        open_backend(FilterProperties(framework="lua", model=str(p)))

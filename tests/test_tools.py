@@ -136,3 +136,24 @@ class TestPbtxt:
         nodes2 = pbtxt_pipeline.parse_pbtxt(text)
         mux2 = [n for n in nodes2 if n.element == "tensor_mux"][0]
         assert sorted(mux2.inputs) == sorted(mux.inputs)
+
+
+def test_pbtxt_named_pads_order_fanin():
+    """mux.sink_K refs slot fan-in inputs by index even when the launch
+    string lists them out of order."""
+    nodes = pbtxt_pipeline.parse_launch_text(
+        "tensor_mux name=mux ! fakesink "
+        "appsrc name=b ! mux.sink_1 "
+        "appsrc name=a ! mux.sink_0")
+    mux = next(n for n in nodes if n.name == "mux")
+    assert mux.inputs == ["a", "b"]
+
+
+def test_pbtxt_mixed_chain_and_pad_refs():
+    """An in-chain link and an indexed ref mix correctly: sink_0 wins
+    slot 0 even though the chain link was parsed first."""
+    nodes = pbtxt_pipeline.parse_launch_text(
+        "appsrc name=a ! tensor_mux name=mux ! fakesink "
+        "appsrc name=b ! mux.sink_0")
+    mux = next(n for n in nodes if n.name == "mux")
+    assert mux.inputs == ["b", "a"]

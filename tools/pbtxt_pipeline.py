@@ -56,8 +56,10 @@ def parse_launch_text(description: str) -> List[Node]:
 
     nodes: List[Node] = []
     by_name: Dict[str, Node] = {}
-    into_refs: List[Tuple[Node, str]] = []
+    #: fan-in link records: (src_node, sink_name, pad_idx_or_None, seq)
+    into_refs: List[Tuple[Node, str, Optional[int], int]] = []
     from_refs: List[Tuple[str, Node]] = []
+    link_seq = 0
     gen = 0
     prev = None                # Node | str (forward branch ref) | None
     linked = False
@@ -69,11 +71,18 @@ def parse_launch_text(description: str) -> List[Node]:
             linked = True
             continue
         if kind == "ref":
-            name = op[1]
+            name, pad = op[1], (op[2] if len(op) > 2 else None)
             if linked:
                 if isinstance(prev, str):
                     raise ValueError("cannot link two bare references")
-                into_refs.append((prev, name))
+                # sink-pad names order the fan-in: mux.sink_1 slots the
+                # connection at index 1 (src-pad identity is positional
+                # in the pbtxt node model)
+                idx = None
+                if pad and pad.rsplit("_", 1)[-1].isdigit():
+                    idx = int(pad.rsplit("_", 1)[-1])
+                into_refs.append((prev, name, idx, link_seq))
+                link_seq += 1
                 prev, linked = None, False
             else:
                 prev = name
@@ -95,16 +104,26 @@ def parse_launch_text(description: str) -> List[Node]:
             if isinstance(prev, str):
                 from_refs.append((prev, node))
             else:
-                node.inputs.append(prev.name)
+                # in-chain links join the same ordering pool as pad refs:
+                # 'a ! mux' requests the next pad at THIS point in the line
+                into_refs.append((prev, node.name, None, link_seq))
+                link_seq += 1
         prev, linked = node, False
     for src_name, sink in from_refs:
         if src_name not in by_name:
             raise ValueError(f"unknown reference {src_name!r}")
         sink.inputs.insert(0, src_name)
-    for src, sink_name in into_refs:
+    # resolve fan-ins: explicit sink_K indices order first, then the
+    # un-indexed links in encounter order
+    ordered: Dict[str, List[Tuple[Tuple[int, int], str]]] = {}
+    for src, sink_name, idx, seq in into_refs:
         if sink_name not in by_name:
             raise ValueError(f"unknown reference {sink_name!r}")
-        by_name[sink_name].inputs.append(src.name)
+        key = (0, idx) if idx is not None else (1, seq)
+        ordered.setdefault(sink_name, []).append((key, src.name))
+    for sink_name, entries in ordered.items():
+        for _, src_name in sorted(entries, key=lambda kv: kv[0]):
+            by_name[sink_name].inputs.append(src_name)
     return nodes
 
 
