@@ -22,7 +22,7 @@ import numpy as np
 
 from ...tensor.info import TensorInfo, TensorsInfo
 from ...tensor.types import TensorType
-from ...utils.minilua import LuaError, LuaState, LuaTable
+from ...utils.minilua import LuaState, LuaTable
 from ..framework import (Accelerator, FilterError, FilterFramework,
                          FilterProperties, FilterStatistics, register_filter)
 
