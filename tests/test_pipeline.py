@@ -371,3 +371,68 @@ class TestTracing:
         conv = rep["conv"]
         assert sink["proctime_avg_us"] > 9000       # the sleep lives here
         assert conv["proctime_avg_us"] < 5000, conv  # not charged upstream
+
+
+class TestConcurrencyStress:
+    def test_mux_two_streaming_threads_1000_frames(self):
+        """Two sources on their own threads fan into one mux: every frame
+        pairs up exactly once, in order, under real thread interleaving."""
+        from nnstreamer_tpu import parse_launch
+
+        import numpy as np
+
+        n = 1000
+        p = parse_launch(
+            "tensor_mux name=mux sync-mode=nosync ! tensor_sink name=out "
+            f"videotestsrc num-buffers={n} pattern=gradient ! "
+            "video/x-raw,format=GRAY8,width=4,height=4,framerate=1000/1 ! "
+            "tensor_converter ! queue max-size-buffers=16 ! mux.sink_0 "
+            f"videotestsrc num-buffers={n} pattern=checkers ! "
+            "video/x-raw,format=GRAY8,width=4,height=4,framerate=1000/1 ! "
+            "tensor_converter ! queue max-size-buffers=16 ! mux.sink_1")
+        p.run(timeout=60)
+        out = p.get("out").results
+        assert len(out) == n
+        # pin the PAIRING, not just the count: frame k must combine
+        # gradient frame k (rolls right by k) with checkers frame k
+        # (parity flips by k) — see VideoTestSrc._render
+        row = np.linspace(0, 255, 4, dtype=np.uint8)
+        for k in (0, 1, 7, n // 2, n - 1):
+            buf = out[k]
+            assert buf.num_tensors == 2
+            grad = np.asarray(buf.np(0)).reshape(4, 4)
+            np.testing.assert_array_equal(grad[0], np.roll(row, k))
+            check = np.asarray(buf.np(1)).reshape(4, 4)
+            assert check[0, 0] == ((0 + 0 + k) % 2) * 255
+
+    def test_tee_three_branches_queue_backpressure(self):
+        from nnstreamer_tpu import parse_launch
+
+        n = 500
+        p = parse_launch(
+            f"videotestsrc num-buffers={n} ! "
+            "video/x-raw,format=GRAY8,width=4,height=4,framerate=1000/1 ! "
+            "tensor_converter ! tee name=t "
+            "t. ! queue max-size-buffers=4 ! tensor_sink name=a "
+            "t. ! queue max-size-buffers=4 ! tensor_sink name=b "
+            "t. ! queue max-size-buffers=4 ! tensor_sink name=c")
+        p.run(timeout=60)
+        assert all(len(p.get(k).results) == n for k in ("a", "b", "c"))
+
+    def test_tracer_under_threads(self):
+        """Tracer counts stay exact across queue thread boundaries."""
+        from nnstreamer_tpu import parse_launch
+
+        n = 400
+        p = parse_launch(
+            f"videotestsrc num-buffers={n} ! "
+            "video/x-raw,format=GRAY8,width=4,height=4,framerate=1000/1 ! "
+            "tensor_converter name=conv ! queue ! "
+            "tensor_transform mode=typecast option=float32 name=xf ! "
+            "queue ! tensor_sink name=out")
+        tracer = p.enable_tracing()
+        p.run(timeout=60)
+        rep = tracer.report()
+        assert rep["conv"]["buffers"] == n
+        assert rep["xf"]["buffers"] == n
+        assert rep["out"]["buffers"] == n
