@@ -377,10 +377,6 @@ class TestConcurrencyStress:
     def test_mux_two_streaming_threads_1000_frames(self):
         """Two sources on their own threads fan into one mux: every frame
         pairs up exactly once, in order, under real thread interleaving."""
-        from nnstreamer_tpu import parse_launch
-
-        import numpy as np
-
         n = 1000
         p = parse_launch(
             "tensor_mux name=mux sync-mode=nosync ! tensor_sink name=out "
@@ -406,8 +402,6 @@ class TestConcurrencyStress:
             assert check[0, 0] == ((0 + 0 + k) % 2) * 255
 
     def test_tee_three_branches_queue_backpressure(self):
-        from nnstreamer_tpu import parse_launch
-
         n = 500
         p = parse_launch(
             f"videotestsrc num-buffers={n} ! "
@@ -421,8 +415,6 @@ class TestConcurrencyStress:
 
     def test_tracer_under_threads(self):
         """Tracer counts stay exact across queue thread boundaries."""
-        from nnstreamer_tpu import parse_launch
-
         n = 400
         p = parse_launch(
             f"videotestsrc num-buffers={n} ! "
