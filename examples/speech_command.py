@@ -28,7 +28,7 @@ LABELS = ["silence", "unknown", "yes", "no", "up", "down",
 def main() -> None:
     model = os.path.join(REF, "models", "conv_actions_frozen.pb")
     wav = os.path.join(REF, "data", "yes.wav")
-    if not os.path.isfile(model):
+    if not (os.path.isfile(model) and os.path.isfile(wav)):
         print("reference checkout not present; nothing to run")
         return
     p = parse_launch(
