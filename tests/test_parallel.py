@@ -379,3 +379,55 @@ class TestPipelineParallel:
                          axes=("dp", "sp", "tp", "pp"))
         with pytest.raises(ValueError, match="must divide layers"):
             make_pp_train_step(mesh, StreamFormerConfig(layers=3))
+
+
+class TestLongContextScale:
+    def test_ring_equals_ulysses_at_2k_tokens_sp4(self, jax_cpu_devices):
+        """The two exact sequence-parallel strategies agree at a
+        long-context scale (T=2048 over sp=4, bf16 inputs)."""
+        from nnstreamer_tpu.parallel import ulysses_attention
+
+        mesh = Mesh(np.array(jax_cpu_devices[:4]), ("sp",))
+        t, h, d = 2048, 4, 16
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(rng.standard_normal((t, h, d)),
+                               jnp.bfloat16) for _ in range(3))
+
+        def run(fn):
+            f = jax.shard_map(
+                lambda a, b, c: fn(a, b, c, "sp", causal=True),
+                mesh=mesh, in_specs=(P("sp"),) * 3, out_specs=P("sp"),
+                check_vma=False)
+            return np.asarray(jax.jit(f)(q, k, v), np.float32)
+
+        ring = run(ring_attention)
+        uly = run(lambda a, b, c, ax, causal: ulysses_attention(
+            a, b, c, ax, causal=causal, flash=False))
+        np.testing.assert_allclose(ring, uly, atol=3e-2, rtol=3e-2)
+        # and both match the single-device oracle
+        ref = np.asarray(local_attention(q, k, v, causal=True), np.float32)
+        np.testing.assert_allclose(ring, ref, atol=3e-2, rtol=3e-2)
+
+    def test_pp4_deep_pipeline_trains(self, jax_cpu_devices):
+        """Four pipeline stages, eight layers, four microbatches: the
+        fill-drain schedule stays correct at depth."""
+        from nnstreamer_tpu.parallel.mesh import make_mesh
+        from nnstreamer_tpu.parallel.pipeline_parallel import \
+            make_pp_train_step
+        from nnstreamer_tpu.parallel.train_step import StreamFormerConfig
+
+        mesh = make_mesh(8, axis_sizes={"dp": 1, "sp": 1, "tp": 2, "pp": 4},
+                         axes=("dp", "sp", "tp", "pp"))
+        cfg = StreamFormerConfig(vocab=61, dim=32, heads=4, head_dim=8,
+                                 mlp=64, layers=8, max_seq=32,
+                                 dtype=jnp.float32)
+        step, params, opt, _ = make_pp_train_step(mesh, cfg,
+                                                  microbatches=4)
+        rng = np.random.default_rng(1)
+        toks = rng.integers(0, 61, (8, 16)).astype(np.int32)
+        labs = np.roll(toks, -1, axis=1).astype(np.int32)
+        first = None
+        for _ in range(6):
+            params, opt, loss = step(params, opt, toks, labs)
+            first = first if first is not None else float(loss)
+        assert float(loss) < first
