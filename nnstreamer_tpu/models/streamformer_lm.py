@@ -46,9 +46,17 @@ def _moe_dense(y, lyr, cfg: StreamFormerConfig):
 
 
 def forward_logits(params: Dict[str, Any], tokens: jnp.ndarray,
-                   cfg: StreamFormerConfig) -> jnp.ndarray:
+                   cfg: StreamFormerConfig,
+                   flash: "bool | None" = None) -> jnp.ndarray:
     """Full-sequence forward: tokens (T,) int32 → logits (T, vocab).
-    Same math as the training forward (single device, causal)."""
+    Same math as the training forward (single device, causal).
+
+    ``flash``: run attention as the Pallas streaming-softmax kernel
+    (ops/flash_attention.py) — the long-prompt prefill path never
+    materializes (T, T) scores.  Default: on TPU only (numerics are
+    oracle-tested identical; the CPU interpreter is slow)."""
+    if flash is None:
+        flash = jax.default_backend() == "tpu"
     t = tokens.shape[0]
     pos = jnp.arange(t)
     x = (params["embed"][tokens] + params["pos"][pos]).astype(cfg.dtype)
@@ -56,13 +64,14 @@ def forward_logits(params: Dict[str, Any], tokens: jnp.ndarray,
         y = _ln(x.astype(jnp.float32), lyr["ln1"]).astype(cfg.dtype)
         qkv = jnp.einsum("td,dchn->tchn", y, lyr["wqkv"].astype(cfg.dtype))
         q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-        scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
-        s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
-                       k.astype(jnp.float32)) * scale
-        mask = jnp.arange(t)[None, None, :] > jnp.arange(t)[None, :, None]
-        s = jnp.where(mask, -jnp.inf, s)
-        p = jax.nn.softmax(s, axis=-1)
-        attn = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+        if flash:
+            from ..ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=True)
+        else:
+            from ..parallel.ring_attention import local_attention
+
+            attn = local_attention(q, k, v, causal=True)
         o = jnp.einsum("qhd,hdn->qn", attn.astype(cfg.dtype),
                        lyr["wo"].astype(cfg.dtype))
         x = x + o

@@ -155,3 +155,15 @@ class TestPipelineFilter:
         ref = np.asarray(model.forward(model.params,
                                        jnp.asarray(toks))[0])
         np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_forward_flash_matches_naive():
+    """The Pallas-flash prefill path equals the naive attention path."""
+    cfg = _cfg()
+    params = init_params(cfg, seed=7)
+    toks = jnp.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab, 16), jnp.int32)
+    naive = forward_logits(params, toks, cfg, flash=False)
+    flashed = forward_logits(params, toks, cfg, flash=True)
+    np.testing.assert_allclose(np.asarray(flashed), np.asarray(naive),
+                               atol=1e-3, rtol=1e-3)
