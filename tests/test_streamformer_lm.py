@@ -167,3 +167,23 @@ def test_forward_flash_matches_naive():
     flashed = forward_logits(params, toks, cfg, flash=True)
     np.testing.assert_allclose(np.asarray(flashed), np.asarray(naive),
                                atol=1e-3, rtol=1e-3)
+
+
+def test_decode_step_vmaps_over_streams():
+    """Serving N independent token streams = one vmap over (cache, token)
+    with shared params — each lane advances its own KV cache."""
+    cfg = _cfg()
+    params = init_params(cfg, seed=9)
+    n = 3
+    caches = jax.vmap(lambda _: init_cache(cfg))(jnp.arange(n))
+    toks = jnp.asarray([5, 17, 42], jnp.int32)
+
+    step = jax.vmap(lambda c, t: decode_step(params, c, t, cfg))
+    logits, caches = step(caches, toks)
+    assert logits.shape == (n, cfg.vocab)
+    assert caches["pos"].tolist() == [1, 1, 1]
+
+    # lane i equals a solo decode of the same token
+    solo, _ = decode_step(params, init_cache(cfg), jnp.int32(17), cfg)
+    np.testing.assert_allclose(np.asarray(logits[1]), np.asarray(solo),
+                               atol=1e-5, rtol=1e-5)
