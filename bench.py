@@ -25,6 +25,7 @@ Usage:
   python bench.py --config deeplab     # DeepLabV3 + image_segment
   python bench.py --config posenet     # PoseNet + pose_estimation
   python bench.py --config edge        # distributed edge_sink -> edge_src
+  python bench.py --config lm          # StreamFormer LM prefill + decode
   python bench.py --all                # every config, one JSON line each
   python bench.py --cpu                # escape hatch: bench on host CPU
 Env: NNS_TPU_BENCH_DEADLINE (s/attempt, default 480),
@@ -34,6 +35,7 @@ Env: NNS_TPU_BENCH_DEADLINE (s/attempt, default 480),
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -64,7 +66,57 @@ CONFIG_METRICS = {
     "deeplab": "deeplab_v3_257_image_segment_e2e_fps",
     "posenet": "posenet_257_pose_estimation_e2e_fps",
     "edge": "mobilenet_v2_edge_distributed_e2e_fps",
+    "lm": "streamformer_lm_serving",
 }
+
+
+class _ExtrasTimeout(BaseException):
+    """Raised by SIGALRM inside the optional-extras block.  Derives from
+    BaseException so it pierces the broad ``except Exception`` guards in
+    the extras helpers (_model_cost, _batched_fps) — those may be mid-jit
+    when the alarm fires."""
+
+
+def _extras_alarm(signum, frame):
+    raise _ExtrasTimeout
+
+
+class _extras_deadline:
+    """Sub-deadline for post-measurement extras (cost analysis, batched
+    mode): a green measurement must not be turned into a deadline-killed
+    child by optional enrichment — on timeout the extras are abandoned and
+    the child exits 0 with the core numbers."""
+
+    def __init__(self, seconds: float):
+        self.seconds = max(1, int(seconds))
+        self.timed_out = False
+
+    def __enter__(self):
+        self._old = signal.signal(signal.SIGALRM, _extras_alarm)
+        signal.alarm(self.seconds)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, self._old)
+        self.timed_out = exc_type is _ExtrasTimeout
+        return self.timed_out  # swallow only the sub-deadline
+
+
+EXTRAS_BUDGET = float(os.environ.get("NNS_TPU_BENCH_EXTRAS_BUDGET", "150"))
+_CHILD_T0 = time.monotonic()
+_CHILD_DEADLINE = float(os.environ.get("NNS_TPU_BENCH_DEADLINE", "480"))
+
+
+def _extras_budget() -> float:
+    """Seconds the extras may spend: the configured budget, capped by what
+    is left of the parent's per-attempt deadline (minus margin).  SIGALRM
+    cannot preempt a single in-flight native XLA call, so the alarm alone
+    is not enough — this pre-gate keeps the child from even STARTING an
+    extra it can't finish, and the emit-before-extras line remains the
+    backstop if one native call still overruns."""
+    left = _CHILD_DEADLINE - (time.monotonic() - _CHILD_T0) - 30.0
+    return min(EXTRAS_BUDGET, left)
 
 
 # ---------------------------------------------------------------------------
@@ -126,6 +178,15 @@ def _invoke_p50(fw, size: int) -> float:
     return lats[len(lats) // 2]
 
 
+def _cost_analysis(lowered) -> dict:
+    """Normalize ``lowered.compile().cost_analysis()`` across jax versions
+    (older ones return [dict]); {} if the backend doesn't expose it."""
+    cost = lowered.compile().cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
 def _model_cost(model, device):
     """Per-frame (flops, bytes_accessed) from XLA cost analysis
     ((0, 0) if the backend doesn't expose it)."""
@@ -133,12 +194,8 @@ def _model_cost(model, device):
 
     try:
         zeros = [np.zeros(i.np_shape, i.np_dtype) for i in model.in_info]
-        lowered = jax.jit(model.forward).lower(model.params, *zeros)
-        cost = lowered.compile().cost_analysis()
-        if isinstance(cost, list):  # older jax returns [dict]
-            cost = cost[0] if cost else {}
-        if not cost:
-            return 0.0, 0.0
+        cost = _cost_analysis(jax.jit(model.forward).lower(
+            model.params, *zeros))
         return (float(cost.get("flops", 0.0)),
                 float(cost.get("bytes accessed", 0.0)))
     except Exception:
@@ -221,18 +278,28 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
             emit(out)
         model = fw._model
         device = fw._device
-        flops, bytes_acc = _model_cost(model, device)
         peak = _peak_flops(device)
         bw = _peak_bw(device)
+        flops = bytes_acc = 0.0
         bfps = bfps_big = 0.0
-        try:
-            bfps = _batched_fps(model, device, size)
-            if device.platform != "cpu":
-                # a second point for the batch-tuning curve (TPU only —
-                # batch-256 convs take minutes on host CPU)
-                bfps_big = _batched_fps(model, device, size, batch=256)
-        except Exception:
-            pass
+        budget = _extras_budget()
+        if budget > 10:
+            with _extras_deadline(budget) as dl:
+                flops, bytes_acc = _model_cost(model, device)
+                try:
+                    bfps = _batched_fps(model, device, size)
+                    if device.platform != "cpu" and _extras_budget() > 10:
+                        # a second point for the batch-tuning curve (TPU
+                        # only — batch-256 convs take minutes on host CPU)
+                        bfps_big = _batched_fps(model, device, size,
+                                                batch=256)
+                except Exception:
+                    pass
+            if dl.timed_out:
+                out["note"] = (f"extras abandoned at {dl.seconds}s "
+                               "sub-deadline (core numbers complete)")
+        else:
+            out["note"] = "extras skipped (parent deadline nearly spent)"
     finally:
         p.stop()
     if flops:
@@ -296,6 +363,105 @@ def bench_edge(dtype_prop: str) -> dict:
             "vs_baseline": round(fps / BASELINE_FPS, 3), "frames": n}
 
 
+def bench_lm(emit=None) -> dict:
+    """LM serving (net-new axis, no reference analogue): prefill tokens/sec
+    + MFU on the full-sequence forward (Pallas flash path on TPU), and
+    KV-cache decode tokens/sec through the compiled generate scan at a
+    stated cache size.  Both measurements run twice; headline is the
+    SLOWER decode run (same stability policy as the vision configs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.models.streamformer_lm import (forward_logits,
+                                                       generate)
+    from nnstreamer_tpu.parallel.train_step import (StreamFormerConfig,
+                                                    init_params)
+
+    device = jax.devices()[0]
+    # forward_logits enables the flash kernel on platform == "tpu" only:
+    # key the label and the scale choice on the same predicate (a CUDA
+    # backend must not be labelled pallas_flash)
+    on_tpu = device.platform == "tpu"
+    prefill_t = int(os.environ.get("NNS_TPU_BENCH_LM_PREFILL",
+                                   "2048" if on_tpu else "256"))
+    decode_n = int(os.environ.get("NNS_TPU_BENCH_LM_DECODE",
+                                  "256" if on_tpu else "48"))
+    prompt_len = 64
+    cfg = StreamFormerConfig(vocab=8192, dim=512, heads=8, head_dim=64,
+                             mlp=2048, layers=4, experts=2,
+                             max_seq=max(prefill_t,
+                                         prompt_len + decode_n),
+                             dtype=jnp.bfloat16)
+    params = jax.device_put(init_params(cfg, 0), device)
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(params))
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab, (prefill_t,)), jnp.int32)
+    fwd = jax.jit(lambda p, t: forward_logits(p, t, cfg))
+
+    def _prefill_tok_s() -> float:
+        reps = 3
+        t0 = time.monotonic()
+        for _ in range(reps):
+            out = fwd(params, toks)
+        jax.block_until_ready(out)
+        return prefill_t * reps / (time.monotonic() - t0)
+
+    jax.block_until_ready(fwd(params, toks))      # compile
+    pre1, pre2 = _prefill_tok_s(), _prefill_tok_s()
+
+    prompt = np.asarray(rng.integers(0, cfg.vocab, (prompt_len,)), np.int32)
+    generate(params, cfg, prompt, decode_n)       # compile
+
+    def _decode_tok_s() -> float:
+        # every scan step (prompt prefill + continuation) is one
+        # decode_step through the KV cache, so all of them count
+        t0 = time.monotonic()
+        generate(params, cfg, prompt, decode_n)
+        return (prompt_len + decode_n) / (time.monotonic() - t0)
+
+    dec1, dec2 = _decode_tok_s(), _decode_tok_s()
+    out = {"metric": CONFIG_METRICS["lm"], "value": round(min(dec1, dec2), 2),
+           "unit": "decode_tok_s", "vs_baseline": None,
+           "note": "net-new axis: reference has no LM serving path",
+           "decode_tok_s_run1": round(dec1, 2),
+           "decode_tok_s_run2": round(dec2, 2),
+           "prefill_tok_s": round(min(pre1, pre2), 1),
+           "prefill_tok_s_run1": round(pre1, 1),
+           "prefill_tok_s_run2": round(pre2, 1),
+           "prefill_len": prefill_t, "decode_len": decode_n,
+           "kv_cache_tokens": cfg.max_seq,
+           "params_m": round(n_params / 1e6, 2),
+           "attn_path": "pallas_flash" if on_tpu else "naive"}
+    if emit is not None:
+        # flush before the cost-analysis extra (it re-jits the naive path)
+        emit(out)
+    budget = _extras_budget()
+    if budget <= 10:
+        out["note"] += "; extras skipped (parent deadline nearly spent)"
+        return out
+    with _extras_deadline(budget) as dl:
+        flops = 0.0
+        try:
+            # flop count from the naive-math lowering: the flash kernel
+            # computes the same matmuls (plus O(T) rescales), and XLA's
+            # cost model can't see inside a pallas_call
+            cost = _cost_analysis(jax.jit(lambda p, t: forward_logits(
+                p, t, cfg, flash=False)).lower(params, toks))
+            flops = float(cost.get("flops", 0.0))
+        except Exception:
+            pass
+        peak = _peak_flops(device)
+        if flops:
+            out["gflops_prefill"] = round(flops / 1e9, 2)
+            if peak:
+                out["prefill_mfu"] = round(
+                    min(pre1, pre2) / prefill_t * flops / peak, 6)
+    if dl.timed_out:
+        out["note"] += "; extras abandoned at sub-deadline"
+    return out
+
+
 def _ssd_priors_file(n_anchors: int) -> str:
     """Synthetic box priors (cy cx h w rows x n_anchors) for the
     mobilenet-ssd decode scheme."""
@@ -355,6 +521,8 @@ def run_child(config: str) -> dict:
         result = bench_model(
             CONFIG_METRICS[config], "posenet", 257, "pose_estimation",
             dtype_prop, "option1=257:257 option2=257:257", emit=emit)
+    elif config == "lm":
+        result = bench_lm(emit=emit)
     else:
         result = bench_edge(dtype_prop)
     result["device"] = str(device)
@@ -403,6 +571,8 @@ def orchestrate(config: str, cpu: bool, deadline: float,
         env["JAX_PLATFORMS"] = "cpu"
     if stream_batch:
         env["NNS_TPU_BENCH_BATCH"] = str(stream_batch)
+    # the child gates its optional extras on what's left of this deadline
+    env["NNS_TPU_BENCH_DEADLINE"] = str(deadline)
     cmd = [sys.executable, os.path.abspath(__file__),
            "--_child", "--config", config]
     errors = []
@@ -432,7 +602,9 @@ def orchestrate(config: str, cpu: bool, deadline: float,
             spent = time.monotonic() - t0
             time.sleep(min(30.0, 5.0 * (attempt + 1)) if spent < 60 else 1.0)
     metric = CONFIG_METRICS[config] + ("_cpu" if cpu else "")
-    return {"metric": metric, "value": 0, "unit": "fps", "vs_baseline": 0,
+    # failure lines keep the same unit/baseline schema as success lines
+    unit, base = (("decode_tok_s", None) if config == "lm" else ("fps", 0))
+    return {"metric": metric, "value": 0, "unit": unit, "vs_baseline": base,
             "error": "; ".join(errors)[-1500:], "device": "unavailable"}
 
 

@@ -36,9 +36,13 @@ import jax.numpy as jnp
 _NEG_INF = float("-inf")
 
 
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, max_ref, sum_ref, *,
             n_k_blocks: int, causal: bool, q_offset: int, k_offset: int,
-            scale: float):
+            scale: float, kv_len: int = 0):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -59,6 +63,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, max_ref, sum_ref, *,
     def _accumulate():
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if kv_len:
+            # K/V were zero-padded up to a block multiple: mask the
+            # padded tail (local positions >= the real length)
+            k_local = j * block_k + jax.lax.iota(jnp.int32, block_k)
+            s = jnp.where(k_local[None, :] >= kv_len, _NEG_INF, s)
         if causal:
             q_idx = (q_offset + iq * block_q
                      + jax.lax.iota(jnp.int32, block_q))
@@ -104,13 +113,20 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
 
     t_q, h, d = q.shape
     t_kv = k.shape[0]
-    block_q = min(block_q, t_q)
-    while t_q % block_q:
-        block_q //= 2
-    block_k = min(block_k, t_kv)
-    while t_kv % block_k:
-        block_k //= 2
-    n_k_blocks = t_kv // block_k
+    # tile choice: never shrink below the 8-row sublane granule — a T that
+    # doesn't divide the tile is PADDED up to a block multiple instead
+    # (an odd/prime T used to collapse blocks to 1-row tiles: a severe
+    # MXU perf cliff and a Mosaic shape the tests never exercised)
+    block_q = min(block_q, _round_up(t_q, 8))
+    block_k = min(block_k, _round_up(t_kv, 8))
+    t_q_pad = _round_up(t_q, block_q)
+    t_kv_pad = _round_up(t_kv, block_k)
+    if t_q_pad != t_q:
+        q = jnp.pad(q, ((0, t_q_pad - t_q), (0, 0), (0, 0)))
+    if t_kv_pad != t_kv:
+        k = jnp.pad(k, ((0, t_kv_pad - t_kv), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, t_kv_pad - t_kv), (0, 0), (0, 0)))
+    n_k_blocks = t_kv_pad // block_k
 
     qh = jnp.transpose(q, (1, 0, 2))   # (H, Tq, D)
     kh = jnp.transpose(k, (1, 0, 2))
@@ -119,10 +135,11 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
 
     kern = functools.partial(_kernel, n_k_blocks=n_k_blocks, causal=causal,
                              q_offset=q_offset, k_offset=k_offset,
-                             scale=scale)
+                             scale=scale,
+                             kv_len=t_kv if t_kv_pad != t_kv else 0)
     out = pl.pallas_call(
         kern,
-        grid=(h, t_q // block_q, n_k_blocks),
+        grid=(h, t_q_pad // block_q, n_k_blocks),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda hh, qq, kk: (hh, qq, 0)),
             pl.BlockSpec((1, block_k, d), lambda hh, qq, kk: (hh, kk, 0)),
@@ -130,7 +147,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda hh, qq, kk: (hh, qq, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, t_q, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((h, t_q_pad, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -138,7 +155,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qh, kh, vh)
-    return jnp.transpose(out, (1, 0, 2))
+    return jnp.transpose(out, (1, 0, 2))[:t_q]
 
 
 def _naive_grads(q, k, v, do, causal, q_offset, k_offset):
@@ -207,7 +224,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         off on TPU, on elsewhere.
 
     Differentiable (custom VJP: flash forward, exact recompute backward).
-    Tile sizes shrink automatically to divide the sequence lengths.
+    Sequence lengths that don't divide the tile are zero-padded up to a
+    block multiple (padded K positions masked, padded Q rows sliced off)
+    — tiles never shrink below the 8-row sublane granule.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -157,3 +157,32 @@ def test_pbtxt_mixed_chain_and_pad_refs():
         "appsrc name=b ! mux.sink_0")
     mux = next(n for n in nodes if n.name == "mux")
     assert mux.inputs == ["b", "a"]
+
+
+def test_pbtxt_explicit_index_is_absolute_slot():
+    """sink_1 with no sink_0 ref: the un-indexed chain link fills slot 0
+    and the explicit ref lands at its ABSOLUTE position 1 (the round-3
+    advisor case: it used to be treated as relative order → slot 0)."""
+    nodes = pbtxt_pipeline.parse_launch_text(
+        "appsrc name=a ! tensor_mux name=mux ! fakesink "
+        "appsrc name=b ! mux.sink_1")
+    mux = next(n for n in nodes if n.name == "mux")
+    assert mux.inputs == ["a", "b"]
+
+
+def test_pbtxt_unhonorable_explicit_index_errors():
+    import pytest
+
+    with pytest.raises(ValueError, match="cannot honor"):
+        pbtxt_pipeline.parse_launch_text(
+            "tensor_mux name=mux ! fakesink "
+            "appsrc name=b ! mux.sink_2")
+
+
+def test_pbtxt_duplicate_explicit_index_errors():
+    import pytest
+
+    with pytest.raises(ValueError, match="connected twice"):
+        pbtxt_pipeline.parse_launch_text(
+            "tensor_mux name=mux ! fakesink "
+            "appsrc name=a ! mux.sink_0 appsrc name=b ! mux.sink_0")

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""On-device proof for the Pallas flash-attention kernel.
+
+All in-tree flash tests run the Pallas interpreter on CPU
+(tests/test_flash_attention.py); tile/VMEM-limit bugs only manifest when
+Mosaic compiles the kernel for a real chip.  This script runs the kernel
+NON-interpreted on the TPU, checks it against the naive jnp oracle at bf16
+tolerances, and times kernel vs naive at several sequence lengths.
+
+Prints ONE JSON line:
+  {"metric": "flash_attention_tpu_proof", "value": <speedup@max T>,
+   "unit": "x_vs_naive", "ok": true, "checks": [...], "timings": [...]}
+
+Exit code 0 iff every correctness check passed on a real TPU.
+Refuses to run on CPU (the proof would be meaningless): emits an error
+line and exits 2 so the capture loop records an .err, not a false green.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np  # noqa: E402
+
+# bf16 has ~3 decimal digits; the kernel accumulates in f32 so the error
+# vs an f32 oracle is dominated by the bf16 cast of inputs/outputs.
+BF16_TOL = 2e-2
+CHECK_SHAPES = [
+    # (T, H, D, causal) — 2k/8k per VERDICT; 1023 exercises the
+    # pad-to-block path (odd T must not collapse tiles to 1 row)
+    (2048, 8, 64, True),
+    (2048, 8, 64, False),
+    (1023, 8, 64, True),
+    (8192, 8, 64, True),
+]
+TIME_SHAPES = [(2048, 8, 64), (8192, 8, 64)]
+
+
+def _time(fn, *args, reps=10):
+    import jax
+
+    jax.block_until_ready(fn(*args))  # compile
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / reps * 1000  # ms
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the tunneled-TPU sitecustomize overrides the env var; the config
+        # update is authoritative (same pattern as bench.py / conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        print(json.dumps({"metric": "flash_attention_tpu_proof",
+                          "value": 0, "unit": "x_vs_naive", "ok": False,
+                          "error": "no TPU (refusing interpreter proof)",
+                          "device": str(dev)}), flush=True)
+        return 2
+
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.ops.flash_attention import flash_attention
+    from nnstreamer_tpu.parallel.ring_attention import local_attention
+
+    rng = np.random.default_rng(0)
+    checks = []
+    ok = True
+    for t, h, d, causal in CHECK_SHAPES:
+        q = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
+        flash = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, interpret=False))
+        try:
+            got = np.asarray(flash(q, k, v), np.float32)
+            want = np.asarray(local_attention(
+                q.astype(jnp.float32), k.astype(jnp.float32),
+                v.astype(jnp.float32), causal=causal), np.float32)
+            err = float(np.max(np.abs(got - want)))
+            passed = bool(np.isfinite(err) and err < BF16_TOL)
+        except Exception as exc:  # Mosaic compile/launch failure
+            err, passed = float("nan"), False
+            checks.append({"T": t, "H": h, "D": d, "causal": causal,
+                           "ok": False, "error": repr(exc)[:300]})
+            ok = False
+            continue
+        checks.append({"T": t, "H": h, "D": d, "causal": causal,
+                       "max_abs_err": round(err, 5), "ok": passed})
+        ok = ok and passed
+
+    timings = []
+    speedup = 0.0
+    for t, h, d in TIME_SHAPES:
+        q = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
+        flash = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=False))
+        naive = jax.jit(lambda q, k, v: local_attention(
+            q, k, v, causal=True))
+        try:
+            ms_flash = _time(flash, q, k, v)
+            ms_naive = _time(naive, q, k, v)
+        except Exception as exc:
+            timings.append({"T": t, "error": repr(exc)[:300]})
+            ok = False
+            continue
+        speedup = ms_naive / ms_flash if ms_flash else 0.0
+        timings.append({"T": t, "flash_ms": round(ms_flash, 3),
+                        "naive_ms": round(ms_naive, 3),
+                        "speedup": round(speedup, 3)})
+
+    print(json.dumps({"metric": "flash_attention_tpu_proof",
+                      "value": round(speedup, 3), "unit": "x_vs_naive",
+                      "ok": ok, "checks": checks, "timings": timings,
+                      "device": str(dev)}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,17 +113,46 @@ def parse_launch_text(description: str) -> List[Node]:
         if src_name not in by_name:
             raise ValueError(f"unknown reference {src_name!r}")
         sink.inputs.insert(0, src_name)
-    # resolve fan-ins: explicit sink_K indices order first, then the
-    # un-indexed links in encounter order
-    ordered: Dict[str, List[Tuple[Tuple[int, int], str]]] = {}
+    # resolve fan-ins: an explicit sink_K is an ABSOLUTE slot (input
+    # position K), not a relative ordering hint; un-indexed links fill the
+    # remaining slots in encounter order.  Gaps cannot be represented in
+    # the positional node model, so they are an error rather than a
+    # silent re-pack.
+    ordered: Dict[str, List[Tuple[Optional[int], int, str]]] = {}
     for src, sink_name, idx, seq in into_refs:
         if sink_name not in by_name:
             raise ValueError(f"unknown reference {sink_name!r}")
-        key = (0, idx) if idx is not None else (1, seq)
-        ordered.setdefault(sink_name, []).append((key, src.name))
+        ordered.setdefault(sink_name, []).append((idx, seq, src.name))
     for sink_name, entries in ordered.items():
-        for _, src_name in sorted(entries, key=lambda kv: kv[0]):
-            by_name[sink_name].inputs.append(src_name)
+        sink = by_name[sink_name]
+        slots: Dict[int, str] = {}
+        for idx, _seq, src_name in entries:
+            if idx is not None:
+                if idx in slots:
+                    raise ValueError(
+                        f"{sink_name}.sink_{idx} is connected twice "
+                        f"({slots[idx]!r} and {src_name!r})")
+                slots[idx] = src_name
+        # earlier branch-from inputs (already in sink.inputs) keep their
+        # precedence, then un-indexed links in encounter order — all
+        # filling the lowest slots the explicit indices left free
+        pending = list(sink.inputs) + [
+            src_name for idx, seq, src_name in
+            sorted((e for e in entries if e[0] is None),
+                   key=lambda e: e[1])]
+        sink.inputs = []
+        limit = len(pending) + max(slots, default=-1) + 1
+        free = (i for i in range(limit + 1) if i not in slots)
+        for src_name in pending:
+            slots[next(free)] = src_name
+        n_slots = max(slots) + 1
+        missing = [i for i in range(n_slots) if i not in slots]
+        if missing:
+            raise ValueError(
+                f"{sink_name}: explicit pad indices leave input slots "
+                f"{missing} unconnected — the positional node model "
+                "cannot honor the requested index")
+        sink.inputs = [slots[i] for i in range(n_slots)]
     return nodes
 
 
