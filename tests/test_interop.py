@@ -113,6 +113,55 @@ class TestTFLiteParser:
             fw.close()
 
     @needs_ref
+    def test_add_model_bf16_compute(self):
+        """compute:bfloat16 keeps the external f32 interface (host cast)
+        and matches the f32 path within bf16 tolerance."""
+        props = FilterProperties(
+            framework="tensorflow-lite",
+            model=os.path.join(REF_MODELS, "add.tflite"),
+            custom_properties={"compute": "bfloat16"})
+        fw = open_backend(props)
+        try:
+            assert fw._lower.compute is not None
+            # params live in HBM as bf16
+            import jax.numpy as jnp
+            assert all(a.dtype == jnp.bfloat16
+                       for a in fw._lower.params.values()
+                       if jnp.issubdtype(a.dtype, jnp.floating))
+            ii, _ = fw.get_model_info()
+            x = np.full(ii[0].np_shape, 3.5, np.float32)
+            out = np.asarray(fw.invoke([x])[0])
+            assert out.dtype == np.float32      # external dtype unchanged
+            assert np.allclose(out, 5.5, atol=0.05)
+        finally:
+            fw.close()
+
+    @needs_ref
+    def test_quant_graph_auto_mode_on_cpu(self):
+        """auto compute on CPU: f32 emulation, NO native-int8 selection
+        (native int8 is the TPU default — _compute_mode returns
+        quant_native=True only when the picked device is a TPU)."""
+        props = FilterProperties(
+            framework="tensorflow-lite",
+            model=os.path.join(REF_MODELS,
+                               "mobilenet_v2_1.0_224_quant.tflite"))
+        fw = open_backend(props)
+        try:
+            assert fw._lower.compute is None
+            assert not fw._lower.quant_native
+            assert not fw._lower._nq
+        finally:
+            fw.close()
+
+    def test_unknown_compute_dtype_errors(self):
+        props = FilterProperties(
+            framework="tensorflow-lite", model="x.tflite",
+            custom_properties={"compute": "int4"})
+        from nnstreamer_tpu.filter.backends.tflite import TFLiteFilter
+        with pytest.raises(FilterError, match="unknown compute dtype"):
+            TFLiteFilter()._compute_mode(props, object())
+
+    @needs_ref
     def test_auto_detect_by_extension(self):
         path = os.path.join(REF_MODELS, "add.tflite")
         assert detect_framework(path) == "tensorflow-lite"

@@ -1,0 +1,177 @@
+"""Native-int8 tflite execution vs float emulation on tiny synthetic
+quant graphs (fast CI twin of the full-model check: the real
+mobilenet_v2_1.0_224_quant.tflite agrees top-1 with max 3 quant steps,
+but costs ~90s of XLA CPU int8-conv compile — exercised in the TPU
+bench window instead).
+
+Covers the correction-term algebra of ``_Lowerer._run_native_quant``
+(reference semantics: tensor_filter_tensorflow_lite.cc quantized invoke
+path delegates to the int kernels; here the int math runs on XLA):
+uint8 asymmetric activations, uint8 per-tensor weights (B0 ≠ 0 →
+winsum term), int8 per-channel weights, SAME padding with a non-zero
+input zero-point (pad fill must encode real 0.0), strides, bias,
+fused activations, and the FULLY_CONNECTED path.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.filter.backends.tflite import (_Graph, _Lowerer, _Op,
+                                                   _TSpec)
+from nnstreamer_tpu.utils import flatbuf as fb
+
+
+def _opts(fields):
+    """Build an options fb.Table from {vtable_index: (type, value)}."""
+    b = fb.Builder()
+    b.start_table()
+    for idx, (typ, val) in fields.items():
+        b.add_scalar(idx, typ, val)
+    return fb.root(b.finish(b.end_table()))
+
+
+def _qspec(shape, dtype, buffer, scale, zp, qdim=0):
+    return _TSpec(shape=tuple(shape), np_dtype=dtype, buffer=buffer,
+                  name="", scale=np.asarray(scale, np.float32).ravel(),
+                  zero_point=np.asarray(zp, np.int64).ravel(), qdim=qdim)
+
+
+def _run(g, native, x):
+    lo = _Lowerer(g, quant_native=native)
+    if native:
+        assert lo._nq, "native-int8 selection picked no ops"
+    out = lo.forward(lo.params, x)[0]
+    return np.asarray(out).astype(np.int32)
+
+
+def _agree(g, x, tol=2):
+    emul = _run(g, False, x)
+    nat = _run(g, True, x)
+    diff = np.abs(emul - nat)
+    assert diff.max() <= tol, f"max quant-step diff {diff.max()}"
+
+
+def test_conv_uint8_same_pad_asymmetric():
+    """uint8 conv, SAME padding, zp_x far from 128: the pad fill and both
+    zero-point correction terms (B0·winsum, A0·colsum) must line up."""
+    rng = np.random.default_rng(0)
+    w = rng.integers(0, 256, (5, 3, 3, 4), dtype=np.uint8)
+    bias = rng.integers(-400, 400, (5,), dtype=np.int32)
+    g = _Graph(
+        tensors=[
+            _qspec((1, 6, 6, 4), np.uint8, 0, [0.05], [3]),
+            _qspec((5, 3, 3, 4), np.uint8, 1, [0.02], [131]),
+            _qspec((5,), np.int32, 2, [0.001], [0]),
+            _qspec((1, 6, 6, 5), np.uint8, 0, [0.11], [100]),
+        ],
+        inputs=[0], outputs=[3],
+        ops=[_Op(code=3, custom_code=None, inputs=[0, 1, 2], outputs=[3],
+                 options=_opts({1: ("int32", 1), 2: ("int32", 1)}))],
+        buffers=[b"", w.tobytes(), bias.tobytes()])
+    x = rng.integers(0, 256, (1, 6, 6, 4), dtype=np.uint8)
+    _agree(g, x)
+
+
+def test_conv_int8_per_channel_stride2_relu6():
+    rng = np.random.default_rng(1)
+    w = rng.integers(-128, 128, (6, 3, 3, 4), dtype=np.int8)
+    bias = rng.integers(-300, 300, (6,), dtype=np.int32)
+    g = _Graph(
+        tensors=[
+            _qspec((1, 8, 8, 4), np.int8, 0, [0.04], [-5]),
+            _qspec((6, 3, 3, 4), np.int8, 1,
+                   0.01 + 0.01 * np.arange(6), [0] * 6),
+            # tflite invariant: bias scale == s_x · s_w per channel
+            _qspec((6,), np.int32, 2,
+                   0.04 * (0.01 + 0.01 * np.arange(6)), [0] * 6),
+            _qspec((1, 4, 4, 6), np.int8, 0, [0.03], [-128]),
+        ],
+        inputs=[0], outputs=[3],
+        ops=[_Op(code=3, custom_code=None, inputs=[0, 1, 2], outputs=[3],
+                 options=_opts({1: ("int32", 2), 2: ("int32", 2),
+                                3: ("int32", 3)}))],   # RELU6
+        buffers=[b"", w.tobytes(), bias.tobytes()])
+    x = rng.integers(-128, 128, (1, 8, 8, 4), dtype=np.int8)
+    _agree(g, x)
+
+
+def test_depthwise_uint8_stride2():
+    rng = np.random.default_rng(2)
+    w = rng.integers(0, 256, (1, 3, 3, 4), dtype=np.uint8)
+    bias = rng.integers(-200, 200, (4,), dtype=np.int32)
+    g = _Graph(
+        tensors=[
+            _qspec((1, 7, 7, 4), np.uint8, 0, [0.06], [121]),
+            _qspec((1, 3, 3, 4), np.uint8, 1, [0.015], [140], qdim=3),
+            _qspec((4,), np.int32, 2, [0.0009], [0]),
+            _qspec((1, 4, 4, 4), np.uint8, 0, [0.09], [110]),
+        ],
+        inputs=[0], outputs=[3],
+        ops=[_Op(code=4, custom_code=None, inputs=[0, 1, 2], outputs=[3],
+                 options=_opts({1: ("int32", 2), 2: ("int32", 2)}))],
+        buffers=[b"", w.tobytes(), bias.tobytes()])
+    x = rng.integers(0, 256, (1, 7, 7, 4), dtype=np.uint8)
+    _agree(g, x)
+
+
+def test_fully_connected_uint8():
+    rng = np.random.default_rng(3)
+    w = rng.integers(0, 256, (6, 16), dtype=np.uint8)
+    bias = rng.integers(-500, 500, (6,), dtype=np.int32)
+    g = _Graph(
+        tensors=[
+            _qspec((1, 16), np.uint8, 0, [0.05], [7]),
+            _qspec((6, 16), np.uint8, 1, [0.02], [125]),
+            _qspec((6,), np.int32, 2, [0.001], [0]),
+            _qspec((1, 6), np.uint8, 0, [0.2], [128]),
+        ],
+        inputs=[0], outputs=[3],
+        ops=[_Op(code=9, custom_code=None, inputs=[0, 1, 2], outputs=[3],
+                 options=_opts({}))],
+        buffers=[b"", w.tobytes(), bias.tobytes()])
+    x = rng.integers(0, 256, (1, 16), dtype=np.uint8)
+    _agree(g, x)
+
+
+def test_two_layer_chain_requantizes_between_ops():
+    """conv → depthwise chain: the intermediate activation round-trips
+    through its own quantization spec in both modes."""
+    rng = np.random.default_rng(4)
+    w1 = rng.integers(0, 256, (4, 3, 3, 3), dtype=np.uint8)
+    w2 = rng.integers(0, 256, (1, 3, 3, 4), dtype=np.uint8)
+    g = _Graph(
+        tensors=[
+            _qspec((1, 6, 6, 3), np.uint8, 0, [0.05], [128]),
+            _qspec((4, 3, 3, 3), np.uint8, 1, [0.02], [128]),
+            _qspec((1, 6, 6, 4), np.uint8, 0, [0.1], [128]),
+            _qspec((1, 3, 3, 4), np.uint8, 2, [0.03], [120], qdim=3),
+            _qspec((1, 6, 6, 4), np.uint8, 0, [0.2], [128]),
+        ],
+        inputs=[0], outputs=[4],
+        ops=[
+            _Op(code=3, custom_code=None, inputs=[0, 1, -1], outputs=[2],
+                options=_opts({1: ("int32", 1), 2: ("int32", 1)})),
+            _Op(code=4, custom_code=None, inputs=[2, 3, -1], outputs=[4],
+                options=_opts({1: ("int32", 1), 2: ("int32", 1)})),
+        ],
+        buffers=[b"", w1.tobytes(), w2.tobytes()])
+    x = rng.integers(0, 256, (1, 6, 6, 3), dtype=np.uint8)
+    _agree(g, x, tol=3)       # two requant roundings may compound once
+
+
+def test_float_graph_selects_nothing():
+    w = np.zeros((2, 4), np.float32)
+    g = _Graph(
+        tensors=[
+            _TSpec(shape=(1, 4), np_dtype=np.float32, buffer=0, name=""),
+            _TSpec(shape=(2, 4), np_dtype=np.float32, buffer=1, name=""),
+            _TSpec(shape=(1, 2), np_dtype=np.float32, buffer=0, name=""),
+        ],
+        inputs=[0], outputs=[2],
+        ops=[_Op(code=9, custom_code=None, inputs=[0, 1, -1], outputs=[2],
+                 options=_opts({}))],
+        buffers=[b"", w.tobytes()])
+    lo = _Lowerer(g, quant_native=True)
+    assert not lo._nq
+    out = lo.forward(lo.params, np.ones((1, 4), np.float32))[0]
+    assert np.asarray(out).shape == (1, 2)

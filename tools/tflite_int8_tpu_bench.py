@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""On-device proof for native-int8 tflite execution.
+
+Runs the reference's real mobilenet_v2_1.0_224_quant.tflite on the TPU in
+both modes — f32 emulation (compute:float32) and native int8
+(compute:int8, the TPU default for quant graphs) — and reports agreement
+(quant steps, top-1) plus p50 single-invoke latency and batch-64
+throughput for each.  Prints ONE JSON line; exit 0 iff the modes agree
+within tolerance on a real TPU.
+
+CPU twin: tests/test_tflite_quant_native.py (synthetic graphs — the full
+model costs ~90s of XLA CPU int8-conv compile, so the real-model check
+lives here in the TPU window where it is cheap).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np  # noqa: E402
+
+MODEL = ("/root/reference/tests/test_models/models/"
+         "mobilenet_v2_1.0_224_quant.tflite")
+TOL_STEPS = 4
+BATCH = 64
+
+
+def _bench(fw, x):
+    import jax
+
+    lats = []
+    for _ in range(20):
+        t0 = time.monotonic()
+        out = fw.invoke([x[0]])
+        jax.block_until_ready(out)
+        lats.append((time.monotonic() - t0) * 1000)
+    lats.sort()
+    fw.warmup_batched(BATCH)
+    frames = [[x[0]] for _ in range(BATCH)]
+    t0 = time.monotonic()
+    reps = 5
+    for _ in range(reps):
+        handle = fw.invoke_batched(frames, BATCH)
+        handle.wait()
+    bfps = reps * BATCH / (time.monotonic() - t0)
+    return lats[len(lats) // 2], bfps
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    result = {"metric": "tflite_quant_native_tpu", "unit": "x_vs_emulation",
+              "device": str(dev)}
+    if dev.platform == "cpu":
+        result.update(value=0, ok=False,
+                      error="no TPU (CPU twin is the synthetic test)")
+        print(json.dumps(result), flush=True)
+        return 2
+    if not os.path.isfile(MODEL):
+        result.update(value=0, ok=False, error="reference model missing")
+        print(json.dumps(result), flush=True)
+        return 2
+
+    from nnstreamer_tpu.filter.framework import (FilterProperties,
+                                                 open_backend)
+
+    x = np.random.default_rng(0).integers(
+        0, 256, (1, 224, 224, 3), dtype=np.uint8)
+    outs, perf = {}, {}
+    for mode in ("float32", "int8"):
+        fw = open_backend(FilterProperties(
+            framework="tensorflow-lite", model=MODEL,
+            custom_properties={"compute": mode}))
+        try:
+            outs[mode] = np.asarray(fw.invoke([x[0]])[0], np.int32)
+            perf[mode] = _bench(fw, x)
+        finally:
+            fw.close()
+    diff = np.abs(outs["float32"] - outs["int8"])
+    ok = (int(diff.max()) <= TOL_STEPS
+          and outs["float32"].argmax() == outs["int8"].argmax())
+    speedup = perf["float32"][1] and perf["int8"][1] / perf["float32"][1]
+    result.update(
+        value=round(float(speedup), 3), ok=bool(ok),
+        max_qstep_diff=int(diff.max()),
+        top1_agree=bool(outs["float32"].argmax() == outs["int8"].argmax()),
+        p50_ms_f32=round(perf["float32"][0], 3),
+        p50_ms_int8=round(perf["int8"][0], 3),
+        batched_fps_f32=round(perf["float32"][1], 1),
+        batched_fps_int8=round(perf["int8"][1], 1), batch=BATCH)
+    print(json.dumps(result), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
