@@ -37,6 +37,7 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from ...tensor.info import TensorInfo, TensorsInfo
+from ...utils.conf import parse_bool
 from ...utils.log import logger
 from ..framework import (Accelerator, FilterError, FilterFramework,
                          FilterProperties, FilterStatistics, register_filter)
@@ -58,6 +59,9 @@ class PyTorchFilter(JitExecMixin, FilterFramework):
         self._out_info: Optional[TensorsInfo] = None
         #: "xla" (lowered, on device) or "torch-host" (eager fallback)
         self.executor: str = ""
+        #: WHY the host fallback engaged (the blocking op, e.g.
+        #: "pool2d ceil_mode") — surfaced by launch --stats and tests
+        self.fallback_reason: str = ""
         self.stats = FilterStatistics()
 
     # -- lifecycle -----------------------------------------------------------
@@ -84,18 +88,27 @@ class PyTorchFilter(JitExecMixin, FilterFramework):
 
         want_tpu = Accelerator.TPU in (props.accelerators or [])
         force_host = props.custom_properties.get("executor") == "torch"
+        strict = parse_bool(props.custom_properties.get("strict", ""))
         if force_host and want_tpu:
             raise FilterError(
                 "pytorch: executor:torch contradicts accelerator=true:tpu")
+        if force_host and strict:
+            raise FilterError(
+                "pytorch: executor:torch contradicts strict:true "
+                "(strict forbids the host fallback)")
         self.executor = ""
+        self.fallback_reason = ""
         if not force_host:
             try:
                 self._open_xla(props)
             except Exception as e:
-                if want_tpu:
+                if want_tpu or strict:
+                    demand = ("accelerator=true:tpu" if want_tpu
+                              else "strict:true")
                     raise FilterError(
-                        f"pytorch: accelerator=true:tpu demanded but the "
-                        f"TorchScript graph does not lower to XLA: {e}")
+                        f"pytorch: {demand} demanded but the TorchScript "
+                        f"graph does not lower to XLA: {e}")
+                self.fallback_reason = str(e)
                 logger.warning(
                     "pytorch: %s — falling back to host-CPU TorchScript "
                     "eager execution", e)

@@ -108,6 +108,15 @@ def main(argv=None) -> int:
                           file=sys.stderr)
                 print(f"latency total: {total / 1e6:.3f} ms",
                       file=sys.stderr)
+                for el in p.elements:
+                    fw = getattr(el, "fw", None)
+                    executor = getattr(fw, "executor", "")
+                    if executor:
+                        reason = getattr(fw, "fallback_reason", "")
+                        note = f" (device path blocked by: {reason})" \
+                            if reason else ""
+                        print(f"executor {el.name}: {executor}{note}",
+                              file=sys.stderr)
         finally:
             p.stop()
             if tracer is not None:

@@ -78,7 +78,7 @@ class Conf:
         v = self.get(group, key)
         if v is None:
             return default
-        return str(v).strip().lower() in ("1", "true", "yes", "on")
+        return parse_bool(v)
 
     def framework_priority(self) -> List[str]:
         raw = self.get("filter", "framework_priority") or ""
@@ -89,6 +89,12 @@ class Conf:
         with self._lock:
             self._load_locked()
             return {g: dict(kv) for g, kv in self._values.items()}
+
+
+def parse_bool(value) -> bool:
+    """The ONE truthy-token rule for conf values and custom properties
+    (divergent per-backend parses accepted different token sets)."""
+    return str(value).strip().lower() in ("1", "true", "yes", "on")
 
 
 conf = Conf()

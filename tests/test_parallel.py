@@ -315,6 +315,75 @@ class TestMultihostPlumbing:
         finally:
             mh._initialized = old
 
+    def test_two_process_psum_over_real_distributed_runtime(self):
+        """TWO real processes on localhost join one JAX runtime through
+        multihost.initialize (CPU backend, gloo collectives) and a
+        shard_map psum crosses the process boundary — the JAX-collective
+        twin of the two-process query offload test (reference strategy:
+        tests/nnstreamer_edge/query/runTest.sh:14-50 runs server and
+        client as separate gst-launch processes)."""
+        import os
+        import socket
+        import subprocess
+        import sys as _sys
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        coord = f"127.0.0.1:{port}"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pythonpath = os.pathsep.join(
+            p for p in (repo, os.environ.get("PYTHONPATH")) if p)
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=pythonpath)
+        procs = [subprocess.Popen(
+            [_sys.executable, "-c", MH_WORKER, coord, "2", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env) for i in range(2)]
+        try:
+            for i, p in enumerate(procs):
+                out, err = p.communicate(timeout=240)
+                assert p.returncode == 0, f"worker {i}: {err[-2000:]}"
+                assert f"WORKER_OK {i}" in out, out[-500:]
+        finally:
+            # a worker stuck in initialize() waiting for a dead peer must
+            # not outlive the test
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
+
+
+#: two-process worker: initialize the real distributed runtime, build a
+#: global dp mesh over BOTH processes' devices, psum across the boundary
+MH_WORKER = """
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+from nnstreamer_tpu.parallel import multihost
+multihost.initialize(coordinator=coord, num_processes=nproc,
+                     process_id=pid)
+assert multihost.is_initialized()
+info = multihost.process_info()
+assert info["process_count"] == nproc, info
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+devs = jax.devices()
+n_local = len(jax.local_devices())
+assert len(devs) == nproc * n_local, (devs, n_local)
+mesh = Mesh(np.array(devs), ("dp",))
+local = np.full((n_local, 4), float(pid + 1), np.float32)
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), local, (len(devs), 4))
+fn = jax.shard_map(lambda x: jax.lax.psum(x, "dp"),
+                   mesh=mesh, in_specs=P("dp"), out_specs=P())
+val = np.asarray(jax.jit(fn)(arr).addressable_data(0))
+expect = n_local * nproc * (nproc + 1) / 2   # sum of every shard's fill
+assert np.allclose(val, expect), (val, expect)
+print("WORKER_OK", pid)
+"""
+
 
 class TestPipelineParallel:
     """GPipe stage sharding over the pp axis (pipeline_parallel.py)."""
