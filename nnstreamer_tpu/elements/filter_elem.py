@@ -263,6 +263,11 @@ class TensorFilter(Element):
                 return False
             if not self.fw.set_postprocess(fn):
                 return False
+            if self._batch > 1:
+                # the fusion rebuilt both executables: re-warm at
+                # negotiation time so neither a mid-stream batch nor the
+                # EOS flush tail pays the compile
+                self.fw.warmup_batched(self._batch)
             self._out_config = TensorsConfig(info=out_info,
                                              rate=self._in_config.rate)
             from ..tensor.caps_util import caps_from_config

@@ -36,6 +36,17 @@ class BatchHandle:
         return [[m[i] for m in mats] for i in range(self._n)]
 
 
+class _FlushHandle:
+    """Tiny-tail twin of :class:`BatchHandle`: per-frame device outputs
+    (the unbatched executable), same wait() contract."""
+
+    def __init__(self, per_frame_outs) -> None:
+        self._outs = per_frame_outs
+
+    def wait(self) -> List[List[np.ndarray]]:
+        return [[np.asarray(o) for o in frame] for frame in self._outs]
+
+
 class CastingHandle:
     """Wraps a :class:`BatchHandle`, applying per-output host dtype casts
     at wait() (declared-int64 outputs come back int32 when jax x64 is
@@ -114,10 +125,20 @@ class JitExecMixin:
         ``bucket`` frames: the per-dispatch RTT is paid once per batch
         instead of once per frame.  Short batches are padded by repeating
         the last frame (sliced away in wait()), so exactly one executable
-        shape ever compiles."""
+        shape ever compiles — EXCEPT tiny flush tails (EOS /
+        renegotiation drains, ≤ bucket/8 frames), which dispatch
+        per-frame through the already-compiled unbatched executable:
+        a 1-frame flush at bucket=64 would otherwise burn 64× the FLOPs."""
         import jax
 
         n = len(frames)
+        if 8 * n <= bucket:
+            t0 = time.monotonic_ns()
+            outs = [self._invoke_device(list(f)) for f in frames]
+            for o in outs:
+                start_output_transfers(o)
+            self.stats.record(time.monotonic_ns() - t0)
+            return _FlushHandle(outs)
         stacked = []
         for k in range(len(frames[0])):
             arrs = [np.asarray(f[k]) for f in frames]
@@ -142,14 +163,19 @@ class JitExecMixin:
         return outs
 
     def warmup_batched(self, bucket: int) -> None:
-        """Pre-compile the batched executable — outside the statistics
-        (compile time would dominate the last-10 latency average)."""
+        """Pre-compile BOTH batching executables — the bucket-wide vmap
+        and the unbatched one the tiny-tail flush rides — outside the
+        statistics (compile time would dominate the last-10 latency
+        average) and outside the EOS drain (a compile stall there can
+        blow pipeline wait timeouts)."""
         import jax
 
         in_info, _ = self.get_model_info()
         zeros = [np.zeros((bucket,) + i.np_shape, i.np_dtype)
                  for i in in_info]
         jax.block_until_ready(self._dispatch_batched(zeros))
+        ones = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
+        jax.block_until_ready(self._invoke_device(ones))
 
     def set_postprocess(self, fn) -> bool:
         """Compose a decoder-pushed reduction into the jitted forward: one

@@ -111,7 +111,9 @@ def build_mobilenet_v2(custom_props: Dict[str, str]) -> Model:
     variables = host_init(lambda: module.init(
         jax.random.PRNGKey(seed), jnp.zeros((1, size, size, 3), dtype)))
 
-    use_pallas = custom_props.get("use_pallas", "0") in ("1", "true")
+    from ..utils.conf import parse_bool
+
+    use_pallas = parse_bool(custom_props.get("use_pallas", "0"))
 
     def forward(variables, frame):
         """frame: uint8 (H, W, 3) — preprocessing fused into the graph

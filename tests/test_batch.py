@@ -76,6 +76,9 @@ class TestBatchedInvoke:
         (10, 4),   # EOS flush pads the 2-frame remainder
         (3, 4),    # stream shorter than one batch
         (7, 16),   # batch larger than whole stream
+        (33, 32),  # 1-frame EOS tail at a big bucket: the per-frame
+                   # flush path (≤ bucket/8), not a 32-wide padded batch
+        (2, 64),   # whole stream goes through the flush path
     ])
     def test_matches_unbatched_and_preserves_order(self, tiny_model, n,
                                                    batch):
