@@ -803,13 +803,17 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
     def warmup_batched(self, bucket: int) -> None:
         if self._host_pre is None:
             return super().warmup_batched(bucket)
-        # batched warmup with DECODED shapes, not the byte-blob info
+        # batched warmup with DECODED shapes, not the byte-blob info;
+        # warm the unbatched executable too (the tiny-tail flush path
+        # rides it — see JitExecMixin.warmup_batched)
         import jax
 
         n, c = self._wav_shape
         zeros = [np.zeros((bucket, n, c), np.float32),
                  np.zeros((bucket,), np.int32)]
         jax.block_until_ready(self._dispatch_batched(zeros))
+        jax.block_until_ready(self._invoke_device(
+            [np.zeros((n, c), np.float32), np.zeros((), np.int32)]))
 
     # -- model meta ----------------------------------------------------------
     def get_model_info(self) -> Tuple[TensorsInfo, TensorsInfo]:

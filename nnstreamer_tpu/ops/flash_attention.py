@@ -13,11 +13,13 @@ a hand-written Pallas TPU kernel (per /opt/skills/guides/pallas_guide.md):
 - **MXU-shaped**: both matmuls (Q·Kᵀ and P·V) run as ``dot_general`` with
   f32 accumulation on bf16/f32 inputs; tiles default to 128 to match the
   MXU systolic array;
-- **differentiable**: a ``jax.custom_vjp`` pairs the flash forward with an
-  exact recompute backward (standard attention gradients in jnp) so
-  training steps (train_step.py's ``value_and_grad``) work — backward
-  materializes one (T_q, T_kv) score matrix, the usual
-  recompute-checkpoint trade.
+- **differentiable, flash both ways**: a ``jax.custom_vjp`` pairs the
+  flash forward with STREAMING Pallas backward kernels
+  (FlashAttention-2 structure): the forward saves only O and the
+  per-row logsumexp; dq and dk/dv kernels recompute one (bq, bk)
+  probability tile at a time in VMEM — no (T_q, T_kv) matrix ever
+  lands in HBM in either direction, so trainable sequence length is
+  bounded by O(T·d), not O(T²).
 
 ``interpret=True`` runs the same kernel on CPU (tests validate it against
 the naive oracle); on non-TPU platforms callers should prefer the jnp
@@ -40,9 +42,9 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, max_ref, sum_ref, *,
-            n_k_blocks: int, causal: bool, q_offset: int, k_offset: int,
-            scale: float, kv_len: int = 0):
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, max_ref,
+            sum_ref, *, n_k_blocks: int, causal: bool, q_offset: int,
+            k_offset: int, scale: float, kv_len: int = 0):
     from jax.experimental import pallas as pl
 
     iq = pl.program_id(1)
@@ -102,12 +104,19 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, max_ref, sum_ref, *,
 
     @pl.when(j == n_k_blocks - 1)
     def _finalize():
-        denom = jnp.maximum(sum_ref[:, 0], 1e-20)
+        row_sum = sum_ref[:, 0]
+        denom = jnp.maximum(row_sum, 1e-20)
         o_ref[0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
+        # logsumexp per row — the only forward residual the streaming
+        # backward needs (fully-masked rows: -inf)
+        lse_ref[0] = jnp.where(
+            row_sum > 0, max_ref[:, 0] + jnp.log(denom),
+            _NEG_INF)[:, None]
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   q_offset: int, k_offset: int, interpret: bool):
+                   q_offset: int, k_offset: int, interpret: bool,
+                   return_lse: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -137,7 +146,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
                              q_offset=q_offset, k_offset=k_offset,
                              scale=scale,
                              kv_len=t_kv if t_kv_pad != t_kv else 0)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kern,
         grid=(h, t_q_pad // block_q, n_k_blocks),
         in_specs=[
@@ -145,9 +154,14 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, block_k, d), lambda hh, qq, kk: (hh, kk, 0)),
             pl.BlockSpec((1, block_k, d), lambda hh, qq, kk: (hh, kk, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda hh, qq, kk: (hh, qq, 0)),
-        out_shape=jax.ShapeDtypeStruct((h, t_q_pad, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda hh, qq, kk: (hh, qq, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda hh, qq, kk: (hh, qq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, t_q_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((h, t_q_pad, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -155,33 +169,204 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qh, kh, vh)
-    return jnp.transpose(out, (1, 0, 2))[:t_q]
+    out = jnp.transpose(out, (1, 0, 2))[:t_q]
+    if return_lse:
+        return out, lse[:, :t_q, 0]            # (H, Tq)
+    return out
 
 
-def _naive_grads(q, k, v, do, causal, q_offset, k_offset):
-    """Exact attention gradients by recompute (one (Tq,Tkv) score matrix
-    per head — the standard flash-backward checkpoint trade)."""
+def _recompute_p(q, k, lse, j, iq, block_q, block_k, causal, q_offset,
+                 k_offset, scale, kv_len):
+    """Shared backward recompute of one (bq, bk) probability tile from
+    the saved logsumexp: p = exp(s − lse).  Masked positions and
+    fully-masked rows (lse = −inf) come out exactly 0."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    block_q_, block_k_ = s.shape
+    if kv_len:
+        k_local = j * block_k + jax.lax.iota(jnp.int32, block_k_)
+        s = jnp.where(k_local[None, :] >= kv_len, _NEG_INF, s)
+    if causal:
+        q_idx = q_offset + iq * block_q + jax.lax.iota(jnp.int32, block_q_)
+        k_idx = k_offset + j * block_k + jax.lax.iota(jnp.int32, block_k_)
+        s = jnp.where(k_idx[None, :] > q_idx[:, None], _NEG_INF, s)
+    p = jnp.exp(s - lse[:, None])
+    return s, jnp.where(jnp.isfinite(lse)[:, None] & jnp.isfinite(s),
+                        p, 0.0)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, n_k_blocks: int, causal: bool,
+                   q_offset: int, k_offset: int, scale: float,
+                   kv_len: int = 0):
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, p = _recompute_p(q, k, lse_ref[0][:, 0], j, iq, block_q,
+                            block_k, causal, q_offset, k_offset, scale,
+                            kv_len)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        live = (k_offset + j * block_k
+                <= q_offset + (iq + 1) * block_q - 1)
+        pl.when(live)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(j == n_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, n_q_blocks: int,
+                    causal: bool, q_offset: int, k_offset: int,
+                    scale: float, kv_len: int = 0):
+    from jax.experimental import pallas as pl
+
+    jk = pl.program_id(1)          # K block (outer)
+    iq = pl.program_id(2)          # Q block (inner, sequential)
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        _, p = _recompute_p(q, k, lse_ref[0][:, 0], jk, iq, block_q,
+                            block_k, causal, q_offset, k_offset, scale,
+                            kv_len)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0]) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # a Q block entirely in THIS k-block's past is all-masked
+        live = (q_offset + (iq + 1) * block_q - 1
+                >= k_offset + jk * block_k)
+        pl.when(live)(_accumulate)
+    else:
+        _accumulate()
+
+    @pl.when(iq == n_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, do, lse, delta, causal, block_q, block_k,
+                    q_offset, k_offset, interpret):
+    """Streaming flash backward: dq, dk, dv without ever materializing a
+    (Tq, Tkv) matrix in HBM — VMEM holds one (bq, bk) tile recomputed
+    from the saved logsumexp (FlashAttention-2 backward structure)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     t_q, h, d = q.shape
     t_kv = k.shape[0]
+    block_q = min(block_q, _round_up(t_q, 8))
+    block_k = min(block_k, _round_up(t_kv, 8))
+    t_q_pad = _round_up(t_q, block_q)
+    t_kv_pad = _round_up(t_kv, block_k)
+    if t_q_pad != t_q:
+        pad = ((0, t_q_pad - t_q), (0, 0), (0, 0))
+        q = jnp.pad(q, pad)
+        do = jnp.pad(do, pad)
+        # padded Q rows: lse = -inf makes their p tiles exactly 0, so
+        # they contribute nothing to dk/dv
+        lse = jnp.pad(lse, ((0, 0), (0, t_q_pad - t_q)),
+                      constant_values=_NEG_INF)
+        delta = jnp.pad(delta, ((0, 0), (0, t_q_pad - t_q)))
+    if t_kv_pad != t_kv:
+        pad = ((0, t_kv_pad - t_kv), (0, 0), (0, 0))
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    n_q_blocks = t_q_pad // block_q
+    n_k_blocks = t_kv_pad // block_k
     scale = 1.0 / float(d) ** 0.5
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum("qhd,khd->hqk", qf, kf) * scale
-    if causal:
-        q_idx = q_offset + jnp.arange(t_q)
-        k_idx = k_offset + jnp.arange(t_kv)
-        s = jnp.where(k_idx[None, None, :] > q_idx[None, :, None],
-                      _NEG_INF, s)
-    p = jax.nn.softmax(s, axis=-1)
-    p = jnp.where(jnp.isnan(p), 0.0, p)        # fully-masked rows
-    dv = jnp.einsum("hqk,qhd->khd", p, dof)
-    dp = jnp.einsum("qhd,khd->hqk", dof, vf)
-    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
-    dq = jnp.einsum("hqk,khd->qhd", ds, kf) * scale
-    dk = jnp.einsum("hqk,qhd->khd", ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    kv_len = t_kv if t_kv_pad != t_kv else 0
+
+    qh = jnp.transpose(q, (1, 0, 2))
+    kh = jnp.transpose(k, (1, 0, 2))
+    vh = jnp.transpose(v, (1, 0, 2))
+    doh = jnp.transpose(do, (1, 0, 2))
+    lseh = lse[..., None]                      # (H, Tq, 1)
+    deltah = delta[..., None]
+
+    common = dict(causal=causal, q_offset=q_offset, k_offset=k_offset,
+                  scale=scale, kv_len=kv_len)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda hh, a, b: (hh, a, 0))
+    q1_spec = pl.BlockSpec((1, block_q, 1), lambda hh, a, b: (hh, a, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda hh, a, b: (hh, b, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, n_k_blocks=n_k_blocks, **common),
+        grid=(h, n_q_blocks, n_k_blocks),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, q1_spec, q1_spec],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda hh, a, b: (hh, a, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, t_q_pad, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lseh, deltah)
+
+    # dkv grid: K blocks outer, Q blocks inner (sequential accumulation)
+    qi_spec = pl.BlockSpec((1, block_q, d), lambda hh, a, b: (hh, b, 0))
+    qi1_spec = pl.BlockSpec((1, block_q, 1), lambda hh, a, b: (hh, b, 0))
+    ki_spec = pl.BlockSpec((1, block_k, d), lambda hh, a, b: (hh, a, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, n_q_blocks=n_q_blocks,
+                          **common),
+        grid=(h, n_k_blocks, n_q_blocks),
+        in_specs=[qi_spec, ki_spec, ki_spec, qi_spec, qi1_spec, qi1_spec],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda hh, a, b: (hh, a, 0)),
+            pl.BlockSpec((1, block_k, d), lambda hh, a, b: (hh, a, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, t_kv_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((h, t_kv_pad, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lseh, deltah)
+
+    dq = jnp.transpose(dq, (1, 0, 2))[:t_q].astype(q.dtype)
+    dk = jnp.transpose(dk, (1, 0, 2))[:t_kv].astype(k.dtype)
+    dv = jnp.transpose(dv, (1, 0, 2))[:t_kv].astype(v.dtype)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
@@ -193,15 +378,19 @@ def _flash(q, k, v, causal, block_q, block_k, q_offset, k_offset,
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, q_offset, k_offset,
                interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_k, q_offset,
-                         k_offset, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, q_offset,
+                              k_offset, interpret, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
                res, do):
-    q, k, v = res
-    return _naive_grads(q, k, v, do, causal, q_offset, k_offset)
+    q, k, v, out, lse = res
+    # D_i = dO_i · O_i, the softmax-backward row correction
+    delta = jnp.transpose(jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1))
+    return _flash_backward(q, k, v, do, lse, delta, causal, block_q,
+                           block_k, q_offset, k_offset, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -8,11 +8,18 @@ its own interpreter for the Lua subset those filters use — written from
 the Lua 5.x reference manual, not from any Lua implementation:
 
 statements   assignment (incl. table fields), local, function defs,
-             numeric for, while, if/elseif/else, return, break, calls
+             numeric for, while, repeat/until, if/elseif/else, return,
+             break, calls
 expressions  precedence-climbing: or/and, comparisons, .., + -, * / %,
              unary - not #, ^, calls, table constructors, field/index
 values       numbers (int/float), strings, booleans, nil, 1-based tables
-stdlib       math.floor/ceil/abs/min/max/sqrt/huge, #, print
+stdlib       math.floor/ceil/abs/min/max/sqrt/huge · string.format/sub/
+             len/upper/lower/rep/reverse/byte/char/find/gsub (find and
+             gsub take PLAIN needles — Lua pattern magic raises loudly)
+             · table.insert/remove/concat · tostring · tonumber · # ·
+             print.  Not implemented: metatables, closures-as-upvalue
+             mutation, coroutines, goto, string pattern matching —
+             scripts touching those fail with a named LuaError.
 
 Execution compiles the AST to Python closures once (scripts run a
 nested-loop body per frame — ~1M interpreted ops for the reference's
@@ -38,8 +45,8 @@ class LuaError(RuntimeError):
 # ---------------------------------------------------------------------------
 
 _KEYWORDS = {"and", "break", "do", "else", "elseif", "end", "false", "for",
-             "function", "if", "local", "nil", "not", "or", "return",
-             "then", "true", "while"}
+             "function", "if", "local", "nil", "not", "or", "repeat",
+             "return", "then", "true", "until", "while"}
 
 _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+)
@@ -281,6 +288,23 @@ class _Parser:
                     except _Break:
                         break
             return while_stmt
+        if k == "repeat":
+            self.next()
+            body = self.block(("until",))
+            self.expect("until")
+            cond = self.expr()
+
+            def repeat_stmt(env, body=body, cond=cond):
+                # body locals stay visible to the until-condition (same
+                # env object runs both, per the Lua scoping rule)
+                while True:
+                    try:
+                        body(env)
+                    except _Break:
+                        break
+                    if _truthy(cond(env)):
+                        break
+            return repeat_stmt
         if k == "if":
             return self.if_stmt()
         if k == "return":
@@ -608,6 +632,22 @@ def _lua_str(v) -> str:
     return str(v)
 
 
+def _lua_tonumber(v, base=None):
+    if base is not None:
+        try:
+            return float(int(str(v).strip(), int(base)))
+        except ValueError:
+            return None
+    if isinstance(v, (int, float)):
+        return v
+    try:
+        s = str(v).strip()
+        return int(s, 16) if s[:2].lower() == "0x" else (
+            int(s) if s.lstrip("+-").isdigit() else float(s))
+    except (ValueError, IndexError):
+        return None
+
+
 def _arith(name, fn):
     def op(a, b):
         if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
@@ -642,6 +682,147 @@ def _make_math() -> LuaTable:
     })
 
 
+_FMT_RE = re.compile(r"%[-+ #0]*\d*(?:\.\d+)?[diouxXeEfgGqsc%]")
+_LUA_MAGIC = re.compile(r"[\^\$\*\+\?\.\(\)\[\]%\-]")
+
+
+def _lua_format(fmt: str, *args) -> str:
+    """string.format per the Lua manual's C-printf subset (%q quotes).
+    Every '%' must start a valid directive — an invalid one raises
+    wherever it sits (Lua: "invalid conversion")."""
+    out: List[str] = []
+    pos = 0
+    ai = 0
+    while True:
+        i = fmt.find("%", pos)
+        if i < 0:
+            out.append(fmt[pos:])
+            break
+        out.append(fmt[pos:i])
+        m = _FMT_RE.match(fmt, i)
+        if m is None:
+            raise LuaError("lua: string.format: invalid conversion "
+                           f"{fmt[i:i + 2]!r}")
+        pos = m.end()
+        spec = m.group()
+        conv = spec[-1]
+        if conv == "%":
+            out.append("%")
+            continue
+        if ai >= len(args):
+            raise LuaError(f"lua: string.format: no argument #{ai + 1} "
+                           f"for {spec!r}")
+        a = args[ai]
+        ai += 1
+        if conv == "q":
+            s = _lua_str(a).replace("\\", "\\\\").replace('"', '\\"')
+            out.append('"' + s.replace("\n", "\\n") + '"')
+        elif conv in "diouxX":
+            out.append(spec % int(a))
+        elif conv in "eEfgG":
+            out.append(spec % float(a))
+        elif conv == "c":
+            out.append(chr(int(a)))
+        else:                                   # s
+            out.append(spec % _lua_str(a))
+    return "".join(out)
+
+
+def _str_range(s: str, i, j=None):
+    """Lua 1-based, negative-from-end [i, j] → Python slice bounds."""
+    n = len(s)
+    i = int(i)
+    j = n if j is None else int(j)
+    if i < 0:
+        i = max(n + i + 1, 1)
+    elif i == 0:
+        i = 1
+    if j < 0:
+        j = n + j + 1
+    elif j > n:
+        j = n
+    return i - 1, j
+
+
+def _plain_only(pat: str, what: str) -> None:
+    if _LUA_MAGIC.search(pat):
+        raise LuaError(
+            f"lua: {what}: Lua patterns are not supported by this "
+            f"interpreter — only plain-text needles ({pat!r} contains "
+            "pattern magic)")
+
+
+def _make_string() -> LuaTable:
+    def sub(s, i, j=None):
+        a, b = _str_range(s, i, j)
+        return s[a:b] if a < b else ""
+
+    def find(s, pat, init=1, plain=None):
+        if not _truthy(plain):
+            _plain_only(pat, "string.find")
+        a, _ = _str_range(s, init)
+        idx = s.find(pat, a)
+        if idx < 0:
+            return None
+        return idx + 1                      # (start; end omitted = start
+        # + #pat - 1 is derivable — single-return keeps the evaluator's
+        # one-value expression model)
+
+    def gsub(s, pat, repl, n=None):
+        _plain_only(pat, "string.gsub")
+        if not isinstance(repl, str):
+            raise LuaError(
+                "lua: string.gsub: only string replacements are "
+                "supported (function/table replacements are not)")
+        limit = -1 if n is None else int(n)
+        return s.replace(pat, repl, limit if limit >= 0 else -1)
+
+    def byte(s, i=1):
+        a, _ = _str_range(s, i)
+        return float(ord(s[a])) if a < len(s) else None
+
+    return LuaTable({
+        "format": _lua_format,
+        "sub": sub, "len": lambda s: len(s),
+        "upper": lambda s: s.upper(), "lower": lambda s: s.lower(),
+        "rep": lambda s, n, sep=None: (
+            (_lua_str(sep or "")).join([s] * int(n)) if int(n) > 0 else ""),
+        "reverse": lambda s: s[::-1],
+        "byte": byte,
+        "char": lambda *cs: "".join(chr(int(c)) for c in cs),
+        "find": find, "gsub": gsub,
+    })
+
+
+def _make_table() -> LuaTable:
+    def insert(t: LuaTable, a, b=None):
+        if b is None:
+            t.set(t.length() + 1, a)
+            return
+        pos = int(a)
+        for k in range(t.length(), pos - 1, -1):
+            t.set(k + 1, t.get(k))
+        t.set(pos, b)
+
+    def remove(t: LuaTable, pos=None):
+        n = t.length()
+        if n == 0:
+            return None
+        p = n if pos is None else int(pos)
+        v = t.get(p)
+        for k in range(p, n):
+            t.set(k, t.get(k + 1))
+        t.data.pop(n, None)
+        return v
+
+    def concat(t: LuaTable, sep=""):
+        return _lua_str(sep).join(
+            _lua_str(t.get(k)) for k in range(1, t.length() + 1))
+
+    return LuaTable({"insert": insert, "remove": remove,
+                     "concat": concat})
+
+
 class LuaState:
     """A loaded script: globals table + compiled chunk."""
 
@@ -649,6 +830,10 @@ class LuaState:
                  host_globals: Optional[Dict[str, Any]] = None):
         self.globals: Dict[str, Any] = {
             "math": _make_math(),
+            "string": _make_string(),
+            "table": _make_table(),
+            "tostring": _lua_str,
+            "tonumber": _lua_tonumber,
             "print": lambda *a: print("[lua]", *[_lua_str(x) for x in a]),
         }
         if host_globals:

@@ -173,3 +173,109 @@ def test_open_errors_become_filter_errors(tmp_path):
     p.write_text("x = -'a'")
     with pytest.raises(FilterError, match="script error"):
         open_backend(FilterProperties(framework="lua", model=str(p)))
+
+
+class TestStdlibExtensions:
+    """string/table libraries + repeat/until (round-3 weakness: a user
+    script using string.format died; Lua-manual semantics, plain-text
+    find/gsub only — pattern magic raises loudly)."""
+
+    def test_string_format(self):
+        st = LuaState(
+            's = string.format("%s=%d (%.2f) %x %q %%", "w", 7.0, '
+            '1.5, 255, "a\\"b")')
+        assert st.get("s") == 'w=7 (1.50) ff "a\\"b" %'
+
+    def test_string_sub_negative_and_len(self):
+        st = LuaState(
+            'a = string.sub("hello", 2, 4)\n'
+            'b = string.sub("hello", -3)\n'
+            'c = string.len("hello")\n'
+            'd = string.sub("hello", 4, 2)')
+        assert st.get("a") == "ell"
+        assert st.get("b") == "llo"
+        assert st.get("c") == 5
+        assert st.get("d") == ""
+
+    def test_string_case_rep_reverse_byte_char(self):
+        st = LuaState(
+            'u = string.upper("ab") .. string.lower("CD")\n'
+            'r = string.rep("ab", 3)\n'
+            'v = string.reverse("abc")\n'
+            'y = string.byte("A")\n'
+            'z = string.char(65, 66)')
+        assert st.get("u") == "ABcd"
+        assert st.get("r") == "ababab"
+        assert st.get("v") == "cba"
+        assert st.get("y") == 65.0
+        assert st.get("z") == "AB"
+
+    def test_string_find_gsub_plain(self):
+        st = LuaState(
+            'i = string.find("banana", "nan", 1, true)\n'
+            'g = string.gsub("banana", "na", "NA")')
+        assert st.get("i") == 3
+        assert st.get("g") == "baNANA"
+
+    def test_pattern_magic_is_loud(self):
+        with pytest.raises(LuaError, match="pattern"):
+            LuaState('x = string.find("abc", "a%d", 1)')
+        with pytest.raises(LuaError, match="pattern"):
+            LuaState('x = string.gsub("abc", "a.c", "x")')
+
+    def test_repeat_until(self):
+        st = LuaState(
+            "n = 0\n"
+            "repeat\n"
+            "  n = n + 1\n"
+            "  local done = n >= 4\n"
+            "until done")
+        assert st.get("n") == 4
+
+    def test_repeat_body_runs_at_least_once(self):
+        st = LuaState("n = 0\nrepeat n = n + 1 until true")
+        assert st.get("n") == 1
+
+    def test_table_insert_remove_concat(self):
+        st = LuaState(
+            "t = {1, 2, 4}\n"
+            "table.insert(t, 5)\n"
+            "table.insert(t, 3, 3)\n"
+            'joined = table.concat(t, "-")\n'
+            "popped = table.remove(t)\n"
+            "first = table.remove(t, 1)\n"
+            'rest = table.concat(t, ",")')
+        assert st.get("joined") == "1-2-3-4-5"
+        assert st.get("popped") == 5
+        assert st.get("first") == 1
+        assert st.get("rest") == "2,3,4"
+
+    def test_tostring_tonumber(self):
+        st = LuaState(
+            's = tostring(3.0) .. tostring(nil) .. tostring(true)\n'
+            'a = tonumber("42")\n'
+            'b = tonumber("0x10")\n'
+            'c = tonumber("2.5")\n'
+            'd = tonumber("ff", 16)\n'
+            'e = tonumber("zz")')
+        assert st.get("s") == "3niltrue"
+        assert st.get("a") == 42
+        assert st.get("b") == 16
+        assert st.get("c") == 2.5
+        assert st.get("d") == 255.0
+        assert st.get("e") is None
+
+    def test_format_missing_arg_is_loud(self):
+        with pytest.raises(LuaError, match="format"):
+            LuaState('x = string.format("%d %d", 1)')
+
+    def test_format_invalid_directive_is_loud_anywhere(self):
+        with pytest.raises(LuaError, match="invalid conversion"):
+            LuaState('x = string.format("%y %d", 5)')
+        with pytest.raises(LuaError, match="invalid conversion"):
+            LuaState('x = string.format("%d %y", 5)')
+
+    def test_gsub_function_replacement_is_loud(self):
+        with pytest.raises(LuaError, match="string replacements"):
+            LuaState('function f(c) return "X" end\n'
+                     'x = string.gsub("abc", "b", f)')
