@@ -19,6 +19,53 @@ import numpy as np
 from ..framework import Accelerator, FilterError, start_output_transfers
 
 
+def _wrap_compute_dtype(forward_fn, params, dtype, example_inputs=None):
+    """Cast f32 param leaves to ``dtype`` and wrap the forward so float
+    inputs enter in ``dtype`` and every float output leaves in its
+    ORIGINAL dtype (external tensor meta unchanged — including native
+    f16/bf16 outputs, recovered via a traced eval_shape of the unwrapped
+    forward when example inputs are available)."""
+    import jax
+    import jax.numpy as jnp
+
+    out_dtypes = None
+    if example_inputs is not None:
+        try:
+            shapes = jax.eval_shape(forward_fn, params, *example_inputs)
+            out_dtypes = [jnp.dtype(o.dtype) for o in shapes]
+        except Exception:
+            out_dtypes = None
+
+    def _cast_param(a):
+        arr = np.asarray(a)
+        return arr.astype(np.dtype(dtype)) if arr.dtype == np.float32 \
+            else a
+
+    params = jax.tree_util.tree_map(_cast_param, params)
+
+    def _restore(o, want):
+        if (want is not None and hasattr(o, "dtype") and o.dtype != want
+                and jnp.issubdtype(o.dtype, jnp.floating)
+                and jnp.issubdtype(want, jnp.floating)):
+            return o.astype(want)
+        if (want is None and hasattr(o, "dtype")
+                and jnp.issubdtype(o.dtype, jnp.floating)
+                and jnp.dtype(o.dtype) == jnp.dtype(dtype)):
+            # no trace available: at least undo the compute-dtype leak
+            return o.astype(jnp.float32)
+        return o
+
+    def wrapped(p, *xs):
+        xs = [jnp.asarray(x) for x in xs]
+        xs = [x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating)
+              else x for x in xs]
+        outs = forward_fn(p, *xs)
+        wants = out_dtypes or [None] * len(outs)
+        return [_restore(o, w) for o, w in zip(outs, wants)]
+
+    return wrapped, params
+
+
 class BatchHandle:
     """An in-flight batched invoke: batched device outputs + frame count.
 
@@ -68,12 +115,24 @@ class JitExecMixin:
 
     SUPPORTS_BATCHING = True
 
-    def _setup_exec(self, forward_fn, params, device, warmup_inputs=None):
+    def _setup_exec(self, forward_fn, params, device, warmup_inputs=None,
+                    compute_dtype=None):
         """Compile + stage: params → HBM, jit the forward, optional warm-up
         invoke so frame 1 is steady state.  Returns the warm-up outputs
-        (callers probe output meta from them — no second device trip)."""
+        (callers probe output meta from them — no second device trip).
+
+        ``compute_dtype`` (e.g. bf16): float32 param leaves are cast
+        BEFORE staging (half the HBM weight traffic) and the forward is
+        wrapped to run float math in that dtype, casting float outputs
+        back to their original precision — the generic MXU-native mode
+        for lowered-graph backends (the tflite backend does this inside
+        its lowering instead, where it also owns requantization)."""
         import jax
 
+        if compute_dtype is not None:
+            forward_fn, params = _wrap_compute_dtype(
+                forward_fn, params, compute_dtype,
+                example_inputs=warmup_inputs)
         self._device = device
         self._forward_fn = forward_fn
         self._params_dev = jax.device_put(params, device)
@@ -84,6 +143,24 @@ class JitExecMixin:
         outs = self._invoke_device(warmup_inputs)
         jax.block_until_ready(outs)
         return outs
+
+    @staticmethod
+    def _resolve_compute(props, device):
+        """``custom=compute:{auto,float32,bfloat16}`` for lowered-graph
+        backends: auto = bfloat16 on TPU, float32 elsewhere."""
+        import jax.numpy as jnp
+
+        choice = str(getattr(props, "custom_properties", {}).get(
+            "compute", "auto")).lower()
+        if choice in ("float32", "fp32", "f32"):
+            return None
+        if choice in ("bfloat16", "bf16"):
+            return jnp.bfloat16
+        if choice != "auto":
+            raise FilterError(
+                f"unknown compute dtype {choice!r} "
+                "(auto | float32 | bfloat16)")
+        return jnp.bfloat16 if device.platform == "tpu" else None
 
     def _teardown_exec(self) -> None:
         self._jitted = None

@@ -769,7 +769,9 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
 
         zeros = warm if warm is not None else [
             np.zeros(i.np_shape, i.np_dtype) for i in in_info]
-        outs = self._setup_exec(fn, consts, device, warmup_inputs=zeros)
+        outs = self._setup_exec(
+            fn, consts, device, warmup_inputs=zeros,
+            compute_dtype=self._resolve_compute(props, device))
         probed = TensorsInfo([TensorInfo.from_np(np.asarray(o), name=r)
                               for o, r in zip(outs, out_refs)])
         if props.output_info is not None and props.output_info.is_valid():

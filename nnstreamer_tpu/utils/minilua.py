@@ -633,6 +633,8 @@ def _lua_str(v) -> str:
 
 
 def _lua_tonumber(v, base=None):
+    if isinstance(v, bool):
+        return None                 # Lua: booleans are not numbers
     if base is not None:
         try:
             return float(int(str(v).strip(), int(base)))
@@ -774,6 +776,10 @@ def _make_string() -> LuaTable:
             raise LuaError(
                 "lua: string.gsub: only string replacements are "
                 "supported (function/table replacements are not)")
+        if "%" in repl:
+            raise LuaError(
+                "lua: string.gsub: '%' escapes/captures in the "
+                "replacement are not supported (plain text only)")
         limit = -1 if n is None else int(n)
         return s.replace(pat, repl, limit if limit >= 0 else -1)
 
@@ -800,7 +806,12 @@ def _make_table() -> LuaTable:
             t.set(t.length() + 1, a)
             return
         pos = int(a)
-        for k in range(t.length(), pos - 1, -1):
+        n = t.length()
+        if pos < 1 or pos > n + 1:
+            raise LuaError(
+                f"lua: table.insert: position {pos} out of bounds "
+                f"(table length {n})")
+        for k in range(n, pos - 1, -1):
             t.set(k + 1, t.get(k))
         t.set(pos, b)
 

@@ -189,6 +189,30 @@ def test_fc_softmax_net(tmp_path):
     f.close()
 
 
+def test_fc_softmax_net_bf16_compute(tmp_path):
+    """Generic compute:bfloat16 via the shared jit engine — external
+    meta unchanged, values within bf16 tolerance of the f32 path."""
+    w = np.arange(12, dtype=np.float32).reshape(4, 3) * 0.1
+    b = np.array([0.5, -0.5, 0.0, 1.0], np.float32)
+    model = _write_pair(
+        tmp_path,
+        [_fill("w", (4, 3), w.ravel()), _fill("b", (4,), b)],
+        [_op("FC", ["data", "w", "b"], ["fc"]),
+         _op("Softmax", ["fc"], ["softmax"])],
+        external_input=["data", "w", "b"])
+    f = Caffe2Filter()
+    f.open(FilterProperties(
+        model=model, input_info=_info(("data", "float32", (3, 1))),
+        custom_properties={"compute": "bfloat16"}))
+    x = np.array([[1.0, 2.0, -1.0]], np.float32)
+    out = np.asarray(f.invoke([x])[0])
+    assert out.dtype == np.float32
+    ref = x @ w.T + b
+    ref = np.exp(ref - ref.max()) / np.exp(ref - ref.max()).sum()
+    np.testing.assert_allclose(out, ref, atol=2e-2)
+    f.close()
+
+
 def test_broadcast_add_axis(tmp_path):
     model = _write_pair(
         tmp_path,

@@ -556,6 +556,30 @@ class TestTensorFlowBackend:
             fw.close()
 
     @needs_ref
+    def test_mnist_pb_bf16_compute(self):
+        """Generic compute:bfloat16 (shared jit engine): bf16 weights in
+        HBM, f32 external meta, top-1 stable vs the f32 path."""
+        from nnstreamer_tpu.tensor.info import TensorInfo
+
+        ii = TensorsInfo([TensorInfo.from_np(np.zeros((1, 784),
+                                                      np.float32))])
+        x = np.random.default_rng(0).random((1, 784), np.float32)
+        outs = {}
+        for mode in ("float32", "bfloat16"):
+            fw = open_backend(FilterProperties(
+                framework="tensorflow",
+                model=os.path.join(REF_MODELS, "mnist.pb"), input_info=ii,
+                custom_properties={"compute": mode}))
+            try:
+                outs[mode] = np.asarray(fw.invoke([x])[0])
+            finally:
+                fw.close()
+        assert outs["bfloat16"].dtype == np.float32
+        assert outs["bfloat16"].argmax() == outs["float32"].argmax()
+        np.testing.assert_allclose(outs["bfloat16"], outs["float32"],
+                                   atol=5e-2)
+
+    @needs_ref
     def test_auto_detect_pb(self):
         assert detect_framework(
             os.path.join(REF_MODELS, "mnist.pb")) == "tensorflow"

@@ -279,3 +279,15 @@ class TestStdlibExtensions:
         with pytest.raises(LuaError, match="string replacements"):
             LuaState('function f(c) return "X" end\n'
                      'x = string.gsub("abc", "b", f)')
+
+    def test_tonumber_boolean_is_nil(self):
+        st = LuaState("a = tonumber(true)\nb = tonumber(false)")
+        assert st.get("a") is None and st.get("b") is None
+
+    def test_gsub_percent_in_replacement_is_loud(self):
+        with pytest.raises(LuaError, match="escapes"):
+            LuaState('x = string.gsub("abc", "b", "%1")')
+
+    def test_table_insert_out_of_bounds_is_loud(self):
+        with pytest.raises(LuaError, match="out of bounds"):
+            LuaState("t = {1, 2, 3}\ntable.insert(t, 10, 9)")
