@@ -967,23 +967,14 @@ class TFLiteFilter(JitExecMixin, FilterFramework):
         exact, closer to the reference's int kernels than f32 emulation).
         auto elsewhere: f32.  Explicit values force a mode anywhere
         (int8 on a float graph is a no-op: no quantized ops to select)."""
-        import jax.numpy as jnp
-
         choice = str(props.custom_properties.get("compute", "auto")).lower()
-        if choice in ("float32", "fp32", "f32"):
-            return None, False
-        if choice in ("bfloat16", "bf16"):
-            return jnp.bfloat16, False
         if choice in ("int8", "quant-native"):
             return None, True
-        if choice != "auto":
-            raise FilterError(
-                f"tflite: unknown compute dtype {choice!r} "
-                "(auto | float32 | bfloat16 | int8)")
-        if device.platform == "tpu":
-            quantized = any(t.quantized for t in self._graph.tensors)
-            return (None, True) if quantized else (jnp.bfloat16, False)
-        return None, False
+        if (choice == "auto" and device.platform == "tpu"
+                and any(t.quantized for t in self._graph.tensors)):
+            return None, True
+        # float32/bfloat16/auto: the shared engine policy (_jitexec)
+        return self._resolve_compute(props, device), False
 
     def close(self) -> None:
         self._graph = self._lower = None

@@ -776,10 +776,11 @@ def _make_string() -> LuaTable:
             raise LuaError(
                 "lua: string.gsub: only string replacements are "
                 "supported (function/table replacements are not)")
-        if "%" in repl:
+        if "%" in repl.replace("%%", ""):
             raise LuaError(
-                "lua: string.gsub: '%' escapes/captures in the "
+                "lua: string.gsub: capture escapes (%1, %0, ...) in the "
                 "replacement are not supported (plain text only)")
+        repl = repl.replace("%%", "%")      # the literal-% spelling
         limit = -1 if n is None else int(n)
         return s.replace(pat, repl, limit if limit >= 0 else -1)
 

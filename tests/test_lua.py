@@ -291,3 +291,7 @@ class TestStdlibExtensions:
     def test_table_insert_out_of_bounds_is_loud(self):
         with pytest.raises(LuaError, match="out of bounds"):
             LuaState("t = {1, 2, 3}\ntable.insert(t, 10, 9)")
+
+    def test_gsub_double_percent_is_literal(self):
+        st = LuaState('x = string.gsub("rate {p}", "{p}", "85%%")')
+        assert st.get("x") == "rate 85%"
