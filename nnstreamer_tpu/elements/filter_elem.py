@@ -97,6 +97,7 @@ class TensorFilter(Element):
         self._pending: list = []        # per-frame input lists, collecting
         self._pending_bufs: list = []
         self._inflight = None           # (bufs, handle) dispatched batch
+        self._rewarm = False            # re-compile owed after pushdown
         if self._batch > 1:
             self.fw.warmup_batched(self._batch)
 
@@ -142,6 +143,13 @@ class TensorFilter(Element):
         fw = self.fw
         if fw is None or not fw.opened:
             raise RuntimeError(f"{self.name}: not started")
+        if self._rewarm:
+            # deferred from the pushdown-fusion event handler (compiling
+            # there deadlocks the downstream queue's drain thread): pay
+            # both executable compiles here, before the stream is deep,
+            # so neither a mid-stream batch nor the EOS flush tail does
+            self._rewarm = False
+            fw.warmup_batched(self._batch)
         # QoS throttle-drop (reference :609): after a downstream QoS event,
         # drop frames arriving faster than the reported consumption rate
         if self._throttle_ns and buf.pts is not None:
@@ -255,7 +263,14 @@ class TensorFilter(Element):
             # dispatch on actual tensor shapes.
             fn = event.data["fn"]
             out_info = event.data["out_info"]
-            self._drain_batches()  # old executable's frames go out first
+            # NOTE: no draining and no compiling here.  This handler can
+            # run on a downstream queue's drain thread, where pushing
+            # data or blocking for seconds deadlocks the pipeline (the
+            # invariant is "never push DATA downstream from the drain
+            # thread"; caps/event markers are exempt — queues enqueue
+            # them unbounded).  In-flight batches keep the OLD output
+            # shape and decoders dispatch on actual tensor shapes, so
+            # ordering stays correct without a drain.
             if self._out_comb is not None:
                 # output-combination re-indexes/mixes the model outputs
                 # AFTER invoke; a reduction computed against the combined
@@ -264,10 +279,9 @@ class TensorFilter(Element):
             if not self.fw.set_postprocess(fn):
                 return False
             if self._batch > 1:
-                # the fusion rebuilt both executables: re-warm at
-                # negotiation time so neither a mid-stream batch nor the
-                # EOS flush tail pays the compile
-                self.fw.warmup_batched(self._batch)
+                # the fusion rebuilt both executables; re-warm on the
+                # next chain() call (producer thread)
+                self._rewarm = True
             self._out_config = TensorsConfig(info=out_info,
                                              rate=self._in_config.rate)
             from ..tensor.caps_util import caps_from_config

@@ -206,8 +206,6 @@ class JitExecMixin:
         renegotiation drains, ≤ bucket/8 frames), which dispatch
         per-frame through the already-compiled unbatched executable:
         a 1-frame flush at bucket=64 would otherwise burn 64× the FLOPs."""
-        import jax
-
         n = len(frames)
         if 8 * n <= bucket:
             t0 = time.monotonic_ns()

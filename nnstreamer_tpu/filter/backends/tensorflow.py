@@ -678,8 +678,6 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
 
     # -- lifecycle -----------------------------------------------------------
     def open(self, props: FilterProperties) -> None:
-        import jax
-
         path = str(props.model)
         if not os.path.isfile(path):
             raise FilterError(f"tensorflow: model file not found: {path}")

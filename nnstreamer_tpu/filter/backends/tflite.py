@@ -778,8 +778,6 @@ def _argminmax(fn):
 
 
 def _cast(ins, opts, statics):
-    import jax.numpy as jnp
-
     out_t = opts.scalar(1, "int32", 0) if opts else 0
     return ins[0].astype(_TENSORTYPE_NP.get(out_t, np.float32))
 
@@ -974,7 +972,12 @@ class TFLiteFilter(JitExecMixin, FilterFramework):
                 and any(t.quantized for t in self._graph.tensors)):
             return None, True
         # float32/bfloat16/auto: the shared engine policy (_jitexec)
-        return self._resolve_compute(props, device), False
+        try:
+            return self._resolve_compute(props, device), False
+        except FilterError:
+            raise FilterError(                      # tflite also has int8
+                f"tflite: unknown compute dtype {choice!r} "
+                "(auto | float32 | bfloat16 | int8)")
 
     def close(self) -> None:
         self._graph = self._lower = None

@@ -77,8 +77,6 @@ class XLAFilter(JitExecMixin, FilterFramework):
 
     # -- lifecycle -----------------------------------------------------------
     def open(self, props: FilterProperties) -> None:
-        import jax
-
         from ...models.registry import get_model
 
         _enable_compilation_cache()

@@ -281,7 +281,14 @@ class Queue(Element):
         self.add_src_pad(Caps.any(), "src")
 
     def start(self):
-        self._q: _queue.Queue = _queue.Queue(maxsize=int(self.max_size_buffers))
+        # capacity bounds DATA buffers only (the semaphore); the queue
+        # itself is unbounded so control markers (caps/events/EOS) can
+        # always be enqueued — a caps announcement arriving from the
+        # drain thread of a downstream queue must never block on data
+        # capacity (that is a self-deadlock: the would-be consumer is
+        # the blocked thread)
+        self._q: _queue.Queue = _queue.Queue()
+        self._slots = threading.Semaphore(int(self.max_size_buffers))
         self._worker = threading.Thread(target=self._drain,
                                         name=f"queue:{self.name}", daemon=True)
         self._stop = threading.Event()
@@ -302,26 +309,29 @@ class Queue(Element):
     def get_allowed_caps(self, sink_pad):
         return self.src_pad.peer_allowed_caps()
 
-    def _enqueue(self, item) -> FlowReturn:
-        """Bounded put that can't deadlock: gives up when the queue is being
-        stopped or the drain worker died."""
+    def _enqueue(self, buf) -> FlowReturn:
+        """Slot-bounded data put that can't deadlock: gives up when the
+        queue is being stopped or the drain worker died."""
         while not self._stop.is_set():
-            try:
-                self._q.put(item, timeout=0.1)
+            if self._slots.acquire(timeout=0.1):
+                self._q.put(("buf", buf))
                 return FlowReturn.OK
-            except _queue.Full:
-                if not self._worker.is_alive():
-                    return FlowReturn.ERROR
+            if not self._worker.is_alive():
+                return FlowReturn.ERROR
         return FlowReturn.EOS
 
+    def _enqueue_event(self, event) -> None:
+        if not self._stop.is_set():
+            self._q.put(("event", event))   # unbounded: never blocks
+
     def chain(self, pad, buf):
-        return self._enqueue(("buf", buf))
+        return self._enqueue(buf)
 
     def set_caps(self, pad, caps):
-        self._enqueue(("event", CapsEvent(caps)))
+        self._enqueue_event(CapsEvent(caps))
 
     def on_event(self, pad, event):
-        self._enqueue(("event", event))
+        self._enqueue_event(event)
 
     def _drain(self):
         while not self._stop.is_set():
@@ -331,7 +341,10 @@ class Queue(Element):
             kind, payload = item
             try:
                 if kind == "buf":
-                    self.src_pad.push(payload)
+                    try:
+                        self.src_pad.push(payload)
+                    finally:
+                        self._slots.release()
                 else:
                     self.src_pad.push_event(payload)
                     if isinstance(payload, EOSEvent):

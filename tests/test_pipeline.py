@@ -154,6 +154,42 @@ class TestThreading:
         vals = [b.np(0)[0] for b in sink.results]
         assert vals == sorted(vals)
 
+    def test_queue_control_markers_never_block_on_full_queue(self):
+        """Capacity bounds DATA only: a caps/event marker must enqueue
+        even when every buffer slot is taken and the drain thread is
+        busy — otherwise an upstream-event cascade running ON the drain
+        thread deadlocks announcing caps (the r4 bench pushdown hang)."""
+        import threading
+        import time as _time
+
+        from nnstreamer_tpu.pipeline.caps import Caps
+
+        p = Pipeline()
+        src = AppSrc("src", caps=tensors_caps())
+        q = Queue("q", **{"max-size-buffers": 1})
+        from nnstreamer_tpu.elements import TensorSink
+
+        sink = TensorSink("sink")
+        p.add(src, q, sink)
+        p.link(src, q, sink)
+        gate = threading.Event()
+        orig_chain = sink.chain
+        sink.chain = lambda pad, buf: (gate.wait(15), orig_chain(pad, buf))[1]
+        p.play()
+        push_n(src, 2)          # one stuck in the sink, one in the slot
+        from nnstreamer_tpu.pipeline.element import CustomEvent
+
+        t0 = _time.monotonic()
+        q.set_caps(None, src.src_pad.caps or Caps.any())
+        q.on_event(None, CustomEvent("noop", {}))
+        elapsed = _time.monotonic() - t0
+        gate.set()
+        src.end_of_stream()
+        p.wait(timeout=20)
+        p.stop()
+        assert elapsed < 1.0, f"control marker blocked {elapsed:.1f}s"
+        assert len(sink.results) == 2
+
     def test_tee_duplicates(self):
         p = Pipeline()
         src = AppSrc("src", caps=tensors_caps())

@@ -87,6 +87,34 @@ class TestPushdown:
         got = _run(p, [x])
         assert got[0].extra["index"] == 5
 
+    def test_batched_pushdown_through_tiny_queue_no_deadlock(
+            self, tiny_classifier):
+        """Regression: the post-pushdown re-warm used to compile INSIDE
+        the upstream-event handler, which runs on the downstream queue's
+        drain thread — while it compiled, the producer filled the queue
+        and announce_src_caps deadlocked enqueueing into the queue that
+        thread should drain (hung the r4 bench pipeline).  With the
+        re-warm deferred to chain(), a batched filter through a
+        2-buffer queue must complete."""
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_cls batch=4 name=f ! "
+            "queue max-size-buffers=2 ! "
+            "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+        x = np.array([2.0, 0, 0, 0], np.float32)
+        got = _run(p, [x] * 40)
+        assert len(got) == 40
+        assert all(b.extra["index"] == 5 for b in got)
+        # fusion must have ENGAGED (not been refused): the filter's src
+        # caps are the reduced form, and the deferred re-warm ran
+        f = p.get("f")
+        fcaps = f.src_pad.caps.first()
+        assert fcaps.get("types") == "int32"
+        assert fcaps.get("dimensions") == "1"
+        assert f._rewarm is False
+
     def test_no_pushdown_for_host_backend(self, tiny_classifier):
         """custom-easy cannot compose device fns: the event is refused and
         the decoder keeps the host argmax path."""
