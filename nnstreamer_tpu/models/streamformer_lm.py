@@ -56,7 +56,9 @@ def forward_logits(params: Dict[str, Any], tokens: jnp.ndarray,
     materializes (T, T) scores.  Default: on TPU only (numerics are
     oracle-tested identical; the CPU interpreter is slow)."""
     if flash is None:
-        flash = jax.default_backend() == "tpu"
+        from ..ops.flash_attention import flash_is_default
+
+        flash = flash_is_default()
     t = tokens.shape[0]
     pos = jnp.arange(t)
     x = (params["embed"][tokens] + params["pos"][pos]).astype(cfg.dtype)

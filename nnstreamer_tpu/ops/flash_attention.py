@@ -42,6 +42,19 @@ def _round_up(n: int, m: int) -> int:
     return -(-n // m) * m
 
 
+def flash_is_default() -> bool:
+    """Whether callers with ``flash=None`` should pick the Mosaic kernel:
+    keys off the ACTUAL placement, not just the process default — a
+    ``jax.default_device(cpu)`` pin on a TPU host must not select it."""
+    dev = getattr(jax.config, "jax_default_device", None)
+    if isinstance(dev, str):               # e.g. JAX_DEFAULT_DEVICE=cpu
+        platform = dev.split(":")[0]
+    else:
+        platform = (getattr(dev, "platform", None)
+                    or jax.default_backend())
+    return platform == "tpu"
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, max_ref,
             sum_ref, *, n_k_blocks: int, causal: bool, q_offset: int,
             k_offset: int, scale: float, kv_len: int = 0):
@@ -396,11 +409,44 @@ def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, block_q, block_k, q_offset, k_offset,
+               interpret):
+    """(out, lse) variant for blockwise callers (ring attention) that
+    combine blocks through the logsumexp."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, q_offset,
+                          k_offset, interpret, return_lse=True)
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k, q_offset, k_offset,
+                   interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, q_offset,
+                              k_offset, interpret, return_lse=True)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
+                   res, cots):
+    q, k, v, out, lse = res
+    do, dlse = cots
+    # lse_i = logsumexp(s_i·) has dlse/ds_ij = p_ij, so its cotangent
+    # folds into the delta term: ds = p·(dp − (delta − ḡ_lse))
+    delta = jnp.transpose(jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1))
+    delta = delta - dlse.astype(jnp.float32)
+    return _flash_backward(q, k, v, do, lse, delta, causal, block_q,
+                           block_k, q_offset, k_offset, interpret)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False, block_q: int = 128,
                     block_k: int = 128, q_offset: int = 0,
                     k_offset: int = 0,
-                    interpret: Optional[bool] = None) -> jnp.ndarray:
+                    interpret: Optional[bool] = None,
+                    return_lse: bool = False):
     """Exact attention via the Pallas streaming-softmax kernel.
 
     Args:
@@ -412,12 +458,20 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       interpret: force the Pallas interpreter (CPU); default: interpret
         off on TPU, on elsewhere.
 
-    Differentiable (custom VJP: flash forward, exact recompute backward).
+    Differentiable (custom VJP: flash forward, streaming flash backward).
     Sequence lengths that don't divide the tile are zero-padded up to a
     block multiple (padded K positions masked, padded Q rows sliced off)
     — tiles never shrink below the 8-row sublane granule.
+
+    ``return_lse``: also return the per-row logsumexp (H, T_q) — the
+    residual blockwise callers (ring attention) need to merge block
+    outputs; both outputs stay differentiable (the lse cotangent folds
+    into the backward's delta term).
     """
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not flash_is_default()
+    if return_lse:
+        return _flash_lse(q, k, v, causal, block_q, block_k, q_offset,
+                          k_offset, interpret)
     return _flash(q, k, v, causal, block_q, block_k, q_offset, k_offset,
                   interpret)

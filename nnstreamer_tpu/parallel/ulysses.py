@@ -56,16 +56,9 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qg, kg, vg = (a2a(x, 1, 0) for x in (q, k, v))
     # the full sequence is local now, so plain causal attention is exact
     if flash is None:
-        # auto keys off the ACTUAL placement, not just the process
-        # default: a jax.default_device(cpu) pin on a TPU host must not
-        # select the Mosaic kernel
-        dev = getattr(jax.config, "jax_default_device", None)
-        if isinstance(dev, str):           # e.g. JAX_DEFAULT_DEVICE=cpu
-            platform = dev.split(":")[0]
-        else:
-            platform = (getattr(dev, "platform", None)
-                        or jax.default_backend())
-        flash = platform == "tpu"
+        from ..ops.flash_attention import flash_is_default
+
+        flash = flash_is_default()
     if flash:
         from ..ops.flash_attention import flash_attention
 
