@@ -51,7 +51,9 @@ def normalize_frame(frame, scale: float = 1.0 / 127.5, shift: float = -1.0,
         x = in_ref[:].astype(jnp.float32)
         out_ref[:] = (x * scale + shift).astype(out_ref.dtype)
 
-    interpret = jax.default_backend() == "cpu"
+    from .flash_attention import flash_is_default
+
+    interpret = not flash_is_default()
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(tiled.shape, dtype),

@@ -11,7 +11,9 @@ statements   assignment (incl. table fields), local, function defs,
              numeric for, while, repeat/until, if/elseif/else, return,
              break, calls
 expressions  precedence-climbing: or/and, comparisons, .., + -, * / %,
-             unary - not #, ^, calls, table constructors, field/index
+             unary - not #, ^, calls, colon method calls (strings
+             dispatch via the string library), table constructors,
+             field/index
 values       numbers (int/float), strings, booleans, nil, 1-based tables
 stdlib       math.floor/ceil/abs/min/max/sqrt/huge · string.format/sub/
              len/upper/lower/rep/reverse/byte/char/find/gsub (find and
@@ -568,6 +570,34 @@ class _Parser:
                         raise LuaError("lua: call of nil")
                     return f(*[a(env) for a in args])
                 node = ("expr", call)
+            elif p == ":":
+                # method-call sugar: obj:m(a) == obj.m(obj, a); strings
+                # dispatch through the `string` library table (the role
+                # of Lua's string metatable)
+                self.next()
+                method = self.expect("name")
+                self.expect("(")
+                margs: List[Callable] = []
+                if self.peek() != ")":
+                    margs = self.exprlist()
+                self.expect(")")
+                objfn = self.node_value(node)
+
+                def mcall(env, objfn=objfn, method=method,
+                          margs=tuple(margs)):
+                    obj = objfn(env)
+                    if isinstance(obj, str):
+                        lib = env.get("string")
+                        f = (lib.get(method)
+                             if isinstance(lib, LuaTable) else None)
+                    else:
+                        f = _index(obj, method)
+                    if f is None:
+                        raise LuaError(
+                            f"lua: no method {method!r} on "
+                            f"{_lua_str(obj)[:40]!r}")
+                    return f(obj, *[a(env) for a in margs])
+                node = ("expr", mcall)
             else:
                 return node
 

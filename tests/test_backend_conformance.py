@@ -238,3 +238,44 @@ class TestBackendConformance:
         fw2 = type(fw)()
         with pytest.raises(FilterError):
             fw2.open(bad)
+
+    #: backends where custom=compute:bfloat16 selects the MXU-native
+    #: math mode (tflite in its lowering, the rest via the shared
+    #: _jitexec wrap)
+    BF16_BACKENDS = ("tensorflow-lite", "tensorflow", "caffe2", "mxnet")
+
+    def test_bf16_compute_mode_preserves_contract(self, tmp_path, backend):
+        """compute:bfloat16 must keep external dtypes/shapes identical
+        and values within bf16 tolerance of the f32 path — the same
+        lifecycle contract, any model format."""
+        if backend not in self.BF16_BACKENDS:
+            pytest.skip("compute prop applies to model-file backends")
+        import dataclasses
+
+        fw, props = _make(tmp_path, backend)
+        fw.open(props)
+        try:
+            ii, _ = fw.get_model_info()
+            rng = np.random.default_rng(0)
+            xs = [(rng.random(i.np_shape) * 2 - 1).astype(i.np_dtype)
+                  if np.issubdtype(i.np_dtype, np.floating)
+                  else rng.integers(0, 4, i.np_shape).astype(i.np_dtype)
+                  for i in ii]
+            ref = [np.asarray(o) for o in fw.invoke(xs)]
+        finally:
+            fw.close()
+        props2 = dataclasses.replace(
+            props, custom_properties=dict(props.custom_properties,
+                                          compute="bfloat16"))
+        fw2 = type(fw)()
+        fw2.open(props2)
+        try:
+            outs = [np.asarray(o) for o in fw2.invoke(xs)]
+            assert len(outs) == len(ref)
+            for o, r in zip(outs, ref):
+                assert o.dtype == r.dtype and o.shape == r.shape
+                if np.issubdtype(o.dtype, np.floating):
+                    span = max(1.0, float(np.abs(r).max()))
+                    np.testing.assert_allclose(o, r, atol=0.03 * span)
+        finally:
+            fw2.close()

@@ -295,3 +295,19 @@ class TestStdlibExtensions:
     def test_gsub_double_percent_is_literal(self):
         st = LuaState('x = string.gsub("rate {p}", "{p}", "85%%")')
         assert st.get("x") == "rate 85%"
+
+    def test_colon_method_calls_on_strings_and_tables(self):
+        st = LuaState(
+            's = ("abc"):upper()\n'
+            'x = "hello world"\n'
+            'u = x:sub(1, 5):rep(2)\n'
+            "t = {greet = function(self, who) return self.prefix .. who end,"
+            ' prefix = "hi "}\n'
+            'g = t:greet("lua")')
+        assert st.get("s") == "ABC"
+        assert st.get("u") == "hellohello"
+        assert st.get("g") == "hi lua"
+
+    def test_colon_method_missing_is_loud(self):
+        with pytest.raises(LuaError, match="no method"):
+            LuaState('x = ("abc"):nosuch()')
