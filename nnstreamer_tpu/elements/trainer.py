@@ -48,6 +48,17 @@ class TrainerFramework:
         pass
 
 
+def _save_orbax(params, path: str) -> None:
+    """Shared checkpoint writer for trainer frameworks."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(os.path.abspath(path), params)
+    ckpt.wait_until_finished()
+
+
 _TRAINERS: Dict[str, Type[TrainerFramework]] = {}
 
 
@@ -157,14 +168,88 @@ class JaxTrainer(TrainerFramework):
     def save(self, path: str) -> None:
         if self._state is None:
             return  # no samples were seen; nothing to save
-        import os
+        _save_orbax(self._state[0], path)
 
-        import orbax.checkpoint as ocp
 
-        params, _ = self._state
-        ckpt = ocp.StandardCheckpointer()
-        ckpt.save(os.path.abspath(path), params)
-        ckpt.wait_until_finished()
+@register_trainer
+class MeshTrainer(TrainerFramework):
+    """``framework=mesh``: the stream trains the SHARDED StreamFormer —
+    every (tokens, labels) frame becomes one step of
+    :func:`nnstreamer_tpu.parallel.make_train_step` jitted over a
+    dp/sp/tp/ep mesh.  This is the pipeline-to-parallel-core bridge:
+    the reference's trainer ABI (nnstreamer_plugin_api_trainer.h) only
+    ever trains on the host; here the same element drives multi-chip
+    SPMD training with ring/Ulysses sequence parallelism and the Pallas
+    flash kernel on TPU.
+
+    props (via ``custom=``): mesh axes ``dp/sp/tp/ep`` (defaults:
+    auto-factorized over all devices), model hyperparams ``vocab/dim/
+    heads/head_dim/mlp/layers/experts/max_seq``, ``seq_parallel``
+    (ring|ulysses).  Samples: tensor 0 = tokens (B, T) int32, tensor 1 =
+    labels (B, T) int32, already sharded (dp, sp) by the step.
+    """
+
+    NAME = "mesh"
+
+    def create(self, props: Dict[str, Any]) -> None:
+        self.props = props
+        self.epochs = int(props.get("num-epochs", 1))
+        self._samples: List[Tuple[List[np.ndarray], List[np.ndarray]]] = []
+        self.losses: List[float] = []
+        self._built = False
+
+    def push_data(self, inputs, labels) -> None:
+        self._samples.append((inputs, labels))
+
+    def _build(self) -> None:
+        import jax
+
+        from ..parallel import make_data_sharding, make_mesh
+        from ..parallel.train_step import (StreamFormerConfig,
+                                           make_train_step)
+
+        p = self.props
+        axes = {a: int(p[a]) for a in ("dp", "sp", "tp", "ep") if a in p}
+        self._mesh = make_mesh(axis_sizes=axes or None)
+        cfg_kw = {k: int(p[k]) for k in ("vocab", "dim", "heads",
+                                         "head_dim", "mlp", "layers",
+                                         "experts", "max_seq") if k in p}
+        for k in ("lr", "capacity_factor", "aux_coef"):
+            if k in p:
+                cfg_kw[k] = float(p[k])
+        if "seq_parallel" in p:
+            cfg_kw["seq_parallel"] = str(p["seq_parallel"])
+        cfg = StreamFormerConfig(**cfg_kw) if cfg_kw \
+            else StreamFormerConfig()
+        self._step, self._params, self._opt, _ = make_train_step(
+            self._mesh, cfg, seed=int(p.get("seed", 0)))
+        self._sharding = make_data_sharding(self._mesh)
+        self._put = lambda x: jax.device_put(x, self._sharding)
+        self._built = True
+
+    def finish(self) -> Dict[str, Any]:
+        if not self._samples:
+            return {"epochs": 0, "samples": 0, "final_loss": None}
+        if not self._built:
+            self._build()
+        for _ in range(self.epochs):
+            for inputs, labels in self._samples:
+                tokens = np.asarray(inputs[0], np.int32)
+                labs = np.asarray(labels[0], np.int32)
+                self._params, self._opt, loss = self._step(
+                    self._params, self._opt, self._put(tokens),
+                    self._put(labs))
+                self.losses.append(float(loss))
+        return {"epochs": self.epochs, "samples": len(self._samples),
+                "final_loss": self.losses[-1] if self.losses else None,
+                "mesh": {a: int(s) for a, s in
+                         zip(self._mesh.axis_names,
+                             self._mesh.devices.shape)}}
+
+    def save(self, path: str) -> None:
+        if not self._built:
+            return
+        _save_orbax(self._params, path)
 
 
 @register_element

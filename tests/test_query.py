@@ -124,6 +124,41 @@ class TestTrainer:
         # trained: loss decreased over steps
         assert trainer.trainer.losses[-1] < trainer.trainer.losses[0]
 
+    def test_mesh_trainer_pipeline(self, tmp_path, jax_cpu_devices):
+        """The stream trains the SHARDED StreamFormer: every frame is one
+        make_train_step step over a dp=2/sp=2/tp=2 mesh (8 virtual CPU
+        devices) — the pipeline-to-parallel-core bridge."""
+        from nnstreamer_tpu.elements import TensorTrainer
+        from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+
+        p = Pipeline()
+        seq = 16
+        src = AppSrc("src", caps=(
+            f"other/tensors,format=static,num_tensors=2,"
+            f"dimensions={seq}:4.{seq}:4,types=int32.int32,framerate=0/1"))
+        trainer = TensorTrainer("tr", framework="mesh", **{
+            "num-epochs": 4,
+            "model-save-path": str(tmp_path / "mesh_ckpt"),
+            "custom": ("dp:2,sp:2,tp:2,ep:1,vocab:32,dim:16,heads:4,"
+                       "head_dim:4,mlp:32,layers:1,experts:1,"
+                       f"max_seq:{seq}")})
+        sink = TensorSink("out")
+        p.add(src, trainer, sink)
+        p.link(src, trainer, sink)
+        rng = np.random.default_rng(0)
+        for i in range(6):
+            toks = rng.integers(0, 32, (4, seq)).astype(np.int32)
+            labs = np.roll(toks, -1, axis=1).astype(np.int32)
+            src.push_buffer(TensorBuffer(tensors=[toks, labs], pts=i))
+        src.end_of_stream()
+        p.run(timeout=300)
+        assert trainer.summary["samples"] == 6
+        assert trainer.summary["mesh"] == {"dp": 2, "sp": 2, "tp": 2,
+                                           "ep": 1}
+        losses = trainer.trainer.losses
+        assert losses[-1] < losses[0]          # it learns the shift task
+        assert (tmp_path / "mesh_ckpt").exists()
+
 
 class TestEdgePubSub:
     def test_pub_sub_round_trip(self):
