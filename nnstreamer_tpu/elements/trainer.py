@@ -219,8 +219,7 @@ class MeshTrainer(TrainerFramework):
                 cfg_kw[k] = float(p[k])
         if "seq_parallel" in p:
             cfg_kw["seq_parallel"] = str(p["seq_parallel"])
-        cfg = StreamFormerConfig(**cfg_kw) if cfg_kw \
-            else StreamFormerConfig()
+        cfg = StreamFormerConfig(**cfg_kw)
         self._step, self._params, self._opt, _ = make_train_step(
             self._mesh, cfg, seed=int(p.get("seed", 0)))
         self._sharding = make_data_sharding(self._mesh)
@@ -228,23 +227,24 @@ class MeshTrainer(TrainerFramework):
         self._built = True
 
     def finish(self) -> Dict[str, Any]:
+        from ..parallel import mesh_info
+
         if not self._samples:
             return {"epochs": 0, "samples": 0, "final_loss": None}
         if not self._built:
             self._build()
+        # transfer + reshard each sample once, not once per epoch
+        staged = [(self._put(np.asarray(i[0], np.int32)),
+                   self._put(np.asarray(l[0], np.int32)))
+                  for i, l in self._samples]
         for _ in range(self.epochs):
-            for inputs, labels in self._samples:
-                tokens = np.asarray(inputs[0], np.int32)
-                labs = np.asarray(labels[0], np.int32)
+            for tokens, labs in staged:
                 self._params, self._opt, loss = self._step(
-                    self._params, self._opt, self._put(tokens),
-                    self._put(labs))
+                    self._params, self._opt, tokens, labs)
                 self.losses.append(float(loss))
         return {"epochs": self.epochs, "samples": len(self._samples),
                 "final_loss": self.losses[-1] if self.losses else None,
-                "mesh": {a: int(s) for a, s in
-                         zip(self._mesh.axis_names,
-                             self._mesh.devices.shape)}}
+                "mesh": mesh_info(self._mesh)}
 
     def save(self, path: str) -> None:
         if not self._built:

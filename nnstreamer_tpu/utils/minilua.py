@@ -8,8 +8,8 @@ its own interpreter for the Lua subset those filters use — written from
 the Lua 5.x reference manual, not from any Lua implementation:
 
 statements   assignment (incl. table fields), local, function defs,
-             numeric for, while, repeat/until, if/elseif/else, return,
-             break, calls
+             numeric for, generic for over pairs/ipairs, while,
+             repeat/until, if/elseif/else, return, break, calls
 expressions  precedence-climbing: or/and, comparisons, .., + -, * / %,
              unary - not #, ^, calls, colon method calls (strings
              dispatch via the string library), table constructors,
@@ -47,7 +47,7 @@ class LuaError(RuntimeError):
 # ---------------------------------------------------------------------------
 
 _KEYWORDS = {"and", "break", "do", "else", "elseif", "end", "false", "for",
-             "function", "if", "local", "nil", "not", "or", "repeat",
+             "function", "if", "in", "local", "nil", "not", "or", "repeat",
              "return", "then", "true", "until", "while"}
 
 _TOKEN_RE = re.compile(r"""
@@ -117,7 +117,11 @@ class LuaTable:
     def set(self, key, value):
         if isinstance(key, float) and key.is_integer():
             key = int(key)
-        self.data[key] = value
+        if value is None:
+            # Lua: assigning nil DELETES the entry (pairs/# never see it)
+            self.data.pop(key, None)
+        else:
+            self.data[key] = value
 
     def length(self) -> int:
         n = 0
@@ -329,6 +333,8 @@ class _Parser:
     def for_stmt(self) -> Callable:
         self.next()
         var = self.expect("name")
+        if self.peek() in (",", "in"):
+            return self.generic_for(var)
         self.expect("=")
         start = self.expr()
         self.expect(",")
@@ -365,6 +371,59 @@ class _Parser:
                     env.locals.pop(var, None)
                 else:
                     env.locals[var] = saved
+        return run
+
+    def generic_for(self, first_var: str) -> Callable:
+        """``for k, v in pairs(t) do … end`` — the Lua generic-for
+        protocol: the in-list evaluates to (iterator, state, control);
+        each round calls ``iterator(state, control)`` and stops when the
+        first result is nil (manual §3.3.5)."""
+        names = [first_var]
+        while self.accept(","):
+            names.append(self.expect("name"))
+        self.expect("in")
+        exprs = self.exprlist()
+        self.expect("do")
+        body = self.block(("end",))
+        self.expect("end")
+
+        _MISSING = object()
+
+        def run(env, names=tuple(names), exprs=tuple(exprs), body=body,
+                _MISSING=_MISSING):
+            vals = [e(env) for e in exprs]
+            # a single expr may return an iterator TRIPLE (pairs/ipairs)
+            if len(vals) == 1 and isinstance(vals[0], tuple):
+                vals = list(vals[0])
+            vals += [None] * (3 - len(vals))
+            it, state, ctrl = vals[:3]
+            if not callable(it):
+                raise LuaError("lua: generic for needs an iterator "
+                               "function (pairs/ipairs)")
+            saved = {n: env.locals.get(n, _MISSING) for n in names}
+            try:
+                while True:
+                    res = it(state, ctrl)
+                    if isinstance(res, tuple):
+                        first = res[0] if res else None
+                    else:
+                        res = (res,)
+                        first = res[0]
+                    if first is None:
+                        break
+                    ctrl = first
+                    for i, n in enumerate(names):
+                        env.set_local(n, res[i] if i < len(res) else None)
+                    try:
+                        body(env)
+                    except _Break:
+                        break
+            finally:
+                for n, s in saved.items():
+                    if s is _MISSING:
+                        env.locals.pop(n, None)
+                    else:
+                        env.locals[n] = s
         return run
 
     def if_stmt(self) -> Callable:
@@ -662,6 +721,42 @@ def _lua_str(v) -> str:
     return str(v)
 
 
+def _lua_pairs(t):
+    """Iterator triple over ALL entries (snapshot of keys at call time)."""
+    if not isinstance(t, LuaTable):
+        raise LuaError("lua: pairs expects a table")
+    keys = list(t.data.keys())
+    succ: Dict[Any, Any] = {}
+    prev: Any = None
+    for key in keys:
+        succ[prev] = key
+        prev = key
+
+    def nxt(state, ctrl):
+        k = succ.get(ctrl)
+        if k is None:
+            return None
+        return (k, t.get(k))
+
+    return (nxt, t, None)
+
+
+def _lua_ipairs(t):
+    """Iterator triple over the 1..n array part, stopping at the first
+    nil (the Lua ipairs contract)."""
+    if not isinstance(t, LuaTable):
+        raise LuaError("lua: ipairs expects a table")
+
+    def nxt(state, ctrl):
+        i = 1 if ctrl is None else int(ctrl) + 1
+        v = state.get(i)
+        if v is None:
+            return None
+        return (i, v)
+
+    return (nxt, t, None)
+
+
 def _lua_tonumber(v, base=None):
     if isinstance(v, bool):
         return None                 # Lua: booleans are not numbers
@@ -876,6 +971,8 @@ class LuaState:
             "table": _make_table(),
             "tostring": _lua_str,
             "tonumber": _lua_tonumber,
+            "pairs": _lua_pairs,
+            "ipairs": _lua_ipairs,
             "print": lambda *a: print("[lua]", *[_lua_str(x) for x in a]),
         }
         if host_globals:

@@ -311,3 +311,48 @@ class TestStdlibExtensions:
     def test_colon_method_missing_is_loud(self):
         with pytest.raises(LuaError, match="no method"):
             LuaState('x = ("abc"):nosuch()')
+
+    def test_generic_for_pairs_and_ipairs(self):
+        st = LuaState(
+            "t = {10, 20, 30, label = 99}\n"
+            "sum = 0\n"
+            "for i, v in ipairs(t) do sum = sum + i * v end\n"
+            "n = 0\n"
+            "total = 0\n"
+            "for k, v in pairs(t) do n = n + 1 total = total + v end")
+        assert st.get("sum") == 10 + 40 + 90
+        assert st.get("n") == 4
+        assert st.get("total") == 159
+
+    def test_ipairs_stops_at_nil_hole(self):
+        st = LuaState(
+            "t = {1, 2}\n"
+            "t[4] = 9\n"
+            "c = 0\n"
+            "for i, v in ipairs(t) do c = c + 1 end")
+        assert st.get("c") == 2
+
+    def test_generic_for_break_and_scoping(self):
+        st = LuaState(
+            "k = 'outer'\n"
+            "seen = 0\n"
+            "for k, v in ipairs({5, 6, 7}) do\n"
+            "  seen = v\n"
+            "  if v == 6 then break end\n"
+            "end")
+        assert st.get("seen") == 6
+        assert st.get("k") == "outer"      # control vars are loop-local
+
+    def test_generic_for_requires_iterator(self):
+        with pytest.raises(LuaError, match="iterator"):
+            LuaState("for k, v in 5 do end")
+
+    def test_assigning_nil_deletes_entry(self):
+        st = LuaState(
+            "t = {10, 20}\n"
+            "t[1] = nil\n"
+            "n = 0\n"
+            "for k, v in pairs(t) do n = n + 1 end\n"
+            "has = t[1]")
+        assert st.get("n") == 1
+        assert st.get("has") is None
