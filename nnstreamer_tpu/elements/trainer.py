@@ -233,14 +233,16 @@ class MeshTrainer(TrainerFramework):
             return {"epochs": 0, "samples": 0, "final_loss": None}
         if not self._built:
             self._build()
-        # transfer + reshard each sample once, not once per epoch
-        staged = [(self._put(np.asarray(i[0], np.int32)),
-                   self._put(np.asarray(l[0], np.int32)))
-                  for i, l in self._samples]
+        # host-side convert once; device_put per step — bounded HBM (one
+        # sample resident at a time) beats saving a transfer per epoch
+        # for a trainer fed by an arbitrarily long stream
+        host = [(np.asarray(i[0], np.int32), np.asarray(l[0], np.int32))
+                for i, l in self._samples]
         for _ in range(self.epochs):
-            for tokens, labs in staged:
+            for tokens, labs in host:
                 self._params, self._opt, loss = self._step(
-                    self._params, self._opt, tokens, labs)
+                    self._params, self._opt, self._put(tokens),
+                    self._put(labs))
                 self.losses.append(float(loss))
         return {"epochs": self.epochs, "samples": len(self._samples),
                 "final_loss": self.losses[-1] if self.losses else None,

@@ -273,6 +273,24 @@ class _Parser:
         if k == "function":
             self.next()
             name = self.expect("name")
+            if self.peek() in (".", ":"):
+                # function t.m(...) / function t:m(self-style) — define
+                # into a table field; colon form prepends the implicit
+                # `self` parameter (Lua manual §3.4.11)
+                sep = self.next()[0]
+                field = self.expect("name")
+                fn = self.function_body(implicit_self=(sep == ":"))
+
+                def mdef(env, name=name, field=field, fn=fn):
+                    obj = env.get(name)
+                    if obj is None:
+                        raise LuaError(
+                            f"lua: function {name}.{field}: {name!r} "
+                            "is nil")
+                    # same assignment rule as `obj.field = fn` (tables
+                    # AND host __setitem__ proxies)
+                    _setindex(obj, field, fn(env))
+                return mdef
             fn = self.function_body()
 
             def fndef(env, name=name, fn=fn):
@@ -482,9 +500,9 @@ class _Parser:
         return run
 
     # -- functions -----------------------------------------------------------
-    def function_body(self) -> Callable:
+    def function_body(self, implicit_self: bool = False) -> Callable:
         self.expect("(")
-        params: List[str] = []
+        params: List[str] = ["self"] if implicit_self else []
         if self.peek() != ")":
             params.append(self.expect("name"))
             while self.accept(","):
@@ -734,6 +752,10 @@ def _lua_pairs(t):
 
     def nxt(state, ctrl):
         k = succ.get(ctrl)
+        # skip keys deleted mid-traversal (Lua allows removing fields
+        # during pairs; next never yields a removed key)
+        while k is not None and k not in t.data:
+            k = succ.get(k)
         if k is None:
             return None
         return (k, t.get(k))

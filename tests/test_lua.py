@@ -356,3 +356,34 @@ class TestStdlibExtensions:
             "has = t[1]")
         assert st.get("n") == 1
         assert st.get("has") is None
+
+    def test_function_definitions_into_tables(self):
+        st = LuaState(
+            "M = {}\n"
+            "function M.double(x) return x * 2 end\n"
+            "function M:describe(tag) return tag .. ':' .. "
+            "tostring(self.double(21)) end\n"
+            'a = M.double(4)\n'
+            'b = M:describe("answer")')
+        assert st.get("a") == 8
+        assert st.get("b") == "answer:42"
+
+    def test_function_def_on_non_table_is_loud(self):
+        with pytest.raises(LuaError, match="cannot index-assign"):
+            LuaState("x = 5\nfunction x.m() return 1 end")
+
+    def test_pairs_skips_keys_deleted_mid_traversal(self):
+        st = LuaState(
+            "t = {a = 1, b = 2, c = 3}\n"
+            "out = 0\n"
+            "for k, v in pairs(t) do\n"
+            "  t['c'] = nil\n"
+            "  out = out + v\n"
+            "end")
+        # 'c' may be visited only if it came first in the snapshot;
+        # after deletion it must never surface as (key, nil)
+        assert st.get("out") in (3, 6)
+
+    def test_function_def_on_nil_is_loud(self):
+        with pytest.raises(LuaError, match="is nil"):
+            LuaState("function nothere.m() return 1 end")
