@@ -14,7 +14,10 @@ expressions  precedence-climbing: or/and, comparisons, .., + -, * / %,
              unary - not #, ^, calls, colon method calls (strings
              dispatch via the string library), table constructors,
              field/index
-values       numbers (int/float), strings, booleans, nil, 1-based tables
+values       numbers (int/float), strings, booleans, nil, 1-based
+             tables; multiple return values with Lua's expression-list
+             adjustment (non-final results truncate to one value, the
+             final one expands; conditions take the first value)
 stdlib       math.floor/ceil/abs/min/max/sqrt/huge · string.format/sub/
              len/upper/lower/rep/reverse/byte/char/find/gsub (find and
              gsub take PLAIN needles — Lua pattern magic raises loudly)
@@ -170,6 +173,8 @@ class _Return(Exception):
 
 
 def _truthy(v) -> bool:
+    if isinstance(v, tuple):              # a condition takes the FIRST
+        v = v[0] if v else None           # of a multi-value result
     return v is not None and v is not False
 
 
@@ -181,6 +186,40 @@ def _index(obj, key):
             key = int(key)
         return obj[key]
     raise LuaError(f"lua: cannot index {type(obj).__name__}")
+
+
+def _first(v):
+    """Single-value adjustment: a multi-value result (Python tuple) in a
+    scalar position — operator operand, index key/object, parenthesized
+    expression, keyed constructor value — takes its FIRST value
+    (manual §3.4)."""
+    if isinstance(v, tuple):
+        return v[0] if v else None
+    return v
+
+
+def _adjust_values(vals: List[Any], n: int) -> List[Any]:
+    """Lua multiple-value adjustment for an evaluated expression list:
+    a non-final multi-value result (Python tuple) truncates to its first
+    value, the FINAL one expands; the list is then padded with nil / cut
+    to ``n`` (manual §3.4: expression-list adjustment)."""
+    out = _expand_args(vals)
+    return [out[i] if i < len(out) else None for i in range(n)]
+
+
+def _expand_args(vals: List[Any]) -> List[Any]:
+    """Call-argument adjustment: final multi-value expands, earlier ones
+    truncate to their first value."""
+    out: List[Any] = []
+    for i, v in enumerate(vals):
+        if isinstance(v, tuple):
+            if i == len(vals) - 1:
+                out.extend(v)
+            else:
+                out.append(v[0] if v else None)
+        else:
+            out.append(v)
+    return out
 
 
 def _setindex(obj, key, value):
@@ -266,9 +305,9 @@ class _Parser:
                 exprs = self.exprlist()
 
             def local_stmt(env, names=names, exprs=exprs):
-                for i, n in enumerate(names):
-                    env.set_local(n, exprs[i](env) if i < len(exprs)
-                                  else None)
+                vals = _adjust_values([e(env) for e in exprs], len(names))
+                for n, v in zip(names, vals):
+                    env.set_local(n, v)
             return local_stmt
         if k == "function":
             self.next()
@@ -333,12 +372,20 @@ class _Parser:
             return self.if_stmt()
         if k == "return":
             self.next()
-            expr = None
-            if self.peek() not in ("end", "else", "elseif", "<eof>"):
-                expr = self.expr()
+            exprs: List[Callable] = []
+            if self.peek() not in ("end", "else", "elseif", "until",
+                                   "<eof>", ";"):
+                exprs = self.exprlist()
 
-            def ret(env, expr=expr):
-                raise _Return(expr(env) if expr else None)
+            def ret(env, exprs=tuple(exprs)):
+                if not exprs:
+                    raise _Return(None)
+                if len(exprs) == 1:
+                    # single expr: pass through (incl. a callee's own
+                    # multi-value tuple — chained returns)
+                    raise _Return(exprs[0](env))
+                vals = _expand_args([e(env) for e in exprs])
+                raise _Return(tuple(vals))
             return ret
         if k == "break":
             self.next()
@@ -484,13 +531,13 @@ class _Parser:
                     raise LuaError("lua: cannot assign to expression")
 
             def assign(env, setters=setters, exprs=exprs):
-                vals = [e(env) for e in exprs]
-                for i, s in enumerate(setters):
-                    v = vals[i] if i < len(vals) else None
+                vals = _adjust_values([e(env) for e in exprs],
+                                      len(setters))
+                for s, v in zip(setters, vals):
                     if s[0] == "name":
                         env.set(s[1], v)
                     else:
-                        _setindex(s[1](env), s[2](env), v)
+                        _setindex(_first(s[1](env)), _first(s[2](env)), v)
             return assign
         # bare expression statement (function call)
         fn = self.finish_expr_from_suffixed(target)
@@ -551,22 +598,22 @@ class _Parser:
             right = self.expr(level + 1)
             if op == "or":
                 left = (lambda a, b: lambda env:
-                        (lambda v: v if _truthy(v) else b(env))(a(env))
-                        )(left, right)
+                        (lambda v: v if _truthy(v) else _first(b(env)))
+                        (_first(a(env))))(left, right)
             elif op == "and":
                 left = (lambda a, b: lambda env:
-                        (lambda v: b(env) if _truthy(v) else v)(a(env))
-                        )(left, right)
+                        (lambda v: _first(b(env)) if _truthy(v) else v)
+                        (_first(a(env))))(left, right)
             else:
                 fn = _BINFN[op]
-                left = (lambda a, b, fn=fn: lambda env: fn(a(env), b(env))
-                        )(left, right)
+                left = (lambda a, b, fn=fn: lambda env:
+                        fn(_first(a(env)), _first(b(env))))(left, right)
         return left
 
     def unary(self) -> Callable:
         if self.accept("-"):
             operand = self.unary()
-            return lambda env: -operand(env)
+            return lambda env: -_first(operand(env))
         if self.accept("not"):
             operand = self.unary()
             return lambda env: not _truthy(operand(env))
@@ -574,7 +621,7 @@ class _Parser:
             operand = self.unary()
 
             def length(env):
-                v = operand(env)
+                v = _first(operand(env))
                 if isinstance(v, LuaTable):
                     return v.length()
                 if isinstance(v, str):
@@ -590,7 +637,7 @@ class _Parser:
         base = self.finish_expr_from_suffixed(self.suffixed())
         if self.accept("^"):
             exp = self.unary()       # right associative, binds over unary
-            return lambda env: base(env) ** exp(env)
+            return lambda env: _first(base(env)) ** _first(exp(env))
         return base
 
     # -- primary/suffixed expressions ---------------------------------------
@@ -612,7 +659,7 @@ class _Parser:
         elif k == "(":
             inner = self.expr()
             self.expect(")")
-            node = ("expr", inner)
+            node = ("expr", lambda env, inner=inner: _first(inner(env)))
         elif k == "{":
             node = ("expr", self.table_constructor())
         elif k == "function":
@@ -642,10 +689,10 @@ class _Parser:
                 fnv = self.node_value(node)
 
                 def call(env, fnv=fnv, args=tuple(args)):
-                    f = fnv(env)
+                    f = _first(fnv(env))
                     if f is None:
                         raise LuaError("lua: call of nil")
-                    return f(*[a(env) for a in args])
+                    return f(*_expand_args([a(env) for a in args]))
                 node = ("expr", call)
             elif p == ":":
                 # method-call sugar: obj:m(a) == obj.m(obj, a); strings
@@ -662,7 +709,7 @@ class _Parser:
 
                 def mcall(env, objfn=objfn, method=method,
                           margs=tuple(margs)):
-                    obj = objfn(env)
+                    obj = _first(objfn(env))
                     if isinstance(obj, str):
                         lib = env.get("string")
                         f = (lib.get(method)
@@ -673,7 +720,7 @@ class _Parser:
                         raise LuaError(
                             f"lua: no method {method!r} on "
                             f"{_lua_str(obj)[:40]!r}")
-                    return f(obj, *[a(env) for a in margs])
+                    return f(obj, *_expand_args([a(env) for a in margs]))
                 node = ("expr", mcall)
             else:
                 return node
@@ -687,7 +734,8 @@ class _Parser:
             return load
         if node[0] == "index":
             objfn, keyfn = node[1], node[2]
-            return lambda env: _index(objfn(env), keyfn(env))
+            return lambda env: _index(_first(objfn(env)),
+                                      _first(keyfn(env)))
         return node[1]
 
     def finish_expr_from_suffixed(self, node) -> Callable:
@@ -714,15 +762,25 @@ class _Parser:
         def build(env, items=items):
             t = LuaTable()
             n = 0
-            for key, vexpr in items:
+            for i, (key, vexpr) in enumerate(items):
                 v = vexpr(env)
                 if key is None:
+                    if isinstance(v, tuple):
+                        # multi-value adjustment in constructors: the
+                        # FINAL positional item expands, earlier ones
+                        # truncate to their first value
+                        if i == len(items) - 1:
+                            for vv in v:
+                                n += 1
+                                t.set(n, vv)
+                            continue
+                        v = v[0] if v else None
                     n += 1
                     t.set(n, v)
                 elif callable(key):
-                    t.set(key(env), v)
+                    t.set(_first(key(env)), _first(v))
                 else:
-                    t.set(key, v)
+                    t.set(key, _first(v))
             return t
         return build
 
@@ -913,9 +971,7 @@ def _make_string() -> LuaTable:
         idx = s.find(pat, a)
         if idx < 0:
             return None
-        return idx + 1                      # (start; end omitted = start
-        # + #pat - 1 is derivable — single-return keeps the evaluator's
-        # one-value expression model)
+        return (idx + 1, idx + len(pat))    # (start, end), Lua 1-based
 
     def gsub(s, pat, repl, n=None):
         _plain_only(pat, "string.gsub")

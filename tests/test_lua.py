@@ -387,3 +387,77 @@ class TestStdlibExtensions:
     def test_function_def_on_nil_is_loud(self):
         with pytest.raises(LuaError, match="is nil"):
             LuaState("function nothere.m() return 1 end")
+
+    def test_multi_value_returns_and_adjustment(self):
+        st = LuaState(
+            "function mm() return 1, 2, 3 end\n"
+            "a, b, c = mm()\n"
+            "single = mm()\n"
+            "x, y = mm(), 10\n"          # non-final call truncates
+            "local p, q = mm()\n"
+            "pq = p + q\n"
+            "function chain() return mm() end\n"
+            "d, e = chain()")
+        assert (st.get("a"), st.get("b"), st.get("c")) == (1, 2, 3)
+        assert st.get("single") == 1
+        assert (st.get("x"), st.get("y")) == (1, 10)
+        assert st.get("pq") == 3
+        assert (st.get("d"), st.get("e")) == (1, 2)
+
+    def test_string_find_returns_start_and_end(self):
+        st = LuaState(
+            'i, j = string.find("banana", "nan", 1, true)\n'
+            'only = string.find("banana", "nan", 1, true)\n'
+            'sub = string.sub("banana", i, j)')
+        assert (st.get("i"), st.get("j")) == (3, 5)
+        assert st.get("only") == 3
+        assert st.get("sub") == "nan"
+
+    def test_multi_values_expand_into_final_call_args(self):
+        st = LuaState(
+            "function two() return 7, 8 end\n"
+            "function add3(a, b, c) return a + b + c end\n"
+            "s = add3(1, two())\n"        # final expands: 1, 7, 8
+            "t = add3(two(), 1, 1)")      # non-final truncates: 7, 1, 1
+        assert st.get("s") == 16
+        assert st.get("t") == 9
+
+    def test_condition_takes_first_value(self):
+        st = LuaState(
+            "function found() return 4, 6 end\n"
+            "if found() then hit = true end")
+        assert st.get("hit") is True
+
+    def test_table_constructor_expands_final_call(self):
+        st = LuaState(
+            "function two() return 8, 9 end\n"
+            "t = {1, two()}\n"
+            "u = {two(), 1}\n"
+            "tn = #t\n"
+            "un = #u\n"
+            "t3 = t[3]\n"
+            "u1 = u[1]")
+        assert st.get("tn") == 3 and st.get("t3") == 9
+        assert st.get("un") == 2 and st.get("u1") == 8
+
+    def test_scalar_positions_take_first_value(self):
+        st = LuaState(
+            "function f() return 1, 2 end\n"
+            'ok = string.find("banana", "nan", 1, true) == 3\n'
+            "s = 'x' .. f()\n"
+            "neg = -f()\n"
+            "paren_a, paren_b = (f())\n"
+            "t = {f() or 0}\n"
+            "tn = #t\n"
+            "tb = {}\n"
+            "tb[f()] = 'a'\n"
+            "keyed = {pos = f()}\n"
+            "kp = keyed.pos + 10\n"
+            "got = tb[1]")
+        assert st.get("ok") is True
+        assert st.get("s") == "x1"
+        assert st.get("neg") == -1
+        assert st.get("paren_a") == 1 and st.get("paren_b") is None
+        assert st.get("tn") == 1
+        assert st.get("got") == "a"
+        assert st.get("kp") == 11
