@@ -415,9 +415,9 @@ class _Parser:
 
         def run(env, var=var, start=start, stop=stop, step=step,
                 body=body, _MISSING=_MISSING):
-            i = start(env)
-            limit = stop(env)
-            inc = step(env) if step else 1
+            i = _first(start(env))
+            limit = _first(stop(env))
+            inc = _first(step(env)) if step else 1
             if inc == 0:
                 raise LuaError("lua: for step is zero")
             saved = env.locals.get(var, _MISSING)
@@ -456,12 +456,9 @@ class _Parser:
 
         def run(env, names=tuple(names), exprs=tuple(exprs), body=body,
                 _MISSING=_MISSING):
-            vals = [e(env) for e in exprs]
-            # a single expr may return an iterator TRIPLE (pairs/ipairs)
-            if len(vals) == 1 and isinstance(vals[0], tuple):
-                vals = list(vals[0])
-            vals += [None] * (3 - len(vals))
-            it, state, ctrl = vals[:3]
+            # standard expression-list adjustment to the (iterator,
+            # state, control) triple — pairs/ipairs expand from one expr
+            it, state, ctrl = _adjust_values([e(env) for e in exprs], 3)
             if not callable(it):
                 raise LuaError("lua: generic for needs an iterator "
                                "function (pairs/ipairs)")

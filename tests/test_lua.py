@@ -461,3 +461,18 @@ class TestStdlibExtensions:
         assert st.get("tn") == 1
         assert st.get("got") == "a"
         assert st.get("kp") == 11
+
+    def test_numeric_for_bounds_adjust_to_one_value(self):
+        st = LuaState(
+            "function f() return 1, 99 end\n"
+            "n = 0\n"
+            "for i = f(), 3 do n = n + 1 end")
+        assert st.get("n") == 3
+
+    def test_generic_for_in_list_adjustment(self):
+        st = LuaState(
+            "t = {7, 8}\n"
+            "function f() return ipairs(t) end\n"
+            "s = 0\n"
+            "for i, v in f() do s = s + v end")
+        assert st.get("s") == 15
