@@ -202,10 +202,25 @@ class Pipeline:
         for el in self.elements:
             if isinstance(el, Source):
                 el._halt()
+        stopped_any = False
         for el in self.elements:
             if el._started:
                 el.stop()
                 el._started = False
+                stopped_any = True
+        if stopped_any:
+            # the element/pad graph is cyclic, so DROPPED pipelines from
+            # earlier runs (and the buffers their sinks retained) linger
+            # until the cycle collector fires — measured ~10x throughput
+            # collapse on a live stream while gc ground through GBs of
+            # dead buffers.  Collecting at each stop boundary clears
+            # prior runs' garbage at a moment a pause is cheapest.  (The
+            # pipeline being stopped is still referenced by the caller —
+            # sink.results stays readable — so ITS payload frees at the
+            # caller's drop + a later collect.)
+            import gc
+
+            gc.collect()
 
     def run(self, timeout: Optional[float] = None) -> None:
         try:
