@@ -26,7 +26,8 @@ class TensorSink(Element):
     FACTORY = "tensor_sink"
     PROPERTIES = {
         "emit-signal": (True, "invoke new-data callbacks"),
-        "sync": (False, "no-op (no wall-clock sync yet)"),
+        "sync": (False, "render buffers at their PTS against the "
+                        "pipeline clock (real-time playback pacing)"),
         "collect": (True, "keep buffers in .results"),
         "max-results": (0, "cap on retained buffers, 0 = unlimited"),
         "qos": (False, "emit upstream QoS events when consuming slower "
@@ -40,6 +41,16 @@ class TensorSink(Element):
         self._caps: Optional[Caps] = None
         self._eos = threading.Event()
         self._qos_late = False
+        self._unblock = threading.Event()   # stop() aborts a sync wait
+
+    def start(self):
+        self._unblock.clear()
+
+    def unblock(self):
+        self._unblock.set()
+
+    def stop(self):
+        self._unblock.set()
 
     def _make_pads(self):
         self.add_sink_pad(Caps.any(), "sink")
@@ -67,6 +78,17 @@ class TensorSink(Element):
         return 0
 
     def chain(self, pad, buf):
+        if self.sync and buf.pts is not None and self.pipeline is not None:
+            # render at PTS: wait until base_time + pts on the pipeline
+            # clock (GStreamer sink sync semantics); stop() unblocks
+            base = getattr(self.pipeline, "base_time_ns", None)
+            if base is not None:
+                target = base + int(buf.pts)
+                while not self._unblock.is_set():
+                    delta = (target - time.monotonic_ns()) / 1e9
+                    if delta <= 0:
+                        break
+                    self._unblock.wait(delta)   # set() wakes immediately
         t0 = time.monotonic_ns() if self.qos else 0
         if self.collect:
             self.results.append(buf)

@@ -255,6 +255,11 @@ class Element:
     def stop(self) -> None:
         """NULL transition hook."""
 
+    def unblock(self) -> None:
+        """Pre-stop hook: release any blocking waits (sync sinks, etc.)
+        so upstream streaming threads can run to completion before the
+        teardown joins them."""
+
     # -- dataflow entries (called by pads) -----------------------------------
     def _chain_entry(self, pad: Pad, buf: TensorBuffer) -> FlowReturn:
         tracer = (self.pipeline.tracer

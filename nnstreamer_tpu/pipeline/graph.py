@@ -11,6 +11,7 @@ plays between decoupled segments.
 from __future__ import annotations
 
 import threading
+import time
 import queue as _queue
 from typing import Dict, List, Optional
 
@@ -142,6 +143,9 @@ class Pipeline:
                 raise PipelineError(el, exc) from exc
             el._started = True
         self._playing = True
+        #: running-time origin: sinks with sync=true render buffer PTS
+        #: against this (GStreamer base-time role)
+        self.base_time_ns = time.monotonic_ns()
         for el in self.elements:
             if isinstance(el, Source):
                 el._spawn()
@@ -199,6 +203,11 @@ class Pipeline:
 
     def stop(self) -> None:
         self._playing = False
+        # phase 0: release blocking waits (a sync sink's PTS wait holds
+        # the very streaming thread _halt() is about to join)
+        for el in self.elements:
+            if el._started:
+                el.unblock()
         for el in self.elements:
             if isinstance(el, Source):
                 el._halt()

@@ -464,3 +464,59 @@ class TestConcurrencyStress:
         assert rep["conv"]["buffers"] == n
         assert rep["xf"]["buffers"] == n
         assert rep["out"]["buffers"] == n
+
+
+class TestSinkSync:
+    def test_sync_paces_buffers_to_pts(self):
+        """sync=true renders at PTS against the pipeline clock: a
+        50 fps 6-frame stream takes >= 100 ms and stamps spread out."""
+        import time as _time
+
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            "videotestsrc num-buffers=6 ! "
+            "video/x-raw,format=GRAY8,width=4,height=4,framerate=50/1 ! "
+            "tensor_converter ! tensor_sink name=out sync=true")
+        stamps = []
+        p.get("out").connect("new-data",
+                             lambda b: stamps.append(_time.monotonic()))
+        t0 = _time.monotonic()
+        p.run(timeout=30)
+        wall = _time.monotonic() - t0
+        assert len(stamps) == 6
+        assert wall >= 0.1                      # 6 frames at 20 ms apart
+        gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+        assert sum(gaps) / len(gaps) >= 0.015   # paced, not a burst
+
+    def test_sync_false_runs_flat_out(self):
+        import time as _time
+
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            "videotestsrc num-buffers=6 ! "
+            "video/x-raw,format=GRAY8,width=4,height=4,framerate=2/1 ! "
+            "tensor_converter ! tensor_sink name=out")
+        t0 = _time.monotonic()
+        p.run(timeout=30)
+        assert _time.monotonic() - t0 < 1.0     # 2 fps stream, no pacing
+
+    def test_stop_unblocks_a_syncing_sink(self):
+        import threading as _threading
+        import time as _time
+
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            "videotestsrc num-buffers=3 ! "
+            "video/x-raw,format=GRAY8,width=4,height=4,framerate=1/10 ! "
+            "tensor_converter ! tensor_sink name=out sync=true")
+        p.play()
+        _time.sleep(0.3)                        # sink is mid-wait (10 s/frame)
+        t0 = _time.monotonic()
+        done = _threading.Event()
+        _threading.Thread(target=lambda: (p.stop(), done.set()),
+                          daemon=True).start()
+        assert done.wait(5), "stop() hung on a syncing sink"
+        assert _time.monotonic() - t0 < 5
