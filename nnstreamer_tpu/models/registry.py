@@ -88,6 +88,30 @@ def restore_params(template, path: str):
     return ckpt.restore(os.path.abspath(path), target=template)
 
 
+def graft_params(dst, src):
+    """Copy every ``src`` leaf into ``dst`` where the tree path AND shape
+    match; returns ``(grafted, n_copied)``.
+
+    The transfer-learning helper behind real-trunk validation: the zoo's
+    SSD/posenet heads share the MobileNetV2 trunk by flax auto-naming
+    (ConvBN_0, InvertedResidual_0.., incl. batch_stats), so grafting the
+    real ImageNet weights under an untrained head takes one call —
+    head layers differ in shape and keep their fresh init."""
+    n = 0
+    out = {}
+    for k, v in dst.items():
+        if k in src and isinstance(v, dict) and isinstance(src[k], dict):
+            out[k], m = graft_params(v, src[k])
+            n += m
+        elif (k in src and hasattr(v, "shape")
+                and getattr(src[k], "shape", None) == v.shape):
+            out[k] = src[k]
+            n += 1
+        else:
+            out[k] = v
+    return out, n
+
+
 def _ensure_loaded() -> None:
     from . import (mobilenet_v2, ssd, deeplab_v3, posenet,  # noqa: F401
                    streamformer_lm)  # noqa: F401

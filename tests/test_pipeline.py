@@ -472,8 +472,6 @@ class TestSinkSync:
         50 fps 6-frame stream takes >= 100 ms and stamps spread out."""
         import time as _time
 
-        from nnstreamer_tpu import parse_launch
-
         p = parse_launch(
             "videotestsrc num-buffers=6 ! "
             "video/x-raw,format=GRAY8,width=4,height=4,framerate=50/1 ! "
@@ -492,8 +490,6 @@ class TestSinkSync:
     def test_sync_false_runs_flat_out(self):
         import time as _time
 
-        from nnstreamer_tpu import parse_launch
-
         p = parse_launch(
             "videotestsrc num-buffers=6 ! "
             "video/x-raw,format=GRAY8,width=4,height=4,framerate=2/1 ! "
@@ -505,8 +501,6 @@ class TestSinkSync:
     def test_stop_unblocks_a_syncing_sink(self):
         import threading as _threading
         import time as _time
-
-        from nnstreamer_tpu import parse_launch
 
         p = parse_launch(
             "videotestsrc num-buffers=3 ! "

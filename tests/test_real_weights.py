@@ -106,26 +106,6 @@ class TestRealMobileNetOnXLAPath:
             import_weights("deeplab_v3", "x.tflite", "/tmp/nope")
 
 
-def _graft_matching(dst, src):
-    """Recursively copy ``src`` leaves into ``dst`` where the path AND
-    shape match — the shared MobileNetV2 trunk aligns by flax auto-naming
-    (ConvBN_0, InvertedResidual_0..16, incl. batch_stats); head layers
-    differ in shape and keep their fresh init."""
-    n = 0
-    out = {}
-    for k, v in dst.items():
-        if k in src and isinstance(v, dict) and isinstance(src[k], dict):
-            out[k], m = _graft_matching(v, src[k])
-            n += m
-        elif (k in src and hasattr(v, "shape")
-                and getattr(src[k], "shape", None) == v.shape):
-            out[k] = src[k]
-            n += 1
-        else:
-            out[k] = v
-    return out, n
-
-
 @needs_ref
 class TestRealTrunkDecodeScales:
     """Box/keypoint decode against REAL-graph activation scales: the real
@@ -136,13 +116,14 @@ class TestRealTrunkDecodeScales:
 
     def _grafted_ckpt(self, tmp_path, mobilenet_ckpt, model_name):
         from nnstreamer_tpu.models.registry import (get_model,
+                                                    graft_params,
                                                     restore_params,
                                                     save_checkpoint)
 
         mnet = get_model("mobilenet_v2", {"seed": "0", "dtype": "float32"})
         real = restore_params(mnet.params, mobilenet_ckpt)
         tgt = get_model(model_name, {"seed": "0", "dtype": "float32"})
-        grafted, n = _graft_matching(tgt.params, real)
+        grafted, n = graft_params(tgt.params, real)
         assert n > 100, f"trunk graft only matched {n} leaves"
         tgt.params = grafted
         out = str(tmp_path / f"{model_name}_graft")
