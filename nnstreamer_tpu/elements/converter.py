@@ -23,7 +23,7 @@ import numpy as np
 from ..pipeline.caps import Caps, Structure
 from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
-from ..tensor.buffer import TensorBuffer, frames_to_ns
+from ..tensor.buffer import TensorBuffer, frames_to_ns, is_device_array
 from ..tensor.caps_util import caps_from_config, flexible_tensors_caps
 from ..tensor.info import TensorInfo, TensorsConfig, TensorsInfo
 from ..tensor.meta import TensorMetaInfo
@@ -214,17 +214,30 @@ class TensorConverter(Element):
         raise RuntimeError(f"no caps negotiated on {self.name}")
 
     def _chain_video(self, buf: TensorBuffer) -> FlowReturn:
-        frame = buf.np(0)
         fpt = int(self.frames_per_tensor)
+        # (h,w,c) video IS the tensor layout: pass the payload handle
+        # through untouched -- a device-resident frame (HBM handle from
+        # ``videotestsrc device-cache``) must NOT be synced to host here,
+        # that's the whole point of the device path
+        frame = buf.tensors[0] if is_device_array(buf.tensors[0]) \
+            else buf.np(0)
         if fpt == 1:
             return self.push(buf.with_tensors([frame]))
-        # accumulate frames → one tensor of dims (c,w,h,fpt)
+        # accumulate frames → one tensor of dims (c,w,h,fpt); device
+        # payloads accumulate as handles and stack ON DEVICE, keeping the
+        # zero-h2d property of the device path for frames-per-tensor > 1
         self._pending.append(frame)
         if self._pending_pts is None:
             self._pending_pts = buf.pts
         if len(self._pending) < fpt:
             return FlowReturn.OK
-        stacked = np.stack(self._pending, axis=0)  # (fpt,h,w,c)
+        if all(is_device_array(f) for f in self._pending):
+            import jax.numpy as jnp
+
+            stacked = jnp.stack(self._pending, axis=0)  # (fpt,h,w,c)
+        else:
+            stacked = np.stack([np.asarray(f) for f in self._pending],
+                               axis=0)  # (fpt,h,w,c)
         self._pending = []
         out = TensorBuffer(tensors=[stacked], pts=self._pending_pts,
                            duration=(buf.duration or 0) * fpt)

@@ -49,6 +49,13 @@ class VideoTestSrc(Source):
         "cache-frames": (0, "pre-render N distinct frames and cycle them "
                             "(0 = render every frame); removes source "
                             "render cost from throughput measurements"),
+        "device-cache": (0, "pre-render N distinct frames, stage them to "
+                            "the default jax device ONCE at start, and "
+                            "cycle the device-resident handles; downstream "
+                            "device consumers (tensor_filter) then see "
+                            "zero host->device traffic per frame -- the "
+                            "TPU-native source mode (frames live in HBM "
+                            "for their whole pipeline life)"),
     }
 
     def _make_pads(self):
@@ -90,8 +97,20 @@ class VideoTestSrc(Source):
         n = int(self.num_buffers)
         if n >= 0 and self._count >= n:
             return None
-        k = int(self.cache_frames)
-        if k > 0:
+        kd, k = int(self.device_cache), int(self.cache_frames)
+        if kd > 0:
+            if self._cache is None:
+                # one device_put per distinct frame, ONCE -- after this the
+                # source emits existing HBM handles (no per-frame device op,
+                # no per-frame host render, no h2d in the steady state;
+                # jax arrays are immutable, so no freeze needed)
+                import jax
+
+                dev = jax.devices()[0]
+                self._cache = [jax.device_put(self._render(i), dev)
+                               for i in range(kd)]
+            frame = self._cache[self._count % kd]
+        elif k > 0:
             if self._cache is None:
                 self._cache = []
                 for i in range(k):

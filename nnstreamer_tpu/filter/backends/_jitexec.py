@@ -16,6 +16,7 @@ from typing import Any, List
 
 import numpy as np
 
+from ...tensor.buffer import is_device_array
 from ..framework import Accelerator, FilterError, start_output_transfers
 
 
@@ -184,9 +185,46 @@ class JitExecMixin:
         return jax.devices()[0]
 
     # -- hot path ------------------------------------------------------------
+    def _ensure_device(self, x):
+        """Re-commit a device array pinned to a DIFFERENT device onto this
+        backend's device (no-op in the common case; a jitted call rejects
+        mixed-device arguments, e.g. ``videotestsrc device-cache`` staging
+        to the TPU while the filter runs ``accelerator=true:cpu``).  Moves
+        are memoized by handle identity — sources cycle a small fixed set
+        of cached frames, so a pinning mismatch costs one copy per distinct
+        handle, not one per frame — and warned about once: a cross-device
+        hop per distinct frame defeats the device-resident fast path."""
+        if is_device_array(x):
+            devs = getattr(x, "devices", None)
+            if devs is not None and self._device not in devs():
+                cache = getattr(self, "_xdev_cache", None)
+                if cache is None:
+                    cache = self._xdev_cache = {}
+                    from ...utils.log import ml_logw
+
+                    ml_logw(
+                        "input pinned to %s but filter runs on %s: "
+                        "re-committing (device-resident fast path degraded "
+                        "to cross-device copies)", devs(), self._device)
+                hit = cache.get(id(x))
+                if hit is not None and hit[0]() is x:  # id-reuse guard
+                    return hit[1]
+                import weakref
+
+                import jax
+
+                moved = jax.device_put(x, self._device)
+                if len(cache) < 1024:   # bound: sources cycle small sets
+                    key = id(x)
+                    ref = weakref.ref(x, lambda _, k=key: cache.pop(k, None))
+                    cache[key] = (ref, moved)
+                return moved
+        return x
+
     def _invoke_device(self, inputs: List[Any]):
         import jax
 
+        inputs = [self._ensure_device(x) for x in inputs]
         with jax.default_device(self._device):
             return self._jitted(self._params_dev, *inputs)
 
@@ -216,10 +254,21 @@ class JitExecMixin:
             return _FlushHandle(outs)
         stacked = []
         for k in range(len(frames[0])):
-            arrs = [np.asarray(f[k]) for f in frames]
+            arrs = [f[k] for f in frames]
+            on_device = all(map(is_device_array, arrs))
+            if not on_device:
+                arrs = [np.asarray(a) for a in arrs]
             if n < bucket:
                 arrs = arrs + [arrs[-1]] * (bucket - n)
-            stacked.append(np.stack(arrs))
+            if on_device:
+                # device-resident inputs (HBM handles from an upstream
+                # device source or filter): stack ON DEVICE -- one tiny
+                # dispatch instead of a d2h sync + full h2d re-upload
+                import jax.numpy as jnp
+
+                stacked.append(self._ensure_device(jnp.stack(arrs)))
+            else:
+                stacked.append(np.stack(arrs))
         t0 = time.monotonic_ns()
         outs = self._dispatch_batched(stacked)
         self.stats.record(time.monotonic_ns() - t0)
