@@ -21,6 +21,7 @@ one-frame-per-dispatch streaming bound.
 
 Usage:
   python bench.py                      # flagship (config 1), TPU
+  python bench.py --config resident    # flagship w/ HBM-resident frames
   python bench.py --config ssd         # SSD-MobileNetV2 + bounding_boxes
   python bench.py --config deeplab     # DeepLabV3 + image_segment
   python bench.py --config posenet     # PoseNet + pose_estimation
@@ -62,12 +63,18 @@ PEAK_BW = {"v5e": 819e9, "v5litepod": 819e9, "v5p": 2765e9,
 
 CONFIG_METRICS = {
     "mobilenet": "mobilenet_v2_224_image_labeling_e2e_fps",
+    "resident": "mobilenet_v2_224_device_resident_e2e_fps",
     "ssd": "ssd_mobilenet_v2_300_bounding_boxes_e2e_fps",
     "deeplab": "deeplab_v3_257_image_segment_e2e_fps",
     "posenet": "posenet_257_pose_estimation_e2e_fps",
     "edge": "mobilenet_v2_edge_distributed_e2e_fps",
     "lm": "streamformer_lm_serving",
 }
+
+#: per-config input frame edge length (used to scale the frame count to
+#: the measured host->device link so two runs fit the deadline)
+CONFIG_SIZE = {"mobilenet": 224, "resident": 224, "ssd": 300,
+               "deeplab": 257, "posenet": 257, "edge": 224}
 
 
 class _ExtrasTimeout(BaseException):
@@ -147,11 +154,13 @@ def _measure(pipeline, sink_name: str, timeout: float = 1200,
 
 
 def _model_pipeline(model: str, size: int, decoder: str, dtype_prop: str,
-                    decoder_opts: str = "") -> str:
+                    decoder_opts: str = "", src_cache: str = "cache-frames",
+                    n_frames: int = 0) -> str:
     from nnstreamer_tpu import parse_launch
 
     return parse_launch(
-        f"videotestsrc num-buffers={N_FRAMES} pattern=random cache-frames=64 ! "
+        f"videotestsrc num-buffers={n_frames or N_FRAMES} pattern=random "
+        f"{src_cache}=64 ! "
         f"video/x-raw,format=RGB,width={size},height={size},"
         "framerate=120/1 ! "
         "tensor_converter ! "
@@ -162,6 +171,53 @@ def _model_pipeline(model: str, size: int, decoder: str, dtype_prop: str,
         f"queue max-size-buffers={max(8, 2 * STREAM_BATCH)} ! "
         f"tensor_decoder mode={decoder} {decoder_opts} ! "
         "tensor_sink name=out")
+
+
+def _probe_link(device) -> dict:
+    """Quick host->device link profile: dispatch RTT (tiny op round trip)
+    and h2d bandwidth (one 4 MiB device_put).  On a tunneled chip these,
+    not the chip, bound the streaming path — stamping them into every
+    result row lets a capture be judged against the link it ran on."""
+    import jax
+
+    out = {}
+    try:
+        one = jax.device_put(np.float32(1.0), device)
+        f = jax.jit(lambda x: x + 1.0)
+        float(f(one))  # warm compile
+        rtts = []
+        for _ in range(10):
+            t0 = time.monotonic()
+            float(f(one))
+            rtts.append(time.monotonic() - t0)
+        rtts.sort()
+        out["link_rtt_ms"] = round(rtts[len(rtts) // 2] * 1e3, 2)
+        payload = np.random.default_rng(0).integers(
+            0, 255, 4 << 20, dtype=np.uint8)
+        t0 = time.monotonic()
+        jax.device_put(payload, device).block_until_ready()
+        out["link_h2d_MBps"] = round(4.0 / (time.monotonic() - t0), 2)
+    except Exception:
+        pass
+    return out
+
+
+def _auto_frames(size: int, link: dict, deadline: float) -> int:
+    """Scale the frame count so TWO full streaming runs (plus compile and
+    p50 probe) fit the per-attempt deadline on the MEASURED link.  On a
+    fast link this returns the 1920-frame default; on a ~1 MB/s tunnel
+    window it shrinks toward the floor so the stability pass still
+    happens (a run1-only row is worth less than two shorter runs)."""
+    bw = link.get("link_h2d_MBps", 0.0)
+    if bw <= 0:
+        return N_FRAMES
+    frame_mb = size * size * 3 / 1e6
+    usable_per_run = max((deadline - 150.0) / 2.5, 30.0)
+    fit = int(bw * usable_per_run / frame_mb)
+    fit = (fit // STREAM_BATCH) * STREAM_BATCH
+    # cap at the configured default (which itself scales with the
+    # micro-batch so sweep runs keep >= 30 batches)
+    return int(min(max(fit, 4 * STREAM_BATCH), N_FRAMES))
 
 
 def _invoke_p50(fw, size: int) -> float:
@@ -237,7 +293,13 @@ def _batched_profile(model, device, size: int, batch: int = BATCH):
     frames = jax.device_put(frames, device)
     compiled = jax.jit(batched).lower(params, frames).compile()
     jax.block_until_ready(compiled(params, frames))  # warm
-    reps, t0 = 5, time.monotonic()
+    # pick reps so the timed window is ~2s: on a tunneled chip a handful
+    # of reps is all dispatch RTT and wildly understates the executable
+    t0 = time.monotonic()
+    jax.block_until_ready(compiled(params, frames))
+    once = max(time.monotonic() - t0, 1e-4)
+    reps = int(min(max(2.0 / once, 5), 50))
+    t0 = time.monotonic()
     for _ in range(reps):
         out = compiled(params, frames)
     jax.block_until_ready(out)
@@ -252,8 +314,10 @@ def _batched_profile(model, device, size: int, batch: int = BATCH):
 
 def bench_model(name: str, model_name: str, size: int, decoder: str,
                 dtype_prop: str, decoder_opts: str = "",
-                emit=None) -> dict:
-    p = _model_pipeline(model_name, size, decoder, dtype_prop, decoder_opts)
+                emit=None, src_cache: str = "cache-frames",
+                n_frames: int = 0) -> dict:
+    p = _model_pipeline(model_name, size, decoder, dtype_prop, decoder_opts,
+                        src_cache, n_frames)
     try:
         fps1, n = _measure(p, "out")
     finally:
@@ -269,7 +333,8 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
     # stability pass: a second full pipeline run (fresh elements, warm
     # XLA compile cache) — round-2's number swung 1.9x between runs, so
     # both runs are recorded and the SLOWER one is the headline value
-    p = _model_pipeline(model_name, size, decoder, dtype_prop, decoder_opts)
+    p = _model_pipeline(model_name, size, decoder, dtype_prop, decoder_opts,
+                        src_cache, n_frames)
     try:
         fps2, _ = _measure(p, "out")
         fps = min(fps1, fps2)
@@ -521,12 +586,30 @@ def run_child(config: str) -> dict:
         # deadline (the TPU frame count stays the measured default)
         N_FRAMES = 200
 
+    link = _probe_link(device) if on_tpu else {}
+    if (on_tpu and config in CONFIG_SIZE and config != "resident"
+            and "NNS_TPU_BENCH_FRAMES" not in os.environ):
+        # frames cross the tunnel once each: fit two runs to the link
+        # (the device-resident config pays no per-frame link bytes and
+        # keeps the full default count)
+        N_FRAMES = _auto_frames(CONFIG_SIZE[config], link, _CHILD_DEADLINE)
+
     def emit(core: dict) -> None:
-        print(json.dumps(dict(core, device=str(device))), flush=True)
+        print(json.dumps(dict(core, device=str(device), **link)),
+              flush=True)
 
     if config == "mobilenet":
         result = bench_model(CONFIG_METRICS[config], "mobilenet_v2", 224,
                              "image_labeling", dtype_prop, emit=emit)
+    elif config == "resident":
+        # device-resident streaming: frames are staged to HBM once by the
+        # source and cycle as handles; per-frame link traffic is zero, so
+        # this measures the pipeline machinery + dispatch + device compute
+        # (what the flagship config would do on LOCAL hardware, where the
+        # PCIe link doesn't gate it)
+        result = bench_model(CONFIG_METRICS[config], "mobilenet_v2", 224,
+                             "image_labeling", dtype_prop, emit=emit,
+                             src_cache="device-cache")
     elif config == "ssd":
         from nnstreamer_tpu.models.registry import get_model
 
@@ -550,6 +633,7 @@ def run_child(config: str) -> dict:
     else:
         result = bench_edge(dtype_prop)
     result["device"] = str(device)
+    result.update(link)
     return result
 
 

@@ -12,7 +12,7 @@
 #     2. one-time proofs, in priority order, first green wins:
 #          flash_tpu_bench.py   -> flash.json   (Pallas kernel on real TPU)
 #          tflite_int8_tpu_bench.py -> int8.json
-#          bench.py --all       -> all.jsonl    (six configs)
+#          bench.py --all       -> all.jsonl    (seven configs)
 #          bench.py --sweep-batch 32,64,128,256 -> sweep.jsonl
 #     3. flagship recapture IF this window's h2d bandwidth beats the
 #        best window so far by >1.25x (the streaming number is
@@ -86,10 +86,10 @@ while :; do
       && log "int8 proof GREEN" || log "int8 proof failed"
   fi
   if [ ! -f "$STAGE/all.jsonl" ] || ! all_green "$STAGE/all.jsonl"; then
-    log "six-config --all..."
-    timeout 5400 python bench.py --all --deadline 780 > "$STAGE/all.jsonl" 2>"$STAGE/all.err"
+    log "seven-config --all..."
+    timeout 9000 python bench.py --all --deadline 780 > "$STAGE/all.jsonl" 2>"$STAGE/all.err"
     all_green "$STAGE/all.jsonl" && cp "$STAGE/all.jsonl" BENCH_all_r04.json \
-      && log "--all GREEN (all six)" || {
+      && log "--all GREEN (all seven)" || {
         log "--all partial"; cp "$STAGE/all.jsonl" BENCH_all_r04.json; }
   fi
   if [ ! -f "$STAGE/sweep.jsonl" ] || ! all_green "$STAGE/sweep.jsonl"; then

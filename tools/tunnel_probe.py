@@ -102,9 +102,9 @@ def probe(reps_rtt: int = 30, sizes_mib=(1, 4, 16)) -> dict:
     for _ in range(reps):
         r = chain(a)
     r.block_until_ready()
-    dt = time.monotonic() - t0
+    elapsed = time.monotonic() - t0
     flops = reps * 8 * 2 * n**3
-    out["device_matmul_tflops"] = round(flops / dt / 1e12, 2)
+    out["device_matmul_tflops"] = round(flops / elapsed / 1e12, 2)
 
     # implied streaming ceiling for the flagship (u8 224x224x3 frames)
     frame_bytes = 224 * 224 * 3
