@@ -55,6 +55,13 @@ class TensorFilter(Element):
         "latency-report": (False, "report invoke latency"),
         "batch": (1, "micro-batch N frames into one device invoke "
                      "(latency/throughput trade; backend-gated)"),
+        "output-device": (False, "emit device-resident outputs (BatchView/"
+                                 "jax.Array payloads): a downstream batched "
+                                 "filter consumes them without any host "
+                                 "round trip — cascade intermediates never "
+                                 "leave HBM.  Host consumers (decoders, "
+                                 "sinks) still work: they materialize one "
+                                 "d2h per batch on first touch"),
     }
 
     def _make_pads(self):
@@ -94,6 +101,15 @@ class TensorFilter(Element):
         if self._batch > 1 and not getattr(self.fw, "SUPPORTS_BATCHING",
                                            False):
             self._batch = 1
+        self._emit_device = bool(self.output_device)
+        if self._emit_device and not getattr(self.fw, "SUPPORTS_BATCHING",
+                                             False):
+            from ..utils.log import ml_logw
+
+            ml_logw("%s: output-device requested but backend %s has no "
+                    "device execution engine; emitting host tensors",
+                    self.name, self._props.framework)
+            self._emit_device = False
         self._pending: list = []        # per-frame input lists, collecting
         self._pending_bufs: list = []
         self._inflight = None           # (bufs, handle) dispatched batch
@@ -175,7 +191,10 @@ class TensorFilter(Element):
             if len(self._pending) >= self._batch:
                 return self._dispatch_pending()
             return FlowReturn.OK
-        outs = fw.invoke(list(tensors))
+        if self._emit_device:
+            outs = fw.invoke(list(tensors), emit_device=True)
+        else:
+            outs = fw.invoke(list(tensors))
         return self._push_result(buf, outs)
 
     def _push_result(self, buf: TensorBuffer, outs) -> FlowReturn:
@@ -190,7 +209,11 @@ class TensorFilter(Element):
     def _dispatch_pending(self) -> FlowReturn:
         """Dispatch the collecting batch, then push the PREVIOUS batch's
         results (its d2h copies overlapped this batch's collection)."""
-        handle = self.fw.invoke_batched(self._pending, self._batch)
+        if self._emit_device:
+            handle = self.fw.invoke_batched(self._pending, self._batch,
+                                            emit_device=True)
+        else:
+            handle = self.fw.invoke_batched(self._pending, self._batch)
         prev, self._inflight = self._inflight, (self._pending_bufs, handle)
         self._pending, self._pending_bufs = [], []
         if prev is not None:
@@ -199,8 +222,9 @@ class TensorFilter(Element):
 
     def _push_inflight(self, inflight) -> FlowReturn:
         bufs, handle = inflight
+        per_frame = handle.views() if self._emit_device else handle.wait()
         ret = FlowReturn.OK
-        for buf, outs in zip(bufs, handle.wait()):
+        for buf, outs in zip(bufs, per_frame):
             r = self._push_result(buf, list(outs))
             if r is FlowReturn.ERROR:
                 return r

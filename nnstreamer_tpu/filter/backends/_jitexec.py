@@ -16,7 +16,7 @@ from typing import Any, List
 
 import numpy as np
 
-from ...tensor.buffer import is_device_array
+from ...tensor.buffer import BatchView, is_device_array
 from ..framework import Accelerator, FilterError, start_output_transfers
 
 
@@ -72,7 +72,9 @@ class BatchHandle:
 
     ``wait()`` materializes each batched output on host ONCE (the async
     copies were started at dispatch) and hands back zero-copy numpy views
-    per frame.
+    per frame.  ``views()`` instead hands back device-resident
+    :class:`BatchView` handles — nothing crosses to host; a downstream
+    batched filter consumes the underlying arrays directly (cascade mode).
     """
 
     def __init__(self, outs, n: int) -> None:
@@ -83,10 +85,16 @@ class BatchHandle:
         mats = [np.asarray(o) for o in self._outs]
         return [[m[i] for m in mats] for i in range(self._n)]
 
+    def views(self) -> List[List[BatchView]]:
+        caches = [{} for _ in self._outs]
+        return [[BatchView(o, i, c) for o, c in zip(self._outs, caches)]
+                for i in range(self._n)]
+
 
 class _FlushHandle:
     """Tiny-tail twin of :class:`BatchHandle`: per-frame device outputs
-    (the unbatched executable), same wait() contract."""
+    (the unbatched executable), same wait()/views() contract (per-frame
+    device arrays are already valid device-resident payloads)."""
 
     def __init__(self, per_frame_outs) -> None:
         self._outs = per_frame_outs
@@ -94,11 +102,15 @@ class _FlushHandle:
     def wait(self) -> List[List[np.ndarray]]:
         return [[np.asarray(o) for o in frame] for frame in self._outs]
 
+    def views(self):
+        return [list(frame) for frame in self._outs]
+
 
 class CastingHandle:
     """Wraps a :class:`BatchHandle`, applying per-output host dtype casts
     at wait() (declared-int64 outputs come back int32 when jax x64 is
-    off)."""
+    off).  ``views()`` falls back to host materialization — a cast that
+    jax cannot represent has no device-resident form."""
 
     def __init__(self, inner: BatchHandle, casts) -> None:
         self._inner = inner
@@ -108,6 +120,9 @@ class CastingHandle:
         return [[o if c is None else np.asarray(o).astype(c)
                  for o, c in zip(frame, self._casts)]
                 for frame in self._inner.wait()]
+
+    def views(self):
+        return self.wait()
 
 
 class JitExecMixin:
@@ -224,18 +239,22 @@ class JitExecMixin:
     def _invoke_device(self, inputs: List[Any]):
         import jax
 
+        inputs = [x.device_slice() if isinstance(x, BatchView) else x
+                  for x in inputs]
         inputs = [self._ensure_device(x) for x in inputs]
         with jax.default_device(self._device):
             return self._jitted(self._params_dev, *inputs)
 
-    def invoke(self, inputs: List[Any]) -> List[Any]:
+    def invoke(self, inputs: List[Any],
+               emit_device: bool = False) -> List[Any]:
         t0 = time.monotonic_ns()
         outs = self._invoke_device(inputs)
-        start_output_transfers(outs)
+        if not emit_device:
+            start_output_transfers(outs)
         self.stats.record(time.monotonic_ns() - t0)
         return list(outs)
 
-    def invoke_batched(self, frames, bucket: int):
+    def invoke_batched(self, frames, bucket: int, emit_device: bool = False):
         """One h2d stage + one dispatch + one d2h stream for up to
         ``bucket`` frames: the per-dispatch RTT is paid once per batch
         instead of once per frame.  Short batches are padded by repeating
@@ -243,38 +262,77 @@ class JitExecMixin:
         shape ever compiles — EXCEPT tiny flush tails (EOS /
         renegotiation drains, ≤ bucket/8 frames), which dispatch
         per-frame through the already-compiled unbatched executable:
-        a 1-frame flush at bucket=64 would otherwise burn 64× the FLOPs."""
+        a 1-frame flush at bucket=64 would otherwise burn 64× the FLOPs.
+
+        ``emit_device=True`` (cascade mode): outputs stay in HBM and the
+        returned handle's ``views()`` hands out :class:`BatchView`
+        payloads instead of host arrays — no d2h copies are started."""
         n = len(frames)
         if 8 * n <= bucket:
             t0 = time.monotonic_ns()
             outs = [self._invoke_device(list(f)) for f in frames]
-            for o in outs:
-                start_output_transfers(o)
+            if not emit_device:
+                for o in outs:
+                    start_output_transfers(o)
             self.stats.record(time.monotonic_ns() - t0)
             return _FlushHandle(outs)
-        stacked = []
-        for k in range(len(frames[0])):
-            arrs = [f[k] for f in frames]
-            on_device = all(map(is_device_array, arrs))
-            if not on_device:
-                arrs = [np.asarray(a) for a in arrs]
-            if n < bucket:
-                arrs = arrs + [arrs[-1]] * (bucket - n)
-            if on_device:
-                # device-resident inputs (HBM handles from an upstream
-                # device source or filter): stack ON DEVICE -- one tiny
-                # dispatch instead of a d2h sync + full h2d re-upload
-                import jax.numpy as jnp
-
-                stacked.append(self._ensure_device(jnp.stack(arrs)))
-            else:
-                stacked.append(np.stack(arrs))
+        stacked = [self._stage_batch([f[k] for f in frames], bucket)
+                   for k in range(len(frames[0]))]
         t0 = time.monotonic_ns()
-        outs = self._dispatch_batched(stacked)
+        outs = self._dispatch_batched(stacked, emit_device=emit_device)
         self.stats.record(time.monotonic_ns() - t0)
         return BatchHandle(list(outs), n)
 
-    def _dispatch_batched(self, stacked):
+    def _stage_batch(self, arrs, bucket: int):
+        """One input's frames → one ``(bucket, …)`` batch array.
+
+        Cascade fast path: contiguous :class:`BatchView` runs over shared
+        underlying arrays are re-joined with at most one device op per run
+        (zero when one upstream batch maps 1:1) — an A→B filter cascade at
+        equal batch sizes moves NO tensor bytes and dispatches NO per-frame
+        ops between the two executables.  Device arrays stack on device;
+        host arrays stack on host (the h2d rides the dispatch)."""
+        n = len(arrs)
+        if not all(map(is_device_array, arrs)):
+            arrs = [np.asarray(a) for a in arrs]
+            if n < bucket:
+                arrs = arrs + [arrs[-1]] * (bucket - n)
+            return np.stack(arrs)
+        import jax.numpy as jnp
+
+        if all(isinstance(a, BatchView) for a in arrs):
+            # group consecutive rows of the same underlying batch
+            segs, i = [], 0
+            while i < n:
+                v, j = arrs[i], i + 1
+                while (j < n and arrs[j].batch is v.batch
+                       and arrs[j].index == arrs[j - 1].index + 1):
+                    j += 1
+                segs.append((v.batch, v.index, arrs[j - 1].index + 1))
+                i = j
+            b0, lo, _hi = segs[0]
+            if len(segs) == 1 and lo == 0 and b0.shape[0] == bucket:
+                # 1:1 with the upstream batch (padding rows included —
+                # upstream pads by repeating its last frame, exactly this
+                # stage's own padding policy): feed it straight through
+                return self._ensure_device(b0)
+            parts = [b[lo:hi] for b, lo, hi in segs]
+            if n < bucket:
+                last = segs[-1]
+                pad = last[0][last[2] - 1:last[2]]
+                parts.append(jnp.broadcast_to(
+                    pad, (bucket - n,) + tuple(pad.shape[1:])))
+            return self._ensure_device(jnp.concatenate(parts, axis=0))
+        # plain device arrays (device source / flush-tail outputs):
+        # stack ON DEVICE -- one tiny dispatch instead of a d2h sync +
+        # full h2d re-upload
+        arrs = [a.device_slice() if isinstance(a, BatchView) else a
+                for a in arrs]
+        if n < bucket:
+            arrs = arrs + [arrs[-1]] * (bucket - n)
+        return self._ensure_device(jnp.stack(arrs))
+
+    def _dispatch_batched(self, stacked, emit_device: bool = False):
         import jax
 
         if self._vjit is None:
@@ -283,7 +341,8 @@ class JitExecMixin:
                                           in_axes=(None,) + (0,) * n_in))
         with jax.default_device(self._device):
             outs = self._vjit(self._params_dev, *stacked)
-        start_output_transfers(outs)
+        if not emit_device:
+            start_output_transfers(outs)
         return outs
 
     def warmup_batched(self, bucket: int) -> None:

@@ -193,18 +193,21 @@ class PyTorchFilter(JitExecMixin, FilterFramework):
             outs = [out]
         return [o.detach().cpu().numpy() for o in outs]
 
-    def invoke(self, inputs: List[Any]) -> List[Any]:
+    def invoke(self, inputs: List[Any],
+               emit_device: bool = False) -> List[Any]:
         if self.executor == "xla":
-            return JitExecMixin.invoke(self, inputs)
+            return JitExecMixin.invoke(self, inputs,
+                                       emit_device=emit_device)
         t0 = time.monotonic_ns()
         outs = self._run_torch([np.asarray(x) for x in inputs])
         self.stats.record(time.monotonic_ns() - t0)
         return outs
 
-    def invoke_batched(self, frames, bucket: int):
+    def invoke_batched(self, frames, bucket: int, emit_device: bool = False):
         if self.executor != "xla":
             raise FilterError("pytorch: host executor has no batched path")
-        return JitExecMixin.invoke_batched(self, frames, bucket)
+        return JitExecMixin.invoke_batched(self, frames, bucket,
+                                           emit_device=emit_device)
 
     def warmup_batched(self, bucket: int) -> None:
         if self.executor == "xla":

@@ -790,15 +790,16 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
         super().close()
 
     # -- hot path: host preprocessing (DecodeWav hoist) ----------------------
-    def invoke(self, inputs):
+    def invoke(self, inputs, emit_device: bool = False):
         if self._host_pre is not None:
             inputs = self._host_pre(inputs)
-        return super().invoke(inputs)
+        return super().invoke(inputs, emit_device=emit_device)
 
-    def invoke_batched(self, frames, bucket: int):
+    def invoke_batched(self, frames, bucket: int, emit_device: bool = False):
         if self._host_pre is not None:
             frames = [self._host_pre(f) for f in frames]
-        return super().invoke_batched(frames, bucket)
+        return super().invoke_batched(frames, bucket,
+                                      emit_device=emit_device)
 
     def warmup_batched(self, bucket: int) -> None:
         if self._host_pre is None:

@@ -998,16 +998,23 @@ class TFLiteFilter(JitExecMixin, FilterFramework):
                 TensorsInfo([self._spec_info(i) for i in self._graph.outputs]))
 
     # -- hot path ------------------------------------------------------------
-    def invoke(self, inputs: List[Any]) -> List[Any]:
-        outs = JitExecMixin.invoke(self, inputs)
+    def invoke(self, inputs: List[Any],
+               emit_device: bool = False) -> List[Any]:
+        outs = JitExecMixin.invoke(self, inputs, emit_device=emit_device)
         for i, cast in enumerate(self._out_casts):
             if cast is not None:
+                # no device-resident form for this dtype: host-cast even
+                # in cascade mode (downstream np-materializes anyway)
                 outs[i] = np.asarray(outs[i]).astype(cast)
         return outs
 
-    def invoke_batched(self, frames, bucket: int):
-        handle = JitExecMixin.invoke_batched(self, frames, bucket)
-        if any(c is not None for c in self._out_casts):
+    def invoke_batched(self, frames, bucket: int, emit_device: bool = False):
+        casting = any(c is not None for c in self._out_casts)
+        # a host-side cast means views() must materialize anyway: keep the
+        # async d2h overlap by dispatching in host mode
+        handle = JitExecMixin.invoke_batched(
+            self, frames, bucket, emit_device=emit_device and not casting)
+        if casting:
             return CastingHandle(handle, self._out_casts)
         return handle
 

@@ -169,13 +169,16 @@ class FilterFramework:
     def invoke(self, inputs: List[Any]) -> List[Any]:
         raise NotImplementedError
 
-    def invoke_batched(self, frames: List[List[Any]], bucket: int):
+    def invoke_batched(self, frames: List[List[Any]], bucket: int,
+                       emit_device: bool = False):
         """Dispatch ONE device invocation covering ``len(frames)`` frames
         (each a per-frame input list), padded up to the fixed ``bucket``
         batch size so steady state uses a single compiled executable.
 
         Returns a handle with ``wait() -> List[List[np.ndarray]]`` (one
-        output list per input frame, padding sliced away).  The dispatch
+        output list per input frame, padding sliced away) and ``views()``
+        (``emit_device=True``: device-resident per-frame payloads, no d2h
+        started — cascade mode).  The dispatch
         itself must not block on device completion — tensor_filter
         double-buffers: it only ``wait()``s a batch after the NEXT one has
         been dispatched, so h2d/compute/d2h of consecutive batches overlap.

@@ -27,10 +27,82 @@ CLOCK_TIME_NONE: Optional[int] = None
 
 
 def is_device_array(x: Any) -> bool:
-    """True when ``x`` is a jax.Array (device-resident handle)."""
+    """True when ``x`` is a jax.Array (device-resident handle) or a
+    :class:`BatchView` into one."""
     # Avoid importing jax at module import time for host-only tooling.
     cls = x.__class__
-    return cls.__module__.startswith("jax") or hasattr(x, "addressable_shards")
+    return (cls.__module__.startswith("jax")
+            or hasattr(x, "addressable_shards")
+            or isinstance(x, BatchView))
+
+
+class BatchView:
+    """Zero-copy per-frame view into a batched device array.
+
+    Net-new TPU-native concept (no reference counterpart; the closest
+    discipline is the zero-copy GstMemory mapping of tensor_filter.c:
+    631-894): a batched ``tensor_filter`` invoke produces ONE device array
+    of shape ``(bucket, *frame_shape)`` per output.  Instead of syncing it
+    to host and slicing into numpy rows, the filter can emit one BatchView
+    per frame — the batch stays in HBM, and:
+
+    - a DOWNSTREAM device consumer (another batched filter) recognizes
+      contiguous views over the same underlying array and feeds the batch
+      straight back into its own executable — the cascade's intermediate
+      tensors never leave the device, and no per-frame device ops run;
+    - a host consumer (decoder/sink/numpy code) triggers ``__array__``,
+      which materializes the WHOLE underlying batch once (one d2h per
+      batch, cached and shared by all sibling views) and returns its row.
+
+    Views are immutable handles; ``shape``/``dtype``/``nbytes`` describe
+    the single frame, not the batch.
+    """
+
+    __slots__ = ("batch", "index", "_cache")
+
+    def __init__(self, batch: Any, index: int, cache: dict) -> None:
+        self.batch = batch      # jax.Array, shape (bucket, *frame_shape)
+        self.index = int(index)
+        self._cache = cache     # shared per underlying array: {"host": np}
+
+    @property
+    def shape(self):
+        return tuple(self.batch.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.batch.dtype
+
+    @property
+    def nbytes(self) -> int:
+        n = int(np.dtype(self.batch.dtype).itemsize)
+        for d in self.batch.shape[1:]:
+            n *= int(d)
+        return n
+
+    def device_slice(self):
+        """This frame as its own device array (dispatches one slice op —
+        the slow path; batch-aware consumers use ``batch`` directly)."""
+        return self.batch[self.index]
+
+    def _host_batch(self) -> np.ndarray:
+        host = self._cache.get("host")
+        if host is None:
+            host = self._cache["host"] = np.asarray(self.batch)
+        return host
+
+    def __array__(self, dtype=None, copy=None):
+        row = self._host_batch()[self.index]
+        if dtype is not None and row.dtype != np.dtype(dtype):
+            return row.astype(dtype)
+        # always hand out an independent row: the host batch is SHARED by
+        # sibling views, and consumers may mutate what they np.asarray'd
+        # (jax.Array.__array__ gives the same independence guarantee)
+        return row.copy()
+
+    def __repr__(self) -> str:
+        return (f"BatchView(row {self.index} of "
+                f"{tuple(self.batch.shape)} {self.batch.dtype})")
 
 
 @dataclasses.dataclass
