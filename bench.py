@@ -68,13 +68,14 @@ CONFIG_METRICS = {
     "deeplab": "deeplab_v3_257_image_segment_e2e_fps",
     "posenet": "posenet_257_pose_estimation_e2e_fps",
     "edge": "mobilenet_v2_edge_distributed_e2e_fps",
+    "vit": "vit_s16_224_image_labeling_e2e_fps",
     "lm": "streamformer_lm_serving",
 }
 
 #: per-config input frame edge length (used to scale the frame count to
 #: the measured host->device link so two runs fit the deadline)
 CONFIG_SIZE = {"mobilenet": 224, "resident": 224, "ssd": 300,
-               "deeplab": 257, "posenet": 257, "edge": 224}
+               "deeplab": 257, "posenet": 257, "edge": 224, "vit": 224}
 
 
 class _ExtrasTimeout(BaseException):
@@ -531,15 +532,27 @@ def bench_lm(emit=None) -> dict:
         return out
     with _extras_deadline(budget) as dl:
         flops = 0.0
+        # flop count from the naive-math lowering: the flash kernel
+        # computes the same matmuls (plus O(T) rescales), and XLA's
+        # cost model can't see inside a pallas_call.  Every step stays
+        # inside a guard — a cost-analysis failure must degrade to the
+        # core metrics, never lose the enriched result line.
+        lowered = None
         try:
-            # flop count from the naive-math lowering: the flash kernel
-            # computes the same matmuls (plus O(T) rescales), and XLA's
-            # cost model can't see inside a pallas_call
-            cost = _cost_analysis(jax.jit(lambda p, t: forward_logits(
-                p, t, cfg, flash=False)).lower(params, toks))
-            flops = float(cost.get("flops", 0.0))
-        except Exception:
-            pass
+            lowered = jax.jit(lambda p, t: forward_logits(
+                p, t, cfg, flash=False)).lower(params, toks)
+            flops = float(_cost_analysis(lowered).get("flops", 0.0))
+        except Exception as exc:
+            out["prefill_mfu_error"] = repr(exc)[:160]
+        if not flops and lowered is not None:
+            # pre-compile cost analysis is backend-dependent (axon's
+            # Lowered lacks it); the compiled executable always has it
+            try:
+                flops = float(
+                    _cost_analysis(lowered.compile()).get("flops", 0.0))
+                out.pop("prefill_mfu_error", None)
+            except Exception as exc:
+                out["prefill_mfu_error"] = repr(exc)[:160]
         peak = _peak_flops(device)
         if flops:
             out["gflops_prefill"] = round(flops / 1e9, 2)
@@ -578,9 +591,12 @@ def run_child(config: str) -> dict:
     global N_FRAMES, STREAM_BATCH
     if on_tpu and "NNS_TPU_BENCH_BATCH" not in os.environ:
         # dispatch RTT dominates streaming on a tunneled chip: a larger
-        # micro-batch amortizes it further (measured 32→195 fps; host
-        # pipeline sustains 44k fps at batch 128, docs/PERFORMANCE.md)
-        STREAM_BATCH = 64  # the 1920-frame default already spans 30 batches
+        # micro-batch amortizes it further.  128 won the round-4 sweep
+        # (BENCH_sweep_r04.json: 253.7 fps headline, runs within 4%;
+        # 256 loses — the bigger upload per dispatch stops pipelining
+        # behind compute) and the 1920-frame default still spans 15
+        # batches
+        STREAM_BATCH = 128
     if not on_tpu and "NNS_TPU_BENCH_FRAMES" not in os.environ:
         # host-CPU convs are ~100x slower; keep the smoke run inside the
         # deadline (the TPU frame count stays the measured default)
@@ -628,6 +644,18 @@ def run_child(config: str) -> dict:
         result = bench_model(
             CONFIG_METRICS[config], "posenet", 257, "pose_estimation",
             dtype_prop, "option1=257:257 option2=257:257", emit=emit)
+    elif config == "vit":
+        # attention-family vision config: ViT-S/16 whose encoder runs the
+        # Pallas flash kernel on TPU (models/vit.py).  CPU smoke shrinks
+        # the tower the way the lm config shrinks its lengths — an f32
+        # 12-deep ViT at 224 is ~2 s/frame on this host.
+        props = "" if on_tpu else ",depth:2,dim:192,heads:3"
+        result = bench_model(CONFIG_METRICS[config], "vit", 224,
+                             "image_labeling", dtype_prop + props,
+                             emit=emit)
+        if not on_tpu:
+            result["note"] = (result.get("note", "") +
+                              "; CPU smoke uses depth:2,dim:192").lstrip("; ")
     elif config == "lm":
         result = bench_lm(emit=emit)
     else:

@@ -114,12 +114,7 @@ def graft_params(dst, src):
 
 def _ensure_loaded() -> None:
     from . import (mobilenet_v2, ssd, deeplab_v3, posenet,  # noqa: F401
-                   streamformer_lm)  # noqa: F401
-
-
-def has_model(name: str) -> bool:
-    _ensure_loaded()
-    return name in _MODELS
+                   streamformer_lm, vit)  # noqa: F401
 
 
 def get_model(name: str, custom_props: Optional[Dict[str, str]] = None) -> Model:

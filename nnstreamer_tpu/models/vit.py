@@ -1,0 +1,156 @@
+"""Vision Transformer classifier — the attention-based vision family.
+
+The reference's model zoo is conv-only (mobilenet/ssd/deeplab/posenet
+fixtures under tests/test_models/models/); a TPU-native framework's
+flagship compute is the MXU matmul, and a ViT is the model family whose
+FLOPs are *pure* matmul — patch embedding, QKV projections, attention,
+MLP.  This model ties the framework's marquee Pallas flash-attention
+kernel (ops/flash_attention.py) into the vision streaming path: encoder
+attention runs the streaming-softmax kernel on TPU and the naive jnp
+oracle elsewhere, selected exactly like the LM path
+(models/streamformer_lm.py forward_logits).
+
+TPU-first choices:
+- bfloat16 compute throughout, f32 params and f32 logits out;
+- MXU-aligned defaults (ViT-S/16: dim 384 = 3 sublanes x 128 lanes,
+  6 heads x 64 head-dim), patchify as a stride-16 conv;
+- token count 197 (196 patches + CLS) exercises the kernel's
+  pad-to-block path on every frame — odd lengths are the norm here;
+- the whole uint8-frame -> logits path is one jitted graph, vmap-safe
+  (the micro-batched streaming engine vmaps ``forward``; pallas_call
+  lifts the batch axis into its grid).
+
+Served through the registry backend::
+
+    tensor_filter framework=registry model=vit custom=depth:12,dim:384
+
+Weights are deterministic random (``seed`` prop); pretrained restore
+goes through orbax via the ``checkpoint`` custom property, same as
+every registry model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..tensor.info import TensorInfo, TensorsInfo
+from ..tensor.types import TensorType
+from .registry import Model, host_init, register_model
+
+
+class _Attention(nn.Module):
+    heads: int
+    dtype: Any = jnp.bfloat16
+    flash: bool | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        """x: (T, dim) one frame's token sequence (unbatched)."""
+        t, dim = x.shape
+        head_dim = dim // self.heads
+        qkv = nn.Dense(3 * dim, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv.reshape(t, 3, self.heads, head_dim)
+                            .swapaxes(0, 1), 3, axis=0)
+        q, k, v = q[0], k[0], v[0]          # (T, H, D) kernel layout
+        flash = self.flash
+        if flash is None:
+            from ..ops.flash_attention import flash_is_default
+
+            flash = flash_is_default()
+        if flash:
+            from ..ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=False)
+        else:
+            from ..parallel.ring_attention import local_attention
+
+            attn = local_attention(q, k, v, causal=False)
+        out = attn.astype(self.dtype).reshape(t, dim)
+        return nn.Dense(dim, dtype=self.dtype, name="proj")(out)
+
+
+class _Block(nn.Module):
+    heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    flash: bool | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + _Attention(self.heads, self.dtype, self.flash)(y)
+        y = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(dim, dtype=self.dtype)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """ViT-S/16 by default; every knob is a custom prop."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    dim: int = 384
+    depth: int = 12
+    heads: int = 6
+    dtype: Any = jnp.bfloat16
+    flash: bool | None = None
+
+    @nn.compact
+    def __call__(self, x):
+        """x: bf16 (H, W, 3) in [-1, 1], one frame."""
+        h, w, _ = x.shape
+        x = nn.Conv(self.dim, (self.patch, self.patch),
+                    strides=self.patch, padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x[None])
+        n_tok = (h // self.patch) * (w // self.patch)
+        x = x.reshape(n_tok, self.dim)
+        cls = self.param("cls", nn.initializers.zeros, (1, self.dim))
+        x = jnp.concatenate([cls.astype(self.dtype), x], axis=0)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (n_tok + 1, self.dim))
+        x = x + pos.astype(self.dtype)
+        for _ in range(self.depth):
+            x = _Block(self.heads, dtype=self.dtype, flash=self.flash)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype,
+                          name="head")(x[0])
+        return logits.astype(jnp.float32)
+
+
+def build_vit(custom_props: Dict[str, str]) -> Model:
+    seed = int(custom_props.get("seed", 0))
+    num_classes = int(custom_props.get("num_classes", 1000))
+    size = int(custom_props.get("input_size", 224))
+    patch = int(custom_props.get("patch", 16))
+    dim = int(custom_props.get("dim", 384))
+    depth = int(custom_props.get("depth", 12))
+    heads = int(custom_props.get("heads", 6))
+    dtype = jnp.dtype(custom_props.get("dtype", "bfloat16"))
+    flash: bool | None = None
+    if "attn" in custom_props:  # attn:flash / attn:naive overrides
+        flash = custom_props["attn"] == "flash"
+    module = ViT(num_classes=num_classes, patch=patch, dim=dim,
+                 depth=depth, heads=heads, dtype=dtype, flash=flash)
+    variables = host_init(lambda: module.init(
+        jax.random.PRNGKey(seed), jnp.zeros((size, size, 3), dtype)))
+
+    def forward(variables, frame):
+        """frame: uint8 (H, W, 3) — preprocessing fused into the graph."""
+        x = frame.astype(dtype) * (1.0 / 127.5) - 1.0
+        return (module.apply(variables, x),)
+
+    in_info = TensorsInfo([TensorInfo(TensorType.UINT8, (3, size, size))])
+    out_info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (num_classes,))])
+    return Model(name="vit", forward=forward, params=variables,
+                 in_info=in_info, out_info=out_info)
+
+
+register_model("vit")(build_vit)

@@ -1,0 +1,66 @@
+"""ViT registry model: the attention-based vision family.
+
+Mirrors the reference's strategy of exercising each zoo model through
+the single-invoke API and the streaming pipeline (its runTest.sh
+suites invoke each fixture through gst-launch); here additionally
+pins that the model's flash and naive attention paths agree — the
+vision encoder shares the Pallas kernel with the LM/ring paths
+(tests/test_flash_attention.py covers the kernel itself).
+"""
+
+import numpy as np
+
+from nnstreamer_tpu.filter.single import FilterSingle
+from nnstreamer_tpu.models.registry import get_model, list_models
+
+TINY = "input_size:32,patch:16,dim:64,depth:2,heads:2,num_classes:10"
+
+
+class TestViTModel:
+    def test_registered(self):
+        assert "vit" in list_models()
+
+    def test_single_invoke(self):
+        s = FilterSingle(framework="xla", model="vit", custom=TINY)
+        with s:
+            frame = np.random.default_rng(0).integers(
+                0, 255, (32, 32, 3), dtype=np.uint8)
+            out, = s.invoke([frame])
+            assert out.shape == (10,)
+            assert out.dtype == np.float32
+            assert np.all(np.isfinite(out))
+            out2, = s.invoke([frame])
+            np.testing.assert_allclose(out, out2)
+
+    def test_flash_matches_naive(self):
+        """attn:flash (Pallas interpreter on CPU) == attn:naive oracle.
+
+        5 tokens (2x2 patches + CLS) exercises the kernel's pad-to-block
+        path; both builds share seed so params are identical."""
+        props = dict(p.split(":") for p in TINY.split(","))
+        naive = get_model("vit", {**props, "attn": "naive"})
+        flash = get_model("vit", {**props, "attn": "flash"})
+        frame = np.random.default_rng(1).integers(
+            0, 255, (32, 32, 3), dtype=np.uint8)
+        want, = naive.forward(naive.params, frame)
+        got, = flash.forward(flash.params, frame)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=5e-2, rtol=5e-2)
+
+    def test_vmap_batched(self):
+        """The micro-batched streaming engine vmaps forward; the model
+        (incl. its attention) must lift over a batch axis."""
+        import jax
+
+        m = get_model("vit", dict(p.split(":") for p in TINY.split(",")))
+        frames = np.random.default_rng(2).integers(
+            0, 255, (3, 32, 32, 3), dtype=np.uint8)
+        batched = jax.jit(jax.vmap(m.forward, in_axes=(None, 0)))
+        out, = batched(m.params, frames)
+        assert out.shape == (3, 10)
+        one, = m.forward(m.params, frames[1])
+        # bf16 compute: the vmapped executable fuses/accumulates in a
+        # different order than the unbatched one — agreement is bounded
+        # by bf16 epsilon (~1/256), not exact
+        np.testing.assert_allclose(np.asarray(out[1]), np.asarray(one),
+                                   atol=5e-2, rtol=5e-2)

@@ -16,6 +16,7 @@ Refuses to run on CPU (the proof would be meaningless): emits an error
 line and exits 2 so the capture loop records an .err, not a false green.
 """
 
+import functools
 import json
 import os
 import sys
@@ -36,7 +37,11 @@ CHECK_SHAPES = [
     (1023, 8, 64, True),
     (8192, 8, 64, True),
 ]
-TIME_SHAPES = [(2048, 8, 64), (8192, 8, 64)]
+# 16k/32k are the lengths the kernel exists for: naive local_attention
+# materializes the (T,T) score matrix per head (32k -> tens of GB),
+# so an OOM there is the expected capability win, not a test failure.
+TIME_SHAPES = [(2048, 8, 64), (8192, 8, 64), (16384, 8, 64),
+               (32768, 8, 64)]
 
 
 def _time(fn, *args, reps=10):
@@ -48,6 +53,56 @@ def _time(fn, *args, reps=10):
         out = fn(*args)
     jax.block_until_ready(out)
     return (time.monotonic() - t0) / reps * 1000  # ms
+
+
+def tune() -> int:
+    """Sweep (block_q, block_k) at T=8192 causal and print one JSON line
+    ranking the tile shapes — run in a healthy TPU window to pick kernel
+    defaults (the 128x128 default matches the MXU but bigger K tiles cut
+    grid-iteration overhead when VMEM allows)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.ops.flash_attention import flash_attention
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        print(json.dumps({"metric": "flash_tile_tune", "value": 0,
+                          "error": "no TPU"}), flush=True)
+        return 2
+    rng = np.random.default_rng(0)
+    t, h, d = 8192, 8, 64
+    q = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
+    rows = []
+    for bq, bk in [(128, 128), (128, 256), (128, 512), (256, 256),
+                   (256, 512), (512, 512), (512, 1024), (1024, 1024)]:
+        fn = jax.jit(functools.partial(
+            flash_attention, causal=True, block_q=bq, block_k=bk,
+            interpret=False))
+        try:
+            ms = _time(fn, q, k, v)
+            rows.append({"block_q": bq, "block_k": bk,
+                         "ms": round(ms, 3)})
+        except Exception as exc:
+            rows.append({"block_q": bq, "block_k": bk,
+                         "error": repr(exc)[:200]})
+    timed = [r for r in rows if "ms" in r]
+    best = min(timed, key=lambda r: r["ms"]) if timed else {}
+    # headline value = default-tile ms / best ms (higher is better, like
+    # every other artifact value — the capture loop's keep-best-score
+    # policy relies on that orientation)
+    default_ms = next((r["ms"] for r in timed
+                       if r["block_q"] == 128 and r["block_k"] == 128),
+                      best.get("ms", 0))
+    speedup = default_ms / best["ms"] if best else 0
+    print(json.dumps({"metric": "flash_tile_tune",
+                      "unit": "x_vs_128x128_tile",
+                      "value": round(speedup, 4), "best": best,
+                      "default_ms": default_ms,
+                      "rows": rows, "device": str(dev)}), flush=True)
+    return 0 if timed else 1
 
 
 def main() -> int:
@@ -147,10 +202,21 @@ def main() -> int:
             q, k, v, causal=True))
         try:
             ms_flash = _time(flash, q, k, v)
-            ms_naive = _time(naive, q, k, v)
         except Exception as exc:
+            # the kernel itself must run at every length — that IS the proof
             timings.append({"T": t, "error": repr(exc)[:300]})
             ok = False
+            continue
+        try:
+            ms_naive = _time(naive, q, k, v)
+        except Exception as exc:
+            # naive blowing up (OOM on the (T,T) scores) at long T is the
+            # capability headroom the streaming kernel buys — record it as
+            # a win, not a failure
+            timings.append({"T": t, "flash_ms": round(ms_flash, 3),
+                            "naive_ms": None,
+                            "naive_error": repr(exc)[:200],
+                            "flash_only": True})
             continue
         speedup = ms_naive / ms_flash if ms_flash else 0.0
         timings.append({"T": t, "flash_ms": round(ms_flash, 3),
@@ -166,4 +232,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(tune() if "--tune" in sys.argv[1:] else main())
