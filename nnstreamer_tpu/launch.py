@@ -74,6 +74,11 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="record per-element proctime/framerate (GstShark "
                          "tracer role) and print the report at EOS")
+    ap.add_argument("--jax-trace", default=None, metavar="DIR",
+                    help="record a device-level JAX/XLA profiler trace "
+                         "into DIR (TensorBoard profile format): per-op "
+                         "device timeline under the element-granular "
+                         "--trace report")
     args = ap.parse_args(argv)
 
     if args.inspect is not None:
@@ -98,6 +103,10 @@ def main(argv=None) -> int:
                 if hasattr(el, "latency_report"):
                     el.latency_report = True
         tracer = p.enable_tracing() if args.trace else None
+        if args.jax_trace:
+            import jax
+
+            jax.profiler.start_trace(args.jax_trace)
         try:
             p.play()
             p.wait(args.timeout)
@@ -119,6 +128,12 @@ def main(argv=None) -> int:
                               file=sys.stderr)
         finally:
             p.stop()
+            if args.jax_trace:
+                import jax
+
+                jax.profiler.stop_trace()
+                print(f"jax trace written to {args.jax_trace}",
+                      file=sys.stderr)
             if tracer is not None:
                 # print even on timeout/error: bounded profiling of a
                 # live pipeline is exactly the --trace --timeout use case

@@ -62,3 +62,18 @@ def test_stats_reports_executor_and_fallback_reason(tmp_path):
     assert "executor f: torch-host" in r.stderr
     assert "aten::fft_fft" in r.stderr
     assert "latency total" in r.stderr
+
+
+def test_jax_trace_writes_device_profile(tmp_path):
+    """--jax-trace: the device-level profiler counterpart of --trace —
+    a TensorBoard-format trace directory materializes for the run."""
+    tdir = str(tmp_path / "prof")
+    r = _run_cli(
+        "videotestsrc num-buffers=3 ! "
+        "video/x-raw,format=RGB,width=8,height=8,framerate=30/1 ! "
+        "tensor_converter ! tensor_sink name=out",
+        "--jax-trace", tdir, "--timeout", "120")
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "jax trace written" in r.stderr
+    files = [os.path.join(dp, f) for dp, _, fs in os.walk(tdir) for f in fs]
+    assert files, "profiler trace directory is empty"
