@@ -37,14 +37,18 @@ def checkpoint_props() -> str:
 
 
 def main() -> None:
+    # any registry classifier works here; `vit` swaps in the
+    # attention-family model (Pallas flash encoder on TPU)
+    model = sys.argv[1] if len(sys.argv) > 1 else "mobilenet_v2"
+    props = checkpoint_props() if model == "mobilenet_v2" else ""
     labels = os.path.join(REF, "labels", "labels.txt")
     label_opt = f"option1={labels}" if os.path.isfile(labels) else ""
     p = parse_launch(
         "videotestsrc num-buffers=32 pattern=gradient ! "
         "video/x-raw,format=RGB,width=224,height=224,framerate=30/1 ! "
         "tensor_converter ! "
-        f"tensor_filter framework=xla model=mobilenet_v2 "
-        f"custom=seed:0{checkpoint_props()} batch=8 ! "
+        f"tensor_filter framework=xla model={model} "
+        f"custom=seed:0{props} batch=8 ! "
         "queue ! "
         f"tensor_decoder mode=image_labeling {label_opt} ! "
         "tensor_sink name=out")

@@ -171,8 +171,65 @@ class JaxTrainer(TrainerFramework):
         _save_orbax(self._state[0], path)
 
 
+class _MeshStreamTrainer(TrainerFramework):
+    """Shared skeleton for mesh-jitted stream trainers: accumulate
+    (inputs, labels) samples, lazily build the sharded step at first
+    finish, run the epoch loop (host-side convert once; device_put per
+    step — bounded HBM beats saving a transfer per epoch for a trainer
+    fed by an arbitrarily long stream), checkpoint params via orbax.
+
+    Subclasses provide ``_build()`` (set ``self._mesh``, ``self._step``,
+    ``self._params``, ``self._opt``, ``self._sharding``),
+    ``_host_convert(inputs, labels)`` and optionally ``_summary_extra``.
+    """
+
+    def create(self, props: Dict[str, Any]) -> None:
+        self.props = props
+        self.epochs = int(props.get("num-epochs", 1))
+        self._samples: List[Tuple[List[np.ndarray], List[np.ndarray]]] = []
+        self.losses: List[float] = []
+        self._built = False
+
+    def push_data(self, inputs, labels) -> None:
+        self._samples.append((inputs, labels))
+
+    def _build(self) -> None:
+        raise NotImplementedError
+
+    def _host_convert(self, inputs, labels):
+        raise NotImplementedError
+
+    def _summary_extra(self) -> Dict[str, Any]:
+        return {}
+
+    def finish(self) -> Dict[str, Any]:
+        import jax
+
+        from ..parallel import mesh_info
+
+        if not self._samples:
+            return {"epochs": 0, "samples": 0, "final_loss": None}
+        if not self._built:
+            self._build()
+        host = [self._host_convert(i, l) for i, l in self._samples]
+        put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+        for _ in range(self.epochs):
+            for ins, labs in host:
+                self._params, self._opt, loss = self._step(
+                    self._params, self._opt, put(ins), put(labs))
+                self.losses.append(float(loss))
+        return {"epochs": self.epochs, "samples": len(self._samples),
+                "final_loss": self.losses[-1] if self.losses else None,
+                "mesh": mesh_info(self._mesh), **self._summary_extra()}
+
+    def save(self, path: str) -> None:
+        if not self._built:
+            return
+        _save_orbax(self._params, path)
+
+
 @register_trainer
-class MeshTrainer(TrainerFramework):
+class MeshTrainer(_MeshStreamTrainer):
     """``framework=mesh``: the stream trains the SHARDED StreamFormer —
     every (tokens, labels) frame becomes one step of
     :func:`nnstreamer_tpu.parallel.make_train_step` jitted over a
@@ -191,19 +248,7 @@ class MeshTrainer(TrainerFramework):
 
     NAME = "mesh"
 
-    def create(self, props: Dict[str, Any]) -> None:
-        self.props = props
-        self.epochs = int(props.get("num-epochs", 1))
-        self._samples: List[Tuple[List[np.ndarray], List[np.ndarray]]] = []
-        self.losses: List[float] = []
-        self._built = False
-
-    def push_data(self, inputs, labels) -> None:
-        self._samples.append((inputs, labels))
-
     def _build(self) -> None:
-        import jax
-
         from ..parallel import make_data_sharding, make_mesh
         from ..parallel.train_step import (StreamFormerConfig,
                                            make_train_step)
@@ -223,35 +268,59 @@ class MeshTrainer(TrainerFramework):
         self._step, self._params, self._opt, _ = make_train_step(
             self._mesh, cfg, seed=int(p.get("seed", 0)))
         self._sharding = make_data_sharding(self._mesh)
-        self._put = lambda x: jax.device_put(x, self._sharding)
         self._built = True
 
-    def finish(self) -> Dict[str, Any]:
-        from ..parallel import mesh_info
+    def _host_convert(self, inputs, labels):
+        return (np.asarray(inputs[0], np.int32),
+                np.asarray(labels[0], np.int32))
 
-        if not self._samples:
-            return {"epochs": 0, "samples": 0, "final_loss": None}
-        if not self._built:
-            self._build()
-        # host-side convert once; device_put per step — bounded HBM (one
-        # sample resident at a time) beats saving a transfer per epoch
-        # for a trainer fed by an arbitrarily long stream
-        host = [(np.asarray(i[0], np.int32), np.asarray(l[0], np.int32))
-                for i, l in self._samples]
-        for _ in range(self.epochs):
-            for tokens, labs in host:
-                self._params, self._opt, loss = self._step(
-                    self._params, self._opt, self._put(tokens),
-                    self._put(labs))
-                self.losses.append(float(loss))
-        return {"epochs": self.epochs, "samples": len(self._samples),
-                "final_loss": self.losses[-1] if self.losses else None,
-                "mesh": mesh_info(self._mesh)}
 
-    def save(self, path: str) -> None:
-        if not self._built:
-            return
-        _save_orbax(self._params, path)
+@register_trainer
+class MeshVisionTrainer(_MeshStreamTrainer):
+    """``framework=mesh-vision``: the stream trains any REGISTRY VISION
+    model data-parallel over a mesh — replicated params, frame batches
+    sharded on ``dp``, XLA-inserted gradient psum
+    (parallel/vision_train.py).  With ``model:vit`` the trained encoder
+    is the Pallas flash-attention path.
+
+    props (via ``custom=``): ``model`` (registry name, default vit),
+    ``dp`` (default: all devices), ``lr``, plus any model custom props
+    (``dim/depth/heads/patch/input_size/num_classes/seed``…).  Samples:
+    tensor 0 = frames (B, H, W, 3) uint8, tensor 1 = labels (B,) int32.
+    """
+
+    NAME = "mesh-vision"
+
+    _MODEL_KEYS = ("seed", "num_classes", "input_size", "patch", "dim",
+                   "depth", "heads", "dtype", "attn", "width")
+
+    def _build(self) -> None:
+        import jax
+
+        from ..models.registry import get_model
+        from ..parallel import make_mesh
+        from ..parallel.vision_train import make_vision_train_step
+
+        p = self.props
+        dp = int(p.get("dp", len(jax.devices())))
+        self._mesh = make_mesh(n_devices=dp, axis_sizes={"dp": dp})
+        model_props = {k: str(p[k]) for k in self._MODEL_KEYS if k in p}
+        self._model = get_model(str(p.get("model", "vit")), model_props)
+        (self._step, self._params, self._opt,
+         self._sharding) = make_vision_train_step(
+            self._mesh, self._model, lr=float(p.get("lr", 1e-3)))
+        self._dp = dp
+        self._built = True
+
+    def _host_convert(self, inputs, labels):
+        from ..parallel.vision_train import pad_to_multiple
+
+        return (pad_to_multiple(np.asarray(inputs[0], np.uint8), self._dp),
+                pad_to_multiple(np.asarray(labels[0], np.int32)
+                                .reshape(-1), self._dp))
+
+    def _summary_extra(self) -> Dict[str, Any]:
+        return {"model": self._model.name}
 
 
 @register_element

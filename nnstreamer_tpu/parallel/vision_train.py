@@ -1,0 +1,84 @@
+"""Data-parallel training step for registry vision models.
+
+`train_step.make_train_step` shards the StreamFormer over the full
+dp/sp/tp/ep mesh; vision classifiers (MobileNetV2, ViT, …) are small
+enough that replicated params + batch sharding over ``dp`` is the
+right decomposition — the classic SPMD data-parallel recipe: annotate
+shardings, jit, and let XLA's partitioner insert the gradient psum
+(no hand-written collectives, per the scaling-book recipe).
+
+The reference's trainer ABI (nnstreamer_plugin_api_trainer.h) trains
+on the host only; this gives every registry vision model a multi-chip
+stream-fed training path (elements/trainer.py ``framework=mesh-vision``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _param_labels(variables) -> Any:
+    """'adam' for trainable collections, 'freeze' for batch_stats —
+    running BN statistics are not gradient-trained (flax convention)."""
+    return {k: jax.tree.map(lambda _: "freeze" if k == "batch_stats"
+                            else "adam", v)
+            for k, v in variables.items()} if isinstance(variables, dict) \
+        else jax.tree.map(lambda _: "adam", variables)
+
+
+def make_vision_train_step(mesh: Mesh, model, lr: float = 1e-3
+                           ) -> Tuple[Callable, Any, Any, NamedSharding]:
+    """Returns ``(step, params, opt_state, batch_sharding)``.
+
+    ``step(params, opt, frames, labels) -> (params, opt, loss)`` where
+    ``frames`` is a uint8 (B, H, W, 3) batch sharded over ``dp`` (B must
+    divide by the dp size) and ``labels`` int32 (B,) class ids.  Params
+    and optimizer state are replicated; XLA inserts the cross-device
+    gradient reduction.
+    """
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("dp"))
+    tx = optax.multi_transform(
+        {"adam": optax.adam(lr), "freeze": optax.set_to_zero()},
+        _param_labels(model.params))
+    params = jax.device_put(model.params, repl)
+    opt = jax.device_put(tx.init(model.params), repl)
+    fwd = jax.vmap(model.forward, in_axes=(None, 0))
+
+    def loss_fn(p, frames, labels):
+        logits = fwd(p, frames)[0].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)
+        return jnp.mean(nll)
+
+    @functools.partial(jax.jit,
+                       in_shardings=(repl, repl, data, data),
+                       out_shardings=(repl, repl, None),
+                       donate_argnums=(0, 1))
+    def step(p, o, frames, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(p, frames, labels)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    return step, params, opt, data
+
+
+def pad_to_multiple(batch: np.ndarray, m: int) -> np.ndarray:
+    """Repeat-pad axis 0 up to a multiple of ``m`` (dp size) so a
+    stream tail still shards evenly; loss over repeated samples is a
+    reweighting, not a correctness issue, for the trailing batch.
+    Cycles the batch as many times as needed — a 3-frame tail on a
+    dp=8 mesh pads to 8, not 6."""
+    b = batch.shape[0]
+    pad = (-b) % m
+    if not pad:
+        return batch
+    filler = np.concatenate([batch] * -(-pad // b), axis=0)[:pad]
+    return np.concatenate([batch, filler], axis=0)

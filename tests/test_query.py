@@ -159,6 +159,42 @@ class TestTrainer:
         assert losses[-1] < losses[0]          # it learns the shift task
         assert (tmp_path / "mesh_ckpt").exists()
 
+    def test_mesh_vision_trainer_pipeline(self, tmp_path, jax_cpu_devices):
+        """The stream trains a VISION model (tiny ViT) data-parallel over
+        a dp=8 mesh: frames shard over dp, params replicate, XLA inserts
+        the gradient psum (parallel/vision_train.py)."""
+        from nnstreamer_tpu.elements import TensorTrainer
+        from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+
+        p = Pipeline()
+        src = AppSrc("src", caps=(
+            "other/tensors,format=static,num_tensors=2,"
+            "dimensions=3:16:16:8.8,types=uint8.int32,framerate=0/1"))
+        trainer = TensorTrainer("tr", framework="mesh-vision", **{
+            "num-epochs": 6,
+            "model-save-path": str(tmp_path / "vit_ckpt"),
+            "custom": ("model:vit,input_size:16,patch:8,dim:16,depth:1,"
+                       "heads:2,num_classes:4,dtype:float32,lr:0.01")})
+        sink = TensorSink("out")
+        p.add(src, trainer, sink)
+        p.link(src, trainer, sink)
+        rng = np.random.default_rng(0)
+        for i in range(4):
+            # learnable task: class = brightness band of the frame
+            labs = rng.integers(0, 4, 8).astype(np.int32)
+            frames = np.repeat(
+                (labs * 64 + 32).astype(np.uint8)[:, None, None, None],
+                16 * 16 * 3, axis=1).reshape(8, 16, 16, 3)
+            src.push_buffer(TensorBuffer(tensors=[frames, labs], pts=i))
+        src.end_of_stream()
+        p.run(timeout=300)
+        assert trainer.summary["samples"] == 4
+        assert trainer.summary["model"] == "vit"
+        assert trainer.summary["mesh"]["dp"] == 8
+        losses = trainer.trainer.losses
+        assert losses[-1] < losses[0]          # it learns the band task
+        assert (tmp_path / "vit_ckpt").exists()
+
 
 class TestEdgePubSub:
     def test_pub_sub_round_trip(self):
