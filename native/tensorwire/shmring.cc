@@ -1,0 +1,234 @@
+// shmring: single-producer single-consumer shared-memory ring for
+// host-local tensor transport.
+//
+// The reference's inter-pipeline transports are all socket wires (TCP
+// query protocol nnstreamer_query.c, MQTT, gRPC) — even when producer
+// and consumer share one host, every buffer pays the kernel socket
+// path.  On a TPU host feeding a device at tens of kfps, that is the
+// wrong transport: this ring gives two pipelines on one machine a
+// single-copy path through POSIX shared memory (shm_open + mmap),
+// bookkept by C++11 atomics (acquire/release SPSC — no locks, no
+// syscalls on the hot path).
+//
+// Region layout (little-endian, 64-byte aligned ring header):
+//   u32 magic 'NTSR'   u32 version
+//   u64 slot_size      u32 n_slots     u32 caps_len
+//   u8  caps[4096]                       (pad-sized, producer-written)
+//   u64 head (atomic; next slot producer writes)   [64-byte aligned]
+//   u64 tail (atomic; next slot consumer reads)    [64-byte aligned]
+//   u32 eos  (atomic)                              [64-byte aligned]
+//   slots[n_slots]: { u64 len; s64 pts; u8 payload[slot_size] }
+//
+// The same layout is implemented in pure Python (nnstreamer_tpu/query/
+// shm.py) as the no-toolchain fallback; the two interoperate.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4e545352;  // 'NTSR'
+constexpr uint32_t kVersion = 1;
+constexpr uint32_t kCapsMax = 4096;
+
+struct Header {
+  uint32_t magic;
+  uint32_t version;
+  uint64_t slot_size;
+  uint32_t n_slots;
+  uint32_t caps_len;
+  uint8_t caps[kCapsMax];
+  alignas(64) std::atomic<uint64_t> head;
+  alignas(64) std::atomic<uint64_t> tail;
+  alignas(64) std::atomic<uint32_t> eos;
+  alignas(64) uint8_t slots[];  // n_slots * (16 + slot_size)
+};
+
+struct Ring {
+  Header *h;
+  size_t map_len;
+  char name[256];
+  bool owner;
+};
+
+inline uint8_t *slot_at(Header *h, uint64_t i) {
+  return h->slots + (i % h->n_slots) * (16 + h->slot_size);
+}
+
+inline void sleep_us(unsigned us) {
+  struct timespec ts = {0, static_cast<long>(us) * 1000};
+  nanosleep(&ts, nullptr);
+}
+
+inline uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+size_t region_len(uint64_t slot_size, uint32_t n_slots) {
+  return sizeof(Header) + static_cast<size_t>(n_slots) * (16 + slot_size);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (producer side).  Returns opaque handle or nullptr.
+void *tw_shm_create(const char *name, uint64_t slot_size, uint32_t n_slots,
+                    const char *caps) {
+  if (!name || !n_slots || !slot_size) return nullptr;
+  size_t caps_len = caps ? strlen(caps) : 0;
+  if (caps_len > kCapsMax) return nullptr;
+  shm_unlink(name);  // stale ring from a crashed producer
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t len = region_len(slot_size, n_slots);
+  if (ftruncate(fd, static_cast<off_t>(len)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void *mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header *h = new (mem) Header();
+  h->slot_size = slot_size;
+  h->n_slots = n_slots;
+  h->caps_len = static_cast<uint32_t>(caps_len);
+  if (caps_len) memcpy(h->caps, caps, caps_len);
+  h->head.store(0, std::memory_order_relaxed);
+  h->tail.store(0, std::memory_order_relaxed);
+  h->eos.store(0, std::memory_order_relaxed);
+  h->version = kVersion;
+  // magic last: a concurrently-opening consumer sees a complete header
+  std::atomic_thread_fence(std::memory_order_release);
+  h->magic = kMagic;
+  Ring *r = new Ring{h, len, {0}, true};
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  return r;
+}
+
+// Open (consumer side); waits up to timeout_ms for the ring to appear.
+void *tw_shm_open(const char *name, uint32_t timeout_ms) {
+  uint64_t deadline = now_ms() + timeout_ms;
+  int fd = -1;
+  do {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd >= 0) break;
+    sleep_us(2000);
+  } while (now_ms() < deadline);
+  if (fd < 0) return nullptr;
+  struct stat st = {};
+  // wait for ftruncate + header init
+  while (fstat(fd, &st) == 0 &&
+         st.st_size < static_cast<off_t>(sizeof(Header)) &&
+         now_ms() < deadline)
+    sleep_us(2000);
+  if (fstat(fd, &st) != 0 ||
+      st.st_size < static_cast<off_t>(sizeof(Header))) {
+    close(fd);
+    return nullptr;
+  }
+  size_t len = static_cast<size_t>(st.st_size);
+  void *mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header *h = static_cast<Header *>(mem);
+  while (h->magic != kMagic && now_ms() < deadline) sleep_us(2000);
+  if (h->magic != kMagic || h->version != kVersion) {
+    munmap(mem, len);
+    return nullptr;
+  }
+  Ring *r = new Ring{h, len, {0}, false};
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  return r;
+}
+
+// Negotiated caps string; returns length (0 if none / cap too small).
+uint32_t tw_shm_caps(void *ring, char *out, uint32_t cap) {
+  Ring *r = static_cast<Ring *>(ring);
+  if (!r || r->h->caps_len > cap) return 0;
+  memcpy(out, r->h->caps, r->h->caps_len);
+  return r->h->caps_len;
+}
+
+// Push one record.  0 ok; -1 timeout (ring full); -2 len > slot_size.
+int tw_shm_push(void *ring, const uint8_t *data, uint64_t len, int64_t pts,
+                uint32_t timeout_ms) {
+  Ring *r = static_cast<Ring *>(ring);
+  Header *h = r->h;
+  if (len > h->slot_size) return -2;
+  uint64_t deadline = now_ms() + timeout_ms;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  while (head - h->tail.load(std::memory_order_acquire) >= h->n_slots) {
+    if (now_ms() >= deadline) return -1;
+    sleep_us(100);
+  }
+  uint8_t *s = slot_at(h, head);
+  memcpy(s, &len, 8);
+  memcpy(s + 8, &pts, 8);
+  if (len) memcpy(s + 16, data, len);
+  h->head.store(head + 1, std::memory_order_release);
+  return 0;
+}
+
+// Pop one record into out (cap bytes).  >=0 length; -1 timeout;
+// -2 record larger than cap (record stays); -3 EOS and drained.
+int64_t tw_shm_pop(void *ring, uint8_t *out, uint64_t cap, int64_t *pts,
+                   uint32_t timeout_ms) {
+  Ring *r = static_cast<Ring *>(ring);
+  Header *h = r->h;
+  uint64_t deadline = now_ms() + timeout_ms;
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  while (h->head.load(std::memory_order_acquire) == tail) {
+    if (h->eos.load(std::memory_order_acquire)) return -3;
+    if (now_ms() >= deadline) return -1;
+    sleep_us(100);
+  }
+  uint8_t *s = slot_at(h, tail);
+  uint64_t len;
+  memcpy(&len, s, 8);
+  if (len > cap) return -2;
+  if (pts) memcpy(pts, s + 8, 8);
+  if (len) memcpy(out, s + 16, len);
+  h->tail.store(tail + 1, std::memory_order_release);
+  return static_cast<int64_t>(len);
+}
+
+void tw_shm_eos(void *ring) {
+  static_cast<Ring *>(ring)->h->eos.store(1, std::memory_order_release);
+}
+
+uint64_t tw_shm_slot_size(void *ring) {
+  return static_cast<Ring *>(ring)->h->slot_size;
+}
+
+// Close; unlinks the shm name when do_unlink != 0.  Lifecycle: the
+// producer does NOT unlink at close (a consumer that hasn't attached
+// yet must still find the drained ring); the consumer unlinks once it
+// is done, and tw_shm_create unlinks any stale ring it replaces — so
+// an unconsumed ring leaks only until the name is reused.
+void tw_shm_close(void *ring, int do_unlink) {
+  Ring *r = static_cast<Ring *>(ring);
+  if (!r) return;
+  char name[256];
+  memcpy(name, r->name, sizeof(name));
+  munmap(r->h, r->map_len);
+  if (do_unlink) shm_unlink(name);
+  delete r;
+}
+
+}  // extern "C"

@@ -25,6 +25,7 @@ from ..query import edge as _query_edge  # noqa: F401
 from ..query import grpc_service as _query_grpc  # noqa: F401
 from ..query import mqtt as _query_mqtt  # noqa: F401
 from ..query import server as _query_server  # noqa: F401
+from ..query import shm as _query_shm  # noqa: F401
 
 from .aggregator import TensorAggregator
 from .converter import TensorConverter

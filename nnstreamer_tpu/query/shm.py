@@ -1,0 +1,393 @@
+"""Shared-memory ring transport: host-local single-copy tensor streams.
+
+The reference's inter-pipeline transports are all socket wires — TCP
+query (`gst/nnstreamer/tensor_query/`), MQTT, gRPC — so two pipelines
+on ONE host still pay the kernel socket path per buffer.  On a TPU host
+feeding tens of kfps that's the wrong transport; this module gives
+co-located pipelines a lock-free SPSC ring through POSIX shared memory:
+
+    producer: … ! tensor_shm_sink path=frames
+    consumer: tensor_shm_src path=frames ! …
+
+Record payloads use the same tensor framing as the TCP wire
+(`protocol.encode_tensors`), so static and flexible streams both ride
+the ring.  Caps negotiate through the ring header (producer writes the
+caps string; consumer's ``negotiate`` reads it) — the role of the TCP
+HELLO exchange.
+
+Two interoperable implementations of one region layout (documented in
+native/tensorwire/shmring.cc): the C++ ring via ctypes when the native
+lib is available, else a pure-Python mmap fallback (adequate for tests
+and toolchain-less hosts; the native path is the fast one).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import struct
+import time
+from typing import Optional, Tuple
+
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.graph import Source
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import tensors_template_caps
+from .protocol import decode_tensors, encode_tensors
+
+# region layout constants — must match native/tensorwire/shmring.cc
+_MAGIC = 0x4E545352  # 'NTSR'
+_VERSION = 1
+_CAPS_MAX = 4096
+_OFF_CAPS = 24
+_OFF_HEAD = 4160
+_OFF_TAIL = 4224
+_OFF_EOS = 4288
+_OFF_SLOTS = 4352
+_SLOT_HDR = 16  # u64 len + s64 pts
+
+DEFAULT_SLOT_BYTES = 1 << 20
+DEFAULT_SLOTS = 16
+
+
+def _native_lib():
+    from .. import native
+
+    lib = native._load()
+    if lib is None or not hasattr(lib, "tw_shm_create"):
+        return None
+    if not getattr(lib, "_shm_bound", False):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.tw_shm_create.restype = ctypes.c_void_p
+        lib.tw_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_uint32, ctypes.c_char_p]
+        lib.tw_shm_open.restype = ctypes.c_void_p
+        lib.tw_shm_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.tw_shm_caps.restype = ctypes.c_uint32
+        lib.tw_shm_caps.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint32]
+        lib.tw_shm_push.restype = ctypes.c_int
+        lib.tw_shm_push.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64,
+                                    ctypes.c_int64, ctypes.c_uint32]
+        lib.tw_shm_pop.restype = ctypes.c_int64
+        lib.tw_shm_pop.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64,
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.c_uint32]
+        lib.tw_shm_eos.argtypes = [ctypes.c_void_p]
+        lib.tw_shm_slot_size.restype = ctypes.c_uint64
+        lib.tw_shm_slot_size.argtypes = [ctypes.c_void_p]
+        lib.tw_shm_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib._shm_bound = True
+    return lib
+
+
+class ShmRing:
+    """One endpoint of the ring; ``create=True`` = producer side."""
+
+    def __init__(self, name: str, create: bool,
+                 slot_bytes: int = DEFAULT_SLOT_BYTES,
+                 n_slots: int = DEFAULT_SLOTS, caps: str = "",
+                 timeout: float = 10.0):
+        if not name.startswith("/"):
+            name = "/" + name
+        self.name = name
+        self._lib = _native_lib()
+        self._h = None
+        self._mm: Optional[mmap.mmap] = None
+        self._owner = create
+        if self._lib is not None:
+            if create:
+                self._h = self._lib.tw_shm_create(
+                    name.encode(), slot_bytes, n_slots, caps.encode())
+            else:
+                self._h = self._lib.tw_shm_open(
+                    name.encode(), int(timeout * 1000))
+            if not self._h:
+                raise ConnectionError(f"shm ring {name!r}: "
+                                      f"{'create' if create else 'open'} "
+                                      "failed")
+            self.slot_bytes = int(self._lib.tw_shm_slot_size(self._h))
+        else:
+            self._py_init(create, slot_bytes, n_slots, caps, timeout)
+
+    # -- pure-Python fallback (same layout).  SAFETY: cross-process
+    # correctness relies on x86-64 TSO (stores retire in order) and on
+    # aligned 8-byte mmap writes being single stores — CPython emits no
+    # fences.  On other ISAs (aarch64) a consumer could observe the head
+    # advance before the payload lands; warn loudly there and prefer the
+    # native ring (its C++11 atomics are correct everywhere). ------------
+    def _py_init(self, create, slot_bytes, n_slots, caps, timeout):
+        import platform
+
+        if platform.machine() not in ("x86_64", "AMD64"):
+            from ..utils.log import logger
+
+            logger.warning(
+                "shm ring %s: pure-Python fallback has no memory barriers "
+                "— cross-process use on %s may tear records; build the "
+                "native lib (make -C native)", self.name,
+                platform.machine())
+        path = "/dev/shm" + self.name
+        if create:
+            caps_b = caps.encode()
+            total = _OFF_SLOTS + n_slots * (_SLOT_HDR + slot_bytes)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.truncate(total)
+            os.replace(tmp, path)
+            self._fd = os.open(path, os.O_RDWR)
+            self._mm = mmap.mmap(self._fd, total)
+            self._mm[8:16] = struct.pack("<Q", slot_bytes)
+            self._mm[16:24] = struct.pack("<II", n_slots, len(caps_b))
+            self._mm[_OFF_CAPS:_OFF_CAPS + len(caps_b)] = caps_b
+            # magic last (consumer spins on it)
+            self._mm[0:8] = struct.pack("<II", _MAGIC, _VERSION)
+        else:
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    self._fd = os.open(path, os.O_RDWR)
+                    st = os.fstat(self._fd)
+                    if st.st_size >= _OFF_SLOTS:
+                        self._mm = mmap.mmap(self._fd, st.st_size)
+                        magic, ver = struct.unpack("<II", self._mm[0:8])
+                        if magic == _MAGIC and ver == _VERSION:
+                            break
+                        self._mm.close()
+                        self._mm = None
+                        if magic == _MAGIC:  # right ring, wrong layout
+                            os.close(self._fd)
+                            raise ConnectionError(
+                                f"shm ring {self.name!r}: version {ver} "
+                                f"!= {_VERSION}")
+                    os.close(self._fd)
+                except OSError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise ConnectionError(f"shm ring {self.name!r}: "
+                                          "open timed out")
+                time.sleep(0.002)
+        self.slot_bytes = struct.unpack("<Q", self._mm[8:16])[0]
+        self._n_slots = struct.unpack("<I", self._mm[16:20])[0]
+
+    def _py_u64(self, off: int) -> int:
+        return struct.unpack("<Q", self._mm[off:off + 8])[0]
+
+    # -- API -------------------------------------------------------------
+    def caps(self) -> str:
+        if self._lib is not None:
+            out = ctypes.create_string_buffer(_CAPS_MAX)
+            n = self._lib.tw_shm_caps(self._h, out, _CAPS_MAX)
+            return out.raw[:n].decode()
+        n = struct.unpack("<I", self._mm[20:24])[0]
+        return bytes(self._mm[_OFF_CAPS:_OFF_CAPS + n]).decode()
+
+    def push(self, payload: bytes, pts: int, timeout: float = 10.0) -> None:
+        if self._lib is not None:
+            # zero-copy view of the immutable bytes (C side only reads)
+            buf = ctypes.cast(ctypes.c_char_p(payload),
+                              ctypes.POINTER(ctypes.c_uint8))
+            rc = self._lib.tw_shm_push(self._h, buf, len(payload), pts,
+                                       int(timeout * 1000))
+            if rc == -2:
+                raise ValueError(f"record {len(payload)} B exceeds slot "
+                                 f"size {self.slot_bytes}")
+            if rc != 0:
+                raise TimeoutError("shm ring full (consumer stalled?)")
+            return
+        if len(payload) > self.slot_bytes:
+            raise ValueError(f"record {len(payload)} B exceeds slot "
+                             f"size {self.slot_bytes}")
+        deadline = time.monotonic() + timeout
+        while (self._py_u64(_OFF_HEAD) - self._py_u64(_OFF_TAIL)
+               >= self._n_slots):
+            if time.monotonic() > deadline:
+                raise TimeoutError("shm ring full (consumer stalled?)")
+            time.sleep(0.0001)
+        head = self._py_u64(_OFF_HEAD)
+        off = _OFF_SLOTS + (head % self._n_slots) * (_SLOT_HDR
+                                                    + self.slot_bytes)
+        self._mm[off:off + 16] = struct.pack("<Qq", len(payload), pts)
+        self._mm[off + 16:off + 16 + len(payload)] = payload
+        self._mm[_OFF_HEAD:_OFF_HEAD + 8] = struct.pack("<Q", head + 1)
+
+    def pop(self, timeout: float = 10.0
+            ) -> Optional[Tuple[bytes, int]]:
+        """(payload, pts) — or None on EOS-and-drained."""
+        if self._lib is not None:
+            if not hasattr(self, "_pop_buf"):
+                self._pop_buf = (ctypes.c_uint8 * self.slot_bytes)()
+            out = self._pop_buf
+            pts = ctypes.c_int64()
+            n = self._lib.tw_shm_pop(self._h, out, self.slot_bytes,
+                                     ctypes.byref(pts),
+                                     int(timeout * 1000))
+            if n == -3:
+                return None
+            if n < 0:
+                raise TimeoutError("shm ring empty (producer stalled?)")
+            return ctypes.string_at(out, n), pts.value
+        deadline = time.monotonic() + timeout
+        while self._py_u64(_OFF_HEAD) == self._py_u64(_OFF_TAIL):
+            if struct.unpack("<I", self._mm[_OFF_EOS:_OFF_EOS + 4])[0]:
+                return None
+            if time.monotonic() > deadline:
+                raise TimeoutError("shm ring empty (producer stalled?)")
+            time.sleep(0.0001)
+        tail = self._py_u64(_OFF_TAIL)
+        off = _OFF_SLOTS + (tail % self._n_slots) * (_SLOT_HDR
+                                                     + self.slot_bytes)
+        ln, pts = struct.unpack("<Qq", self._mm[off:off + 16])
+        payload = bytes(self._mm[off + 16:off + 16 + ln])
+        self._mm[_OFF_TAIL:_OFF_TAIL + 8] = struct.pack("<Q", tail + 1)
+        return payload, pts
+
+    def eos(self) -> None:
+        if self._lib is not None:
+            self._lib.tw_shm_eos(self._h)
+        else:
+            self._mm[_OFF_EOS:_OFF_EOS + 4] = struct.pack("<I", 1)
+
+    def close(self, unlink: Optional[bool] = None) -> None:
+        """Unmap; unlink the shm name when ``unlink`` (default: consumer
+        side).  The producer must NOT unlink at close — a consumer that
+        attaches late still needs to drain the ring; ``create`` replaces
+        any stale ring left behind, bounding the leak to one name."""
+        if unlink is None:
+            unlink = not self._owner
+        if self._lib is not None:
+            if self._h:
+                self._lib.tw_shm_close(self._h, 1 if unlink else 0)
+                self._h = None
+            return
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+            os.close(self._fd)
+            if unlink:
+                try:
+                    os.unlink("/dev/shm" + self.name)
+                except OSError:
+                    pass
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
+
+
+@register_element
+class ShmSink(Element):
+    """Publish the stream into a shared-memory ring (host-local
+    single-copy transport; see module docstring)."""
+
+    FACTORY = "tensor_shm_sink"
+    PROPERTIES = {
+        "path": ("nns-shm", "shm ring name (under /dev/shm)"),
+        "slot-bytes": (DEFAULT_SLOT_BYTES, "max record size"),
+        "slots": (DEFAULT_SLOTS, "ring capacity in records"),
+        "timeout": (10.0, "push timeout (s) when the ring is full"),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+
+    def start(self):
+        self._ring: Optional[ShmRing] = None
+        self._pending_caps = ""
+
+    def stop(self):
+        if self._ring is not None:
+            self._ring.eos()
+            self._ring.close()
+            self._ring = None
+
+    def set_caps(self, pad, caps):
+        # ring is created at caps time so the consumer's negotiate() can
+        # read them from the header (the TCP path's HELLO role)
+        if self._ring is None:
+            self._ring = ShmRing(str(self.path), create=True,
+                                 slot_bytes=int(self.slot_bytes),
+                                 n_slots=int(self.slots), caps=str(caps))
+
+    def chain(self, pad, buf):
+        if self._ring is None:
+            # caps always precede data in this framework (set_caps creates
+            # the ring); a buffer without caps is a bug upstream — fail
+            # loudly rather than publish an un-negotiable capsless ring
+            raise RuntimeError(f"{self.name}: buffer before caps")
+        self._ring.push(encode_tensors(buf), buf.pts or 0,
+                        float(self.timeout))
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            if self._ring is not None:
+                self._ring.eos()
+            self.post_eos_reached()
+
+
+@register_element
+class ShmSrc(Source):
+    """Consume a shared-memory ring published by ``tensor_shm_sink``."""
+
+    FACTORY = "tensor_shm_src"
+    PROPERTIES = {
+        "path": ("nns-shm", "shm ring name (under /dev/shm)"),
+        "caps": (None, "override caps (else the ring header's)"),
+        "timeout": (10.0, "open/pop timeout (s)"),
+        "num-buffers": (-1, "stop after N buffers, -1 unlimited"),
+    }
+
+    def _make_pads(self):
+        self.add_src_pad(tensors_template_caps(), "src")
+
+    def start(self):
+        self._ring: Optional[ShmRing] = None
+        self._count = 0
+
+    def stop(self):
+        self._halt()
+        if self._ring is not None:
+            self._ring.close()   # consumer side unlinks
+            self._ring = None
+
+    def negotiate(self) -> Caps:
+        # the blocking ring-open happens HERE, on the streaming thread —
+        # start() runs synchronously inside Pipeline.play(), and a
+        # not-yet-up producer must not stall the whole pipeline's startup
+        self._ring = ShmRing(str(self.path), create=False,
+                             timeout=float(self.timeout))
+        if self.caps:
+            c = self.caps
+            return Caps.from_string(c) if isinstance(c, str) else c
+        caps = self._ring.caps()
+        if not caps:
+            raise ValueError(f"{self.name}: ring {self.path!r} carries no "
+                             "caps; set the caps property")
+        return Caps.from_string(caps)
+
+    def create(self) -> Optional[TensorBuffer]:
+        n = int(self.num_buffers)
+        if n >= 0 and self._count >= n:
+            return None
+        deadline = time.monotonic() + float(self.timeout)
+        while not self._halted.is_set():
+            try:
+                got = self._ring.pop(timeout=0.1)
+            except TimeoutError:
+                # honor the documented bound: a producer that vanished
+                # without EOS must not hang the pipeline forever
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{self.name}: no data on ring {self.path!r} for "
+                        f"{self.timeout}s and no EOS (producer gone?)")
+                continue
+            if got is None:
+                return None
+            payload, pts = got
+            self._count += 1
+            return TensorBuffer(tensors=decode_tensors(payload), pts=pts)
+        return None

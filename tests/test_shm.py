@@ -1,0 +1,213 @@
+"""Shared-memory ring transport (query/shm.py + native shmring.cc).
+
+Mirrors the reference's strategy of exercising each transport with real
+separate processes (tests/nnstreamer_edge/query/runTest.sh): the ring
+is driven native-to-native, fallback-to-fallback, AND cross
+(native producer / Python consumer — one on-disk layout), plus a
+two-process pipeline test over tensor_shm_sink/src.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.query.shm import ShmRing
+from nnstreamer_tpu import native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _unique(name):
+    return f"{name}-{os.getpid()}-{time.monotonic_ns()}"
+
+
+def _make_py_ring(name, create, **kw):
+    """Build a ShmRing with the native lib masked out."""
+    import nnstreamer_tpu.query.shm as shm_mod
+
+    orig = shm_mod._native_lib
+    shm_mod._native_lib = lambda: None
+    try:
+        return ShmRing(name, create, **kw)
+    finally:
+        shm_mod._native_lib = orig
+
+
+class TestRing:
+    def _roundtrip(self, prod, cons):
+        payloads = [os.urandom(n) for n in (1, 100, 65536)]
+        for i, p in enumerate(payloads):
+            prod.push(p, pts=i * 10)
+        for i, p in enumerate(payloads):
+            got, pts = cons.pop()
+            assert got == p and pts == i * 10
+        prod.eos()
+        assert cons.pop() is None
+
+    def test_python_fallback_ring(self):
+        name = _unique("t-py")
+        prod = _make_py_ring(name, True, slot_bytes=1 << 17, n_slots=4)
+        cons = _make_py_ring(name, False)
+        assert not prod.is_native and not cons.is_native
+        try:
+            self._roundtrip(prod, cons)
+        finally:
+            cons.close()
+            prod.close()
+
+    @pytest.mark.skipif(not native.available(), reason="no native lib")
+    def test_native_ring(self):
+        name = _unique("t-nat")
+        prod = ShmRing(name, True, slot_bytes=1 << 17, n_slots=4)
+        cons = ShmRing(name, False)
+        assert prod.is_native and cons.is_native
+        try:
+            self._roundtrip(prod, cons)
+        finally:
+            cons.close()
+            prod.close()
+
+    @pytest.mark.skipif(not native.available(), reason="no native lib")
+    def test_cross_native_producer_python_consumer(self):
+        """One region layout: the C++ ring and the mmap fallback
+        interoperate in both roles."""
+        name = _unique("t-x1")
+        prod = ShmRing(name, True, slot_bytes=1 << 16, n_slots=4,
+                       caps="other/tensors,format=static")
+        cons = _make_py_ring(name, False)
+        try:
+            assert cons.caps() == "other/tensors,format=static"
+            self._roundtrip(prod, cons)
+        finally:
+            cons.close()
+            prod.close()
+
+    @pytest.mark.skipif(not native.available(), reason="no native lib")
+    def test_cross_python_producer_native_consumer(self):
+        name = _unique("t-x2")
+        prod = _make_py_ring(name, True, slot_bytes=1 << 16, n_slots=4,
+                             caps="other/tensors")
+        cons = ShmRing(name, False)
+        try:
+            assert cons.caps() == "other/tensors"
+            self._roundtrip(prod, cons)
+        finally:
+            cons.close()
+            prod.close()
+
+    def test_backpressure_full_ring_times_out(self):
+        name = _unique("t-full")
+        prod = _make_py_ring(name, True, slot_bytes=256, n_slots=2)
+        try:
+            prod.push(b"a", 0)
+            prod.push(b"b", 1)
+            with pytest.raises(TimeoutError):
+                prod.push(b"c", 2, timeout=0.2)
+        finally:
+            prod.close(unlink=True)   # no consumer will ever unlink it
+
+    def test_oversize_record_rejected(self):
+        name = _unique("t-big")
+        prod = _make_py_ring(name, True, slot_bytes=64, n_slots=2)
+        try:
+            with pytest.raises(ValueError):
+                prod.push(b"x" * 65, 0)
+        finally:
+            prod.close(unlink=True)   # no consumer will ever unlink it
+
+    def test_blocked_producer_resumes_when_consumer_drains(self):
+        name = _unique("t-drain")
+        prod = _make_py_ring(name, True, slot_bytes=256, n_slots=2)
+        cons = _make_py_ring(name, False)
+        try:
+            prod.push(b"a", 0)
+            prod.push(b"b", 1)
+
+            def drain():
+                time.sleep(0.2)
+                cons.pop()
+
+            t = threading.Thread(target=drain)
+            t.start()
+            prod.push(b"c", 2, timeout=5.0)  # unblocks when drain() pops
+            t.join()
+            assert cons.pop()[0] == b"b"
+            assert cons.pop()[0] == b"c"
+        finally:
+            cons.close()
+            prod.close()
+
+
+_PRODUCER = r"""
+import sys
+sys.path.insert(0, {repo!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+from nnstreamer_tpu import parse_launch
+p = parse_launch(
+    "videotestsrc num-buffers=16 pattern=gradient ! "
+    "video/x-raw,format=RGB,width=24,height=24,framerate=60/1 ! "
+    "tensor_converter ! tensor_shm_sink path={name}")
+p.run(timeout=60)
+print("producer done", flush=True)
+"""
+
+
+class TestLateConsumer:
+    def test_producer_done_before_consumer_opens(self):
+        """The producer closing does NOT unlink the ring: a consumer
+        that attaches after the producer is completely gone still drains
+        every record then sees EOS (the late-attach race a socket
+        transport can't survive at all)."""
+        name = _unique("t-late")
+        prod = ShmRing(name, True, slot_bytes=4096, n_slots=8,
+                       caps="other/tensors")
+        for i in range(5):
+            prod.push(f"rec{i}".encode(), i)
+        prod.eos()
+        prod.close()                      # producer fully gone
+        cons = ShmRing(name, False)
+        try:
+            assert cons.caps() == "other/tensors"
+            for i in range(5):
+                payload, pts = cons.pop()
+                assert payload == f"rec{i}".encode() and pts == i
+            assert cons.pop() is None     # EOS
+        finally:
+            cons.close()                  # consumer unlinks
+
+
+class TestShmPipeline:
+    def test_two_process_pipeline_over_shm(self, tmp_path):
+        """Producer pipeline in a separate process, consumer pipeline
+        here; caps negotiate through the ring header; all 16 frames
+        arrive in order with PTS intact."""
+        from nnstreamer_tpu import parse_launch
+
+        name = _unique("t-pipe")
+        prod = subprocess.Popen(
+            [sys.executable, "-c", _PRODUCER.format(repo=REPO, name=name)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        try:
+            p = parse_launch(
+                f"tensor_shm_src path={name} timeout=30 ! "
+                "tensor_sink name=out")
+            got = []
+            p.get("out").connect(
+                "new-data", lambda b: got.append((b.pts, b.tensors[0])))
+            p.run(timeout=60)
+            out, err = prod.communicate(timeout=60)
+            assert prod.returncode == 0, err[-1500:]
+            assert len(got) == 16
+            pts = [g[0] for g in got]
+            assert pts == sorted(pts)
+            assert all(g[1].shape == (3, 24, 24) or g[1].size == 3 * 24 * 24
+                       for g in got)
+        finally:
+            if prod.poll() is None:
+                prod.kill()
