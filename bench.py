@@ -448,9 +448,37 @@ def bench_edge(dtype_prop: str) -> dict:
             recv.stop()
     finally:
         broker.close()
-    return {"metric": "mobilenet_v2_edge_distributed_e2e_fps",
-            "value": round(fps, 2), "unit": "fps",
-            "vs_baseline": round(fps / BASELINE_FPS, 3), "frames": n}
+    out = {"metric": "mobilenet_v2_edge_distributed_e2e_fps",
+           "value": round(fps, 2), "unit": "fps",
+           "vs_baseline": round(fps / BASELINE_FPS, 3), "frames": n}
+    # supplementary: the same dual-pipeline config over the net-new
+    # shared-memory ring (query/shm.py) — what co-located pipelines get
+    # when they skip the socket path.  Headline stays the TCP number
+    # (that's the reference-parity transport).
+    try:
+        ring = f"nns-bench-{os.getpid()}"
+        recv = parse_launch(
+            f"tensor_shm_src path={ring} timeout=60 "
+            f"num-buffers={N_FRAMES} ! "
+            "tensor_filter framework=xla model=mobilenet_v2"
+            f" custom=seed:0{dtype_prop} batch={STREAM_BATCH} name=f ! "
+            f"queue max-size-buffers={max(8, 2 * STREAM_BATCH)} ! "
+            "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+        send = parse_launch(
+            f"videotestsrc num-buffers={N_FRAMES} pattern=random "
+            "cache-frames=64 ! "
+            "video/x-raw,format=RGB,width=224,height=224,framerate=120/1 ! "
+            "tensor_converter ! "
+            f"tensor_shm_sink path={ring} slots=64")
+        try:
+            fps_shm, _ = _measure(recv, "out", feeders=(send,))
+            out["fps_shm_transport"] = round(fps_shm, 2)
+        finally:
+            send.stop()
+            recv.stop()
+    except Exception as exc:  # supplementary only — never fail the row
+        out["fps_shm_transport_error"] = repr(exc)[:160]
+    return out
 
 
 def bench_lm(emit=None) -> dict:
