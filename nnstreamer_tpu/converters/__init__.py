@@ -55,4 +55,4 @@ def list_converters():
 
 
 def _ensure_loaded() -> None:
-    from . import flatbuf, flexbuf, protobuf  # noqa: F401
+    from . import flatbuf, flexbuf, protobuf, python  # noqa: F401

@@ -208,25 +208,37 @@ class PythonScriptDecoder(Decoder):
 
     def set_option(self, index: int, value: str) -> None:
         if index == 1 and value:
-            import importlib.util
-            import sys
+            from ..utils.nns_python_compat import load_user_script
 
-            name = f"_nns_pydec_{abs(hash(value)) & 0xffffff:x}"
-            spec = importlib.util.spec_from_file_location(name, value)
-            mod = importlib.util.module_from_spec(spec)
-            sys.modules[name] = mod
-            spec.loader.exec_module(mod)
-            self._obj = (mod.decoder_instance
-                         if hasattr(mod, "decoder_instance")
-                         else mod.CustomDecoder())
+            got, _ = load_user_script(value, "_nns_pydec",
+                                      "CustomDecoder", "decoder_instance")
+            self._obj = got() if isinstance(got, type) else got
 
     def get_out_caps(self, config: TensorsConfig) -> Caps:
         if self._obj is None:
             raise ValueError("python3 decoder: option1 script required")
+        if hasattr(self._obj, "getOutCaps"):
+            # reference tensordec-python3.cc contract: caps as bytes,
+            # no arguments
+            raw = self._obj.getOutCaps()
+            if isinstance(raw, bytes):
+                raw = raw.decode()
+            return Caps.from_string(str(raw))
         return Caps.from_string(str(self._obj.get_out_caps(config)))
 
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
         tensors = [buf.np(i) for i in range(buf.num_tensors)]
+        if hasattr(self._obj, "getOutCaps"):
+            # reference contract: decode(raw_data, in_info, rate_n,
+            # rate_d) -> serialized bytes (one u8 output tensor)
+            from ..utils.nns_python_compat import from_tensors_info
+
+            raw = [np.ascontiguousarray(t).tobytes() for t in tensors]
+            rate = config.rate or Fraction(0, 1)
+            out = self._obj.decode(raw, from_tensors_info(config.info),
+                                   rate.numerator, rate.denominator)
+            arr = np.frombuffer(bytes(out), dtype=np.uint8).copy()
+            return buf.with_tensors([arr])
         out = self._obj.decode(tensors, config)
         if not isinstance(out, (list, tuple)):
             out = [out]

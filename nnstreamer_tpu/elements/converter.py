@@ -116,9 +116,16 @@ class TensorConverter(Element):
         mode = self.mode
         if mode:
             kind, _, name = str(mode).partition(":")
-            from ..converters import find_converter
+            if kind == "custom-script":
+                # reference tensor_converter_python3.cc contract: the
+                # mode value is a .py file path
+                from ..converters.python import PythonScriptConverter
 
-            self._custom = find_converter(name)
+                self._custom = PythonScriptConverter(name)
+            else:
+                from ..converters import find_converter
+
+                self._custom = find_converter(name)
 
     # -- negotiation ---------------------------------------------------------
     def set_caps(self, pad, caps):

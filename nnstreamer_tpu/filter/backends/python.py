@@ -18,11 +18,8 @@ Script contract: define ``class CustomFilter`` (or a module-level
 
 from __future__ import annotations
 
-import importlib.util
-import os
-import sys
 import time
-from typing import Any, List, Tuple
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -35,6 +32,12 @@ from ..framework import (Accelerator, FilterError, FilterFramework,
 def _coerce_info(value) -> TensorsInfo:
     if isinstance(value, TensorsInfo):
         return value
+    value = list(value)
+    # reference-API scripts return list[nns.TensorShape]
+    if value and hasattr(value[0], "getDims"):
+        from ...utils import nns_python_compat
+
+        return nns_python_compat.to_tensors_info(value)
     # list of (dims, dtype) pairs, dims innermost-first like the reference
     infos = []
     for dims, dtype in value:
@@ -57,29 +60,58 @@ class PythonFilter(FilterFramework):
 
     def open(self, props: FilterProperties) -> None:
         path = str(props.model)
-        if not os.path.exists(path):
-            raise FilterError(f"python: script not found: {path}")
-        name = f"_nns_pyfilter_{abs(hash(path)) & 0xffffff:x}"
-        spec = importlib.util.spec_from_file_location(name, path)
-        mod = importlib.util.module_from_spec(spec)
-        sys.modules[name] = mod
-        spec.loader.exec_module(mod)
-        if hasattr(mod, "filter_instance"):
-            self._obj = mod.filter_instance
-        elif hasattr(mod, "CustomFilter"):
-            self._obj = mod.CustomFilter()
+        from ...utils.nns_python_compat import load_user_script
+
+        try:
+            got, self._ref_style = load_user_script(
+                path, "_nns_pyfilter", "CustomFilter", "filter_instance")
+        except (FileNotFoundError, AttributeError) as exc:
+            raise FilterError(f"python: {exc}") from exc
+        if isinstance(got, type):
+            if self._ref_style:
+                # reference contract: the whole custom string is ONE
+                # constructor argument (tensor_filter_python3.cc passes
+                # it verbatim, e.g. custom=640x480)
+                custom = ",".join(
+                    k if not v else f"{k}:{v}"
+                    for k, v in props.custom_properties.items())
+                self._obj = got(custom) if custom else got()
+            else:
+                self._obj = got()
         else:
-            raise FilterError(
-                f"python: {path} defines neither CustomFilter nor "
-                "filter_instance")
+            self._obj = got
         super().open(props)
+        self._negotiated: Optional[Tuple[TensorsInfo, TensorsInfo]] = None
+        if not hasattr(self._obj, "getInputDim"):
+            # setInputDim-only script (reference scaler.py shape): its
+            # meta comes from negotiation; with a forced input-dim
+            # (Single API / input-dim prop) negotiate once at open
+            if props.input_info is None:
+                raise FilterError(
+                    "python: script has no getInputDim — set input-dim/"
+                    "input-type (or input_info) so setInputDim can run")
+            self._negotiated = self.set_input_info(props.input_info)
 
     def get_model_info(self) -> Tuple[TensorsInfo, TensorsInfo]:
+        if self._negotiated is not None:
+            return self._negotiated
         return (_coerce_info(self._obj.getInputDim()),
                 _coerce_info(self._obj.getOutputDim()))
 
     def set_input_info(self, in_info: TensorsInfo):
         if hasattr(self._obj, "setInputDim"):
+            if self._ref_style:
+                # reference contract: setInputDim(list[TensorShape]) ->
+                # output TensorShape list (input accepted as-is)
+                from ...utils import nns_python_compat
+
+                got = self._obj.setInputDim(
+                    nns_python_compat.from_tensors_info(in_info))
+                if got is None:
+                    raise FilterError("python: setInputDim rejected the "
+                                      f"input meta {in_info}")
+                return in_info, _coerce_info(got)
+            # native contract: setInputDim(TensorsInfo) -> (in, out)
             new_in, new_out = self._obj.setInputDim(in_info)
             return _coerce_info(new_in), _coerce_info(new_out)
         return super().set_input_info(in_info)

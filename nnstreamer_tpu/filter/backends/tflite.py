@@ -153,6 +153,9 @@ _STATIC_OPERANDS: Dict[int, Sequence[int]] = {
     56: (1,),        # ARG_MAX axis
     79: (1,),        # ARG_MIN axis
     67: (0,),        # TRANSPOSE_CONV output_shape
+    # 130 BROADCAST_TO is absent on purpose: its shape operand is often
+    # COMPUTED shape arithmetic (SHAPE -> BROADCAST_ARGS) that constant-
+    # folds to numpy — the handler checks concreteness itself
 }
 
 # operands whose handler can recover from a non-constant tensor via the op's
@@ -468,6 +471,11 @@ class _Lowerer:
             outs = [outs]
         for t, v in zip(op.outputs, outs):
             env[t] = self._clamp_to_qrange(t, v)
+            if isinstance(v, np.ndarray):
+                # constant-folded result (SHAPE / shape arithmetic —
+                # runtime data is always a tracer or device array here):
+                # register it so _STATIC_OPERANDS consumers accept it
+                self.static[t] = v
 
     def _clamp_to_qrange(self, t: int, v):
         """Emulate requantization saturation: quantized tflite graphs encode
@@ -611,6 +619,29 @@ def _unary(fn):
     def run(ins, opts, statics):
         return fn(ins[0])
     return run
+
+
+def _broadcast_args(ins, opts, statics):
+    """BROADCAST_ARGS: broadcastable result shape of two shape vectors.
+    Under XLA every shape is static, so both operands must be concrete
+    (constants or SHAPE results) and the result stays concrete."""
+    a, b = ins
+    if not isinstance(a, np.ndarray) or not isinstance(b, np.ndarray):
+        raise FilterError("tflite: BROADCAST_ARGS on a computed (dynamic) "
+                          "shape — unsupported under XLA static shapes")
+    out = np.broadcast_shapes(tuple(int(d) for d in a),
+                              tuple(int(d) for d in b))
+    return np.asarray(out, a.dtype)
+
+
+def _broadcast_to(ins, opts, statics):
+    import jax.numpy as jnp
+
+    shape = ins[1]   # graph constant (via _val) or constant-folded (145)
+    if not isinstance(shape, np.ndarray):
+        raise FilterError("tflite: BROADCAST_TO with a computed (dynamic) "
+                          "shape — unsupported under XLA static shapes")
+    return jnp.broadcast_to(ins[0], tuple(int(d) for d in shape))
 
 
 def _reshape(ins, opts, statics):
@@ -888,7 +919,11 @@ def _build_handlers() -> Dict[int, Callable]:
         59: _unary(jnp.negative), 66: _unary(jnp.sin),
         67: _transpose_conv, 70: _expand_dims,
         75: _unary(jnp.sqrt), 76: _unary(lambda x: 1.0 / jnp.sqrt(x)),
-        77: _unary(lambda x: jnp.asarray(x.shape, jnp.int32)),  # SHAPE
+        # SHAPE: numpy (not traced) — shapes are static under XLA, and a
+        # concrete result lets downstream shape arithmetic constant-fold
+        # (the result is re-registered as a graph constant by _run_op)
+        77: _unary(lambda x: np.asarray(x.shape, np.int32)),
+        130: _broadcast_to, 145: _broadcast_args,
         83: _pack, 88: _unpack,
         92: _unary(jnp.square), 101: _unary(jnp.abs),
         98: lambda ins, o, s: jnp.where(

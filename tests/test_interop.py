@@ -113,6 +113,31 @@ class TestTFLiteParser:
             fw.close()
 
     @needs_ref
+    def test_rank5_two_input_model(self):
+        """The reference's rank-5 multi-input fixture
+        (sample_4x4x4x4x4_two_input_one_output.tflite, used by its
+        high-rank tensor suites): two 4^5 inputs, output = x + y —
+        exercises rank>4 shape plumbing through the flatbuffer parser
+        and the XLA lowering (its .pt twin is covered in
+        test_torchscript)."""
+        props = FilterProperties(
+            framework="tensorflow-lite",
+            model=os.path.join(
+                REF_MODELS, "sample_4x4x4x4x4_two_input_one_output.tflite"))
+        fw = open_backend(props)
+        try:
+            ii, oi = fw.get_model_info()
+            assert ii.num_tensors == 2
+            assert oi[0].np_shape[-5:] == (4, 4, 4, 4, 4)
+            rng = np.random.default_rng(5)
+            x = rng.standard_normal(ii[0].np_shape).astype(np.float32)
+            y = rng.standard_normal(ii[1].np_shape).astype(np.float32)
+            (o,) = fw.invoke([x, y])
+            np.testing.assert_allclose(np.asarray(o), x + y, rtol=1e-6)
+        finally:
+            fw.close()
+
+    @needs_ref
     def test_add_model_bf16_compute(self):
         """compute:bfloat16 keeps the external f32 interface (host cast)
         and matches the f32 path within bf16 tolerance."""
