@@ -83,9 +83,18 @@ def _conv2d(x, w, b, stride, padding, dilation, groups):
 def _conv_transpose2d(x, w, b, stride, padding, output_padding, dilation,
                       groups):
     from jax import lax
+    import jax.numpy as jnp
 
     if int(groups) != 1:
-        raise UnsupportedTorchOp("grouped conv_transpose2d")
+        # torch convT weight is (in, out//g, kh, kw) with groups along
+        # the IN axis: run each group through the single-group path and
+        # concat output channels — XLA fuses the slices
+        g = int(groups)
+        ys = [_conv_transpose2d(xi, wi, None, stride, padding,
+                                output_padding, dilation, 1)
+              for xi, wi in zip(jnp.split(x, g, axis=1),
+                                jnp.split(w, g, axis=0))]
+        return _bias(jnp.concatenate(ys, axis=1), b)
     sh, sw = _pair(stride)
     ph, pw = _pair(padding)
     oph, opw = _pair(output_padding)
@@ -106,27 +115,48 @@ def _bias(y, b):
     return y if b is None else y + b.reshape(1, -1, 1, 1)
 
 
+def _ceil_extra(in_sz: int, k: int, s: int, p: int) -> int:
+    """Extra right/bottom padding that makes floor-mode output match
+    torch's ceil_mode size.  Torch rule (Pooling.h): the output grows by
+    one only if that last window STARTS inside input+left-padding."""
+    span = in_sz + 2 * p - k
+    out = span // s + 1
+    if span % s:
+        if (out * s) < in_sz + p:     # last window starts in-bounds
+            out += 1
+    return max((out - 1) * s + k - (in_sz + 2 * p), 0)
+
+
 def _pool2d(x, kernel, stride, padding, reducer, init, ceil_mode=False,
             count_include_pad=True):
     from jax import lax
     import jax.numpy as jnp
 
-    if ceil_mode:
-        raise UnsupportedTorchOp("pool2d ceil_mode")
     kh, kw = _pair(kernel)
     sh, sw = _pair(stride) if stride not in (None, []) else (kh, kw)
     ph, pw = _pair(padding)
+    eh = _ceil_extra(x.shape[2], kh, sh, ph) if ceil_mode else 0
+    ew = _ceil_extra(x.shape[3], kw, sw, pw) if ceil_mode else 0
     dims = (1, 1, kh, kw)
     strides = (1, 1, sh, sw)
-    pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    pads = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
     init = np.asarray(init, x.dtype)[()]
     y = lax.reduce_window(x, init, reducer, dims, strides, pads)
     if reducer is lax.add:  # average pool
-        if count_include_pad or (ph == 0 and pw == 0):
+        if (count_include_pad or (ph == 0 and pw == 0)) and not ceil_mode:
             y = y / (kh * kw)
         else:
+            # divisor = cells inside input (+ regular padding when
+            # count_include_pad) — ceil-extra cells never count (torch)
             ones = jnp.ones(x.shape, x.dtype)
-            cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+            if count_include_pad:
+                ones = jnp.pad(ones, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                               constant_values=1)
+                cnt_pads = ((0, 0), (0, 0), (0, eh), (0, ew))
+            else:
+                cnt_pads = pads
+            cnt = lax.reduce_window(ones, np.asarray(0.0, x.dtype)[()],
+                                    lax.add, dims, strides, cnt_pads)
             y = y / cnt
     return y
 

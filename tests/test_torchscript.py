@@ -225,19 +225,62 @@ class TestPyTorchBackendXLA:
         finally:
             fw.close()
 
-    def test_fallback_reason_names_ceil_mode_pooling(self, tmp_path):
-        """The round-3 verdict case: ceil_mode pooling silently served
-        from host — now the reason carries the op detail."""
+    def test_ceil_mode_pooling_lowers(self, tmp_path):
+        """The round-3 verdict case (ceil_mode served from host) is now
+        LOWERED: floor-mode padding extended per torch's output-size
+        rule; max and avg (both count_include_pad settings) match the
+        torch oracle, incl. the window-must-start-in-bounds corner."""
         class M(torch.nn.Module):
             def forward(self, x):
-                return torch.nn.functional.max_pool2d(x, 2, ceil_mode=True)
+                a = torch.nn.functional.max_pool2d(x, 2, ceil_mode=True)
+                b = torch.nn.functional.avg_pool2d(
+                    x, 3, stride=2, padding=1, ceil_mode=True)
+                c = torch.nn.functional.avg_pool2d(
+                    x, 3, stride=2, padding=1, ceil_mode=True,
+                    count_include_pad=False)
+                return a.sum() + b.sum() + c.sum()
 
+        x0 = torch.randn(1, 1, 5, 5)
         path = str(tmp_path / "ceil.pt")
-        torch.jit.trace(M().eval(), torch.zeros(1, 1, 5, 5)).save(path)
+        m = M().eval()
+        torch.jit.trace(m, x0).save(path)
         fw, _ = self._open(path, ("5:5:1:1", "float32"))
         try:
-            assert fw.executor == "torch-host"
-            assert "ceil_mode" in fw.fallback_reason
+            assert fw.executor == "xla"
+            x = np.random.default_rng(0).standard_normal(
+                (1, 1, 5, 5)).astype(np.float32)
+            (got,) = fw.invoke([x])
+            want = m(torch.from_numpy(x)).detach().numpy()
+            np.testing.assert_allclose(np.asarray(got).reshape(want.shape),
+                                       want, rtol=1e-5, atol=1e-5)
+        finally:
+            fw.close()
+
+    def test_grouped_conv_transpose_lowers(self, tmp_path):
+        class M(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.t = torch.nn.ConvTranspose2d(
+                    4, 6, 3, stride=2, padding=1, output_padding=1,
+                    groups=2)
+
+            def forward(self, x):
+                return self.t(x)
+
+        torch.manual_seed(0)
+        m = M().eval()
+        x0 = torch.randn(1, 4, 7, 7)
+        path = str(tmp_path / "gct.pt")
+        torch.jit.trace(m, x0).save(path)
+        fw, _ = self._open(path, ("7:7:4:1", "float32"))
+        try:
+            assert fw.executor == "xla"
+            x = np.random.default_rng(1).standard_normal(
+                (1, 4, 7, 7)).astype(np.float32)
+            (got,) = fw.invoke([x])
+            want = m(torch.from_numpy(x)).detach().numpy()
+            np.testing.assert_allclose(np.asarray(got).reshape(want.shape),
+                                       want, rtol=1e-4, atol=1e-4)
         finally:
             fw.close()
 
@@ -246,12 +289,12 @@ class TestPyTorchBackendXLA:
 
         class M(torch.nn.Module):
             def forward(self, x):
-                return torch.nn.functional.max_pool2d(x, 2, ceil_mode=True)
+                return torch.fft.rfft(x).real
 
-        path = str(tmp_path / "ceil.pt")
-        torch.jit.trace(M().eval(), torch.zeros(1, 1, 5, 5)).save(path)
-        with pytest.raises(FilterError, match="ceil_mode"):
-            self._open(path, ("5:5:1:1", "float32"), strict="true")
+        path = str(tmp_path / "fftm.pt")
+        torch.jit.trace(M().eval(), torch.zeros(8)).save(path)
+        with pytest.raises(FilterError, match="fft"):
+            self._open(path, ("8", "float32"), strict="true")
 
     def test_strict_contradicts_executor_torch(self, tmp_path):
         from nnstreamer_tpu.filter.framework import FilterError
