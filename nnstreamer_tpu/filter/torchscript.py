@@ -381,6 +381,27 @@ def _make_handlers() -> Dict[str, Callable]:
         "aten::layer_norm": lambda a: _layer_norm(*a),
         "aten::cat": lambda a: jnp.concatenate(a[0], axis=int(a[1])),
         "aten::stack": lambda a: jnp.stack(a[0], axis=int(a[1])),
+        # FFT family: native on the XLA TPU backend (ops/audio.py already
+        # rides jnp.fft for AudioSpectrogram); torch signature
+        # fft_*(input, n, dim, norm)
+        "aten::fft_fft": lambda a: jnp.fft.fft(
+            a[0], n=None if len(a) < 2 or a[1] is None else int(a[1]),
+            axis=int(a[2]) if len(a) > 2 and a[2] is not None else -1,
+            norm=a[3] if len(a) > 3 else None),
+        "aten::fft_ifft": lambda a: jnp.fft.ifft(
+            a[0], n=None if len(a) < 2 or a[1] is None else int(a[1]),
+            axis=int(a[2]) if len(a) > 2 and a[2] is not None else -1,
+            norm=a[3] if len(a) > 3 else None),
+        "aten::fft_rfft": lambda a: jnp.fft.rfft(
+            a[0], n=None if len(a) < 2 or a[1] is None else int(a[1]),
+            axis=int(a[2]) if len(a) > 2 and a[2] is not None else -1,
+            norm=a[3] if len(a) > 3 else None),
+        "aten::fft_irfft": lambda a: jnp.fft.irfft(
+            a[0], n=None if len(a) < 2 or a[1] is None else int(a[1]),
+            axis=int(a[2]) if len(a) > 2 and a[2] is not None else -1,
+            norm=a[3] if len(a) > 3 else None),
+        "aten::real": lambda a: jnp.real(a[0]),
+        "aten::imag": lambda a: jnp.imag(a[0]),
         "aten::mean": aten_mean,
         "aten::sum": aten_sum,
         "aten::max": aten_max,
@@ -570,10 +591,20 @@ def _adaptive_avg(x, out_size):
 
     oh, ow = int(out_size[0]), int(out_size[1])
     n, c, ih, iw = x.shape
-    if ih % oh or iw % ow:
-        raise UnsupportedTorchOp(
-            f"adaptive_avg_pool2d {ih}x{iw} -> {oh}x{ow} (non-divisible)")
-    return jnp.mean(x.reshape(n, c, oh, ih // oh, ow, iw // ow), (3, 5))
+    if ih % oh == 0 and iw % ow == 0:
+        return jnp.mean(x.reshape(n, c, oh, ih // oh, ow, iw // ow), (3, 5))
+    # non-divisible: torch windows start=floor(i·I/O), end=ceil((i+1)·I/O)
+    # — all static, so unroll the (small) output grid into slices XLA
+    # fuses; no dynamic shapes involved
+    rows = []
+    for i in range(oh):
+        h0, h1 = (i * ih) // oh, -(-((i + 1) * ih) // oh)
+        cols = []
+        for j in range(ow):
+            w0, w1 = (j * iw) // ow, -(-((j + 1) * iw) // ow)
+            cols.append(jnp.mean(x[:, :, h0:h1, w0:w1], axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
 
 
 def _const_value(node):

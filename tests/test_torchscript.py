@@ -118,7 +118,7 @@ class TestLoweringOracle:
 
         class M(torch.nn.Module):
             def forward(self, x):
-                return torch.fft.fft(x).real
+                return torch.nonzero(x)
 
         scripted = torch.jit.script(M().eval())
         with pytest.raises(UnsupportedTorchOp):
@@ -206,22 +206,67 @@ class TestPyTorchBackendXLA:
             fw.close()
 
     def test_unlowerable_graph_falls_back_to_host(self, tmp_path):
+        """nonzero is the canonical unlowerable op: its output SHAPE is
+        data-dependent, which XLA's static-shape model cannot express —
+        the host interpreter serves it, with the blocker named."""
         class M(torch.nn.Module):
             def forward(self, x):
-                return torch.fft.fft(x).real
+                return torch.nonzero(x).to(torch.float32).sum(dim=0)
 
         scripted = torch.jit.script(M().eval())
-        path = str(tmp_path / "fft.pt")
+        path = str(tmp_path / "nz.pt")
         scripted.save(path)
         fw, _ = self._open(path, ("8", "float32"))
         try:
             assert fw.executor == "torch-host"
             # the blocking op is NAMED, for --stats and the logs
-            assert "fft" in fw.fallback_reason
-            x = np.arange(8, dtype=np.float32)
+            assert "nonzero" in fw.fallback_reason
+            x = np.array([0, 1, 0, 2, 3, 0, 0, 4], np.float32)
             (got,) = fw.invoke([x])
-            want = np.fft.fft(x).real.astype(np.float32)
+            want = M()(torch.from_numpy(x)).numpy()
             np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        finally:
+            fw.close()
+
+    def test_fft_family_lowers(self, tmp_path):
+        """fft/rfft (+ real/imag) compile onto the device path — XLA has
+        native FFT; the host-fallback example moved to nonzero."""
+        class M(torch.nn.Module):
+            def forward(self, x):
+                f = torch.fft.fft(x)
+                return f.real + f.imag + torch.fft.rfft(x).real.sum()
+
+        m = M().eval()
+        x = np.random.default_rng(7).standard_normal(16).astype(np.float32)
+        path = str(tmp_path / "fft.pt")
+        torch.jit.trace(m, torch.from_numpy(x)).save(path)
+        fw, _ = self._open(path, ("16", "float32"))
+        try:
+            assert fw.executor == "xla"
+            (got,) = fw.invoke([x])
+            want = m(torch.from_numpy(x)).numpy()
+            np.testing.assert_allclose(np.asarray(got), want,
+                                       rtol=1e-4, atol=1e-4)
+        finally:
+            fw.close()
+
+    def test_adaptive_avg_pool_non_divisible(self, tmp_path):
+        class M(torch.nn.Module):
+            def forward(self, x):
+                return torch.nn.functional.adaptive_avg_pool2d(x, (3, 5))
+
+        m = M().eval()
+        x = np.random.default_rng(8).standard_normal(
+            (1, 2, 7, 11)).astype(np.float32)
+        path = str(tmp_path / "ada.pt")
+        torch.jit.trace(m, torch.from_numpy(x)).save(path)
+        fw, _ = self._open(path, ("11:7:2:1", "float32"))
+        try:
+            assert fw.executor == "xla"
+            (got,) = fw.invoke([x])
+            want = m(torch.from_numpy(x)).numpy()
+            np.testing.assert_allclose(np.asarray(got).reshape(want.shape),
+                                       want, rtol=1e-5, atol=1e-5)
         finally:
             fw.close()
 
@@ -289,11 +334,11 @@ class TestPyTorchBackendXLA:
 
         class M(torch.nn.Module):
             def forward(self, x):
-                return torch.fft.rfft(x).real
+                return torch.nonzero(x).to(torch.float32).sum(dim=0)
 
-        path = str(tmp_path / "fftm.pt")
-        torch.jit.trace(M().eval(), torch.zeros(8)).save(path)
-        with pytest.raises(FilterError, match="fft"):
+        path = str(tmp_path / "nzm.pt")
+        torch.jit.script(M().eval()).save(path)
+        with pytest.raises(FilterError, match="nonzero"):
             self._open(path, ("8", "float32"), strict="true")
 
     def test_strict_contradicts_executor_torch(self, tmp_path):
@@ -311,9 +356,9 @@ class TestPyTorchBackendXLA:
 
         class M(torch.nn.Module):
             def forward(self, x):
-                return torch.fft.fft(x).real
+                return torch.nonzero(x).to(torch.float32).sum(dim=0)
 
-        path = str(tmp_path / "fft.pt")
+        path = str(tmp_path / "nz.pt")
         torch.jit.script(M().eval()).save(path)
         props = FilterProperties(
             framework="pytorch", model=path,
