@@ -128,21 +128,27 @@ def _ceil_extra(in_sz: int, k: int, s: int, p: int) -> int:
 
 
 def _pool2d(x, kernel, stride, padding, reducer, init, ceil_mode=False,
-            count_include_pad=True):
+            count_include_pad=True, dilation=(1, 1),
+            divisor_override=None):
     from jax import lax
     import jax.numpy as jnp
 
     kh, kw = _pair(kernel)
     sh, sw = _pair(stride) if stride not in (None, []) else (kh, kw)
     ph, pw = _pair(padding)
-    eh = _ceil_extra(x.shape[2], kh, sh, ph) if ceil_mode else 0
-    ew = _ceil_extra(x.shape[3], kw, sw, pw) if ceil_mode else 0
+    dh, dw = _pair(dilation)
+    keh, kew = (kh - 1) * dh + 1, (kw - 1) * dw + 1  # effective spans
+    eh = _ceil_extra(x.shape[2], keh, sh, ph) if ceil_mode else 0
+    ew = _ceil_extra(x.shape[3], kew, sw, pw) if ceil_mode else 0
     dims = (1, 1, kh, kw)
     strides = (1, 1, sh, sw)
     pads = ((0, 0), (0, 0), (ph, ph + eh), (pw, pw + ew))
     init = np.asarray(init, x.dtype)[()]
-    y = lax.reduce_window(x, init, reducer, dims, strides, pads)
+    y = lax.reduce_window(x, init, reducer, dims, strides, pads,
+                          window_dilation=(1, 1, dh, dw))
     if reducer is lax.add:  # average pool
+        if divisor_override is not None:
+            return y / divisor_override
         if (count_include_pad or (ph == 0 and pw == 0)) and not ceil_mode:
             y = y / (kh * kw)
         else:
@@ -557,23 +563,22 @@ def _max_pool2d(args):
     from jax import lax
 
     a = list(args)
-    if len(a) > 4 and a[4] not in (None, 1, [1, 1], (1, 1)):
-        raise UnsupportedTorchOp(f"max_pool2d dilation {a[4]!r}")
+    dil = _pair(a[4]) if len(a) > 4 and a[4] not in (None, 1) else (1, 1)
     return _pool2d(a[0], a[1], a[2] if len(a) > 2 else None,
                    a[3] if len(a) > 3 else 0, lax.max, -np.inf,
-                   ceil_mode=bool(a[5]) if len(a) > 5 else False)
+                   ceil_mode=bool(a[5]) if len(a) > 5 else False,
+                   dilation=dil)
 
 
 def _avg_pool2d(args):
     from jax import lax
 
     a = list(args)
-    if len(a) > 6 and a[6] is not None:
-        raise UnsupportedTorchOp(f"avg_pool2d divisor_override {a[6]!r}")
     return _pool2d(a[0], a[1], a[2] if len(a) > 2 else None,
                    a[3] if len(a) > 3 else 0, lax.add, 0.0,
                    ceil_mode=bool(a[4]) if len(a) > 4 else False,
-                   count_include_pad=bool(a[5]) if len(a) > 5 else True)
+                   count_include_pad=bool(a[5]) if len(a) > 5 else True,
+                   divisor_override=(a[6] if len(a) > 6 else None))
 
 
 def _expand(x, sizes):

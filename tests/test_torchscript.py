@@ -250,6 +250,32 @@ class TestPyTorchBackendXLA:
         finally:
             fw.close()
 
+    def test_dilated_max_pool_and_divisor_override(self, tmp_path):
+        class M(torch.nn.Module):
+            def forward(self, x):
+                a = torch.nn.functional.max_pool2d(
+                    x, 3, stride=1, padding=1, dilation=2)
+                b = torch.nn.functional.max_pool2d(
+                    x, 2, stride=2, dilation=1, ceil_mode=True)
+                c = torch.nn.functional.avg_pool2d(
+                    x, 3, stride=2, padding=1, divisor_override=5)
+                return a.sum() + b.sum() + c.sum()
+
+        m = M().eval()
+        x = np.random.default_rng(9).standard_normal(
+            (1, 2, 9, 9)).astype(np.float32)
+        path = str(tmp_path / "dil.pt")
+        torch.jit.trace(m, torch.from_numpy(x)).save(path)
+        fw, _ = self._open(path, ("9:9:2:1", "float32"))
+        try:
+            assert fw.executor == "xla"
+            (got,) = fw.invoke([x])
+            want = m(torch.from_numpy(x)).numpy()
+            np.testing.assert_allclose(np.asarray(got).reshape(want.shape),
+                                       want, rtol=1e-5, atol=1e-5)
+        finally:
+            fw.close()
+
     def test_adaptive_avg_pool_non_divisible(self, tmp_path):
         class M(torch.nn.Module):
             def forward(self, x):
