@@ -420,10 +420,8 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
     return out
 
 
-def bench_edge(dtype_prop: str) -> dict:
-    """BASELINE config 5: distributed pipeline over the edge transport
-    (sender and receiver as two pipelines through the TCP broker — the
-    localhost twin of the reference's 2-host query/edge tests)."""
+def _edge_pass(dtype_prop: str):
+    """One full dual-pipeline edge pass (fresh broker + both pipelines)."""
     from nnstreamer_tpu import parse_launch
     from nnstreamer_tpu.query.edge import get_broker
 
@@ -437,20 +435,36 @@ def bench_edge(dtype_prop: str) -> dict:
             f"queue max-size-buffers={max(8, 2 * STREAM_BATCH)} ! "
             "tensor_decoder mode=image_labeling ! tensor_sink name=out")
         send = parse_launch(
-            f"videotestsrc num-buffers={N_FRAMES} pattern=random cache-frames=64 ! "
+            f"videotestsrc num-buffers={N_FRAMES} pattern=random "
+            "cache-frames=64 ! "
             "video/x-raw,format=RGB,width=224,height=224,framerate=120/1 ! "
             "tensor_converter ! "
             f"edge_sink port={broker.port} topic=bench")
         try:
-            fps, n = _measure(recv, "out", feeders=(send,))
+            return _measure(recv, "out", feeders=(send,))
         finally:
             send.stop()
             recv.stop()
     finally:
         broker.close()
+
+
+def bench_edge(dtype_prop: str) -> dict:
+    """BASELINE config 5: distributed pipeline over the edge transport
+    (sender and receiver as two pipelines through the TCP broker — the
+    localhost twin of the reference's 2-host query/edge tests).  Two
+    full passes, headline = the slower (same stability policy as every
+    other config; this row was single-pass through round 4's first
+    capture)."""
+    from nnstreamer_tpu import parse_launch
+
+    fps1, n = _edge_pass(dtype_prop)
+    fps2, _ = _edge_pass(dtype_prop)
+    fps = min(fps1, fps2)
     out = {"metric": "mobilenet_v2_edge_distributed_e2e_fps",
            "value": round(fps, 2), "unit": "fps",
-           "vs_baseline": round(fps / BASELINE_FPS, 3), "frames": n}
+           "vs_baseline": round(fps / BASELINE_FPS, 3), "frames": n,
+           "fps_run1": round(fps1, 2), "fps_run2": round(fps2, 2)}
     # supplementary: the same dual-pipeline config over the net-new
     # shared-memory ring (query/shm.py) — what co-located pipelines get
     # when they skip the socket path.  Headline stays the TCP number
@@ -469,7 +483,10 @@ def bench_edge(dtype_prop: str) -> dict:
             "cache-frames=64 ! "
             "video/x-raw,format=RGB,width=224,height=224,framerate=120/1 ! "
             "tensor_converter ! "
-            f"tensor_shm_sink path={ring} slots=64")
+            # push timeout must ride out the consumer's one-time model
+            # compile (the ring fills long before the filter's first
+            # drain on a cold cache)
+            f"tensor_shm_sink path={ring} slots=64 timeout=300")
         try:
             fps_shm, _ = _measure(recv, "out", feeders=(send,))
             out["fps_shm_transport"] = round(fps_shm, 2)
