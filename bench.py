@@ -458,9 +458,9 @@ def bench_edge(dtype_prop: str) -> dict:
     capture)."""
     from nnstreamer_tpu import parse_launch
 
-    fps1, n = _edge_pass(dtype_prop)
-    fps2, _ = _edge_pass(dtype_prop)
-    fps = min(fps1, fps2)
+    fps1, n1 = _edge_pass(dtype_prop)
+    fps2, n2 = _edge_pass(dtype_prop)
+    fps, n = min((fps1, n1), (fps2, n2))  # frames from the headline run
     out = {"metric": "mobilenet_v2_edge_distributed_e2e_fps",
            "value": round(fps, 2), "unit": "fps",
            "vs_baseline": round(fps / BASELINE_FPS, 3), "frames": n,

@@ -95,6 +95,24 @@ class DetectedObject:
     label: Optional[str] = None
 
 
+def ssd_topcls(xp, scores):
+    """Background-skipping per-anchor top class: (N, C) -> (cls, score).
+    ``xp`` is numpy (host decode) or jax.numpy (fused device decode) —
+    ONE copy of the background-offset convention for both paths."""
+    cls = xp.argmax(scores[:, 1:], axis=1) + 1
+    return cls, xp.max(scores[:, 1:], axis=1)
+
+
+def ssd_prior_decode(xp, boxes, priors):
+    """SSD box regression -> corner coordinates (reference variances
+    10/5, _get_objects_mobilenet_ssd): one copy for host and device."""
+    cy = boxes[:, 0] / 10.0 * priors[2] + priors[0]
+    cx = boxes[:, 1] / 10.0 * priors[3] + priors[1]
+    h = xp.exp(boxes[:, 2] / 5.0) * priors[2]
+    w = xp.exp(boxes[:, 3] / 5.0) * priors[3]
+    return cy - h / 2, cx - w / 2, cy + h / 2, cx + w / 2
+
+
 def nms(objs: List[DetectedObject], iou_thresh: float = NMS_IOU
         ) -> List[DetectedObject]:
     """Greedy per-class NMS (reference boundingbox NMS)."""
@@ -200,10 +218,15 @@ class BoundingBoxDecoder(Decoder):
 
     # -- per-scheme decode ---------------------------------------------------
     def device_reduce_spec(self, config):
-        """Pushdown for the mobilenet-ssd scheme: the decode is
-        top-1-per-anchor, so reduce the (N, C) score matrix to per-anchor
-        (class, score) on device — SSD-300 fetches ~15 KB/frame instead of
-        ~700 KB."""
+        """Pushdown for the mobilenet-ssd scheme.
+
+        Without priors: reduce the (N, C) score matrix to per-anchor
+        (class, score) on device — SSD-300 fetches ~15 KB/frame instead
+        of ~700 KB.  With priors (option3), the ENTIRE detection tail
+        runs on device — prior decode, threshold, top-K cap, greedy
+        per-class NMS (ops/nms.py) — and only the ≤DETECTION_MAX
+        surviving boxes cross device→host (~2.4 KB/frame), in the
+        ssd-postprocess output contract (boxes/classes/scores/num)."""
         if self.scheme != "mobilenet-ssd" or config.info.num_tensors != 2:
             return None
         boxes_i, scores_i = config.info[0], config.info[1]
@@ -214,6 +237,32 @@ class BoundingBoxDecoder(Decoder):
 
         from ..tensor.info import TensorInfo, TensorsInfo
         from ..tensor.types import TensorType
+
+        if self.priors is not None and self.priors.shape[1] >= n:
+            from ..ops.nms import device_nms
+
+            priors = jnp.asarray(self.priors[:, :n], jnp.float32)
+            thr = float(self._threshold(DEFAULT_THRESHOLD))
+            k = min(DETECTION_MAX, n)
+
+            def fn(outs):
+                boxes, scores = outs
+                boxes = boxes.reshape(-1, 4)[:n].astype(jnp.float32)
+                scores = scores.reshape(n, -1)
+                cls, sc = ssd_topcls(jnp, scores)
+                corners = jnp.stack(
+                    ssd_prior_decode(jnp, boxes, priors), axis=1)
+                return list(device_nms(corners, sc.astype(jnp.float32),
+                                       cls.astype(jnp.int32), k=k,
+                                       iou_thresh=NMS_IOU,
+                                       score_thresh=thr))
+
+            reduced = TensorsInfo([
+                TensorInfo(TensorType.FLOAT32, (4, k)),
+                TensorInfo(TensorType.INT32, (k,)),
+                TensorInfo(TensorType.FLOAT32, (k,)),
+                TensorInfo(TensorType.INT32, (1,))])
+            return fn, reduced
 
         def fn(outs):
             boxes, scores = outs
@@ -228,6 +277,17 @@ class BoundingBoxDecoder(Decoder):
         return fn, reduced
 
     def _decode_mobilenet_ssd(self, buf: TensorBuffer) -> List[DetectedObject]:
+        if buf.num_tensors == 4:
+            # fully device-decoded pushdown form (boxes/classes/scores/
+            # num, NMS already applied on device) — just materialize
+            b = np.asarray(buf.np(0)).reshape(-1, 4)
+            cls = np.asarray(buf.np(1)).reshape(-1)
+            sc = np.asarray(buf.np(2)).reshape(-1)
+            num = int(np.asarray(buf.np(3)).reshape(-1)[0])
+            return [DetectedObject(int(c), float(s), float(y0), float(x0),
+                                   float(y1), float(x1))
+                    for c, s, (y0, x0, y1, x1) in zip(cls, sc, b)
+                    if c >= 0][:num]
         boxes = squeeze_leading(buf.np(0), 2)    # (N, 4)
         if buf.num_tensors == 3:
             # device-reduced pushdown form: (boxes, class, score)
@@ -241,15 +301,10 @@ class BoundingBoxDecoder(Decoder):
             sc = np.asarray(sc_dev)
         else:
             scores = squeeze_leading(buf.np(1), 2)   # (N, C)
-            cls = scores[:, 1:].argmax(axis=1) + 1  # skip background 0
-            sc = scores[np.arange(len(cls)), cls]
+            cls, sc = ssd_topcls(np, scores)
         if self.priors is not None:
-            cy = boxes[:, 0] / 10.0 * self.priors[2] + self.priors[0]
-            cx = boxes[:, 1] / 10.0 * self.priors[3] + self.priors[1]
-            h = np.exp(boxes[:, 2] / 5.0) * self.priors[2]
-            w = np.exp(boxes[:, 3] / 5.0) * self.priors[3]
-            ymin, xmin = cy - h / 2, cx - w / 2
-            ymax, xmax = cy + h / 2, cx + w / 2
+            ymin, xmin, ymax, xmax = ssd_prior_decode(np, boxes,
+                                                      self.priors)
         else:
             ymin, xmin, ymax, xmax = boxes.T
         sel = _cap_candidates(sc >= self._threshold(DEFAULT_THRESHOLD), sc)
@@ -397,7 +452,9 @@ class BoundingBoxDecoder(Decoder):
 
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
         if self.scheme == "mobilenet-ssd":
-            objs = nms(self._decode_mobilenet_ssd(buf))
+            objs = self._decode_mobilenet_ssd(buf)
+            if buf.num_tensors != 4:   # 4-tensor form: NMS ran on device
+                objs = nms(objs)
         elif self.scheme == "mobilenet-ssd-postprocess":
             objs = self._decode_ssd_postprocess(buf)  # model already NMSed
         elif self.scheme == "ov-person-detection":

@@ -214,3 +214,107 @@ class TestPushdown:
             assert fcaps.get("dimensions") == "5:6"
         finally:
             _MODELS.pop("tiny_seg", None)
+
+
+class TestSSDFullDecodePushdown:
+    def test_device_nms_matches_host_oracle(self):
+        """ops/nms.py greedy per-class NMS == decoders.boundingbox.nms
+        on random candidates (same f32 corner values, no prior decode in
+        the loop so the math is bit-comparable)."""
+        from nnstreamer_tpu.decoders.boundingbox import DetectedObject, nms
+        from nnstreamer_tpu.ops.nms import device_nms
+
+        rng = np.random.default_rng(0)
+        n = 64
+        y0 = rng.random(n).astype(np.float32) * 0.8
+        x0 = rng.random(n).astype(np.float32) * 0.8
+        boxes = np.stack([y0, x0,
+                          y0 + 0.05 + rng.random(n).astype(np.float32) * .3,
+                          x0 + 0.05 + rng.random(n).astype(np.float32) * .3],
+                         axis=1)
+        scores = rng.random(n).astype(np.float32)
+        classes = rng.integers(1, 4, n).astype(np.int32)
+
+        b, c, s, num = device_nms(boxes, scores, classes, k=n,
+                                  iou_thresh=0.5, score_thresh=0.3)
+        got = [(int(ci), float(si),
+                tuple(round(float(v), 4) for v in bi))
+               for bi, ci, si in zip(np.asarray(b), np.asarray(c),
+                                     np.asarray(s)) if ci >= 0]
+        assert len(got) == int(np.asarray(num)[0])
+
+        objs = [DetectedObject(int(c_), float(s_), *map(float, bx))
+                for bx, c_, s_ in zip(boxes, classes, scores) if s_ >= 0.3]
+        want = [(o.class_id, round(o.score, 6),
+                 tuple(round(float(v), 4)
+                       for v in (o.ymin, o.xmin, o.ymax, o.xmax)))
+                for o in nms(objs)]
+        want.sort(key=lambda t: -t[1])
+        got_cmp = [(c_, round(s_, 6), bx) for c_, s_, bx in got]
+        assert got_cmp == want
+
+    def test_ssd_full_decode_runs_on_device(self, tmp_path):
+        """With priors set, the ENTIRE ssd tail (prior decode, threshold,
+        top-K, NMS) fuses into the filter executable: the filter's src
+        caps carry the reduced boxes/classes/scores/num form and the
+        decoded objects match the host-path oracle."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.decoders.boundingbox import (
+            BoundingBoxDecoder, nms)
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        n, c = 8, 3
+        rng = np.random.default_rng(1)
+        raw_boxes = (rng.standard_normal((n, 4)) * 0.5).astype(np.float32)
+        scores = rng.random((n, c)).astype(np.float32)
+
+        def build(custom):
+            def forward(params, x):
+                return (jnp.asarray(raw_boxes), jnp.asarray(scores))
+
+            return Model(
+                name="tiny_ssd", forward=forward, params=np.zeros(1),
+                in_info=TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))]),
+                out_info=TensorsInfo([
+                    TensorInfo(TensorType.FLOAT32, (4, n)),
+                    TensorInfo(TensorType.FLOAT32, (c, n))]))
+
+        register_model("tiny_ssd")(build)
+        try:
+            priors = tmp_path / "priors.txt"
+            pr = rng.random((4, n)).astype(np.float32) * 0.5 + 0.25
+            priors.write_text("\n".join(
+                " ".join(f"{v:.6f}" for v in row) for row in pr))
+            p = parse_launch(
+                f"appsrc caps={CAPS} name=in ! "
+                "tensor_filter framework=xla model=tiny_ssd name=f ! "
+                "tensor_decoder mode=bounding_boxes option1=mobilenet-ssd "
+                f"option3={priors} option4=100:100 option5=100:100 ! "
+                "tensor_sink name=out")
+            x = np.zeros(4, np.float32)
+            got = _run(p, [x])
+            assert len(got) == 1
+            # reduced caps: 4 tensors, last is the num scalar
+            fcaps = p.get("f").src_pad.caps.first()
+            assert fcaps.get("num_tensors") == 4
+            # oracle: host-path decode of the same raw tensors
+            dec = BoundingBoxDecoder()
+            dec.set_option(1, "mobilenet-ssd")
+            dec.set_option(3, str(priors))
+            dec.set_option(4, "100:100")
+            dec.set_option(5, "100:100")
+            want_objs = nms(dec._decode_mobilenet_ssd(TensorBuffer(
+                tensors=[raw_boxes, scores])))
+            got_objs = got[0].extra["objects"]
+            assert len(got_objs) == len(want_objs)
+            for g, w in zip(
+                    sorted(got_objs, key=lambda o: -o.score),
+                    sorted(want_objs, key=lambda o: -o.score)):
+                assert g.class_id == w.class_id
+                np.testing.assert_allclose(
+                    [g.ymin, g.xmin, g.ymax, g.xmax],
+                    [w.ymin, w.xmin, w.ymax, w.xmax], rtol=2e-5, atol=2e-5)
+        finally:
+            _MODELS.pop("tiny_ssd", None)
