@@ -48,23 +48,54 @@ class PoseDecoder(Decoder):
             "format": "RGBA", "width": self.out_w, "height": self.out_h,
             "framerate": config.rate or Fraction(0, 1)})])
 
-    def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
-        heat = squeeze_leading(buf.np(0), 3)             # (H', W', K)
-        offsets = squeeze_leading(
-            buf.np(1) if buf.num_tensors > 1 else None, 3)  # (H',W',2K)
-        hh, ww, k = heat.shape
-        kps: List[Tuple[float, float, float]] = []  # (x, y, score) normalized
-        for i in range(k):
-            flat = int(heat[:, :, i].argmax())
-            gy, gx = divmod(flat, ww)
-            score = float(heat[gy, gx, i])
+    def device_reduce_spec(self, config: TensorsConfig):
+        """Pushdown: the whole keypoint extraction — per-keypoint heatmap
+        argmax + offset refinement — runs inside the filter executable;
+        only the (K, 3) (x, y, score) table crosses device→host (~200 B
+        instead of the full heatmap/offset stack)."""
+        if config.info.num_tensors not in (1, 2):
+            return None
+        heat_i = config.info[0]
+        if len(heat_i.np_shape) != 3:
+            return None
+        hh, ww, k = heat_i.np_shape
+        has_off = config.info.num_tensors == 2
+        if has_off and config.info[1].np_shape != (hh, ww, 2 * k):
+            return None
+        in_w, in_h = self.in_w, self.in_h
+        import jax.numpy as jnp
+
+        from ..tensor.info import TensorInfo, TensorsInfo
+        from ..tensor.types import TensorType
+
+        def fn(outs):
+            heat = outs[0].reshape(hh, ww, k).astype(jnp.float32)
+            flat = heat.reshape(-1, k)
+            idx = jnp.argmax(flat, axis=0)
+            score = jnp.max(flat, axis=0)
+            gy, gx = idx // ww, idx % ww
             y = gy / max(hh - 1, 1)
             x = gx / max(ww - 1, 1)
-            if offsets is not None:
-                # short-range offsets in input-pixel units (posenet contract)
-                y += float(offsets[gy, gx, i]) / self.in_h
-                x += float(offsets[gy, gx, i + k]) / self.in_w
-            kps.append((x, y, score))
+            if has_off:
+                off = outs[1].reshape(hh, ww, 2 * k).astype(jnp.float32)
+                ks = jnp.arange(k)
+                y = y + off[gy, gx, ks] / in_h
+                x = x + off[gy, gx, ks + k] / in_w
+            return [jnp.stack([x, y, score], axis=1)
+                    .astype(jnp.float32)]
+
+        reduced = TensorsInfo([TensorInfo(TensorType.FLOAT32, (3, k))])
+        return fn, reduced
+
+    def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
+        first = np.asarray(buf.np(0))
+        if (buf.num_tensors == 1 and first.ndim == 2
+                and first.shape[1] == 3):
+            # device-reduced pushdown form: (K, 3) rows of (x, y, score)
+            kps = [(float(x), float(y), float(s)) for x, y, s in first]
+        else:
+            kps = self._host_keypoints(buf)
+        k = len(kps)
         canvas = np.zeros((self.out_h, self.out_w, 4), dtype=np.uint8)
         for x, y, s in kps:
             if s >= self.threshold:
@@ -76,6 +107,26 @@ class PoseDecoder(Decoder):
         out = buf.with_tensors([canvas])
         out.extra["keypoints"] = kps
         return out
+
+    def _host_keypoints(self, buf: TensorBuffer
+                        ) -> List[Tuple[float, float, float]]:
+        heat = squeeze_leading(buf.np(0), 3)             # (H', W', K)
+        offsets = squeeze_leading(
+            buf.np(1) if buf.num_tensors > 1 else None, 3)  # (H',W',2K)
+        hh, ww, k = heat.shape
+        kps: List[Tuple[float, float, float]] = []  # (x, y, score) norm.
+        for i in range(k):
+            flat = int(heat[:, :, i].argmax())
+            gy, gx = divmod(flat, ww)
+            score = float(heat[gy, gx, i])
+            y = gy / max(hh - 1, 1)
+            x = gx / max(ww - 1, 1)
+            if offsets is not None:
+                # short-range offsets in input-pixel units (posenet)
+                y += float(offsets[gy, gx, i]) / self.in_h
+                x += float(offsets[gy, gx, i + k]) / self.in_w
+            kps.append((x, y, score))
+        return kps
 
     def _dot(self, canvas: np.ndarray, x: float, y: float) -> None:
         h, w = canvas.shape[:2]

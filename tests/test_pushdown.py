@@ -318,3 +318,53 @@ class TestSSDFullDecodePushdown:
                     [w.ymin, w.xmin, w.ymax, w.xmax], rtol=2e-5, atol=2e-5)
         finally:
             _MODELS.pop("tiny_ssd", None)
+
+
+class TestPosePushdown:
+    def test_pose_keypoints_reduce_on_device(self):
+        """Heatmap argmax + offset refinement fuse into the filter; only
+        the (K, 3) keypoint table crosses device→host, equal to the
+        host-path oracle."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.decoders.pose import PoseDecoder
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        hh, ww, k = 5, 5, 4
+        rng = np.random.default_rng(2)
+        heat = rng.random((hh, ww, k)).astype(np.float32)
+        off = (rng.standard_normal((hh, ww, 2 * k)) * 3).astype(np.float32)
+
+        def build(custom):
+            def forward(params, x):
+                return (jnp.asarray(heat), jnp.asarray(off))
+
+            return Model(
+                name="tiny_pose", forward=forward, params=np.zeros(1),
+                in_info=TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))]),
+                out_info=TensorsInfo([
+                    TensorInfo(TensorType.FLOAT32, (k, ww, hh)),
+                    TensorInfo(TensorType.FLOAT32, (2 * k, ww, hh))]))
+
+        register_model("tiny_pose")(build)
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS} name=in ! "
+                "tensor_filter framework=xla model=tiny_pose name=f ! "
+                "tensor_decoder mode=pose_estimation option1=64:64 "
+                "option2=257:257 ! tensor_sink name=out")
+            got = _run(p, [np.zeros(4, np.float32)])
+            assert len(got) == 1
+            fcaps = p.get("f").src_pad.caps.first()
+            assert fcaps.get("dimensions") == f"3:{k}"
+
+            dec = PoseDecoder()
+            dec.set_option(2, "257:257")
+            want = dec._host_keypoints(TensorBuffer(tensors=[heat, off]))
+            got_kps = got[0].extra["keypoints"]
+            assert len(got_kps) == len(want)
+            for g, w in zip(got_kps, want):
+                np.testing.assert_allclose(g, w, rtol=2e-5, atol=2e-6)
+        finally:
+            _MODELS.pop("tiny_pose", None)
