@@ -217,16 +217,31 @@ class BoundingBoxDecoder(Decoder):
             "framerate": config.rate or Fraction(0, 1)})])
 
     # -- per-scheme decode ---------------------------------------------------
-    def device_reduce_spec(self, config):
-        """Pushdown for the mobilenet-ssd scheme.
+    def _nms_reduced_info(self, k):
+        from ..tensor.info import TensorInfo, TensorsInfo
+        from ..tensor.types import TensorType
 
-        Without priors: reduce the (N, C) score matrix to per-anchor
-        (class, score) on device — SSD-300 fetches ~15 KB/frame instead
-        of ~700 KB.  With priors (option3), the ENTIRE detection tail
-        runs on device — prior decode, threshold, top-K cap, greedy
+        return TensorsInfo([
+            TensorInfo(TensorType.FLOAT32, (4, k)),
+            TensorInfo(TensorType.INT32, (k,)),
+            TensorInfo(TensorType.FLOAT32, (k,)),
+            TensorInfo(TensorType.INT32, (1,))])
+
+    def device_reduce_spec(self, config):
+        """Pushdown for the single-pass detection schemes.
+
+        mobilenet-ssd without priors: reduce the (N, C) score matrix to
+        per-anchor (class, score) on device — SSD-300 fetches
+        ~15 KB/frame instead of ~700 KB.  mobilenet-ssd WITH priors
+        (option3), yolov5, and mp-palm-detection: the ENTIRE detection
+        tail runs on device — box decode, threshold, top-K cap, greedy
         per-class NMS (ops/nms.py) — and only the ≤DETECTION_MAX
         surviving boxes cross device→host (~2.4 KB/frame), in the
         ssd-postprocess output contract (boxes/classes/scores/num)."""
+        if self.scheme == "yolov5":
+            return self._yolo_reduce_spec(config)
+        if self.scheme == "mp-palm-detection":
+            return self._palm_reduce_spec(config)
         if self.scheme != "mobilenet-ssd" or config.info.num_tensors != 2:
             return None
         boxes_i, scores_i = config.info[0], config.info[1]
@@ -257,12 +272,7 @@ class BoundingBoxDecoder(Decoder):
                                        iou_thresh=NMS_IOU,
                                        score_thresh=thr))
 
-            reduced = TensorsInfo([
-                TensorInfo(TensorType.FLOAT32, (4, k)),
-                TensorInfo(TensorType.INT32, (k,)),
-                TensorInfo(TensorType.FLOAT32, (k,)),
-                TensorInfo(TensorType.INT32, (1,))])
-            return fn, reduced
+            return fn, self._nms_reduced_info(k)
 
         def fn(outs):
             boxes, scores = outs
@@ -276,18 +286,95 @@ class BoundingBoxDecoder(Decoder):
                                TensorInfo(TensorType.FLOAT32, (n,))])
         return fn, reduced
 
+    def _yolo_reduce_spec(self, config):
+        """yolov5 full device decode: obj·cls scores, box form
+        conversion, threshold, top-K, NMS — same output contract as the
+        ssd pushdown."""
+        if config.info.num_tensors != 1:
+            return None
+        pred_i = config.info[0]
+        if len(pred_i.np_shape) < 2:
+            return None
+        n, width = pred_i.np_shape[-2], pred_i.np_shape[-1]
+        if width <= 5:
+            return None
+        import jax.numpy as jnp
+
+        from ..ops.nms import device_nms
+
+        thr = float(self._threshold(DEFAULT_THRESHOLD))
+        k = min(DETECTION_MAX, n)
+        in_w, in_h = float(self.in_w), float(self.in_h)
+
+        def fn(outs):
+            pred = outs[0].reshape(-1, width)[:n].astype(jnp.float32)
+            cls_scores = pred[:, 5:] * pred[:, 4:5]
+            cls = jnp.argmax(cls_scores, axis=1).astype(jnp.int32)
+            sc = jnp.max(cls_scores, axis=1)
+            cx, cy = pred[:, 0] / in_w, pred[:, 1] / in_h
+            w, h = pred[:, 2] / in_w, pred[:, 3] / in_h
+            corners = jnp.stack([cy - h / 2, cx - w / 2,
+                                 cy + h / 2, cx + w / 2], axis=1)
+            return list(device_nms(corners, sc, cls, k=k,
+                                   iou_thresh=NMS_IOU, score_thresh=thr))
+
+        return fn, self._nms_reduced_info(k)
+
+    def _palm_reduce_spec(self, config):
+        """mp-palm-detection full device decode: sigmoid scores, anchor
+        decode, threshold, top-K, NMS.  Unlike the host path this caps
+        survivors at DETECTION_MAX (the ssd reference's cap) — a frame
+        with >100 above-threshold palms is not a real workload."""
+        if config.info.num_tensors != 2:
+            return None
+        boxes_i, scores_i = config.info[0], config.info[1]
+        if len(boxes_i.np_shape) != 2:
+            return None
+        n, width = boxes_i.np_shape
+        anchors_np = self._palm_anchor_table()
+        n = min(n, len(anchors_np))
+        import jax.numpy as jnp
+
+        from ..ops.nms import device_nms
+
+        anchors = jnp.asarray(anchors_np[:n], jnp.float32)  # (n,4) ycxhw
+        thr = float(self._threshold(self.PALM_THRESHOLD))
+        k = min(DETECTION_MAX, n)
+        in_w, in_h = float(self.in_w), float(self.in_h)
+
+        def fn(outs):
+            boxes = outs[0].reshape(-1, width)[:n].astype(jnp.float32)
+            logits = outs[1].reshape(-1)[:n].astype(jnp.float32)
+            # same clipped sigmoid as the host path (overflow-safe)
+            sc = 1.0 / (1.0 + jnp.exp(-jnp.clip(logits, -100.0, 100.0)))
+            yc = boxes[:, 0] / in_h * anchors[:, 2] + anchors[:, 0]
+            xc = boxes[:, 1] / in_w * anchors[:, 3] + anchors[:, 1]
+            h = boxes[:, 2] / in_h * anchors[:, 2]
+            w = boxes[:, 3] / in_w * anchors[:, 3]
+            corners = jnp.stack([yc - h / 2, xc - w / 2,
+                                 yc + h / 2, xc + w / 2], axis=1)
+            cls = jnp.zeros((n,), jnp.int32)
+            return list(device_nms(corners, sc, cls, k=k,
+                                   iou_thresh=NMS_IOU, score_thresh=thr))
+
+        return fn, self._nms_reduced_info(k)
+
+    @staticmethod
+    def _materialize_device_nms(buf: TensorBuffer) -> List[DetectedObject]:
+        """Fully device-decoded pushdown form (boxes/classes/scores/num,
+        NMS already applied on device) — just materialize objects."""
+        b = np.asarray(buf.np(0)).reshape(-1, 4)
+        cls = np.asarray(buf.np(1)).reshape(-1)
+        sc = np.asarray(buf.np(2)).reshape(-1)
+        num = int(np.asarray(buf.np(3)).reshape(-1)[0])
+        return [DetectedObject(int(c), float(s), float(y0), float(x0),
+                               float(y1), float(x1))
+                for c, s, (y0, x0, y1, x1) in zip(cls, sc, b)
+                if c >= 0][:num]
+
     def _decode_mobilenet_ssd(self, buf: TensorBuffer) -> List[DetectedObject]:
         if buf.num_tensors == 4:
-            # fully device-decoded pushdown form (boxes/classes/scores/
-            # num, NMS already applied on device) — just materialize
-            b = np.asarray(buf.np(0)).reshape(-1, 4)
-            cls = np.asarray(buf.np(1)).reshape(-1)
-            sc = np.asarray(buf.np(2)).reshape(-1)
-            num = int(np.asarray(buf.np(3)).reshape(-1)[0])
-            return [DetectedObject(int(c), float(s), float(y0), float(x0),
-                                   float(y1), float(x1))
-                    for c, s, (y0, x0, y1, x1) in zip(cls, sc, b)
-                    if c >= 0][:num]
+            return self._materialize_device_nms(buf)
         boxes = squeeze_leading(buf.np(0), 2)    # (N, 4)
         if buf.num_tensors == 3:
             # device-reduced pushdown form: (boxes, class, score)
@@ -460,9 +547,13 @@ class BoundingBoxDecoder(Decoder):
         elif self.scheme == "ov-person-detection":
             objs = self._decode_ov_person(buf)        # model already NMSed
         elif self.scheme == "yolov5":
-            objs = nms(self._decode_yolov5(buf))
+            objs = (self._materialize_device_nms(buf)
+                    if buf.num_tensors == 4
+                    else nms(self._decode_yolov5(buf)))
         elif self.scheme == "mp-palm-detection":
-            objs = nms(self._decode_mp_palm(buf))
+            objs = (self._materialize_device_nms(buf)
+                    if buf.num_tensors == 4
+                    else nms(self._decode_mp_palm(buf)))
         elif self.scheme == "raw":
             objs = nms(self._decode_raw(buf))
         else:

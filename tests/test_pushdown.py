@@ -368,3 +368,108 @@ class TestPosePushdown:
                 np.testing.assert_allclose(g, w, rtol=2e-5, atol=2e-6)
         finally:
             _MODELS.pop("tiny_pose", None)
+
+
+class TestYoloPalmDecodePushdown:
+    def _oracle_vs_device(self, scheme, model_name, out_infos, raw_tensors,
+                          opts=""):
+        """Run the scheme's pipeline with pushdown and compare objects
+        against the host-path oracle on the same raw tensors."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.decoders.boundingbox import (
+            BoundingBoxDecoder, nms)
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            f"tensor_filter framework=xla model={model_name} name=f ! "
+            f"tensor_decoder mode=bounding_boxes option1={scheme} "
+            f"{opts} ! tensor_sink name=out")
+        got = _run(p, [np.zeros(4, np.float32)])
+        assert len(got) == 1
+        fcaps = p.get("f").src_pad.caps.first()
+        assert fcaps.get("num_tensors") == 4      # device-NMS contract
+
+        dec = BoundingBoxDecoder()
+        dec.set_option(1, scheme)
+        for idx, val in [(4, "100:100"), (5, "100:100")]:
+            dec.set_option(idx, val)
+        host = {
+            "yolov5": dec._decode_yolov5,
+            "mp-palm-detection": dec._decode_mp_palm,
+        }[scheme](TensorBuffer(tensors=list(raw_tensors)))
+        want = nms(host)
+        got_objs = got[0].extra["objects"]
+        assert len(got_objs) == len(want)
+        for g, w in zip(sorted(got_objs, key=lambda o: -o.score),
+                        sorted(want, key=lambda o: -o.score)):
+            assert g.class_id == w.class_id
+            np.testing.assert_allclose(
+                [g.score, g.ymin, g.xmin, g.ymax, g.xmax],
+                [w.score, w.ymin, w.xmin, w.ymax, w.xmax],
+                rtol=2e-4, atol=2e-5)
+
+    def test_yolov5_full_decode_on_device(self):
+        import jax.numpy as jnp
+
+        n, c = 12, 3
+        rng = np.random.default_rng(3)
+        pred = rng.random((n, 5 + c)).astype(np.float32)
+        pred[:, :4] *= 80.0     # boxes in input pixels
+
+        def build(custom):
+            def forward(params, x):
+                return (jnp.asarray(pred),)
+
+            return Model(
+                name="tiny_yolo", forward=forward, params=np.zeros(1),
+                in_info=TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))]),
+                out_info=TensorsInfo([
+                    TensorInfo(TensorType.FLOAT32, (5 + c, n))]))
+
+        register_model("tiny_yolo")(build)
+        try:
+            self._oracle_vs_device(
+                "yolov5", "tiny_yolo",
+                None, [pred], opts="option4=100:100 option5=100:100")
+        finally:
+            _MODELS.pop("tiny_yolo", None)
+
+    def test_mp_palm_full_decode_on_device(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.decoders.boundingbox import BoundingBoxDecoder
+
+        # anchor table size for the default palm config
+        probe = BoundingBoxDecoder()
+        probe.set_option(1, "mp-palm-detection")
+        n_anchors = len(probe._palm_anchor_table())
+        n = n_anchors
+        rng = np.random.default_rng(4)
+        boxes = (rng.standard_normal((n, 18)) * 20).astype(np.float32)
+        # realistic detection density: a handful of positive logits (the
+        # device path caps survivors at DETECTION_MAX=100, like the ssd
+        # reference; a frame with >100 palms is not a real workload)
+        logits = np.full(n, -10.0, np.float32)
+        hot = rng.choice(n, 25, replace=False)
+        logits[hot] = rng.standard_normal(25).astype(np.float32) * 2 + 1
+
+        def build(custom):
+            def forward(params, x):
+                return (jnp.asarray(boxes), jnp.asarray(logits))
+
+            return Model(
+                name="tiny_palm", forward=forward, params=np.zeros(1),
+                in_info=TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))]),
+                out_info=TensorsInfo([
+                    TensorInfo(TensorType.FLOAT32, (18, n)),
+                    TensorInfo(TensorType.FLOAT32, (n,))]))
+
+        register_model("tiny_palm")(build)
+        try:
+            self._oracle_vs_device(
+                "mp-palm-detection", "tiny_palm",
+                None, [boxes, logits],
+                opts="option4=100:100 option5=100:100")
+        finally:
+            _MODELS.pop("tiny_palm", None)
