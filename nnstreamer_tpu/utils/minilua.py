@@ -22,9 +22,13 @@ stdlib       math.floor/ceil/abs/min/max/sqrt/huge · string.format/sub/
              len/upper/lower/rep/reverse/byte/char/find/gsub (find and
              gsub take PLAIN needles — Lua pattern magic raises loudly)
              · table.insert/remove/concat · tostring · tonumber · # ·
-             print.  Not implemented: metatables, closures-as-upvalue
-             mutation, coroutines, goto, string pattern matching —
-             scripts touching those fail with a named LuaError.
+             print · setmetatable/getmetatable/rawget/rawset/type with
+             the __index (table or function, chained), __newindex, and
+             __call metamethods — the class/OOP idiom works.  Not
+             implemented: operator metamethods (__add …),
+             closures-as-upvalue mutation, coroutines, goto, string
+             pattern matching — scripts touching those fail with a
+             named LuaError.
 
 Execution compiles the AST to Python closures once (scripts run a
 nested-loop body per frame — ~1M interpreted ops for the reference's
@@ -105,12 +109,16 @@ def _lex(src: str) -> List[Tuple[str, Any]]:
 # ---------------------------------------------------------------------------
 
 class LuaTable:
-    """1-based table: array part + hash part in one dict."""
+    """1-based table: array part + hash part in one dict; optional
+    metatable (``__index``/``__newindex``/``__call`` are honored — the
+    metamethods the reference-era filter scripts use; operator
+    metamethods stay outside the subset and fail loudly)."""
 
-    __slots__ = ("data",)
+    __slots__ = ("data", "metatable")
 
     def __init__(self, data: Optional[Dict[Any, Any]] = None):
         self.data = data or {}
+        self.metatable: Optional["LuaTable"] = None
 
     def get(self, key):
         if isinstance(key, float) and key.is_integer():
@@ -178,9 +186,18 @@ def _truthy(v) -> bool:
     return v is not None and v is not False
 
 
-def _index(obj, key):
+def _index(obj, key, _depth=0):
+    if _depth > 100:
+        raise LuaError("lua: __index chain too deep")
     if isinstance(obj, LuaTable):
-        return obj.get(key)
+        v = obj.get(key)
+        if v is None and obj.metatable is not None:
+            handler = obj.metatable.get("__index")
+            if isinstance(handler, LuaTable):
+                return _index(handler, key, _depth + 1)
+            if callable(handler):
+                return _first(handler(obj, key))
+        return v
     if hasattr(obj, "__getitem__"):
         if isinstance(key, float) and key.is_integer():
             key = int(key)
@@ -222,8 +239,19 @@ def _expand_args(vals: List[Any]) -> List[Any]:
     return out
 
 
-def _setindex(obj, key, value):
+def _setindex(obj, key, value, _depth=0):
+    if _depth > 100:
+        raise LuaError("lua: __newindex chain too deep")
     if isinstance(obj, LuaTable):
+        # __newindex fires only for keys ABSENT from the table (manual
+        # §2.4); existing keys raw-assign
+        if obj.get(key) is None and obj.metatable is not None:
+            handler = obj.metatable.get("__newindex")
+            if isinstance(handler, LuaTable):
+                return _setindex(handler, key, value, _depth + 1)
+            if callable(handler):
+                handler(obj, key, value)
+                return
         obj.set(key, value)
         return
     if hasattr(obj, "__setitem__"):
@@ -232,6 +260,20 @@ def _setindex(obj, key, value):
         obj[key] = value
         return
     raise LuaError(f"lua: cannot index-assign {type(obj).__name__}")
+
+
+def _call_value(f, args):
+    """Invoke a Lua value: function, or table with a ``__call``
+    metamethod (the callable-object pattern)."""
+    if callable(f):
+        return f(*args)
+    if isinstance(f, LuaTable) and f.metatable is not None:
+        handler = f.metatable.get("__call")
+        if callable(handler):
+            return handler(f, *args)
+    if f is None:
+        raise LuaError("lua: call of nil")
+    raise LuaError(f"lua: cannot call a {type(f).__name__} value")
 
 
 # ---------------------------------------------------------------------------
@@ -687,9 +729,8 @@ class _Parser:
 
                 def call(env, fnv=fnv, args=tuple(args)):
                     f = _first(fnv(env))
-                    if f is None:
-                        raise LuaError("lua: call of nil")
-                    return f(*_expand_args([a(env) for a in args]))
+                    return _call_value(
+                        f, _expand_args([a(env) for a in args]))
                 node = ("expr", call)
             elif p == ":":
                 # method-call sugar: obj:m(a) == obj.m(obj, a); strings
@@ -717,7 +758,8 @@ class _Parser:
                         raise LuaError(
                             f"lua: no method {method!r} on "
                             f"{_lua_str(obj)[:40]!r}")
-                    return f(obj, *_expand_args([a(env) for a in margs]))
+                    return _call_value(
+                        f, [obj] + _expand_args([a(env) for a in margs]))
                 node = ("expr", mcall)
             else:
                 return node
@@ -792,6 +834,48 @@ def _lua_str(v) -> str:
     if v is None:
         return "nil"
     return str(v)
+
+
+def _lua_setmetatable(t, mt):
+    if not isinstance(t, LuaTable):
+        raise LuaError("lua: setmetatable on non-table")
+    if mt is not None and not isinstance(mt, LuaTable):
+        raise LuaError("lua: metatable must be a table or nil")
+    t.metatable = mt
+    return t
+
+
+def _lua_getmetatable(t):
+    return t.metatable if isinstance(t, LuaTable) else None
+
+
+def _lua_rawget(t, k):
+    if not isinstance(t, LuaTable):
+        raise LuaError("lua: rawget on non-table")
+    return t.get(k)
+
+
+def _lua_rawset(t, k, v):
+    if not isinstance(t, LuaTable):
+        raise LuaError("lua: rawset on non-table")
+    t.set(k, v)
+    return t
+
+
+def _lua_type(v):
+    if v is None:
+        return "nil"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, LuaTable):
+        return "table"
+    if callable(v):
+        return "function"
+    return "userdata"
 
 
 def _lua_pairs(t):
@@ -1049,6 +1133,11 @@ class LuaState:
             "pairs": _lua_pairs,
             "ipairs": _lua_ipairs,
             "print": lambda *a: print("[lua]", *[_lua_str(x) for x in a]),
+            "setmetatable": _lua_setmetatable,
+            "getmetatable": _lua_getmetatable,
+            "rawget": _lua_rawget,
+            "rawset": _lua_rawset,
+            "type": _lua_type,
         }
         if host_globals:
             self.globals.update(host_globals)

@@ -476,3 +476,85 @@ class TestStdlibExtensions:
             "s = 0\n"
             "for i, v in f() do s = s + v end")
         assert st.get("s") == 15
+
+
+class TestMetatables:
+    def test_class_pattern_via_index(self):
+        """The canonical Lua OOP idiom: methods resolve through the
+        metatable __index chain; instance state stays per-object."""
+        st = LuaState("""
+            Counter = {}
+            Counter.__index = Counter
+            function Counter.new(start)
+                return setmetatable({n = start}, Counter)
+            end
+            function Counter:bump(d)
+                self.n = self.n + d
+                return self.n
+            end
+            a = Counter.new(10)
+            b = Counter.new(100)
+            r1 = a:bump(1)
+            r2 = b:bump(5)
+            r3 = a:bump(1)
+        """)
+        assert st.get("r1") == 11
+        assert st.get("r2") == 105
+        assert st.get("r3") == 12
+
+    def test_index_function_and_newindex(self):
+        st = LuaState("""
+            log = {}
+            t = setmetatable({}, {
+                __index = function(t, k) return k .. "!" end,
+                __newindex = function(t, k, v)
+                    rawset(t, k, v * 2)
+                    table.insert(log, k)
+                end,
+            })
+            a = t.missing         -- __index fires
+            t.x = 21              -- __newindex fires (absent key)
+            b = t.x               -- present now: raw read
+            t.x = 5               -- present: raw assign, no handler
+            c = t.x
+            n = #log
+        """)
+        assert st.get("a") == "missing!"
+        assert st.get("b") == 42
+        assert st.get("c") == 5
+        assert st.get("n") == 1
+
+    def test_call_metamethod(self):
+        st = LuaState("""
+            adder = setmetatable({base = 7},
+                                 {__call = function(self, x)
+                                      return self.base + x
+                                  end})
+            r = adder(35)
+        """)
+        assert st.get("r") == 42
+
+    def test_getmetatable_type_raw(self):
+        st = LuaState("""
+            mt = {__index = function() return 0 end}
+            t = setmetatable({}, mt)
+            same = getmetatable(t) == mt
+            raw = rawget(t, "nope")       -- bypasses __index
+            ty1 = type(t)
+            ty2 = type(type)
+            ty3 = type(nil)
+        """)
+        assert st.get("same") is True
+        assert st.get("raw") is None
+        assert st.get("ty1") == "table"
+        assert st.get("ty2") == "function"
+        assert st.get("ty3") == "nil"
+
+    def test_operator_metamethods_stay_loud(self):
+        """__add etc. are outside the subset: arithmetic on a table must
+        still fail loudly, never silently misbehave."""
+        with pytest.raises((LuaError, TypeError)):
+            LuaState("""
+                v = setmetatable({}, {__add = function() return 1 end})
+                x = v + 1
+            """)
