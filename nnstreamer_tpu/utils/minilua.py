@@ -24,11 +24,13 @@ stdlib       math.floor/ceil/abs/min/max/sqrt/huge · string.format/sub/
              · table.insert/remove/concat · tostring · tonumber · # ·
              print · setmetatable/getmetatable/rawget/rawset/type with
              the __index (table or function, chained), __newindex, and
-             __call metamethods — the class/OOP idiom works.  Not
-             implemented: operator metamethods (__add …),
-             closures-as-upvalue mutation, coroutines, goto, string
-             pattern matching — scripts touching those fail with a
-             named LuaError.
+             __call metamethods — the class/OOP idiom works; closures
+             capture lexical scope and MUTATE upvalues (the counter
+             idiom works).  Not implemented: operator metamethods
+             (__add …), per-iteration loop-variable scoping, coroutines,
+             goto, string pattern matching — scripts touching those
+             fail with a named LuaError (or behave as documented in
+             Env for loop captures).
 
 Execution compiles the AST to Python closures once (scripts run a
 nested-loop body per frame — ~1M interpreted ops for the reference's
@@ -142,30 +144,42 @@ class LuaTable:
 
 
 class Env:
-    """Variable scope: per-call locals over the shared globals table.
+    """Variable scope: per-call locals chained to the DEFINING scope
+    (lexical upvalues), over the shared globals table.
 
-    Lua semantics: reads fall through locals → globals; PLAIN assignment
-    writes the local if one exists, else the GLOBAL; ``local`` and loop
-    control variables write locals explicitly.  The top-level chunk uses
-    the globals table as its locals."""
+    Lua semantics: reads fall through locals → enclosing function
+    locals → globals; PLAIN assignment writes the nearest existing
+    binding in that chain (closures MUTATE captured upvalues — the
+    counter idiom works), else the GLOBAL; ``local`` and loop control
+    variables write the current frame explicitly.  The top-level chunk
+    uses the globals table as its locals.  Subset note: a closure
+    created inside a loop captures the frame, not a per-iteration
+    binding (real Lua scopes loop variables per iteration)."""
 
-    __slots__ = ("locals", "globals")
+    __slots__ = ("locals", "globals", "parent")
 
-    def __init__(self, locals_: Dict[str, Any], globals_: Dict[str, Any]):
+    def __init__(self, locals_: Dict[str, Any], globals_: Dict[str, Any],
+                 parent: Optional["Env"] = None):
         self.locals = locals_
         self.globals = globals_
+        self.parent = parent
 
     def get(self, name: str):
-        L = self.locals
-        if name in L:
-            return L[name]
+        e = self
+        while e is not None:
+            if name in e.locals:
+                return e.locals[name]
+            e = e.parent
         return self.globals.get(name)
 
     def set(self, name: str, value) -> None:
-        if name in self.locals:
-            self.locals[name] = value
-        else:
-            self.globals[name] = value
+        e = self
+        while e is not None:
+            if name in e.locals:
+                e.locals[name] = value
+                return
+            e = e.parent
+        self.globals[name] = value
 
     def set_local(self, name: str, value) -> None:
         self.locals[name] = value
@@ -599,9 +613,12 @@ class _Parser:
 
         def make(defenv, params=params, body=body):
             g = defenv.globals
+            # the chunk-level env aliases globals as its locals; chaining
+            # to it would only duplicate the globals fallback
+            parent = defenv if defenv.locals is not g else None
 
             def call(*args):
-                env = Env({}, g)
+                env = Env({}, g, parent)
                 for i, p in enumerate(params):
                     env.set_local(p, args[i] if i < len(args) else None)
                 try:

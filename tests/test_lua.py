@@ -558,3 +558,51 @@ class TestMetatables:
                 v = setmetatable({}, {__add = function() return 1 end})
                 x = v + 1
             """)
+
+
+class TestClosureUpvalues:
+    def test_counter_idiom_mutates_upvalue(self):
+        st = LuaState("""
+            function make_counter()
+                local n = 0
+                return function()
+                    n = n + 1
+                    return n
+                end
+            end
+            c1 = make_counter()
+            c2 = make_counter()
+            a = c1()
+            b = c1()
+            c = c2()
+        """)
+        assert st.get("a") == 1
+        assert st.get("b") == 2
+        assert st.get("c") == 1          # independent upvalue per closure
+
+    def test_nested_read_and_shared_state(self):
+        st = LuaState("""
+            function make_acc(start)
+                local total = start
+                local t = {}
+                t.add = function(x) total = total + x end
+                t.get = function() return total end
+                return t
+            end
+            acc = make_acc(10)
+            acc.add(5)
+            acc.add(7)
+            r = acc.get()
+        """)
+        assert st.get("r") == 22          # both closures share the upvalue
+
+    def test_plain_assignment_still_reaches_globals(self):
+        st = LuaState("""
+            g = 1
+            function bump()
+                g = g + 1                -- no local binding: global write
+            end
+            bump()
+            bump()
+        """)
+        assert st.get("g") == 3
