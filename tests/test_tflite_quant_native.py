@@ -175,3 +175,51 @@ def test_float_graph_selects_nothing():
     assert not lo._nq
     out = lo.forward(lo.params, np.ones((1, 4), np.float32))[0]
     assert np.asarray(out).shape == (1, 2)
+
+
+def test_weight_only_mode_matches_emulation_exactly():
+    """compute:w8 — packed int8 weights, in-jit dequant, float math:
+    numerics must EQUAL f32 emulation (same ops, different placement of
+    the dequant) while the staged params stay int8 in HBM."""
+    rng = np.random.default_rng(7)
+    w = rng.integers(-127, 128, (5, 3, 3, 4), dtype=np.int8)
+    bias = rng.integers(-400, 400, (5,), dtype=np.int32)
+    g = _Graph(
+        tensors=[
+            _qspec((1, 6, 6, 4), np.uint8, 0, [0.05], [3]),
+            _qspec((5, 3, 3, 4), np.int8, 1,
+                   [0.02, 0.03, 0.01, 0.04, 0.05], [0] * 5, qdim=0),
+            _qspec((5,), np.int32, 2, [0.001], [0]),
+            _qspec((1, 6, 6, 5), np.uint8, 0, [0.11], [100]),
+        ],
+        inputs=[0], outputs=[3],
+        ops=[_Op(code=3, custom_code=None, inputs=[0, 1, 2], outputs=[3],
+                 options=_opts({1: ("int32", 1), 2: ("int32", 1)}))],
+        buffers=[b"", w.tobytes(), bias.tobytes()])
+    x = rng.integers(0, 256, (1, 6, 6, 4), dtype=np.uint8)
+
+    emul = _run(g, False, x)
+    lo = _Lowerer(g, weight_only=True)
+    assert lo._wo, "weight-only selected no packed tensors"
+    packed = [v for v in lo.params.values() if v.dtype == np.int8]
+    assert packed and packed[0].nbytes == w.nbytes   # stays int8 in HBM
+    got = np.asarray(lo.forward(lo.params, x)[0]).astype(np.int32)
+    np.testing.assert_array_equal(got, emul)
+
+
+def test_weight_only_on_float_graph_is_noop():
+    g = _Graph(
+        tensors=[
+            _TSpec(shape=(1, 4), np_dtype=np.float32, buffer=0, name="",
+                   scale=None, zero_point=None, qdim=0),
+            _TSpec(shape=(1, 4), np_dtype=np.float32, buffer=0, name="",
+                   scale=None, zero_point=None, qdim=0),
+        ],
+        inputs=[0], outputs=[1],
+        ops=[_Op(code=6, custom_code=None, inputs=[0], outputs=[1],
+                 options=None)],
+        buffers=[b""])
+    lo = _Lowerer(g, weight_only=True)
+    assert not lo._wo
+    x = np.ones((1, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(lo.forward(lo.params, x)[0]), x)
