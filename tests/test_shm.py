@@ -211,3 +211,29 @@ class TestShmPipeline:
         finally:
             if prod.poll() is None:
                 prod.kill()
+
+
+class TestNoProducer:
+    def test_missing_ring_fails_cleanly_within_timeout(self):
+        """A consumer pipeline whose producer never appears must surface
+        a timely pipeline error (the blocking open runs on the streaming
+        thread with the documented timeout), not hang play() or wait()."""
+        from nnstreamer_tpu import parse_launch
+
+        name = _unique("t-none")
+        p = parse_launch(
+            f"tensor_shm_src path={name} timeout=1 ! tensor_sink name=out")
+        t0 = time.monotonic()
+        try:
+            p.run(timeout=30)
+            errored = getattr(p, "error", None) is not None
+        except Exception:
+            errored = True
+        finally:
+            try:
+                p.stop()
+            except Exception:
+                pass
+        elapsed = time.monotonic() - t0
+        assert errored, "missing producer did not surface an error"
+        assert elapsed < 20, f"took {elapsed:.1f}s (should be ~timeout)"
