@@ -473,3 +473,35 @@ class TestYoloPalmDecodePushdown:
                 opts="option4=100:100 option5=100:100")
         finally:
             _MODELS.pop("tiny_palm", None)
+
+
+class TestDeviceNmsVmap:
+    def test_device_nms_lifts_over_batch(self):
+        """The micro-batched engine vmaps the fused decode fn — the NMS
+        kernel (top_k + fori_loop keep-scan) must lift over a batch axis
+        and agree with per-item calls."""
+        import jax
+
+        from nnstreamer_tpu.ops.nms import device_nms
+
+        rng = np.random.default_rng(6)
+        bsz, n = 3, 32
+        y0 = rng.random((bsz, n)).astype(np.float32) * 0.8
+        x0 = rng.random((bsz, n)).astype(np.float32) * 0.8
+        boxes = np.stack(
+            [y0, x0, y0 + 0.1 + rng.random((bsz, n)).astype(np.float32) * .2,
+             x0 + 0.1 + rng.random((bsz, n)).astype(np.float32) * .2],
+            axis=2)
+        scores = rng.random((bsz, n)).astype(np.float32)
+        classes = rng.integers(1, 3, (bsz, n)).astype(np.int32)
+
+        vfn = jax.jit(jax.vmap(
+            lambda b, s, c: device_nms(b, s, c, k=n, score_thresh=0.3)))
+        vb, vc, vs, vnum = vfn(boxes, scores, classes)
+        for i in range(bsz):
+            b1, c1, s1, n1 = device_nms(boxes[i], scores[i], classes[i],
+                                        k=n, score_thresh=0.3)
+            np.testing.assert_array_equal(np.asarray(vc[i]),
+                                          np.asarray(c1))
+            np.testing.assert_allclose(np.asarray(vs[i]), np.asarray(s1))
+            assert int(vnum[i][0]) == int(np.asarray(n1)[0])
