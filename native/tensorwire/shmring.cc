@@ -41,7 +41,10 @@ constexpr uint32_t kVersion = 1;
 constexpr uint32_t kCapsMax = 4096;
 
 struct Header {
-  uint32_t magic;
+  // atomic: the consumer spins on magic to detect a fully-initialized
+  // header; release-store / acquire-load pairing makes every prior
+  // header write visible on weakly-ordered ISAs too (not just x86-64)
+  std::atomic<uint32_t> magic;
   uint32_t version;
   uint64_t slot_size;
   uint32_t n_slots;
@@ -114,8 +117,7 @@ void *tw_shm_create(const char *name, uint64_t slot_size, uint32_t n_slots,
   h->eos.store(0, std::memory_order_relaxed);
   h->version = kVersion;
   // magic last: a concurrently-opening consumer sees a complete header
-  std::atomic_thread_fence(std::memory_order_release);
-  h->magic = kMagic;
+  h->magic.store(kMagic, std::memory_order_release);
   Ring *r = new Ring{h, len, {0}, true};
   strncpy(r->name, name, sizeof(r->name) - 1);
   return r;
@@ -147,8 +149,11 @@ void *tw_shm_open(const char *name, uint32_t timeout_ms) {
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
   Header *h = static_cast<Header *>(mem);
-  while (h->magic != kMagic && now_ms() < deadline) sleep_us(2000);
-  if (h->magic != kMagic || h->version != kVersion) {
+  while (h->magic.load(std::memory_order_acquire) != kMagic &&
+         now_ms() < deadline)
+    sleep_us(2000);
+  if (h->magic.load(std::memory_order_acquire) != kMagic ||
+      h->version != kVersion) {
     munmap(mem, len);
     return nullptr;
   }

@@ -94,6 +94,12 @@ class ShmRing:
         if not name.startswith("/"):
             name = "/" + name
         self.name = name
+        if create and len(caps.encode()) > _CAPS_MAX:
+            # uniform, descriptive reject on BOTH paths (the native
+            # tw_shm_create would return nullptr -> opaque ConnectionError)
+            raise ValueError(
+                f"shm ring {name!r}: caps string {len(caps.encode())} B "
+                f"exceeds {_CAPS_MAX} B header slot")
         self._lib = _native_lib()
         self._h = None
         self._mm: Optional[mmap.mmap] = None
@@ -132,7 +138,7 @@ class ShmRing:
                 platform.machine())
         path = "/dev/shm" + self.name
         if create:
-            caps_b = caps.encode()
+            caps_b = caps.encode()  # <= _CAPS_MAX, checked in __init__
             total = _OFF_SLOTS + n_slots * (_SLOT_HDR + slot_bytes)
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "wb") as f:
@@ -164,7 +170,11 @@ class ShmRing:
                                 f"shm ring {self.name!r}: version {ver} "
                                 f"!= {_VERSION}")
                     os.close(self._fd)
-                except OSError:
+                except FileNotFoundError:
+                    # only "not created yet" retries; anything else —
+                    # including the version-mismatch ConnectionError
+                    # (an OSError subclass!) — must escape, not spin
+                    # into a misleading "open timed out"
                     pass
                 if time.monotonic() > deadline:
                     raise ConnectionError(f"shm ring {self.name!r}: "
@@ -296,7 +306,6 @@ class ShmSink(Element):
 
     def start(self):
         self._ring: Optional[ShmRing] = None
-        self._pending_caps = ""
 
     def stop(self):
         if self._ring is not None:
@@ -311,6 +320,15 @@ class ShmSink(Element):
             self._ring = ShmRing(str(self.path), create=True,
                                  slot_bytes=int(self.slot_bytes),
                                  n_slots=int(self.slots), caps=str(caps))
+            self._ring_caps = str(caps)
+        elif str(caps) != self._ring_caps:
+            # the header caps are the consumer's negotiation source; a
+            # silent mid-stream change would let differently-shaped
+            # records flow under stale caps
+            raise RuntimeError(
+                f"{self.name}: caps renegotiation after ring creation is "
+                f"not supported (ring header holds {self._ring_caps!r}); "
+                "stop/start the sink to change caps")
 
     def chain(self, pad, buf):
         if self._ring is None:

@@ -90,7 +90,18 @@ def load_user_script(path: str, prefix: str, class_attr: str,
         sys.modules.pop(name, None)
         raise
     shim = sys.modules.get("nnstreamer_python")
-    ref_style = any(v is shim for v in vars(mod).values())
+    # reference-style detection must also catch `from nnstreamer_python
+    # import TensorShape`: any script global that IS a shim-defined API
+    # member (by identity) marks the script, not just the bound module
+    # object.  Only members DEFINED here count — matching re-exported
+    # imports (np, os) would misclassify native scripts that import
+    # numpy themselves.
+    shim_api_ids = {id(v) for k, v in vars(shim).items()
+                    if not k.startswith("_")
+                    and getattr(v, "__module__", None) == shim.__name__
+                    } if shim else set()
+    ref_style = any(v is shim or id(v) in shim_api_ids
+                    for v in vars(mod).values())
     if hasattr(mod, instance_attr):
         return getattr(mod, instance_attr), ref_style
     if hasattr(mod, class_attr):

@@ -25,6 +25,43 @@ REF_MODELS = "/root/reference/tests/test_models/models"
 HAVE_REF = os.path.isfile(os.path.join(REF_MODELS, "passthrough.py"))
 
 
+class TestRefStyleDetection:
+    def test_from_import_detected_as_ref_style(self, tmp_path):
+        """`from nnstreamer_python import TensorShape` must classify as
+        reference-style just like `import nnstreamer_python` (the
+        argument contract of setInputDim differs between styles)."""
+        from nnstreamer_tpu.utils.nns_python_compat import load_user_script
+
+        script = tmp_path / "from_import_filter.py"
+        script.write_text(
+            "from nnstreamer_python import TensorShape\n"
+            "class CustomFilter:\n"
+            "    def getInputDim(self):\n"
+            "        return [TensorShape([4], 'uint8')]\n"
+            "    def getOutputDim(self):\n"
+            "        return [TensorShape([4], 'uint8')]\n"
+            "    def invoke(self, tensors):\n"
+            "        return tensors\n")
+        _, ref_style = load_user_script(str(script), "t_refdet",
+                                        "CustomFilter", "filter_instance")
+        assert ref_style
+
+    def test_native_script_importing_numpy_not_misclassified(self, tmp_path):
+        """A native-style script that imports numpy must NOT be flagged
+        ref-style just because the shim also has numpy in its globals."""
+        from nnstreamer_tpu.utils.nns_python_compat import load_user_script
+
+        script = tmp_path / "native_filter.py"
+        script.write_text(
+            "import numpy as np\n"
+            "class CustomFilter:\n"
+            "    def invoke(self, tensors):\n"
+            "        return [np.asarray(t) for t in tensors]\n")
+        _, ref_style = load_user_script(str(script), "t_natdet",
+                                        "CustomFilter", "filter_instance")
+        assert not ref_style
+
+
 class TestShim:
     def test_tensor_shape_mutable_dims(self):
         s = TensorShape([3, 224, 224, 1], np.uint8)

@@ -213,6 +213,56 @@ class TestShmPipeline:
                 prod.kill()
 
 
+class TestHeaderSafety:
+    def test_py_oversized_caps_rejected(self):
+        """Pure-Python producer must mirror the native reject: a caps
+        string over the 4096 B header slot would overwrite the head/tail
+        atomics region."""
+        with pytest.raises(ValueError, match="caps"):
+            _make_py_ring(_unique("t-caps"), True, slot_bytes=1 << 12,
+                          n_slots=2, caps="x" * 5000)
+
+    def test_py_version_mismatch_surfaces_as_version_error(self):
+        """A wrong-version ring must raise the version error promptly,
+        not spin to the deadline and report a misleading open timeout
+        (ConnectionError subclasses OSError — the retry loop must not
+        swallow it)."""
+        import struct
+
+        name = _unique("t-ver")
+        prod = _make_py_ring(name, True, slot_bytes=1 << 12, n_slots=2)
+        try:
+            prod._mm[0:8] = struct.pack("<II", 0x4E545352, 99)
+            t0 = time.monotonic()
+            with pytest.raises(ConnectionError, match="version"):
+                _make_py_ring(name, False, timeout=10.0)
+            assert time.monotonic() - t0 < 5, "spun to deadline instead"
+        finally:
+            prod.close(unlink=True)  # no consumer will ever unlink it
+
+    def test_sink_caps_renegotiation_raises(self):
+        """Mid-stream caps change after ring creation must fail loudly:
+        consumers negotiate from the ring header, which cannot change."""
+        from nnstreamer_tpu.pipeline.registry import make_element
+
+        ring_name = _unique("t-reneg")
+        sink = make_element("tensor_shm_sink", path=ring_name)
+        sink.start()
+        try:
+            caps1 = "other/tensors,num_tensors=1,dimensions=3:4,types=uint8"
+            caps2 = "other/tensors,num_tensors=1,dimensions=5:6,types=uint8"
+            sink.set_caps(None, caps1)
+            sink.set_caps(None, caps1)      # same caps: fine
+            with pytest.raises(RuntimeError, match="renegotiation"):
+                sink.set_caps(None, caps2)
+        finally:
+            sink.stop()
+            try:  # producer-side stop never unlinks; no consumer will
+                os.unlink("/dev/shm/" + ring_name)
+            except OSError:
+                pass
+
+
 class TestNoProducer:
     def test_missing_ring_fails_cleanly_within_timeout(self):
         """A consumer pipeline whose producer never appears must surface
