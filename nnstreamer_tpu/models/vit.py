@@ -58,9 +58,11 @@ class _Attention(nn.Module):
         q, k, v = q[0], k[0], v[0]          # (T, H, D) kernel layout
         flash = self.flash
         if flash is None:
-            from ..ops.flash_attention import flash_is_default
+            # length-gated: at ViT's T≈197 naive XLA attention measured
+            # FASTER than the kernel on hardware (see flash_wins)
+            from ..ops.flash_attention import flash_wins
 
-            flash = flash_is_default()
+            flash = flash_wins(t)
         if flash:
             from ..ops.flash_attention import flash_attention
 

@@ -55,6 +55,46 @@ def flash_is_default() -> bool:
     return platform == "tpu"
 
 
+#: Sequence-length crossover for kernel-vs-naive selection.  Hardware
+#: timings (BENCH_flash_r04.json, one v5e chip) show naive XLA attention
+#: FASTER than this kernel at every captured length — 1.23x at T=2048,
+#: 1.05x at T=8192, trend converging — so full-attention callers below
+#: the crossover should let XLA fuse the naive path.  The kernel's
+#: upside is memory: naive materializes the (T, T) score matrix per head
+#: (O(T^2) HBM — 2 GiB/head bf16 at 32k, OOM territory), the kernel
+#: streams it through VMEM at O(T*d).  Above the crossover the kernel is
+#: both the faster and the only-feasible choice.  Refreshed from the
+#: 16k/32k rows of tools/flash_tpu_bench.py when a capture window
+#: provides them; override with NNS_TPU_FLASH_MIN_T.
+FLASH_MIN_T_DEFAULT = 16384
+
+
+def flash_min_t() -> int:
+    import os
+
+    raw = os.environ.get("NNS_TPU_FLASH_MIN_T")
+    if not raw:
+        return FLASH_MIN_T_DEFAULT
+    try:
+        return int(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"NNS_TPU_FLASH_MIN_T={raw!r} is not an int; "
+                      f"using default {FLASH_MIN_T_DEFAULT}")
+        return FLASH_MIN_T_DEFAULT
+
+
+def flash_wins(t: int) -> bool:
+    """Length-gated kernel selection for ``flash=None`` callers doing
+    FULL local attention (vit@197, lm@2k): pick the Pallas kernel only
+    where it beats (or memory-obsoletes) naive XLA attention — on TPU at
+    ``t >= flash_min_t()``.  Blockwise callers (ring attention) keep
+    selecting the kernel directly: their per-block lse-merge and O(T*d)
+    footprint are the point, not raw single-block speed."""
+    return flash_is_default() and t >= flash_min_t()
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, max_ref,
             sum_ref, *, n_k_blocks: int, causal: bool, q_offset: int,
             k_offset: int, scale: float, kv_len: int = 0):

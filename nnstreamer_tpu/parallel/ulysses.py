@@ -36,8 +36,10 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       q, k, v: (T_local, n_heads, head_dim); n_heads must divide by the
       axis size.
       flash: run the local core as the Pallas streaming-softmax kernel
-        (ops/flash_attention.py) — default: on TPU only (the interpreter
-        is slow on CPU; numerics are oracle-tested identical).
+        (ops/flash_attention.py) — default: on TPU (this is the
+        sequence-parallel training path: the kernel's O(T·d)
+        forward AND backward residuals are the design, so the
+        forward-speed crossover gate does not apply here).
 
     Returns: (T_local, n_heads, head_dim).
     """
@@ -56,6 +58,12 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qg, kg, vg = (a2a(x, 1, 0) for x in (q, k, v))
     # the full sequence is local now, so plain causal attention is exact
     if flash is None:
+        # NOT length-gated: ulysses is the sequence-parallel TRAINING
+        # path, where the kernel's O(T*d) forward+backward residuals are
+        # the point — naive autodiff saves (H, T, T) probability
+        # residuals per layer, which OOMs long-context jobs that fit
+        # with the kernel.  The speed crossover (flash_wins) is measured
+        # on forward-only timings and does not cover the backward.
         from ..ops.flash_attention import flash_is_default
 
         flash = flash_is_default()

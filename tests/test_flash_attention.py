@@ -170,3 +170,108 @@ def test_gradients_match_naive():
     for a, b in zip(gf, gn):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
+
+
+class TestLengthGatedSelection:
+    """flash_wins: kernel-vs-naive selection is gated on sequence length
+    (hardware data: naive XLA attention beat the kernel at 2k and 8k;
+    the kernel's O(T*d) memory makes it mandatory at long context)."""
+
+    def test_below_crossover_prefers_naive_even_on_tpu(self, monkeypatch):
+        from nnstreamer_tpu.ops import flash_attention as fa
+
+        monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
+        monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+        assert not fa.flash_wins(197)      # vit
+        assert not fa.flash_wins(2048)     # lm prefill
+        assert not fa.flash_wins(8192)     # measured 0.95x
+        assert fa.flash_wins(16384)
+        assert fa.flash_wins(32768)
+
+    def test_off_tpu_never_selects_kernel(self, monkeypatch):
+        from nnstreamer_tpu.ops import flash_attention as fa
+
+        monkeypatch.setattr(fa, "flash_is_default", lambda: False)
+        assert not fa.flash_wins(32768)
+
+    def test_env_override_moves_crossover(self, monkeypatch):
+        from nnstreamer_tpu.ops import flash_attention as fa
+
+        monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+        monkeypatch.setenv("NNS_TPU_FLASH_MIN_T", "1024")
+        assert fa.flash_wins(2048)
+        monkeypatch.setenv("NNS_TPU_FLASH_MIN_T", "65536")
+        assert not fa.flash_wins(32768)
+
+    def test_malformed_env_override_warns_and_uses_default(
+            self, monkeypatch):
+        import warnings
+
+        from nnstreamer_tpu.ops import flash_attention as fa
+
+        monkeypatch.setenv("NNS_TPU_FLASH_MIN_T", "16k")
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            assert fa.flash_min_t() == fa.FLASH_MIN_T_DEFAULT
+        assert any("NNS_TPU_FLASH_MIN_T" in str(w.message) for w in caught)
+
+    def test_ulysses_training_path_keeps_kernel(self, monkeypatch):
+        """The seq-parallel training core must NOT be length-gated: the
+        kernel's O(T*d) backward residuals are the design (naive
+        autodiff saves (H, T, T) probabilities per layer)."""
+        import inspect
+
+        from nnstreamer_tpu.parallel import ulysses
+
+        src = inspect.getsource(ulysses.ulysses_attention)
+        assert "flash_is_default" in src and "flash_wins(" not in src
+
+    def test_vit_attention_defaults_to_naive_below_crossover(
+            self, monkeypatch):
+        monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
+        """A TPU-resident ViT (T=197) must take the naive path under the
+        gate: the kernel would be selected only above the crossover."""
+        import nnstreamer_tpu.ops.flash_attention as fa
+        from nnstreamer_tpu.models import vit as vit_mod
+
+        monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+
+        called = {"flash": False}
+        real = fa.flash_attention
+
+        def spy(*a, **kw):
+            called["flash"] = True
+            return real(*a, **kw, interpret=True)
+
+        monkeypatch.setattr(fa, "flash_attention", spy)
+        model = vit_mod.ViT(num_classes=10, depth=1, dim=64, heads=2,
+                            patch=16, dtype=jnp.float32)
+        x = np.zeros((32, 32, 3), np.float32)
+        params = model.init(jax.random.PRNGKey(0), x)
+        model.apply(params, x)
+        assert not called["flash"], "vit below crossover selected kernel"
+
+    def test_lm_prefill_defaults_to_naive_below_crossover(
+            self, monkeypatch):
+        monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
+        import nnstreamer_tpu.ops.flash_attention as fa
+        from nnstreamer_tpu.models.streamformer_lm import forward_logits
+        from nnstreamer_tpu.parallel.train_step import (StreamFormerConfig,
+                                                        init_params)
+
+        monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+        called = {"flash": False}
+        real = fa.flash_attention
+
+        def spy(*a, **kw):
+            called["flash"] = True
+            return real(*a, **kw, interpret=True)
+
+        monkeypatch.setattr(fa, "flash_attention", spy)
+        cfg = StreamFormerConfig(vocab=64, dim=32, heads=2, head_dim=16,
+                                 mlp=64, layers=1, experts=1, max_seq=64,
+                                 dtype=jnp.float32)
+        params = init_params(cfg, 0)
+        toks = jnp.zeros((16,), jnp.int32)
+        forward_logits(params, toks, cfg)
+        assert not called["flash"], "short prefill selected kernel"

@@ -154,8 +154,11 @@ def main() -> int:
     # streaming backward (FlashAttention-2 structure): gradcheck vs the
     # naive oracle, non-interpreted — Mosaic must compile all three
     # backward kernels for the real chip
+    # 8192 hardware-verifies the O(T·d) claim at a length where it
+    # matters: the naive backward materializes (T,T) probability tiles,
+    # the streaming backward never does
     grad_checks = []
-    for t, h, d in [(1024, 8, 64), (1023, 4, 64)]:
+    for t, h, d in [(1024, 8, 64), (1023, 4, 64), (8192, 8, 64)]:
         q = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
         k = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
         v = jnp.asarray(rng.standard_normal((t, h, d)), jnp.bfloat16)
@@ -223,9 +226,17 @@ def main() -> int:
                         "naive_ms": round(ms_naive, 3),
                         "speedup": round(speedup, 3)})
 
+    # measured kernel-vs-naive crossover: smallest T where the kernel
+    # wins outright (speedup > 1 or naive OOM).  Feeds the length-gated
+    # selection default (ops/flash_attention.py flash_min_t) and the
+    # docs/PERFORMANCE.md crossover sentence.
+    crossover = next(
+        (row["T"] for row in timings
+         if row.get("flash_only") or row.get("speedup", 0) > 1.0), None)
     print(json.dumps({"metric": "flash_attention_tpu_proof",
                       "value": round(speedup, 3), "unit": "x_vs_naive",
-                      "ok": ok, "checks": checks,
+                      "ok": ok, "crossover_T": crossover,
+                      "checks": checks,
                       "grad_checks": grad_checks, "timings": timings,
                       "device": str(dev)}), flush=True)
     return 0 if ok else 1
