@@ -110,6 +110,16 @@ class TensorFilter(Element):
                     "device execution engine; emitting host tensors",
                     self.name, self._props.framework)
             self._emit_device = False
+        if self._batch <= 1 and getattr(self.fw, "_mesh", None) is not None:
+            # only the BATCHED executable spans the mesh; per-frame
+            # dispatch would silently serve on one device while paying
+            # replicated-param HBM on all of them
+            from ..filter.framework import FilterError
+
+            raise FilterError(
+                f"{self.name}: custom=mesh:dp=N requires micro-batching "
+                f"(set batch= to a multiple of dp); per-frame dispatch "
+                "cannot shard")
         self._pending: list = []        # per-frame input lists, collecting
         self._pending_bufs: list = []
         self._inflight = None           # (bufs, handle) dispatched batch

@@ -132,7 +132,7 @@ class JitExecMixin:
     SUPPORTS_BATCHING = True
 
     def _setup_exec(self, forward_fn, params, device, warmup_inputs=None,
-                    compute_dtype=None):
+                    compute_dtype=None, mesh=None):
         """Compile + stage: params → HBM, jit the forward, optional warm-up
         invoke so frame 1 is steady state.  Returns the warm-up outputs
         (callers probe output meta from them — no second device trip).
@@ -142,7 +142,18 @@ class JitExecMixin:
         wrapped to run float math in that dtype, casting float outputs
         back to their original precision — the generic MXU-native mode
         for lowered-graph backends (the tflite backend does this inside
-        its lowering instead, where it also owns requantization)."""
+        its lowering instead, where it also owns requantization).
+
+        ``mesh`` (from ``custom=mesh:dp=N`` via :meth:`_resolve_mesh`):
+        dp-shard the BATCHED serving executable over a ``("dp",)`` device
+        mesh — params replicated, the stream micro-batch split along
+        axis 0, XLA placing per-device compute (the TPU-native superset
+        of the reference's among-device offload,
+        tensor_query_client.c:656-743: instead of shipping sub-pipelines
+        to other devices over TCP, the ONE serving executable spans the
+        mesh).  The unbatched executable (p50 probe, tiny-tail flush)
+        stays single-device on ``device`` with its own param copy — a
+        1-frame dispatch has nothing to shard."""
         import jax
 
         if compute_dtype is not None:
@@ -154,11 +165,51 @@ class JitExecMixin:
         self._params_dev = jax.device_put(params, device)
         self._jitted = jax.jit(forward_fn)
         self._vjit = None
+        self._mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._params_mesh = jax.device_put(
+                params, NamedSharding(mesh, PartitionSpec()))
+        else:
+            self._params_mesh = None
         if warmup_inputs is None:
             return None
         outs = self._invoke_device(warmup_inputs)
         jax.block_until_ready(outs)
         return outs
+
+    @staticmethod
+    def _resolve_mesh(props, device):
+        """``custom=mesh:dp=N``: a data-parallel serving mesh of N devices
+        of this backend's platform.  None when the prop is absent or
+        N == 1; FilterError on bad syntax or too few devices."""
+        import jax
+        from jax.sharding import Mesh
+
+        spec = str(getattr(props, "custom_properties", {}).get(
+            "mesh", "")).strip()
+        if not spec:
+            return None
+        if not spec.startswith("dp="):
+            raise FilterError(
+                f"mesh spec {spec!r} not understood (expected mesh:dp=N; "
+                "tp/pp serving shardings are model-parallel training "
+                "territory — see parallel/)")
+        try:
+            dp = int(spec[3:])
+        except ValueError:
+            raise FilterError(f"mesh:dp={spec[3:]!r} is not an integer")
+        if dp < 1:
+            raise FilterError(f"mesh:dp={dp} must be >= 1")
+        if dp == 1:
+            return None
+        devs = [d for d in jax.devices() if d.platform == device.platform]
+        if len(devs) < dp:
+            raise FilterError(
+                f"mesh:dp={dp} but only {len(devs)} {device.platform} "
+                "device(s) visible")
+        return Mesh(np.array(devs[:dp]), ("dp",))
 
     @staticmethod
     def _resolve_compute(props, device):
@@ -183,6 +234,8 @@ class JitExecMixin:
         self._vjit = None
         self._forward_fn = None
         self._params_dev = None
+        self._params_mesh = None
+        self._mesh = None
 
     @staticmethod
     def _pick_device(accelerators):
@@ -211,7 +264,11 @@ class JitExecMixin:
         hop per distinct frame defeats the device-resident fast path."""
         if is_device_array(x):
             devs = getattr(x, "devices", None)
-            if devs is not None and self._device not in devs():
+            # a mismatch is EITHER a different device OR a multi-device
+            # (mesh-sharded) array feeding a single-device executable —
+            # e.g. a mesh:dp cascade into a plain filter; device_put
+            # gathers/reshards both cases
+            if devs is not None and set(devs()) != {self._device}:
                 cache = getattr(self, "_xdev_cache", None)
                 if cache is None:
                     cache = self._xdev_cache = {}
@@ -314,33 +371,67 @@ class JitExecMixin:
             if len(segs) == 1 and lo == 0 and b0.shape[0] == bucket:
                 # 1:1 with the upstream batch (padding rows included —
                 # upstream pads by repeating its last frame, exactly this
-                # stage's own padding policy): feed it straight through
+                # stage's own padding policy): feed it straight through.
+                # In mesh mode a sharded upstream batch stays sharded —
+                # _dispatch_batched's device_put onto the batch sharding
+                # is a no-op for a same-mesh cascade (true zero-copy).
+                if getattr(self, "_mesh", None) is not None:
+                    return b0
                 return self._ensure_device(b0)
-            parts = [b[lo:hi] for b, lo, hi in segs]
+            # mixed segments: normalize every part onto this executable's
+            # device BEFORE concatenating — jnp ops reject operands
+            # committed to different device sets (a dp-sharded cascade
+            # row next to a single-device flush-tail row)
+            parts = [self._ensure_device(b[lo:hi]) for b, lo, hi in segs]
             if n < bucket:
-                last = segs[-1]
-                pad = last[0][last[2] - 1:last[2]]
+                pad = parts[-1][-1:]
                 parts.append(jnp.broadcast_to(
                     pad, (bucket - n,) + tuple(pad.shape[1:])))
-            return self._ensure_device(jnp.concatenate(parts, axis=0))
+            return jnp.concatenate(parts, axis=0)
         # plain device arrays (device source / flush-tail outputs):
         # stack ON DEVICE -- one tiny dispatch instead of a d2h sync +
-        # full h2d re-upload
-        arrs = [a.device_slice() if isinstance(a, BatchView) else a
+        # full h2d re-upload (per-element ensure: see mixed-segment note)
+        arrs = [self._ensure_device(
+                    a.device_slice() if isinstance(a, BatchView) else a)
                 for a in arrs]
         if n < bucket:
             arrs = arrs + [arrs[-1]] * (bucket - n)
-        return self._ensure_device(jnp.stack(arrs))
+        return jnp.stack(arrs)
 
     def _dispatch_batched(self, stacked, emit_device: bool = False):
         import jax
 
-        if self._vjit is None:
-            n_in = len(stacked)
-            self._vjit = jax.jit(jax.vmap(self._forward_fn,
-                                          in_axes=(None,) + (0,) * n_in))
-        with jax.default_device(self._device):
-            outs = self._vjit(self._params_dev, *stacked)
+        mesh = getattr(self, "_mesh", None)
+        n_in = len(stacked)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            dp = mesh.devices.size
+            bucket = stacked[0].shape[0]
+            if bucket % dp:
+                raise FilterError(
+                    f"stream batch {bucket} not divisible by mesh dp={dp} "
+                    "(set tensor_filter batch= to a multiple)")
+            bs = NamedSharding(mesh, P("dp"))
+            if self._vjit is None:
+                ps = NamedSharding(mesh, P())
+                self._vjit = jax.jit(
+                    jax.vmap(self._forward_fn,
+                             in_axes=(None,) + (0,) * n_in),
+                    in_shardings=(ps,) + (bs,) * n_in,
+                    out_shardings=bs)
+            # committed single-device arrays (device sources, cascades)
+            # must be resharded onto the mesh explicitly — jit treats a
+            # committed-mismatch as an error, device_put reshards
+            stacked = [jax.device_put(s, bs) if is_device_array(s) else s
+                       for s in stacked]
+            outs = self._vjit(self._params_mesh, *stacked)
+        else:
+            if self._vjit is None:
+                self._vjit = jax.jit(jax.vmap(self._forward_fn,
+                                              in_axes=(None,) + (0,) * n_in))
+            with jax.default_device(self._device):
+                outs = self._vjit(self._params_dev, *stacked)
         if not emit_device:
             start_output_transfers(outs)
         return outs

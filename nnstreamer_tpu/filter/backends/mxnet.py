@@ -450,7 +450,8 @@ class MXNetFilter(JitExecMixin, FilterFramework):
         zeros = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
         outs = self._setup_exec(
             fn, params, device, warmup_inputs=zeros,
-            compute_dtype=self._resolve_compute(props, device))
+            compute_dtype=self._resolve_compute(props, device),
+            mesh=self._resolve_mesh(props, device))
         probed = TensorsInfo([TensorInfo.from_np(np.asarray(o), name=n)
                               for o, n in zip(outs, out_names)])
         if props.output_info is not None and props.output_info.is_valid():

@@ -130,7 +130,8 @@ class PyTorchFilter(JitExecMixin, FilterFramework):
         zeros = [np.zeros(i.np_shape, i.np_dtype) for i in self._in_info]
         # the warm-up outputs double as the output-meta probe (the
         # reference probes the interpreter the same way at open)
-        outs = self._setup_exec(fn, ts_params, device, warmup_inputs=zeros)
+        outs = self._setup_exec(fn, ts_params, device, warmup_inputs=zeros,
+                                mesh=self._resolve_mesh(props, device))
         probed = TensorsInfo([TensorInfo.from_np(np.asarray(o))
                               for o in outs])
         self._check_declared_output(props, probed)

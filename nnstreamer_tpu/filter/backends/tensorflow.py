@@ -769,7 +769,8 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
             np.zeros(i.np_shape, i.np_dtype) for i in in_info]
         outs = self._setup_exec(
             fn, consts, device, warmup_inputs=zeros,
-            compute_dtype=self._resolve_compute(props, device))
+            compute_dtype=self._resolve_compute(props, device),
+            mesh=self._resolve_mesh(props, device))
         probed = TensorsInfo([TensorInfo.from_np(np.asarray(o), name=r)
                               for o, r in zip(outs, out_refs)])
         if props.output_info is not None and props.output_info.is_valid():

@@ -1018,7 +1018,8 @@ class TFLiteFilter(JitExecMixin, FilterFramework):
         in_info, out_info = self.get_model_info()
         zeros = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
         outs = self._setup_exec(self._lower.forward, self._lower.params,
-                                device, warmup_inputs=zeros)
+                                device, warmup_inputs=zeros,
+                                mesh=self._resolve_mesh(props, device))
         # declared int64 outputs (e.g. ARG_MAX) come back int32 when jax
         # x64 is off — record per-output host casts so invoke() honors the
         # declared meta downstream relies on

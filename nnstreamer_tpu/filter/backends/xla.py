@@ -109,7 +109,8 @@ class XLAFilter(JitExecMixin, FilterFramework):
         zeros = [np.zeros(i.np_shape, i.np_dtype)
                  for i in self._model.in_info]
         self._setup_exec(self._model.forward, self._model.params,
-                         self._device, warmup_inputs=zeros)
+                         self._device, warmup_inputs=zeros,
+                         mesh=self._resolve_mesh(props, self._device))
         super().open(props)
 
     def close(self) -> None:
