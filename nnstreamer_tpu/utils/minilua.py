@@ -19,18 +19,24 @@ values       numbers (int/float), strings, booleans, nil, 1-based
              adjustment (non-final results truncate to one value, the
              final one expands; conditions take the first value)
 stdlib       math.floor/ceil/abs/min/max/sqrt/huge · string.format/sub/
-             len/upper/lower/rep/reverse/byte/char/find/gsub (find and
-             gsub take PLAIN needles — Lua pattern magic raises loudly)
-             · table.insert/remove/concat · tostring · tonumber · # ·
+             len/upper/lower/rep/reverse/byte/char/find/match/gmatch/
+             gsub with REAL Lua patterns (§6.4.1: classes %a %d %s %w
+             %l %u %p %c %x + complements, [sets] with ranges and ^,
+             * + - ? quantifiers, ^ $ anchors, captures incl. position
+             captures and %1-%9 back-references, %b balanced, %f
+             frontier; gsub takes string/function/table replacements
+             with %0-%9 escapes and returns (result, count)) ·
+             table.insert/remove/concat · tostring · tonumber · # ·
              print · setmetatable/getmetatable/rawget/rawset/type with
-             the __index (table or function, chained), __newindex, and
-             __call metamethods — the class/OOP idiom works; closures
+             __index (table or function, chained), __newindex, __call,
+             AND the operator metamethods __add/__sub/__mul/__div/
+             __mod/__pow/__unm/__eq/__lt/__le/__concat (first operand's
+             metatable, then the second's, manual §2.8); closures
              capture lexical scope and MUTATE upvalues (the counter
-             idiom works).  Not implemented: operator metamethods
-             (__add …), per-iteration loop-variable scoping, coroutines,
-             goto, string pattern matching — scripts touching those
-             fail with a named LuaError (or behave as documented in
-             Env for loop captures).
+             idiom works).  Not implemented: per-iteration
+             loop-variable scoping, coroutines, goto — scripts touching
+             those fail with a named LuaError (or behave as documented
+             in Env for loop captures).
 
 Execution compiles the AST to Python closures once (scripts run a
 nested-loop body per frame — ~1M interpreted ops for the reference's
@@ -112,9 +118,10 @@ def _lex(src: str) -> List[Tuple[str, Any]]:
 
 class LuaTable:
     """1-based table: array part + hash part in one dict; optional
-    metatable (``__index``/``__newindex``/``__call`` are honored — the
-    metamethods the reference-era filter scripts use; operator
-    metamethods stay outside the subset and fail loudly)."""
+    metatable (``__index``/``__newindex``/``__call`` plus the operator
+    metamethods ``__add``/``__sub``/``__mul``/``__div``/``__mod``/
+    ``__pow``/``__unm``/``__eq``/``__lt``/``__le``/``__concat`` are
+    honored — see _BINFN and the unary/power parsers)."""
 
     __slots__ = ("data", "metatable")
 
@@ -669,7 +676,17 @@ class _Parser:
     def unary(self) -> Callable:
         if self.accept("-"):
             operand = self.unary()
-            return lambda env: -_first(operand(env))
+
+            def neg(env):
+                v = _first(operand(env))
+                if isinstance(v, (int, float)):
+                    return -v
+                h = _metamethod(v, "__unm")
+                if h is not None:
+                    return _first(_call_value(h, (v, v)))
+                raise LuaError("lua: arithmetic (unary -) on non-number "
+                               "(no __unm metamethod)")
+            return neg
         if self.accept("not"):
             operand = self.unary()
             return lambda env: not _truthy(operand(env))
@@ -693,7 +710,18 @@ class _Parser:
         base = self.finish_expr_from_suffixed(self.suffixed())
         if self.accept("^"):
             exp = self.unary()       # right associative, binds over unary
-            return lambda env: _first(base(env)) ** _first(exp(env))
+
+            def powr(env):
+                a, b = _first(base(env)), _first(exp(env))
+                if isinstance(a, (int, float)) and isinstance(b,
+                                                              (int, float)):
+                    return a ** b
+                h = _meta_bin(a, b, "__pow")
+                if h is not None:
+                    return h()
+                raise LuaError("lua: arithmetic (^) on non-number "
+                               "(no __pow metamethod)")
+            return powr
         return base
 
     # -- primary/suffixed expressions ---------------------------------------
@@ -953,24 +981,95 @@ def _lua_tonumber(v, base=None):
         return None
 
 
-def _arith(name, fn):
+def _metamethod(v, event):
+    if isinstance(v, LuaTable) and v.metatable is not None:
+        return v.metatable.get(event)
+    return None
+
+
+def _meta_bin(a, b, event):
+    """First operand's metamethod, then the second's (manual §2.8 order);
+    None when neither has one."""
+    h = _metamethod(a, event) or _metamethod(b, event)
+    if h is None:
+        return None
+    return lambda: _first(_call_value(h, (a, b)))
+
+
+def _arith(name, fn, event):
     def op(a, b):
-        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
-            raise LuaError(f"lua: arithmetic ({name}) on non-number")
-        return fn(a, b)
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            return fn(a, b)
+        h = _meta_bin(a, b, event)
+        if h is not None:
+            return h()
+        raise LuaError(f"lua: arithmetic ({name}) on non-number "
+                       f"(no {event} metamethod)")
     return op
 
 
+def _lua_lt(a, b):
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a < b
+    if isinstance(a, str) and isinstance(b, str):
+        return a < b
+    h = _meta_bin(a, b, "__lt")
+    if h is not None:
+        return _truthy(h())
+    raise LuaError("lua: attempt to compare incompatible values "
+                   "(no __lt metamethod)")
+
+
+def _lua_le(a, b):
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a <= b
+    if isinstance(a, str) and isinstance(b, str):
+        return a <= b
+    h = _meta_bin(a, b, "__le")
+    if h is not None:
+        return _truthy(h())
+    raise LuaError("lua: attempt to compare incompatible values "
+                   "(no __le metamethod)")
+
+
+def _lua_eq(a, b):
+    if a is b:
+        return True
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False       # Lua: different types are never equal
+                           # (Python would unify True == 1)
+    if isinstance(a, LuaTable) and isinstance(b, LuaTable):
+        # __eq fires only when neither raw-equal nor identical (§2.8)
+        h = _meta_bin(a, b, "__eq")
+        if h is not None:
+            return _truthy(h())
+        return False
+    if isinstance(a, LuaTable) or isinstance(b, LuaTable):
+        return False
+    return a == b
+
+
+def _lua_concat(a, b):
+    if isinstance(a, (str, int, float)) and isinstance(b, (str, int, float)):
+        return _lua_str(a) + _lua_str(b)
+    h = _meta_bin(a, b, "__concat")
+    if h is not None:
+        return h()
+    bad = a if not isinstance(a, (str, int, float)) else b
+    raise LuaError(f"lua: attempt to concatenate a {_lua_type(bad)} "
+                   "value (no __concat metamethod)")
+
+
 _BINFN: Dict[str, Callable] = {
-    "+": _arith("+", lambda a, b: a + b),
-    "-": _arith("-", lambda a, b: a - b),
-    "*": _arith("*", lambda a, b: a * b),
-    "/": _arith("/", lambda a, b: a / b),
-    "%": _arith("%", lambda a, b: a - math.floor(a / b) * b),
-    "<": lambda a, b: a < b, ">": lambda a, b: a > b,
-    "<=": lambda a, b: a <= b, ">=": lambda a, b: a >= b,
-    "==": lambda a, b: a == b, "~=": lambda a, b: a != b,
-    "..": lambda a, b: _lua_str(a) + _lua_str(b),
+    "+": _arith("+", lambda a, b: a + b, "__add"),
+    "-": _arith("-", lambda a, b: a - b, "__sub"),
+    "*": _arith("*", lambda a, b: a * b, "__mul"),
+    "/": _arith("/", lambda a, b: a / b, "__div"),
+    "%": _arith("%", lambda a, b: a - math.floor(a / b) * b, "__mod"),
+    "<": _lua_lt, ">": lambda a, b: _lua_lt(b, a),
+    "<=": _lua_le, ">=": lambda a, b: _lua_le(b, a),
+    "==": _lua_eq, "~=": lambda a, b: not _lua_eq(a, b),
+    "..": _lua_concat,
 }
 
 
@@ -988,7 +1087,6 @@ def _make_math() -> LuaTable:
 
 
 _FMT_RE = re.compile(r"%[-+ #0]*\d*(?:\.\d+)?[diouxXeEfgGqsc%]")
-_LUA_MAGIC = re.compile(r"[\^\$\*\+\?\.\(\)\[\]%\-]")
 
 
 def _lua_format(fmt: str, *args) -> str:
@@ -1049,12 +1147,295 @@ def _str_range(s: str, i, j=None):
     return i - 1, j
 
 
-def _plain_only(pat: str, what: str) -> None:
-    if _LUA_MAGIC.search(pat):
-        raise LuaError(
-            f"lua: {what}: Lua patterns are not supported by this "
-            f"interpreter — only plain-text needles ({pat!r} contains "
-            "pattern magic)")
+# ---------------------------------------------------------------------------
+# Lua pattern matching (manual §6.4.1), written from the manual's
+# specification: character classes, sets, quantifiers (* + - ?),
+# anchors, captures (incl. position captures and %1-%9 back-references),
+# %b balanced match, %f frontier.  Recursive matcher with explicit
+# backtracking — the same observable semantics as liblua's lstrlib, from
+# a fresh implementation.
+# ---------------------------------------------------------------------------
+
+_HEXDIGITS = "0123456789abcdefABCDEF"
+
+
+def _cls_match(ch: str, cl: str) -> bool:
+    """Single class character (the letter after %%) against one char."""
+    low = cl.lower()
+    if low == "a":
+        res = ch.isalpha()
+    elif low == "c":
+        res = ord(ch) < 32 or ord(ch) == 127
+    elif low == "d":
+        res = ch.isdigit()
+    elif low == "l":
+        res = ch.islower()
+    elif low == "p":
+        res = ch.isprintable() and not ch.isalnum() and not ch.isspace()
+    elif low == "s":
+        res = ch in " \t\n\r\f\v"
+    elif low == "u":
+        res = ch.isupper()
+    elif low == "w":
+        res = ch.isalnum()
+    elif low == "x":
+        res = ch in _HEXDIGITS
+    else:
+        return ch == cl                    # %. %% %( … : literal escape
+    return (not res) if cl.isupper() else res
+
+
+class _MatchState:
+    __slots__ = ("src", "pat", "caps")
+
+    def __init__(self, src: str, pat: str):
+        self.src = src
+        self.pat = pat
+        self.caps: List[List[Any]] = []    # [start, len] ; len -1 = open,
+        #                                    "pos" = position capture
+
+
+def _class_end(ms: _MatchState, pi: int) -> int:
+    """Index just past the single-item class starting at pat[pi]."""
+    p = ms.pat
+    c = p[pi]
+    pi += 1
+    if c == "%":
+        if pi >= len(p):
+            raise LuaError("lua pattern: malformed (ends with '%')")
+        return pi + 1
+    if c == "[":
+        if pi < len(p) and p[pi] == "^":
+            pi += 1
+        first = True                        # ']' as first char is literal
+        while True:
+            if pi >= len(p):
+                raise LuaError("lua pattern: malformed (missing ']')")
+            if p[pi] == "]" and not first:
+                return pi + 1
+            if p[pi] == "%":
+                pi += 1
+                if pi >= len(p):
+                    raise LuaError("lua pattern: malformed (ends with '%')")
+            pi += 1
+            first = False
+    return pi
+
+
+def _set_match(ms: _MatchState, ch: str, pi: int, ep: int) -> bool:
+    """Char vs a [set] spanning pat[pi:ep] (pi at '[', ep past ']')."""
+    p = ms.pat
+    i = pi + 1
+    neg = False
+    if i < ep - 1 and p[i] == "^":
+        neg = True
+        i += 1
+    res = False
+    while i < ep - 1:
+        if p[i] == "%" and i + 1 < ep - 1:
+            if _cls_match(ch, p[i + 1]):
+                res = True
+            i += 2
+        elif i + 2 < ep - 1 and p[i + 1] == "-":
+            if p[i] <= ch <= p[i + 2]:
+                res = True
+            i += 3
+        else:
+            if p[i] == ch:
+                res = True
+            i += 1
+    return res != neg
+
+
+def _single_match(ms: _MatchState, si: int, pi: int, ep: int) -> bool:
+    if si >= len(ms.src):
+        return False
+    ch = ms.src[si]
+    c = ms.pat[pi]
+    if c == ".":
+        return True
+    if c == "%":
+        return _cls_match(ch, ms.pat[pi + 1])
+    if c == "[":
+        return _set_match(ms, ch, pi, ep)
+    return ch == c
+
+
+def _max_expand(ms: _MatchState, si: int, pi: int, ep: int):
+    i = 0
+    while _single_match(ms, si + i, pi, ep):
+        i += 1
+    while i >= 0:
+        r = _pm(ms, si + i, ep + 1)
+        if r is not None:
+            return r
+        i -= 1
+    return None
+
+
+def _min_expand(ms: _MatchState, si: int, pi: int, ep: int):
+    while True:
+        r = _pm(ms, si, ep + 1)
+        if r is not None:
+            return r
+        if _single_match(ms, si, pi, ep):
+            si += 1
+        else:
+            return None
+
+
+def _pm(ms: _MatchState, si: int, pi: int):
+    """Match pat[pi:] at src[si:]; returns the end index or None."""
+    p, s = ms.pat, ms.src
+    while True:
+        if pi >= len(p):
+            return si
+        c = p[pi]
+        if c == "(":
+            if pi + 1 < len(p) and p[pi + 1] == ")":   # position capture
+                ms.caps.append([si, "pos"])
+                r = _pm(ms, si, pi + 2)
+                if r is None:
+                    ms.caps.pop()
+                return r
+            ms.caps.append([si, -1])
+            r = _pm(ms, si, pi + 1)
+            if r is None:
+                ms.caps.pop()
+            return r
+        if c == ")":
+            for cap in reversed(ms.caps):
+                if cap[1] == -1:
+                    cap[1] = si - cap[0]
+                    r = _pm(ms, si, pi + 1)
+                    if r is None:
+                        cap[1] = -1
+                    return r
+            raise LuaError("lua pattern: unmatched ')'")
+        if c == "$" and pi + 1 == len(p):
+            return si if si == len(s) else None
+        if c == "%" and pi + 1 < len(p):
+            nx = p[pi + 1]
+            if nx == "b":
+                if pi + 3 >= len(p):
+                    raise LuaError("lua pattern: malformed %b "
+                                   "(needs two chars)")
+                x, y = p[pi + 2], p[pi + 3]
+                if si >= len(s) or s[si] != x:
+                    return None
+                bal, j = 1, si + 1
+                while j < len(s):
+                    if s[j] == y:
+                        bal -= 1
+                        if bal == 0:
+                            r = _pm(ms, j + 1, pi + 4)
+                            if r is not None:
+                                return r
+                            break
+                    elif s[j] == x:
+                        bal += 1
+                    j += 1
+                return None
+            if nx == "f":
+                if pi + 2 >= len(p) or p[pi + 2] != "[":
+                    raise LuaError("lua pattern: missing '[' after %f")
+                ep = _class_end(ms, pi + 2)
+                prev = s[si - 1] if si > 0 else "\0"
+                cur = s[si] if si < len(s) else "\0"
+                if (not _set_match(ms, prev, pi + 2, ep)
+                        and _set_match(ms, cur, pi + 2, ep)):
+                    pi = ep
+                    continue
+                return None
+            if nx.isdigit():                      # back-reference
+                idx = int(nx) - 1
+                if (nx == "0" or idx >= len(ms.caps)
+                        or ms.caps[idx][1] in (-1, "pos")):
+                    raise LuaError(f"lua pattern: invalid capture %{nx}")
+                st, ln = ms.caps[idx]
+                cap = s[st:st + ln]
+                if s.startswith(cap, si):
+                    si += len(cap)
+                    pi += 2
+                    continue
+                return None
+        ep = _class_end(ms, pi)
+        q = p[ep] if ep < len(p) else ""
+        if q == "?":
+            if _single_match(ms, si, pi, ep):
+                r = _pm(ms, si + 1, ep + 1)
+                if r is not None:
+                    return r
+            pi = ep + 1
+            continue
+        if q == "+":
+            if not _single_match(ms, si, pi, ep):
+                return None
+            return _max_expand(ms, si + 1, pi, ep)
+        if q == "*":
+            return _max_expand(ms, si, pi, ep)
+        if q == "-":
+            return _min_expand(ms, si, pi, ep)
+        if not _single_match(ms, si, pi, ep):
+            return None
+        si += 1
+        pi = ep
+
+
+def _captures(ms: _MatchState, si: int, ei: int) -> List[Any]:
+    """Captured values (whole match when no captures)."""
+    if not ms.caps:
+        return [ms.src[si:ei]]
+    out = []
+    for start, ln in ms.caps:
+        if ln == "pos":
+            out.append(float(start + 1))          # 1-based position
+        elif ln == -1:
+            raise LuaError("lua pattern: unfinished capture")
+        else:
+            out.append(ms.src[start:start + ln])
+    return out
+
+
+def _has_captures(pat: str) -> bool:
+    i = 0
+    while i < len(pat):
+        c = pat[i]
+        if c == "%":
+            i += 2
+        elif c == "[":
+            # skip the whole [set] — '(' inside it is literal
+            i += 1
+            if i < len(pat) and pat[i] == "^":
+                i += 1
+            first = True
+            while i < len(pat) and (pat[i] != "]" or first):
+                if pat[i] == "%":
+                    i += 1
+                i += 1
+                first = False
+            i += 1
+        elif c == "(":
+            return True
+        else:
+            i += 1
+    return False
+
+
+def _pat_search(s: str, pat: str, init: int = 0):
+    """Find the first match of `pat` in `s` at/after byte `init`.
+    Returns (start, end, captures) with 0-based [start, end), or None."""
+    anchor = pat.startswith("^")
+    p0 = 1 if anchor else 0
+    si = init
+    while True:
+        ms = _MatchState(s, pat)
+        e = _pm(ms, si, p0)
+        if e is not None:
+            return si, e, _captures(ms, si, e)
+        si += 1
+        if anchor or si > len(s):
+            return None
 
 
 def _make_string() -> LuaTable:
@@ -1063,27 +1444,113 @@ def _make_string() -> LuaTable:
         return s[a:b] if a < b else ""
 
     def find(s, pat, init=1, plain=None):
-        if not _truthy(plain):
-            _plain_only(pat, "string.find")
         a, _ = _str_range(s, init)
-        idx = s.find(pat, a)
-        if idx < 0:
+        a = min(a, len(s))          # Lua 5.1 clamps init to #s+1
+        if _truthy(plain):
+            idx = s.find(pat, a)
+            if idx < 0:
+                return None
+            return (float(idx + 1), float(idx + len(pat)))
+        hit = _pat_search(s, pat, a)
+        if hit is None:
             return None
-        return (idx + 1, idx + len(pat))    # (start, end), Lua 1-based
+        st, en, ms_caps = hit
+        caps = () if not _has_captures(pat) else tuple(ms_caps)
+        return (float(st + 1), float(en)) + caps
+
+    def match(s, pat, init=1):
+        a, _ = _str_range(s, init)
+        a = min(a, len(s))          # Lua 5.1 clamps init to #s+1
+        hit = _pat_search(s, pat, a)
+        if hit is None:
+            return None
+        caps = hit[2]
+        return caps[0] if len(caps) == 1 else tuple(caps)
+
+    def gmatch(s, pat):
+        state = {"pos": 0}
+
+        def it(*_ignored):
+            while state["pos"] <= len(s):
+                hit = _pat_search(s, pat, state["pos"])
+                if hit is None:
+                    return None
+                st, en, caps = hit
+                state["pos"] = en + 1 if en == st else en  # empty-match step
+                return caps[0] if len(caps) == 1 else tuple(caps)
+            return None
+        return it
+
+    def _expand_repl(repl: str, caps: List[Any], whole: str) -> str:
+        out: List[str] = []
+        i = 0
+        while i < len(repl):
+            ch = repl[i]
+            if ch == "%" and i + 1 < len(repl):
+                nx = repl[i + 1]
+                if nx == "%":
+                    out.append("%")
+                elif nx == "0":
+                    out.append(whole)
+                elif nx.isdigit():
+                    idx = int(nx) - 1
+                    if idx >= len(caps):
+                        raise LuaError(
+                            f"lua: string.gsub: invalid capture %{nx} "
+                            "in replacement")
+                    out.append(_lua_str(caps[idx]))
+                else:
+                    raise LuaError(
+                        f"lua: string.gsub: invalid use of '%' in "
+                        f"replacement ('%{nx}')")
+                i += 2
+            else:
+                out.append(ch)
+                i += 1
+        return "".join(out)
 
     def gsub(s, pat, repl, n=None):
-        _plain_only(pat, "string.gsub")
-        if not isinstance(repl, str):
-            raise LuaError(
-                "lua: string.gsub: only string replacements are "
-                "supported (function/table replacements are not)")
-        if "%" in repl.replace("%%", ""):
-            raise LuaError(
-                "lua: string.gsub: capture escapes (%1, %0, ...) in the "
-                "replacement are not supported (plain text only)")
-        repl = repl.replace("%%", "%")      # the literal-% spelling
-        limit = -1 if n is None else int(n)
-        return s.replace(pat, repl, limit if limit >= 0 else -1)
+        limit = math.inf if n is None else int(n)
+        out: List[str] = []
+        pos = 0
+        count = 0
+        anchor = pat.startswith("^")
+        while count < limit and pos <= len(s):
+            hit = _pat_search(s, pat, pos)
+            if hit is None:
+                break
+            st, en, caps = hit
+            out.append(s[pos:st])
+            whole = s[st:en]
+            if isinstance(repl, str):
+                rep = _expand_repl(repl, caps, whole)
+            elif isinstance(repl, LuaTable):
+                rep = repl.get(caps[0])
+            elif callable(repl):
+                rep = _first(repl(*caps))
+            else:
+                raise LuaError("lua: string.gsub: replacement must be a "
+                               "string, table, or function")
+            if rep is None or rep is False:     # nil/false: keep the match
+                rep = whole
+            elif not isinstance(rep, str):
+                if isinstance(rep, (int, float)):
+                    rep = _lua_str(rep)
+                else:
+                    raise LuaError("lua: string.gsub: replacement value "
+                                   f"must be a string (got {_lua_type(rep)})")
+            out.append(rep)
+            count += 1
+            if en == st:                         # empty match: emit + step
+                if st < len(s):
+                    out.append(s[st])
+                pos = st + 1
+            else:
+                pos = en
+            if anchor:
+                break
+        out.append(s[pos:])
+        return ("".join(out), float(count))
 
     def byte(s, i=1):
         a, _ = _str_range(s, i)
@@ -1098,7 +1565,7 @@ def _make_string() -> LuaTable:
         "reverse": lambda s: s[::-1],
         "byte": byte,
         "char": lambda *cs: "".join(chr(int(c)) for c in cs),
-        "find": find, "gsub": gsub,
+        "find": find, "match": match, "gmatch": gmatch, "gsub": gsub,
     })
 
 

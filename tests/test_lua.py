@@ -177,8 +177,8 @@ def test_open_errors_become_filter_errors(tmp_path):
 
 class TestStdlibExtensions:
     """string/table libraries + repeat/until (round-3 weakness: a user
-    script using string.format died; Lua-manual semantics, plain-text
-    find/gsub only — pattern magic raises loudly)."""
+    script using string.format died; Lua-manual semantics — real
+    pattern matching is covered in TestLuaPatterns below)."""
 
     def test_string_format(self):
         st = LuaState(
@@ -217,11 +217,11 @@ class TestStdlibExtensions:
         assert st.get("i") == 3
         assert st.get("g") == "baNANA"
 
-    def test_pattern_magic_is_loud(self):
+    def test_malformed_pattern_is_loud(self):
         with pytest.raises(LuaError, match="pattern"):
-            LuaState('x = string.find("abc", "a%d", 1)')
-        with pytest.raises(LuaError, match="pattern"):
-            LuaState('x = string.gsub("abc", "a.c", "x")')
+            LuaState('x = string.find("abc", "[a")')      # missing ]
+        with pytest.raises(LuaError, match="capture"):
+            LuaState('x = string.gsub("abc", "a", "%9")')  # bad capture
 
     def test_repeat_until(self):
         st = LuaState(
@@ -275,18 +275,19 @@ class TestStdlibExtensions:
         with pytest.raises(LuaError, match="invalid conversion"):
             LuaState('x = string.format("%d %y", 5)')
 
-    def test_gsub_function_replacement_is_loud(self):
-        with pytest.raises(LuaError, match="string replacements"):
-            LuaState('function f(c) return "X" end\n'
-                     'x = string.gsub("abc", "b", f)')
+    def test_gsub_function_replacement(self):
+        st = LuaState('function f(c) return "X" end\n'
+                      'x = string.gsub("abc", "b", f)')
+        assert st.get("x") == "aXc"
 
     def test_tonumber_boolean_is_nil(self):
         st = LuaState("a = tonumber(true)\nb = tonumber(false)")
         assert st.get("a") is None and st.get("b") is None
 
-    def test_gsub_percent_in_replacement_is_loud(self):
-        with pytest.raises(LuaError, match="escapes"):
-            LuaState('x = string.gsub("abc", "b", "%1")')
+    def test_gsub_capture_escape_in_replacement(self):
+        # %1 with no explicit capture refers to the whole match
+        st = LuaState('x = string.gsub("abc", "b", "[%1]")')
+        assert st.get("x") == "a[b]c"
 
     def test_table_insert_out_of_bounds_is_loud(self):
         with pytest.raises(LuaError, match="out of bounds"):
@@ -550,12 +551,12 @@ class TestMetatables:
         assert st.get("ty2") == "function"
         assert st.get("ty3") == "nil"
 
-    def test_operator_metamethods_stay_loud(self):
-        """__add etc. are outside the subset: arithmetic on a table must
-        still fail loudly, never silently misbehave."""
-        with pytest.raises((LuaError, TypeError)):
+    def test_operator_metamethod_without_handler_stays_loud(self):
+        """Arithmetic on a table WITHOUT the metamethod must fail loudly,
+        never silently misbehave."""
+        with pytest.raises(LuaError, match="__add"):
             LuaState("""
-                v = setmetatable({}, {__add = function() return 1 end})
+                v = setmetatable({}, {__mul = function() return 1 end})
                 x = v + 1
             """)
 
@@ -606,3 +607,259 @@ class TestClosureUpvalues:
             bump()
         """)
         assert st.get("g") == 3
+
+
+class TestLuaPatterns:
+    """Real Lua pattern matching (manual §6.4.1) — the reference embeds
+    full liblua (tensor_filter_lua.cc:591), so reference-style scripts
+    use string.match/gmatch/gsub with classes, captures, and anchors."""
+
+    def test_find_with_classes(self):
+        st = LuaState('s, e = string.find("abc123", "%d+")')
+        assert st.get("s") == 4 and st.get("e") == 6
+
+    def test_find_returns_captures(self):
+        st = LuaState(
+            's, e, k, v = string.find("width=640", "(%a+)=(%d+)")')
+        assert (st.get("s"), st.get("e")) == (1, 9)
+        assert st.get("k") == "width" and st.get("v") == "640"
+
+    def test_match_whole_and_captures(self):
+        st = LuaState("""
+            whole = string.match("frame_0042.png", "%d+")
+            name, num = string.match("frame_0042.png", "(%a+)_(%d+)")
+        """)
+        assert st.get("whole") == "0042"
+        assert st.get("name") == "frame" and st.get("num") == "0042"
+
+    def test_match_returns_nil_on_no_match(self):
+        st = LuaState('m = string.match("abc", "%d")')
+        assert st.get("m") is None
+
+    def test_gmatch_iterates_all(self):
+        st = LuaState("""
+            acc = {}
+            for w in string.gmatch("one two  three", "%a+") do
+                table.insert(acc, w)
+            end
+            joined = table.concat(acc, ",")
+        """)
+        assert st.get("joined") == "one,two,three"
+
+    def test_gmatch_key_value_pairs(self):
+        st = LuaState("""
+            t = {}
+            for k, v in string.gmatch("a=1, b=2", "(%w+)=(%w+)") do
+                t[k] = v
+            end
+        """)
+        t = st.get("t")
+        assert t.get("a") == "1" and t.get("b") == "2"
+
+    def test_gsub_pattern_and_capture_escapes(self):
+        st = LuaState("""
+            r1, n1 = string.gsub("hello world", "o", "0")
+            r2 = string.gsub("hello world", "(%w+)", "<%1>")
+            r3 = string.gsub("abc", "%w", "%0%0", 2)
+        """)
+        assert st.get("r1") == "hell0 w0rld" and st.get("n1") == 2
+        assert st.get("r2") == "<hello> <world>"
+        assert st.get("r3") == "aabbc"
+
+    def test_gsub_function_replacement(self):
+        st = LuaState("""
+            r = string.gsub("4+5", "%d", function(d)
+                return tostring(tonumber(d) * 2)
+            end)
+        """)
+        assert st.get("r") == "8+10"
+
+    def test_gsub_table_replacement(self):
+        st = LuaState("""
+            map = {name = "lua", version = "5.1"}
+            r = string.gsub("$name-$version", "%$(%w+)", map)
+        """)
+        assert st.get("r") == "lua-5.1"
+
+    def test_gsub_nil_replacement_keeps_match(self):
+        st = LuaState("""
+            r = string.gsub("a1b2", "%d", function(d)
+                if d == "1" then return "X" end
+            end)
+        """)
+        assert st.get("r") == "aXb2"
+
+    def test_anchors(self):
+        st = LuaState("""
+            a = string.match("hello", "^h%a+$")
+            b = string.match("hello", "^e")
+        """)
+        assert st.get("a") == "hello" and st.get("b") is None
+
+    def test_sets_ranges_negation(self):
+        st = LuaState("""
+            a = string.match("x42y", "[0-9]+")
+            b = string.match("x42y", "[^0-9]+")
+            c = string.gsub("a-b_c", "[-_]", ".")
+        """)
+        assert st.get("a") == "42" and st.get("b") == "x"
+        assert st.get("c") == "a.b.c"
+
+    def test_lazy_quantifier(self):
+        st = LuaState('m = string.match("<a><b>", "<(.-)>")')
+        assert st.get("m") == "a"
+
+    def test_balanced_match(self):
+        st = LuaState('m = string.match("f(a(b)c)d", "%b()")')
+        assert st.get("m") == "(a(b)c)"
+
+    def test_frontier(self):
+        st = LuaState(
+            'r = string.gsub("THE (quick) brOwn", "%f[%a]%u+%f[%A]", "X")')
+        assert st.get("r") == "X (quick) brOwn"
+
+    def test_position_capture(self):
+        st = LuaState('p = string.match("hello", "l()l")')
+        assert st.get("p") == 4
+
+    def test_back_reference(self):
+        st = LuaState("""
+            a = string.match("abcabc", "(abc)%1")
+            b = string.match("abcdef", "(abc)%1")
+        """)
+        assert st.get("a") == "abc" and st.get("b") is None
+
+    def test_escaped_magic_is_literal(self):
+        st = LuaState("""
+            s = string.find("3.14", "%.")
+            r = string.gsub("50%", "%%", " percent")
+        """)
+        assert st.get("s") == 2
+        assert st.get("r") == "50 percent"
+
+    def test_plain_find_still_plain(self):
+        st = LuaState('i = string.find("a.c", ".", 1, true)')
+        assert st.get("i") == 2
+
+    def test_empty_match_advances(self):
+        st = LuaState('r, n = string.gsub("abc", "x*", "-")')
+        assert st.get("r") == "-a-b-c-" and st.get("n") == 4
+
+
+class TestOperatorMetamethods:
+    """__add .. __concat (manual §2.8): the vector/complex class idiom
+    reference-era scripts use."""
+
+    def test_arith_metamethods(self):
+        st = LuaState("""
+            mt = {}
+            mt.__add = function(a, b) return a.v + b.v end
+            mt.__sub = function(a, b) return a.v - b.v end
+            mt.__mul = function(a, b) return a.v * b.v end
+            mt.__div = function(a, b) return a.v / b.v end
+            mt.__mod = function(a, b) return a.v % b.v end
+            mt.__pow = function(a, b) return a.v ^ b.v end
+            mt.__unm = function(a) return -a.v end
+            function box(n) return setmetatable({v = n}, mt) end
+            add = box(7) + box(3)
+            sub = box(7) - box(3)
+            mul = box(7) * box(3)
+            div = box(6) / box(3)
+            mod = box(7) % box(3)
+            pow = box(2) ^ box(3)
+            neg = -box(5)
+        """)
+        assert st.get("add") == 10 and st.get("sub") == 4
+        assert st.get("mul") == 21 and st.get("div") == 2
+        assert st.get("mod") == 1 and st.get("pow") == 8
+        assert st.get("neg") == -5
+
+    def test_mixed_operand_uses_either_metatable(self):
+        st = LuaState("""
+            mt = {__add = function(a, b)
+                local av = type(a) == "table" and a.v or a
+                local bv = type(b) == "table" and b.v or b
+                return av + bv
+            end}
+            x = setmetatable({v = 10}, mt) + 5
+            y = 5 + setmetatable({v = 10}, mt)
+        """)
+        assert st.get("x") == 15 and st.get("y") == 15
+
+    def test_eq_lt_le(self):
+        st = LuaState("""
+            mt = {
+                __eq = function(a, b) return a.v == b.v end,
+                __lt = function(a, b) return a.v < b.v end,
+                __le = function(a, b) return a.v <= b.v end,
+            }
+            function box(n) return setmetatable({v = n}, mt) end
+            eq = box(3) == box(3)
+            ne = box(3) ~= box(4)
+            lt = box(2) < box(3)
+            gt = box(3) > box(2)
+            le = box(3) <= box(3)
+            ge = box(3) >= box(3)
+        """)
+        assert st.get("eq") is True and st.get("ne") is True
+        assert st.get("lt") is True and st.get("gt") is True
+        assert st.get("le") is True and st.get("ge") is True
+
+    def test_eq_not_called_for_identical_tables(self):
+        st = LuaState("""
+            calls = 0
+            mt = {__eq = function(a, b) calls = calls + 1
+                                        return false end}
+            t = setmetatable({}, mt)
+            same = t == t
+        """)
+        assert st.get("same") is True and st.get("calls") == 0
+
+    def test_concat_metamethod(self):
+        st = LuaState("""
+            mt = {__concat = function(a, b)
+                local as = type(a) == "table" and a.s or a
+                local bs = type(b) == "table" and b.s or b
+                return as .. bs
+            end}
+            v = setmetatable({s = "mid"}, mt)
+            r = "pre-" .. v .. "-post"
+        """)
+        assert st.get("r") == "pre-mid-post"
+
+    def test_tables_without_eq_compare_by_identity(self):
+        st = LuaState("""
+            a = {}
+            b = {}
+            same = a == b
+            self_same = a == a
+        """)
+        assert st.get("same") is False and st.get("self_same") is True
+
+
+class TestPatternEdges:
+    """Review-found divergences from liblua, pinned."""
+
+    def test_percent_zero_in_pattern_is_loud(self):
+        with pytest.raises(LuaError, match="capture"):
+            LuaState('m = string.match("abc", "%0")')
+        with pytest.raises(LuaError, match="capture"):
+            LuaState('m = string.match("abcabc", "(abc)%0")')
+
+    def test_paren_inside_set_is_not_a_capture(self):
+        st = LuaState('s, e, c = string.find("a(b", "[(]")')
+        assert st.get("s") == 2 and st.get("e") == 2
+        assert st.get("c") is None
+
+    def test_boolean_never_equals_number(self):
+        st = LuaState("""
+            a = (true == 1)
+            b = (false == 0)
+            c = (true ~= 1)
+        """)
+        assert st.get("a") is False and st.get("b") is False
+        assert st.get("c") is True
+
+    def test_find_init_past_end_clamps(self):
+        st = LuaState('s, e = string.find("abc", "x*", 10)')
+        assert st.get("s") == 4 and st.get("e") == 3   # Lua 5.1 clamp
