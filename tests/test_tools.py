@@ -186,3 +186,38 @@ def test_pbtxt_duplicate_explicit_index_errors():
         pbtxt_pipeline.parse_launch_text(
             "tensor_mux name=mux ! fakesink "
             "appsrc name=a ! mux.sink_0 appsrc name=b ! mux.sink_0")
+
+
+@pytest.fixture(scope="module")
+def probe_out():
+    import tunnel_probe
+
+    return tunnel_probe.probe(reps_rtt=3, sizes_mib=(1,))
+
+
+class TestTunnelProbeCeilings:
+    """Per-config dispatch-bound ceiling table (VERDICT r4 #6): every
+    streaming capture must be auditable against the fps the measured
+    link could possibly deliver."""
+
+    def test_probe_emits_config_ceiling_table(self, probe_out):
+        table = probe_out["config_fps_ceilings_b128"]
+        for cfg in ("mobilenet", "ssd", "deeplab", "posenet", "vit",
+                    "edge", "resident"):
+            assert table[cfg] > 0
+        # resident pays no link bytes: its dispatch-RTT bound must be
+        # the highest ceiling
+        assert table["resident"] >= max(v for k, v in table.items()
+                                        if k != "resident")
+        # bigger frames -> lower link-bound ceiling
+        assert table["ssd"] <= table["mobilenet"]
+
+    def test_ceiling_formula(self, probe_out):
+        # double-buffered: ceiling = B / max(B*frame_bytes/bw, rtt)
+        bw = probe_out["value"] * (1 << 20)
+        rtt = probe_out["rtt_ms_p50"] / 1e3
+        fb = 224 * 224 * 3
+        b = probe_out["ceiling_batch"]
+        want = b / max(b * fb / bw, rtt)
+        assert abs(probe_out["config_fps_ceilings_b128"]["mobilenet"]
+                   - want) < 1
