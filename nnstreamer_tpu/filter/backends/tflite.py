@@ -1044,6 +1044,23 @@ class TFLiteFilter(JitExecMixin, FilterFramework):
         mode anywhere (int8/w8 on a float graph is a no-op: no quantized
         tensors to pack)."""
         choice = str(props.custom_properties.get("compute", "auto")).lower()
+        if (choice == "auto" and device.platform == "tpu"
+                and any(t.quantized for t in self._graph.tensors)):
+            # the quant-graph default is DERIVED FROM HARDWARE DATA
+            # (utils/tuned.py, rewritten by tflite_int8_tpu_bench
+            # --apply), not assumed from MXU theory
+            from ...utils import tuned
+
+            choice = tuned.QUANT_AUTO_TPU
+            if choice not in ("float32", "int8", "w8"):
+                raise FilterError(
+                    f"utils/tuned.py QUANT_AUTO_TPU={choice!r} is not a "
+                    "measured mode (float32 | int8 | w8) — record "
+                    "corrupted?")
+            if choice == "float32":
+                # tuned f32 EMULATION (the measured mode), not the
+                # generic auto policy (which would pick bf16)
+                return None, False, False
         if choice in ("int8", "quant-native"):
             return None, True, False
         if choice in ("w8", "weight-only"):
@@ -1051,9 +1068,6 @@ class TFLiteFilter(JitExecMixin, FilterFramework):
 
             cdtype = jnp.bfloat16 if device.platform == "tpu" else None
             return cdtype, False, True
-        if (choice == "auto" and device.platform == "tpu"
-                and any(t.quantized for t in self._graph.tensors)):
-            return None, True, False
         # float32/bfloat16/auto: the shared engine policy (_jitexec)
         try:
             return self._resolve_compute(props, device), False, False

@@ -1,0 +1,31 @@
+"""Measurement-derived runtime defaults (data over theory).
+
+The quant-graph ``compute:auto`` mode on TPU is a default DECIDED BY
+HARDWARE DATA, not theory: in theory int8×int8→int32 on the MXU is 2×
+the bf16 rate (v5e) with ¼ the f32 weight traffic, but the only
+hardware capture so far (BENCH_int8_r04.json, degraded window) measured
+native int8 at 0.65× the f32-emulation batched rate — with an
+internally inconsistent per-invoke win, pointing at window drift.
+
+``tools/tflite_int8_tpu_bench.py`` measures all three modes
+(f32-emulation / native int8 / weight-only w8) on the real chip each
+healthy capture window and emits a ``recommended_default``; running it
+with ``--apply`` rewrites the record below from its green artifact, so
+the shipped default always carries its own provenance.  The reference's
+analogue decision — which delegate serves a quant graph — is hardcoded
+per-vendor (tensor_filter_tensorflow_lite.cc:55-118); here it follows
+the measurement.
+"""
+
+#: compute mode quant tflite graphs get under ``compute:auto`` on TPU:
+#: "int8" (native MXU int8), "w8" (weight-only), or "float32"
+#: (f32 emulation).
+QUANT_AUTO_TPU = "int8"
+
+#: where the current value came from (rewritten by
+#: tools/tflite_int8_tpu_bench.py --apply)
+QUANT_AUTO_PROVENANCE = (
+    "theory default (MXU int8 2x bf16 rate, exact accumulation); the "
+    "only capture, BENCH_int8_r04.json, measured 0.65x vs emulation "
+    "batched in a DEGRADED window with an inconsistent per-invoke win "
+    "- awaiting a healthy-window 3-mode capture (r5 loop armed)")

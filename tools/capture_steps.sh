@@ -20,5 +20,11 @@ capture sweep "BENCH_sweep_$ROUND.json" all 3600 \
   python bench.py --sweep-batch 32,64,128,256 --deadline 700
 capture int8 "BENCH_int8_$ROUND.json" last 900 \
   python tools/tflite_int8_tpu_bench.py
+# data-derived quant default: a green 3-mode capture rewrites
+# utils/tuned.py (provenance-stamped; committed with the round)
+if _green "BENCH_int8_$ROUND.json" 2>/dev/null; then
+  python tools/tflite_int8_tpu_bench.py --apply "BENCH_int8_$ROUND.json" \
+    && log "quant default applied from BENCH_int8_$ROUND.json"
+fi
 capture flashtune "BENCH_flashtune_$ROUND.json" last 1200 \
   python tools/flash_tpu_bench.py --tune

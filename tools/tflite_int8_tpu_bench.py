@@ -93,6 +93,17 @@ def main() -> int:
           and int(diff_w8.max()) <= TOL_STEPS
           and outs["float32"].argmax() == outs["w8"].argmax())
     speedup = perf["float32"][1] and perf["int8"][1] / perf["float32"][1]
+    # the data-derived default (utils/tuned.py consumes this via
+    # --apply): among modes that AGREED with the f32 oracle, the one
+    # with the best batched throughput serves compute:auto quant graphs
+    candidates = {"float32": perf["float32"][1]}
+    if int(diff.max()) <= TOL_STEPS and bool(
+            outs["float32"].argmax() == outs["int8"].argmax()):
+        candidates["int8"] = perf["int8"][1]
+    if int(diff_w8.max()) <= TOL_STEPS and bool(
+            outs["float32"].argmax() == outs["w8"].argmax()):
+        candidates["w8"] = perf["w8"][1]
+    recommended = max(candidates, key=candidates.get)
     result.update(
         value=round(float(speedup), 3), ok=bool(ok),
         max_qstep_diff=int(diff.max()),
@@ -105,10 +116,84 @@ def main() -> int:
         batched_fps_int8=round(perf["int8"][1], 1),
         batched_fps_w8=round(perf["w8"][1], 1),
         w8_vs_f32=round(perf["w8"][1] / perf["float32"][1], 3)
-        if perf["float32"][1] else 0, batch=BATCH)
+        if perf["float32"][1] else 0, batch=BATCH,
+        recommended_default=recommended)
     print(json.dumps(result), flush=True)
     return 0 if ok else 1
 
 
+def apply_from_artifact(path: str, tuned_path: str = None) -> int:
+    """--apply <artifact.json>: rewrite utils/tuned.py's quant-auto
+    default from a GREEN 3-mode capture, stamping provenance (file,
+    per-mode fps, window link) so the shipped default is auditable.
+    No-op (exit 1) when the artifact is missing/red or lacks the
+    recommendation."""
+    import io
+    import re
+
+    try:
+        rows = [json.loads(ln) for ln in io.open(path)
+                if ln.strip().startswith("{")]
+    except (OSError, ValueError):
+        print(f"apply: cannot read {path}", file=sys.stderr)
+        return 1
+    # gate on a COMPLETED measurement, not on global ok: ok=False means
+    # some mode disagreed with the f32 oracle — exactly when the
+    # recommendation (drawn only from AGREEING modes, f32 always in)
+    # matters most.  A crashed run has no recommended_default.
+    greens = [r for r in rows
+              if r.get("metric") == "tflite_quant_native_tpu"
+              and r.get("recommended_default")
+              and r.get("batched_fps_f32", 0) > 0
+              and "error" not in r]
+    if not greens:
+        print(f"apply: no completed 3-mode row in {path}", file=sys.stderr)
+        return 1
+    row = greens[-1]
+    mode = row["recommended_default"]
+    if mode not in ("float32", "int8", "w8"):
+        print(f"apply: bad mode {mode!r}", file=sys.stderr)
+        return 1
+    provenance = (
+        f"measured: {os.path.basename(path)} — batched fps "
+        f"f32={row.get('batched_fps_f32')} "
+        f"int8={row.get('batched_fps_int8')} "
+        f"w8={row.get('batched_fps_w8')} (batch {row.get('batch')}, "
+        f"{row.get('device', '?')}); modes agreeing with the f32 "
+        f"oracle only; applied by tflite_int8_tpu_bench --apply")
+    if tuned_path is None:
+        tuned_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "nnstreamer_tpu", "utils", "tuned.py")
+    src = io.open(tuned_path).read()
+    src, n_mode = re.subn(r'QUANT_AUTO_TPU = "[a-z0-9]+"',
+                          lambda _m: f'QUANT_AUTO_TPU = "{mode}"',
+                          src, count=1)
+    if not n_mode:
+        print("apply: QUANT_AUTO_TPU line not found in tuned.py",
+              file=sys.stderr)
+        return 1
+    new_prov = ("QUANT_AUTO_PROVENANCE = (\n    "
+                + json.dumps(provenance) + "\n)")
+    # matches both the hand-written block ('")' on the last string line)
+    # and a previously-applied one (')' on its own line)
+    src, n = re.subn(
+        r'QUANT_AUTO_PROVENANCE = \((?:\n    "[^"]*")+\n?\)',
+        lambda _m: new_prov, src, count=1)
+    if not n:
+        print("apply: provenance block not found in tuned.py",
+              file=sys.stderr)
+        return 1
+    io.open(tuned_path, "w").write(src)
+    print(json.dumps({"applied": mode, "provenance": provenance}),
+          flush=True)
+    return 0
+
+
 if __name__ == "__main__":
+    if "--apply" in sys.argv[1:]:
+        idx = sys.argv.index("--apply")
+        target = (sys.argv[idx + 1] if idx + 1 < len(sys.argv)
+                  else "BENCH_int8_r05.json")
+        sys.exit(apply_from_artifact(target))
     sys.exit(main())
