@@ -11,8 +11,10 @@ a hand-written Pallas TPU kernel (per /opt/skills/guides/pallas_guide.md):
   O(block_q·d + block_q·block_k + block_k·d), never O(T²) scores and
   never the full K/V;
 - **MXU-shaped**: both matmuls (Q·Kᵀ and P·V) run as ``dot_general`` with
-  f32 accumulation on bf16/f32 inputs; tiles default to 128 to match the
-  MXU systolic array;
+  f32 accumulation on bf16/f32 inputs; tile defaults are 128 (MXU
+  systolic shape) for short sequences and the MEASURED best shape from
+  ``tools/flash_tpu_bench.py --tune`` (utils/tuned.py FLASH_TILES) for
+  long ones;
 - **differentiable, flash both ways**: a ``jax.custom_vjp`` pairs the
   flash forward with STREAMING Pallas backward kernels
   (FlashAttention-2 structure): the forward saves only O and the
@@ -481,9 +483,23 @@ def _flash_lse_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def _default_tiles(t_q: int, t_kv: int, interpret: bool):
+    """Tile defaults: the measured (tuned) shape on real TPU when both
+    lengths cover it; the 128x128 MXU-shaped default otherwise (a tiny
+    input must not pad up to a giant tuned tile — and the interpreter
+    has no tuned data)."""
+    if not interpret:
+        from ..utils.tuned import FLASH_TILES
+
+        bq, bk = FLASH_TILES
+        if t_q >= bq and t_kv >= bk:
+            return int(bq), int(bk)
+    return 128, 128
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                    causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, q_offset: int = 0,
+                    causal: bool = False, block_q: Optional[int] = None,
+                    block_k: Optional[int] = None, q_offset: int = 0,
                     k_offset: int = 0,
                     interpret: Optional[bool] = None,
                     return_lse: bool = False):
@@ -510,6 +526,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     if interpret is None:
         interpret = not flash_is_default()
+    if block_q is None or block_k is None:
+        dbq, dbk = _default_tiles(q.shape[0], k.shape[0], interpret)
+        block_q = dbq if block_q is None else block_q
+        block_k = dbk if block_k is None else block_k
     if return_lse:
         return _flash_lse(q, k, v, causal, block_q, block_k, q_offset,
                           k_offset, interpret)

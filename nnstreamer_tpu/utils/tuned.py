@@ -29,3 +29,14 @@ QUANT_AUTO_PROVENANCE = (
     "only capture, BENCH_int8_r04.json, measured 0.65x vs emulation "
     "batched in a DEGRADED window with an inconsistent per-invoke win "
     "- awaiting a healthy-window 3-mode capture (r5 loop armed)")
+
+#: (block_q, block_k) the flash kernel defaults to for long sequences
+#: on TPU, measured by tools/flash_tpu_bench.py --tune at T=8192 and
+#: applied with --tune --apply.  Used only when both sequence lengths
+#: cover the tile (short sequences keep the 128x128 MXU-shaped default
+#: so tiny inputs don't pad up to a giant tile).
+FLASH_TILES = (128, 128)
+
+FLASH_TILES_PROVENANCE = (
+    "default (MXU-shaped 128x128); no healthy-window tile-tune capture "
+    "applied yet (r5 loop runs flash_tpu_bench --tune each window)")

@@ -275,3 +275,97 @@ class TestLengthGatedSelection:
         toks = jnp.zeros((16,), jnp.int32)
         forward_logits(params, toks, cfg)
         assert not called["flash"], "short prefill selected kernel"
+
+
+class TestTunedTileDefaults:
+    """Tile defaults follow measured tune data (utils/tuned.py
+    FLASH_TILES) for long sequences; short inputs keep 128x128 so they
+    don't pad up to a giant tuned tile."""
+
+    def test_short_sequences_keep_mxu_default(self, monkeypatch):
+        from nnstreamer_tpu.ops.flash_attention import _default_tiles
+        from nnstreamer_tpu.utils import tuned
+
+        monkeypatch.setattr(tuned, "FLASH_TILES", (512, 1024))
+        assert _default_tiles(197, 197, interpret=False) == (128, 128)
+
+    def test_long_sequences_use_tuned(self, monkeypatch):
+        from nnstreamer_tpu.ops.flash_attention import _default_tiles
+        from nnstreamer_tpu.utils import tuned
+
+        monkeypatch.setattr(tuned, "FLASH_TILES", (256, 512))
+        assert _default_tiles(8192, 8192, interpret=False) == (256, 512)
+
+    def test_interpret_ignores_tuned(self, monkeypatch):
+        from nnstreamer_tpu.ops.flash_attention import _default_tiles
+        from nnstreamer_tpu.utils import tuned
+
+        monkeypatch.setattr(tuned, "FLASH_TILES", (512, 512))
+        assert _default_tiles(8192, 8192, interpret=True) == (128, 128)
+
+    def test_explicit_blocks_still_win(self):
+        # callers passing block_q/block_k keep exact control (the tests
+        # above all pass explicit tiles; spot-check the plumbing)
+        q, k, v = _qkv(64, 2, 16, seed=12)
+        a = flash_attention(q, k, v, block_q=16, block_k=16,
+                            interpret=True)
+        b = flash_attention(q, k, v, interpret=True)  # default tiles
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=1e-5)
+
+    def test_apply_rewrites_flash_tiles(self, tmp_path):
+        import json
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import flash_tpu_bench as tool
+
+        artifact = tmp_path / "tune.json"
+        artifact.write_text(json.dumps({
+            "metric": "flash_tile_tune", "value": 1.31,
+            "best": {"block_q": 256, "block_k": 512, "ms": 4.2},
+            "grad_ok": True,
+            "default_ms": 5.5, "device": "TPU_0"}) + "\n")
+        tuned_copy = tmp_path / "tuned.py"
+        src = open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "nnstreamer_tpu", "utils",
+            "tuned.py")).read()
+        tuned_copy.write_text(src)
+        rc = tool.apply_tiles_from_artifact(str(artifact),
+                                            tuned_path=str(tuned_copy))
+        assert rc == 0
+        new = tuned_copy.read_text()
+        assert "FLASH_TILES = (256, 512)" in new
+        assert "tune.json" in new
+        compile(new, "tuned.py", "exec")
+        # idempotent re-apply
+        assert tool.apply_tiles_from_artifact(
+            str(artifact), tuned_path=str(tuned_copy)) == 0
+
+    def test_apply_refuses_tune_without_baseline_or_gradcheck(
+            self, tmp_path):
+        import json
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import flash_tpu_bench as tool
+
+        # missing 128x128 baseline
+        a1 = tmp_path / "nobase.json"
+        a1.write_text(json.dumps({
+            "metric": "flash_tile_tune", "value": 1.0,
+            "best": {"block_q": 512, "block_k": 512, "ms": 4.0},
+            "grad_ok": True, "default_ms": None}) + "\n")
+        assert tool.apply_tiles_from_artifact(str(a1)) == 1
+        # gradient check failed/absent: the tile must not become the
+        # custom_vjp default
+        a2 = tmp_path / "nograd.json"
+        a2.write_text(json.dumps({
+            "metric": "flash_tile_tune", "value": 1.2,
+            "best": {"block_q": 1024, "block_k": 1024, "ms": 3.0},
+            "grad_ok": False, "default_ms": 3.6}) + "\n")
+        assert tool.apply_tiles_from_artifact(str(a2)) == 1

@@ -1,0 +1,66 @@
+"""Shared machinery for measurement→default application.
+
+Both appliers (tflite_int8_tpu_bench --apply, flash_tpu_bench --tune
+--apply) rewrite provenance-stamped records in
+nnstreamer_tpu/utils/tuned.py from green capture artifacts; the
+row-loading and rewrite plumbing lives here once so the tuned.py format
+has a single consumer to keep in sync with.
+"""
+
+import json
+import os
+import re
+import sys
+
+
+def load_last_row(path: str, metric: str, pred=None):
+    """Last artifact row matching `metric` (and `pred(row)` when given),
+    or None.  Rows with an "error" key never match."""
+    try:
+        with open(path) as fh:
+            rows = [json.loads(ln) for ln in fh
+                    if ln.strip().startswith("{")]
+    except (OSError, ValueError):
+        print(f"apply: cannot read {path}", file=sys.stderr)
+        return None
+    hits = [r for r in rows if r.get("metric") == metric
+            and "error" not in r and (pred is None or pred(r))]
+    return hits[-1] if hits else None
+
+
+def default_tuned_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "nnstreamer_tpu", "utils", "tuned.py")
+
+
+def rewrite_tuned(value_pattern: str, value_repl: str,
+                  provenance_var: str, provenance: str,
+                  tuned_path: str = None) -> bool:
+    """Rewrite one value line (regex `value_pattern` -> literal
+    `value_repl`) and its provenance block in tuned.py.  Returns False
+    (with stderr detail) when either pattern is missing — a silent
+    partial rewrite would make the provenance lie."""
+    if tuned_path is None:
+        tuned_path = default_tuned_path()
+    with open(tuned_path) as fh:
+        src = fh.read()
+    src, n_val = re.subn(value_pattern, lambda _m: value_repl, src,
+                         count=1)
+    if not n_val:
+        print(f"apply: {value_pattern!r} not found in tuned.py",
+              file=sys.stderr)
+        return False
+    # matches both the hand-written block ('")' on the last string
+    # line) and a previously-applied one (')' on its own line)
+    src, n_prov = re.subn(
+        provenance_var + r' = \((?:\n    "[^"]*")+\n?\)',
+        lambda _m: (provenance_var + " = (\n    "
+                    + json.dumps(provenance) + "\n)"), src, count=1)
+    if not n_prov:
+        print(f"apply: {provenance_var} block not found in tuned.py",
+              file=sys.stderr)
+        return False
+    with open(tuned_path, "w") as fh:
+        fh.write(src)
+    return True

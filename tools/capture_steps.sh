@@ -28,3 +28,10 @@ if _green "BENCH_int8_$ROUND.json" 2>/dev/null; then
 fi
 capture flashtune "BENCH_flashtune_$ROUND.json" last 1200 \
   python tools/flash_tpu_bench.py --tune
+# data-derived flash tile default: a green tune capture rewrites
+# utils/tuned.py FLASH_TILES (provenance-stamped)
+if _green "BENCH_flashtune_$ROUND.json" 2>/dev/null; then
+  python tools/flash_tpu_bench.py --tune --apply \
+    "BENCH_flashtune_$ROUND.json" \
+    && log "flash tiles applied from BENCH_flashtune_$ROUND.json"
+fi

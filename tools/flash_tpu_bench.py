@@ -92,14 +92,35 @@ def tune() -> int:
     best = min(timed, key=lambda r: r["ms"]) if timed else {}
     # headline value = default-tile ms / best ms (higher is better, like
     # every other artifact value — the capture loop's keep-best-score
-    # policy relies on that orientation)
+    # policy relies on that orientation).  A missing 128x128 baseline
+    # leaves default_ms null — --apply refuses such rows (a provenance
+    # stamp must not claim a baseline that was never measured).
     default_ms = next((r["ms"] for r in timed
                        if r["block_q"] == 128 and r["block_k"] == 128),
-                      best.get("ms", 0))
-    speedup = default_ms / best["ms"] if best else 0
+                      None)
+    speedup = (default_ms / best["ms"]) if (best and default_ms) else 0
+    # gradient-path validation at the winning tile: the tuned shape
+    # becomes the default for the custom_vjp path too, whose dq/dk/dv
+    # kernels have a much bigger VMEM footprint than the forward — a
+    # tile that only the forward can allocate must not ship
+    grad_ok = False
+    if best:
+        try:
+            def loss(q, k, v):
+                return jnp.sum(flash_attention(
+                    q, k, v, causal=True, block_q=best["block_q"],
+                    block_k=best["block_k"], interpret=False) ** 2)
+
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+            jax.block_until_ready(g)
+            grad_ok = all(bool(jnp.all(jnp.isfinite(
+                x.astype(jnp.float32)))) for x in g)
+        except Exception as exc:
+            best = dict(best, grad_error=repr(exc)[:200])
     print(json.dumps({"metric": "flash_tile_tune",
                       "unit": "x_vs_128x128_tile",
                       "value": round(speedup, 4), "best": best,
+                      "grad_ok": grad_ok,
                       "default_ms": default_ms,
                       "rows": rows, "device": str(dev)}), flush=True)
     return 0 if timed else 1
@@ -242,5 +263,52 @@ def main() -> int:
     return 0 if ok else 1
 
 
+def apply_tiles_from_artifact(path: str, tuned_path: str = None) -> int:
+    """--tune --apply <artifact.json>: rewrite utils/tuned.py's
+    FLASH_TILES from a green tile-tune capture, provenance-stamped.
+    Requires the row to carry (a) a measured 128x128 baseline — the
+    provenance must never claim a comparison that didn't run — and
+    (b) grad_ok: the tuned tile becomes the custom_vjp default too, so
+    the backward kernels must have allocated at that shape on the real
+    chip.  Exit 1 otherwise."""
+    from _tuned_apply import load_last_row, rewrite_tuned
+
+    row = load_last_row(
+        path, "flash_tile_tune",
+        pred=lambda r: (r.get("best", {}).get("ms")
+                        and r.get("default_ms")
+                        and r.get("grad_ok")))
+    if row is None:
+        print(f"apply: no tile-tune row with a 128x128 baseline AND a "
+              f"passing gradient check in {path}", file=sys.stderr)
+        return 1
+    best = row["best"]
+    bq, bk = int(best["block_q"]), int(best["block_k"])
+    provenance = (
+        f"measured: {os.path.basename(path)} — best {bq}x{bk} at "
+        f"{best['ms']} ms vs 128x128 at {row['default_ms']} ms "
+        f"(T=8192 causal, {row.get('device', '?')}); backward kernels "
+        "validated at this tile (grad_ok); applied by flash_tpu_bench "
+        "--tune --apply")
+    if not rewrite_tuned(r"FLASH_TILES = \(\d+, \d+\)",
+                         f"FLASH_TILES = ({bq}, {bk})",
+                         "FLASH_TILES_PROVENANCE", provenance,
+                         tuned_path):
+        return 1
+    print(json.dumps({"applied": [bq, bk], "provenance": provenance}),
+          flush=True)
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(tune() if "--tune" in sys.argv[1:] else main())
+    argv = sys.argv[1:]
+    if "--tune" in argv and "--apply" in argv:
+        idx = argv.index("--apply")
+        if idx + 1 >= len(argv):
+            # no silent fallback to a (possibly stale prior-round)
+            # artifact: the operand is the audit trail
+            print("usage: flash_tpu_bench.py --tune --apply "
+                  "<BENCH_flashtune_r0N.json>", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(apply_tiles_from_artifact(argv[idx + 1]))
+    sys.exit(tune() if "--tune" in argv else main())

@@ -124,32 +124,23 @@ def main() -> int:
 
 def apply_from_artifact(path: str, tuned_path: str = None) -> int:
     """--apply <artifact.json>: rewrite utils/tuned.py's quant-auto
-    default from a GREEN 3-mode capture, stamping provenance (file,
+    default from a COMPLETED 3-mode capture, stamping provenance (file,
     per-mode fps, window link) so the shipped default is auditable.
+
+    Gates on completion, not on global ok: ok=False means some mode
+    disagreed with the f32 oracle — exactly when the recommendation
+    (drawn only from AGREEING modes, f32 always in) matters most.
     No-op (exit 1) when the artifact is missing/red or lacks the
     recommendation."""
-    import io
-    import re
+    from _tuned_apply import load_last_row, rewrite_tuned
 
-    try:
-        rows = [json.loads(ln) for ln in io.open(path)
-                if ln.strip().startswith("{")]
-    except (OSError, ValueError):
-        print(f"apply: cannot read {path}", file=sys.stderr)
-        return 1
-    # gate on a COMPLETED measurement, not on global ok: ok=False means
-    # some mode disagreed with the f32 oracle — exactly when the
-    # recommendation (drawn only from AGREEING modes, f32 always in)
-    # matters most.  A crashed run has no recommended_default.
-    greens = [r for r in rows
-              if r.get("metric") == "tflite_quant_native_tpu"
-              and r.get("recommended_default")
-              and r.get("batched_fps_f32", 0) > 0
-              and "error" not in r]
-    if not greens:
+    row = load_last_row(
+        path, "tflite_quant_native_tpu",
+        pred=lambda r: (r.get("recommended_default")
+                        and r.get("batched_fps_f32", 0) > 0))
+    if row is None:
         print(f"apply: no completed 3-mode row in {path}", file=sys.stderr)
         return 1
-    row = greens[-1]
     mode = row["recommended_default"]
     if mode not in ("float32", "int8", "w8"):
         print(f"apply: bad mode {mode!r}", file=sys.stderr)
@@ -161,30 +152,11 @@ def apply_from_artifact(path: str, tuned_path: str = None) -> int:
         f"w8={row.get('batched_fps_w8')} (batch {row.get('batch')}, "
         f"{row.get('device', '?')}); modes agreeing with the f32 "
         f"oracle only; applied by tflite_int8_tpu_bench --apply")
-    if tuned_path is None:
-        tuned_path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "nnstreamer_tpu", "utils", "tuned.py")
-    src = io.open(tuned_path).read()
-    src, n_mode = re.subn(r'QUANT_AUTO_TPU = "[a-z0-9]+"',
-                          lambda _m: f'QUANT_AUTO_TPU = "{mode}"',
-                          src, count=1)
-    if not n_mode:
-        print("apply: QUANT_AUTO_TPU line not found in tuned.py",
-              file=sys.stderr)
+    if not rewrite_tuned(r'QUANT_AUTO_TPU = "[a-z0-9]+"',
+                         f'QUANT_AUTO_TPU = "{mode}"',
+                         "QUANT_AUTO_PROVENANCE", provenance,
+                         tuned_path):
         return 1
-    new_prov = ("QUANT_AUTO_PROVENANCE = (\n    "
-                + json.dumps(provenance) + "\n)")
-    # matches both the hand-written block ('")' on the last string line)
-    # and a previously-applied one (')' on its own line)
-    src, n = re.subn(
-        r'QUANT_AUTO_PROVENANCE = \((?:\n    "[^"]*")+\n?\)',
-        lambda _m: new_prov, src, count=1)
-    if not n:
-        print("apply: provenance block not found in tuned.py",
-              file=sys.stderr)
-        return 1
-    io.open(tuned_path, "w").write(src)
     print(json.dumps({"applied": mode, "provenance": provenance}),
           flush=True)
     return 0
@@ -193,7 +165,11 @@ def apply_from_artifact(path: str, tuned_path: str = None) -> int:
 if __name__ == "__main__":
     if "--apply" in sys.argv[1:]:
         idx = sys.argv.index("--apply")
-        target = (sys.argv[idx + 1] if idx + 1 < len(sys.argv)
-                  else "BENCH_int8_r05.json")
-        sys.exit(apply_from_artifact(target))
+        if idx + 1 >= len(sys.argv):
+            # no silent fallback to a (possibly stale prior-round)
+            # artifact: the operand is the audit trail
+            print("usage: tflite_int8_tpu_bench.py --apply "
+                  "<BENCH_int8_r0N.json>", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(apply_from_artifact(sys.argv[idx + 1]))
     sys.exit(main())
