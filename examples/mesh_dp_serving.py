@@ -57,7 +57,9 @@ def main() -> int:
     import jax
 
     n_dev = len(jax.devices())
-    dp = min(4, n_dev)
+    # largest dp <= 4 that divides BATCH (the element requires an even
+    # split; a 3-device host clamps to dp=2)
+    dp = next((d for d in (4, 2) if d <= n_dev and BATCH % d == 0), 1)
     if dp < 2:
         print(f"need >=2 devices for a dp mesh, have {n_dev} — "
               "set XLA_FLAGS=--xla_force_host_platform_device_count=4")

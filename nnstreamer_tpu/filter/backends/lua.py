@@ -82,10 +82,16 @@ class LuaFilter(FilterFramework):
 
     def open(self, props: FilterProperties) -> None:
         path = str(props.model)
-        if not os.path.isfile(path):
+        if os.path.isfile(path):
+            with open(path) as f:
+                source = f.read()
+        elif "\n" in path:
+            # inline script-as-model: the reference's lua filter accepts
+            # the script TEXT in the model property (its own unit tests
+            # drive it that way, unittest_filter_lua.cc:36-65)
+            source = path
+        else:
             raise FilterError(f"lua: script not found: {path}")
-        with open(path) as f:
-            source = f.read()
         try:
             state = LuaState(source)
         except FilterError:

@@ -360,7 +360,12 @@ class TestTunedTileDefaults:
             "metric": "flash_tile_tune", "value": 1.0,
             "best": {"block_q": 512, "block_k": 512, "ms": 4.0},
             "grad_ok": True, "default_ms": None}) + "\n")
-        assert tool.apply_tiles_from_artifact(str(a1)) == 1
+        safe = tmp_path / "tuned_copy.py"
+        safe.write_text(open(os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "nnstreamer_tpu",
+            "utils", "tuned.py")).read())
+        assert tool.apply_tiles_from_artifact(
+            str(a1), tuned_path=str(safe)) == 1
         # gradient check failed/absent: the tile must not become the
         # custom_vjp default
         a2 = tmp_path / "nograd.json"
@@ -368,4 +373,9 @@ class TestTunedTileDefaults:
             "metric": "flash_tile_tune", "value": 1.2,
             "best": {"block_q": 1024, "block_k": 1024, "ms": 3.0},
             "grad_ok": False, "default_ms": 3.6}) + "\n")
-        assert tool.apply_tiles_from_artifact(str(a2)) == 1
+        assert tool.apply_tiles_from_artifact(
+            str(a2), tuned_path=str(safe)) == 1
+        # the refusals really were refusals: record untouched
+        assert safe.read_text() == open(os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "nnstreamer_tpu",
+            "utils", "tuned.py")).read()

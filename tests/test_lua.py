@@ -863,3 +863,64 @@ class TestPatternEdges:
     def test_find_init_past_end_clamps(self):
         st = LuaState('s, e = string.find("abc", "x*", 10)')
         assert st.get("s") == 4 and st.get("e") == 3   # Lua 5.1 clamp
+
+
+class TestInlineScriptModel:
+    """Script-as-model-string (the reference's own lua unit tests drive
+    the filter with inline scripts, unittest_filter_lua.cc:36-65): the
+    EXACT multi-in/multi-out script from the reference runs here."""
+
+    REF_SCRIPT = """
+inputTensorsInfo = {
+  num = 2,
+  dim = {{3, 100, 100, 1}, {3, 24, 24, 1},},
+  type = {'uint8', 'uint8',}
+}
+
+outputTensorsInfo = {
+  num = 2,
+  dim = {{3, 100, 100, 1}, {2, 1, 1, 1},},
+  type = {'uint8', 'float32',}
+}
+
+function nnstreamer_invoke()
+  input = input_tensor(1) --[[ get the first input tensor --]]
+  output = output_tensor(1) --[[ get the first output tensor --]]
+
+  for i=1,3*100*100*1 do
+    output[i] = input[i]
+  end
+
+  input = input_tensor(2) --[[ get the second input tensor --]]
+  output = output_tensor(2) --[[ get the second output tensor --]]
+
+  for i=1,2 do
+    output[i] = i * 11
+  end
+
+end
+"""
+
+    def test_reference_inline_multi_tensor_script(self):
+        fw = open_backend(FilterProperties(framework="lua",
+                                           model=self.REF_SCRIPT))
+        try:
+            in_info, out_info = fw.get_model_info()
+            assert in_info.num_tensors == 2
+            assert out_info[1].dims == (2, 1, 1, 1)
+            rng = np.random.default_rng(3)
+            x1 = rng.integers(0, 255, in_info[0].np_shape, dtype=np.uint8)
+            x2 = rng.integers(0, 255, in_info[1].np_shape, dtype=np.uint8)
+            o1, o2 = fw.invoke([x1, x2])
+            np.testing.assert_array_equal(np.asarray(o1).reshape(-1),
+                                          x1.reshape(-1))
+            # the reference's check_output: output[i-1] == i * 11
+            np.testing.assert_allclose(np.asarray(o2).reshape(-1),
+                                       [11.0, 22.0])
+        finally:
+            fw.close()
+
+    def test_bogus_path_still_loud(self):
+        with pytest.raises(FilterError, match="not found"):
+            open_backend(FilterProperties(framework="lua",
+                                          model="no/such/script.lua"))

@@ -302,6 +302,12 @@ def apply_tiles_from_artifact(path: str, tuned_path: str = None) -> int:
 
 if __name__ == "__main__":
     argv = sys.argv[1:]
+    if "--apply" in argv and "--tune" not in argv:
+        print("usage: flash_tpu_bench.py --tune --apply "
+              "<BENCH_flashtune_r0N.json> (--apply applies TILE-TUNE "
+              "data; bare --apply would silently run the full proof)",
+              file=sys.stderr)
+        sys.exit(2)
     if "--tune" in argv and "--apply" in argv:
         idx = argv.index("--apply")
         if idx + 1 >= len(argv):
