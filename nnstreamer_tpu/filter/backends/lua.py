@@ -85,10 +85,12 @@ class LuaFilter(FilterFramework):
         if os.path.isfile(path):
             with open(path) as f:
                 source = f.read()
-        elif "\n" in path:
+        elif "\n" in path or (" " in path and "nnstreamer_invoke" in path):
             # inline script-as-model: the reference's lua filter accepts
             # the script TEXT in the model property (its own unit tests
-            # drive it that way, unittest_filter_lua.cc:36-65)
+            # drive it that way, unittest_filter_lua.cc:36-65).  A
+            # single-line script qualifies via the space+invoke check;
+            # a typo'd PATH (no whitespace) still reports 'not found'
             source = path
         else:
             raise FilterError(f"lua: script not found: {path}")

@@ -223,8 +223,15 @@ class _Lowerer:
         self._nq: Dict[int, Dict[str, Any]] = {}     # id(op) → meta
         self._nq_raw: Dict[int, np.ndarray] = {}     # tensor → int array
         self._wo: Dict[int, _TSpec] = {}             # packed-weight specs
+        #: tensors kept INT8-RESIDENT in env (shifted a-domain, int8):
+        #: activations flowing native-op → native-op never round-trip
+        #: through f32 — ¼ the HBM activation traffic and one round/clip
+        #: per link instead of two (the reference's integer kernels keep
+        #: activations int8 the same way)
+        self._qres: set = set()
         if quant_native:
             self._select_native_quant_ops()
+            self._select_resident_tensors()
         self._classify_consts()
 
     def _select_native_quant_ops(self) -> None:
@@ -249,7 +256,13 @@ class _Lowerer:
             if (w_raw is None or not spec_x.quantized
                     or not spec_w.quantized or not spec_o.quantized
                     or consumers.get(t_w, 0) > 1
-                    or w_raw.dtype not in (np.int8, np.uint8)):
+                    or w_raw.dtype not in (np.int8, np.uint8)
+                    # 8-bit activations only: the kernel's a-domain is
+                    # int8 — a 16x8-quantized model (int16 activations)
+                    # would wrap in the int8 cast
+                    or np.dtype(spec_x.np_dtype) not in (np.int8, np.uint8)
+                    or np.dtype(spec_o.np_dtype) not in (np.int8,
+                                                         np.uint8)):
                 continue
             zp_w = np.asarray(spec_w.zero_point).ravel()
             if zp_w.size > 1 and np.any(zp_w):
@@ -279,6 +292,49 @@ class _Lowerer:
                 "b0": int(zp_w[0]) - shift_w,
                 "s_w": np.asarray(spec_w.scale, np.float32).ravel(),
             }
+
+    def _select_resident_tensors(self) -> None:
+        """Mark activations that can stay int8 in env end-to-end.
+
+        A tensor is int8-resident when it is quantized per-tensor and
+        EVERY consumer is a native-quant op reading it as the activation
+        (input 0); the producer must be a native-quant op with no fused
+        float activation (quant graphs encode clamps in the tensor
+        range, so act==NONE is the norm), or the graph input itself.
+        Graph outputs may be resident too — the declared output dtype IS
+        the quantized encoding, so emission gets CHEAPER (int shift, no
+        float round)."""
+        g = self.g
+        consumers: Dict[int, list] = {}
+        for op2 in g.ops:
+            for pos, t in enumerate(op2.inputs):
+                if t >= 0:
+                    consumers.setdefault(t, []).append((op2, pos))
+
+        def _eligible(t: int) -> bool:
+            spec = g.tensors[t]
+            if (not spec.quantized or spec.scale is None
+                    or np.asarray(spec.scale).size != 1
+                    or np.dtype(spec.np_dtype) not in (np.int8,
+                                                       np.uint8)):
+                return False
+            return all(id(op2) in self._nq and pos == 0
+                       for op2, pos in consumers.get(t, []))
+
+        act_field = {"fc": 0, "conv": 3, "dw": 4}
+        for op in g.ops:
+            meta = self._nq.get(id(op))
+            if meta is None:
+                continue
+            opts = op.options
+            act = (opts.scalar(act_field[meta["kind"]], "int32", 0)
+                   if opts else 0)
+            t_o = op.outputs[0]
+            if act == 0 and _eligible(t_o):
+                self._qres.add(t_o)
+        for t in g.inputs:
+            if _eligible(t):
+                self._qres.add(t)
 
     def _classify_consts(self) -> None:
         g = self.g
@@ -333,6 +389,12 @@ class _Lowerer:
         for i, t in enumerate(g.inputs):
             spec = g.tensors[t]
             x = jnp.asarray(inputs[i]).reshape(spec.shape)
+            if t in self._qres:
+                # int8-resident entry: the quantized feed IS the
+                # encoding — shift to the a-domain, no float math
+                shift = 128 if spec.np_dtype == np.uint8 else 0
+                env[t] = (x.astype(jnp.int32) - shift).astype(jnp.int8)
+                continue
             if spec.quantized:
                 x = ((x.astype(jnp.float32) - float(spec.zero_point[0]))
                      * float(spec.scale[0]))
@@ -348,7 +410,11 @@ class _Lowerer:
         for t in g.outputs:
             spec = g.tensors[t]
             y = env[t]
-            if spec.quantized:
+            if t in self._qres:
+                # already the quantized encoding (a-domain): un-shift
+                shift = 128 if spec.np_dtype == np.uint8 else 0
+                y = (y.astype(jnp.int32) + shift).astype(spec.np_dtype)
+            elif spec.quantized:
                 info = jnp.iinfo(spec.np_dtype)
                 # requantize in f32 regardless of compute dtype: bf16's
                 # 8-bit mantissa would cost quantization steps here
@@ -413,9 +479,13 @@ class _Lowerer:
         zp_x = int(spec_x.zero_point[0])
         qi = np.iinfo(spec_x.np_dtype)
         shift_x = 128 if spec_x.np_dtype == np.uint8 else 0
-        xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x) + zp_x,
-                      qi.min, qi.max)
-        a = (xq - shift_x).astype(jnp.int8)
+        if op.inputs[0] in self._qres:
+            a = x                        # already int8 a-domain: exact,
+            #                              zero float ops on the way in
+        else:
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x) + zp_x,
+                          qi.min, qi.max)
+            a = (xq - shift_x).astype(jnp.int8)
         a0 = zp_x - shift_x
         b0 = meta["b0"]
         kind = meta["kind"]
@@ -475,6 +545,24 @@ class _Lowerer:
         acc = acc - b0 * winsum - a0 * colsum + a0 * b0 * meta["k_acc"]
         if bias is not None:
             acc = acc + bias                    # scale s_x·s_w, zp 0
+        t_o = op.outputs[0]
+        if t_o in self._qres:
+            # requantize STRAIGHT to the consumer's int8 a-domain: one
+            # round/clip per link (vs dequant→float→requant), and the
+            # activation that lands in HBM is int8, not f32.  Numerics:
+            # round(acc·(s_x·s_w/s_o)) vs round((acc·s_x·s_w)/s_o) —
+            # identical modulo f32 associativity (within the quant-step
+            # agreement tolerance the suite pins).  act==0 guaranteed by
+            # _select_resident_tensors; saturation = the range clip.
+            spec_o = g.tensors[t_o]
+            s_o = float(spec_o.scale[0])
+            zp_o = int(spec_o.zero_point[0])
+            shift_o = 128 if spec_o.np_dtype == np.uint8 else 0
+            qo = np.iinfo(spec_o.np_dtype)
+            mult = jnp.asarray(s_x * meta["s_w"] / s_o, jnp.float32)
+            y = jnp.round(acc.astype(jnp.float32) * mult) + (zp_o - shift_o)
+            y = jnp.clip(y, qo.min - shift_o, qo.max - shift_o)
+            return [y.astype(jnp.int8)]
         y = acc.astype(jnp.float32) * jnp.asarray(
             s_x * meta["s_w"], jnp.float32)
         return [_act(y, act)]

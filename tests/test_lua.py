@@ -924,3 +924,18 @@ end
         with pytest.raises(FilterError, match="not found"):
             open_backend(FilterProperties(framework="lua",
                                           model="no/such/script.lua"))
+
+    def test_single_line_inline_script(self):
+        script = ("inputTensorsInfo = {num=1, dim={{4,1,1,1},}, "
+                  "type={'uint8',}} outputTensorsInfo = {num=1, "
+                  "dim={{4,1,1,1},}, type={'uint8',}} "
+                  "function nnstreamer_invoke() output = output_tensor(1) "
+                  "input = input_tensor(1) for i=1,4 do output[i] = "
+                  "input[i] end end")
+        fw = open_backend(FilterProperties(framework="lua", model=script))
+        try:
+            out, = fw.invoke([np.arange(4, dtype=np.uint8)])
+            np.testing.assert_array_equal(np.asarray(out).reshape(-1),
+                                          [0, 1, 2, 3])
+        finally:
+            fw.close()

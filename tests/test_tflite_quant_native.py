@@ -340,3 +340,119 @@ class TestDataDerivedQuantDefault:
 
         with pytest.raises(FilterError, match="tuned"):
             self._mode(monkeypatch, "bfloat16")
+
+
+class TestInt8ResidentActivations:
+    """Activations between native-quant ops stay INT8 in the executable
+    (1/4 the HBM activation traffic, one round/clip per link) — the
+    reference's integer kernels keep activations int8 the same way; the
+    f32-emulation oracle pins the numerics."""
+
+    def _chain_graph(self, rng):
+        w1 = rng.integers(0, 256, (4, 3, 3, 3), dtype=np.uint8)
+        w2 = rng.integers(0, 256, (1, 3, 3, 4), dtype=np.uint8)
+        g = _Graph(
+            tensors=[
+                _qspec((1, 6, 6, 3), np.uint8, 0, [0.05], [128]),
+                _qspec((4, 3, 3, 3), np.uint8, 1, [0.02], [128]),
+                _qspec((1, 6, 6, 4), np.uint8, 0, [0.1], [128]),
+                _qspec((1, 3, 3, 4), np.uint8, 2, [0.03], [120], qdim=3),
+                _qspec((1, 6, 6, 4), np.uint8, 0, [0.2], [128]),
+            ],
+            inputs=[0], outputs=[4],
+            ops=[
+                _Op(code=3, custom_code=None, inputs=[0, 1, -1],
+                    outputs=[2],
+                    options=_opts({1: ("int32", 1), 2: ("int32", 1)})),
+                _Op(code=4, custom_code=None, inputs=[2, 3, -1],
+                    outputs=[4],
+                    options=_opts({1: ("int32", 1), 2: ("int32", 1)})),
+            ],
+            buffers=[b"", w1.tobytes(), w2.tobytes()])
+        return g
+
+    def test_chain_is_fully_resident(self):
+        g = self._chain_graph(np.random.default_rng(4))
+        lo = _Lowerer(g, quant_native=True)
+        # input, intermediate, and output all stay int8 in env
+        assert lo._qres == {0, 2, 4}
+
+    def test_resident_output_dtype_and_agreement(self):
+        rng = np.random.default_rng(4)
+        g = self._chain_graph(rng)
+        x = rng.integers(0, 256, (1, 6, 6, 3), dtype=np.uint8)
+        lo = _Lowerer(g, quant_native=True)
+        out = np.asarray(lo.forward(lo.params, x)[0])
+        assert out.dtype == np.uint8            # declared encoding
+        emul = _run(g, False, x)
+        assert np.abs(out.astype(np.int32) - emul).max() <= 3
+
+    def test_float_consumer_breaks_residency(self):
+        """conv whose output ALSO feeds a generic (float) handler must
+        keep the float intermediate — and still agree."""
+        rng = np.random.default_rng(5)
+        w1 = rng.integers(0, 256, (4, 3, 3, 3), dtype=np.uint8)
+        shape = np.asarray([1, 36, 4], np.int32)
+        g = _Graph(
+            tensors=[
+                _qspec((1, 6, 6, 3), np.uint8, 0, [0.05], [128]),
+                _qspec((4, 3, 3, 3), np.uint8, 1, [0.02], [128]),
+                _qspec((1, 6, 6, 4), np.uint8, 0, [0.1], [128]),
+                _qspec((1, 36, 4), np.uint8, 0, [0.1], [128]),
+                _TSpec(shape=(3,), np_dtype=np.int32, buffer=2, name=""),
+            ],
+            inputs=[0], outputs=[3],
+            ops=[
+                _Op(code=3, custom_code=None, inputs=[0, 1, -1],
+                    outputs=[2],
+                    options=_opts({1: ("int32", 1), 2: ("int32", 1)})),
+                # RESHAPE (22): generic float handler consumer
+                _Op(code=22, custom_code=None, inputs=[2, 4], outputs=[3],
+                    options=None),
+            ],
+            buffers=[b"", w1.tobytes(), shape.tobytes()])
+        lo = _Lowerer(g, quant_native=True)
+        assert 2 not in lo._qres and 0 in lo._qres
+        x = rng.integers(0, 256, (1, 6, 6, 3), dtype=np.uint8)
+        _agree(g, x, tol=3)
+
+    def test_fused_activation_keeps_float_path(self):
+        """A native op WITH a fused activation keeps the float exit (the
+        quant-domain clamp is not the same function for e.g. tanh)."""
+        rng = np.random.default_rng(6)
+        w1 = rng.integers(0, 256, (4, 3, 3, 3), dtype=np.uint8)
+        g = _Graph(
+            tensors=[
+                _qspec((1, 6, 6, 3), np.uint8, 0, [0.05], [128]),
+                _qspec((4, 3, 3, 3), np.uint8, 1, [0.02], [128]),
+                _qspec((1, 6, 6, 4), np.uint8, 0, [0.1], [0]),
+            ],
+            inputs=[0], outputs=[2],
+            ops=[_Op(code=3, custom_code=None, inputs=[0, 1, -1],
+                     outputs=[2],
+                     options=_opts({1: ("int32", 1), 2: ("int32", 1),
+                                    3: ("int32", 1)}))],   # RELU
+            buffers=[b"", w1.tobytes()])
+        lo = _Lowerer(g, quant_native=True)
+        assert 2 not in lo._qres
+        x = rng.integers(0, 256, (1, 6, 6, 3), dtype=np.uint8)
+        _agree(g, x, tol=3)
+
+    def test_int16_activations_never_go_native(self):
+        """16x8 quantization (int16 activations): the int8 a-domain would
+        wrap, so such ops must stay on the emulation path entirely."""
+        rng = np.random.default_rng(7)
+        w1 = rng.integers(-128, 128, (4, 3, 3, 3)).astype(np.int8)
+        g = _Graph(
+            tensors=[
+                _qspec((1, 6, 6, 3), np.int16, 0, [0.001], [0]),
+                _qspec((4, 3, 3, 3), np.int8, 1, [0.02], [0]),
+                _qspec((1, 6, 6, 4), np.int16, 0, [0.002], [0]),
+            ],
+            inputs=[0], outputs=[2],
+            ops=[_Op(code=3, custom_code=None, inputs=[0, 1, -1],
+                     outputs=[2],
+                     options=_opts({1: ("int32", 1), 2: ("int32", 1)}))],
+            buffers=[b"", w1.tobytes()])
+        lo = _Lowerer(g, quant_native=True)
+        assert not lo._nq and not lo._qres
