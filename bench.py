@@ -77,6 +77,18 @@ CONFIG_METRICS = {
 CONFIG_SIZE = {"mobilenet": 224, "resident": 224, "ssd": 300,
                "deeplab": 257, "posenet": 257, "edge": 224, "vit": 224}
 
+#: configs whose pipeline honors NNS_TPU_BENCH_NO_PUSHDOWN (the
+#: _model_pipeline decoder toggle) — only these may carry the
+#: _host_decode metric suffix; edge/lm pipelines ignore the env var
+PUSHDOWN_CONFIGS = frozenset(
+    {"mobilenet", "resident", "ssd", "deeplab", "posenet", "vit"})
+
+
+def _pd_suffix(config: str) -> str:
+    return ("_host_decode"
+            if (os.environ.get("NNS_TPU_BENCH_NO_PUSHDOWN")
+                and config in PUSHDOWN_CONFIGS) else "")
+
 
 class _ExtrasTimeout(BaseException):
     """Raised by SIGALRM inside the optional-extras block.  Derives from
@@ -170,7 +182,10 @@ def _model_pipeline(model: str, size: int, decoder: str, dtype_prop: str,
         # queue = thread boundary: decoding a pushed batch overlaps the
         # dispatch + async d2h of the next batch (double-buffered filter)
         f"queue max-size-buffers={max(8, 2 * STREAM_BATCH)} ! "
-        f"tensor_decoder mode={decoder} {decoder_opts} ! "
+        f"tensor_decoder mode={decoder} {decoder_opts}"
+        # NNS_TPU_BENCH_NO_PUSHDOWN=1: host decode path, so the capture
+        # loop can measure the device-fused decode tail's fps DELTA
+        f"{' pushdown=false' if os.environ.get('NNS_TPU_BENCH_NO_PUSHDOWN') else ''} ! "
         "tensor_sink name=out")
 
 
@@ -670,6 +685,9 @@ def run_child(config: str) -> dict:
     device = jax.devices()[0]
     on_tpu = device.platform != "cpu"
     dtype_prop = "" if on_tpu else ",dtype:float32"
+    # metric hygiene: the host-decode (pushdown-off) delta variant names
+    # itself — a row must never describe a configuration that wasn't run
+    pd_suffix = _pd_suffix(config)
     global N_FRAMES, STREAM_BATCH
     if on_tpu and "NNS_TPU_BENCH_BATCH" not in os.environ:
         # dispatch RTT dominates streaming on a tunneled chip: a larger
@@ -697,7 +715,7 @@ def run_child(config: str) -> dict:
               flush=True)
 
     if config == "mobilenet":
-        result = bench_model(CONFIG_METRICS[config], "mobilenet_v2", 224,
+        result = bench_model(CONFIG_METRICS[config] + pd_suffix, "mobilenet_v2", 224,
                              "image_labeling", dtype_prop, emit=emit)
     elif config == "resident":
         # device-resident streaming: frames are staged to HBM once by the
@@ -705,7 +723,7 @@ def run_child(config: str) -> dict:
         # this measures the pipeline machinery + dispatch + device compute
         # (what the flagship config would do on LOCAL hardware, where the
         # PCIe link doesn't gate it)
-        result = bench_model(CONFIG_METRICS[config], "mobilenet_v2", 224,
+        result = bench_model(CONFIG_METRICS[config] + pd_suffix, "mobilenet_v2", 224,
                              "image_labeling", dtype_prop, emit=emit,
                              src_cache="device-cache")
     elif config == "ssd":
@@ -715,16 +733,16 @@ def run_child(config: str) -> dict:
             "ssd_mobilenet_v2", {"seed": "0"}).out_info[0].np_shape[0]
         priors = _ssd_priors_file(n_anchors)
         result = bench_model(
-            CONFIG_METRICS[config], "ssd_mobilenet_v2", 300,
+            CONFIG_METRICS[config] + pd_suffix, "ssd_mobilenet_v2", 300,
             "bounding_boxes", dtype_prop,
             f"option1=mobilenet-ssd option3={priors} "
             "option4=300:300 option5=300:300", emit=emit)
     elif config == "deeplab":
-        result = bench_model(CONFIG_METRICS[config], "deeplab_v3", 257,
+        result = bench_model(CONFIG_METRICS[config] + pd_suffix, "deeplab_v3", 257,
                              "image_segment", dtype_prop, emit=emit)
     elif config == "posenet":
         result = bench_model(
-            CONFIG_METRICS[config], "posenet", 257, "pose_estimation",
+            CONFIG_METRICS[config] + pd_suffix, "posenet", 257, "pose_estimation",
             dtype_prop, "option1=257:257 option2=257:257", emit=emit)
     elif config == "vit":
         # attention-family vision config: ViT-S/16 whose encoder runs the
@@ -735,7 +753,7 @@ def run_child(config: str) -> dict:
         # metric-name hygiene: a shrunk smoke must not carry the
         # full-size model's metric name (notes don't survive
         # spreadsheet copy-paste) — the CPU smoke renames itself
-        metric = (CONFIG_METRICS[config] if on_tpu
+        metric = (CONFIG_METRICS[config] + pd_suffix if on_tpu
                   else "vit_depth2_dim192_224_image_labeling_smoke_e2e_fps")
         result = bench_model(metric, "vit", 224,
                              "image_labeling", dtype_prop + props,
@@ -900,7 +918,8 @@ def _cached_green(metric: str) -> dict:
 def _failure_row(config: str, error: str, cpu: bool = False) -> dict:
     """Value-0 failure row sharing the success schema (single source for
     both the dead-tunnel gate and post-retries failures)."""
-    metric = CONFIG_METRICS[config] + ("_cpu" if cpu else "")
+    metric = (CONFIG_METRICS[config] + _pd_suffix(config)
+              + ("_cpu" if cpu else ""))
     unit, base = (("decode_tok_s", None) if config == "lm" else ("fps", 0))
     return {"metric": metric, "value": 0, "unit": unit,
             "vs_baseline": base, "error": error, "device": "unavailable"}

@@ -18,7 +18,12 @@ from ..tensor.caps_util import config_from_caps, tensors_template_caps
 class TensorDecoder(Element):
     FACTORY = "tensor_decoder"
     PROPERTIES = dict(
-        {"mode": (None, "decoder mode name")},
+        {"mode": (None, "decoder mode name"),
+         # net-new: the device-reduction pushdown (fusing the pure part
+         # of decode into the upstream executable) can be disabled to
+         # measure its delta or to force the host decode path
+         "pushdown": (True, "fuse pure decode reductions into the "
+                            "upstream filter executable")},
         **{f"option{i}": (None, f"decoder option {i}") for i in range(1, 10)})
 
     #: custom callbacks registered via register_decoder_custom (reference
@@ -56,7 +61,10 @@ class TensorDecoder(Element):
     def set_caps(self, pad, caps):
         self._config = config_from_caps(caps)
         if self._decoder is not None:
-            spec = self._decoder.device_reduce_spec(self._config)
+            from ..utils.conf import parse_bool
+
+            spec = (self._decoder.device_reduce_spec(self._config)
+                    if parse_bool(self.pushdown) else None)
             if spec is not None:
                 fn, reduced = spec
                 ev = CustomEvent("nns/device-reduce",

@@ -75,6 +75,25 @@ class TestPushdown:
         assert fcaps.get("types") == "int32"
         assert fcaps.get("dimensions") == "1"
 
+    def test_pushdown_false_property_keeps_host_decode(
+            self, tiny_classifier):
+        """tensor_decoder pushdown=false: the fusion must NOT engage
+        (filter src caps keep the raw model output), outputs identical —
+        the toggle behind the capture loop's decode-tail fps delta."""
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_cls name=f ! "
+            "tensor_decoder mode=image_labeling pushdown=false ! "
+            "tensor_sink name=out")
+        x = np.array([3.0, 0, 0, 0], np.float32)
+        got = _run(p, [x, x])
+        assert len(got) == 2
+        assert got[0].extra["index"] == 5        # same answer
+        fcaps = p.get("f").src_pad.caps.first()
+        assert fcaps.get("types") != "int32"     # raw float outputs
+
     def test_pushdown_through_queue(self, tiny_classifier):
         from nnstreamer_tpu import parse_launch
 

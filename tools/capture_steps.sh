@@ -18,6 +18,13 @@ capture all "BENCH_all_$ROUND.json" all 9000 \
   python bench.py --all --deadline 780
 capture sweep "BENCH_sweep_$ROUND.json" all 3600 \
   python bench.py --sweep-batch 32,64,128,256 --deadline 700
+# device-fused decode-tail DELTA (VERDICT r4 #1: the decode-on-device
+# claim needs an fps delta, not just oracle equality): same ssd/posenet
+# configs with the pushdown disabled — compare against the --all rows
+capture ssd_nopd "BENCH_ssd_nopushdown_$ROUND.json" last 900 \
+  env NNS_TPU_BENCH_NO_PUSHDOWN=1 python bench.py --config ssd --deadline 780
+capture posenet_nopd "BENCH_posenet_nopushdown_$ROUND.json" last 900 \
+  env NNS_TPU_BENCH_NO_PUSHDOWN=1 python bench.py --config posenet --deadline 780
 capture int8 "BENCH_int8_$ROUND.json" last 900 \
   python tools/tflite_int8_tpu_bench.py
 # data-derived quant default: a green 3-mode capture rewrites
