@@ -84,10 +84,17 @@ PUSHDOWN_CONFIGS = frozenset(
     {"mobilenet", "resident", "ssd", "deeplab", "posenet", "vit"})
 
 
+def _no_pushdown() -> bool:
+    """The ONE reading of NNS_TPU_BENCH_NO_PUSHDOWN (metric naming and
+    pipeline construction must never diverge)."""
+    from nnstreamer_tpu.utils.conf import parse_bool
+
+    return parse_bool(os.environ.get("NNS_TPU_BENCH_NO_PUSHDOWN", ""))
+
+
 def _pd_suffix(config: str) -> str:
     return ("_host_decode"
-            if (os.environ.get("NNS_TPU_BENCH_NO_PUSHDOWN")
-                and config in PUSHDOWN_CONFIGS) else "")
+            if _no_pushdown() and config in PUSHDOWN_CONFIGS else "")
 
 
 class _ExtrasTimeout(BaseException):
@@ -185,7 +192,7 @@ def _model_pipeline(model: str, size: int, decoder: str, dtype_prop: str,
         f"tensor_decoder mode={decoder} {decoder_opts}"
         # NNS_TPU_BENCH_NO_PUSHDOWN=1: host decode path, so the capture
         # loop can measure the device-fused decode tail's fps DELTA
-        f"{' pushdown=false' if os.environ.get('NNS_TPU_BENCH_NO_PUSHDOWN') else ''} ! "
+        f"{' pushdown=false' if _no_pushdown() else ''} ! "
         "tensor_sink name=out")
 
 
@@ -754,7 +761,8 @@ def run_child(config: str) -> dict:
         # full-size model's metric name (notes don't survive
         # spreadsheet copy-paste) — the CPU smoke renames itself
         metric = (CONFIG_METRICS[config] + pd_suffix if on_tpu
-                  else "vit_depth2_dim192_224_image_labeling_smoke_e2e_fps")
+                  else ("vit_depth2_dim192_224_image_labeling_smoke"
+                        "_e2e_fps" + pd_suffix))
         result = bench_model(metric, "vit", 224,
                              "image_labeling", dtype_prop + props,
                              emit=emit)

@@ -197,6 +197,10 @@ class _Lowerer:
 
     #: op codes eligible for native int8 execution (the MXU-heavy ones)
     _NQ_CODES = {3: "conv", 4: "dw", 9: "fc"}
+    #: elementwise ops that can run in the int8 a-domain purely to BRIDGE
+    #: residency (MobileNetV2's residual ADDs would otherwise break every
+    #: int8 chain back to f32 activations in HBM)
+    _NQ_ELTWISE = {0: "add"}
 
     def __init__(self, g: _Graph, compute_dtype: Any = None,
                  quant_native: bool = False,
@@ -245,6 +249,23 @@ class _Lowerer:
                 if t >= 0:
                     consumers[t] = consumers.get(t, 0) + 1
         for op in g.ops:
+            if op.code in self._NQ_ELTWISE and len(op.inputs) == 2:
+                t_a, t_b2 = op.inputs[0], op.inputs[1]
+                spec_a, spec_b = g.tensors[t_a], g.tensors[t_b2]
+                spec_o = g.tensors[op.outputs[0]]
+                act = (op.options.scalar(0, "int32", 0)
+                       if op.options else 0)
+                if (act == 0
+                        and all(s.quantized and s.scale is not None
+                                and np.asarray(s.scale).size == 1
+                                and np.dtype(s.np_dtype) in (np.int8,
+                                                             np.uint8)
+                                for s in (spec_a, spec_b, spec_o))
+                        and tuple(spec_a.shape) == tuple(spec_b.shape)
+                        and _const_array(g, t_a) is None
+                        and _const_array(g, t_b2) is None):
+                    self._nq[id(op)] = {"kind": "add"}
+                continue
             kind = self._NQ_CODES.get(op.code)
             if kind is None or len(op.inputs) < 2:
                 continue
@@ -311,6 +332,12 @@ class _Lowerer:
                 if t >= 0:
                     consumers.setdefault(t, []).append((op2, pos))
 
+        def _acts_pos(op2) -> tuple:
+            """Input positions that are ACTIVATIONS for a native op
+            (eltwise add reads two; matmul kinds read one)."""
+            return ((0, 1) if self._nq[id(op2)]["kind"] == "add"
+                    else (0,))
+
         def _eligible(t: int) -> bool:
             spec = g.tensors[t]
             if (not spec.quantized or spec.scale is None
@@ -318,10 +345,10 @@ class _Lowerer:
                     or np.dtype(spec.np_dtype) not in (np.int8,
                                                        np.uint8)):
                 return False
-            return all(id(op2) in self._nq and pos == 0
+            return all(id(op2) in self._nq and pos in _acts_pos(op2)
                        for op2, pos in consumers.get(t, []))
 
-        act_field = {"fc": 0, "conv": 3, "dw": 4}
+        act_field = {"fc": 0, "conv": 3, "dw": 4, "add": 0}
         for op in g.ops:
             meta = self._nq.get(id(op))
             if meta is None:
@@ -335,6 +362,16 @@ class _Lowerer:
         for t in g.inputs:
             if _eligible(t):
                 self._qres.add(t)
+        # an ADD that bridges no resident tensor buys nothing (it would
+        # just add a grid-rounding round-trip vs emulation): drop it.
+        # Safe post-_qres: by the prune condition none of its tensors is
+        # resident, so no eligibility decision referenced it positively.
+        for op in g.ops:
+            meta = self._nq.get(id(op))
+            if meta is not None and meta["kind"] == "add":
+                ts = (op.inputs[0], op.inputs[1], op.outputs[0])
+                if not any(t in self._qres for t in ts):
+                    del self._nq[id(op)]
 
     def _classify_consts(self) -> None:
         g = self.g
@@ -450,6 +487,59 @@ class _Lowerer:
             return self.static[idx]
         return env[idx]
 
+    def _a_domain(self, env, t: int):
+        """One activation input in the shifted int8 a-domain (resident
+        pass-through, or float→grid requantize)."""
+        import jax.numpy as jnp
+
+        x = self._val(env, t)
+        if t in self._qres:
+            return x
+        spec = self.g.tensors[t]
+        qi = np.iinfo(spec.np_dtype)
+        shift = 128 if spec.np_dtype == np.uint8 else 0
+        xq = jnp.clip(jnp.round(x.astype(jnp.float32)
+                                / float(spec.scale[0]))
+                      + int(spec.zero_point[0]), qi.min, qi.max)
+        return (xq - shift).astype(jnp.int8)
+
+    def _run_native_add(self, op: _Op, env: Dict[int, Any]) -> List[Any]:
+        """Quantized elementwise ADD in the a-domain: int8 in, int8 (or
+        float) out — exists to carry residency across MobileNetV2-style
+        residual connections (the adjacent convs do the MXU work).
+
+        With a_i the shifted int8 inputs and A0_i = zp_i − shift_i:
+          real = s1·(a1 − A0_1) + s2·(a2 − A0_2)
+        The float intermediates are fusion-local (VPU registers); only
+        int8 crosses HBM when the output is resident."""
+        import jax.numpy as jnp
+
+        g = self.g
+        s1_spec = g.tensors[op.inputs[0]]
+        s2_spec = g.tensors[op.inputs[1]]
+        a1 = self._a_domain(env, op.inputs[0])
+        a2 = self._a_domain(env, op.inputs[1])
+        s1 = float(s1_spec.scale[0])
+        s2 = float(s2_spec.scale[0])
+        a01 = (int(s1_spec.zero_point[0])
+               - (128 if s1_spec.np_dtype == np.uint8 else 0))
+        a02 = (int(s2_spec.zero_point[0])
+               - (128 if s2_spec.np_dtype == np.uint8 else 0))
+        f1 = a1.astype(jnp.float32)
+        f2 = a2.astype(jnp.float32)
+        t_o = op.outputs[0]
+        spec_o = g.tensors[t_o]
+        if t_o in self._qres:
+            s_o = float(spec_o.scale[0])
+            zp_o = int(spec_o.zero_point[0])
+            shift_o = 128 if spec_o.np_dtype == np.uint8 else 0
+            qo = np.iinfo(spec_o.np_dtype)
+            c = (-(s1 * a01 + s2 * a02) / s_o) + (zp_o - shift_o)
+            y = jnp.round((s1 / s_o) * f1 + (s2 / s_o) * f2 + c)
+            y = jnp.clip(y, qo.min - shift_o, qo.max - shift_o)
+            return [y.astype(jnp.int8)]
+        return [s1 * (f1 - a01) + s2 * (f2 - a02)]
+
     def _run_native_quant(self, op: _Op, env: Dict[int, Any]) -> List[Any]:
         """One quantized conv/dw/fc natively: requantize the float-domain
         activation to int8, run the matmul int8×int8→int32 (MXU-native —
@@ -470,22 +560,15 @@ class _Lowerer:
         g = self.g
         meta = self._nq[id(op)]
         spec_x = g.tensors[op.inputs[0]]
-        x = self._val(env, op.inputs[0])
         w8 = self._val(env, op.inputs[1])
         t_b = op.inputs[2] if len(op.inputs) > 2 else -1
         bias = self._val(env, t_b) if t_b >= 0 else None
         opts = op.options
         s_x = float(spec_x.scale[0])
         zp_x = int(spec_x.zero_point[0])
-        qi = np.iinfo(spec_x.np_dtype)
         shift_x = 128 if spec_x.np_dtype == np.uint8 else 0
-        if op.inputs[0] in self._qres:
-            a = x                        # already int8 a-domain: exact,
-            #                              zero float ops on the way in
-        else:
-            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x) + zp_x,
-                          qi.min, qi.max)
-            a = (xq - shift_x).astype(jnp.int8)
+        a = self._a_domain(env, op.inputs[0])   # resident pass-through
+        #                                         or float→grid requant
         a0 = zp_x - shift_x
         b0 = meta["b0"]
         kind = meta["kind"]
@@ -568,8 +651,11 @@ class _Lowerer:
         return [_act(y, act)]
 
     def _run_op(self, op: _Op, env: Dict[int, Any]) -> None:
-        if id(op) in self._nq:
-            for t, v in zip(op.outputs, self._run_native_quant(op, env)):
+        meta = self._nq.get(id(op))
+        if meta is not None:
+            runner = (self._run_native_add if meta["kind"] == "add"
+                      else self._run_native_quant)
+            for t, v in zip(op.outputs, runner(op, env)):
                 env[t] = self._clamp_to_qrange(t, v)
             return
         handler = _OP_HANDLERS.get(op.code)

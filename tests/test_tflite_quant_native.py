@@ -456,3 +456,93 @@ class TestInt8ResidentActivations:
             buffers=[b"", w1.tobytes()])
         lo = _Lowerer(g, quant_native=True)
         assert not lo._nq and not lo._qres
+
+    def _residual_graph(self, rng):
+        """conv → conv → ADD(residual) → conv: residency must bridge the
+        add (MobileNetV2's bottleneck shape)."""
+        w1 = rng.integers(0, 256, (4, 1, 1, 3), dtype=np.uint8)
+        w2 = rng.integers(0, 256, (4, 1, 1, 4), dtype=np.uint8)
+        w3 = rng.integers(0, 256, (2, 1, 1, 4), dtype=np.uint8)
+        g = _Graph(
+            tensors=[
+                _qspec((1, 4, 4, 3), np.uint8, 0, [0.05], [128]),   # in
+                _qspec((4, 1, 1, 3), np.uint8, 1, [0.02], [128]),
+                _qspec((1, 4, 4, 4), np.uint8, 0, [0.1], [128]),    # c1
+                _qspec((4, 1, 1, 4), np.uint8, 2, [0.03], [130]),
+                _qspec((1, 4, 4, 4), np.uint8, 0, [0.15], [126]),   # c2
+                _qspec((1, 4, 4, 4), np.uint8, 0, [0.2], [127]),    # add
+                _qspec((2, 1, 1, 4), np.uint8, 3, [0.04], [125]),
+                _qspec((1, 4, 4, 2), np.uint8, 0, [0.3], [128]),    # out
+            ],
+            inputs=[0], outputs=[7],
+            ops=[
+                _Op(code=3, custom_code=None, inputs=[0, 1, -1],
+                    outputs=[2],
+                    options=_opts({1: ("int32", 1), 2: ("int32", 1)})),
+                _Op(code=3, custom_code=None, inputs=[2, 3, -1],
+                    outputs=[4],
+                    options=_opts({1: ("int32", 1), 2: ("int32", 1)})),
+                _Op(code=0, custom_code=None, inputs=[2, 4],
+                    outputs=[5], options=_opts({})),
+                _Op(code=3, custom_code=None, inputs=[5, 6, -1],
+                    outputs=[7],
+                    options=_opts({1: ("int32", 1), 2: ("int32", 1)})),
+            ],
+            buffers=[b"", w1.tobytes(), w2.tobytes(), w3.tobytes()])
+        return g
+
+    def test_residual_add_bridges_residency(self):
+        rng = np.random.default_rng(8)
+        g = self._residual_graph(rng)
+        lo = _Lowerer(g, quant_native=True)
+        # the whole graph stays int8: input, both conv outs (2 is read
+        # by conv AND add pos0/1 — both native), add out, final out
+        assert lo._qres == {0, 2, 4, 5, 7}
+        assert any(m["kind"] == "add" for m in lo._nq.values())
+        x = rng.integers(0, 256, (1, 4, 4, 3), dtype=np.uint8)
+        # four resident links snap to four different uncalibrated grids
+        # (synthetic scales), so vs the float-through emulation the
+        # roundings compound ~1 step/link — the REFERENCE's integer
+        # runtime quantizes at every tensor identically.  The real
+        # calibrated model agrees within 3 steps over 60+ layers.
+        _agree(g, x, tol=6)
+
+    def test_add_with_fused_activation_stays_float(self):
+        rng = np.random.default_rng(9)
+        g = self._residual_graph(rng)
+        # give the ADD a fused RELU: it must not go native
+        g.ops[2] = _Op(code=0, custom_code=None, inputs=[2, 4],
+                       outputs=[5], options=_opts({0: ("int32", 1)}))
+        lo = _Lowerer(g, quant_native=True)
+        assert not any(m["kind"] == "add" for m in lo._nq.values())
+        x = rng.integers(0, 256, (1, 4, 4, 3), dtype=np.uint8)
+        _agree(g, x, tol=3)
+
+    def test_useless_add_is_pruned_from_native(self):
+        """An ADD bridging NOTHING resident (float producers AND a float
+        consumer) must not go native — it would only add grid
+        roundings."""
+        rng = np.random.default_rng(10)
+        shape = np.asarray([1, 16], np.int32)
+        shape2 = np.asarray([1, 4, 4], np.int32)
+        g = _Graph(
+            tensors=[
+                _qspec((1, 4, 4), np.uint8, 0, [0.05], [128]),
+                _qspec((1, 16), np.uint8, 0, [0.05], [128]),
+                _qspec((1, 16), np.uint8, 0, [0.07], [128]),
+                _TSpec(shape=(2,), np_dtype=np.int32, buffer=1, name=""),
+                _qspec((1, 4, 4), np.uint8, 0, [0.07], [128]),
+                _TSpec(shape=(3,), np_dtype=np.int32, buffer=2, name=""),
+            ],
+            inputs=[0], outputs=[4],
+            ops=[
+                _Op(code=22, custom_code=None, inputs=[0, 3],
+                    outputs=[1], options=None),        # float RESHAPE
+                _Op(code=0, custom_code=None, inputs=[1, 1],
+                    outputs=[2], options=_opts({})),
+                _Op(code=22, custom_code=None, inputs=[2, 5],
+                    outputs=[4], options=None),        # float consumer
+            ],
+            buffers=[b"", shape.tobytes(), shape2.tobytes()])
+        lo = _Lowerer(g, quant_native=True)
+        assert not lo._nq
