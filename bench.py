@@ -682,9 +682,29 @@ def _ssd_priors_file(n_anchors: int) -> str:
     return f.name
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache for every bench/capture child:
+    each child is a fresh process, and on the tunneled TPU a single
+    config re-pays 20-40 s of compiles per invocation — in a short
+    healthy window that's the difference between capturing four proofs
+    and capturing one.  Safe across code changes (keyed on HLO+flags);
+    shared by the capture tools."""
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # unknown config names on an older jax: no cache
+        pass
+
+
 def run_child(config: str) -> dict:
     import jax
 
+    _enable_compile_cache()
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # The tunneled-TPU sitecustomize can override the env var; the
         # config update is authoritative (same pattern as tests/conftest.py).

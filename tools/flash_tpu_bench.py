@@ -60,6 +60,9 @@ def tune() -> int:
     ranking the tile shapes — run in a healthy TPU window to pick kernel
     defaults (the 128x128 default matches the MXU but bigger K tiles cut
     grid-iteration overhead when VMEM allows)."""
+    from bench import _enable_compile_cache
+
+    _enable_compile_cache()
     import jax
     import jax.numpy as jnp
 
@@ -128,6 +131,10 @@ def tune() -> int:
 
 def main() -> int:
     import jax
+
+    from bench import _enable_compile_cache
+
+    _enable_compile_cache()
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # the tunneled-TPU sitecustomize overrides the env var; the config

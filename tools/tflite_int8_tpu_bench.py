@@ -52,6 +52,10 @@ def _bench(fw, x):
 def main() -> int:
     import jax
 
+    from bench import _enable_compile_cache
+
+    _enable_compile_cache()
+
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]

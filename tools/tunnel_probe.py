@@ -31,8 +31,14 @@ bench environment's device is remote.
 """
 
 import json
+import os
 import sys
 import time
+
+# the repo root (bench.py lives there): python puts the SCRIPT dir on
+# sys.path, not the cwd — without this, `import bench` works under
+# pytest but dies under `python tools/tunnel_probe.py`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _percentile(xs, q):
@@ -43,6 +49,10 @@ def _percentile(xs, q):
 
 def probe(reps_rtt: int = 30, sizes_mib=(1, 4, 16)) -> dict:
     import jax
+
+    from bench import _enable_compile_cache
+
+    _enable_compile_cache()
     import jax.numpy as jnp
     import numpy as np
 
