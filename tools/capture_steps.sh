@@ -42,3 +42,19 @@ if _green "BENCH_flashtune_$ROUND.json" 2>/dev/null; then
     "BENCH_flashtune_$ROUND.json" \
     && log "flash tiles applied from BENCH_flashtune_$ROUND.json"
 fi
+
+# commit artifacts (and any tuned.py the appliers rewrote) the moment a
+# window lands them — a capture must never sit uncommitted if the
+# session dies.  Pathspec-scoped commit: never sweeps up unrelated
+# staged/working-tree changes; failures (no changes yet, or a
+# concurrent index lock) are harmless — the next iteration retries.
+_paths=""
+for f in BENCH_*_"$ROUND".json "TUNNEL_$ROUND.json" \
+         nnstreamer_tpu/utils/tuned.py; do
+  [ -e "$f" ] && _paths="$_paths $f"
+done
+if [ -n "$_paths" ]; then
+  # shellcheck disable=SC2086
+  git commit -q -m "TPU capture artifacts (round-5 window)" -- $_paths \
+    2>/dev/null && log "committed r05 artifacts"
+fi
