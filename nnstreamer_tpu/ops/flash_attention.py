@@ -57,17 +57,16 @@ def flash_is_default() -> bool:
     return platform == "tpu"
 
 
-#: Sequence-length crossover for kernel-vs-naive selection.  Hardware
-#: timings (BENCH_flash_r04.json, one v5e chip) show naive XLA attention
-#: FASTER than this kernel at every captured length — 1.23x at T=2048,
-#: 1.05x at T=8192, trend converging — so full-attention callers below
-#: the crossover should let XLA fuse the naive path.  The kernel's
-#: upside is memory: naive materializes the (T, T) score matrix per head
+#: Fallback sequence-length crossover for kernel-vs-naive selection
+#: when the measured record (utils/tuned.py FLASH_MIN_T, rewritten by
+#: tools/flash_tpu_bench.py --apply-crossover from green proof
+#: captures) is unavailable.  The kernel's unconditional upside is
+#: memory: naive materializes the (T, T) score matrix per head
 #: (O(T^2) HBM — 2 GiB/head bf16 at 32k, OOM territory), the kernel
-#: streams it through VMEM at O(T*d).  Above the crossover the kernel is
-#: both the faster and the only-feasible choice.  Refreshed from the
-#: 16k/32k rows of tools/flash_tpu_bench.py when a capture window
-#: provides them; override with NNS_TPU_FLASH_MIN_T.
+#: streams it through VMEM at O(T*d).  Above the crossover the kernel
+#: is both the faster and the only-feasible choice; below it, which is
+#: faster is a per-chip measurement, not theory.  Override with
+#: NNS_TPU_FLASH_MIN_T.
 FLASH_MIN_T_DEFAULT = 16384
 
 
@@ -75,15 +74,18 @@ def flash_min_t() -> int:
     import os
 
     raw = os.environ.get("NNS_TPU_FLASH_MIN_T")
-    if not raw:
-        return FLASH_MIN_T_DEFAULT
-    try:
-        return int(raw)
-    except ValueError:
-        import warnings
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            import warnings
 
-        warnings.warn(f"NNS_TPU_FLASH_MIN_T={raw!r} is not an int; "
-                      f"using default {FLASH_MIN_T_DEFAULT}")
+            warnings.warn(f"NNS_TPU_FLASH_MIN_T={raw!r} is not an int; "
+                          f"ignoring the override")
+    try:
+        from ..utils.tuned import FLASH_MIN_T
+        return int(FLASH_MIN_T)
+    except Exception:
         return FLASH_MIN_T_DEFAULT
 
 

@@ -40,3 +40,20 @@ FLASH_TILES = (128, 128)
 FLASH_TILES_PROVENANCE = (
     "default (MXU-shaped 128x128); no healthy-window tile-tune capture "
     "applied yet (r5 loop runs flash_tpu_bench --tune each window)")
+
+#: Sequence-length threshold above which full-attention callers
+#: (``flash=None``) pick the Pallas flash kernel over naive XLA
+#: attention (ops/flash_attention.py flash_wins).  Measured by the
+#: timing rows of tools/flash_tpu_bench.py with SUFFIX-WIN semantics:
+#: the smallest measured T such that the kernel wins (speedup > 1, or
+#: naive fails to compile/OOMs) at that T *and every longer measured
+#: T* — a threshold gate must not route an interior losing length to
+#: the kernel just because some shorter length won.  Applied with
+#: ``flash_tpu_bench --apply-crossover <proof.json>``.
+FLASH_MIN_T = 16384
+
+FLASH_MIN_T_PROVENANCE = (
+    "r4 default: BENCH_flash_r04.json showed naive faster at every "
+    "captured length (0.81x@2k, 0.95x@8k), kernel kept only for the "
+    "O(T*d) memory regime; awaiting a healthy-window proof capture "
+    "(r5 loop applies the measured crossover automatically)")

@@ -179,14 +179,31 @@ class TestLengthGatedSelection:
 
     def test_below_crossover_prefers_naive_even_on_tpu(self, monkeypatch):
         from nnstreamer_tpu.ops import flash_attention as fa
+        from nnstreamer_tpu.utils import tuned
 
         monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
         monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+        # pin the measured record: the live tuned.py value moves with
+        # each applied capture, the GATE semantics must not
+        monkeypatch.setattr(tuned, "FLASH_MIN_T", 16384)
         assert not fa.flash_wins(197)      # vit
         assert not fa.flash_wins(2048)     # lm prefill
-        assert not fa.flash_wins(8192)     # measured 0.95x
+        assert not fa.flash_wins(8192)
         assert fa.flash_wins(16384)
         assert fa.flash_wins(32768)
+
+    def test_gate_follows_measured_tuned_record(self, monkeypatch):
+        """flash_min_t() consults utils/tuned.py FLASH_MIN_T (the
+        provenance-stamped record --apply-crossover rewrites), not a
+        hardcoded constant."""
+        from nnstreamer_tpu.ops import flash_attention as fa
+        from nnstreamer_tpu.utils import tuned
+
+        monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
+        monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+        monkeypatch.setattr(tuned, "FLASH_MIN_T", 2048)
+        assert fa.flash_wins(2048)
+        assert not fa.flash_wins(2047)
 
     def test_off_tpu_never_selects_kernel(self, monkeypatch):
         from nnstreamer_tpu.ops import flash_attention as fa
@@ -203,16 +220,19 @@ class TestLengthGatedSelection:
         monkeypatch.setenv("NNS_TPU_FLASH_MIN_T", "65536")
         assert not fa.flash_wins(32768)
 
-    def test_malformed_env_override_warns_and_uses_default(
+    def test_malformed_env_override_warns_and_falls_through(
             self, monkeypatch):
         import warnings
 
         from nnstreamer_tpu.ops import flash_attention as fa
+        from nnstreamer_tpu.utils import tuned
 
         monkeypatch.setenv("NNS_TPU_FLASH_MIN_T", "16k")
+        monkeypatch.setattr(tuned, "FLASH_MIN_T", 4096)
         with warnings.catch_warnings(record=True) as caught:
             warnings.simplefilter("always")
-            assert fa.flash_min_t() == fa.FLASH_MIN_T_DEFAULT
+            # malformed override is ignored; the measured record wins
+            assert fa.flash_min_t() == 4096
         assert any("NNS_TPU_FLASH_MIN_T" in str(w.message) for w in caught)
 
     def test_ulysses_training_path_keeps_kernel(self, monkeypatch):
@@ -379,3 +399,145 @@ class TestTunedTileDefaults:
         assert safe.read_text() == open(os.path.join(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))), "nnstreamer_tpu",
             "utils", "tuned.py")).read()
+
+
+class TestMeasuredCrossover:
+    """Suffix-win crossover semantics + the --apply-crossover path that
+    turns a green proof capture into the FLASH_MIN_T tuned record."""
+
+    def _tool(self):
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import flash_tpu_bench as tool
+        return tool
+
+    def test_suffix_win_skips_interior_loss(self):
+        # 2k wins but 16k loses: a threshold gate derived from "first
+        # win" would route 16k to the slower kernel — suffix-win
+        # reports the length where wins become unbroken (32k, naive
+        # genuinely out of memory there)
+        tool = self._tool()
+        timings = [
+            {"T": 2048, "speedup": 1.365},
+            {"T": 8192, "speedup": 1.011},
+            {"T": 16384, "speedup": 0.795},
+            {"T": 32768, "flash_only": True,
+             "naive_error": "RESOURCE_EXHAUSTED: ..."},
+        ]
+        assert tool.measured_crossover(timings) == 32768
+
+    def test_unbroken_wins_reach_back(self):
+        tool = self._tool()
+        timings = [
+            {"T": 2048, "speedup": 0.9},
+            {"T": 8192, "speedup": 1.1},
+            {"T": 16384, "speedup": 1.2},
+            {"T": 32768, "flash_only": True,
+             "naive_error": "out of memory allocating scores"},
+        ]
+        assert tool.measured_crossover(timings) == 8192
+
+    def test_kernel_error_breaks_suffix(self):
+        tool = self._tool()
+        timings = [
+            {"T": 8192, "speedup": 1.1},
+            {"T": 16384, "error": "Mosaic..."},
+            {"T": 32768, "flash_only": True,
+             "naive_error": "RESOURCE_EXHAUSTED"},
+        ]
+        assert tool.measured_crossover(timings) == 32768
+
+    def test_all_losses_is_none(self):
+        tool = self._tool()
+        assert tool.measured_crossover(
+            [{"T": 2048, "speedup": 0.8},
+             {"T": 8192, "speedup": 0.95}]) is None
+
+    def test_transient_naive_infra_error_is_not_a_win(self):
+        # the checked-in r5 artifact's 32k naive failure was an HTTP
+        # 500 from the remote-compile helper — a tunnel flake, not the
+        # O(T^2) capacity wall.  Such rows are evidence-free: they
+        # must neither extend the win suffix (here: 16k loses, so no
+        # crossover) nor break it.
+        tool = self._tool()
+        timings = [
+            {"T": 8192, "speedup": 1.011},
+            {"T": 16384, "speedup": 0.795},
+            {"T": 32768, "flash_only": True,
+             "naive_error": "JaxRuntimeError('INTERNAL: http://...: "
+                            "HTTP 500: tpu_compile_helper subprocess "
+                            "exit code 1')"},
+        ]
+        assert tool.measured_crossover(timings) is None
+        # ...and with the interior loss absent, the flake is skipped
+        # but the definite wins below still anchor the crossover
+        timings2 = [
+            {"T": 8192, "speedup": 1.011},
+            {"T": 16384, "speedup": 1.2},
+            {"T": 32768, "flash_only": True,
+             "naive_error": "HTTP 500: tpu_compile_helper"},
+        ]
+        assert tool.measured_crossover(timings2) == 8192
+
+    def _proof_row(self, **over):
+        row = {"metric": "flash_attention_tpu_proof", "value": 1.0,
+               "unit": "x_vs_naive", "ok": True, "crossover_T": 2048,
+               "timings": [{"T": 2048, "speedup": 1.365},
+                           {"T": 8192, "speedup": 1.011},
+                           {"T": 32768, "flash_only": True,
+                            "naive_error": "RESOURCE_EXHAUSTED"}],
+               "device": "TPU_0"}
+        row.update(over)
+        return row
+
+    def _tuned_copy(self, tmp_path):
+        import os
+
+        src = open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "nnstreamer_tpu", "utils",
+            "tuned.py")).read()
+        p = tmp_path / "tuned.py"
+        p.write_text(src)
+        return p
+
+    def test_apply_crossover_rewrites_min_t(self, tmp_path):
+        import json
+
+        tool = self._tool()
+        artifact = tmp_path / "proof.json"
+        artifact.write_text(json.dumps(self._proof_row()) + "\n")
+        tuned_copy = self._tuned_copy(tmp_path)
+        assert tool.apply_crossover_from_artifact(
+            str(artifact), tuned_path=str(tuned_copy)) == 0
+        new = tuned_copy.read_text()
+        assert "FLASH_MIN_T = 2048" in new
+        assert "proof.json" in new
+        compile(new, "tuned.py", "exec")
+        # idempotent re-apply (the loop re-runs it every iteration)
+        assert tool.apply_crossover_from_artifact(
+            str(artifact), tuned_path=str(tuned_copy)) == 0
+
+    def test_apply_crossover_refuses_not_ok_or_null(self, tmp_path):
+        import json
+
+        tool = self._tool()
+        tuned_copy = self._tuned_copy(tmp_path)
+        before = tuned_copy.read_text()
+        # a run whose kernel mis-computed must not set the default
+        a1 = tmp_path / "notok.json"
+        a1.write_text(json.dumps(self._proof_row(ok=False)) + "\n")
+        assert tool.apply_crossover_from_artifact(
+            str(a1), tuned_path=str(tuned_copy)) == 1
+        # kernel lost even at the longest length: fallback stands
+        # (crossover recomputed from timings, not the stored field)
+        a2 = tmp_path / "nullx.json"
+        a2.write_text(json.dumps(self._proof_row(
+            crossover_T=2048,
+            timings=[{"T": 2048, "speedup": 0.8},
+                     {"T": 8192, "speedup": 0.9}])) + "\n")
+        assert tool.apply_crossover_from_artifact(
+            str(a2), tuned_path=str(tuned_copy)) == 1
+        assert tuned_copy.read_text() == before

@@ -14,6 +14,23 @@ capture flagship "BENCH_flagship_best_$ROUND.json" last 900 \
   python bench.py --config mobilenet --deadline 800
 capture flash "BENCH_flash_$ROUND.json" last 1200 \
   python tools/flash_tpu_bench.py
+# a post-tune re-measure must install even when it scores lower than
+# the pre-tune artifact: it reflects the tiles that actually ship
+# (capture()'s keep-best policy would otherwise retain stale timings)
+if [ -f "$STAGE/flash.force_install" ] \
+    && _green "$STAGE/flash.out" 2>/dev/null; then
+  cp "$STAGE/flash.out" "BENCH_flash_$ROUND.json"
+  rm -f "$STAGE/flash.force_install"
+  log "flash post-tune re-measure force-installed"
+fi
+# data-derived flash-vs-naive selection threshold: a green proof
+# rewrites utils/tuned.py FLASH_MIN_T (suffix-win crossover,
+# provenance-stamped; idempotent re-runs are harmless)
+if _green "BENCH_flash_$ROUND.json" 2>/dev/null; then
+  python tools/flash_tpu_bench.py --apply-crossover \
+    "BENCH_flash_$ROUND.json" \
+    && log "flash crossover applied from BENCH_flash_$ROUND.json"
+fi
 capture all "BENCH_all_$ROUND.json" all 9000 \
   python bench.py --all --deadline 780
 capture sweep "BENCH_sweep_$ROUND.json" all 3600 \
@@ -38,9 +55,26 @@ capture flashtune "BENCH_flashtune_$ROUND.json" last 1200 \
 # data-derived flash tile default: a green tune capture rewrites
 # utils/tuned.py FLASH_TILES (provenance-stamped)
 if _green "BENCH_flashtune_$ROUND.json" 2>/dev/null; then
-  python tools/flash_tpu_bench.py --tune --apply \
-    "BENCH_flashtune_$ROUND.json" \
-    && log "flash tiles applied from BENCH_flashtune_$ROUND.json"
+  _tiles_before=$(python -c \
+    "from nnstreamer_tpu.utils.tuned import FLASH_TILES as t; print(t)")
+  if python tools/flash_tpu_bench.py --tune --apply \
+      "BENCH_flashtune_$ROUND.json"; then
+    log "flash tiles applied from BENCH_flashtune_$ROUND.json"
+    # the proof's timing rows (esp. 16k) were captured under the OLD
+    # tiles; whenever the SHIPPED tiles actually change, invalidate
+    # the proof stage so the next iteration re-measures (and
+    # re-derives the crossover) under them.  Keyed on the before/after
+    # value in tuned.py itself — a /tmp marker would misread stage
+    # loss (reboot, cleanup) as a tile change and force-install a
+    # possibly-degraded re-measure over a healthy artifact
+    _tiles_after=$(python -c \
+      "from nnstreamer_tpu.utils.tuned import FLASH_TILES as t; print(t)")
+    if [ -n "$_tiles_after" ] && [ "$_tiles_after" != "$_tiles_before" ]; then
+      rm -f "$STAGE/flash.out" "$STAGE/flash.bw"
+      touch "$STAGE/flash.force_install"
+      log "flash proof stage invalidated for re-measure under tiles $_tiles_after"
+    fi
+  fi
 fi
 
 # commit artifacts (and any tuned.py the appliers rewrote) the moment a

@@ -129,6 +129,51 @@ def tune() -> int:
     return 0 if timed else 1
 
 
+_NAIVE_INFEASIBLE_MARKERS = (
+    # XLA/PJRT device-capacity signatures only — deliberately NOT loose
+    # substrings like "allocat"/"exceeds", which also appear in
+    # host/infra failures ("Cannot allocate memory" from a dying
+    # remote-compile helper) and would defeat the flake filter
+    "RESOURCE_EXHAUSTED", "OUT_OF_MEMORY", "Out of memory",
+    "out of memory", "OOM", "VMEM limit", "vmem limit",
+    "HBM capacity", "hbm capacity")
+
+
+def _naive_infeasible(err: str) -> bool:
+    """True when a naive-path failure reads like a DEVICE capacity
+    limit (the O(T^2) score matrix not fitting) rather than transient
+    infra (e.g. a remote-compile HTTP 500 through the tunnel).  Only
+    capacity failures count as kernel WINS — a tunnel flake during the
+    naive run must not lower the persisted selection default."""
+    return any(m in (err or "") for m in _NAIVE_INFEASIBLE_MARKERS)
+
+
+def measured_crossover(timings):
+    """Kernel-vs-naive crossover with SUFFIX-WIN semantics: the smallest
+    measured T such that the kernel wins (speedup > 1, or the naive
+    path hit a CAPACITY failure while the kernel ran) at that T AND at
+    every longer measured T.  flash_min_t() is a threshold gate —
+    deriving it from "first winning length" would route an interior
+    LOSING length (e.g. a 16k row under un-tuned tiles) to the kernel
+    just because 2k won.  Rows where the kernel itself errored break
+    any win suffix; flash_only rows whose naive failure looks like
+    transient infra (not capacity) are SKIPPED — no evidence either
+    way — so they neither extend nor break the suffix, and the
+    crossover must anchor on a definite win.  None when even the
+    longest measured length loses."""
+    crossover = None
+    for row in reversed(timings):
+        if row.get("flash_only") and not _naive_infeasible(
+                row.get("naive_error", "")):
+            continue
+        wins = (row.get("flash_only")
+                or row.get("speedup", 0) > 1.0)
+        if not wins:
+            break
+        crossover = row["T"]
+    return crossover
+
+
 def main() -> int:
     import jax
 
@@ -254,13 +299,7 @@ def main() -> int:
                         "naive_ms": round(ms_naive, 3),
                         "speedup": round(speedup, 3)})
 
-    # measured kernel-vs-naive crossover: smallest T where the kernel
-    # wins outright (speedup > 1 or naive OOM).  Feeds the length-gated
-    # selection default (ops/flash_attention.py flash_min_t) and the
-    # docs/PERFORMANCE.md crossover sentence.
-    crossover = next(
-        (row["T"] for row in timings
-         if row.get("flash_only") or row.get("speedup", 0) > 1.0), None)
+    crossover = measured_crossover(timings)
     print(json.dumps({"metric": "flash_attention_tpu_proof",
                       "value": round(speedup, 3), "unit": "x_vs_naive",
                       "ok": ok, "crossover_T": crossover,
@@ -307,8 +346,61 @@ def apply_tiles_from_artifact(path: str, tuned_path: str = None) -> int:
     return 0
 
 
+def apply_crossover_from_artifact(path: str, tuned_path: str = None) -> int:
+    """--apply-crossover <proof.json>: rewrite utils/tuned.py's
+    FLASH_MIN_T from a green flash-proof capture, provenance-stamped.
+    Requires the row to be fully ok (every correctness and grad check
+    passed — a selection default must not come from a run whose kernel
+    mis-computed) and its timings to yield a non-null suffix-win
+    crossover (recomputed here, NOT read from the stored crossover_T
+    field, so artifacts written under older crossover semantics apply
+    correctly; a null crossover means the kernel lost even at the
+    longest measured length, and the memory-regime fallback default
+    stands).  Exit 1 otherwise."""
+    from _tuned_apply import load_last_row, rewrite_tuned
+
+    row = load_last_row(
+        path, "flash_attention_tpu_proof",
+        pred=lambda r: (r.get("ok")
+                        and measured_crossover(r.get("timings", []))))
+    if row is None:
+        print(f"apply-crossover: no fully-ok proof row with a non-null "
+              f"suffix-win crossover in {path}", file=sys.stderr)
+        return 1
+    t = int(measured_crossover(row["timings"]))
+    wins = []
+    for r in row.get("timings", []):
+        if "error" in r:
+            continue
+        if r.get("flash_only"):
+            wins.append("%s:%s" % (
+                r["T"], "naive-oom" if _naive_infeasible(
+                    r.get("naive_error", "")) else "no-evidence"))
+        else:
+            wins.append("%s:%sx" % (r["T"], r.get("speedup")))
+    provenance = (
+        f"measured: {os.path.basename(path)} — suffix-win crossover at "
+        f"T={t} ({', '.join(wins)}; {row.get('device', '?')}); applied "
+        "by flash_tpu_bench --apply-crossover")
+    if not rewrite_tuned(r"FLASH_MIN_T = \d+",
+                         f"FLASH_MIN_T = {t}",
+                         "FLASH_MIN_T_PROVENANCE", provenance,
+                         tuned_path):
+        return 1
+    print(json.dumps({"applied_min_t": t, "provenance": provenance}),
+          flush=True)
+    return 0
+
+
 if __name__ == "__main__":
     argv = sys.argv[1:]
+    if "--apply-crossover" in argv:
+        idx = argv.index("--apply-crossover")
+        if idx + 1 >= len(argv):
+            print("usage: flash_tpu_bench.py --apply-crossover "
+                  "<BENCH_flash_r0N.json>", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(apply_crossover_from_artifact(argv[idx + 1]))
     if "--apply" in argv and "--tune" not in argv:
         print("usage: flash_tpu_bench.py --tune --apply "
               "<BENCH_flashtune_r0N.json> (--apply applies TILE-TUNE "
