@@ -88,7 +88,19 @@ for f in BENCH_*_"$ROUND".json "TUNNEL_$ROUND.json" \
   [ -e "$f" ] && _paths="$_paths $f"
 done
 if [ -n "$_paths" ]; then
+  # stage first: `git commit -- <path>` alone cannot commit UNTRACKED
+  # files, and every round's artifacts are new files on their first
+  # green — without the add, the round-5 evidence sat uncommitted
   # shellcheck disable=SC2086
-  git commit -q -m "TPU capture artifacts (round-5 window)" -- $_paths \
-    2>/dev/null && log "committed r05 artifacts"
+  git add -- $_paths 2>/dev/null
+  # shellcheck disable=SC2086
+  if git commit -q -m "TPU capture artifacts ($ROUND window)" \
+      -- $_paths 2>/dev/null; then
+    log "committed $ROUND artifacts"
+  else
+    # unstage on failure (e.g. concurrent index.lock): leftover staged
+    # artifacts must not ride along into someone's unrelated commit
+    # shellcheck disable=SC2086
+    git reset -q -- $_paths 2>/dev/null
+  fi
 fi
