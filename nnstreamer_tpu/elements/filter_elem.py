@@ -55,6 +55,13 @@ class TensorFilter(Element):
         "latency-report": (False, "report invoke latency"),
         "batch": (1, "micro-batch N frames into one device invoke "
                      "(latency/throughput trade; backend-gated)"),
+        "inflight": (1, "dispatched micro-batches kept in flight before "
+                        "the oldest is awaited (pipeline depth).  1 = "
+                        "double-buffered (one collecting, one dispatched)"
+                        ".  Deeper overlaps K dispatch round-trips — the "
+                        "lever when dispatch latency, not device compute,"
+                        " bounds throughput (remote/tunneled chips); "
+                        "costs K batches of output HBM+latency"),
         "output-device": (False, "emit device-resident outputs (BatchView/"
                                  "jax.Array payloads): a downstream batched "
                                  "filter consumes them without any host "
@@ -122,7 +129,19 @@ class TensorFilter(Element):
                 "cannot shard")
         self._pending: list = []        # per-frame input lists, collecting
         self._pending_bufs: list = []
-        self._inflight = None           # (bufs, handle) dispatched batch
+        # FIFO of dispatched (bufs, handle) batches; stream order is the
+        # queue order.  Depth 1 keeps the historical double-buffering
+        # (one collecting + one dispatched)
+        from collections import deque
+
+        self._inflight: deque = deque()
+        self._inflight_depth = max(1, int(self.inflight or 1))
+        if self._inflight_depth > 1 and self._batch <= 1:
+            from ..utils.log import ml_logw
+
+            ml_logw("%s: inflight=%d needs micro-batching (batch>1); "
+                    "running per-frame", self.name, self._inflight_depth)
+            self._inflight_depth = 1
         self._rewarm = False            # re-compile owed after pushdown
         if self._batch > 1:
             self.fw.warmup_batched(self._batch)
@@ -217,17 +236,19 @@ class TensorFilter(Element):
 
     # -- micro-batching ------------------------------------------------------
     def _dispatch_pending(self) -> FlowReturn:
-        """Dispatch the collecting batch, then push the PREVIOUS batch's
-        results (its d2h copies overlapped this batch's collection)."""
+        """Dispatch the collecting batch, then — once the in-flight queue
+        is at depth — push the OLDEST batch's results (d2h copies of
+        every queued batch overlap this batch's collection; deeper
+        queues overlap more dispatch round-trips)."""
         if self._emit_device:
             handle = self.fw.invoke_batched(self._pending, self._batch,
                                             emit_device=True)
         else:
             handle = self.fw.invoke_batched(self._pending, self._batch)
-        prev, self._inflight = self._inflight, (self._pending_bufs, handle)
+        self._inflight.append((self._pending_bufs, handle))
         self._pending, self._pending_bufs = [], []
-        if prev is not None:
-            return self._push_inflight(prev)
+        if len(self._inflight) > self._inflight_depth:
+            return self._push_inflight(self._inflight.popleft())
         return FlowReturn.OK
 
     def _push_inflight(self, inflight) -> FlowReturn:
@@ -251,9 +272,8 @@ class TensorFilter(Element):
         ret = FlowReturn.OK
         if self._pending:
             ret = self._dispatch_pending()
-        if self._inflight is not None:
-            inflight, self._inflight = self._inflight, None
-            r = self._push_inflight(inflight)
+        while self._inflight:
+            r = self._push_inflight(self._inflight.popleft())
             ret = r if r is FlowReturn.ERROR else ret
         if ret is FlowReturn.ERROR:
             raise RuntimeError(

@@ -37,13 +37,28 @@ _cache_enabled = False
 def _enable_compilation_cache() -> None:
     """Persistent XLA compilation cache: model open cost is paid once per
     (model, shape, device) across processes — the TPU analogue of the
-    reference caching built TensorRT engines."""
+    reference caching built TensorRT engines.
+
+    Also the library's chokepoint for honoring ``JAX_PLATFORMS=cpu``: a
+    site customization can force a tunneled-TPU platform plugin over the
+    env var, and the first backend touch then BLOCKS in remote client
+    init when the tunnel is dead — a CPU-requested pipeline must never
+    wait on a device it asked not to use, so the env var is promoted to
+    the authoritative config here (the same pattern bench.run_child and
+    tests/conftest.py apply at process level)."""
     global _cache_enabled
     if _cache_enabled:
         return
     import os
 
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        try:
+            if jax.config.jax_platforms != "cpu":
+                jax.config.update("jax_platforms", "cpu")
+        except Exception:  # pragma: no cover - very old jax
+            pass
 
     cache_dir = os.environ.get(
         "NNS_TPU_COMPILE_CACHE",

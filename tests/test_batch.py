@@ -111,15 +111,87 @@ class TestBatchedInvoke:
 
         f = p.get("f")
         deadline = time.monotonic() + 10
-        while f._inflight is None and time.monotonic() < deadline:
+        while not f._inflight and time.monotonic() < deadline:
             time.sleep(0.01)
-        assert f._inflight is not None and len(got) == 0
+        assert len(f._inflight) == 1 and len(got) == 0
         for arr in feeds[4:]:
             src.push_buffer(TensorBuffer(tensors=[arr]))
         src.end_of_stream()
         p.wait(timeout=60)
         p.stop()
         assert len(got) == 8
+
+    @pytest.mark.parametrize("n,batch,depth", [
+        (24, 4, 3),   # 6 full batches through a 3-deep queue
+        (10, 4, 3),   # EOS flush drains a part-full queue + remainder
+        (8, 4, 8),    # depth larger than the whole stream: EOS drains all
+        (33, 8, 2),   # 1-frame EOS tail behind a 2-deep queue
+    ])
+    def test_inflight_depth_matches_unbatched(self, tiny_model, n, batch,
+                                              depth):
+        """A deeper dispatch queue (inflight=K) must change throughput
+        only — outputs, order, and timestamps stay identical to the
+        per-frame path."""
+        from nnstreamer_tpu import parse_launch
+
+        feeds = _feeds(n)
+        pts = [i * 1000 for i in range(n)]
+        ref = _run(self._launch(1), feeds, pts)
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            f"tensor_filter framework=xla model=tiny_batch batch={batch} "
+            f"inflight={depth} name=f ! tensor_sink name=out")
+        got = _run(p, feeds, pts)
+        assert len(got) == len(ref) == n
+        for i, (r, g) in enumerate(zip(ref, got)):
+            assert g.pts == r.pts == i * 1000
+            np.testing.assert_allclose(g.np(0), r.np(0), rtol=1e-5)
+
+    def test_inflight_queue_holds_depth_batches(self, tiny_model):
+        """With inflight=2, the first TWO full batches are held in the
+        dispatch queue; the oldest is pushed only when the third
+        dispatches (or at EOS)."""
+        import time
+
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_batch batch=4 "
+            "inflight=2 name=f ! tensor_sink name=out")
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b))
+        p.play()
+        src = p.get("in")
+        feeds = _feeds(12)
+        f = p.get("f")
+        for arr in feeds[:8]:
+            src.push_buffer(TensorBuffer(tensors=[arr]))
+        deadline = time.monotonic() + 10
+        while len(f._inflight) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # two dispatched batches queued, nothing surfaced yet
+        assert len(f._inflight) == 2 and len(got) == 0
+        for arr in feeds[8:]:
+            src.push_buffer(TensorBuffer(tensors=[arr]))
+        src.end_of_stream()
+        p.wait(timeout=60)
+        p.stop()
+        assert len(got) == 12
+
+    def test_inflight_without_batching_is_clamped(self, tiny_model):
+        """inflight>1 without micro-batching has nothing to queue: warn
+        and run per-frame (inert perf prop, reference behavior)."""
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_batch inflight=4 "
+            "name=f ! tensor_sink name=out")
+        feeds = _feeds(5)
+        got = _run(p, feeds)
+        assert p.get("f")._inflight_depth == 1
+        assert len(got) == 5
 
     def test_batched_with_output_combination(self, tiny_model):
         from nnstreamer_tpu import parse_launch

@@ -42,6 +42,11 @@ capture ssd_nopd "BENCH_ssd_nopushdown_$ROUND.json" last 900 \
   env NNS_TPU_BENCH_NO_PUSHDOWN=1 python bench.py --config ssd --deadline 780
 capture posenet_nopd "BENCH_posenet_nopushdown_$ROUND.json" last 900 \
   env NNS_TPU_BENCH_NO_PUSHDOWN=1 python bench.py --config posenet --deadline 780
+# device-resident re-capture under the K-deep dispatch queue
+# (tensor_filter inflight=8, bench run_child default): the --all row
+# was measured double-buffered; this is the 1%-stream-MFU attempt
+capture resident "BENCH_resident_$ROUND.json" last 900 \
+  python bench.py --config resident --deadline 780
 capture int8 "BENCH_int8_$ROUND.json" last 900 \
   python tools/tflite_int8_tpu_bench.py
 # data-derived quant default: a green 3-mode capture rewrites
