@@ -47,7 +47,7 @@ capture posenet_nopd "BENCH_posenet_nopushdown_$ROUND.json" last 900 \
 # was measured double-buffered; this is the 1%-stream-MFU attempt
 capture resident "BENCH_resident_$ROUND.json" last 900 \
   python bench.py --config resident --deadline 780
-capture int8 "BENCH_int8_$ROUND.json" last 900 \
+capture int8 "BENCH_int8_$ROUND.json" last 1500 \
   python tools/tflite_int8_tpu_bench.py
 # data-derived quant default: a green 3-mode capture rewrites
 # utils/tuned.py (provenance-stamped; committed with the round)
@@ -55,7 +55,7 @@ if _green "BENCH_int8_$ROUND.json" 2>/dev/null; then
   python tools/tflite_int8_tpu_bench.py --apply "BENCH_int8_$ROUND.json" \
     && log "quant default applied from BENCH_int8_$ROUND.json"
 fi
-capture flashtune "BENCH_flashtune_$ROUND.json" last 1200 \
+capture flashtune "BENCH_flashtune_$ROUND.json" last 1800 \
   python tools/flash_tpu_bench.py --tune
 # data-derived flash tile default: a green tune capture rewrites
 # utils/tuned.py FLASH_TILES (provenance-stamped)
