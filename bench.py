@@ -408,12 +408,27 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
                "stream_batch": STREAM_BATCH,
                "inflight": _effective_inflight(p)}
         if emit is not None:
-            # flush the core number NOW: the optional extras below re-jit
-            # (cost analysis, vmap batch) and could blow the parent's
-            # deadline — a kill mid-extras must not lose a measured fps
-            # (_parse_result takes the LAST parsed line, so a completed
-            # enriched line supersedes this one)
+            # flush the core number NOW: everything below (drift probe,
+            # cost analysis, vmap batch) re-touches the link or re-jits
+            # and could blow the parent's deadline — a kill mid-extras
+            # must not lose a measured fps (_parse_result takes the
+            # LAST parsed line, so a completed enriched line supersedes
+            # this one)
             emit(out)
+        if fps2 and abs(fps1 - fps2) / max(fps1, fps2) > 0.2:
+            # the stability bar is two runs within 20%; when a window
+            # misses it, re-profile the link so the artifact itself
+            # shows whether the spread is link drift (the common case
+            # on the tunnel: round-4 saw window quality swing ~100x in
+            # minutes) or pipeline nondeterminism
+            drift = _probe_link(fw._device) if (
+                fw._device.platform != "cpu") else {}
+            if drift:
+                out["link_h2d_MBps_after_run2"] = drift.get(
+                    "link_h2d_MBps")
+                out["link_rtt_ms_after_run2"] = drift.get("link_rtt_ms")
+                if emit is not None:
+                    emit(out)
         model = fw._model
         device = fw._device
         peak = _peak_flops(device)

@@ -142,6 +142,15 @@ class XLAFilter(JitExecMixin, FilterFramework):
     # -- events --------------------------------------------------------------
     def handle_event(self, name: str, data: Optional[Dict[str, Any]] = None) -> None:
         if name == "reload_model":
+            if data and "model" in data and \
+                    str(data["model"]) != str(self.props.model):
+                # a DIFFERENT model name changes the forward function,
+                # not just the params — the jitted/vmapped executables
+                # must be rebuilt, so take the generic close+open swap
+                # (interface check + rollback).  The fast path below
+                # would silently rebuild the OLD model: it merges data
+                # into custom properties and re-gets props.model
+                return super().handle_event(name, data)
             # Hot reload: rebuild params (e.g. new checkpoint path in data),
             # keep serving the old executable until the swap (reference
             # RELOAD_MODEL holds the old model,

@@ -228,7 +228,20 @@ class FilterFramework:
                     "backend is not supported by the generic path")
             old = self.props
             old_info = self.get_model_info()
-            props = dataclasses.replace(old, model=new_model)
+            # non-model event keys ride into custom properties (the
+            # reference's RELOAD_MODEL carries the full new prop set);
+            # a model-NAME change drops a stale `checkpoint` unless the
+            # event supplies a new one — the old model's checkpoint
+            # applied to the new model's params is a shape-mismatch
+            # rollback at best and a silent wrong-weights load at worst
+            custom = dict(old.custom_properties)
+            extra = {k: str(v) for k, v in (data or {}).items()
+                     if k != "model"}
+            if str(new_model) != str(old.model) and "checkpoint" not in extra:
+                custom.pop("checkpoint", None)
+            custom.update(extra)
+            props = dataclasses.replace(old, model=new_model,
+                                        custom_properties=custom)
 
             def rollback(cause: Exception):
                 try:

@@ -179,6 +179,132 @@ class TestBatchedInvoke:
         p.stop()
         assert len(got) == 12
 
+    def test_inflight_drains_midstream_on_model_update(self, tiny_model):
+        """A model-update event behind a DEEP dispatch queue: every
+        frame pushed before the event flushes through the OLD weights
+        in stream order (queued batches + the collecting partial), and
+        every frame after runs the NEW weights — the mid-stream
+        _drain_batches path, not the EOS one."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.models.registry import (_MODELS, Model,
+                                                    register_model)
+        from nnstreamer_tpu.pipeline.element import CustomEvent
+
+        w2 = np.full((4, 8), 2.0, np.float32)
+
+        @register_model("tiny_batch_b")
+        def build_b(custom):
+            def forward(params, x):
+                return (jnp.asarray(x, jnp.float32) @ params,)
+
+            return Model(name="tiny_batch_b", forward=forward, params=w2,
+                         in_info=TensorsInfo(
+                             [TensorInfo(TensorType.FLOAT32, (4,))]),
+                         out_info=TensorsInfo(
+                             [TensorInfo(TensorType.FLOAT32, (8,))]))
+
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS} name=in ! "
+                "tensor_filter framework=xla model=tiny_batch batch=4 "
+                "inflight=3 is-updatable=true name=f ! "
+                "tensor_sink name=out")
+            got = []
+            p.get("out").connect("new-data", lambda b: got.append(b))
+            p.play()
+            src = p.get("in")
+            feeds = _feeds(20)
+            # 10 frames = 2 full batches (queued, depth 3) + 2 collecting
+            for arr in feeds[:10]:
+                src.push_buffer(TensorBuffer(tensors=[arr]))
+            src.push_event(CustomEvent("tensor_filter_update_model",
+                                       {"model": "tiny_batch_b"}))
+            for arr in feeds[10:]:
+                src.push_buffer(TensorBuffer(tensors=[arr]))
+            src.end_of_stream()
+            p.wait(timeout=60)
+            p.stop()
+            assert len(got) == 20
+            w_old = np.arange(32, dtype=np.float32).reshape(4, 8)
+            for i, (f_in, g) in enumerate(zip(feeds, got)):
+                want = f_in @ (w_old if i < 10 else w2)
+                np.testing.assert_allclose(g.np(0), want, rtol=1e-5)
+        finally:
+            _MODELS.pop("tiny_batch_b", None)
+
+    def test_model_name_reload_with_pushdown_decoder(self, tiny_model):
+        """Model-NAME reload behind a pushdown-fused decoder: the
+        close+open swap resets the backend's fused reduction, so
+        post-reload buffers carry the FULL tensor again — the decoder
+        must keep decoding correctly either way (it distinguishes
+        reduced vs full by shape), and pre-reload frames flush through
+        the old weights."""
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.models.registry import (_MODELS, Model,
+                                                    register_model)
+        from nnstreamer_tpu.pipeline.element import CustomEvent
+
+        # weights chosen so argmax(f(x)) differs between models for
+        # one-hot inputs: A routes class i -> i, B routes i -> 7-i
+        w_a = np.eye(4, 8, dtype=np.float32) * 10.0
+        w_b = np.fliplr(np.eye(4, 8, dtype=np.float32) * 10.0)
+
+        @register_model("tiny_batch_c")
+        def build_c(custom):
+            def forward(params, x):
+                return (jnp.asarray(x, jnp.float32) @ params,)
+
+            return Model(name="tiny_batch_c", forward=forward, params=w_b,
+                         in_info=TensorsInfo(
+                             [TensorInfo(TensorType.FLOAT32, (4,))]),
+                         out_info=TensorsInfo(
+                             [TensorInfo(TensorType.FLOAT32, (8,))]))
+
+        import nnstreamer_tpu.models.registry as registry
+
+        # rebind tiny_batch's params to w_a for deterministic argmax
+        orig_builder = registry._MODELS["tiny_batch"]
+
+        def build_a(custom):
+            m = orig_builder(custom)
+            m.params = w_a
+            return m
+
+        registry._MODELS["tiny_batch"] = build_a
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS} name=in ! "
+                "tensor_filter framework=xla model=tiny_batch batch=4 "
+                "inflight=2 is-updatable=true name=f ! "
+                "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+            got = []
+            p.get("out").connect("new-data",
+                                 lambda b: got.append(b.extra["index"]))
+            p.play()
+            src = p.get("in")
+            onehots = [np.eye(4, dtype=np.float32)[i % 4] for i in range(8)]
+            for arr in onehots:
+                src.push_buffer(TensorBuffer(tensors=[arr]))
+            src.push_event(CustomEvent("tensor_filter_update_model",
+                                       {"model": "tiny_batch_c"}))
+            for arr in onehots:
+                src.push_buffer(TensorBuffer(tensors=[arr]))
+            src.end_of_stream()
+            p.wait(timeout=60)
+            p.stop()
+            assert len(got) == 16
+            for i in range(8):
+                assert got[i] == i % 4, (i, got[i])
+            for i in range(8):
+                assert got[8 + i] == 7 - (i % 4), (i, got[8 + i])
+        finally:
+            registry._MODELS["tiny_batch"] = orig_builder
+            _MODELS.pop("tiny_batch_c", None)
+
     def test_inflight_without_batching_is_clamped(self, tiny_model):
         """inflight>1 without micro-batching has nothing to queue: warn
         and run per-frame (inert perf prop, reference behavior)."""

@@ -230,3 +230,65 @@ class TestXLABackend:
             # deterministic across invokes
             out2, = s.invoke([frame])
             np.testing.assert_allclose(out, out2)
+
+
+class TestReloadPropMerge:
+    """Generic reload_model prop handling: non-model event keys merge
+    into custom properties; a model-NAME change drops a stale
+    `checkpoint` unless the event supplies a new one (the old model's
+    checkpoint applied to the new model's params is a shape-mismatch
+    rollback at best, a silent wrong-weights load at worst)."""
+
+    def _spy_backend(self, initial_custom):
+        from nnstreamer_tpu.filter.framework import (FilterFramework,
+                                                     FilterProperties)
+        from nnstreamer_tpu.tensor import TensorsInfo
+
+        opened = []
+
+        class Spy(FilterFramework):
+            NAME = "spy"
+            SUPPORTED_ACCELERATORS = (Accelerator.CPU,)
+
+            def open(self, props):
+                opened.append(props)
+                self.props = props
+
+            def close(self):
+                pass
+
+            def invoke(self, inputs):
+                return inputs
+
+            def get_model_info(self):
+                info = TensorsInfo.from_strings("4", "float32")
+                return info, info
+
+        fw = Spy()
+        fw.open(FilterProperties(
+            framework="spy", model="model_a",
+            custom_properties=dict(initial_custom)))
+        return fw, opened
+
+    def test_model_change_drops_stale_checkpoint(self):
+        fw, opened = self._spy_backend({"checkpoint": "/ckpt_a",
+                                        "seed": "0"})
+        fw.handle_event("reload_model", {"model": "model_b"})
+        props = opened[-1]
+        assert str(props.model) == "model_b"
+        assert "checkpoint" not in props.custom_properties
+        assert props.custom_properties["seed"] == "0"  # unrelated kept
+
+    def test_model_change_takes_new_checkpoint(self):
+        fw, opened = self._spy_backend({"checkpoint": "/ckpt_a"})
+        fw.handle_event("reload_model", {"model": "model_b",
+                                         "checkpoint": "/ckpt_b"})
+        props = opened[-1]
+        assert str(props.model) == "model_b"
+        assert props.custom_properties["checkpoint"] == "/ckpt_b"
+
+    def test_same_model_keeps_checkpoint(self):
+        fw, opened = self._spy_backend({"checkpoint": "/ckpt_a"})
+        fw.handle_event("reload_model", {"model": "model_a"})
+        props = opened[-1]
+        assert props.custom_properties["checkpoint"] == "/ckpt_a"
