@@ -1,12 +1,15 @@
 #!/usr/bin/env python
 """On-device proof for native-int8 tflite execution.
 
-Runs the reference's real mobilenet_v2_1.0_224_quant.tflite on the TPU in
-both modes — f32 emulation (compute:float32) and native int8
-(compute:int8, the TPU default for quant graphs) — and reports agreement
-(quant steps, top-1) plus p50 single-invoke latency and batch-64
-throughput for each.  Prints ONE JSON line; exit 0 iff the modes agree
-within tolerance on a real TPU.
+Runs the reference's real mobilenet_v2_1.0_224_quant.tflite on the TPU
+in three modes — f32 emulation (compute:float32), native int8
+(compute:int8), weight-only (compute:w8) — and reports agreement (quant
+steps, top-1) plus p50 single-invoke latency and batch-64 throughput
+for each.  Prints one red progress JSON line per completed mode (value
+0 + "error": partial, so a killed run leaves its measured modes on
+record) and a final all-modes line that supersedes them — consumers
+take the LAST line; exit 0 iff the modes agree within tolerance on a
+real TPU.
 
 CPU twin: tests/test_tflite_quant_native.py (synthetic graphs — the full
 model costs ~90s of XLA CPU int8-conv compile, so the real-model check
@@ -26,6 +29,19 @@ MODEL = ("/root/reference/tests/test_models/models/"
          "mobilenet_v2_1.0_224_quant.tflite")
 TOL_STEPS = 4
 BATCH = 64
+
+
+def _perf_fields(perf):
+    """p50/batched-fps row keys for the measured modes — shared by the
+    partial-progress lines and the final row so the key names cannot
+    drift apart ("float32" shortens to "f32" in keys)."""
+    short = {"float32": "f32"}
+    out = {}
+    for m, (p50, bfps) in perf.items():
+        k = short.get(m, m)
+        out[f"p50_ms_{k}"] = round(p50, 3)
+        out[f"batched_fps_{k}"] = round(bfps, 1)
+    return out
 
 
 def _bench(fw, x):
@@ -90,6 +106,17 @@ def main() -> int:
             perf[mode] = _bench(fw, x)
         finally:
             fw.close()
+        # per-mode progress line: a window dying (or the step timeout
+        # firing) mid-run must not discard the modes already measured —
+        # the round-4 outage killed this tool at 15 min with all three
+        # modes' work lost.  The line is red (value 0, error) so the
+        # capture loop never installs it as the proof; the loop keeps
+        # the last red output at $STAGE/int8.red for diagnosis, and the
+        # final all-modes line below supersedes these (last-line-wins)
+        print(json.dumps(dict(
+            result, value=0, ok=False,
+            error=f"partial: {len(perf)}/3 modes measured",
+            modes_done=sorted(perf), **_perf_fields(perf))), flush=True)
     diff = np.abs(outs["float32"] - outs["int8"])
     diff_w8 = np.abs(outs["float32"] - outs["w8"])
     ok = (int(diff.max()) <= TOL_STEPS
@@ -113,12 +140,7 @@ def main() -> int:
         max_qstep_diff=int(diff.max()),
         max_qstep_diff_w8=int(diff_w8.max()),
         top1_agree=bool(outs["float32"].argmax() == outs["int8"].argmax()),
-        p50_ms_f32=round(perf["float32"][0], 3),
-        p50_ms_int8=round(perf["int8"][0], 3),
-        p50_ms_w8=round(perf["w8"][0], 3),
-        batched_fps_f32=round(perf["float32"][1], 1),
-        batched_fps_int8=round(perf["int8"][1], 1),
-        batched_fps_w8=round(perf["w8"][1], 1),
+        **_perf_fields(perf),
         w8_vs_f32=round(perf["w8"][1] / perf["float32"][1], 3)
         if perf["float32"][1] else 0, batch=BATCH,
         recommended_default=recommended)

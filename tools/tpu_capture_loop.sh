@@ -88,6 +88,10 @@ capture() {
     fi
   else
     log "$name failed/red (see $STAGE/$name.err)"
+    # keep the last red output: partial-progress rows (e.g. the int8
+    # proof's per-mode lines) are diagnosis evidence that the next
+    # attempt's truncation of $staged.new would otherwise erase
+    [ -s "$staged.new" ] && cp "$staged.new" "$staged.red" 2>/dev/null
     # a red --all/--sweep still carries partial rows worth keeping if the
     # repo has nothing at all for the judge — but only when at least one
     # row is actually green (a fast dead-tunnel run emits all-zero rows,
