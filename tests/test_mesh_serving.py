@@ -63,14 +63,15 @@ def _feeds(n):
 
 
 class TestDpServing:
-    def _launch(self, batch, mesh=""):
+    def _launch(self, batch, mesh="", extra=""):
         from nnstreamer_tpu import parse_launch
 
         custom = f" custom={mesh}" if mesh else ""
+        extra = f" {extra}" if extra else ""
         return parse_launch(
             f"appsrc caps={CAPS} name=in ! "
             f"tensor_filter framework=xla model=tiny_mesh batch={batch}"
-            f"{custom} name=f ! tensor_sink name=out")
+            f"{custom}{extra} name=f ! tensor_sink name=out")
 
     def test_dp_sharded_stream_matches_unsharded_oracle(self, tiny_model,
                                                         jax_cpu_devices):
@@ -79,6 +80,21 @@ class TestDpServing:
         feeds = _feeds(24)
         ref = _run(self._launch(batch=8), feeds)
         got = _run(self._launch(batch=8, mesh="mesh:dp=4"), feeds)
+        assert len(got) == len(ref) == 24
+        for r, g in zip(ref, got):
+            assert g.pts == r.pts
+            np.testing.assert_allclose(g.np(0), r.np(0), rtol=1e-5)
+
+    def test_dp_sharded_stream_with_deep_inflight_queue(self, tiny_model,
+                                                        jax_cpu_devices):
+        """Mesh dp-serving composes with inflight=K: queued mesh-sharded
+        batch handles drain in stream order with oracle-equal outputs
+        (the dispatch-pipelining lever applies to the sharded
+        executable the same as the single-device one)."""
+        feeds = _feeds(24)
+        ref = _run(self._launch(batch=8), feeds)
+        p = self._launch(batch=8, mesh="mesh:dp=4", extra="inflight=2")
+        got = _run(p, feeds)
         assert len(got) == len(ref) == 24
         for r, g in zip(ref, got):
             assert g.pts == r.pts
