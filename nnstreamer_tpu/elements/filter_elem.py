@@ -143,6 +143,7 @@ class TensorFilter(Element):
                     "running per-frame", self.name, self._inflight_depth)
             self._inflight_depth = 1
         self._rewarm = False            # re-compile owed after pushdown
+        self._pushdown = None           # fn of a fused device reduction
         if self._batch > 1:
             self.fw.warmup_batched(self._batch)
 
@@ -332,6 +333,10 @@ class TensorFilter(Element):
                 return False
             if not self.fw.set_postprocess(fn):
                 return False
+            # remember the fusion: a model reload rebuilds the backend
+            # (close+open), which would silently drop the device-fused
+            # tail back to host decode — the update handler re-applies it
+            self._pushdown = fn
             if self._batch > 1:
                 # the fusion rebuilt both executables; re-warm on the
                 # next chain() call (producer thread)
@@ -366,8 +371,42 @@ class TensorFilter(Element):
                     raise
                 ml_logw("%s: model reload rejected, keeping old model: %s",
                         self.name, exc)
+            self._reapply_pushdown()
             return  # consumed, like the reference custom-event sink
         super().on_event(pad, event)
+
+    def _reapply_pushdown(self) -> None:
+        """Restore a device-fused decoder reduction after a model reload:
+        any close+open swap (new model name, or a rejected reload's
+        rollback) rebuilt the backend WITHOUT the fused tail, so every
+        output would silently pay the full d2h fetch + host decode
+        again.  The reload interface check guarantees the model's
+        tensor io is unchanged, so the stored reduction still applies.
+        If the fresh backend refuses the fusion, fall back loudly to
+        the full output caps (decoders dispatch on actual shapes, so
+        correctness holds either way)."""
+        if self._pushdown is None or not getattr(self.fw, "opened", False):
+            return
+        if self.fw.has_postprocess():
+            # params-only fast path: the backend never closed, the fused
+            # executable survived — re-fusing would compose the reduction
+            # over the already-reduced outputs
+            return
+        if self.fw.set_postprocess(self._pushdown):
+            if self._batch > 1:
+                self._rewarm = True
+            return
+        from ..utils.log import ml_logw
+
+        ml_logw("%s: device-reduce fusion could not be re-applied after "
+                "reload; serving full outputs (host decode)", self.name)
+        self._pushdown = None
+        _, model_out = self.fw.get_model_info()
+        self._out_config = TensorsConfig(info=model_out,
+                                         rate=self._in_config.rate)
+        from ..tensor.caps_util import caps_from_config
+
+        self.announce_src_caps(caps_from_config(self._out_config))
 
     def report_latency(self) -> int:
         """LATENCY-query contribution: rolling average invoke latency in ns

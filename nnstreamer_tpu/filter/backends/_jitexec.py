@@ -236,6 +236,7 @@ class JitExecMixin:
         self._params_dev = None
         self._params_mesh = None
         self._mesh = None
+        self._postprocess_fn = None
 
     @staticmethod
     def _pick_device(accelerators):
@@ -465,4 +466,12 @@ class JitExecMixin:
         self._forward_fn = fused
         self._jitted = jax.jit(fused)
         self._vjit = None  # rebuild the batched executable around the fusion
+        # marker for the element's post-reload re-apply: a backend that
+        # still carries the fusion must NOT be fused again (set_postprocess
+        # composes over _forward_fn — a second application would reduce
+        # the already-reduced outputs)
+        self._postprocess_fn = fn
         return True
+
+    def has_postprocess(self) -> bool:
+        return getattr(self, "_postprocess_fn", None) is not None

@@ -203,6 +203,14 @@ class FilterFramework:
         Return False when the backend cannot compose device functions."""
         return False
 
+    def has_postprocess(self) -> bool:
+        """Does this backend CURRENTLY carry a fused set_postprocess
+        reduction?  The element consults this before re-applying a
+        stored fusion after a model reload — set_postprocess composes
+        over the forward fn, so fusing a backend that kept its fusion
+        (e.g. a params-only hot swap) would apply the reduction twice."""
+        return False
+
     # -- events --------------------------------------------------------------
     def handle_event(self, name: str, data: Optional[Dict[str, Any]] = None) -> None:
         """RELOAD_MODEL / CUSTOM_PROP / SET_ACCELERATOR (reference

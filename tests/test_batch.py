@@ -236,11 +236,10 @@ class TestBatchedInvoke:
 
     def test_model_name_reload_with_pushdown_decoder(self, tiny_model):
         """Model-NAME reload behind a pushdown-fused decoder: the
-        close+open swap resets the backend's fused reduction, so
-        post-reload buffers carry the FULL tensor again — the decoder
-        must keep decoding correctly either way (it distinguishes
-        reduced vs full by shape), and pre-reload frames flush through
-        the old weights."""
+        close+open swap resets the backend's fused reduction, and the
+        element re-applies it (the reload interface check guarantees
+        the tensor io is unchanged) — decode results stay correct
+        across the swap and the device-fused tail survives."""
         import jax.numpy as jnp
 
         from nnstreamer_tpu import parse_launch
@@ -295,6 +294,9 @@ class TestBatchedInvoke:
                 src.push_buffer(TensorBuffer(tensors=[arr]))
             src.end_of_stream()
             p.wait(timeout=60)
+            # the device-fused tail must have been re-applied to the
+            # swapped backend (not silently dropped to host decode)
+            assert p.get("f").fw.has_postprocess()
             p.stop()
             assert len(got) == 16
             for i in range(8):
@@ -304,6 +306,48 @@ class TestBatchedInvoke:
         finally:
             registry._MODELS["tiny_batch"] = orig_builder
             _MODELS.pop("tiny_batch_c", None)
+
+    def test_same_model_reload_does_not_double_fuse(self, tiny_model):
+        """Params-only reload (same model name, xla fast path): the
+        backend keeps its fused executable, and the element must NOT
+        re-apply the reduction — set_postprocess composes over the
+        forward fn, so a second application would argmax the argmax."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.pipeline.element import CustomEvent
+
+        p = parse_launch(
+            f"appsrc caps={CAPS} name=in ! "
+            "tensor_filter framework=xla model=tiny_batch batch=4 "
+            "inflight=2 is-updatable=true name=f ! "
+            "tensor_decoder mode=image_labeling ! tensor_sink name=out")
+        got = []
+        p.get("out").connect("new-data",
+                             lambda b: got.append(b.extra["index"]))
+        p.play()
+        src = p.get("in")
+        # the decoder's pushdown must actually be fused BEFORE the
+        # reload, or this test passes vacuously on the host-decode path
+        import time
+
+        deadline = time.monotonic() + 10
+        while (not p.get("f").fw.has_postprocess()
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert p.get("f").fw.has_postprocess()
+        onehots = [np.eye(4, dtype=np.float32)[i % 4] for i in range(8)]
+        for arr in onehots:
+            src.push_buffer(TensorBuffer(tensors=[arr]))
+        src.push_event(CustomEvent("tensor_filter_update_model",
+                                   {"model": "tiny_batch"}))
+        for arr in onehots:
+            src.push_buffer(TensorBuffer(tensors=[arr]))
+        src.end_of_stream()
+        p.wait(timeout=60)
+        p.stop()
+        assert len(got) == 16
+        # tiny_batch is x @ arange(32): one-hot i selects row i, whose
+        # argmax is always column 7
+        assert all(v == 7 for v in got), got
 
     def test_inflight_without_batching_is_clamped(self, tiny_model):
         """inflight>1 without micro-batching has nothing to queue: warn
