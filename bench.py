@@ -576,7 +576,8 @@ def bench_edge(dtype_prop: str) -> dict:
 
 def bench_lm(emit=None) -> dict:
     """LM serving (net-new axis, no reference analogue): prefill tokens/sec
-    + MFU on the full-sequence forward (Pallas flash path on TPU), and
+    + MFU on the full-sequence forward (attention path chosen by the
+    length gate — naive below the measured flash crossover), and
     KV-cache decode tokens/sec through the compiled generate scan at a
     stated cache size.  Both measurements run twice; headline is the
     SLOWER decode run (same stability policy as the vision configs)."""
@@ -585,13 +586,14 @@ def bench_lm(emit=None) -> dict:
 
     from nnstreamer_tpu.models.streamformer_lm import (forward_logits,
                                                        generate)
+    from nnstreamer_tpu.ops.flash_attention import flash_wins as _flash_wins
     from nnstreamer_tpu.parallel.train_step import (StreamFormerConfig,
                                                     init_params)
 
     device = jax.devices()[0]
-    # forward_logits enables the flash kernel on platform == "tpu" only:
-    # key the label and the scale choice on the same predicate (a CUDA
-    # backend must not be labelled pallas_flash)
+    # the lengths scale with the platform; the attn_path LABEL keys on
+    # the same flash_wins gate forward_logits consults, so the row
+    # reports the kernel that actually served the prefill
     on_tpu = device.platform == "tpu"
     prefill_t = int(os.environ.get("NNS_TPU_BENCH_LM_PREFILL",
                                    "2048" if on_tpu else "256"))
@@ -675,7 +677,11 @@ def bench_lm(emit=None) -> dict:
            "prefill_len": prefill_t, "decode_len": decode_n,
            "kv_cache_tokens": cfg.max_seq,
            "params_m": round(n_params / 1e6, 2),
-           "attn_path": "pallas_flash" if on_tpu else "naive"}
+           # the path the length gate ACTUALLY selects for this prefill
+           # length (flash=None callers route through flash_wins) — a
+           # row must never describe a kernel that didn't run
+           "attn_path": ("pallas_flash" if _flash_wins(prefill_t)
+                         else "naive")}
     if stream_tok_s:
         out["decode_streams"] = n_streams
         out["decode_tok_s_multistream"] = round(stream_tok_s, 1)
