@@ -54,9 +54,9 @@ def forward_logits(params: Dict[str, Any], tokens: jnp.ndarray,
     ``flash``: run attention as the Pallas streaming-softmax kernel
     (ops/flash_attention.py) — the long-prompt prefill path never
     materializes (T, T) scores.  Default: length-gated on TPU
-    (flash_wins): hardware timings show naive XLA attention faster
-    below the measured crossover, so short prefills take the naive
-    path and long-context prefills take the kernel."""
+    (flash_wins): each prefill length takes whichever path the
+    measured win table / crossover records say is faster there (the
+    r5 capture routes 2k prefills to the kernel at 1.365×)."""
     t = tokens.shape[0]
     if flash is None:
         from ..ops.flash_attention import flash_wins

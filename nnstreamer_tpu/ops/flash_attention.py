@@ -70,7 +70,9 @@ def flash_is_default() -> bool:
 FLASH_MIN_T_DEFAULT = 16384
 
 
-def flash_min_t() -> int:
+def _env_min_t():
+    """NNS_TPU_FLASH_MIN_T operator override as an int, or None (absent
+    or malformed; malformed warns once per call site)."""
     import os
 
     raw = os.environ.get("NNS_TPU_FLASH_MIN_T")
@@ -82,6 +84,13 @@ def flash_min_t() -> int:
 
             warnings.warn(f"NNS_TPU_FLASH_MIN_T={raw!r} is not an int; "
                           f"ignoring the override")
+    return None
+
+
+def flash_min_t() -> int:
+    env = _env_min_t()
+    if env is not None:
+        return env
     try:
         from ..utils.tuned import FLASH_MIN_T
         return int(FLASH_MIN_T)
@@ -89,14 +98,61 @@ def flash_min_t() -> int:
         return FLASH_MIN_T_DEFAULT
 
 
+def flash_win_table():
+    """Measured ((T, wins), ...) rows (utils/tuned.py FLASH_WIN_TABLE,
+    rewritten by flash_tpu_bench --apply-crossover), or () when no
+    capture has been applied."""
+    try:
+        from ..utils.tuned import FLASH_WIN_TABLE
+        return tuple(FLASH_WIN_TABLE)
+    except Exception:
+        return ()
+
+
+def _table_verdict(table, t: int):
+    """Kernel-vs-naive verdict for length ``t`` from the measured win
+    table, or None when the table has no say (empty, or ``t`` outside
+    its measured span — the threshold gate decides out-of-span lengths,
+    so the memory-regime fallback survives beyond the longest
+    measurement).  Within the span: an exact hit returns that row;
+    between two measured lengths the kernel is selected only when BOTH
+    neighbors won — hardware data is non-monotonic in T, and an
+    unmeasured interior length must not inherit a win across a loss."""
+    rows = sorted((int(T), bool(w)) for T, w in table)
+    if not rows or t < rows[0][0] or t > rows[-1][0]:
+        return None
+    below = above = None
+    for T, w in rows:
+        if T <= t:
+            below = (T, w)
+        if T >= t and above is None:
+            above = (T, w)
+    if below[0] == t:
+        return below[1]
+    return below[1] and above[1]
+
+
 def flash_wins(t: int) -> bool:
     """Length-gated kernel selection for ``flash=None`` callers doing
     FULL local attention (vit@197, lm@2k): pick the Pallas kernel only
-    where it beats (or memory-obsoletes) naive XLA attention — on TPU at
-    ``t >= flash_min_t()``.  Blockwise callers (ring attention) keep
-    selecting the kernel directly: their per-block lse-merge and O(T*d)
-    footprint are the point, not raw single-block speed."""
-    return flash_is_default() and t >= flash_min_t()
+    where measurement says it beats (or memory-obsoletes) naive XLA
+    attention.  Layered: the NNS_TPU_FLASH_MIN_T operator override is a
+    plain threshold; otherwise the measured per-length win table
+    (FLASH_WIN_TABLE) decides inside its span — the r5 hardware data is
+    non-monotonic (win@2k/8k, loss@16k), which a threshold cannot
+    express — and the FLASH_MIN_T threshold decides outside it.
+    Blockwise callers (ring attention) keep selecting the kernel
+    directly: their per-block lse-merge and O(T*d) footprint are the
+    point, not raw single-block speed."""
+    if not flash_is_default():
+        return False
+    env = _env_min_t()
+    if env is not None:
+        return t >= env
+    verdict = _table_verdict(flash_win_table(), t)
+    if verdict is not None:
+        return verdict
+    return t >= flash_min_t()
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, max_ref,

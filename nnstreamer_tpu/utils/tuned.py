@@ -57,3 +57,21 @@ FLASH_MIN_T_PROVENANCE = (
     "captured length (0.81x@2k, 0.95x@8k), kernel kept only for the "
     "O(T*d) memory regime; awaiting a healthy-window proof capture "
     "(r5 loop applies the measured crossover automatically)")
+
+#: Measured per-length kernel-vs-naive outcomes, ``((T, wins), ...)``
+#: sorted by T, from the same proof timings as FLASH_MIN_T.  The
+#: hardware data is NOT monotonic in T (r5: win@2k, win@8k, loss@16k
+#: under un-tuned long-T tiles), which a single threshold cannot
+#: express — within the table's measured span ``flash_wins`` routes by
+#: this evidence (exact hit: that row; between rows: the kernel only
+#: when BOTH neighbors won); outside the span the FLASH_MIN_T
+#: threshold gate still decides, preserving the memory-regime fallback
+#: beyond the longest measurement.  Rows where the kernel itself
+#: errored record ``wins=False``; naive-path failures that look like
+#: transient infra (not device capacity) contribute no row.  Applied
+#: with ``flash_tpu_bench --apply-crossover``.
+FLASH_WIN_TABLE = ((2048,True),(8192,True),(16384,False),)
+
+FLASH_WIN_TABLE_PROVENANCE = (
+    "measured: BENCH_flash_r05.json \u2014 2048:1.365x, 8192:1.011x, 16384:0.795x, 32768:no-evidence; TPU v5 lite0; applied by flash_tpu_bench --apply-crossover"
+)

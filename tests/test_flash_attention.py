@@ -183,9 +183,10 @@ class TestLengthGatedSelection:
 
         monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
         monkeypatch.setattr(fa, "flash_is_default", lambda: True)
-        # pin the measured record: the live tuned.py value moves with
+        # pin the measured records: the live tuned.py values move with
         # each applied capture, the GATE semantics must not
         monkeypatch.setattr(tuned, "FLASH_MIN_T", 16384)
+        monkeypatch.setattr(tuned, "FLASH_WIN_TABLE", ())
         assert not fa.flash_wins(197)      # vit
         assert not fa.flash_wins(2048)     # lm prefill
         assert not fa.flash_wins(8192)
@@ -202,6 +203,7 @@ class TestLengthGatedSelection:
         monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
         monkeypatch.setattr(fa, "flash_is_default", lambda: True)
         monkeypatch.setattr(tuned, "FLASH_MIN_T", 2048)
+        monkeypatch.setattr(tuned, "FLASH_WIN_TABLE", ())
         assert fa.flash_wins(2048)
         assert not fa.flash_wins(2047)
 
@@ -219,6 +221,58 @@ class TestLengthGatedSelection:
         assert fa.flash_wins(2048)
         monkeypatch.setenv("NNS_TPU_FLASH_MIN_T", "65536")
         assert not fa.flash_wins(32768)
+
+    def test_win_table_routes_nonmonotonic_lengths(self, monkeypatch):
+        """The r5 hardware data is non-monotonic (win@2k/8k, loss@16k
+        under un-tuned long-T tiles) — inside its measured span the
+        per-length table decides: exact hits take their row, interior
+        lengths take the kernel only when BOTH neighbors won."""
+        from nnstreamer_tpu.ops import flash_attention as fa
+        from nnstreamer_tpu.utils import tuned
+
+        monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
+        monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+        monkeypatch.setattr(tuned, "FLASH_MIN_T", 16384)
+        monkeypatch.setattr(
+            tuned, "FLASH_WIN_TABLE",
+            ((2048, True), (8192, True), (16384, False)))
+        assert fa.flash_wins(2048)       # exact measured win (lm@2k)
+        assert fa.flash_wins(8192)
+        assert not fa.flash_wins(16384)  # exact measured loss
+        assert fa.flash_wins(4096)       # interior, both neighbors won
+        assert not fa.flash_wins(12000)  # interior across the 16k loss
+
+    def test_win_table_out_of_span_falls_back_to_threshold(
+            self, monkeypatch):
+        """Outside the table's measured span the FLASH_MIN_T threshold
+        still decides — the memory-regime fallback (naive's O(T^2)
+        score matrix) must survive beyond the longest measurement, and
+        unmeasured short lengths must not inherit the 2k win."""
+        from nnstreamer_tpu.ops import flash_attention as fa
+        from nnstreamer_tpu.utils import tuned
+
+        monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
+        monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+        monkeypatch.setattr(tuned, "FLASH_MIN_T", 16384)
+        monkeypatch.setattr(
+            tuned, "FLASH_WIN_TABLE",
+            ((2048, True), (8192, True), (16384, False)))
+        assert not fa.flash_wins(197)    # below span: threshold says no
+        assert fa.flash_wins(32768)      # above span: memory regime
+        # an above-span length below the threshold stays naive
+        monkeypatch.setattr(
+            tuned, "FLASH_WIN_TABLE", ((1024, True), (2048, True)))
+        assert not fa.flash_wins(4096)
+
+    def test_env_override_beats_win_table(self, monkeypatch):
+        from nnstreamer_tpu.ops import flash_attention as fa
+        from nnstreamer_tpu.utils import tuned
+
+        monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+        monkeypatch.setattr(
+            tuned, "FLASH_WIN_TABLE", ((2048, False), (8192, False)))
+        monkeypatch.setenv("NNS_TPU_FLASH_MIN_T", "1024")
+        assert fa.flash_wins(2048)   # operator override wins over data
 
     def test_malformed_env_override_warns_and_falls_through(
             self, monkeypatch):
@@ -450,6 +504,25 @@ class TestMeasuredCrossover:
         ]
         assert tool.measured_crossover(timings) == 32768
 
+    def test_win_table_classification(self):
+        """measured_win_table: speedup>1 or naive capacity failure →
+        win; kernel error → loss (naive must serve that length); naive
+        infra flake → no row."""
+        tool = self._tool()
+        timings = [
+            {"T": 2048, "speedup": 1.365},
+            {"T": 8192, "speedup": 1.011},
+            {"T": 12288, "error": "Mosaic compile failure"},
+            {"T": 16384, "speedup": 0.795},
+            {"T": 24576, "flash_only": True,
+             "naive_error": "HTTP 500: tpu_compile_helper"},
+            {"T": 32768, "flash_only": True,
+             "naive_error": "RESOURCE_EXHAUSTED"},
+        ]
+        assert tool.measured_win_table(timings) == (
+            (2048, True), (8192, True), (12288, False),
+            (16384, False), (32768, True))
+
     def test_all_losses_is_none(self):
         tool = self._tool()
         assert tool.measured_crossover(
@@ -514,30 +587,89 @@ class TestMeasuredCrossover:
             str(artifact), tuned_path=str(tuned_copy)) == 0
         new = tuned_copy.read_text()
         assert "FLASH_MIN_T = 2048" in new
+        # the same apply writes the per-length evidence table
+        assert ("FLASH_WIN_TABLE = "
+                "((2048,True),(8192,True),(32768,True),)") in new
         assert "proof.json" in new
         compile(new, "tuned.py", "exec")
         # idempotent re-apply (the loop re-runs it every iteration)
         assert tool.apply_crossover_from_artifact(
             str(artifact), tuned_path=str(tuned_copy)) == 0
 
-    def test_apply_crossover_refuses_not_ok_or_null(self, tmp_path):
+    def test_apply_gates_on_checks_ok_not_timing_survival(self, tmp_path):
+        """A kernel error while TIMING a length fails the proof's
+        overall `ok` but is itself evidence (a loss at that length);
+        with the correctness/grad checks green (checks_ok), the apply
+        must persist the capture's evidence — including the loss row —
+        instead of refusing the whole window."""
         import json
+        import re
+
+        tool = self._tool()
+        tuned_copy = self._tuned_copy(tmp_path)
+        min_t_line = re.search(
+            r"FLASH_MIN_T = \d+", tuned_copy.read_text()).group(0)
+        a = tmp_path / "timingerr.json"
+        a.write_text(json.dumps(self._proof_row(
+            ok=False, checks_ok=True,
+            timings=[{"T": 2048, "speedup": 1.2},
+                     {"T": 16384, "error": "Mosaic compile failure"}]))
+            + "\n")
+        assert tool.apply_crossover_from_artifact(
+            str(a), tuned_path=str(tuned_copy)) == 0
+        new = tuned_copy.read_text()
+        assert "FLASH_WIN_TABLE = ((2048,True),(16384,False),)" in new
+        assert "16384:kernel-error" in new
+        # the loss breaks the win suffix: threshold untouched
+        assert min_t_line in new
+        compile(new, "tuned.py", "exec")
+
+    def test_apply_is_atomic_when_threshold_rewrite_fails(self, tmp_path):
+        """Both records land in one write: if the FLASH_MIN_T rewrite
+        cannot match (mangled record), the already-computed win table
+        must NOT have been written either."""
+        import json
+        import re
+
+        tool = self._tool()
+        tuned_copy = self._tuned_copy(tmp_path)
+        mangled = re.sub(r"FLASH_MIN_T = \d+", "FLASH_MIN_T = None",
+                         tuned_copy.read_text())
+        tuned_copy.write_text(mangled)
+        a = tmp_path / "proof.json"
+        a.write_text(json.dumps(self._proof_row()) + "\n")
+        assert tool.apply_crossover_from_artifact(
+            str(a), tuned_path=str(tuned_copy)) == 1
+        assert tuned_copy.read_text() == mangled
+
+    def test_apply_crossover_refuses_not_ok_keeps_threshold_on_null(
+            self, tmp_path):
+        import json
+        import re
 
         tool = self._tool()
         tuned_copy = self._tuned_copy(tmp_path)
         before = tuned_copy.read_text()
-        # a run whose kernel mis-computed must not set the default
+        min_t_line = re.search(r"FLASH_MIN_T = \d+", before).group(0)
+        # a run whose kernel mis-computed must not set any default
         a1 = tmp_path / "notok.json"
         a1.write_text(json.dumps(self._proof_row(ok=False)) + "\n")
         assert tool.apply_crossover_from_artifact(
             str(a1), tuned_path=str(tuned_copy)) == 1
-        # kernel lost even at the longest length: fallback stands
-        # (crossover recomputed from timings, not the stored field)
+        assert tuned_copy.read_text() == before
+        # kernel lost at every measured length: no unbroken win suffix,
+        # so the fallback THRESHOLD stands (crossover recomputed from
+        # timings, not the stored field) — but the losses are still
+        # evidence, and the win table pins those lengths to naive
         a2 = tmp_path / "nullx.json"
         a2.write_text(json.dumps(self._proof_row(
             crossover_T=2048,
             timings=[{"T": 2048, "speedup": 0.8},
                      {"T": 8192, "speedup": 0.9}])) + "\n")
         assert tool.apply_crossover_from_artifact(
-            str(a2), tuned_path=str(tuned_copy)) == 1
-        assert tuned_copy.read_text() == before
+            str(a2), tuned_path=str(tuned_copy)) == 0
+        new = tuned_copy.read_text()
+        assert min_t_line in new
+        assert "FLASH_WIN_TABLE = ((2048,False),(8192,False),)" in new
+        assert "nullx.json" in new
+        compile(new, "tuned.py", "exec")

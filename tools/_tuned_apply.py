@@ -34,33 +34,43 @@ def default_tuned_path() -> str:
         "nnstreamer_tpu", "utils", "tuned.py")
 
 
-def rewrite_tuned(value_pattern: str, value_repl: str,
-                  provenance_var: str, provenance: str,
-                  tuned_path: str = None) -> bool:
-    """Rewrite one value line (regex `value_pattern` -> literal
-    `value_repl`) and its provenance block in tuned.py.  Returns False
-    (with stderr detail) when either pattern is missing — a silent
-    partial rewrite would make the provenance lie."""
+def rewrite_tuned_many(specs, tuned_path: str = None) -> bool:
+    """Rewrite several (value_pattern, value_repl, provenance_var,
+    provenance) records in tuned.py ATOMICALLY: every substitution is
+    applied to an in-memory copy and the file is written only when all
+    of them matched — a partial rewrite (some records updated, the
+    failing one not) would make the provenance lie.  Returns False with
+    stderr detail on the first missing pattern."""
     if tuned_path is None:
         tuned_path = default_tuned_path()
     with open(tuned_path) as fh:
         src = fh.read()
-    src, n_val = re.subn(value_pattern, lambda _m: value_repl, src,
-                         count=1)
-    if not n_val:
-        print(f"apply: {value_pattern!r} not found in tuned.py",
-              file=sys.stderr)
-        return False
-    # matches both the hand-written block ('")' on the last string
-    # line) and a previously-applied one (')' on its own line)
-    src, n_prov = re.subn(
-        provenance_var + r' = \((?:\n    "[^"]*")+\n?\)',
-        lambda _m: (provenance_var + " = (\n    "
-                    + json.dumps(provenance) + "\n)"), src, count=1)
-    if not n_prov:
-        print(f"apply: {provenance_var} block not found in tuned.py",
-              file=sys.stderr)
-        return False
+    for value_pattern, value_repl, provenance_var, provenance in specs:
+        src, n_val = re.subn(value_pattern, lambda _m: value_repl, src,
+                             count=1)
+        if not n_val:
+            print(f"apply: {value_pattern!r} not found in tuned.py",
+                  file=sys.stderr)
+            return False
+        # matches both the hand-written block ('")' on the last string
+        # line) and a previously-applied one (')' on its own line)
+        src, n_prov = re.subn(
+            provenance_var + r' = \((?:\n    "[^"]*")+\n?\)',
+            lambda _m: (provenance_var + " = (\n    "
+                        + json.dumps(provenance) + "\n)"), src, count=1)
+        if not n_prov:
+            print(f"apply: {provenance_var} block not found in tuned.py",
+                  file=sys.stderr)
+            return False
     with open(tuned_path, "w") as fh:
         fh.write(src)
     return True
+
+
+def rewrite_tuned(value_pattern: str, value_repl: str,
+                  provenance_var: str, provenance: str,
+                  tuned_path: str = None) -> bool:
+    """Single-record form of rewrite_tuned_many."""
+    return rewrite_tuned_many(
+        [(value_pattern, value_repl, provenance_var, provenance)],
+        tuned_path)

@@ -148,6 +148,26 @@ def _naive_infeasible(err: str) -> bool:
     return any(m in (err or "") for m in _NAIVE_INFEASIBLE_MARKERS)
 
 
+def _row_evidence(row):
+    """Single classification of one timing row, shared by the
+    crossover, the win table, and the provenance stamp (three consumers
+    of one rule set must not drift): returns (verdict, label) where
+    verdict is True (kernel wins: speedup > 1, or naive hit a DEVICE
+    capacity wall while the kernel ran), False (kernel loses: measured
+    slower, or the kernel itself errored — naive has to serve that
+    length), or None (no evidence: naive failed for reasons that read
+    like transient infra, not capacity)."""
+    t = row.get("T")
+    if row.get("error"):
+        return False, "%s:kernel-error" % t
+    if row.get("flash_only"):
+        if _naive_infeasible(row.get("naive_error", "")):
+            return True, "%s:naive-oom" % t
+        return None, "%s:no-evidence" % t
+    wins = row.get("speedup", 0) > 1.0
+    return wins, "%s:%sx" % (t, row.get("speedup"))
+
+
 def measured_crossover(timings):
     """Kernel-vs-naive crossover with SUFFIX-WIN semantics: the smallest
     measured T such that the kernel wins (speedup > 1, or the naive
@@ -163,15 +183,26 @@ def measured_crossover(timings):
     longest measured length loses."""
     crossover = None
     for row in reversed(timings):
-        if row.get("flash_only") and not _naive_infeasible(
-                row.get("naive_error", "")):
+        verdict, _ = _row_evidence(row)
+        if verdict is None:
             continue
-        wins = (row.get("flash_only")
-                or row.get("speedup", 0) > 1.0)
-        if not wins:
+        if not verdict:
             break
         crossover = row["T"]
     return crossover
+
+
+def measured_win_table(timings):
+    """Per-length ((T, wins), ...) evidence rows for the FLASH_WIN_TABLE
+    record — the non-monotonic complement to the suffix-win threshold.
+    Classification is _row_evidence's; evidence-free rows contribute
+    nothing."""
+    rows = []
+    for row in timings:
+        verdict, _ = _row_evidence(row)
+        if verdict is not None:
+            rows.append((int(row["T"]), verdict))
+    return tuple(sorted(rows))
 
 
 def main() -> int:
@@ -266,6 +297,12 @@ def main() -> int:
                             "ok": passed})
         ok = ok and passed
 
+    # correctness + grad checks are done: snapshot their verdict before
+    # the timing loop — a kernel error while TIMING a length is evidence
+    # (a loss at that length, recorded in the row) and fails the overall
+    # `ok`, but must not impeach the math the checks proved, so the
+    # appliers gate on `checks_ok`
+    checks_ok = ok
     timings = []
     speedup = 0.0
     for t, h, d in TIME_SHAPES:
@@ -302,7 +339,8 @@ def main() -> int:
     crossover = measured_crossover(timings)
     print(json.dumps({"metric": "flash_attention_tpu_proof",
                       "value": round(speedup, 3), "unit": "x_vs_naive",
-                      "ok": ok, "crossover_T": crossover,
+                      "ok": ok, "checks_ok": checks_ok,
+                      "crossover_T": crossover,
                       "checks": checks,
                       "grad_checks": grad_checks, "timings": timings,
                       "device": str(dev)}), flush=True)
@@ -348,47 +386,58 @@ def apply_tiles_from_artifact(path: str, tuned_path: str = None) -> int:
 
 def apply_crossover_from_artifact(path: str, tuned_path: str = None) -> int:
     """--apply-crossover <proof.json>: rewrite utils/tuned.py's
-    FLASH_MIN_T from a green flash-proof capture, provenance-stamped.
-    Requires the row to be fully ok (every correctness and grad check
-    passed — a selection default must not come from a run whose kernel
-    mis-computed) and its timings to yield a non-null suffix-win
-    crossover (recomputed here, NOT read from the stored crossover_T
-    field, so artifacts written under older crossover semantics apply
-    correctly; a null crossover means the kernel lost even at the
-    longest measured length, and the memory-regime fallback default
-    stands).  Exit 1 otherwise."""
-    from _tuned_apply import load_last_row, rewrite_tuned
+    kernel-selection records from a green flash-proof capture,
+    provenance-stamped.  Requires the row to be fully ok (every
+    correctness and grad check passed — a selection default must not
+    come from a run whose kernel mis-computed) and at least one timing
+    row with evidence.  Always writes the per-length FLASH_WIN_TABLE
+    (the hardware data is non-monotonic in T, which a threshold cannot
+    express); additionally rewrites the FLASH_MIN_T threshold when the
+    timings yield a non-null suffix-win crossover (recomputed here, NOT
+    read from the stored crossover_T field, so artifacts written under
+    older crossover semantics apply correctly; a null crossover means
+    no unbroken win suffix, and the out-of-span fallback threshold
+    stands).  Both records land in ONE atomic write (a partial rewrite
+    would make the provenance lie).  The check gate is ``checks_ok``
+    (correctness + grad checks) where the artifact carries it — a
+    kernel error in a TIMING row is itself evidence (a loss at that
+    length), not a reason to refuse the capture's other lengths; old
+    artifacts without checks_ok fall back to the stricter ``ok``.
+    Exit 1 when there is nothing applicable."""
+    from _tuned_apply import load_last_row, rewrite_tuned_many
 
     row = load_last_row(
         path, "flash_attention_tpu_proof",
-        pred=lambda r: (r.get("ok")
-                        and measured_crossover(r.get("timings", []))))
+        pred=lambda r: (r.get("checks_ok", r.get("ok"))
+                        and measured_win_table(r.get("timings", []))))
     if row is None:
-        print(f"apply-crossover: no fully-ok proof row with a non-null "
-              f"suffix-win crossover in {path}", file=sys.stderr)
+        print(f"apply-crossover: no checks-ok proof row with timing "
+              f"evidence in {path}", file=sys.stderr)
         return 1
-    t = int(measured_crossover(row["timings"]))
-    wins = []
-    for r in row.get("timings", []):
-        if "error" in r:
-            continue
-        if r.get("flash_only"):
-            wins.append("%s:%s" % (
-                r["T"], "naive-oom" if _naive_infeasible(
-                    r.get("naive_error", "")) else "no-evidence"))
-        else:
-            wins.append("%s:%sx" % (r["T"], r.get("speedup")))
-    provenance = (
-        f"measured: {os.path.basename(path)} — suffix-win crossover at "
-        f"T={t} ({', '.join(wins)}; {row.get('device', '?')}); applied "
-        "by flash_tpu_bench --apply-crossover")
-    if not rewrite_tuned(r"FLASH_MIN_T = \d+",
-                         f"FLASH_MIN_T = {t}",
-                         "FLASH_MIN_T_PROVENANCE", provenance,
-                         tuned_path):
+    labels = [_row_evidence(r)[1] for r in row.get("timings", [])]
+    evidence = "%s; %s" % (", ".join(labels), row.get("device", "?"))
+    table = measured_win_table(row["timings"])
+    table_src = "(%s,)" % ",".join("(%d,%s)" % tw for tw in table)
+    specs = [(
+        r"FLASH_WIN_TABLE = \(.*\)",
+        f"FLASH_WIN_TABLE = {table_src}",
+        "FLASH_WIN_TABLE_PROVENANCE",
+        f"measured: {os.path.basename(path)} — {evidence}; applied "
+        "by flash_tpu_bench --apply-crossover")]
+    applied = {"applied_win_table": list(table)}
+    crossover = measured_crossover(row["timings"])
+    if crossover is not None:
+        t = int(crossover)
+        specs.append((
+            r"FLASH_MIN_T = \d+", f"FLASH_MIN_T = {t}",
+            "FLASH_MIN_T_PROVENANCE",
+            f"measured: {os.path.basename(path)} — suffix-win crossover "
+            f"at T={t} ({evidence}); applied by flash_tpu_bench "
+            "--apply-crossover"))
+        applied["applied_min_t"] = t
+    if not rewrite_tuned_many(specs, tuned_path):
         return 1
-    print(json.dumps({"applied_min_t": t, "provenance": provenance}),
-          flush=True)
+    print(json.dumps(applied), flush=True)
     return 0
 
 
