@@ -1032,15 +1032,21 @@ def _failure_row(config: str, error: str, cpu: bool = False) -> dict:
             "vs_baseline": base, "error": error, "device": "unavailable"}
 
 
-def _dead_tunnel_row(config: str, probe: dict, cpu: bool = False) -> dict:
-    row = _failure_row(
-        config,
-        f"link preprobe found tunnel dead in {probe.get('elapsed_s', 0)}s;"
-        f" backend init not attempted ({probe.get('detail', '')})", cpu)
+def _attach_cached_green(row: dict) -> dict:
+    """Attach the round's best committed green capture to a failure row
+    (single spot: every failure path must point the driver at committed
+    evidence, never an unexplained 0)."""
     cached = _cached_green(row["metric"])
     if cached:
         row["cached_green"] = cached
     return row
+
+
+def _dead_tunnel_row(config: str, probe: dict, cpu: bool = False) -> dict:
+    return _attach_cached_green(_failure_row(
+        config,
+        f"link preprobe found tunnel dead in {probe.get('elapsed_s', 0)}s;"
+        f" backend init not attempted ({probe.get('detail', '')})", cpu))
 
 
 def orchestrate(config: str, cpu: bool, deadline: float,
@@ -1073,6 +1079,24 @@ def orchestrate(config: str, cpu: bool, deadline: float,
         if rc is None:
             errors.append(f"attempt {attempt + 1}: killed after "
                           f"{deadline:.0f}s deadline (backend init hang?)")
+            # a deadline-killed TPU attempt is the mid-run-death
+            # signature (r5: a window closing UNDER a run left the
+            # child wedged in a device call with nothing on stdout) —
+            # re-probe the link for ~60 s before burning another full
+            # deadline on a tunnel that is already gone
+            if not cpu and env.get("JAX_PLATFORMS") != "cpu":
+                probe = _tunnel_preprobe()
+                if not probe.get("ok"):
+                    row = _attach_cached_green(_failure_row(
+                        config,
+                        f"tunnel died mid-run: attempt {attempt + 1} "
+                        f"killed at the {deadline:.0f}s deadline and the "
+                        f"re-probe found the link dead in "
+                        f"{probe.get('elapsed_s', 0)}s "
+                        f"({probe.get('detail', '')}); "
+                        + "; ".join(errors)[-600:], cpu))
+                    row["tunnel_dead"] = True
+                    return row
         else:
             tail = (err or out or "").strip().splitlines()
             errors.append(f"attempt {attempt + 1}: rc={rc} "
@@ -1083,7 +1107,10 @@ def orchestrate(config: str, cpu: bool, deadline: float,
             spent = time.monotonic() - t0
             time.sleep(min(30.0, 5.0 * (attempt + 1)) if spent < 60 else 1.0)
     # failure lines keep the same unit/baseline schema as success lines
-    return _failure_row(config, "; ".join(errors)[-1500:], cpu)
+    # (with the round's best committed green capture attached, so the
+    # driver artifact is never an unexplained 0)
+    return _attach_cached_green(
+        _failure_row(config, "; ".join(errors)[-1500:], cpu))
 
 
 def main() -> None:
@@ -1137,17 +1164,34 @@ def main() -> None:
                       flush=True)
             return
 
+    # once any orchestration reports the tunnel dying mid-run, later
+    # configs re-check the cheap gate instead of burning a deadline each
+    tunnel_suspect = False
+
+    def _gated(config, stream_batch=0):
+        nonlocal tunnel_suspect
+        on_tpu = not args.cpu and os.environ.get("JAX_PLATFORMS") != "cpu"
+        if tunnel_suspect and on_tpu:
+            probe = _tunnel_preprobe()
+            if not probe.get("ok"):
+                return _dead_tunnel_row(config, probe)
+            tunnel_suspect = False
+        result = orchestrate(config, args.cpu, args.deadline,
+                             args.retries, stream_batch=stream_batch)
+        if result.get("tunnel_dead"):
+            tunnel_suspect = True
+        return result
+
     if sweep_sizes:
         for b in sweep_sizes:
-            result = orchestrate(args.config, args.cpu, args.deadline,
-                                 args.retries, stream_batch=b)
+            result = _gated(args.config, stream_batch=b)
             result["stream_batch"] = b
             print(json.dumps(result), flush=True)
         return
 
     configs = tuple(CONFIG_METRICS) if args.all else (args.config,)
     for config in configs:
-        result = orchestrate(config, args.cpu, args.deadline, args.retries)
+        result = _gated(config)
         if args.cpu and "error" not in result:
             result["metric"] += "_cpu"
         print(json.dumps(result), flush=True)

@@ -33,12 +33,63 @@ def test_orchestrate_emits_error_json_after_retries(monkeypatch):
 
     monkeypatch.setattr(bench, "_run_bounded", fake_run)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    # the link stays alive: deadline kills are slow runs, not a dead
+    # tunnel, so every retry is spent
+    monkeypatch.setattr(bench, "_tunnel_preprobe", lambda: {"ok": True})
     r = bench.orchestrate("mobilenet", cpu=False, deadline=1, retries=2)
     assert len(calls) == 3
     assert r["value"] == 0 and r["vs_baseline"] == 0
     assert r["metric"] == bench.CONFIG_METRICS["mobilenet"]
     assert "deadline" in r["error"]
+    # even the all-retries-burned row points at committed green evidence
+    assert r.get("cached_green", {}).get("value", 0) > 0
     json.dumps(r)                  # always serializable
+
+
+def test_orchestrate_midrun_tunnel_death_short_circuits(monkeypatch):
+    """r5 failure mode: the window closed UNDER a running capture — the
+    child wedged in a device call, printed nothing, and the parent
+    burned retries x deadline until the loop's outer SIGKILL erased all
+    output.  A deadline-killed attempt must re-probe the link and stop
+    immediately when it is dead, with a row that says so."""
+    calls = []
+
+    def fake_run(cmd, env, deadline):
+        calls.append(cmd)
+        return None, "", ""        # rc None = deadline kill
+
+    monkeypatch.setattr(bench, "_run_bounded", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        bench, "_tunnel_preprobe",
+        lambda: {"ok": False, "elapsed_s": 0.1, "detail": "probe dead"})
+    # the conftest pins JAX_PLATFORMS=cpu for the suite; this scenario
+    # is specifically the TPU path
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    r = bench.orchestrate("mobilenet", cpu=False, deadline=1, retries=2)
+    assert len(calls) == 1         # no second deadline burned
+    assert r["value"] == 0
+    assert "tunnel died mid-run" in r["error"]
+    # structured flag: --all / --sweep re-gate later configs on this,
+    # not on the human-readable error text
+    assert r.get("tunnel_dead") is True
+    assert r.get("cached_green", {}).get("value", 0) > 0
+    json.dumps(r)
+
+
+def test_orchestrate_cpu_kill_never_probes_tunnel(monkeypatch):
+    def fake_run(cmd, env, deadline):
+        return None, "", ""
+
+    monkeypatch.setattr(bench, "_run_bounded", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    def boom():
+        raise AssertionError("cpu path must not touch the tunnel probe")
+
+    monkeypatch.setattr(bench, "_tunnel_preprobe", boom)
+    r = bench.orchestrate("mobilenet", cpu=True, deadline=1, retries=0)
+    assert r["value"] == 0
 
 
 def test_orchestrate_recovers_on_retry(monkeypatch):

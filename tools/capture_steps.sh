@@ -10,8 +10,13 @@
 # multistream LM, the 3-mode int8/w8 proof, flash 16k/32k + tile tune,
 # and two runs within 20% on flagship/ssd/posenet.
 
+# budget arithmetic for the 900 s-capped bench steps: one attempt
+# (--retries 0: the LOOP is the retry) at deadline 720 + initial
+# preprobe (~30 s) + the post-kill re-probe (<=60 s) + margin < 900,
+# so a window dying UNDER a run still leaves a committed failure row
+# instead of being erased by the outer kill (r5 posenet_nopd lesson)
 capture flagship "BENCH_flagship_best_$ROUND.json" last 900 \
-  python bench.py --config mobilenet --deadline 800
+  python bench.py --config mobilenet --deadline 720 --retries 0
 capture flash "BENCH_flash_$ROUND.json" last 1200 \
   python tools/flash_tpu_bench.py
 # a post-tune re-measure must install even when it scores lower than
@@ -32,21 +37,21 @@ if _green "BENCH_flash_$ROUND.json" 2>/dev/null; then
     && log "flash crossover applied from BENCH_flash_$ROUND.json"
 fi
 capture all "BENCH_all_$ROUND.json" all 9000 \
-  python bench.py --all --deadline 780
+  python bench.py --all --deadline 780 --retries 0
 capture sweep "BENCH_sweep_$ROUND.json" all 3600 \
-  python bench.py --sweep-batch 32,64,128,256 --deadline 700
+  python bench.py --sweep-batch 32,64,128,256 --deadline 700 --retries 0
 # device-fused decode-tail DELTA (VERDICT r4 #1: the decode-on-device
 # claim needs an fps delta, not just oracle equality): same ssd/posenet
 # configs with the pushdown disabled — compare against the --all rows
 capture ssd_nopd "BENCH_ssd_nopushdown_$ROUND.json" last 900 \
-  env NNS_TPU_BENCH_NO_PUSHDOWN=1 python bench.py --config ssd --deadline 780
+  env NNS_TPU_BENCH_NO_PUSHDOWN=1 python bench.py --config ssd --deadline 720 --retries 0
 capture posenet_nopd "BENCH_posenet_nopushdown_$ROUND.json" last 900 \
-  env NNS_TPU_BENCH_NO_PUSHDOWN=1 python bench.py --config posenet --deadline 780
+  env NNS_TPU_BENCH_NO_PUSHDOWN=1 python bench.py --config posenet --deadline 720 --retries 0
 # device-resident re-capture under the K-deep dispatch queue
 # (tensor_filter inflight=8, bench run_child default): the --all row
 # was measured double-buffered; this is the 1%-stream-MFU attempt
 capture resident "BENCH_resident_$ROUND.json" last 900 \
-  python bench.py --config resident --deadline 780
+  python bench.py --config resident --deadline 720 --retries 0
 capture int8 "BENCH_int8_$ROUND.json" last 1500 \
   python tools/tflite_int8_tpu_bench.py
 # data-derived quant default: a green 3-mode capture rewrites
