@@ -976,6 +976,18 @@ def _tunnel_preprobe(timeout: float = None) -> dict:
     return {"ok": False, "elapsed_s": elapsed, "detail": detail}
 
 
+def tunnel_gate():
+    """Cheap liveness gate for the capture tools (flash/int8 proofs):
+    None when the link is healthy — or the process is CPU-forced, where
+    no tunnel is involved — else the failed probe dict.  Without it a
+    proof launched just before a window closes hangs in backend init
+    until its full capture cap (int8: 25 min) with nothing on stdout."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return None
+    probe = _tunnel_preprobe()
+    return None if probe.get("ok") else probe
+
+
 def _cached_green(metric: str) -> dict:
     """Best committed green capture for `metric`, PREFERRING the newest
     round's artifacts (`..._r0N.json`): a dead-tunnel failure row must
@@ -1042,11 +1054,17 @@ def _attach_cached_green(row: dict) -> dict:
     return row
 
 
+def dead_link_error(probe: dict) -> str:
+    """One place owns the dead-tunnel message format — bench failure
+    rows and every proof tool's red row quote the same string."""
+    return (f"link preprobe found tunnel dead in "
+            f"{probe.get('elapsed_s', 0)}s ({probe.get('detail', '')})")
+
+
 def _dead_tunnel_row(config: str, probe: dict, cpu: bool = False) -> dict:
     return _attach_cached_green(_failure_row(
-        config,
-        f"link preprobe found tunnel dead in {probe.get('elapsed_s', 0)}s;"
-        f" backend init not attempted ({probe.get('detail', '')})", cpu))
+        config, dead_link_error(probe) + "; backend init not attempted",
+        cpu))
 
 
 def orchestrate(config: str, cpu: bool, deadline: float,

@@ -188,6 +188,47 @@ def test_pbtxt_duplicate_explicit_index_errors():
             "appsrc name=a ! mux.sink_0 appsrc name=b ! mux.sink_0")
 
 
+TOOLS = os.path.abspath(os.path.join(os.path.dirname(__file__), "..",
+                                     "tools"))
+
+
+class TestProofToolTunnelGate:
+    """The proof tools must fail a dead tunnel in ~one preprobe timeout
+    with a red row on stdout, never hang out their capture cap in
+    backend init (r5: a window closing between steps left the int8
+    proof wedged for its full 25 min)."""
+
+    def _run(self, argv):
+        import json as _json
+        import time as _time
+
+        env = dict(os.environ)
+        env["NNS_TPU_BENCH_PREPROBE_CMD"] = "false"   # dead link
+        env["NNS_TPU_BENCH_PREPROBE_TIMEOUT"] = "2"
+        env.pop("JAX_PLATFORMS", None)
+        t0 = _time.monotonic()
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=90, env=env,
+                             cwd=os.path.dirname(TOOLS))
+        assert _time.monotonic() - t0 < 30
+        row = _json.loads(out.stdout.strip().splitlines()[-1])
+        assert row["value"] == 0 and "preprobe" in row["error"]
+        assert out.returncode == 2
+        return row
+
+    def test_flash_proof_gates(self):
+        self._run([sys.executable,
+                   os.path.join(TOOLS, "flash_tpu_bench.py")])
+
+    def test_flash_tune_gates(self):
+        self._run([sys.executable,
+                   os.path.join(TOOLS, "flash_tpu_bench.py"), "--tune"])
+
+    def test_int8_proof_gates(self):
+        self._run([sys.executable,
+                   os.path.join(TOOLS, "tflite_int8_tpu_bench.py")])
+
+
 @pytest.fixture(scope="module")
 def probe_out():
     import tunnel_probe

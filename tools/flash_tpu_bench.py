@@ -60,8 +60,14 @@ def tune() -> int:
     ranking the tile shapes — run in a healthy TPU window to pick kernel
     defaults (the 128x128 default matches the MXU but bigger K tiles cut
     grid-iteration overhead when VMEM allows)."""
-    from bench import _enable_compile_cache
+    from bench import _enable_compile_cache, dead_link_error, tunnel_gate
 
+    dead = tunnel_gate()
+    if dead:
+        print(json.dumps({
+            "metric": "flash_tile_tune", "value": 0,
+            "error": dead_link_error(dead)}), flush=True)
+        return 2
     _enable_compile_cache()
     import jax
     import jax.numpy as jnp
@@ -206,9 +212,16 @@ def measured_win_table(timings):
 
 
 def main() -> int:
-    import jax
+    from bench import _enable_compile_cache, dead_link_error, tunnel_gate
 
-    from bench import _enable_compile_cache
+    dead = tunnel_gate()
+    if dead:
+        print(json.dumps({
+            "metric": "flash_attention_tpu_proof", "value": 0,
+            "unit": "x_vs_naive", "ok": False,
+            "error": dead_link_error(dead)}), flush=True)
+        return 2
+    import jax
 
     _enable_compile_cache()
 

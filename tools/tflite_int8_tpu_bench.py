@@ -66,9 +66,16 @@ def _bench(fw, x):
 
 
 def main() -> int:
-    import jax
+    from bench import _enable_compile_cache, dead_link_error, tunnel_gate
 
-    from bench import _enable_compile_cache
+    dead = tunnel_gate()
+    if dead:
+        print(json.dumps({
+            "metric": "tflite_quant_native_tpu", "value": 0,
+            "unit": "x_vs_emulation", "ok": False,
+            "error": dead_link_error(dead)}), flush=True)
+        return 2
+    import jax
 
     _enable_compile_cache()
 
