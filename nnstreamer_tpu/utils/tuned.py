@@ -34,7 +34,10 @@ QUANT_AUTO_PROVENANCE = (
 #: on TPU, measured by tools/flash_tpu_bench.py --tune at T=8192 and
 #: applied with --tune --apply.  Used only when both sequence lengths
 #: cover the tile (short sequences keep the 128x128 MXU-shaped default
-#: so tiny inputs don't pad up to a giant tile).
+#: so tiny inputs don't pad up to a giant tile).  While this record is
+#: still un-measured, sequences at/above FLASH_LONG_T take the
+#: grid-overhead-scaled FLASH_LONG_TILES default instead
+#: (ops/flash_attention.py _default_tiles).
 FLASH_TILES = (128, 128)
 
 FLASH_TILES_PROVENANCE = (
