@@ -542,13 +542,24 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _default_tiles(t_q: int, t_kv: int, interpret: bool):
-    """Tile defaults: the measured (tuned) shape on real TPU when both
-    lengths cover it; the 128x128 MXU-shaped default otherwise (a tiny
-    input must not pad up to a giant tuned tile — and the interpreter
-    has no tuned data)."""
+    """Tile defaults, measured-data first (the 16k grid-overhead lesson:
+    at (128, 128) a causal 16k forward is h·128·128 ≈ 131k Mosaic grid
+    steps ≈ 50 ms of pure dispatch — the measured 0.795× loss — while
+    both matmuls cost ~3 ms; the cure is fewer, larger tiles, but a tile
+    that never passed the on-chip gradcheck must not become the
+    custom_vjp default, so larger tiles ship only via measured records).
+    Precedence: the per-length FLASH_TILES_BY_T record (largest measured
+    length ≤ the sequence, when both lengths cover its tile), then the
+    legacy single FLASH_TILES record, then the 128x128 MXU-shaped
+    default (a tiny input must not pad up to a giant tuned tile — and
+    the interpreter has no tuned data)."""
     if not interpret:
-        from ..utils.tuned import FLASH_TILES
+        from ..utils.tuned import FLASH_TILES, FLASH_TILES_BY_T
 
+        t = max(t_q, t_kv)
+        for rec_t, bq, bk in sorted(FLASH_TILES_BY_T, reverse=True):
+            if t >= rec_t and t_q >= bq and t_kv >= bk:
+                return int(bq), int(bk)
         bq, bk = FLASH_TILES
         if t_q >= bq and t_kv >= bk:
             return int(bq), int(bk)

@@ -31,18 +31,28 @@ QUANT_AUTO_PROVENANCE = (
     "- awaiting a healthy-window 3-mode capture (r5 loop armed)")
 
 #: (block_q, block_k) the flash kernel defaults to for long sequences
-#: on TPU, measured by tools/flash_tpu_bench.py --tune at T=8192 and
-#: applied with --tune --apply.  Used only when both sequence lengths
-#: cover the tile (short sequences keep the 128x128 MXU-shaped default
-#: so tiny inputs don't pad up to a giant tile).  While this record is
-#: still un-measured, sequences at/above FLASH_LONG_T take the
-#: grid-overhead-scaled FLASH_LONG_TILES default instead
-#: (ops/flash_attention.py _default_tiles).
+#: on TPU, measured by tools/flash_tpu_bench.py --tune and applied with
+#: --tune --apply.  Used only when both sequence lengths cover the tile
+#: (short sequences keep the 128x128 MXU-shaped default so tiny inputs
+#: don't pad up to a giant tile).
 FLASH_TILES = (128, 128)
 
 FLASH_TILES_PROVENANCE = (
     "default (MXU-shaped 128x128); no healthy-window tile-tune capture "
     "applied yet (r5 loop runs flash_tpu_bench --tune each window)")
+
+#: Per-length measured tiles ``((T, block_q, block_k), ...)`` — the
+#: tune step sweeps each length in its TUNE_LENGTHS (8192 and 16384:
+#: the 16k grid-overhead loss is why long-T tiles differ) and each
+#: row ships only with an on-chip gradcheck at its winning tile.
+#: _default_tiles picks the largest measured length <= the sequence;
+#: lengths below every row fall back to FLASH_TILES.  Applied with
+#: ``flash_tpu_bench --tune --apply``.
+FLASH_TILES_BY_T = ()
+
+FLASH_TILES_BY_T_PROVENANCE = (
+    "no healthy-window tile-tune capture applied yet (r5 loop runs "
+    "flash_tpu_bench --tune each window)")
 
 #: Sequence-length threshold above which full-attention callers
 #: (``flash=None``) pick the Pallas flash kernel over naive XLA

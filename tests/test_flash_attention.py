@@ -377,6 +377,69 @@ class TestTunedTileDefaults:
         monkeypatch.setattr(tuned, "FLASH_TILES", (512, 512))
         assert _default_tiles(8192, 8192, interpret=True) == (128, 128)
 
+    def test_by_t_record_routes_per_length(self, monkeypatch):
+        """The per-length tile record (the tune step's 8k AND 16k
+        sweeps) takes precedence: the largest measured length <= the
+        sequence wins; lengths below every row fall back to the legacy
+        record / MXU default."""
+        from nnstreamer_tpu.ops import flash_attention as fa
+        from nnstreamer_tpu.utils import tuned
+
+        monkeypatch.setattr(tuned, "FLASH_TILES", (128, 128))
+        monkeypatch.setattr(tuned, "FLASH_TILES_BY_T",
+                            ((8192, 256, 256), (16384, 256, 512)))
+        assert fa._default_tiles(8192, 8192, interpret=False) \
+            == (256, 256)
+        assert fa._default_tiles(16384, 16384, interpret=False) \
+            == (256, 512)
+        # beyond the largest measured length: its tiles extend
+        assert fa._default_tiles(32768, 32768, interpret=False) \
+            == (256, 512)
+        # between rows: the largest measured length below wins
+        assert fa._default_tiles(12288, 12288, interpret=False) \
+            == (256, 256)
+        # below every row: legacy/MXU default (2k measured a WIN at
+        # (128,128) — don't disturb it)
+        assert fa._default_tiles(2048, 2048, interpret=False) \
+            == (128, 128)
+        # a q block too small for a row's tile falls down the list
+        assert fa._default_tiles(64, 32768, interpret=False) \
+            == (128, 128)
+        # interpret has no tuned data
+        assert fa._default_tiles(16384, 16384, interpret=True) \
+            == (128, 128)
+
+    def test_long_tiles_interpret_correctness_and_grad(self):
+        """The asymmetric long-T tune candidate (256, 512) must be
+        numerically correct through forward AND backward with MULTIPLE
+        K blocks and a padded tail (interpret validates the tile
+        plumbing; VMEM feasibility at depth is the on-chip tune
+        gradcheck's job)."""
+        t, h, d = 1088, 1, 32   # pads to 1536: 3 K blocks, masked tail
+        q, k, v = _qkv(t, h, d, seed=77)
+        bq, bk = 256, 512
+        got = flash_attention(q, k, v, causal=True, block_q=bq,
+                              block_k=bk, interpret=True)
+        want = flash_attention(q, k, v, causal=True, block_q=128,
+                               block_k=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=1e-5)
+
+        def loss(fn_blocks, q, k, v):
+            bq_, bk_ = fn_blocks
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, block_q=bq_, block_k=bk_,
+                interpret=True) ** 2)
+
+        import functools
+        g_long = jax.grad(functools.partial(loss, (bq, bk)),
+                          argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(functools.partial(loss, (128, 128)),
+                         argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_long, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=1e-4)
+
     def test_explicit_blocks_still_win(self):
         # callers passing block_q/block_k keep exact control (the tests
         # above all pass explicit tiles; spot-check the plumbing)
@@ -417,6 +480,87 @@ class TestTunedTileDefaults:
         # idempotent re-apply
         assert tool.apply_tiles_from_artifact(
             str(artifact), tuned_path=str(tuned_copy)) == 0
+
+    def test_apply_multilength_tune_writes_by_t(self, tmp_path):
+        """A two-length tune artifact ships a FLASH_TILES_BY_T row per
+        valid length; the legacy FLASH_TILES record follows the first
+        length's winner."""
+        import json
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import flash_tpu_bench as tool
+
+        artifact = tmp_path / "tune2.json"
+        artifact.write_text(json.dumps({
+            "metric": "flash_tile_tune", "value": 1.8,
+            "best": {"block_q": 256, "block_k": 256, "ms": 10.0},
+            "grad_ok": True, "default_ms": 15.0,
+            "lengths": [
+                {"t": 8192, "best": {"block_q": 256, "block_k": 256,
+                                     "ms": 10.0},
+                 "grad_ok": True, "default_ms": 15.0, "speedup": 1.5},
+                {"t": 16384, "best": {"block_q": 256, "block_k": 512,
+                                      "ms": 30.0},
+                 "grad_ok": True, "default_ms": 54.0, "speedup": 1.8},
+            ], "device": "TPU_0"}) + "\n")
+        tuned_copy = tmp_path / "tuned.py"
+        tuned_copy.write_text(open(os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "nnstreamer_tpu",
+            "utils", "tuned.py")).read())
+        assert tool.apply_tiles_from_artifact(
+            str(artifact), tuned_path=str(tuned_copy)) == 0
+        new = tuned_copy.read_text()
+        assert ("FLASH_TILES_BY_T = "
+                "((8192,256,256),(16384,256,512),)") in new
+        assert "FLASH_TILES = (256, 256)" in new
+        assert "tune2.json" in new
+        compile(new, "tuned.py", "exec")
+        # idempotent re-apply
+        assert tool.apply_tiles_from_artifact(
+            str(artifact), tuned_path=str(tuned_copy)) == 0
+
+    def test_apply_multilength_skips_gradfailed_length(self, tmp_path):
+        """A length whose winner failed its gradcheck must not ship —
+        but it must not block the other length's valid row either."""
+        import json
+        import os
+        import re
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import flash_tpu_bench as tool
+
+        artifact = tmp_path / "tune3.json"
+        artifact.write_text(json.dumps({
+            "metric": "flash_tile_tune", "value": 1.8,
+            "best": {"block_q": 512, "block_k": 1024, "ms": 9.0},
+            "grad_ok": False, "default_ms": 15.0,
+            "lengths": [
+                {"t": 8192, "best": {"block_q": 512, "block_k": 1024,
+                                     "ms": 9.0, "grad_error": "VMEM"},
+                 "grad_ok": False, "default_ms": 15.0, "speedup": 1.7},
+                {"t": 16384, "best": {"block_q": 256, "block_k": 512,
+                                      "ms": 30.0},
+                 "grad_ok": True, "default_ms": 54.0, "speedup": 1.8},
+            ], "device": "TPU_0"}) + "\n")
+        tuned_copy = tmp_path / "tuned.py"
+        src = open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "nnstreamer_tpu", "utils",
+            "tuned.py")).read()
+        tuned_copy.write_text(src)
+        tiles_line = re.search(r"FLASH_TILES = \(\d+, \d+\)",
+                               src).group(0)
+        assert tool.apply_tiles_from_artifact(
+            str(artifact), tuned_path=str(tuned_copy)) == 0
+        new = tuned_copy.read_text()
+        assert "FLASH_TILES_BY_T = ((16384,256,512),)" in new
+        # first length invalid -> legacy record untouched
+        assert tiles_line in new
+        compile(new, "tuned.py", "exec")
 
     def test_apply_refuses_tune_without_baseline_or_gradcheck(
             self, tmp_path):

@@ -66,7 +66,7 @@ capture flashtune "BENCH_flashtune_$ROUND.json" last 1800 \
 # utils/tuned.py FLASH_TILES (provenance-stamped)
 if _green "BENCH_flashtune_$ROUND.json" 2>/dev/null; then
   _tiles_before=$(python -c \
-    "from nnstreamer_tpu.utils.tuned import FLASH_TILES as t; print(t)")
+    "from nnstreamer_tpu.utils import tuned as t; print(t.FLASH_TILES, t.FLASH_TILES_BY_T)")
   if python tools/flash_tpu_bench.py --tune --apply \
       "BENCH_flashtune_$ROUND.json"; then
     log "flash tiles applied from BENCH_flashtune_$ROUND.json"
@@ -78,7 +78,7 @@ if _green "BENCH_flashtune_$ROUND.json" 2>/dev/null; then
     # loss (reboot, cleanup) as a tile change and force-install a
     # possibly-degraded re-measure over a healthy artifact
     _tiles_after=$(python -c \
-      "from nnstreamer_tpu.utils.tuned import FLASH_TILES as t; print(t)")
+      "from nnstreamer_tpu.utils import tuned as t; print(t.FLASH_TILES, t.FLASH_TILES_BY_T)")
     if [ -n "$_tiles_after" ] && [ "$_tiles_after" != "$_tiles_before" ]; then
       rm -f "$STAGE/flash.out" "$STAGE/flash.bw"
       touch "$STAGE/flash.force_install"
