@@ -190,6 +190,26 @@ def test_cached_green_unknown_metric_empty():
     assert bench._cached_green("no_such_metric_xyz") == {}
 
 
+def test_batched_roofline_frac_over_one_carries_note():
+    """A measured fps above the computed ceiling flags the ceiling as
+    conservative (XLA cost-analysis bytes overcount on attention-heavy
+    graphs — the r5 vit row measured frac 1.14) instead of silently
+    publishing frac>1."""
+    # vit-shaped: memory-bound, measured ABOVE the bytes-implied ceiling
+    f = bench._batched_roofline_fields(
+        bfps=6769.43, bflops=9.313e9, bbytes=138e6,
+        peak=197e12, bw=819e9)
+    assert f["batched_roofline_frac"] > 1
+    assert "conservative" in f["batched_roofline_note"]
+    assert f["batched_roofline_bound"] == "memory"
+    # an under-ceiling row carries no note
+    f2 = bench._batched_roofline_fields(
+        bfps=1000.0, bflops=9.313e9, bbytes=138e6,
+        peak=197e12, bw=819e9)
+    assert f2["batched_roofline_frac"] < 1
+    assert "batched_roofline_note" not in f2
+
+
 def test_cpu_env_propagates(monkeypatch):
     seen = {}
 
