@@ -220,3 +220,22 @@ def test_cpu_env_propagates(monkeypatch):
     monkeypatch.setattr(bench, "_run_bounded", fake_run)
     bench.orchestrate("mobilenet", cpu=True, deadline=1, retries=0)
     assert seen["env"]["JAX_PLATFORMS"] == "cpu"
+
+
+def test_graft_entry_honors_cpu_before_first_backend_touch():
+    """The driver's single-chip compile check must never wedge in
+    tunneled-TPU backend init when the process is CPU-forced: the
+    sitecustomize pre-selects the axon platform over the env var, and
+    entry()'s model-param init is the first backend touch on its path —
+    so entry() must promote JAX_PLATFORMS to the jax config (the
+    library chokepoint pattern) before importing the model registry."""
+    import inspect
+
+    import __graft_entry__ as g
+
+    src = inspect.getsource(g.entry)
+    assert src.index("honor_jax_platforms()") < src.index("get_model")
+    # and the entry still produces a jittable (fn, args) under the
+    # suite's CPU pin
+    fn, args = g.entry()
+    assert callable(fn) and len(args) == 2
