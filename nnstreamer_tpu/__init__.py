@@ -11,10 +11,11 @@ __version__ = "0.1.0"
 
 from .tensor import (TensorBuffer, TensorFormat, TensorInfo, TensorsConfig,
                      TensorsInfo, TensorType)
-from .pipeline import (Caps, Element, FlowReturn, Pipeline, parse_launch)
+from .pipeline import (Caps, Element, FlowReturn, ParseError, Pipeline,
+                       parse_launch)
 
 __all__ = [
     "TensorType", "TensorFormat", "TensorInfo", "TensorsInfo",
     "TensorsConfig", "TensorBuffer", "Caps", "Element", "FlowReturn",
-    "Pipeline", "parse_launch", "__version__",
+    "ParseError", "Pipeline", "parse_launch", "__version__",
 ]

@@ -5,12 +5,13 @@ from .element import (CapsEvent, CustomEvent, Element, EOSEvent, Event,
                       FlowReturn, Pad, PadDirection, SegmentEvent)
 from .graph import AppSrc, Pipeline, PipelineError, Queue, Source, Tee
 from .registry import element_factory, list_factories, make_element, register_element
-from .parse import CapsFilter, parse_launch
+from .parse import CapsFilter, ParseError, parse_launch
 
 __all__ = [
     "Caps", "Structure", "IntRange", "FractionRange", "ANY_FRAMERATE",
     "Element", "Pad", "PadDirection", "Event", "CapsEvent", "EOSEvent",
     "SegmentEvent", "CustomEvent", "FlowReturn", "Pipeline", "PipelineError",
     "Source", "Queue", "Tee", "AppSrc", "register_element", "make_element",
-    "element_factory", "list_factories", "parse_launch", "CapsFilter",
+    "element_factory", "list_factories", "parse_launch", "ParseError",
+    "CapsFilter",
 ]

@@ -29,6 +29,15 @@ from .graph import Pipeline
 from .registry import make_element, register_element
 
 
+class ParseError(ValueError):
+    """Single error domain for malformed launch strings — the role of
+    GStreamer's GST_PARSE_ERROR quark (no-such-element, link failures,
+    bad syntax all surface as one catchable type;
+    gst/parse/grammar.y).  Subclasses ValueError so existing callers
+    catching ValueError keep working; parser internals must never leak
+    a raw KeyError/NotImplementedError to the user."""
+
+
 @register_element
 class CapsFilter(Element):
     """Pass-through element that constrains negotiation (GStreamer
@@ -144,7 +153,7 @@ class _ForwardRef:
         self.pad = pad
 
 
-def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
+def _parse_launch(description: str, pipeline: Optional[Pipeline]) -> Pipeline:
     """Build a :class:`Pipeline` from a launch string.
 
     Implements gst-launch's chain grammar: elements join with ``!``;
@@ -168,7 +177,7 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
         kind = op[0]
         if kind == "link":
             if prev is None:
-                raise ValueError("launch string: '!' with nothing upstream")
+                raise ParseError("launch string: '!' with nothing upstream")
             linked = True
             continue
         if kind == "ref":
@@ -182,7 +191,7 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
                 prev, linked = None, False
             else:                  # branch FROM named element
                 if isinstance(prev, _ForwardRef):
-                    raise ValueError(
+                    raise ParseError(
                         f"launch string: reference '{prev.name}.' is never "
                         f"linked (followed by '{name}.' without '!')")
                 prev = _ForwardRef(name, pad)
@@ -199,14 +208,14 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
             else:
                 p.link(prev, el)
         elif isinstance(prev, _ForwardRef):
-            raise ValueError(
+            raise ParseError(
                 f"launch string: reference '{prev.name}.' is never linked "
                 f"(followed by an element without '!')")
         prev, linked = el, False
     if linked:
-        raise ValueError("launch string ends with '!'")
+        raise ParseError("launch string ends with '!'")
     if isinstance(prev, _ForwardRef):
-        raise ValueError(f"launch string: trailing reference '{prev.name}.'"
+        raise ParseError(f"launch string: trailing reference '{prev.name}.'"
                          " is never linked")
     for src_name, src_pad, sink_el in from_refs:
         p.link_pads(p.get(src_name), src_pad, sink_el, None)
@@ -215,3 +224,26 @@ def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipel
     for src_name, src_pad, sink_name, sink_pad in ref_refs:
         p.link_pads(p.get(src_name), src_pad, p.get(sink_name), sink_pad)
     return p
+
+
+def parse_launch(description: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
+    """Build a :class:`Pipeline` from a launch string (see
+    :func:`_parse_launch` for the grammar).
+
+    Error contract (the gst_parse_launch GError analogue): ANY
+    malformed launch string raises :class:`ParseError` (a ValueError) —
+    unknown element factories (a KeyError from the registry), unknown
+    properties (an AttributeError from the element,
+    GST_PARSE_ERROR_NO_SUCH_PROPERTY's case), branch/sink references to
+    unknown or static-pad elements, link failures, unparsable caps
+    values (down to Fraction's ZeroDivisionError on framerate=0/0),
+    unbalanced quotes, and bad syntax alike.  Fuzzed in
+    tests/test_pipeline.py."""
+    try:
+        return _parse_launch(description, pipeline)
+    except ParseError:
+        raise                      # already wrapped — no double prefix
+    except (KeyError, NotImplementedError, AttributeError, ValueError,
+            ZeroDivisionError) as exc:
+        detail = exc.args[0] if exc.args else repr(exc)
+        raise ParseError(f"launch string: {detail}") from exc

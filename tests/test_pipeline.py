@@ -40,9 +40,111 @@ class TestParseLaunch:
         cfg = out.caps.first()
         assert cfg.get("dimensions") == "3:16:16"
 
-    def test_unknown_factory(self):
-        with pytest.raises(KeyError):
+    def test_unknown_factory_is_parse_error(self):
+        """gst_parse_launch error-domain parity: no-such-element is a
+        ParseError (a ValueError), not a leaked registry KeyError."""
+        from nnstreamer_tpu import ParseError
+
+        with pytest.raises(ParseError, match="no such element factory"):
             parse_launch("nosuchelement ! fakesink")
+
+    def test_static_pad_ref_is_parse_error(self):
+        from nnstreamer_tpu import ParseError
+
+        with pytest.raises(ParseError):
+            parse_launch("videotestsrc ! fakesink name=f  f. ! fakesink")
+
+    def test_unknown_ref_is_parse_error(self):
+        from nnstreamer_tpu import ParseError
+
+        with pytest.raises(ParseError):
+            parse_launch("videotestsrc ! nosuch.  fakesink")
+
+    def test_bad_caps_value_is_parse_error(self):
+        """framerate=0/0 used to escape as Fraction's
+        ZeroDivisionError."""
+        from nnstreamer_tpu import ParseError
+
+        with pytest.raises(ParseError):
+            parse_launch("videotestsrc ! video/x-raw,framerate=0/0 ! "
+                         "fakesink")
+
+    def test_unbalanced_quote_is_parse_error(self):
+        from nnstreamer_tpu import ParseError
+
+        with pytest.raises(ParseError):
+            parse_launch("videotestsrc ! 'unclosed")
+
+    def test_bad_pad_name_is_parse_error(self):
+        from nnstreamer_tpu import ParseError
+
+        with pytest.raises(ParseError):
+            parse_launch("appsrc name=s ! mux.sink_x  "
+                         "tensor_mux name=mux ! fakesink")
+
+    def test_launch_fuzz_error_contract(self):
+        """Deterministic launch-string fuzz (the reference's parser is
+        battle-tested by arbitrary user strings; gst_parse_launch NEVER
+        crashes, it returns a GError).  Contract: parse_launch either
+        returns a Pipeline or raises ParseError — nothing else escapes,
+        no hang, for any mutation of real pipeline strings."""
+        import random
+
+        bases = [
+            "videotestsrc num-buffers=4 ! video/x-raw,format=RGB,"
+            "width=64,height=64,framerate=30/1 ! tensor_converter ! "
+            "tensor_sink name=out",
+            "appsrc name=s1 ! mux.sink_0  appsrc name=s2 ! mux.sink_1  "
+            "tensor_mux name=mux ! fakesink",
+            "videotestsrc ! tee name=t ! tensor_converter ! fakesink  "
+            "t. ! fakesink",
+            "filesrc location=x.png ! pngdec ! tensor_converter ! "
+            "tensor_filter framework=xla model=mobilenet_v2 ! "
+            "tensor_decoder mode=image_labeling ! tensor_sink",
+            "tensor_if name=i compared-value=A_VALUE supplied-value=0 "
+            "operator=GT then=PASSTHROUGH else=SKIP",
+        ]
+        pool = ["!", ".", "name=", "mux.", "t.", "tensor_converter",
+                "video/x-raw,", "width=0", "=", "'", '"', "a=", "=b",
+                "fakesink", "!!", "x.y.z", "--", "name=.", "/x", ",",
+                "caps=video/x-raw", "framerate=0/0", "width=-1",
+                "width=99999999999999999999"]
+        rng = random.Random(20260801)
+        parsed = 0
+        for _ in range(1500):
+            toks = rng.choice(bases).split()
+            op = rng.randrange(6)
+            if op == 0 and len(toks) > 2:
+                del toks[rng.randrange(len(toks))]
+            elif op == 1:
+                toks.insert(rng.randrange(len(toks) + 1),
+                            rng.choice(pool))
+            elif op == 2 and len(toks) > 2:
+                a, b = (rng.randrange(len(toks)),
+                        rng.randrange(len(toks)))
+                toks[a], toks[b] = toks[b], toks[a]
+            elif op == 3:
+                j = rng.randrange(len(toks))
+                cut = rng.randrange(len(toks[j])) if toks[j] else 0
+                toks[j] = (toks[j][:cut]
+                           + rng.choice(["", "'", "=", ".", "!", ","])
+                           + toks[j][cut:])
+            elif op == 4:
+                toks = toks[:rng.randrange(1, len(toks) + 1)]
+            else:
+                for _k in range(2):
+                    toks.insert(rng.randrange(len(toks) + 1),
+                                rng.choice(pool))
+            try:
+                parse_launch(" ".join(toks))
+                parsed += 1
+            except Exception as exc:
+                from nnstreamer_tpu import ParseError
+
+                assert isinstance(exc, ParseError), (
+                    f"{type(exc).__name__} escaped: {' '.join(toks)!r}")
+        # the mutations must exercise BOTH sides of the contract
+        assert 0 < parsed < 1500
 
     def test_multi_chain_tee_fanout(self):
         """gst-launch chain grammar: whitespace separates chains, 'name.'
