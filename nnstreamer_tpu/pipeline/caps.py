@@ -286,19 +286,35 @@ class Caps:
         return f"Caps({self})"
 
 
-def _parse_value(raw: str) -> FieldValue:
+def _fraction(raw: str) -> Fraction:
+    """Fraction('16/0') raises ZeroDivisionError, which would leak a
+    non-ValueError out of caps parsing (fuzz-found) — a zero
+    denominator is a malformed caps VALUE, i.e. a ValueError."""
+    try:
+        return Fraction(raw)
+    except ZeroDivisionError:
+        raise ValueError(f"caps fraction with zero denominator: {raw!r}")
+
+
+def _parse_value(raw: str, _depth: int = 0) -> FieldValue:
     raw = raw.strip()
     if raw.startswith("{") and raw.endswith("}"):
-        return [_parse_value(p) for p in raw[1:-1].split(";") if p.strip()]
+        # caps lists don't nest semantically; a deeply nested brace
+        # string is malformed input, and unbounded recursion here would
+        # leak a RecursionError out of the ValueError contract
+        if _depth >= 8:
+            raise ValueError(f"caps value nests too deeply: {raw[:40]!r}")
+        return [_parse_value(p, _depth + 1)
+                for p in raw[1:-1].split(";") if p.strip()]
     if raw.startswith("[") and raw.endswith("]"):
         lo, hi = raw[1:-1].split(",")
         lo, hi = lo.strip(), hi.strip()
         if "/" in lo or "/" in hi:
-            return FractionRange(Fraction(lo), Fraction(hi))
+            return FractionRange(_fraction(lo), _fraction(hi))
         return IntRange(int(lo), int(hi))
     if "/" in raw and all(p.strip().lstrip("-").isdigit()
                           for p in raw.split("/", 1)):
-        return Fraction(raw)
+        return _fraction(raw)
     try:
         return int(raw)
     except ValueError:

@@ -111,3 +111,59 @@ class TestTensorCaps:
                                 "dimensions=3:4,types=uint8,framerate=30/1")
         with pytest.raises(ValueError):
             config_from_caps(caps)
+
+
+class TestCapsStringFuzz:
+    """Caps.from_string error contract: a Caps or a ValueError, nothing
+    else, for any mutation of real caps strings (the reference gets
+    this hardening from gst_caps_from_string)."""
+
+    def test_zero_denominator_fraction_is_value_error(self):
+        with pytest.raises(ValueError, match="zero denominator"):
+            Caps.from_string("audio/x-raw,rate=16/0")
+        with pytest.raises(ValueError, match="zero denominator"):
+            Caps.from_string("video/x-raw,framerate=[0/0,30/1]")
+
+    def test_deep_brace_nesting_is_value_error(self):
+        """3000 nested braces used to escape as RecursionError."""
+        with pytest.raises(ValueError, match="nests too deeply"):
+            Caps.from_string(
+                "video/x-raw,f=" + "{" * 3000 + "x" + "}" * 3000)
+
+    def test_mutation_fuzz_never_escapes(self):
+        import random
+
+        bases = [
+            "video/x-raw,format=RGB,width=224,height=224,"
+            "framerate=30/1",
+            "other/tensors,num_tensors=2,dimensions=3:224:224.1:1000,"
+            "types=uint8.float32,format=static",
+            "audio/x-raw,format=S16LE,rate=16000,channels=1",
+            "other/tensors,format=flexible",
+            "video/x-raw,width=[1,2147483647],format={RGB;BGRx}",
+        ]
+        rng = random.Random(20260801)
+        ok = 0
+        for _ in range(1500):
+            s = rng.choice(bases)
+            op = rng.randrange(5)
+            if op == 0 and s:
+                cut = rng.randrange(len(s))
+                s = s[:cut] + s[cut + 1:]
+            elif op == 1:
+                cut = rng.randrange(len(s))
+                s = s[:cut] + rng.choice(",;:={}[]/.!0x") + s[cut:]
+            elif op == 2:
+                s = s[:rng.randrange(len(s))]
+            elif op == 3:
+                a, b = sorted(rng.randrange(len(s)) for _ in range(2))
+                s = s[:a] + s[b:]
+            else:
+                s = s + rng.choice([",", ",x", ",=", ",width=", "{",
+                                    "[1,", ";"])
+            try:
+                Caps.from_string(s)
+                ok += 1
+            except ValueError:
+                pass
+        assert 0 < ok < 1500
