@@ -177,3 +177,49 @@ class TestTypedData:
     def test_typecast(self):
         v = tdata.typecast(3.7, TensorType.UINT8)
         assert v == 3
+
+
+def test_tensors_caps_parse_fuzz_error_contract():
+    """config_from_caps: a TensorsConfig or a ValueError, nothing else,
+    for any mutation of real other/tensors caps strings (the L1 dim/
+    type parsers sit under every negotiation — gst_tensors_config_
+    from_structure gets this hardening from years of fuzzing)."""
+    import random
+
+    from nnstreamer_tpu.pipeline.caps import Caps
+    from nnstreamer_tpu.tensor.caps_util import config_from_caps
+
+    bases = [
+        "other/tensors,num_tensors=2,dimensions=3:224:224.1:1000,"
+        "types=uint8.float32,format=static,framerate=30/1",
+        "other/tensors,num_tensors=1,dimensions=3:16:16:1,types=int8,"
+        "format=static",
+        "other/tensors,format=flexible,framerate=0/1",
+        "other/tensors,num_tensors=3,dimensions=1.2:2.3:3:3,"
+        "types=float16.uint32.int64,format=static",
+    ]
+    rng = random.Random(20260801)
+    ok = 0
+    for _ in range(1000):
+        s = rng.choice(bases)
+        op = rng.randrange(5)
+        if op == 0 and s:
+            cut = rng.randrange(len(s))
+            s = s[:cut] + s[cut + 1:]
+        elif op == 1:
+            cut = rng.randrange(len(s))
+            s = s[:cut] + rng.choice(",;:=.x0-9 ") + s[cut:]
+        elif op == 2:
+            s = s[:rng.randrange(len(s))]
+        elif op == 3:
+            a, b = sorted(rng.randrange(len(s)) for _ in range(2))
+            s = s[:a] + s[b:]
+        else:
+            s += rng.choice([",dimensions=", ".", ":", ",types=nosuch",
+                             ",num_tensors=99"])
+        try:
+            config_from_caps(Caps.from_string(s))
+            ok += 1
+        except ValueError:
+            pass
+    assert 0 < ok < 1000
