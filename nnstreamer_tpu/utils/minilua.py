@@ -219,6 +219,20 @@ def _index(obj, key, _depth=0):
             if callable(handler):
                 return _first(handler(obj, key))
         return v
+    if isinstance(obj, str):
+        # Lua strings carry a metatable with __index = the string
+        # library (lstrlib.c createmetatable): ("x").rep and s:rep(2)
+        # BOTH resolve here (mcall routes through _index); any other
+        # key — numeric indexing included — is nil, never a Python
+        # str.__getitem__ (which leaked a TypeError on string keys,
+        # fuzz-found).  Divergence note: the method table is a shared
+        # singleton, so a script REPLACING string.fn in its own
+        # globals changes neither path (liblua points its string
+        # metatable at the state's own string table, so there a
+        # replacement affects both).
+        if isinstance(key, str):
+            return _string_lib().get(key)
+        return None
     if hasattr(obj, "__getitem__"):
         if isinstance(key, float) and key.is_integer():
             key = int(key)
@@ -715,7 +729,7 @@ class _Parser:
                 a, b = _first(base(env)), _first(exp(env))
                 if isinstance(a, (int, float)) and isinstance(b,
                                                               (int, float)):
-                    return a ** b
+                    return _lua_rawpow(a, b)
                 h = _meta_bin(a, b, "__pow")
                 if h is not None:
                     return h()
@@ -793,12 +807,10 @@ class _Parser:
                 def mcall(env, objfn=objfn, method=method,
                           margs=tuple(margs)):
                     obj = _first(objfn(env))
-                    if isinstance(obj, str):
-                        lib = env.get("string")
-                        f = (lib.get(method)
-                             if isinstance(lib, LuaTable) else None)
-                    else:
-                        f = _index(obj, method)
+                    # strings resolve via _index's shared string-lib
+                    # singleton — the SAME table dot access uses, so
+                    # s:rep(2) and ('x').rep can never diverge
+                    f = _index(obj, method)
                     if f is None:
                         raise LuaError(
                             f"lua: no method {method!r} on "
@@ -1060,12 +1072,55 @@ def _lua_concat(a, b):
                    "value (no __concat metamethod)")
 
 
+def _lua_rawdiv(a, b):
+    """Lua numbers are C doubles: 1/0 is inf, -1/0 is -inf, 0/0 is nan
+    (Python raises ZeroDivisionError instead, which leaked)."""
+    a, b = float(a), float(b)
+    if b == 0.0:
+        if a == 0.0 or math.isnan(a):
+            return math.nan
+        same_sign = (a > 0) == (math.copysign(1.0, b) > 0)
+        return math.inf if same_sign else -math.inf
+    return a / b
+
+
+def _lua_rawmod(a, b):
+    """a % b == a - floor(a/b)*b (manual §2.5.1); x%0 and inf%x are
+    nan per C fmod, and floor() of an infinite quotient must not raise
+    OverflowError."""
+    a, b = float(a), float(b)
+    if b == 0.0 or not math.isfinite(a):
+        return math.nan
+    q = a / b
+    if not math.isfinite(q):
+        return math.nan
+    fl = math.floor(q)
+    if fl == 0:
+        return a                  # 5 % inf = 5 (0*inf would be nan)
+    return a - fl * b
+
+
+def _lua_rawpow(a, b):
+    """C pow semantics: 0^-1 is inf, overflow saturates to inf, and a
+    negative base with a non-integer exponent is nan (Python would
+    raise or go complex)."""
+    a, b = float(a), float(b)
+    if a < 0 and not b.is_integer():
+        return math.nan
+    try:
+        r = a ** b
+    except (ZeroDivisionError, OverflowError):
+        neg = a < 0 and b.is_integer() and int(b) % 2 == 1
+        return -math.inf if neg else math.inf
+    return r
+
+
 _BINFN: Dict[str, Callable] = {
     "+": _arith("+", lambda a, b: a + b, "__add"),
     "-": _arith("-", lambda a, b: a - b, "__sub"),
     "*": _arith("*", lambda a, b: a * b, "__mul"),
-    "/": _arith("/", lambda a, b: a / b, "__div"),
-    "%": _arith("%", lambda a, b: a - math.floor(a / b) * b, "__mod"),
+    "/": _arith("/", _lua_rawdiv, "__div"),
+    "%": _arith("%", _lua_rawmod, "__mod"),
     "<": _lua_lt, ">": lambda a, b: _lua_lt(b, a),
     "<=": _lua_le, ">=": lambda a, b: _lua_le(b, a),
     "==": _lua_eq, "~=": lambda a, b: not _lua_eq(a, b),
@@ -1077,8 +1132,44 @@ _BINFN: Dict[str, Callable] = {
 # public API
 # ---------------------------------------------------------------------------
 
+def _protect(name: str, fn):
+    """Stdlib/builtin boundary guard: a bad argument to a library
+    function — string.gsub(nil, ...), string.sub(s, 'o'), bare
+    ipairs() — must surface as the named LuaError liblua raises ("bad
+    argument #n to 'gsub'"), never as a leaked Python
+    TypeError/ValueError (fuzz-found).  LuaError raised inside a
+    function (its own argument checks) passes through untouched."""
+    def wrapped(*args):
+        try:
+            return fn(*args)
+        except LuaError:
+            raise
+        except (TypeError, ValueError, AttributeError, IndexError,
+                KeyError, OverflowError) as exc:
+            raise LuaError(
+                f"lua: bad argument to '{name}' ({exc})") from exc
+    return wrapped
+
+
+def _protected_lib(entries: Dict[str, Any]) -> LuaTable:
+    return LuaTable({k: (_protect(k, v) if callable(v) else v)
+                     for k, v in entries.items()})
+
+
+_STRING_LIB: Optional[LuaTable] = None
+
+
+def _string_lib() -> LuaTable:
+    """Shared string library for Lua's string-metatable __index (what
+    makes ``s:rep(2)`` / ``("x").sub`` resolve)."""
+    global _STRING_LIB
+    if _STRING_LIB is None:
+        _STRING_LIB = _make_string()
+    return _STRING_LIB
+
+
 def _make_math() -> LuaTable:
-    return LuaTable({
+    return _protected_lib({
         "floor": lambda x: float(math.floor(x)),
         "ceil": lambda x: float(math.ceil(x)),
         "abs": abs, "sqrt": math.sqrt,
@@ -1556,7 +1647,7 @@ def _make_string() -> LuaTable:
         a, _ = _str_range(s, i)
         return float(ord(s[a])) if a < len(s) else None
 
-    return LuaTable({
+    return _protected_lib({
         "format": _lua_format,
         "sub": sub, "len": lambda s: len(s),
         "upper": lambda s: s.upper(), "lower": lambda s: s.lower(),
@@ -1599,8 +1690,8 @@ def _make_table() -> LuaTable:
         return _lua_str(sep).join(
             _lua_str(t.get(k)) for k in range(1, t.length() + 1))
 
-    return LuaTable({"insert": insert, "remove": remove,
-                     "concat": concat})
+    return _protected_lib({"insert": insert, "remove": remove,
+                           "concat": concat})
 
 
 class LuaState:
@@ -1608,21 +1699,27 @@ class LuaState:
 
     def __init__(self, source: str,
                  host_globals: Optional[Dict[str, Any]] = None):
+        # builtins go through the same _protect boundary as the stdlib
+        # tables: bare ipairs() is a LuaError, not a Python TypeError
         self.globals: Dict[str, Any] = {
             "math": _make_math(),
             "string": _make_string(),
             "table": _make_table(),
-            "tostring": _lua_str,
-            "tonumber": _lua_tonumber,
-            "pairs": _lua_pairs,
-            "ipairs": _lua_ipairs,
-            "print": lambda *a: print("[lua]", *[_lua_str(x) for x in a]),
-            "setmetatable": _lua_setmetatable,
-            "getmetatable": _lua_getmetatable,
-            "rawget": _lua_rawget,
-            "rawset": _lua_rawset,
-            "type": _lua_type,
         }
+        self.globals.update({
+            name: _protect(name, fn) for name, fn in {
+                "tostring": _lua_str,
+                "tonumber": _lua_tonumber,
+                "pairs": _lua_pairs,
+                "ipairs": _lua_ipairs,
+                "print": lambda *a: print(
+                    "[lua]", *[_lua_str(x) for x in a]),
+                "setmetatable": _lua_setmetatable,
+                "getmetatable": _lua_getmetatable,
+                "rawget": _lua_rawget,
+                "rawset": _lua_rawset,
+                "type": _lua_type,
+            }.items()})
         if host_globals:
             self.globals.update(host_globals)
         chunk = _Parser(_lex(source)).parse_chunk()

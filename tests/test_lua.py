@@ -939,3 +939,118 @@ end
                                           [0, 1, 2, 3])
         finally:
             fw.close()
+
+
+class TestErrorDomainAndStringMethods:
+    """minilua's user-facing contract (fuzz-pinned): any script —
+    well-formed or mutated garbage — either runs or raises LuaError;
+    Python exception types never leak out of the interpreter.  Plus the
+    liblua string-metatable behavior the fuzz led to: s:method() calls
+    resolve through the string library."""
+
+    def test_stdlib_bad_args_raise_lua_error(self):
+        for src in (
+            "return string.gsub(nil_value, 'o', '0')",   # nil subject
+            "return string.sub('hello', 'o', '0')",      # str index
+            "return string.rep('x', 'many')",
+            "return table.concat(42)",
+            "return math.floor('zzz')",
+            "return ipairs()",                           # bare builtin
+            "return tonumber()",
+        ):
+            with pytest.raises(LuaError, match="bad argument"):
+                LuaState(src)
+
+    def test_string_method_calls_resolve_via_string_lib(self):
+        """liblua gives strings a metatable with __index = string
+        (lstrlib.c createmetatable): s:upper() / ('x'):rep(2) work."""
+        st = LuaState("function f(x) return x:upper() .. ('x'):rep(2) "
+                      "end")
+        assert st.call("f", "ab") == "ABxx"
+
+    def test_lua_float_division_semantics(self):
+        """Lua numbers are C doubles: 1/0 = inf, 0/0 = nan, x%0 = nan,
+        0^-1 = inf, (-2)^0.5 = nan — none of these are Python
+        ZeroDivisionError/OverflowError/complex (review-found leaks)."""
+        import math
+
+        def ev(expr):
+            return LuaState(f"function f() return {expr} end").call("f")
+
+        assert ev("1/0") == math.inf
+        assert ev("-1/0") == -math.inf
+        assert math.isnan(ev("0/0"))
+        assert math.isnan(ev("1%0"))
+        assert math.isnan(ev("(1/0)%2"))
+        assert ev("5%(1/0)") == 5.0
+        assert ev("0^-1") == math.inf
+        assert ev("(-2)^3") == -8.0
+        assert math.isnan(ev("(-2)^0.5"))
+        assert ev("1e308*10/1") == math.inf or ev("2^2048") == math.inf
+
+    def test_overflow_in_stdlib_is_lua_error(self):
+        with pytest.raises(LuaError, match="bad argument"):
+            LuaState("return string.rep('x', math.huge)")
+        with pytest.raises(LuaError, match="bad argument"):
+            LuaState("return math.floor(0/0)")
+
+    def test_string_method_and_dot_access_share_one_table(self):
+        """s:rep(2) and ('x').rep must resolve through the SAME table
+        (they diverged when mcall consulted the per-state globals while
+        dot access used the shared singleton)."""
+        st = LuaState(
+            "function f(x)\n"
+            "  local m = ('y').rep\n"
+            "  return x:rep(2) .. m(x, 2)\n"
+            "end")
+        assert st.call("f", "ab") == "abababab"
+
+    def test_numeric_index_of_string_is_nil(self):
+        # Lua: ('abc')[1] is nil (no Python str.__getitem__ semantics)
+        st = LuaState("function g(x) return x[1] end")
+        assert st.call("g", "abc") is None
+
+    def test_mutation_fuzz_only_lua_error_escapes(self):
+        """Deterministic script-mutation fuzz.  User INFINITE LOOPS are
+        liblua parity (no instruction budget there either) — the seeds
+        and operators here are chosen loop-free; the error contract is
+        what this pins."""
+        import random
+
+        bases = [
+            "local x = 1 + 2\nreturn x",
+            "function f(a, b) return a * b end\nreturn f(3, 4)",
+            "local s = 'hello world'\nreturn string.gsub(s, 'o', '0')",
+            "local s = ''\nfor w in string.gmatch('a,b,c', '[^,]+') do "
+            "s = s .. w end\nreturn s",
+            "return table.concat({1,2,3}, '-') .. string.rep('x', 2)",
+            "return math.floor(3.7) + math.max(1, 2)",
+            "return ('abc'):upper() .. ('x'):rep(2)",
+        ]
+        pool = (list("()[]{}=+-*/.,:;'\" ")
+                + ["end", "do", "then", "function", "local", "return",
+                   "..", "::", "nil", "0x", "---"])
+        rng = random.Random(20260801)
+        ran = 0
+        for _ in range(800):
+            src = rng.choice(bases)
+            op = rng.randrange(5)
+            if op == 0 and src:
+                cut = rng.randrange(len(src))
+                src = src[:cut] + src[cut + 1:]
+            elif op == 1:
+                cut = rng.randrange(len(src))
+                src = src[:cut] + rng.choice(pool) + src[cut:]
+            elif op == 2:
+                src = src[:rng.randrange(len(src))]
+            elif op == 3:
+                a, b = sorted(rng.randrange(len(src)) for _ in range(2))
+                src = src[:a] + src[b:]
+            else:
+                src = src + "\n" + rng.choice(pool)
+            try:
+                LuaState(src)
+                ran += 1
+            except LuaError:
+                pass
+        assert 0 < ran < 800
