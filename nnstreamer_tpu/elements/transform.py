@@ -65,9 +65,21 @@ class TensorTransform(Element):
             self._ops = _parse_arith(option)
         elif mode == "transpose":
             self._perm = tuple(int(x) for x in option.split(":"))
+            # the reference's transpose option is a permutation of axis
+            # indices (gsttensor_transform.c); an out-of-range or
+            # repeated index used to surface as a raw IndexError deep
+            # in negotiation
+            if sorted(self._perm) != list(range(len(self._perm))):
+                raise ValueError(
+                    f"{self.name}: transpose option must be a "
+                    f"permutation of 0..{len(self._perm) - 1}, got "
+                    f"{option!r}")
         elif mode == "dimchg":
             a, _, b = option.partition(":")
             self._dimchg = (int(a), int(b))
+            if min(self._dimchg) < 0:
+                raise ValueError(f"{self.name}: dimchg indices must be "
+                                 f">= 0, got {option!r}")
         elif mode == "stand":
             parts = option.split(":")
             self._stand_mode = parts[0] or "default"
@@ -106,13 +118,31 @@ class TensorTransform(Element):
                     dtype = _[0]
             return TensorInfo(dtype, info.dims, info.name)
         if mode == "transpose":
-            dims = tuple(info.dims[p] for p in self._perm)
-            return TensorInfo(info.dtype, dims, info.name)
+            if len(self._perm) < len(info.dims):
+                raise ValueError(
+                    f"{self.name}: transpose permutation rank "
+                    f"{len(self._perm)} is below tensor rank "
+                    f"{len(info.dims)} (dims {info.dims}) — a shorter "
+                    "permutation would silently drop trailing dims")
+            # reference transpose options are 4-index against NNS dims
+            # padded with trailing 1s; pad, permute, strip the padding
+            # back off (our dims convention is true-rank)
+            padded = info.dims + (1,) * (len(self._perm) - len(info.dims))
+            out = [padded[p] for p in self._perm]
+            while len(out) > len(info.dims) and out[-1] == 1:
+                out.pop()
+            return TensorInfo(info.dtype, tuple(out), info.name)
         if mode == "dimchg":
             a, b = self._dimchg
-            dims = list(info.dims)
+            # same reference convention as transpose: indices address
+            # NNS dims padded with trailing 1s (a verbatim '0:3' is
+            # valid against a true-rank-3 tensor); pad, move, strip
+            rank = max(len(info.dims), a + 1, b + 1)
+            dims = list(info.dims) + [1] * (rank - len(info.dims))
             d = dims.pop(a)
             dims.insert(b, d)
+            while len(dims) > len(info.dims) and dims[-1] == 1:
+                dims.pop()
             return TensorInfo(info.dtype, tuple(dims), info.name)
         if mode == "stand":
             return TensorInfo(TensorType.FLOAT32, info.dims, info.name)
@@ -154,15 +184,30 @@ class TensorTransform(Element):
                 out = out.astype(target.np_dtype)
             return out
         if mode == "transpose":
-            # reference dims are innermost-first; numpy axes are reversed
-            nd = arr.ndim
+            # reference dims are innermost-first; numpy axes are
+            # reversed — and a 4-index reference option against a
+            # lower-rank tensor pads with 1s (NNS trailing dims =
+            # leading numpy axes), permutes, then strips the padding
+            orig_ndim = arr.ndim
+            nd = len(self._perm)
+            if arr.ndim < nd:
+                arr = arr.reshape((1,) * (nd - arr.ndim) + arr.shape)
             np_perm = tuple(nd - 1 - self._perm[nd - 1 - ax]
                             for ax in range(nd))
-            return xp.transpose(arr, np_perm)
+            out = xp.transpose(arr, np_perm)
+            while out.ndim > orig_ndim and out.shape[0] == 1:
+                out = out.reshape(out.shape[1:])
+            return out
         if mode == "dimchg":
             a, b = self._dimchg
-            nd = arr.ndim
-            return xp.moveaxis(arr, nd - 1 - a, nd - 1 - b)
+            orig_ndim = arr.ndim
+            nd = max(arr.ndim, a + 1, b + 1)
+            if arr.ndim < nd:
+                arr = arr.reshape((1,) * (nd - arr.ndim) + arr.shape)
+            out = xp.moveaxis(arr, nd - 1 - a, nd - 1 - b)
+            while out.ndim > orig_ndim and out.shape[0] == 1:
+                out = out.reshape(out.shape[1:])
+            return out
         if mode == "stand":
             x = arr.astype(np.float32)
             axes = (tuple(range(x.ndim - 1)) if self._stand_per_channel

@@ -76,6 +76,42 @@ class TestTransform:
         np.testing.assert_array_equal(
             out, np.arange(12, dtype=np.float32).reshape(4, 3).T)
 
+    def test_transpose_reference_4index_on_rank3(self):
+        """A verbatim reference option ('1:0:2:3', 4 indices against
+        NNS dims padded to rank 4) must work on a true-rank-3 tensor:
+        pad with 1s, permute, strip the padding (used to IndexError)."""
+        x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)  # dims 3:4:2
+        sink = run_chain(
+            tcaps("3:4:2", "float32"),
+            TensorTransform("t", mode="transpose", option="1:0:2:3"),
+            [TensorBuffer(tensors=[x], pts=0)])
+        out = sink.results[0].np(0)
+        assert out.shape == (2, 3, 4)   # dims 4:3:2
+        np.testing.assert_array_equal(out, x.transpose(0, 2, 1))
+
+    def test_transpose_option_validation(self):
+        # repeated / out-of-range indices are not a permutation
+        with pytest.raises(ValueError, match="permutation"):
+            TensorTransform("t", mode="transpose", option="9:9:9:9").start()
+        with pytest.raises(ValueError, match="permutation"):
+            TensorTransform("t", mode="transpose", option="0:0").start()
+
+    def test_dimchg_reference_padded_indices(self):
+        """A verbatim reference dimchg option addressing the padded
+        rank-4 dims ('0:3' on a true-rank-3 tensor) pads, moves, and
+        strips — same convention the transpose branch honors."""
+        x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)  # dims 3:4:2
+        sink = run_chain(
+            tcaps("3:4:2", "float32"),
+            TensorTransform("t", mode="dimchg", option="0:3"),
+            [TensorBuffer(tensors=[x], pts=0)])
+        out = sink.results[0].np(0)
+        # dims 3:4:2 -> move dim0 (3) to padded slot 3 -> 4:2:1:3 ->
+        # numpy shape (3,1,2,4)
+        assert out.shape == (3, 1, 2, 4)
+        np.testing.assert_array_equal(
+            out, np.moveaxis(x.reshape(1, 2, 4, 3), 3, 0))
+
     def test_stand_default(self):
         data = np.array([1, 2, 3, 4], np.float32)
         sink = run_chain(
