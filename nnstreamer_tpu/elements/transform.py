@@ -22,6 +22,7 @@ import numpy as np
 
 from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
+from ..utils.log import ml_logw
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import (caps_from_config, config_from_caps,
                                 static_tensors_caps)
@@ -62,7 +63,7 @@ class TensorTransform(Element):
         if mode == "typecast":
             self._out_type = TensorType.from_string(option)
         elif mode == "arithmetic":
-            self._ops = _parse_arith(option)
+            self._ops, self._ch_dim = _parse_arith(option)
         elif mode == "transpose":
             self._perm = tuple(int(x) for x in option.split(":"))
             # the reference's transpose option is a permutation of axis
@@ -113,9 +114,9 @@ class TensorTransform(Element):
             return TensorInfo(self._out_type, info.dims, info.name)
         if mode == "arithmetic":
             dtype = info.dtype
-            for op, _ in self._ops:
+            for op, operand, _ch in self._ops:
                 if op == "typecast":
-                    dtype = _[0]
+                    dtype = operand[0]
             return TensorInfo(dtype, info.dims, info.name)
         if mode == "transpose":
             if len(self._perm) < len(info.dims):
@@ -169,15 +170,48 @@ class TensorTransform(Element):
             return arr.astype(self._out_type.np_dtype)
         if mode == "arithmetic":
             out = arr
-            for op, operand in self._ops:
+            for op, operand, applying_ch in self._ops:
                 if op == "typecast":
                     out = out.astype(operand[0].np_dtype)
+                    continue
+                val = self._operand(operand, xp)
+                if applying_ch >= 0 and self._ch_dim is not None:
+                    # reference per-channel arithmetic: the op touches
+                    # only index applying_ch along the NNS ch_dim axis
+                    # (= numpy axis ndim-1-ch_dim), with the same
+                    # padded-dims convention as transpose/dimchg: a
+                    # ch_dim beyond the true rank addresses a padded
+                    # size-1 axis, where channel 0 is the whole tensor
+                    # and any other index never matches (the reference
+                    # compares channel indices per element, so an
+                    # out-of-range index is a no-op — made identical
+                    # here on the numpy AND jnp paths)
+                    if self._ch_dim >= out.ndim:
+                        if applying_ch == 0:
+                            out = (out + val if op == "add"
+                                   else out * val if op == "mul"
+                                   else out / val)
+                        continue
+                    axis = out.ndim - 1 - self._ch_dim
+                    if applying_ch >= out.shape[axis]:
+                        continue
+                    idx = [slice(None)] * out.ndim
+                    idx[axis] = applying_ch
+                    idx = tuple(idx)
+                    sl = out[idx]
+                    new = (sl + val if op == "add"
+                           else sl * val if op == "mul" else sl / val)
+                    if hasattr(out, "at"):          # jnp
+                        out = out.at[idx].set(new)
+                    else:
+                        out = out.copy()
+                        out[idx] = new
                 elif op == "add":
-                    out = out + self._operand(operand, xp)
+                    out = out + val
                 elif op == "mul":
-                    out = out * self._operand(operand, xp)
+                    out = out * val
                 elif op == "div":
-                    out = out / self._operand(operand, xp)
+                    out = out / val
             # numpy promotion (e.g. uint8 + 0.5 → float64) must not leak
             # past the caps we announced: cast back to the negotiated dtype
             if target is not None and out.dtype != target.np_dtype:
@@ -232,26 +266,59 @@ class TensorTransform(Element):
         return xp.asarray(vals, dtype=np.float64 if xp is np else None)
 
 
-def _parse_arith(option: str) -> List[Tuple[str, Any]]:
-    """Parse ``typecast:float32,add:-127.5,div:127.5`` chains (reference
-    arithmetic option grammar, incl. multi-value per-channel operands
-    ``add:1,2,3`` — values bind to the innermost dim)."""
-    ops: List[Tuple[str, Any]] = []
+def _parse_arith(option: str):
+    """Parse the reference arithmetic option grammar
+    (gsttensor_transform.c REGEX_ARITH_OPTION):
+    ``[typecast:TYPE,][per-channel:(false|true@DIM),]
+    add|mul|div:NUMBER[@CH_IDX], ...`` — plus this framework's
+    multi-value per-channel operand extension ``add:1,2,3`` (values
+    bind to the innermost dim).  Reference-verbatim behaviors honored:
+    an UNKNOWN operator (``casttype:...``) warns and is skipped
+    (GTT_OP_UNKNOWN — the ssat goldens rely on the pipeline running
+    with the op dropped), and extra ``:NUMBER`` segments after the
+    first operand are accepted-and-ignored (the reference regex admits
+    them, its parser reads only values[0]).
+
+    Returns ``(ops, ch_dim)``: ops as ``(op, operand, applying_ch)``
+    triples (-1 = all channels), ch_dim the per-channel NNS dim index
+    or None."""
+    ops: List[Tuple[str, Any, int]] = []
+    ch_dim = None
     # split on commas that are followed by an op name, so per-channel value
     # lists keep their commas
-    parts = re.split(r",(?=(?:typecast|add|mul|div|sub):)", option)
+    # break before any "word:" token (op names and per-channel alike);
+    # numeric per-channel value lists keep their commas
+    parts = re.split(r",(?=[a-z-]+:)", option)
     for part in parts:
         if not part.strip():
             continue
         op, _, val = part.partition(":")
         op = op.strip()
+        if op == "per-channel":
+            flag, _, dim = val.partition("@")
+            if flag.strip().lower() == "true":
+                ch_dim = int(dim) if dim.strip() else 0
+            continue
         if op == "typecast":
-            ops.append((op, [TensorType.from_string(val)]))
+            ops.append((op, [TensorType.from_string(val)], -1))
         elif op in ("add", "mul", "div", "sub"):
-            vals = [float(v) for v in val.split(",")]
+            val, _, ch = val.partition("@")
+            applying_ch = int(ch) if ch.strip() else -1
+            vals = []
+            for item in val.split(","):
+                segs = item.split(":")
+                if len(segs) > 1:
+                    ml_logw("arithmetic %s: ignoring extra operand "
+                            "segments %s (reference parser reads only "
+                            "the first)", op, segs[1:])
+                vals.append(float(segs[0]))
             if op == "sub":
                 op, vals = "add", [-v for v in vals]
-            ops.append((op, vals))
+            ops.append((op, vals, applying_ch))
         else:
-            raise ValueError(f"unknown arithmetic op {op!r}")
-    return ops
+            # reference GTT_OP_UNKNOWN: warn and drop the op, keep the
+            # pipeline running (ssat tests pass casttype:... expecting
+            # exactly this)
+            ml_logw("arithmetic: unknown operator %r skipped "
+                    "(reference GTT_OP_UNKNOWN behavior)", op)
+    return ops, ch_dim

@@ -89,6 +89,70 @@ class TestTransform:
         assert out.shape == (2, 3, 4)   # dims 4:3:2
         np.testing.assert_array_equal(out, x.transpose(0, 2, 1))
 
+    def test_arith_per_channel_at_dim(self):
+        """Reference grammar: 'per-channel:true@0,add:255@0' adds only
+        to channel 0 along NNS dim 0 (the innermost = last numpy
+        axis)."""
+        x = np.zeros((2, 3), dtype=np.float32)      # dims 3:2
+        sink = run_chain(
+            tcaps("3:2", "float32"),
+            TensorTransform("t", mode="arithmetic",
+                            option="per-channel:true@0,add:255@0"),
+            [TensorBuffer(tensors=[x], pts=0)])
+        out = sink.results[0].np(0)
+        want = np.zeros((2, 3), dtype=np.float32)
+        want[:, 0] = 255
+        np.testing.assert_array_equal(out, want)
+
+    def test_arith_per_channel_padded_dim_and_out_of_range(self):
+        """Padded-dims convention for ch_dim (a ch_dim beyond the true
+        rank addresses a size-1 padded axis: channel 0 = the whole
+        tensor) and never-matching channel indices are a no-op —
+        identical on the numpy and jnp paths (jnp would otherwise
+        silently drop the update while numpy raised IndexError)."""
+        x = np.zeros((2, 3), dtype=np.float32)      # dims 3:2
+        sink = run_chain(
+            tcaps("3:2", "float32"),
+            TensorTransform("t", mode="arithmetic",
+                            option="per-channel:true@3,add:7@0"),
+            [TensorBuffer(tensors=[x], pts=0)])
+        np.testing.assert_array_equal(sink.results[0].np(0),
+                                      np.full((2, 3), 7, np.float32))
+        sink = run_chain(
+            tcaps("3:2", "float32"),
+            TensorTransform("t", mode="arithmetic",
+                            option="per-channel:true@0,add:7@9"),
+            [TensorBuffer(tensors=[x], pts=0)])
+        np.testing.assert_array_equal(sink.results[0].np(0), x)
+
+    def test_arith_unknown_op_skipped_reference_behavior(self):
+        """'casttype:uint64,mul:65535' (a real ssat line): the unknown
+        op warns and is DROPPED, the pipeline runs with just the mul
+        (GTT_OP_UNKNOWN semantics — raising would break verbatim
+        reference pipelines whose goldens encode the skip)."""
+        x = np.ones((2, 2), dtype=np.float32)
+        sink = run_chain(
+            tcaps("2:2", "float32"),
+            TensorTransform("t", mode="arithmetic",
+                            option="casttype:uint64,mul:3"),
+            [TensorBuffer(tensors=[x], pts=0)])
+        np.testing.assert_array_equal(sink.results[0].np(0),
+                                      np.full((2, 2), 3, np.float32))
+
+    def test_arith_extra_operand_segments_ignored(self):
+        """'add:9.900000e-001:-80.256' (a real ssat line): the
+        reference regex admits extra ':NUMBER' segments but its parser
+        reads only the first operand."""
+        x = np.zeros((2, 2), dtype=np.float32)
+        sink = run_chain(
+            tcaps("2:2", "float32"),
+            TensorTransform("t", mode="arithmetic",
+                            option="add:9.900000e-001:-80.256"),
+            [TensorBuffer(tensors=[x], pts=0)])
+        np.testing.assert_allclose(sink.results[0].np(0),
+                                   np.full((2, 2), 0.99, np.float32),
+                                   rtol=1e-6)
+
     def test_transpose_option_validation(self):
         # repeated / out-of-range indices are not a permutation
         with pytest.raises(ValueError, match="permutation"):
