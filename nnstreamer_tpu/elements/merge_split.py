@@ -12,7 +12,7 @@ from typing import Dict, List
 
 import numpy as np
 
-from ..pipeline.clock import CollectPads, SyncMode
+from ..pipeline.clock import CollectPads, SyncMode, parse_sync_option
 from ..pipeline.element import CapsEvent, Element, EOSEvent, FlowReturn, Pad
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
@@ -31,6 +31,7 @@ class TensorMerge(Element):
         "mode": ("linear", "only 'linear' (like the reference's main mode)"),
         "option": (0, "reference dim index to concat along"),
         "sync-mode": ("slowest", "nosync|slowest|basepad|refresh"),
+        "sync-option": (None, "basepad: '<pad>:<duration_ns>'"),
     }
 
     def _make_pads(self):
@@ -45,8 +46,10 @@ class TensorMerge(Element):
         if str(self.mode) != "linear":
             raise ValueError(f"{self.name}: unsupported mode {self.mode}")
         self._dim = int(self.option)
+        dur, base_pad = parse_sync_option(self.sync_option)
         self._collect = CollectPads(len(self.sink_pads),
-                                    SyncMode.from_string(self.sync_mode))
+                                    SyncMode.from_string(self.sync_mode),
+                                    dur, base_pad=base_pad)
         self._pad_index = {p.name: i for i, p in enumerate(self.sink_pads)}
         self._pad_configs: Dict[int, TensorsConfig] = {}
         self._announced = False
@@ -98,7 +101,13 @@ class TensorMerge(Element):
 
     def _combine(self, frame_set: List[TensorBuffer]) -> TensorBuffer:
         arrays = [b.np(0) for b in frame_set]
-        nd = arrays[0].ndim
+        # the concat dim may address a padded NNS dim beyond the true
+        # rank (reference 'option=2' on rank-1 tensors; set_caps pads
+        # the announced dims the same way) — NNS trailing dims are
+        # LEADING numpy axes, so pad with leading 1-axes to cover it
+        nd = max(arrays[0].ndim, self._dim + 1)
+        arrays = [a.reshape((1,) * (nd - a.ndim) + a.shape)
+                  for a in arrays]
         axis = nd - 1 - self._dim
         merged = np.concatenate(arrays, axis=axis)
         pts = max((b.pts or 0) for b in frame_set)

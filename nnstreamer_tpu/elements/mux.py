@@ -11,7 +11,7 @@ from __future__ import annotations
 from fractions import Fraction
 from typing import Dict, List, Optional
 
-from ..pipeline.clock import CollectPads, SyncMode
+from ..pipeline.clock import CollectPads, SyncMode, parse_sync_option
 from ..pipeline.element import CapsEvent, Element, EOSEvent, FlowReturn, Pad
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
@@ -37,14 +37,7 @@ class TensorMux(Element):
     def start(self):
         import threading
 
-        dur = None
-        base_pad = 0
-        if self.sync_option:
-            parts = str(self.sync_option).split(":")
-            if len(parts) == 2:
-                base_pad, dur = int(parts[0]), int(parts[1])
-            else:
-                dur = int(parts[0])
+        dur, base_pad = parse_sync_option(self.sync_option)
         self._collect = CollectPads(len(self.sink_pads),
                                     SyncMode.from_string(self.sync_mode), dur,
                                     base_pad=base_pad)

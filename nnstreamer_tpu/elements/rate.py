@@ -22,7 +22,6 @@ class TensorRate(Element):
     PROPERTIES = {
         "framerate": (None, "target rate 'N/D'"),
         "throttle": (True, "drop-only (no duplication)"),
-        "silent": (True, ""),
     }
 
     def _make_pads(self):

@@ -188,9 +188,13 @@ class TensorTransform(Element):
                     # here on the numpy AND jnp paths)
                     if self._ch_dim >= out.ndim:
                         if applying_ch == 0:
-                            out = (out + val if op == "add"
+                            new = (out + val if op == "add"
                                    else out * val if op == "mul"
                                    else out / val)
+                            # match the in-range slice path, which
+                            # writes back into the current dtype
+                            out = (new.astype(out.dtype)
+                                   if new.dtype != out.dtype else new)
                         continue
                     axis = out.ndim - 1 - self._ch_dim
                     if applying_ch >= out.shape[axis]:
@@ -284,8 +288,6 @@ def _parse_arith(option: str):
     or None."""
     ops: List[Tuple[str, Any, int]] = []
     ch_dim = None
-    # split on commas that are followed by an op name, so per-channel value
-    # lists keep their commas
     # break before any "word:" token (op names and per-channel alike);
     # numeric per-channel value lists keep their commas
     parts = re.split(r",(?=[a-z-]+:)", option)
@@ -314,6 +316,16 @@ def _parse_arith(option: str):
                 vals.append(float(segs[0]))
             if op == "sub":
                 op, vals = "add", [-v for v in vals]
+            if applying_ch >= 0 and len(vals) > 1:
+                # a multi-value operand binds to the innermost dim; a
+                # single-channel selector makes that a shape mismatch,
+                # so keep the first value (and say so) instead of
+                # deferring to a numpy broadcast crash mid-stream
+                ml_logw("arithmetic %s@%d: multi-value operand %s "
+                        "reduced to its first value (per-channel "
+                        "selector takes one operand)", op, applying_ch,
+                        vals)
+                vals = vals[:1]
             ops.append((op, vals, applying_ch))
         else:
             # reference GTT_OP_UNKNOWN: warn and drop the op, keep the

@@ -36,6 +36,29 @@ class SyncMode(enum.Enum):
         return cls(str(s).strip().lower())
 
 
+def parse_sync_option(sync_option) -> "tuple[Optional[int], int]":
+    """Parse the mux/merge ``sync-option`` string into
+    ``(duration_ns, base_pad)``: ``'<basepad>:<duration_ns>'`` or a
+    bare ``'<duration_ns>'``.  Reference ssat spellings include
+    trailing junk (``sync-option=0:0.``) which g_ascii_strtoull
+    ignores — numbers here parse the leading digits and drop the rest
+    the same way (no digits at all parses as 0, as strtoull does)."""
+    import re
+
+    dur: Optional[int] = None
+    base_pad = 0
+    if sync_option not in (None, ""):
+        def num(s):
+            digits = re.match(r"\s*\+?(\d*)", str(s)).group(1)
+            return int(digits) if digits else 0
+        parts = str(sync_option).split(":")
+        if len(parts) >= 2:
+            base_pad, dur = num(parts[0]), num(parts[1])
+        else:
+            dur = num(parts[0])
+    return dur, base_pad
+
+
 class CollectPads:
     """Per-pad FIFOs + a sync policy; thread-safe (each upstream branch may
     chain from its own streaming thread, as with GStreamer collectpads)."""

@@ -197,9 +197,10 @@ class Element:
         self.pipeline = None  # set by Pipeline.add
         self._lock = threading.RLock()
         self._started = False
-        for key, spec in self.PROPERTIES.items():
-            default = spec[0] if isinstance(spec, tuple) else spec
-            setattr(self, key.replace("-", "_"), default)
+        for props_map in (self.UNIVERSAL_PROPERTIES, self.PROPERTIES):
+            for key, spec in props_map.items():
+                default = spec[0] if isinstance(spec, tuple) else spec
+                setattr(self, key.replace("-", "_"), default)
         self._make_pads()
         for k, v in props.items():
             self.set_property(k, v)
@@ -238,9 +239,19 @@ class Element:
         raise NotImplementedError(f"{self.FACTORY} has static pads")
 
     # -- properties ----------------------------------------------------------
+
+    #: properties EVERY reference element accepts (every nnstreamer
+    #: element inherits GObject "silent" for verbose-log suppression —
+    #: ssat launch lines set it liberally, so rejecting it would break
+    #: verbatim reference pipelines)
+    UNIVERSAL_PROPERTIES = {
+        "silent": (True, "suppress verbose per-element logging"),
+    }
+
     def set_property(self, key: str, value: Any) -> None:
         attr = key.replace("-", "_")
-        if key not in self.PROPERTIES and attr not in self.PROPERTIES:
+        if (key not in self.PROPERTIES and attr not in self.PROPERTIES
+                and key not in self.UNIVERSAL_PROPERTIES):
             raise AttributeError(f"{self.FACTORY}: no property {key!r}")
         setattr(self, attr, value)
 

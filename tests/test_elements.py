@@ -89,6 +89,91 @@ class TestTransform:
         assert out.shape == (2, 3, 4)   # dims 4:3:2
         np.testing.assert_array_equal(out, x.transpose(0, 2, 1))
 
+    def test_universal_silent_property(self):
+        """Every reference element inherits 'silent' — ssat launch
+        lines set it liberally, so rejecting it broke verbatim
+        pipelines."""
+        from nnstreamer_tpu import parse_launch
+
+        p = parse_launch(
+            "videotestsrc num-buffers=1 silent=TRUE ! "
+            "video/x-raw,format=RGB,width=4,height=4,framerate=30/1 ! "
+            "tensor_converter silent=true ! fakesink silent=false")
+        p.run(timeout=20)
+
+    def test_merge_verbatim_ssat_line(self):
+        """The reference's 'tensor_merge mode=linear option=2
+        silent=true sync-mode=basepad sync-option=0:0.' line verbatim:
+        merge needed the sync-option property, the tolerant trailing-
+        dot number parse, and the padded concat dim (option=2 against
+        rank-1 tensors used to AxisError in the data path while
+        set_caps padded the announced dims)."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer as TB
+
+        C = ("other/tensors,num_tensors=1,dimensions=4,types=uint8,"
+             "format=static,framerate=0/1")
+        p = parse_launch(
+            f"appsrc name=a caps={C} ! m.sink_0 "
+            f"appsrc name=b caps={C} ! m.sink_1 "
+            "tensor_merge name=m mode=linear option=2 silent=true "
+            "sync-mode=basepad sync-option=0:0. ! tensor_sink name=out")
+        p.play()
+        for i in range(2):
+            p.get("a").push(TB(tensors=[np.full(4, i, np.uint8)],
+                               pts=i * 10**8))
+            p.get("b").push(TB(tensors=[np.full(4, 10 + i, np.uint8)],
+                               pts=i * 10**8))
+        p.get("a").end_of_stream()
+        p.get("b").end_of_stream()
+        p.wait(timeout=30)
+        p.stop()
+        out = p.get("out").results[0].np(0)
+        assert out.shape == (2, 1, 4)     # NNS dims 4:1:2
+        np.testing.assert_array_equal(out[0, 0], np.zeros(4, np.uint8))
+        np.testing.assert_array_equal(out[1, 0],
+                                      np.full(4, 10, np.uint8))
+
+    def test_parse_sync_option_tolerant(self):
+        from nnstreamer_tpu.pipeline.clock import parse_sync_option
+
+        assert parse_sync_option(None) == (None, 0)
+        assert parse_sync_option("") == (None, 0)
+        assert parse_sync_option("0") == (0, 0)
+        assert parse_sync_option("1:33333333") == (33333333, 1)
+        assert parse_sync_option("0:0.") == (0, 0)   # ssat spelling
+        # g_ascii_strtoull tolerance: leading digits parse, junk drops
+        assert parse_sync_option("0:33333333ns") == (33333333, 0)
+        assert parse_sync_option("abc") == (0, 0)
+
+    def test_arith_padded_channel_keeps_dtype_mid_chain(self):
+        """The padded-ch_dim whole-tensor shortcut must write back in
+        the current dtype exactly like the in-range slice path (review
+        repro: uint8 5 div 2 mul 10 gave 25 on the padded branch vs 20
+        in-range)."""
+        x = np.full((2, 3), 5, dtype=np.uint8)
+        sink = run_chain(
+            tcaps("3:2", "uint8"),
+            TensorTransform("t", mode="arithmetic",
+                            option="per-channel:true@2,div:2@0,mul:10"),
+            [TensorBuffer(tensors=[x], pts=0)])
+        np.testing.assert_array_equal(
+            sink.results[0].np(0), np.full((2, 3), 20, np.uint8))
+
+    def test_arith_multivalue_with_channel_selector_reduces(self):
+        """'add:1,2,3@0' with per-channel: the selector takes one
+        operand — keep the first (warned) instead of a numpy broadcast
+        crash mid-stream."""
+        x = np.zeros((2, 3), dtype=np.float32)
+        sink = run_chain(
+            tcaps("3:2", "float32"),
+            TensorTransform("t", mode="arithmetic",
+                            option="per-channel:true@0,add:1,2,3@0"),
+            [TensorBuffer(tensors=[x], pts=0)])
+        want = np.zeros((2, 3), dtype=np.float32)
+        want[:, 0] = 1
+        np.testing.assert_array_equal(sink.results[0].np(0), want)
+
     def test_arith_per_channel_at_dim(self):
         """Reference grammar: 'per-channel:true@0,add:255@0' adds only
         to channel 0 along NNS dim 0 (the innermost = last numpy
