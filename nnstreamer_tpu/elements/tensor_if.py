@@ -35,6 +35,20 @@ _OPS = {
 _CUSTOM_CONDS: dict = {}
 
 
+def _norm(value, aliases: Optional[dict] = None) -> str:
+    """Reference launch lines spell tensor_if enum values in
+    UPPER_SNAKE (compared-value=A_VALUE operator=RANGE_INCLUSIVE
+    then=PASSTHROUGH — every ssat script does); normalize to this
+    module's lower-hyphen names so verbatim lines run."""
+    k = str(value).strip().lower().replace("_", "-")
+    return (aliases or {}).get(k, k)
+
+
+#: reference nick → this module's name, post-normalization
+_CV_ALIASES = {"tensor-average-value": "tensor-average"}
+_BEHAVIOR_ALIASES = {"fill-with-zero": "fill-zero"}
+
+
 def register_if_custom(name: str, fn: Callable[[TensorBuffer], bool]) -> None:
     """Custom condition callback (reference tensor_if.h custom API)."""
     _CUSTOM_CONDS[name] = fn
@@ -64,17 +78,28 @@ class TensorIf(Element):
         return self.add_src_pad(static_tensors_caps(), "src_1")
 
     def start(self):
-        op = str(self.operator)
+        # enum spellings resolve ONCE here (the chain() hot path must
+        # not re-normalize per buffer), and bad spellings fail the
+        # pipeline at start, not mid-stream
+        op = _norm(self.operator)
         if op not in _OPS:
-            raise ValueError(f"unknown operator {op}")
+            raise ValueError(f"unknown operator {self.operator}")
         self._op = _OPS[op]
+        self._cv = _norm(self.compared_value, _CV_ALIASES)
+        self._then = _norm(self.then, _BEHAVIOR_ALIASES)
+        self._else = _norm(getattr(self, "else"), _BEHAVIOR_ALIASES)
+        for raw, b in ((self.then, self._then),
+                       (getattr(self, "else"), self._else)):
+            if b not in ("passthrough", "skip", "fill-zero",
+                         "tensorpick"):
+                raise ValueError(f"unknown behavior {raw!r}")
         sup = str(self.supplied_value or "0")
         vals = [float(x) for x in sup.split(",")]
         self._a = vals[0]
         self._b = vals[1] if len(vals) > 1 else vals[0]
 
     def _compared_value(self, buf: TensorBuffer) -> float:
-        cv = str(self.compared_value)
+        cv = self._cv
         opt = self.compared_value_option
         if cv == "custom":
             fn = _CUSTOM_CONDS.get(str(opt))
@@ -112,11 +137,10 @@ class TensorIf(Element):
         v = self._compared_value(buf)
         cond = bool(self._op(v, self._a, self._b))
         if cond:
-            out = self._apply_behavior(str(self.then), self.then_option, buf)
+            out = self._apply_behavior(self._then, self.then_option, buf)
             target = self.src_pads[0]
         else:
-            out = self._apply_behavior(str(getattr(self, "else")),
-                                       self.else_option, buf)
+            out = self._apply_behavior(self._else, self.else_option, buf)
             target = (self.src_pads[1] if len(self.src_pads) > 1
                       else self.src_pads[0])
         if out is None:
@@ -129,8 +153,8 @@ class TensorIf(Element):
         from ..tensor.info import TensorsConfig, TensorsInfo
 
         cfg = config_from_caps(caps)
-        behaviors = [(str(self.then), self.then_option),
-                     (str(getattr(self, "else")), self.else_option)]
+        behaviors = [(self._then, self.then_option),
+                     (self._else, self.else_option)]
         for sp, (behavior, option) in zip(self.src_pads, behaviors):
             if behavior == "tensorpick" and cfg.info.num_tensors:
                 picks = [int(x) for x in str(option).split(",")]

@@ -89,6 +89,38 @@ class TestTransform:
         assert out.shape == (2, 3, 4)   # dims 4:3:2
         np.testing.assert_array_equal(out, x.transpose(0, 2, 1))
 
+    def test_tensor_if_reference_enum_spellings(self):
+        """Every ssat tensor_if line spells enums UPPER_SNAKE
+        (A_VALUE, TENSOR_AVERAGE_VALUE, RANGE_INCLUSIVE, PASSTHROUGH,
+        TENSORPICK) — verbatim lines must run against our lower-hyphen
+        names."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer as TB
+
+        C = ("other/tensors,num_tensors=1,dimensions=2:2,types=uint8,"
+             "format=static,framerate=0/1")
+        for line, expect in [
+            ("compared-value=A_VALUE compared-value-option=0:0:0:0,0 "
+             "supplied-value=0,127 operator=RANGE_INCLUSIVE "
+             "then=PASSTHROUGH else=SKIP", 1),
+            ("compared-value=TENSOR_AVERAGE_VALUE "
+             "compared-value-option=0 supplied-value=100 operator=LT "
+             "then=PASSTHROUGH else=SKIP", 1),
+            ("compared-value=TENSOR_AVERAGE_VALUE "
+             "compared-value-option=0 supplied-value=1 operator=LT "
+             "then=PASSTHROUGH else=SKIP", 0),   # 5 >= 1: else=SKIP
+        ]:
+            p = parse_launch(f"appsrc name=s caps={C} ! "
+                             f"tensor_if name=tif {line} ! "
+                             "tensor_sink name=o")
+            p.play()
+            p.get("s").push(TB(tensors=[np.full((2, 2), 5, np.uint8)],
+                               pts=0))
+            p.get("s").end_of_stream()
+            p.wait(timeout=30)
+            p.stop()
+            assert len(p.get("o").results) == expect, line
+
     def test_universal_silent_property(self):
         """Every reference element inherits 'silent' — ssat launch
         lines set it liberally, so rejecting it broke verbatim
