@@ -48,6 +48,9 @@ class TensorFilter(Element):
         "output-type": (None, "forced output types"),
         "accelerator": (None, "e.g. true:tpu"),
         "custom": (None, "key:value,... custom properties"),
+        "inputname": (None, "graph input tensor name(s) (reference "
+                            "property; merged into custom props)"),
+        "outputname": (None, "graph output tensor name(s)"),
         "input-combination": (None, "indices of input tensors to feed"),
         "output-combination": (None, "i0,i1/o0,o1 passthrough+output mix"),
         "shared-tensor-filter-key": (None, "share backend across instances"),
@@ -71,6 +74,24 @@ class TensorFilter(Element):
                                  "d2h per batch on first touch"),
     }
 
+    #: the reference's own property names for the same settings
+    #: (gsttensor_filter_common: "input"/"inputtype"/"output"/
+    #: "outputtype" set forced dims/types, "inputname"/"outputname"
+    #: select graph tensors) — every custom-filter ssat line uses the
+    #: short spellings, so they must work verbatim
+    REFERENCE_PROP_ALIASES = {
+        "input": "input-dim", "inputtype": "input-type",
+        "output": "output-dim", "outputtype": "output-type",
+    }
+
+    def set_property(self, key, value):
+        super().set_property(self.REFERENCE_PROP_ALIASES.get(key, key),
+                             value)
+
+    def get_property(self, key):
+        return super().get_property(
+            self.REFERENCE_PROP_ALIASES.get(key, key))
+
     def _make_pads(self):
         self.add_sink_pad(static_tensors_caps(), "sink")
         self.add_src_pad(static_tensors_caps(), "src")
@@ -84,11 +105,18 @@ class TensorFilter(Element):
         if self.output_dim and self.output_type:
             out_info = TensorsInfo.from_strings(str(self.output_dim),
                                                 str(self.output_type))
+        custom = FilterProperties.parse_custom(self.custom)
+        # "inputname=data" / "outputname=prob" are first-class
+        # reference properties; backends read them from the custom map
+        for key in ("inputname", "outputname"):
+            val = getattr(self, key, None)
+            if val not in (None, "") and key not in custom:
+                custom[key] = str(val)
         props = FilterProperties(
             framework=str(self.framework or "auto"), model=self.model,
             input_info=in_info, output_info=out_info,
             accelerators=Accelerator.parse(self.accelerator),
-            custom_properties=FilterProperties.parse_custom(self.custom),
+            custom_properties=custom,
             shared_key=self.shared_tensor_filter_key)
         self.fw = open_backend(props)
         self._props = props

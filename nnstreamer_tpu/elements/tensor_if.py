@@ -86,6 +86,9 @@ class TensorIf(Element):
             raise ValueError(f"unknown operator {self.operator}")
         self._op = _OPS[op]
         self._cv = _norm(self.compared_value, _CV_ALIASES)
+        if self._cv not in ("a-value", "tensor-average", "custom"):
+            raise ValueError(
+                f"unknown compared-value {self.compared_value!r}")
         self._then = _norm(self.then, _BEHAVIOR_ALIASES)
         self._else = _norm(getattr(self, "else"), _BEHAVIOR_ALIASES)
         for raw, b in ((self.then, self._then),
@@ -97,6 +100,16 @@ class TensorIf(Element):
         vals = [float(x) for x in sup.split(",")]
         self._a = vals[0]
         self._b = vals[1] if len(vals) > 1 else vals[0]
+
+    def set_property(self, key, value):
+        super().set_property(key, value)
+        # properties stay runtime-mutable (GObject semantics): a set
+        # on a PLAYING element re-resolves the enum snapshot start()
+        # froze for the hot path
+        if hasattr(self, "_op") and key in (
+                "operator", "compared-value", "then", "else",
+                "supplied-value"):
+            self.start()
 
     def _compared_value(self, buf: TensorBuffer) -> float:
         cv = self._cv

@@ -121,6 +121,28 @@ class TestTransform:
             p.stop()
             assert len(p.get("o").results) == expect, line
 
+    def test_tensor_if_bad_compared_value_fails_at_start(self):
+        import pytest
+
+        from nnstreamer_tpu.elements.tensor_if import TensorIf
+
+        el = TensorIf("t", **{"compared-value": "AVERAGE_VALUE"})
+        with pytest.raises(ValueError, match="compared-value"):
+            el.start()
+
+    def test_tensor_if_runtime_property_set_re_resolves(self):
+        """GObject properties are runtime-mutable: a set on a started
+        element updates the enum snapshot the hot path uses."""
+        from nnstreamer_tpu.elements.tensor_if import TensorIf
+
+        el = TensorIf("t", **{"operator": "GT", "supplied-value": "3"})
+        el.start()
+        assert el._op(5, el._a, el._b)
+        el.set_property("operator", "LT")
+        assert not el._op(5, el._a, el._b)
+        el.set_property("then", "TENSORPICK")
+        assert el._then == "tensorpick"
+
     def test_universal_silent_property(self):
         """Every reference element inherits 'silent' — ssat launch
         lines set it liberally, so rejecting it broke verbatim

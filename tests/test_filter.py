@@ -292,3 +292,79 @@ class TestReloadPropMerge:
         fw.handle_event("reload_model", {"model": "model_a"})
         props = opened[-1]
         assert props.custom_properties["checkpoint"] == "/ckpt_a"
+
+
+class TestReferencePropertySpellings:
+    """The reference's own tensor_filter property names must work
+    verbatim: every custom-filter ssat line uses input=/inputtype=/
+    output=/outputtype= (gsttensor_filter_common), and the
+    tensorflow/caffe2 scripts set inputname=/outputname= as first-class
+    properties."""
+
+    def test_input_output_aliases(self):
+        import numpy as np
+
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+        from nnstreamer_tpu.tensor.info import TensorsInfo
+
+        info = TensorsInfo.from_strings("4:3:1:1", "float32")
+        register_custom_easy("aliaspass", lambda ins: ins, info, info)
+        try:
+            C = ("other/tensors,num_tensors=1,dimensions=4:3:1:1,"
+                 "types=float32,format=static,framerate=0/1")
+            p = parse_launch(
+                f"appsrc name=s caps={C} ! "
+                "tensor_filter framework=custom-easy model=aliaspass "
+                "input=4:3:1:1 inputtype=float32 "
+                "output=4:3:1:1 outputtype=float32 ! "
+                "tensor_sink name=o")
+            p.play()
+            p.get("s").push(TensorBuffer(
+                tensors=[np.ones((1, 1, 3, 4), np.float32)], pts=0))
+            p.get("s").end_of_stream()
+            p.wait(timeout=30)
+            p.stop()
+            assert len(p.get("o").results) == 1
+        finally:
+            unregister_custom_easy("aliaspass")
+
+    def test_inputname_outputname_merge_into_custom(self):
+        """The PRODUCTION start() merge: inputname=/outputname= land in
+        the backend's custom map, with an explicit custom= key winning
+        over the property."""
+        from nnstreamer_tpu.elements.filter_elem import TensorFilter
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+        from nnstreamer_tpu.tensor.info import TensorsInfo
+
+        info = TensorsInfo.from_strings("4", "float32")
+        register_custom_easy("namesink", lambda ins: ins, info, info)
+        try:
+            el = TensorFilter("f", framework="custom-easy",
+                              model="namesink", inputname="data",
+                              outputname="prob")
+            el.start()
+            assert el._props.custom_properties["inputname"] == "data"
+            assert el._props.custom_properties["outputname"] == "prob"
+            el.stop()
+            el2 = TensorFilter("f2", framework="custom-easy",
+                              model="namesink",
+                              custom="inputname:graphin",
+                              inputname="data")
+            el2.start()
+            assert (el2._props.custom_properties["inputname"]
+                    == "graphin")
+            el2.stop()
+        finally:
+            unregister_custom_easy("namesink")
+
+    def test_reference_alias_readback(self):
+        from nnstreamer_tpu.elements.filter_elem import TensorFilter
+
+        el = TensorFilter("f", framework="custom-easy", model="x")
+        el.set_property("input", "4:3:1:1")
+        assert el.get_property("input") == "4:3:1:1"
+        assert el.get_property("input-dim") == "4:3:1:1"
