@@ -150,8 +150,18 @@ class QueryConnection:
 class TensorQueryClient(Element):
     FACTORY = "tensor_query_client"
     PROPERTIES = {
-        "host": ("127.0.0.1", "server host"),
-        "port": (0, "server port"),
+        "host": ("127.0.0.1", "server host (reference: the client's "
+                              "own bind address; kept as the server "
+                              "fallback when dest-* is unset)"),
+        "port": (0, "server port (fallback when dest-port unset)"),
+        "dest-host": (None, "server host (TCP) or MQTT broker host "
+                            "(HYBRID) — the reference's addressing: "
+                            "every ssat line uses dest-host/dest-port"),
+        "dest-port": (None, "server/broker port"),
+        "connect-type": ("tcp", "TCP | HYBRID (reference nicks; hybrid "
+                                "discovers the data address from the "
+                                "retained MQTT record for the topic)"),
+        "topic": (None, "hybrid: discovery topic"),
         "timeout": (10.0, "reply timeout seconds"),
         "max-retries": (3, "connect retries"),
     }
@@ -160,8 +170,46 @@ class TensorQueryClient(Element):
         self.add_sink_pad(tensors_template_caps(), "sink")
         self.add_src_pad(tensors_template_caps(), "src")
 
+    def _server_address(self) -> "tuple[str, int]":
+        """Resolve the data-channel address the reference way: HYBRID
+        looks up the retained record for the topic on the MQTT broker
+        at dest-host:dest-port (tensor_query_client.c via
+        nnstreamer-edge); TCP takes dest-host:dest-port directly, with
+        the legacy host/port pair as fallback."""
+        if str(self.connect_type).lower() == "hybrid":
+            from .mqtt import fetch_retained_record
+
+            if self.topic in (None, ""):
+                raise ValueError(f"{self.name}: connect-type=HYBRID "
+                                 "requires topic")
+            broker_host = str(self.dest_host or "127.0.0.1")
+            broker_port = int(self.dest_port or 1883)
+            record = fetch_retained_record(
+                broker_host, broker_port, f"nns/query/{self.topic}",
+                float(self.timeout), f"nns-query-cli-{self.name}")
+            if not record:
+                raise ConnectionError(
+                    f"{self.name}: no retained discovery record for "
+                    f"topic {self.topic!r} on "
+                    f"{broker_host}:{broker_port}")
+            host, sep, port = record.decode().rpartition(":")
+            if not sep or not port.isdigit():
+                raise ConnectionError(
+                    f"{self.name}: malformed discovery record "
+                    f"{record!r} (want host:port)")
+            return host, int(port)
+        if self.dest_port not in (None, "", 0):
+            return str(self.dest_host or "127.0.0.1"), int(self.dest_port)
+        if self.dest_host not in (None, ""):
+            # silently connecting to the legacy host/port when only
+            # dest-host was given would hit the wrong machine
+            raise ValueError(f"{self.name}: dest-host={self.dest_host!r} "
+                             "needs dest-port")
+        return str(self.host), int(self.port)
+
     def start(self):
-        self.conn = QueryConnection(str(self.host), int(self.port),
+        host, port = self._server_address()
+        self.conn = QueryConnection(host, port,
                                     float(self.timeout),
                                     int(self.max_retries))
         self.conn.connect()

@@ -282,26 +282,22 @@ class EdgeSrc(Source):
         self.add_src_pad(tensors_template_caps(), "src")
 
     def _discover_hybrid(self) -> None:
-        """Resolve host/port from the retained MQTT discovery record."""
-        from .mqtt import MqttClient
+        """Resolve host/port from the retained MQTT discovery record
+        (bounded wait mirroring the TCP path's 10 s connect timeout)."""
+        from .mqtt import fetch_retained_record
 
-        client = MqttClient(str(self.mqtt_host), int(self.mqtt_port),
-                            f"nns-edge-src-{self.name}")
-        try:
-            client.subscribe(f"nns/edge/{self.topic}")
-            # bound the wait: with no retained record the broker sends
-            # nothing (mirrors the TCP path's 10 s connect timeout)
-            client._sock.settimeout(10)
-            got = client.recv_publish()
-            if got is None or not got[1]:
-                raise ValueError(
-                    f"{self.name}: no retained discovery record on "
-                    f"nns/edge/{self.topic}")
-            addr = got[1].decode()
-            host, _, port = addr.rpartition(":")
-            self.host, self.port = host, int(port)
-        finally:
-            client.close()
+        record = fetch_retained_record(
+            str(self.mqtt_host), int(self.mqtt_port),
+            f"nns/edge/{self.topic}", 10.0, f"nns-edge-src-{self.name}")
+        if not record:
+            raise ValueError(
+                f"{self.name}: no retained discovery record on "
+                f"nns/edge/{self.topic}")
+        host, sep, port = record.decode().rpartition(":")
+        if not sep or not port.isdigit():
+            raise ValueError(f"{self.name}: malformed discovery record "
+                             f"{record!r} (want host:port)")
+        self.host, self.port = host, int(port)
 
     def start(self):
         from ..utils.ntp import stream_origin_epoch_us

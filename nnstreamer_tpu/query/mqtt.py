@@ -221,6 +221,25 @@ class MqttClient:
             pass
 
 
+def fetch_retained_record(host: str, port: int, topic: str,
+                          timeout: float, client_id: str):
+    """One-shot hybrid-discovery read: connect to the broker, subscribe
+    to ``topic``, and wait (bounded by ``timeout`` — covering the
+    SUBACK handshake too, so a wedged broker cannot hang the caller
+    indefinitely) for the retained record.  Returns the payload bytes,
+    or None when the broker has no record.  Shared by edge_src and
+    tensor_query_client HYBRID discovery (one copy of the
+    subscribe/wait/parse sequence to keep in sync)."""
+    client = MqttClient(host, port, client_id)
+    try:
+        client._sock.settimeout(timeout)
+        client.subscribe(topic)
+        got = client.recv_publish()
+        return got[1] if got else None
+    finally:
+        client.close()
+
+
 class MqttBroker:
     """Minimal in-process MQTT 3.1.1 broker (QoS 0, exact-topic match) —
     the localhost broker the reference's MQTT tests gate on

@@ -154,6 +154,17 @@ class TensorQueryServerSrc(Source):
         "port": (0, "0 = ephemeral"),
         "id": (0, "server table id"),
         "caps": (None, "caps announced for received tensors"),
+        "connect-type": ("tcp", "TCP | HYBRID (reference nicks; hybrid "
+                                "advertises this server's address as a "
+                                "retained MQTT record under the topic)"),
+        "dest-host": ("127.0.0.1", "hybrid: MQTT broker host"),
+        "dest-port": (1883, "hybrid: MQTT broker port"),
+        "topic": (None, "hybrid: discovery topic"),
+        "advertise-host": (None, "address to advertise in the hybrid "
+                                 "record (default: host — set it when "
+                                 "bound to 0.0.0.0, which is not a "
+                                 "reachable address for remote "
+                                 "clients)"),
     }
 
     def _make_pads(self):
@@ -164,6 +175,37 @@ class TensorQueryServerSrc(Source):
                                  int(self.port))
         if self.caps:
             self.server.set_caps_string(str(self.caps))
+        self._mqtt = None
+        if str(self.connect_type).lower() == "hybrid":
+            # reference HYBRID (tensor_query_serversrc.c via
+            # nnstreamer-edge): dest-host/dest-port address the MQTT
+            # broker; the server advertises its own data address as a
+            # retained record so clients discover it by topic alone
+            from .mqtt import MqttClient
+
+            if self.topic in (None, ""):
+                raise ValueError(f"{self.name}: connect-type=HYBRID "
+                                 "requires topic")
+            self._mqtt = MqttClient(str(self.dest_host),
+                                    int(self.dest_port),
+                                    f"nns-query-srv-{self.name}")
+            adv = str(self.advertise_host or self.host)
+            self._mqtt.publish(
+                f"nns/query/{self.topic}",
+                f"{adv}:{self.server.port}".encode(), retain=True)
+
+    def stop(self):
+        if getattr(self, "_mqtt", None) is not None:
+            try:
+                # clear the retained record: late clients must see "no
+                # record", not a dead address
+                self._mqtt.publish(f"nns/query/{self.topic}", b"",
+                                   retain=True)
+            except OSError:
+                pass
+            self._mqtt.close()
+            self._mqtt = None
+        super().stop()
 
     @property
     def bound_port(self) -> int:

@@ -95,6 +95,72 @@ class TestQueryRoundTrip:
         with pytest.raises(ConnectionError):
             conn.connect()
 
+    def test_reference_dest_addressing(self, serving_pipeline):
+        """Every reference ssat query line addresses the server with
+        dest-host/dest-port ('tensor_query_client dest-port=${PORT}')
+        — host/port are the client's own bind there, so misreading
+        them as the server address breaks verbatim lines."""
+        server, port = serving_pipeline
+        p = Pipeline("client")
+        src = AppSrc("src", caps=tcaps())
+        qc = TensorQueryClient("qc", **{"dest-host": "127.0.0.1",
+                                        "dest-port": port, "port": 0,
+                                        "timeout": 10.0})
+        sink = TensorSink("out")
+        p.add(src, qc, sink)
+        p.link(src, qc, sink)
+        src.push_buffer(TensorBuffer(
+            tensors=[np.full(4, 3, np.float32)], pts=0))
+        src.end_of_stream()
+        p.run(timeout=15)
+        np.testing.assert_array_equal(sink.results[0].np(0),
+                                      np.full(4, 6, np.float32))
+
+    def test_dest_host_without_port_is_loud(self):
+        """dest-host without dest-port must not silently fall back to
+        the legacy host/port pair (it would hit the wrong machine)."""
+        qc = TensorQueryClient("qc", **{"dest-host": "10.0.0.5"})
+        with pytest.raises(ValueError, match="dest-port"):
+            qc._server_address()
+
+    def test_hybrid_discovery_round_trip(self):
+        """connect-type=HYBRID (the reference ssat hybrid line): the
+        serversrc advertises its data address as a retained MQTT record
+        under the topic; the client knows ONLY the broker + topic."""
+        from nnstreamer_tpu.query.mqtt import get_mqtt_broker
+
+        mq = get_mqtt_broker()
+        sid = 77
+        server = Pipeline("server")
+        qsrc = TensorQueryServerSrc(
+            "qsrc", id=sid, port=0, caps=tcaps(),
+            **{"connect-type": "HYBRID", "topic": "qhy",
+               "dest-host": "127.0.0.1", "dest-port": mq.port})
+        t = TensorTransform("t", mode="arithmetic", option="mul:2")
+        qsink = TensorQueryServerSink("qsink", id=sid)
+        server.add(qsrc, t, qsink)
+        server.link(qsrc, t, qsink)
+        server.play()
+        try:
+            p = Pipeline("client")
+            src = AppSrc("src", caps=tcaps())
+            qc = TensorQueryClient(
+                "qc", **{"connect-type": "HYBRID", "topic": "qhy",
+                         "dest-host": "127.0.0.1",
+                         "dest-port": mq.port, "timeout": 10.0})
+            sink = TensorSink("out")
+            p.add(src, qc, sink)
+            p.link(src, qc, sink)
+            src.push_buffer(TensorBuffer(
+                tensors=[np.full(4, 5, np.float32)], pts=0))
+            src.end_of_stream()
+            p.run(timeout=20)
+            np.testing.assert_array_equal(sink.results[0].np(0),
+                                          np.full(4, 10, np.float32))
+        finally:
+            server.stop()
+            shutdown_server(sid)
+
 
 class TestTrainer:
     def test_trainer_pipeline(self, tmp_path):
