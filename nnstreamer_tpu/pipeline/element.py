@@ -246,6 +246,10 @@ class Element:
     #: verbatim reference pipelines)
     UNIVERSAL_PROPERTIES = {
         "silent": (True, "suppress verbose per-element logging"),
+        "async": (False, "GstBaseSink async state-change flag, accepted "
+                         "for launch-line parity (ssat sinks set "
+                         "async=false everywhere; state changes here "
+                         "are synchronous regardless)"),
     }
 
     def set_property(self, key: str, value: Any) -> None:

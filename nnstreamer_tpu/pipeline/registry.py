@@ -34,6 +34,13 @@ def element_factory(name: str) -> Type[Element]:
     return _FACTORIES[name]
 
 
+def register_element_alias(alias: str, cls: Type[Element]) -> None:
+    """Second factory name for the same class (the reference registers
+    ``edgesink``/``edgesrc`` without the underscore our canonical
+    names use — verbatim reference launch lines need both)."""
+    _FACTORIES[alias] = cls
+
+
 def make_element(name: str, element_name=None, **props) -> Element:
     return element_factory(name)(element_name, **props)
 

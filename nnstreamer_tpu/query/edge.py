@@ -21,7 +21,8 @@ from typing import Dict, Optional, Set
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
-from ..pipeline.registry import register_element
+from ..pipeline.registry import (register_element,
+                                 register_element_alias)
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import tensors_template_caps
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, decode_tensors,
@@ -159,6 +160,39 @@ def shutdown_brokers() -> None:
         _BROKERS.clear()
 
 
+
+def _resolve_reference_dest(el) -> str:
+    """Reference addressing (edge_sink.c/edge_src.c): dest-host/
+    dest-port name the broker the element connects to — the TCP data
+    broker for connect-type=TCP, the MQTT broker for HYBRID — and the
+    connect-type nick is spelled UPPER-case in every ssat line.  Maps
+    dest-* onto the canonical host/port or mqtt-host/mqtt-port pair
+    and returns the normalized connect type ('aitt' is the dropped
+    Tizen-only transport: a named error, not a silent TCP fallback)."""
+    ctype = str(el.connect_type or "tcp").strip().lower()
+    if ctype == "aitt":
+        raise ValueError(
+            f"{el.name}: connect-type=AITT is the Tizen-only transport "
+            "this framework drops — use TCP or HYBRID")
+    if not (el.dest_port in (None, "", 0) and el.dest_host in (None, "")):
+        host = str(el.dest_host or "127.0.0.1")
+        port = el.dest_port
+        if ctype == "hybrid":
+            # dest-* is the MQTT broker; its well-known port is the
+            # default when only dest-host was given
+            el.mqtt_host = host
+            el.mqtt_port = int(port) if port not in (None, "", 0) else 1883
+        else:
+            if port in (None, "", 0):
+                # a silent port-0 connect would be an opaque OSError on
+                # the wrong machine (same guard as tensor_query_client)
+                raise ValueError(f"{el.name}: dest-host={host!r} needs "
+                                 "dest-port")
+            el.host = host
+            el.port = int(port)
+    return ctype
+
+
 @register_element
 class EdgeSink(Element):
     """Publish the stream to a broker topic (edge_sink role).
@@ -183,6 +217,11 @@ class EdgeSink(Element):
                                  "in the hybrid discovery record (default: "
                                  "the host property — loopback only "
                                  "reaches same-host subscribers)"),
+        "dest-host": (None, "reference addressing: the TCP broker "
+                            "(connect-type=TCP) or the MQTT broker "
+                            "(HYBRID) — resolves onto host/mqtt-host "
+                            "at start"),
+        "dest-port": (None, "reference addressing: broker port"),
         "ntp-host": (None, "NTP server(s) for epoch alignment, comma-sep "
                            "(default: local wall clock)"),
     }
@@ -193,6 +232,15 @@ class EdgeSink(Element):
     def start(self):
         from ..utils.ntp import stream_origin_epoch_us
 
+        self._ctype = _resolve_reference_dest(self)
+        if self._ctype == "hybrid" and int(self.port or 0) == 0:
+            # verbatim reference HYBRID sink lines configure ONLY the
+            # MQTT broker (dest-*): there the sink itself is the data
+            # endpoint, so with no data broker configured start an
+            # in-process one and advertise it — subscribers discover
+            # whatever address the record carries either way
+            broker = get_broker()
+            self.host, self.port = broker.host, broker.port
         self._sock = socket.create_connection(
             (str(self.host), int(self.port)), timeout=10)
         # publisher sockets only SEND: keep a bounded (long) send timeout
@@ -207,7 +255,7 @@ class EdgeSink(Element):
         # base_time_epoch (mqttsink.c, synchronization-in-mqtt-elements.md)
         self._base_epoch_us = stream_origin_epoch_us(self.ntp_host, self.name)
         self._mqtt = None
-        if str(self.connect_type) == "hybrid":
+        if self._ctype == "hybrid":
             from .mqtt import MqttClient
 
             self._mqtt = MqttClient(str(self.mqtt_host),
@@ -271,6 +319,10 @@ class EdgeSrc(Source):
         "connect-type": ("tcp", "tcp | hybrid (MQTT discovery + TCP data)"),
         "mqtt-host": ("127.0.0.1", "MQTT broker host (connect-type=hybrid)"),
         "mqtt-port": (1883, "MQTT broker port (connect-type=hybrid)"),
+        "dest-host": (None, "reference addressing: the TCP broker "
+                            "(connect-type=TCP) or the MQTT broker "
+                            "(HYBRID)"),
+        "dest-port": (None, "reference addressing: broker port"),
         "caps": (None, "override caps (else retained topic caps)"),
         "num-buffers": (-1, "stop after N buffers, -1 unlimited"),
         "sync-pts": (False, "re-base incoming PTS onto this host's clock "
@@ -302,10 +354,11 @@ class EdgeSrc(Source):
     def start(self):
         from ..utils.ntp import stream_origin_epoch_us
 
+        self._ctype = _resolve_reference_dest(self)
         # own stream-origin epoch, for re-basing sender PTS (the receiver
         # half of the reference's NTP-based mqtt timestamp alignment)
         self._base_epoch_us = stream_origin_epoch_us(self.ntp_host, self.name)
-        if str(self.connect_type) == "hybrid":
+        if self._ctype == "hybrid":
             self._discover_hybrid()
         self._sock = socket.create_connection(
             (str(self.host), int(self.port)), timeout=10)
@@ -381,3 +434,10 @@ class EdgeSrc(Source):
                 self._count += 1
             return item
         return None
+
+
+# the reference registers these factories WITHOUT the underscore
+# (gst/edge/edge_elements.c) — verbatim reference launch lines use
+# `edgesink`/`edgesrc`
+register_element_alias("edgesink", EdgeSink)
+register_element_alias("edgesrc", EdgeSrc)

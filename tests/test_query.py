@@ -488,3 +488,94 @@ class TestQueryTwoProcess:
             capture_output=True, text=True, timeout=120, env=env)
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "CHILD_OK" in proc.stdout
+
+
+class TestReferenceEdgeSpellings:
+    """The reference registers `edgesink`/`edgesrc` (no underscore,
+    gst/edge/edge_elements.c) and its ssat lines address the broker as
+    dest-host/dest-port with UPPER connect-type nicks and async=false
+    — all must work verbatim."""
+
+    def test_verbatim_edge_lines_round_trip(self):
+        import time
+
+        from nnstreamer_tpu.query.edge import get_broker
+
+        tcp = get_broker()
+        C = ("other/tensors,num_tensors=1,dimensions=4,types=float32,"
+             "format=static,framerate=0/1")
+        tx = parse_launch(
+            f"appsrc caps={C} name=in ! "
+            "edgesink port=0 connect-type=TCP dest-host=127.0.0.1 "
+            f"dest-port={tcp.port} topic=tempTopic async=false")
+        tx.play()
+        time.sleep(0.2)
+        rx = parse_launch(
+            f"edgesrc dest-port={tcp.port} topic=tempTopic "
+            "num-buffers=2 name=rx ! tensor_sink name=out")
+        rx.play()
+        time.sleep(0.2)
+        for i in range(2):
+            tx.get("in").push_buffer(TensorBuffer(
+                tensors=[np.full(4, float(i), np.float32)]))
+        tx.get("in").end_of_stream()
+        rx.wait(timeout=30)
+        tx.wait(timeout=30)
+        rx.stop()
+        tx.stop()
+        assert len(rx.get("out").results) == 2
+
+    def test_aitt_is_a_named_drop(self):
+        import pytest
+
+        from nnstreamer_tpu.query.edge import EdgeSink
+
+        el = EdgeSink("e", **{"connect-type": "AITT",
+                              "dest-host": "127.0.0.1",
+                              "dest-port": 1, "topic": "t"})
+        with pytest.raises(ValueError, match="AITT"):
+            el.start()
+
+    def test_verbatim_hybrid_edge_lines(self):
+        """The EXACT reference HYBRID shape: both halves configure ONLY
+        the MQTT broker (dest-*) — the sink auto-starts an in-process
+        data broker, advertises it as the retained record, and the src
+        discovers it by topic."""
+        import time
+
+        from nnstreamer_tpu.query.mqtt import get_mqtt_broker
+
+        mq = get_mqtt_broker()
+        C = ("other/tensors,num_tensors=1,dimensions=4,types=float32,"
+             "format=static,framerate=0/1")
+        tx = parse_launch(
+            f"appsrc caps={C} name=in ! "
+            "edgesink port=0 connect-type=HYBRID dest-host=127.0.0.1 "
+            f"dest-port={mq.port} topic=hvbt async=false")
+        tx.play()
+        time.sleep(0.3)
+        rx = parse_launch(
+            "edgesrc port=0 connect-type=HYBRID dest-host=127.0.0.1 "
+            f"dest-port={mq.port} topic=hvbt num-buffers=2 name=rx ! "
+            "tensor_sink name=out")
+        rx.play()
+        time.sleep(0.3)
+        for i in range(2):
+            tx.get("in").push_buffer(TensorBuffer(
+                tensors=[np.full(4, float(i), np.float32)]))
+        tx.get("in").end_of_stream()
+        rx.wait(timeout=30)
+        tx.wait(timeout=30)
+        rx.stop()
+        tx.stop()
+        assert len(rx.get("out").results) == 2
+
+    def test_edge_dest_host_without_port_tcp_is_loud(self):
+        import pytest
+
+        from nnstreamer_tpu.query.edge import EdgeSrc
+
+        el = EdgeSrc("e", **{"connect-type": "TCP",
+                             "dest-host": "10.0.0.2", "topic": "t"})
+        with pytest.raises(ValueError, match="dest-port"):
+            el.start()
