@@ -130,9 +130,31 @@ class VideoTestSrc(Source):
         self._count += 1
         return buf
 
+    #: GStreamer videotestsrc numeric pattern ids → nearest pattern
+    #: here (ssat lines say pattern=13/15/18; byte-goldens cannot be
+    #: verbatim-portable anyway — gst's pixel generators are its own —
+    #: but the launch lines must RUN with a deterministic look-alike)
+    GST_PATTERN_IDS = {
+        0: "smpte", 1: "random", 2: "black", 3: "white", 7: "checkers",
+        8: "checkers", 9: "checkers", 10: "checkers", 11: "gradient",
+        13: "smpte", 14: "gradient", 15: "gradient", 16: "gradient",
+        17: "solid", 18: "checkers", 19: "smpte", 20: "smpte",
+        23: "gradient",
+    }
+
     def _render(self, n: int) -> np.ndarray:
         w, h, ch = self._w, self._h, _CHANNELS[self._format]
         pattern = str(self.pattern)
+        try:
+            pattern = self.GST_PATTERN_IDS.get(int(pattern), "smpte")
+        except ValueError:
+            pass                      # a name, not a numeric gst id
+        if pattern in ("black", "white"):
+            px = np.full((h, w, ch), 0 if pattern == "black" else 255,
+                         dtype=np.uint8)
+            if ch == 4:
+                px[..., 3] = 255
+            return px
         if pattern == "random":
             return self._rng.integers(0, 256, (h, w, ch), dtype=np.uint8)
         if pattern == "solid":

@@ -430,6 +430,13 @@ class MqttSrc(Source):
         "num-buffers": (-1, "stop after N buffers, -1 unlimited"),
         "sync-pts": (False, "re-base incoming PTS onto this host's clock"),
         "ntp-host": (None, "NTP server(s) for epoch alignment, comma-sep"),
+        # reference mqttsrc launch-line parity (ssat sets both): debug
+        # toggles its verbose logging, is-live marks the live-source
+        # flag — this source is always live, the flags are accepted
+        # state
+        "debug": (False, "reference mqttsrc debug flag"),
+        "is-live": (True, "reference live-source flag (always live "
+                          "here)"),
     }
 
     def _make_pads(self):
