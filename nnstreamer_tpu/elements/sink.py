@@ -182,3 +182,44 @@ class FileSink(Element):
         if isinstance(event, EOSEvent):
             self._f.flush()
             self.post_eos_reached()
+
+
+@register_element
+class MultiFileSink(Element):
+    """One file PER BUFFER at ``location % index`` (GStreamer
+    multifilesink role — the ssat harness tees processed streams into
+    indexed files and byte-compares them against goldens, e.g.
+    ``multifilesink location=result_%1d.log``)."""
+
+    FACTORY = "multifilesink"
+    PROPERTIES = {
+        "location": (None, "printf pattern, e.g. result_%1d.log"),
+        "index": (0, "first file index"),
+    }
+
+    def _make_pads(self):
+        self.add_sink_pad(Caps.any(), "sink")
+
+    def start(self):
+        from .src import _indexed_path
+
+        if not self.location:
+            raise ValueError(f"{self.name}: location required")
+        self._idx = int(self.index)
+        self._indexed_path = _indexed_path
+        self._indexed_path(self.location, self._idx, self.name)
+
+    def set_caps(self, pad, caps):
+        pass
+
+    def chain(self, pad, buf):
+        path = self._indexed_path(self.location, self._idx, self.name)
+        with open(path, "wb") as fh:
+            for i in range(buf.num_tensors):
+                fh.write(np.ascontiguousarray(buf.np(i)).tobytes())
+        self._idx += 1
+        return FlowReturn.OK
+
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            self.post_eos_reached()

@@ -280,13 +280,7 @@ class FileSrc(Source):
             f.close()
 
     def negotiate(self) -> Caps:
-        allowed = self.src_pad.peer_allowed_caps()
-        if allowed.is_empty():
-            raise ValueError(f"{self.name}: cannot negotiate with downstream")
-        if allowed.is_any():
-            # no constraint downstream (e.g. fakesink): raw bytes
-            return Caps([Structure("application/octet-stream", {})])
-        return allowed.fixate()
+        return _negotiate_byte_caps(self)
 
     def create(self) -> Optional[TensorBuffer]:
         size = int(self.blocksize)
@@ -297,3 +291,92 @@ class FileSrc(Source):
         # timestamps unset too — stamping 0 would make QoS throttling and
         # tensor_rate collapse all chunks onto one instant)
         return TensorBuffer(tensors=[np.frombuffer(chunk, np.uint8)])
+
+
+def _negotiate_byte_caps(el) -> Caps:
+    """Byte-source negotiation shared by filesrc/multifilesrc: take
+    downstream's constraint, defaulting to raw octets when downstream
+    is unconstrained (e.g. fakesink)."""
+    allowed = el.src_pad.peer_allowed_caps()
+    if allowed.is_empty():
+        raise ValueError(f"{el.name}: cannot negotiate with downstream")
+    if allowed.is_any():
+        return Caps([Structure("application/octet-stream", {})])
+    return allowed.fixate()
+
+
+def _indexed_path(location, index: int, name: str) -> str:
+    """printf-style ``location % index`` (GStreamer multifile pattern,
+    e.g. ``out_%1d.log`` / ``frames.%d``) with a named error for a
+    pattern that doesn't consume the index."""
+    try:
+        return str(location) % index
+    except (TypeError, ValueError) as exc:
+        # %-formatting raises TypeError whenever the index is not
+        # consumed, so this covers patterns with no directive too
+        raise ValueError(f"{name}: location {location!r} must contain "
+                         f"one %d-style index directive ({exc})") from exc
+
+
+@register_element
+class MultiFileSrc(Source):
+    """Reads an INDEXED file sequence (GStreamer multifilesrc role —
+    the ssat detection pipelines stream golden tensors this way:
+    ``multifilesrc location=x.%d start-index=0 stop-index=9
+    caps=application/octet-stream``).  Each file is pushed as one
+    buffer; the sequence ends at stop-index, or at the first missing
+    file when stop-index is -1."""
+
+    FACTORY = "multifilesrc"
+    PROPERTIES = {
+        "location": (None, "printf pattern, e.g. frames.%d"),
+        "start-index": (0, "first index"),
+        "stop-index": (-1, "last index; -1 = until a file is missing"),
+        "caps": (None, "caps of the byte stream (else negotiated like "
+                       "filesrc)"),
+        "loop": (False, "restart from start-index at the end"),
+    }
+
+    def _make_pads(self):
+        self.add_src_pad(Caps.any(), "src")
+
+    def start(self):
+        if not self.location:
+            raise ValueError(f"{self.name}: location required")
+        self._idx = int(self.start_index)
+        stop = int(self.stop_index)
+        if stop >= 0 and self._idx > stop:
+            raise ValueError(f"{self.name}: start-index {self._idx} > "
+                             f"stop-index {stop}")
+        # the pattern must be well-formed even if the first file is
+        # checked lazily (stop-index=-1 ends at the first gap)
+        _indexed_path(self.location, self._idx, self.name)
+
+    def negotiate(self) -> Caps:
+        if self.caps:
+            c = self.caps
+            caps = Caps.from_string(c) if isinstance(c, str) else c
+            return caps.fixate()
+        return _negotiate_byte_caps(self)
+
+    def create(self) -> Optional[TensorBuffer]:
+        stop = int(self.stop_index)
+        while True:
+            if stop >= 0 and self._idx > stop:
+                if not bool(self.loop):
+                    return None
+                self._idx = int(self.start_index)
+            path = _indexed_path(self.location, self._idx, self.name)
+            if not os.path.isfile(path):
+                if stop >= 0:
+                    raise FileNotFoundError(
+                        f"{self.name}: no such file: {path} (index "
+                        f"{self._idx} <= stop-index {stop})")
+                if bool(self.loop) and self._idx != int(self.start_index):
+                    self._idx = int(self.start_index)
+                    continue
+                return None
+            with open(path, "rb") as fh:
+                chunk = fh.read()
+            self._idx += 1
+            return TensorBuffer(tensors=[np.frombuffer(chunk, np.uint8)])

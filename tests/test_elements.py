@@ -121,6 +121,49 @@ class TestTransform:
             p.stop()
             assert len(p.get("o").results) == expect, line
 
+    def test_multifile_round_trip(self, tmp_path):
+        """The ssat harness's core I/O pattern: tee the stream into
+        indexed files (multifilesink location=result_%1d.log) and
+        stream goldens back (multifilesrc ... start-index/stop-index
+        caps=application/octet-stream) — both verbatim."""
+        import os
+
+        from nnstreamer_tpu import parse_launch
+
+        d = str(tmp_path)
+        p = parse_launch(
+            "videotestsrc num-buffers=3 pattern=13 ! "
+            "video/x-raw,format=RGB,width=4,height=4,framerate=30/1 ! "
+            "tensor_converter ! "
+            f"multifilesink async=false location={d}/result_%1d.log")
+        p.run(timeout=30)
+        assert sorted(os.listdir(d)) == [
+            "result_0.log", "result_1.log", "result_2.log"]
+        p2 = parse_launch(
+            f"multifilesrc location={d}/result_%1d.log start-index=0 "
+            "stop-index=2 caps=application/octet-stream ! "
+            "tensor_converter input-dim=3:4:4 input-type=uint8 ! "
+            "tensor_sink name=o")
+        p2.run(timeout=30)
+        res = p2.get("o").results
+        assert len(res) == 3
+        assert res[0].np(0).shape == (4, 4, 3)
+        # byte-exact round trip, first and last
+        raw = open(f"{d}/result_0.log", "rb").read()
+        np.testing.assert_array_equal(
+            np.frombuffer(raw, np.uint8).reshape(4, 4, 3), res[0].np(0))
+
+    def test_multifile_bad_pattern_is_named_error(self, tmp_path):
+        import pytest
+
+        from nnstreamer_tpu.elements.sink import MultiFileSink
+        from nnstreamer_tpu.elements.src import MultiFileSrc
+
+        with pytest.raises(ValueError, match="index directive"):
+            MultiFileSink("m", location=str(tmp_path / "flat.log")).start()
+        with pytest.raises(ValueError, match="index directive"):
+            MultiFileSrc("m", location=str(tmp_path / "flat.log")).start()
+
     def test_tensor_if_bad_compared_value_fails_at_start(self):
         import pytest
 
