@@ -100,6 +100,28 @@ class JaxTrainer(TrainerFramework):
     def push_data(self, inputs, labels) -> None:
         self._samples.append((inputs, labels))
 
+    @staticmethod
+    def _stack(samples):
+        """(N, in_dim), (N, out_dim) float32 arrays from sample pairs —
+        one stacker for the training AND validation paths."""
+        xs = np.stack([np.asarray(s[0][0], np.float32).reshape(-1)
+                       for s in samples])
+        ys = np.stack([np.asarray(s[1][0], np.float32).reshape(-1)
+                       for s in samples])
+        return xs, ys
+
+    @staticmethod
+    def _loss(p, x, y):
+        """THE objective — training grads and the validation metric
+        must never diverge, so both call this."""
+        import jax
+        import jax.numpy as jnp
+
+        h = jax.nn.relu(x @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.sum(logp * y, axis=-1))
+
     def _build(self, in_dim: int, out_dim: int):
         import jax
         import jax.numpy as jnp
@@ -116,12 +138,7 @@ class JaxTrainer(TrainerFramework):
                "v": jax.tree.map(jnp.zeros_like, params),
                "t": jnp.zeros((), jnp.int32)}
         lr = self.lr
-
-        def loss_fn(p, x, y):
-            h = jax.nn.relu(x @ p["w1"] + p["b1"])
-            logits = h @ p["w2"] + p["b2"]
-            logp = jax.nn.log_softmax(logits)
-            return -jnp.mean(jnp.sum(logp * y, axis=-1))
+        loss_fn = self._loss
 
         @jax.jit
         def step(p, o, x, y):
@@ -147,10 +164,7 @@ class JaxTrainer(TrainerFramework):
 
         if not self._samples:
             return {"epochs": 0, "samples": 0, "final_loss": None}
-        xs = np.stack([np.asarray(s[0][0], np.float32).reshape(-1)
-                       for s in self._samples])
-        ys = np.stack([np.asarray(s[1][0], np.float32).reshape(-1)
-                       for s in self._samples])
+        xs, ys = self._stack(self._samples)
         if self._step_fn is None:
             self._build(xs.shape[1], ys.shape[1])
         params, opt = self._state
@@ -164,6 +178,20 @@ class JaxTrainer(TrainerFramework):
         self._state = (params, opt)
         return {"epochs": self.epochs, "samples": n,
                 "final_loss": self.losses[-1] if self.losses else None}
+
+    def evaluate(self, val_data) -> float:
+        """Mean loss over held-out (inputs, labels) pairs (the element's
+        num-validation-samples split) with the trained params —
+        validation frames never touch the optimizer, and the metric is
+        the same _loss the optimizer minimized."""
+        import jax.numpy as jnp
+
+        if self._state is None or not val_data:
+            return float("nan")
+        params, _ = self._state
+        xs, ys = self._stack(val_data)
+        return float(self._loss(params, jnp.asarray(xs),
+                                jnp.asarray(ys)))
 
     def save(self, path: str) -> None:
         if self._state is None:
@@ -329,11 +357,21 @@ class TensorTrainer(Element):
     PROPERTIES = {
         "framework": ("jax", "trainer framework name"),
         "model-save-path": (None, "checkpoint path written at EOS"),
+        "model-config": (None, "framework model-config path (reference "
+                               "property; forwarded to the trainer's "
+                               "props)"),
         "num-inputs": (1, "tensors per frame that are inputs"),
         "num-labels": (1, "tensors per frame that are labels"),
         "num-epochs": (1, ""),
         "batch-size": (8, ""),
         "lr": (1e-3, ""),
+        "num-training-samples": (0, "frames used for TRAINING; the "
+                                    "stream's next num-validation-"
+                                    "samples frames are validation "
+                                    "(reference gsttensor_trainer "
+                                    "split; 0 = train on everything)"),
+        "num-validation-samples": (0, "frames after the training split "
+                                      "held out for validation loss"),
         "custom": (None, "extra key:value props"),
     }
 
@@ -348,10 +386,21 @@ class TensorTrainer(Element):
         self.trainer = cls()
         props = {"num-epochs": self.num_epochs, "batch-size": self.batch_size,
                  "lr": self.lr}
+        if self.model_config not in (None, ""):
+            props["model-config"] = str(self.model_config)
         props.update(FilterProperties.parse_custom(self.custom))
         self.trainer.create(props)
         self.summary: Optional[Dict[str, Any]] = None
         self._done = threading.Event()
+        self._n_seen = 0
+        self._n_train = int(self.num_training_samples or 0)
+        self._n_valid = int(self.num_validation_samples or 0)
+        if self._n_valid > 0 and self._n_train <= 0:
+            # silently training on everything would withhold the
+            # promised validation loss
+            raise ValueError(f"{self.name}: num-validation-samples "
+                             "needs num-training-samples")
+        self._val_data: List = []
 
     def set_caps(self, pad, caps):
         super().set_caps(pad, caps)  # passthrough
@@ -365,7 +414,18 @@ class TensorTrainer(Element):
                 f"{ni}+{nl}")
         inputs = [buf.np(i) for i in range(ni)]
         labels = [buf.np(ni + i) for i in range(nl)]
-        self.trainer.push_data(inputs, labels)
+        # reference split semantics (gsttensor_trainer push_data): the
+        # first num-training-samples frames train, the NEXT
+        # num-validation-samples are held out, anything beyond both is
+        # ignored; with no split configured everything trains
+        idx = self._n_seen
+        self._n_seen += 1
+        if self._n_train <= 0:
+            self.trainer.push_data(inputs, labels)
+        elif idx < self._n_train:
+            self.trainer.push_data(inputs, labels)
+        elif idx < self._n_train + self._n_valid:
+            self._val_data.append((inputs, labels))
         return self.push(buf)
 
     def on_event(self, pad, event):
@@ -373,6 +433,13 @@ class TensorTrainer(Element):
             # train + save before propagating EOS (reference blocks on
             # training_complete_cond at EOS)
             self.summary = self.trainer.finish()
+            if self._val_data:
+                self.summary["validation_samples"] = len(self._val_data)
+                evaluate = getattr(self.trainer, "evaluate", None)
+                if callable(evaluate):
+                    self.summary["validation_loss"] = float(
+                        evaluate(self._val_data))
+                self._val_data = []    # release the held-out frames
             if self.model_save_path:
                 self.trainer.save(str(self.model_save_path))
             self._done.set()

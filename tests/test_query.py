@@ -163,6 +163,45 @@ class TestQueryRoundTrip:
 
 
 class TestTrainer:
+    def test_training_validation_split(self):
+        """Reference gsttensor_trainer split: the first
+        num-training-samples frames train, the next
+        num-validation-samples are held out (never touch the
+        optimizer) and yield a validation loss at EOS."""
+        from nnstreamer_tpu.elements import TensorTrainer
+        from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+
+        p = Pipeline()
+        src = AppSrc("src", caps=(
+            "other/tensors,format=static,num_tensors=2,dimensions=8.4,"
+            "types=float32.float32,framerate=0/1"))
+        trainer = TensorTrainer(
+            "tr", **{"num-epochs": 2, "batch-size": 4, "lr": 0.01,
+                     "num-training-samples": 12,
+                     "num-validation-samples": 4})
+        sink = TensorSink("out")
+        p.add(src, trainer, sink)
+        p.link(src, trainer, sink)
+        rng = np.random.default_rng(0)
+        for i in range(20):    # 12 train + 4 valid + 4 ignored
+            x = rng.standard_normal(8).astype(np.float32)
+            y = np.zeros(4, np.float32)
+            y[i % 4] = 1
+            src.push_buffer(TensorBuffer(tensors=[x, y], pts=i))
+        src.end_of_stream()
+        p.run(timeout=60)
+        s = trainer.summary
+        assert s["samples"] == 12          # only the training split
+        assert s["validation_samples"] == 4
+        assert np.isfinite(s["validation_loss"])
+
+    def test_validation_without_training_split_is_loud(self):
+        from nnstreamer_tpu.elements import TensorTrainer
+
+        el = TensorTrainer("t", **{"num-validation-samples": 4})
+        with pytest.raises(ValueError, match="num-training-samples"):
+            el.start()
+
     def test_trainer_pipeline(self, tmp_path):
         from nnstreamer_tpu.elements import TensorTrainer
         from nnstreamer_tpu.pipeline import AppSrc, Pipeline
