@@ -108,6 +108,12 @@ class TensorCrop(Element):
     """
 
     FACTORY = "tensor_crop"
+    PROPERTIES = {
+        "lateness": (-1, "reference crop-info sync tolerance in ms "
+                         "(accepted for launch-line parity; this crop "
+                         "pairs raw/info buffers exactly by arrival "
+                         "order)"),
+    }
 
     def _make_pads(self):
         self.add_sink_pad(tensors_template_caps(), "raw")

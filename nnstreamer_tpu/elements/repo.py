@@ -53,7 +53,12 @@ repo = _Repo()
 @register_element
 class TensorRepoSink(Element):
     FACTORY = "tensor_reposink"
-    PROPERTIES = {"slot-index": (0, "repository slot")}
+    PROPERTIES = {
+        "slot-index": (0, "repository slot"),
+        "signal-rate": (0, "reference reposink property (emission rate "
+                           "limiter there; accepted for launch-line "
+                           "parity — this sink emits no signals)"),
+    }
 
     def _make_pads(self):
         self.add_sink_pad(tensors_template_caps(), "sink")
