@@ -212,6 +212,11 @@ class GrpcTensorSrc(Source):
         "idl": ("protobuf", "message IDL: protobuf|flatbuf"),
         "caps": (None, "override out caps (else derived from first frame)"),
         "num-buffers": (-1, "stop after N buffers, -1 unlimited"),
+        "blocking": (True, "reference working-mode flag (accepted for "
+                           "launch-line parity; receive here is always "
+                           "queue-blocking with a halt check)"),
+        "out": (0, "reference READABLE counter: output buffers "
+                   "generated so far"),
     }
 
     def _make_pads(self):
@@ -281,6 +286,7 @@ class GrpcTensorSrc(Source):
                 return None
             arrays = self._codec.decode(blob)
         self._count += 1
+        self.out = self._count    # reference READABLE buffer counter
         return TensorBuffer(tensors=arrays)
 
 
