@@ -41,13 +41,23 @@ def inspect(name=None, out=None) -> int:
         print(f"Factory: {name}\n", file=out)
         if doc:
             print(doc + "\n", file=out)
-        props = getattr(cls, "PROPERTIES", {})
+        # element props first, then the universal ones every element
+        # inherits (gst-inspect lists inherited GObject props too)
+        props = dict(getattr(cls, "PROPERTIES", {}))
+        props.update({k: v for k, v in
+                      getattr(cls, "UNIVERSAL_PROPERTIES", {}).items()
+                      if k not in props})
         if props:
             print("Properties:", file=out)
             for key, spec in sorted(props.items()):
                 default, desc = (spec if isinstance(spec, tuple)
                                  else (spec, ""))
                 print(f"  {key:<24} default={default!r}  {desc}", file=out)
+        aliases = getattr(cls, "REFERENCE_PROP_ALIASES", None)
+        if aliases:
+            print("Reference-name aliases:", file=out)
+            for a, target in sorted(aliases.items()):
+                print(f"  {a:<24} -> {target}", file=out)
         return 0
     for fac in sorted(list_factories()):
         cls = element_factory(fac)
