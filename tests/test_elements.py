@@ -672,6 +672,39 @@ class TestRepo:
         assert not np.asarray(out[0].np(0)).any()
 
 
+class TestRepoDynamicity:
+    def test_runtime_slot_switch(self):
+        """The reference's repo-dynamicity scenario
+        (tests/nnstreamer_repo_dynamicity/tensor_repo_dynamic_test.c):
+        slot-index is switched on a PLAYING reposink via set_property
+        and subsequent buffers land in the new slot — slot resolution
+        is per-buffer, not frozen at start."""
+        from nnstreamer_tpu.elements.repo import repo
+        from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+        from nnstreamer_tpu.elements.repo import TensorRepoSink
+
+        repo.clear()
+        p = Pipeline()
+        src = AppSrc("s", caps=(
+            "other/tensors,format=static,num_tensors=1,dimensions=4,"
+            "types=uint8,framerate=0/1"))
+        sink = TensorRepoSink("rs", **{"slot-index": 1})
+        p.add(src, sink)
+        p.link(src, sink)
+        p.play()
+        src.push(TensorBuffer(tensors=[np.full(4, 1, np.uint8)], pts=0))
+        sink.set_property("slot-index", 2)    # runtime switch
+        src.push(TensorBuffer(tensors=[np.full(4, 2, np.uint8)], pts=1))
+        src.end_of_stream()
+        p.wait(timeout=10)
+        p.stop()
+        got1 = repo.slot(1).get(timeout=5)
+        got2 = repo.slot(2).get(timeout=5)
+        np.testing.assert_array_equal(got1.np(0), np.full(4, 1, np.uint8))
+        np.testing.assert_array_equal(got2.np(0), np.full(4, 2, np.uint8))
+        repo.clear()
+
+
 class TestDataRepoSrc:
     def test_reads_frames(self, tmp_path):
         data = np.arange(12, dtype=np.float32).tobytes()
