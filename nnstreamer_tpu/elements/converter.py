@@ -91,7 +91,17 @@ class TensorConverter(Element):
         "input-type": (None, "forced type for octet streams"),
         "set-timestamp": (True, "synthesize PTS when absent"),
         "mode": (None, "custom converter subplugin: 'custom-code:<name>'"),
+        "sub-plugins": (None, "reference READABLE property: registered "
+                              "converter subplugins (get_property "
+                              "returns the live list)"),
     }
+
+    def get_property(self, key):
+        if key in ("sub-plugins", "sub_plugins"):
+            from ..converters import list_converters
+
+            return ",".join(sorted(list_converters()))
+        return super().get_property(key)
 
     def _make_pads(self):
         sink_tmpl = (video_template_caps()
