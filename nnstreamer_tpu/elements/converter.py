@@ -96,11 +96,18 @@ class TensorConverter(Element):
                               "returns the live list)"),
     }
 
+    def set_property(self, key, value):
+        if key == "sub-plugins":
+            # reference G_PARAM_READABLE-only: writing is an error
+            raise ValueError(f"{self.FACTORY}: property {key!r} is "
+                             "read-only")
+        super().set_property(key, value)
+
     def get_property(self, key):
         if key in ("sub-plugins", "sub_plugins"):
             from ..converters import list_converters
 
-            return ",".join(sorted(list_converters()))
+            return ",".join(list_converters())   # registry is sorted
         return super().get_property(key)
 
     def _make_pads(self):

@@ -51,6 +51,22 @@ class TensorFilter(Element):
         "inputname": (None, "graph input tensor name(s) (reference "
                             "property; merged into custom props)"),
         "outputname": (None, "graph output tensor name(s)"),
+        "inputlayout": (None, "reference per-tensor layout hints "
+                              "(NHWC/NCHW/ANY/NONE) — accepted and "
+                              "forwarded to the backend custom props; "
+                              "the XLA path is layout-agnostic (the "
+                              "compiler lays tensors out itself)"),
+        "outputlayout": (None, "see inputlayout"),
+        "inputranks": (None, "reference READABLE property: rank per "
+                             "input tensor of the opened model"),
+        "outputranks": (None, "reference READABLE property: rank per "
+                              "output tensor"),
+        "sub-plugins": (None, "reference READABLE property: registered "
+                              "filter backends"),
+        # "latency"/"throughput" (reference READABLE stats) are python
+        # properties on this class — get_property reaches them via
+        # getattr, so they must NOT appear here (the defaults loop
+        # would try to assign the read-only descriptors)
         "input-combination": (None, "indices of input tensors to feed"),
         "output-combination": (None, "i0,i1/o0,o1 passthrough+output mix"),
         "shared-tensor-filter-key": (None, "share backend across instances"),
@@ -84,13 +100,32 @@ class TensorFilter(Element):
         "output": "output-dim", "outputtype": "output-type",
     }
 
+    #: reference G_PARAM_READABLE-only properties — a write is an
+    #: error there (critical warning), not a silent no-op
+    READONLY_PROPERTIES = ("sub-plugins", "inputranks", "outputranks",
+                           "latency", "throughput")
+
     def set_property(self, key, value):
+        if key in self.READONLY_PROPERTIES:
+            raise ValueError(f"{self.FACTORY}: property {key!r} is "
+                             "read-only")
         super().set_property(self.REFERENCE_PROP_ALIASES.get(key, key),
                              value)
 
     def get_property(self, key):
-        return super().get_property(
-            self.REFERENCE_PROP_ALIASES.get(key, key))
+        key = self.REFERENCE_PROP_ALIASES.get(key, key)
+        if key in ("sub-plugins", "sub_plugins"):
+            from ..filter.framework import list_filters
+
+            return ",".join(list_filters())   # registry is sorted
+        if key in ("inputranks", "outputranks"):
+            fw = getattr(self, "fw", None)
+            if fw is None:
+                return ""
+            in_info, out_info = fw.get_model_info()
+            info = in_info if key == "inputranks" else out_info
+            return ",".join(str(len(t.dims)) for t in info)
+        return super().get_property(key)
 
     def _make_pads(self):
         self.add_sink_pad(static_tensors_caps(), "sink")
@@ -106,9 +141,11 @@ class TensorFilter(Element):
             out_info = TensorsInfo.from_strings(str(self.output_dim),
                                                 str(self.output_type))
         custom = FilterProperties.parse_custom(self.custom)
-        # "inputname=data" / "outputname=prob" are first-class
-        # reference properties; backends read them from the custom map
-        for key in ("inputname", "outputname"):
+        # "inputname=data" / "outputname=prob" (and the layout hints)
+        # are first-class reference properties; backends read them from
+        # the custom map
+        for key in ("inputname", "outputname", "inputlayout",
+                    "outputlayout"):
             val = getattr(self, key, None)
             if val not in (None, "") and key not in custom:
                 custom[key] = str(val)

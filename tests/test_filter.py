@@ -361,6 +361,51 @@ class TestReferencePropertySpellings:
         finally:
             unregister_custom_easy("namesink")
 
+    def test_readable_reference_stats_props(self):
+        """The reference's READABLE tensor_filter properties:
+        sub-plugins (registered backends), inputranks/outputranks (per-
+        tensor ranks of the opened model), latency/throughput (runtime
+        stats) — all reachable through get_property, with layout hints
+        accepted and forwarded."""
+        from nnstreamer_tpu.elements.filter_elem import TensorFilter
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+        from nnstreamer_tpu.tensor.info import TensorsInfo
+
+        info = TensorsInfo.from_strings("3:16:16", "uint8")
+        register_custom_easy("ranksme", lambda ins: ins, info, info)
+        try:
+            el = TensorFilter("f", framework="custom-easy",
+                              model="ranksme", inputlayout="NHWC")
+            el.start()
+            assert "custom-easy" in el.get_property("sub-plugins")
+            assert el.get_property("inputranks") == "3"
+            assert el.get_property("outputranks") == "3"
+            assert el.get_property("latency") >= -1
+            assert el.get_property("throughput") >= 0.0
+            assert (el._props.custom_properties["inputlayout"]
+                    == "NHWC")
+            el.stop()
+        finally:
+            unregister_custom_easy("ranksme")
+
+    def test_readonly_props_reject_writes(self):
+        """The reference marks these G_PARAM_READABLE — a write is an
+        error, never a silent no-op."""
+        from nnstreamer_tpu import ParseError, parse_launch
+        from nnstreamer_tpu.elements.converter import TensorConverter
+        from nnstreamer_tpu.elements.filter_elem import TensorFilter
+
+        el = TensorFilter("f")
+        for key in ("sub-plugins", "inputranks", "latency"):
+            with pytest.raises(ValueError, match="read-only"):
+                el.set_property(key, "x")
+        with pytest.raises(ValueError, match="read-only"):
+            TensorConverter("c").set_property("sub-plugins", "x")
+        with pytest.raises(ParseError, match="read-only"):
+            parse_launch("videotestsrc ! tensor_converter sub-plugins=x "
+                         "! fakesink")
+
     def test_reference_alias_readback(self):
         from nnstreamer_tpu.elements.filter_elem import TensorFilter
 
