@@ -57,6 +57,24 @@ class FilterSingle:
     def output_info(self) -> TensorsInfo:
         return self.fw.get_model_info()[1]
 
+    def input_configured(self) -> bool:
+        """Reference ``input_configured`` check: a started backend with
+        valid input info."""
+        return self.fw is not None and self.input_info.is_valid()
+
+    def output_configured(self) -> bool:
+        return self.fw is not None and self.output_info.is_valid()
+
+    def set_input_info(self, info: TensorsInfo) -> TensorsInfo:
+        """Reference ``set_input_info`` (dynamic input reshape,
+        tensor_filter_single.c:77,106): reconfigure the opened model's
+        input and return the RE-DERIVED output info.  Backends that
+        can't reshape raise a named FilterError."""
+        if self.fw is None:
+            raise FilterError("not started")
+        self.fw.set_input_info(info)
+        return self.output_info
+
     def invoke(self, inputs: Sequence[Any]) -> List[np.ndarray]:
         """Validate against model info, invoke, materialize on host."""
         if self.fw is None:

@@ -152,3 +152,69 @@ class TestReferenceFixtures:
             out = out.reshape(480, 640, 3)
             # nearest-neighbor: output pixel (y, x) = input (y//2, x//2)
             np.testing.assert_array_equal(out[::2, ::2], frame)
+
+
+class TestSingleApiSurface:
+    """FilterSingle parity with GTensorFilterSingle's class surface
+    (tensor_filter_single.c:101-108): input/output_configured checks
+    and set_input_info dynamic reshape (named error from backends that
+    can't reshape)."""
+
+    def test_configured_and_reshape_error(self):
+        import pytest
+
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+        from nnstreamer_tpu.filter.framework import FilterError
+        from nnstreamer_tpu.tensor.info import TensorsInfo
+
+        info = TensorsInfo.from_strings("4", "float32")
+        register_custom_easy("single_surface", lambda ins: ins, info,
+                             info)
+        try:
+            s = FilterSingle(framework="custom-easy",
+                             model="single_surface")
+            with pytest.raises(FilterError, match="not started"):
+                s.set_input_info(info)
+            with s:
+                assert s.input_configured()
+                assert s.output_configured()
+                # custom-easy has a fixed signature: reshape is a NAMED
+                # error, not a crash
+                with pytest.raises(FilterError):
+                    s.set_input_info(
+                        TensorsInfo.from_strings("8", "float32"))
+        finally:
+            unregister_custom_easy("single_surface")
+
+    def test_reshape_through_reshapable_object(self):
+        """A custom filter OBJECT exposing set_input_info reshapes, and
+        the single API returns the re-derived output info."""
+        import numpy as np
+
+        from nnstreamer_tpu.tensor.info import TensorsInfo
+
+        class Reshapable:
+            def __init__(self):
+                self.info = TensorsInfo.from_strings("4", "float32")
+
+            def get_input_info(self):
+                return self.info
+
+            def get_output_info(self):
+                return self.info
+
+            def invoke(self, ins):
+                return ins
+
+            def set_input_info(self, in_info):
+                self.info = in_info
+                return in_info, in_info
+
+        s = FilterSingle(framework="custom", model=Reshapable())
+        with s:
+            new = s.set_input_info(
+                TensorsInfo.from_strings("8", "float32"))
+            assert new[0].dims == (8,)
+            out, = s.invoke([np.zeros(8, np.float32)])
+            assert out.shape == (8,)
