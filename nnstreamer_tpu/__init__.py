@@ -7,7 +7,7 @@ execution (jax.sharding over a Mesh), a gst-launch-style pipeline language,
 and a distributed tensor-query offload layer.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 from .tensor import (TensorBuffer, TensorFormat, TensorInfo, TensorsConfig,
                      TensorsInfo, TensorType)
