@@ -262,3 +262,91 @@ class TestTunnelProbeCeilings:
         want = b / max(b * fb / bw, rtt)
         assert abs(probe_out["config_fps_ceilings_b128"]["mobilenet"]
                    - want) < 1
+
+
+class TestPbtxtRoundTripCorpus:
+    """Generative round-trip over the verbatim launch-line corpus this
+    round's compat sweep established: launch → pbtxt → parse → launch →
+    pbtxt must be a FIXED POINT (same graph: elements, props, links) —
+    the property the reference's gstPrototxt converter pair guarantees."""
+
+    CORPUS = [
+        "videotestsrc num-buffers=3 pattern=13 ! "
+        "video/x-raw,format=RGB,width=64,height=48,framerate=30/1 ! "
+        "tensor_converter ! tensor_sink name=out",
+        "appsrc name=s1 ! mux.sink_0  appsrc name=s2 ! mux.sink_1  "
+        "tensor_mux name=mux sync-mode=slowest ! fakesink",
+        "videotestsrc ! tee name=t ! tensor_converter ! fakesink  "
+        "t. ! fakesink",
+        "tensor_merge name=m mode=linear option=2 silent=true "
+        "sync-mode=basepad sync-option=0:0.  appsrc name=a ! m.sink_0  "
+        "appsrc name=b ! m.sink_1  m. ! fakesink",
+        "videotestsrc num-buffers=1 ! "
+        "video/x-raw,format=RGB,width=4,height=4,framerate=30/1 ! "
+        "tensor_converter ! tensor_transform mode=arithmetic "
+        "option=per-channel:true@0,add:255@0 ! fakesink",
+        "multifilesrc location=x.%d start-index=0 stop-index=2 "
+        "caps=application/octet-stream ! tensor_converter "
+        "input-dim=3:4:4 input-type=uint8 ! tensor_sink name=o",
+        "tensor_if name=tif compared-value=TENSOR_AVERAGE_VALUE "
+        "compared-value-option=0 supplied-value=100 operator=LT "
+        "then=PASSTHROUGH else=SKIP  appsrc name=s ! tif. "
+        "tif. ! tensor_sink name=o",
+    ]
+
+    def test_fixed_point(self):
+        import pbtxt_pipeline as pp
+
+        for line in self.CORPUS:
+            nodes1 = pp.parse_launch_text(line)
+            text1 = pp.to_pbtxt(nodes1)
+            nodes2 = pp.parse_pbtxt(text1)
+            launch2 = pp.to_launch(nodes2)
+            nodes3 = pp.parse_launch_text(launch2)
+            text2 = pp.to_pbtxt(nodes3)
+            # names may be generated, so compare name-independent
+            # structure: element kinds, props, and input DEGREES
+            g1 = [(n.element, tuple(sorted(n.props)), len(n.inputs))
+                  for n in nodes1]
+            g3 = [(n.element, tuple(sorted(n.props)), len(n.inputs))
+                  for n in nodes3]
+            assert sorted(g1) == sorted(g3), line
+            assert text1.count("input:") == text2.count("input:"), line
+
+    def test_unnamed_node_references_round_trip(self):
+        """to_launch must emit name= for any node it references as
+        'name.' — a generated __idN reference without the name would
+        silently re-bind to whichever node regenerates that counter."""
+        import pbtxt_pipeline as pp
+
+        pbtxt = (
+            'node { name: "x" element: "appsrc" }\n'
+            'node { element: "appsrc" }\n'
+            'node { name: "m" element: "tensor_mux" input: "__id1" '
+            'input: "x" }\n'
+            'node { element: "fakesink" input: "m" }\n')
+        back = pp.parse_launch_text(pp.to_launch(pp.parse_pbtxt(pbtxt)))
+        m = next(n for n in back if n.element == "tensor_mux")
+        srcs = [next(n for n in back if n.name == i).element
+                for i in m.inputs]
+        assert srcs == ["appsrc", "appsrc"]
+        fs = next(n for n in back if n.element == "fakesink")
+        assert [next(n for n in back if n.name == i).element
+                for i in fs.inputs] == ["tensor_mux"]
+
+    def test_converter_parity_with_runtime_parser_errors(self):
+        """Strings the RUNTIME parser rejects must not convert into a
+        silently-wrong graph: src-pad branch refs (inexpressible in the
+        positional model), dangling refs, and trailing '!' are named
+        errors."""
+        import pbtxt_pipeline as pp
+
+        for bad, match in [
+            ("tee name=t  t.src_1 ! mux.sink_0  tensor_mux name=mux ! "
+             "fakesink", "src-pad"),
+            ("a. fakesink", "never linked"),
+            ("videotestsrc ! fakesink  t.", "never linked"),
+            ("videotestsrc !", "ends with"),
+        ]:
+            with pytest.raises(ValueError, match=match):
+                pp.parse_launch_text(bad)

@@ -73,11 +73,13 @@ def parse_launch_text(description: str) -> List[Node]:
         if kind == "ref":
             name, pad = op[1], (op[2] if len(op) > 2 else None)
             if linked:
-                if isinstance(prev, str):
-                    raise ValueError("cannot link two bare references")
                 # sink-pad names order the fan-in: mux.sink_1 slots the
                 # connection at index 1 (src-pad identity is positional
-                # in the pbtxt node model)
+                # in the pbtxt node model).  prev may itself be a bare
+                # reference ('a. ! mux.' — the runtime parser's ref_refs
+                # case, and what to_launch emits for pure fan-ins):
+                # record the src BY NAME and resolve once all elements
+                # are known
                 idx = None
                 if pad and pad.rsplit("_", 1)[-1].isdigit():
                     idx = int(pad.rsplit("_", 1)[-1])
@@ -85,6 +87,14 @@ def parse_launch_text(description: str) -> List[Node]:
                 link_seq += 1
                 prev, linked = None, False
             else:
+                if pad:
+                    raise ValueError(
+                        f"'{name}.{pad}': the positional node model "
+                        "cannot express src-pad selection on a "
+                        "branch-from reference")
+                if isinstance(prev, str):
+                    raise ValueError(
+                        f"reference '{prev}.' is never linked")
                 prev = name
             continue
         if kind == "caps":
@@ -96,6 +106,8 @@ def parse_launch_text(description: str) -> List[Node]:
                 name = f"__id{gen}"
                 gen += 1
             node = Node(name, head, list(props))
+        if not linked and isinstance(prev, str):
+            raise ValueError(f"reference '{prev}.' is never linked")
         if node.name in by_name:
             raise ValueError(f"duplicate element name {node.name!r}")
         by_name[node.name] = node
@@ -109,6 +121,10 @@ def parse_launch_text(description: str) -> List[Node]:
                 into_refs.append((prev, node.name, None, link_seq))
                 link_seq += 1
         prev, linked = node, False
+    if linked:
+        raise ValueError("launch string ends with '!'")
+    if isinstance(prev, str):
+        raise ValueError(f"trailing reference '{prev}.' is never linked")
     for src_name, sink in from_refs:
         if src_name not in by_name:
             raise ValueError(f"unknown reference {src_name!r}")
@@ -122,7 +138,10 @@ def parse_launch_text(description: str) -> List[Node]:
     for src, sink_name, idx, seq in into_refs:
         if sink_name not in by_name:
             raise ValueError(f"unknown reference {sink_name!r}")
-        ordered.setdefault(sink_name, []).append((idx, seq, src.name))
+        src_name = src if isinstance(src, str) else src.name
+        if src_name not in by_name:
+            raise ValueError(f"unknown reference {src_name!r}")
+        ordered.setdefault(sink_name, []).append((idx, seq, src_name))
     for sink_name, entries in ordered.items():
         sink = by_name[sink_name]
         slots: Dict[int, str] = {}
@@ -223,6 +242,8 @@ def to_launch(nodes: List[Node]) -> str:
             return n.props[0][1]
         parts = [n.element]
         if with_name or not n.name.startswith("__"):
+            # with_name forces emission even for generated __idN names:
+            # a reference to the node is about to be printed
             parts.append(f"name={n.name}")
         for k, v in n.props:
             v = shlex.quote(str(v))
@@ -244,8 +265,15 @@ def to_launch(nodes: List[Node]) -> str:
             segs.append(f"{n.inputs[0]}.")
         cur: Optional[Node] = n
         while cur is not None and cur.name not in emitted:
-            needs_name = consumers.get(cur.name, 0) > 1 or any(
-                cur.name in m.inputs[1:] for m in nodes)
+            # a node referenced ANYWHERE as 'name.' (fan-out consumer,
+            # extra join input, or a multi-input chain head's first
+            # input) must carry name= — omitting a generated __idN name
+            # while still emitting '__idN.' references would silently
+            # re-bind them to whichever node regenerates that counter
+            needs_name = (consumers.get(cur.name, 0) > 1
+                          or any(cur.name in m.inputs[1:] for m in nodes)
+                          or any(m.inputs and m.inputs[0] == cur.name
+                                 and len(m.inputs) > 1 for m in nodes))
             segs.append(fmt(cur, needs_name))
             emitted.add(cur.name)
             nxt = [m for m in nodes
