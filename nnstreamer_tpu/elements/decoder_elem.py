@@ -23,8 +23,23 @@ class TensorDecoder(Element):
          # of decode into the upstream executable) can be disabled to
          # measure its delta or to force the host decode path
          "pushdown": (True, "fuse pure decode reductions into the "
-                            "upstream filter executable")},
+                            "upstream filter executable"),
+         "sub-plugins": (None, "reference READABLE property: registered "
+                               "decoder modes")},
         **{f"option{i}": (None, f"decoder option {i}") for i in range(1, 10)})
+
+    def set_property(self, key, value):
+        if key == "sub-plugins":
+            raise ValueError(f"{self.FACTORY}: property {key!r} is "
+                             "read-only")
+        super().set_property(key, value)
+
+    def get_property(self, key):
+        if key in ("sub-plugins", "sub_plugins"):
+            from ..decoders import list_decoders
+
+            return ",".join(list_decoders())
+        return super().get_property(key)
 
     #: custom callbacks registered via register_decoder_custom (reference
     #: tensor_decoder_custom.h)
