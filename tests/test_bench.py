@@ -239,3 +239,45 @@ def test_graft_entry_honors_cpu_before_first_backend_touch():
     # suite's CPU pin
     fn, args = g.entry()
     assert callable(fn) and len(args) == 2
+
+
+def test_sweep_regates_after_midrun_tunnel_death(monkeypatch, capsys):
+    """Once one size reports tunnel_dead, later sweep sizes cost one
+    cheap probe each (dead rows), not a full deadline burn — and a
+    recovered link clears the suspicion."""
+    calls = {"orch": 0, "probe": 0}
+
+    def fake_orchestrate(config, cpu, deadline, retries, stream_batch=0):
+        calls["orch"] += 1
+        if calls["orch"] == 1:
+            return {"metric": "m", "value": 0, "unit": "fps",
+                    "vs_baseline": 0, "error": "tunnel died mid-run: x",
+                    "tunnel_dead": True}
+        return {"metric": "m", "value": 9.0, "unit": "fps",
+                "vs_baseline": 0}
+
+    # main()'s initial liveness gate consumes the first probe
+    probes = [{"ok": True, "platform": "tpu"},
+              {"ok": False, "elapsed_s": 0.1, "detail": "dead"},
+              {"ok": True}]
+
+    def fake_probe():
+        calls["probe"] += 1
+        return probes.pop(0)
+
+    monkeypatch.setattr(bench, "orchestrate", fake_orchestrate)
+    monkeypatch.setattr(bench, "_tunnel_preprobe", fake_probe)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(sys, "argv", [
+        "bench.py", "--sweep-batch", "32,64,128"])
+    bench.main()
+    rows = [json.loads(ln) for ln in
+            capsys.readouterr().out.strip().splitlines()]
+    assert [r["stream_batch"] for r in rows] == [32, 64, 128]
+    # size 32: mid-run death (full orchestrate).  size 64: cheap gate
+    # found dead -> dead row without orchestrate.  size 128: gate found
+    # alive -> orchestrate ran and succeeded.
+    assert rows[0].get("tunnel_dead") is True
+    assert "preprobe" in rows[1]["error"]
+    assert rows[2]["value"] == 9.0
+    assert calls["orch"] == 2 and calls["probe"] == 3
