@@ -993,7 +993,7 @@ def _tunnel_preprobe(timeout: float = None) -> dict:
     return {"ok": False, "elapsed_s": elapsed, "detail": detail}
 
 
-def tunnel_gate():
+def tunnel_gate(timeout: float = None):
     """Cheap liveness gate for the capture tools (flash/int8 proofs):
     None when the link is healthy — or the process is CPU-forced, where
     no tunnel is involved — else the failed probe dict.  Without it a
@@ -1001,8 +1001,25 @@ def tunnel_gate():
     until its full capture cap (int8: 25 min) with nothing on stdout."""
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         return None
-    probe = _tunnel_preprobe()
+    probe = _tunnel_preprobe(timeout)
     return None if probe.get("ok") else probe
+
+
+def emit_dead_row_if_gated(metric: str, unit: str, extra: dict = None,
+                           timeout: float = None):
+    """ONE copy of the capture tools' gate-then-dead-row boilerplate:
+    when the link gate trips, print the tool's red row (shared message
+    format, metric-specific fields via ``extra``) and return exit code
+    2; else return None and the tool proceeds.  Keeps the row schema
+    and exit-code convention from drifting across tools."""
+    dead = tunnel_gate(timeout)
+    if dead is None:
+        return None
+    row = {"metric": metric, "value": 0, "unit": unit,
+           "error": dead_link_error(dead)}
+    row.update(extra or {})
+    print(json.dumps(row), flush=True)
+    return 2
 
 
 def _cached_green(metric: str) -> dict:

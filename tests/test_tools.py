@@ -350,3 +350,24 @@ class TestPbtxtRoundTripCorpus:
         ]:
             with pytest.raises(ValueError, match=match):
                 pp.parse_launch_text(bad)
+
+    def test_tunnel_probe_gates(self):
+        """tunnel_probe's contract is the ROW (rc 0 either way): a dead
+        link yields the error row in ~one preprobe timeout instead of
+        wedging until the loop's cap."""
+        import json as _json
+        import time as _time
+
+        env = dict(os.environ)
+        env["NNS_TPU_BENCH_PREPROBE_CMD"] = "false"
+        env["NNS_TPU_BENCH_PREPROBE_TIMEOUT"] = "2"
+        env.pop("JAX_PLATFORMS", None)
+        t0 = _time.monotonic()
+        out = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "tunnel_probe.py")],
+            capture_output=True, text=True, timeout=90, env=env,
+            cwd=os.path.dirname(TOOLS))
+        assert _time.monotonic() - t0 < 30
+        row = _json.loads(out.stdout.strip().splitlines()[-1])
+        assert row["value"] == 0 and "preprobe" in row["error"]
+        assert out.returncode == 0   # row contract, not rc

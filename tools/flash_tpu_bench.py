@@ -75,14 +75,11 @@ def tune() -> int:
     Each length's winner is gradcheck-validated at that length before
     --apply will ship it (the backward kernels' VMEM footprint is much
     bigger than the forward's)."""
-    from bench import _enable_compile_cache, dead_link_error, tunnel_gate
+    from bench import _enable_compile_cache, emit_dead_row_if_gated
 
-    dead = tunnel_gate()
-    if dead:
-        print(json.dumps({
-            "metric": "flash_tile_tune", "value": 0,
-            "error": dead_link_error(dead)}), flush=True)
-        return 2
+    rc = emit_dead_row_if_gated("flash_tile_tune", "x_vs_128x128_tile")
+    if rc is not None:
+        return rc
     _enable_compile_cache()
     import jax
     import jax.numpy as jnp
@@ -238,15 +235,12 @@ def measured_win_table(timings):
 
 
 def main() -> int:
-    from bench import _enable_compile_cache, dead_link_error, tunnel_gate
+    from bench import _enable_compile_cache, emit_dead_row_if_gated
 
-    dead = tunnel_gate()
-    if dead:
-        print(json.dumps({
-            "metric": "flash_attention_tpu_proof", "value": 0,
-            "unit": "x_vs_naive", "ok": False,
-            "error": dead_link_error(dead)}), flush=True)
-        return 2
+    rc = emit_dead_row_if_gated("flash_attention_tpu_proof",
+                                "x_vs_naive", {"ok": False})
+    if rc is not None:
+        return rc
     import jax
 
     _enable_compile_cache()

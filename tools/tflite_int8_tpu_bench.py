@@ -66,15 +66,12 @@ def _bench(fw, x):
 
 
 def main() -> int:
-    from bench import _enable_compile_cache, dead_link_error, tunnel_gate
+    from bench import _enable_compile_cache, emit_dead_row_if_gated
 
-    dead = tunnel_gate()
-    if dead:
-        print(json.dumps({
-            "metric": "tflite_quant_native_tpu", "value": 0,
-            "unit": "x_vs_emulation", "ok": False,
-            "error": dead_link_error(dead)}), flush=True)
-        return 2
+    rc = emit_dead_row_if_gated("tflite_quant_native_tpu",
+                                "x_vs_emulation", {"ok": False})
+    if rc is not None:
+        return rc
     import jax
 
     _enable_compile_cache()

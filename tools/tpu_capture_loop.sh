@@ -107,7 +107,7 @@ capture() {
 
 while :; do
   ts=$(date -u +%m%d_%H%M%S)
-  timeout -k 15 240 python tools/tunnel_probe.py > "$STAGE/tunnel_$ts.json" 2>/dev/null
+  timeout -k 15 300 python tools/tunnel_probe.py > "$STAGE/tunnel_$ts.json" 2>/dev/null
   if ! _green "$STAGE/tunnel_$ts.json"; then
     log "tunnel down/probe failed; sleeping 180s"
     sleep 180
