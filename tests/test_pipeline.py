@@ -103,6 +103,11 @@ class TestParseLaunch:
             "tensor_decoder mode=image_labeling ! tensor_sink",
             "tensor_if name=i compared-value=A_VALUE supplied-value=0 "
             "operator=GT then=PASSTHROUGH else=SKIP",
+            "edgesink port=0 connect-type=HYBRID dest-host=127.0.0.1 "
+            "dest-port=1883 topic=t async=false",
+            "multifilesrc location=x.%d start-index=0 stop-index=9 "
+            "caps=application/octet-stream ! tensor_converter ! "
+            "multifilesink location=out_%1d.log",
         ]
         pool = ["!", ".", "name=", "mux.", "t.", "tensor_converter",
                 "video/x-raw,", "width=0", "=", "'", '"', "a=", "=b",
