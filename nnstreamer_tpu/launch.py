@@ -149,8 +149,13 @@ def main(argv=None) -> int:
                 # live pipeline is exactly the --trace --timeout use case
                 import json as _json
 
-                print(_json.dumps({"trace": tracer.report()}, indent=2),
-                      file=sys.stderr)
+                report = {"trace": tracer.report()}
+                resilience = tracer.resilience_report()
+                if resilience:
+                    # retry/failure/breaker/heartbeat counters from the
+                    # query layer (query/resilience.py), this run only
+                    report["resilience"] = resilience
+                print(_json.dumps(report, indent=2), file=sys.stderr)
     except Exception as exc:  # noqa: BLE001
         print(f"ERROR: {exc}", file=sys.stderr)
         return 1

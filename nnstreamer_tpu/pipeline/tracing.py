@@ -55,6 +55,14 @@ class Tracer:
         self._stats: Dict[str, _ElementStats] = {}
         self._lock = threading.Lock()
         self._tls = threading.local()
+        # resilience counters (query/resilience.py STATS) are process-wide
+        # and monotonic; snapshot at attach so the report shows only THIS
+        # run's retries/failures/breaker transitions.  Lazy import: the
+        # query package is a consumer of the pipeline package.
+        from ..query.resilience import STATS
+
+        self._resilience = STATS
+        self._resilience_base = STATS.snapshot()
 
     # called from Element._chain_entry — keep it lean
     def enter(self) -> None:
@@ -98,3 +106,11 @@ class Tracer:
                     "window_s": round(window, 4),
                 }
         return out
+
+    def resilience_report(self) -> Dict[str, int]:
+        """Retry / failure / breaker-transition / heartbeat counters
+        accumulated since this tracer attached (delta over the
+        process-wide :data:`~nnstreamer_tpu.query.resilience.STATS`) —
+        the dataflow-health half of the report, next to proctime.
+        Empty when the run touched no remote endpoint."""
+        return self._resilience.delta(self._resilience_base)

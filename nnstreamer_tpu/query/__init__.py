@@ -1,14 +1,20 @@
 """Distributed query layer (L5): offload tensor streams between hosts."""
 
-from .client import QueryConnection, TensorQueryClient
+from .client import (FailoverConnection, QueryConnection, TensorQueryClient,
+                     parse_endpoints)
 from .protocol import (Message, decode_tensors, encode_tensors, recv_msg,
                        send_msg)
+from .resilience import (STATS, CircuitBreaker, CircuitOpenError,
+                         HealthMonitor, RetryExhausted, RetryPolicy)
 from .server import (QueryServer, TensorQueryServerSink, TensorQueryServerSrc,
                      get_server, shutdown_server)
 
 __all__ = [
-    "QueryConnection", "TensorQueryClient", "QueryServer",
+    "QueryConnection", "FailoverConnection", "TensorQueryClient",
+    "parse_endpoints", "QueryServer",
     "TensorQueryServerSrc", "TensorQueryServerSink", "get_server",
     "shutdown_server", "Message", "encode_tensors", "decode_tensors",
     "send_msg", "recv_msg",
+    "STATS", "RetryPolicy", "RetryExhausted", "CircuitBreaker",
+    "CircuitOpenError", "HealthMonitor",
 ]

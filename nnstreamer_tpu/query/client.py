@@ -4,6 +4,16 @@ Parity with gst/nnstreamer/tensor_query/tensor_query_client.c: chain sends
 the frame over the transport, blocks on an async queue for the answer
 (:656-743), with reconnect/retry (:368-380,728-732) and a caps handshake
 over the same channel (:512-559).
+
+Resilience (query/resilience.py): connects back off exponentially with
+jitter (:class:`RetryPolicy`); each endpoint sits behind a
+:class:`CircuitBreaker` so a dead server fails fast instead of eating a
+timeout per frame; a :class:`HealthMonitor` heartbeats the active
+endpoint over ``T_PING``/``T_PONG`` and a dead verdict triggers failover
+to the next entry of the ``dest-hosts`` list.  The ``fallback`` property
+picks what a frame does when every endpoint is down: ``error`` (pipeline
+error, the reference default), ``passthrough`` (push the input frame
+unchanged — graceful degradation), or ``drop``.
 """
 
 from __future__ import annotations
@@ -12,52 +22,90 @@ import queue as _queue
 import socket
 import threading
 import time
-from typing import Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import tensors_template_caps
-from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_REPLY,
-                       decode_tensors, encode_tensors, recv_msg, send_msg)
+from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
+                       T_REPLY, decode_tensors, encode_tensors, recv_msg,
+                       send_msg, shutdown_close)
+from .protocol import create_connection as checked_connect
+from .resilience import (STATS, CircuitBreaker, CircuitOpenError,
+                         HealthMonitor, RetryExhausted, RetryPolicy)
 
 
 class QueryConnection:
-    """Socket + reader thread + reply queue, with reconnect."""
+    """Socket + reader thread + reply queue, with reconnect.
+
+    One TCP connection to one endpoint.  ``query()`` owns the whole
+    request budget (``timeout`` seconds covering send, reconnect, and
+    reply wait); ``ping()`` is the heartbeat probe matched by seq on the
+    same stream, handled out of band by the reader thread.
+    """
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 max_retries: int = 3):
+                 max_retries: int = 3,
+                 retry: Optional[RetryPolicy] = None):
         self.host, self.port = host, port
         self.timeout = timeout
         self.max_retries = max_retries
+        self.retry = retry or RetryPolicy(max_attempts=max(1, max_retries),
+                                          base_delay=0.05, max_delay=0.5)
         self.replies: _queue.Queue = _queue.Queue()
         self.server_caps: Optional[str] = None
         self._sock: Optional[socket.socket] = None
         self._reader: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._seq = 0
+        self._send_lock = threading.Lock()   # query+ping share the stream
+        self._pong_waiters: Dict[int, threading.Event] = {}
+        self._waiters_lock = threading.Lock()
 
     def connect(self) -> None:
-        last_err: Optional[Exception] = None
-        for attempt in range(self.max_retries):
+        def _dial():
+            sock = checked_connect(
+                (self.host, self.port), timeout=self.timeout)
+            sock.settimeout(None)
+            self._sock = sock
+            self._stop.clear()
+            reader = threading.Thread(
+                target=self._read_loop, daemon=True, name="query-reader")
+            self._reader = reader
+            reader.start()
             try:
-                sock = socket.create_connection(
-                    (self.host, self.port), timeout=self.timeout)
-                sock.settimeout(None)
-                self._sock = sock
-                self._stop.clear()
-                self._reader = threading.Thread(
-                    target=self._read_loop, daemon=True, name="query-reader")
-                self._reader.start()
                 # caps handshake
-                send_msg(sock, Message(T_HELLO))
-                return
-            except OSError as exc:
-                last_err = exc
-                time.sleep(0.2 * (attempt + 1))
-        raise ConnectionError(
-            f"cannot connect to {self.host}:{self.port}: {last_err}")
+                self._send(Message(T_HELLO))
+            except OSError:
+                # tear this half-made connection down before the retry:
+                # otherwise every failed attempt leaks a socket and a
+                # reader thread whose None sentinel would later be
+                # mistaken for a disconnect on the healthy link
+                shutdown_close(sock)
+                self._sock = None
+                reader.join(timeout=5)
+                while True:
+                    try:
+                        self.replies.get_nowait()
+                    except _queue.Empty:
+                        break
+                raise
+
+        try:
+            self.retry.run(_dial, retry_on=(OSError,),
+                           counter="query.connect")
+        except RetryExhausted as exc:
+            raise ConnectionError(
+                f"cannot connect to {self.host}:{self.port}: "
+                f"{exc.__cause__}") from exc.__cause__
+
+    def _send(self, msg: Message) -> None:
+        # serialize writers: a heartbeat ping must never interleave with
+        # a partially-written DATA frame from the streaming thread
+        with self._send_lock:
+            send_msg(self._sock, msg)
 
     def _read_loop(self) -> None:
         sock = self._sock
@@ -76,39 +124,73 @@ class QueryConnection:
                 self.server_caps = msg.payload.decode()
             elif msg.type == T_REPLY:
                 self.replies.put(msg)
+            elif msg.type == T_PONG:
+                with self._waiters_lock:
+                    evt = self._pong_waiters.pop(msg.seq, None)
+                if evt is not None:
+                    evt.set()
+
+    def ping(self, timeout: float = 1.0) -> float:
+        """Heartbeat probe: send ``T_PING``, await the matching
+        ``T_PONG``.  Returns the RTT in seconds; raises ``TimeoutError``
+        / ``OSError`` on a dead or silent peer."""
+        self._seq += 1
+        seq = self._seq
+        evt = threading.Event()
+        with self._waiters_lock:
+            self._pong_waiters[seq] = evt
+        try:
+            t0 = time.monotonic()
+            try:
+                self._send(Message(T_PING, seq=seq))
+            except AttributeError:   # _sock is None: closed under us
+                raise ConnectionError("not connected") from None
+            if not evt.wait(timeout):
+                raise TimeoutError(
+                    f"no pong from {self.host}:{self.port} "
+                    f"within {timeout}s")
+            return time.monotonic() - t0
+        finally:
+            with self._waiters_lock:
+                self._pong_waiters.pop(seq, None)
 
     def query(self, buf: TensorBuffer) -> Optional[TensorBuffer]:
         """Send one frame, await ITS reply (matched by seq; stale replies
-        from timed-out requests are discarded), reconnecting once."""
+        from timed-out requests are discarded), reconnecting within the
+        request's deadline budget (``timeout`` covers send + reconnect +
+        reply)."""
         self._seq += 1
         seq = self._seq
         msg = Message(T_DATA, seq=seq, pts=buf.pts or 0,
                       payload=encode_tensors(buf))
+        deadline = time.monotonic() + self.timeout
         for attempt in (0, 1):
             try:
-                send_msg(self._sock, msg)
+                self._send(msg)
             except (OSError, AttributeError):
                 if attempt:
                     raise
-                self._reconnect()
+                STATS.incr("query.reconnects")
+                self._reconnect(deadline)
                 continue
-            reply = self._await_reply(seq)
+            reply = self._await_reply(seq, deadline)
             if reply is None:  # disconnected mid-wait → retry once
                 if attempt:
                     raise ConnectionError("server closed connection")
-                self._reconnect()
+                STATS.incr("query.reconnects")
+                self._reconnect(deadline)
                 continue
             out = buf.with_tensors(decode_tensors(reply.payload))
             out.pts = reply.pts
             return out
         return None
 
-    def _await_reply(self, seq: int) -> Optional[Message]:
-        import time as _time
-
-        deadline = _time.monotonic() + self.timeout
+    def _await_reply(self, seq: int,
+                     deadline: Optional[float] = None) -> Optional[Message]:
+        if deadline is None:
+            deadline = time.monotonic() + self.timeout
         while True:
-            remaining = deadline - _time.monotonic()
+            remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(f"no reply within {self.timeout}s")
             try:
@@ -119,8 +201,9 @@ class QueryConnection:
             if reply is None or reply.seq == seq:
                 return reply
             # stale reply from an earlier timed-out request: discard
+            STATS.incr("query.stale_replies")
 
-    def _reconnect(self) -> None:
+    def _reconnect(self, deadline: Optional[float] = None) -> None:
         self.close(send_bye=False)
         # drop anything queued by the dying reader (incl. its None sentinel)
         while True:
@@ -128,7 +211,20 @@ class QueryConnection:
                 self.replies.get_nowait()
             except _queue.Empty:
                 break
-        self.connect()
+        if deadline is not None:
+            # bound the reconnect by the request's remaining budget
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise TimeoutError(
+                    f"no budget left to reconnect to "
+                    f"{self.host}:{self.port}")
+            retry, self.retry = self.retry, self.retry.with_deadline(budget)
+            try:
+                self.connect()
+            finally:
+                self.retry = retry
+        else:
+            self.connect()
 
     def close(self, send_bye: bool = True) -> None:
         self._stop.set()
@@ -136,14 +232,251 @@ class QueryConnection:
         if sock is not None:
             if send_bye:
                 try:
-                    send_msg(sock, Message(T_BYE))
+                    # send on the CAPTURED sock (still under the send
+                    # lock): _send re-reads self._sock, which a racing
+                    # _reconnect may have nulled — an AttributeError
+                    # here would escape teardown
+                    with self._send_lock:
+                        send_msg(sock, Message(T_BYE))
                 except OSError:
                     pass
-            try:
-                sock.close()
-            except OSError:
-                pass
+            # shutdown-then-close wakes the reader thread blocked in
+            # recv (a plain close would leave it blocked forever and the
+            # server would never see a FIN — protocol.py)
+            shutdown_close(sock)
         self._sock = None
+
+
+class FailoverConnection:
+    """Multi-endpoint query connection: one active
+    :class:`QueryConnection` at a time, per-endpoint circuit breakers,
+    optional heartbeat-driven failover.
+
+    ``endpoints`` is an ordered ``[(host, port), …]`` preference list
+    (the ``dest-hosts`` property).  A query failure records on the active
+    endpoint's breaker and rotates to the next endpoint whose breaker
+    admits a call; a heartbeat ``dead`` verdict demotes the active
+    endpoint between frames so the next query fails over without eating
+    a full reply timeout first.
+    """
+
+    _FAILURE = (TimeoutError, ConnectionError, OSError, AttributeError)
+
+    def __init__(self, endpoints: List[Tuple[str, int]],
+                 timeout: float = 10.0, max_retries: int = 3,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker_failures: int = 5,
+                 breaker_cooldown: float = 30.0,
+                 heartbeat_interval: float = 0.0,
+                 heartbeat_max_missed: int = 3,
+                 name: str = "query"):
+        if not endpoints:
+            raise ValueError("FailoverConnection needs >= 1 endpoint")
+        self.endpoints = list(endpoints)
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.retry = retry or RetryPolicy(max_attempts=max(1, max_retries),
+                                          base_delay=0.05, max_delay=0.5)
+        self.breakers = [CircuitBreaker(failure_threshold=breaker_failures,
+                                        cooldown=breaker_cooldown,
+                                        name=f"{name}:{h}:{p}")
+                         for h, p in self.endpoints]
+        self._idx = 0                    # preferred endpoint index
+        self._active: Optional[QueryConnection] = None
+        self._active_idx: Optional[int] = None
+        self._active_key: Optional[str] = None   # lock-free monitor read
+        self._dead = threading.Event()   # heartbeat verdict on active
+        self._lock = threading.RLock()
+        self.monitor: Optional[HealthMonitor] = None
+        if heartbeat_interval > 0:
+            self.monitor = HealthMonitor(
+                interval=heartbeat_interval,
+                max_missed=heartbeat_max_missed,
+                on_down=self._on_endpoint_down, name=name)
+
+    # -- endpoint bookkeeping ------------------------------------------------
+    def _key(self, idx: int) -> str:
+        h, p = self.endpoints[idx]
+        return f"{h}:{p}"
+
+    def _on_endpoint_down(self, key: str) -> None:
+        """Heartbeat verdict: the active endpoint stopped answering.
+        Mark it so the next query fails over immediately instead of
+        waiting out a reply timeout on a dead socket."""
+        # deliberately lock-free: the query thread holds self._lock for
+        # the whole (possibly seconds-long, backoff-sleeping) dial in
+        # _ensure_active, and heartbeats for other endpoints must not
+        # stall behind it.  A stale match only sets a flag the next
+        # _ensure_active clears after reconnecting.
+        if key == self._active_key:
+            self._dead.set()
+
+    @property
+    def server_caps(self) -> Optional[str]:
+        with self._lock:
+            return (self._active.server_caps
+                    if self._active is not None else None)
+
+    @property
+    def active_endpoint(self) -> Optional[Tuple[str, int]]:
+        with self._lock:
+            return (self.endpoints[self._active_idx]
+                    if self._active_idx is not None else None)
+
+    def health_report(self) -> Dict[str, Dict[str, object]]:
+        return self.monitor.report() if self.monitor is not None else {}
+
+    # -- lifecycle -----------------------------------------------------------
+    def connect(self) -> None:
+        """Establish the first connection (rotating through endpoints)."""
+        # start the heartbeat scheduler BEFORE dialing: on a degraded
+        # start (every endpoint down, fallback != error) the dial raises
+        # but the element keeps running, and endpoints watched by later
+        # recoveries still need a live scheduler
+        if self.monitor is not None:
+            self.monitor.start()
+        with self._lock:
+            self._ensure_active()
+
+    def close(self, send_bye: bool = True) -> None:
+        if self.monitor is not None:
+            self.monitor.stop()
+        with self._lock:
+            if self._active is not None:
+                self._active.close(send_bye=send_bye)
+                self._active = None
+                self._active_idx = None
+                self._active_key = None
+
+    # -- core ----------------------------------------------------------------
+    def _ensure_active(self) -> QueryConnection:
+        """Return a live connection, failing over as needed.  Raises
+        :class:`CircuitOpenError` when every breaker refuses, or
+        ``ConnectionError`` when every admitted endpoint is unreachable."""
+        if self._dead.is_set():
+            self._demote("heartbeat")
+        if self._active is not None:
+            return self._active
+        last: Optional[BaseException] = None
+        all_open = True
+        n = len(self.endpoints)
+        for off in range(n):
+            idx = (self._idx + off) % n
+            breaker = self.breakers[idx]
+            if not breaker.allow():
+                continue
+            all_open = False
+            host, port = self.endpoints[idx]
+            # bound the whole per-endpoint dial loop by the request
+            # budget: without the deadline, a blackholed endpoint (SYN
+            # dropped) costs max_attempts x connect-timeout per rotation
+            # inside chain() before the fallback can fire
+            conn = QueryConnection(
+                host, port, self.timeout, self.max_retries,
+                retry=self.retry.with_deadline(self.timeout))
+            try:
+                conn.connect()
+            except ConnectionError as exc:
+                last = exc
+                breaker.record_failure()
+                continue
+            self._active, self._active_idx, self._idx = conn, idx, idx
+            self._active_key = self._key(idx)
+            self._dead.clear()
+            if self.monitor is not None:
+                key = self._key(idx)
+                self.monitor.watch(
+                    key, lambda c=conn: c.ping(
+                        timeout=max(0.1, self.monitor.interval)))
+            if off:
+                STATS.incr("query.failovers")
+            return conn
+        if all_open and n:
+            raise CircuitOpenError(
+                "all endpoints have open circuit breakers: "
+                + ", ".join(self._key(i) for i in range(n)))
+        raise ConnectionError(
+            f"no reachable endpoint among "
+            f"{[self._key(i) for i in range(n)]}: {last!r}")
+
+    def _demote(self, reason: str) -> None:
+        """Drop the active connection and advance the preference index so
+        the next ``_ensure_active`` starts at the following endpoint."""
+        if self._active is not None:
+            if self.monitor is not None and self._active_idx is not None:
+                self.monitor.unwatch(self._key(self._active_idx))
+            self._active.close(send_bye=False)
+            self._active = None
+        if self._active_idx is not None:
+            self._idx = (self._active_idx + 1) % len(self.endpoints)
+            self._active_idx = None
+            self._active_key = None
+            if len(self.endpoints) > 1:
+                # an alternative exists: this demotion starts a failover
+                STATS.incr("query.failovers")
+        self._dead.clear()
+        STATS.incr(f"query.demotions.{reason}")
+
+    def query(self, buf: TensorBuffer) -> Optional[TensorBuffer]:
+        """One frame through the resilient path: per-endpoint breaker
+        gating, rotation on failure, backoff between rotations (so a
+        mid-stream server kill+restart is survived within the retry
+        budget)."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.retry.max_attempts):
+            with self._lock:
+                try:
+                    conn = self._ensure_active()
+                    idx = self._active_idx
+                except CircuitOpenError:
+                    raise                # fail fast: no sleeping on OPEN
+                except ConnectionError as exc:
+                    last = exc
+                    conn = None
+            if conn is not None:
+                breaker = self.breakers[idx]
+                try:
+                    out = conn.query(buf)
+                    breaker.record_success()
+                    return out
+                except self._FAILURE as exc:
+                    last = exc
+                    breaker.record_failure()
+                    STATS.incr("query.failures")
+                    with self._lock:
+                        self._demote("error")
+            if attempt + 1 < self.retry.max_attempts:
+                STATS.incr("query.retries")
+                time.sleep(self.retry.delay(attempt))
+        if isinstance(last, (TimeoutError, ConnectionError, OSError)):
+            raise last
+        if last is not None:
+            # e.g. AttributeError from a connection closed under us:
+            # normalize so chain()'s fallback handling (which catches
+            # the transport error types only) always sees it
+            raise ConnectionError(f"query failed: {last!r}") from last
+        raise ConnectionError("query failed: no endpoint available")
+
+
+def parse_endpoints(spec: str, default_host: str = "127.0.0.1"
+                    ) -> List[Tuple[str, int]]:
+    """``host:port,host2:port2,…`` → ordered endpoint list (a bare
+    ``port`` entry takes ``default_host``)."""
+    out: List[Tuple[str, int]] = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, sep, port = part.rpartition(":")
+        if not sep:
+            host, port = default_host, part
+        if not port.isdigit():
+            raise ValueError(f"dest-hosts: malformed entry {part!r} "
+                             "(want host:port)")
+        out.append((host or default_host, int(port)))
+    if not out:
+        raise ValueError(f"dest-hosts: no endpoints in {spec!r}")
+    return out
 
 
 @register_element
@@ -158,12 +491,33 @@ class TensorQueryClient(Element):
                             "(HYBRID) — the reference's addressing: "
                             "every ssat line uses dest-host/dest-port"),
         "dest-port": (None, "server/broker port"),
+        "dest-hosts": (None, "ordered failover list "
+                             "'host:port,host2:port2' — overrides "
+                             "dest-host/dest-port; the client serves "
+                             "from the first live endpoint and fails "
+                             "over down the list"),
         "connect-type": ("tcp", "TCP | HYBRID (reference nicks; hybrid "
                                 "discovers the data address from the "
                                 "retained MQTT record for the topic)"),
         "topic": (None, "hybrid: discovery topic"),
-        "timeout": (10.0, "reply timeout seconds"),
+        "timeout": (10.0, "reply timeout seconds (per-request budget "
+                          "covering send + reconnect + reply)"),
         "max-retries": (3, "connect retries"),
+        "retry": (None, "retry policy spec 'attempts=4,base=0.05,"
+                        "cap=0.5,mult=2,jitter=0.25[,deadline=S]' "
+                        "(exponential backoff + jitter)"),
+        "fallback": ("error", "what a frame does when the remote is "
+                              "down: error | passthrough | drop"),
+        "breaker-failures": (5, "consecutive failures that OPEN an "
+                                "endpoint's circuit breaker"),
+        "breaker-cooldown": (30.0, "seconds an OPEN breaker waits "
+                                   "before a half-open trial"),
+        "heartbeat-interval": (0.0, "seconds between T_PING heartbeats "
+                                    "on the active endpoint (0 = "
+                                    "disabled); a dead verdict fails "
+                                    "over to the next dest-hosts entry"),
+        "heartbeat-max-missed": (3, "missed pongs before an endpoint "
+                                    "is declared dead"),
     }
 
     def _make_pads(self):
@@ -207,12 +561,43 @@ class TensorQueryClient(Element):
                              "needs dest-port")
         return str(self.host), int(self.port)
 
+    def _endpoints(self) -> List[Tuple[str, int]]:
+        if self.dest_hosts not in (None, ""):
+            return parse_endpoints(str(self.dest_hosts))
+        return [self._server_address()]
+
     def start(self):
-        host, port = self._server_address()
-        self.conn = QueryConnection(host, port,
-                                    float(self.timeout),
-                                    int(self.max_retries))
-        self.conn.connect()
+        self._fallback = str(self.fallback or "error").lower()
+        if self._fallback not in ("error", "passthrough", "drop"):
+            raise ValueError(f"{self.name}: fallback={self.fallback!r} "
+                             "(want error | passthrough | drop)")
+        self.conn = FailoverConnection(
+            self._endpoints(), float(self.timeout),
+            int(self.max_retries),
+            # an explicit retry spec wins; otherwise keep the documented
+            # max-retries contract (parse(None) would be a truthy
+            # 4-attempt default and silently override the property)
+            retry=(RetryPolicy.parse(self.retry)
+                   if self.retry not in (None, "") else None),
+            breaker_failures=int(self.breaker_failures),
+            breaker_cooldown=float(self.breaker_cooldown),
+            heartbeat_interval=float(self.heartbeat_interval),
+            heartbeat_max_missed=int(self.heartbeat_max_missed),
+            name=self.name)
+        try:
+            self.conn.connect()
+        except ConnectionError:
+            if self._fallback == "error":
+                raise
+            # degraded start (reference graceful-degradation story):
+            # stream flows via the fallback while the remote is down;
+            # queries keep probing the endpoints each frame
+            from ..utils.log import logger
+
+            STATS.incr("query.degraded_starts")
+            logger.warning("%s: no endpoint reachable at start; "
+                           "running with fallback=%s", self.name,
+                           self._fallback)
 
     def stop(self):
         conn = getattr(self, "conn", None)
@@ -221,15 +606,61 @@ class TensorQueryClient(Element):
 
     def set_caps(self, pad, caps):
         # announce the server's answer caps when it advertised them,
-        # else assume passthrough shape
+        # else assume passthrough shape (a degraded start has no server
+        # caps yet; chain() re-announces once a recovery learns them)
         sc = self.conn.server_caps
+        self._announced_server_caps = bool(sc)
+        self._sink_caps_str = str(caps)
         if sc:
             self.announce_src_caps(Caps.from_string(sc))
         else:
             super().set_caps(pad, caps)
 
+    def _passthrough_safe(self) -> bool:
+        """May an input frame be pushed downstream as-is?  Only when the
+        downstream negotiation wasn't built on server answer caps that
+        differ from the input caps — otherwise passthrough would hand a
+        wrongly-shaped buffer to elements expecting the server output."""
+        if not getattr(self, "_announced_server_caps", False):
+            return True
+        sc, sk = self.conn.server_caps, getattr(self, "_sink_caps_str", None)
+        if not sc or not sk:
+            return True
+        return str(Caps.from_string(sc)) == str(Caps.from_string(sk))
+
     def chain(self, pad, buf):
-        out = self.conn.query(buf)
+        try:
+            out = self.conn.query(buf)
+        except (TimeoutError, ConnectionError, OSError) as exc:
+            # satellite fix: a reply timeout (or a dead endpoint) maps to
+            # the element's fallback policy instead of escaping the
+            # streaming thread as a raw exception
+            STATS.incr("query.fallbacks")
+            if self._fallback == "passthrough":
+                if self._passthrough_safe():
+                    return self.push(buf)
+                # shapes differ: degrade to drop rather than push an
+                # input-shaped buffer through a downstream negotiated
+                # for the server's answer caps
+                from ..utils.log import logger
+
+                logger.warning("%s: fallback=passthrough unsafe (server "
+                               "caps differ from input); dropping frame",
+                               self.name)
+                return FlowReturn.DROPPED
+            if self._fallback == "drop":
+                return FlowReturn.DROPPED
+            raise ConnectionError(
+                f"{self.name}: query failed with fallback=error: "
+                f"{exc!r}") from exc
         if out is None:
             return FlowReturn.ERROR
+        if not getattr(self, "_announced_server_caps", True):
+            # degraded start negotiated the passthrough shape; the
+            # recovery that served this frame learned the server's real
+            # answer caps — renegotiate downstream before pushing
+            sc = self.conn.server_caps
+            if sc:
+                self._announced_server_caps = True
+                self.announce_src_caps(Caps.from_string(sc))
         return self.push(out)

@@ -25,8 +25,25 @@ from ..pipeline.registry import (register_element,
                                  register_element_alias)
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import tensors_template_caps
-from .protocol import (Message, T_BYE, T_DATA, T_HELLO, decode_tensors,
-                       encode_tensors, recv_msg, send_msg)
+from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
+                       decode_tensors, encode_tensors, recv_msg, send_msg,
+                       shutdown_close)
+from .protocol import create_connection as checked_connect
+from .resilience import STATS, RetryExhausted, RetryPolicy
+
+#: default reconnect policy for edge pub/sub when the ``retry`` property
+#: is unset: the backoff must span a plausible broker restart (seconds),
+#: not just a transient send error — parse(None)'s generic 4x50ms-base
+#: window (~0.35 s of sleep) would give up before a restarted broker is
+#: back, defeating the documented restart survival
+_EDGE_RETRY_DEFAULT = RetryPolicy(max_attempts=10, base_delay=0.1,
+                                  max_delay=1.0, deadline=10.0)
+
+
+def _edge_retry(spec) -> RetryPolicy:
+    if spec in (None, ""):
+        return _EDGE_RETRY_DEFAULT
+    return RetryPolicy.parse(spec)
 
 
 class EdgeBroker:
@@ -42,6 +59,7 @@ class EdgeBroker:
         self.port = self._sock.getsockname()[1]
         self._sock.listen(32)
         self._subs: Dict[str, Set[socket.socket]] = {}
+        self._conns: Set[socket.socket] = set()
         self._topic_caps: Dict[str, str] = {}
         # per-subscriber-socket send locks: concurrent publishers must not
         # interleave partial frames on one subscriber stream
@@ -57,6 +75,8 @@ class EdgeBroker:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            with self._lock:
+                self._conns.add(conn)
             threading.Thread(target=self._conn_loop, args=(conn,),
                              daemon=True).start()
 
@@ -106,13 +126,27 @@ class EdgeBroker:
                         # sub-before-pub startup race)
                         self._fanout(topic, Message(T_HELLO,
                                                     payload=caps.encode()))
+                elif msg.type == T_PING:
+                    # liveness heartbeat: echo seq+payload as PONG (under
+                    # the subscriber's send lock so the reply never
+                    # interleaves with a fanout frame)
+                    with self._lock:
+                        slock = self._send_locks.get(conn)
+                    pong = Message(T_PONG, seq=msg.seq,
+                                   payload=msg.payload)
+                    if slock is None:
+                        send_msg(conn, pong)
+                    else:
+                        with slock:
+                            send_msg(conn, pong)
                 elif msg.type == T_DATA and role == "pub":
                     self._fanout(topic, msg)
         finally:
-            if role == "sub" and topic is not None:
-                with self._lock:
+            with self._lock:
+                if role == "sub" and topic is not None:
                     self._subs.get(topic, set()).discard(conn)
                     self._send_locks.pop(conn, None)
+                self._conns.discard(conn)
             conn.close()
 
     def _fanout(self, topic: str, msg: Message) -> None:
@@ -132,11 +166,24 @@ class EdgeBroker:
                     self._send_locks.pop(s, None)
 
     def close(self) -> None:
+        """Stop the listener AND drop every live connection: a broker
+        "kill" must look like one to its peers immediately (their reads
+        see EOF and the publisher/subscriber reconnect paths kick in)
+        instead of leaving half-dead links blocked in recv."""
         self._stop.set()
         try:
             self._sock.close()
         except OSError:
             pass
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            # shutdown-then-close: a plain close of a socket another
+            # thread is blocked reading sends no FIN, so peers would
+            # never notice the kill AND the dead conns would keep
+            # squatting on the listener's port (protocol.py)
+            shutdown_close(c)
 
 
 _BROKERS: Dict[int, EdgeBroker] = {}
@@ -224,6 +271,9 @@ class EdgeSink(Element):
         "dest-port": (None, "reference addressing: broker port"),
         "ntp-host": (None, "NTP server(s) for epoch alignment, comma-sep "
                            "(default: local wall clock)"),
+        "retry": (None, "reconnect policy spec 'attempts=4,base=0.05,"
+                        "cap=0.5,…' applied when a publish send fails "
+                        "(broker restart survival)"),
     }
 
     def _make_pads(self):
@@ -233,6 +283,7 @@ class EdgeSink(Element):
         from ..utils.ntp import stream_origin_epoch_us
 
         self._ctype = _resolve_reference_dest(self)
+        self._retry = _edge_retry(self.retry)
         if self._ctype == "hybrid" and int(self.port or 0) == 0:
             # verbatim reference HYBRID sink lines configure ONLY the
             # MQTT broker (dest-*): there the sink itself is the data
@@ -241,15 +292,9 @@ class EdgeSink(Element):
             # whatever address the record carries either way
             broker = get_broker()
             self.host, self.port = broker.host, broker.port
-        self._sock = socket.create_connection(
-            (str(self.host), int(self.port)), timeout=10)
-        # publisher sockets only SEND: keep a bounded (long) send timeout
-        # so a wedged broker/subscriber surfaces as a pipeline error
-        # instead of hanging chain() forever (a timed-out partial send
-        # would desync the stream, but the error tears the connection
-        # down anyway)
-        self._sock.settimeout(30.0)
+        self._caps_str: Optional[str] = None
         self._caps_sent = False
+        self._dial_broker()
         # stream-origin epoch: wall clock (NTP-aligned when ntp-host set) at
         # start, when running-time 0 ≈ now — the reference mqttsink's
         # base_time_epoch (mqttsink.c, synchronization-in-mqtt-elements.md)
@@ -260,7 +305,8 @@ class EdgeSink(Element):
 
             self._mqtt = MqttClient(str(self.mqtt_host),
                                     int(self.mqtt_port),
-                                    f"nns-edge-sink-{self.name}")
+                                    f"nns-edge-sink-{self.name}",
+                                    publish_only=True)
             adv = str(self.advertise_host or self.host)
             self._mqtt.publish(
                 f"nns/edge/{self.topic}",
@@ -282,17 +328,68 @@ class EdgeSink(Element):
         except OSError:
             pass
 
+    def _dial_broker(self) -> None:
+        """(Re)connect to the broker and re-announce the pub role (+caps
+        when already negotiated, restoring the retained record a
+        restarted broker lost)."""
+        old = getattr(self, "_sock", None)
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        self._sock = checked_connect(
+            (str(self.host), int(self.port)), timeout=10)
+        # publisher sockets only SEND: keep a bounded (long) send timeout
+        # so a wedged broker/subscriber surfaces as a pipeline error
+        # instead of hanging chain() forever (a timed-out partial send
+        # would desync the stream, but the error tears the connection
+        # down anyway)
+        self._sock.settimeout(30.0)
+        if self._caps_str is not None:
+            send_msg(self._sock, Message(T_HELLO, payload=(
+                f"pub:{self.topic}|{self._caps_str}").encode()))
+            self._caps_sent = True
+        elif self._caps_sent:
+            send_msg(self._sock, Message(
+                T_HELLO, payload=f"pub:{self.topic}".encode()))
+
+    def _send_resilient(self, msg: Message) -> None:
+        """Send, reconnecting with backoff on failure (satellite fix:
+        a publisher socket used to die permanently on the first send
+        error — one broker restart killed the pipeline)."""
+        try:
+            send_msg(self._sock, msg)
+            return
+        except OSError:
+            STATS.incr("edge.send_failures")
+
+        def _redial_and_send():
+            self._dial_broker()
+            send_msg(self._sock, msg)
+            STATS.incr("edge.pub_reconnects")
+
+        try:
+            self._retry.run(_redial_and_send,
+                            retry_on=(OSError, ConnectionError),
+                            counter="edge.reconnect")
+        except RetryExhausted as exc:
+            raise ConnectionError(
+                f"{self.name}: cannot republish to broker "
+                f"{self.host}:{self.port}: {exc.__cause__!r}") from exc
+
     def set_caps(self, pad, caps):
-        send_msg(self._sock, Message(T_HELLO, payload=(
+        self._caps_str = str(caps)
+        self._send_resilient(Message(T_HELLO, payload=(
             f"pub:{self.topic}|{caps}").encode()))
         self._caps_sent = True
 
     def chain(self, pad, buf):
         if not self._caps_sent:
-            send_msg(self._sock, Message(T_HELLO,
-                                         payload=f"pub:{self.topic}".encode()))
+            self._send_resilient(Message(
+                T_HELLO, payload=f"pub:{self.topic}".encode()))
             self._caps_sent = True
-        send_msg(self._sock, Message(T_DATA, pts=buf.pts or 0,
+        self._send_resilient(Message(T_DATA, pts=buf.pts or 0,
                                      epoch_us=self._base_epoch_us,
                                      payload=encode_tensors(buf)))
         return FlowReturn.OK
@@ -328,6 +425,9 @@ class EdgeSrc(Source):
         "sync-pts": (False, "re-base incoming PTS onto this host's clock "
                             "using the sender's embedded epoch"),
         "ntp-host": (None, "NTP server(s) for epoch alignment, comma-sep"),
+        "retry": (None, "reconnect policy spec 'attempts=4,base=0.05,"
+                        "cap=0.5,…' applied when the broker link drops "
+                        "(resubscribe after broker restart)"),
     }
 
     def _make_pads(self):
@@ -355,21 +455,15 @@ class EdgeSrc(Source):
         from ..utils.ntp import stream_origin_epoch_us
 
         self._ctype = _resolve_reference_dest(self)
+        self._retry = _edge_retry(self.retry)
+        self._closing = False
         # own stream-origin epoch, for re-basing sender PTS (the receiver
         # half of the reference's NTP-based mqtt timestamp alignment)
         self._base_epoch_us = stream_origin_epoch_us(self.ntp_host, self.name)
         if self._ctype == "hybrid":
             self._discover_hybrid()
-        self._sock = socket.create_connection(
-            (str(self.host), int(self.port)), timeout=10)
-        # the connect timeout must NOT persist as an idle-read timeout: a
-        # subscriber legitimately sits idle until the first publish (e.g.
-        # while a downstream model compiles), and _recv_exact would treat
-        # the timeout as EOF, silently killing the subscription — the
-        # round-2 edge-bench deadline failure
-        self._sock.settimeout(None)
-        send_msg(self._sock, Message(T_HELLO,
-                                     payload=f"sub:{self.topic}".encode()))
+        self._sock = None
+        self._subscribe()
         self._fifo: _queue.Queue = _queue.Queue()
         self._retained_caps: Optional[str] = None
         self._caps_evt = threading.Event()
@@ -377,11 +471,44 @@ class EdgeSrc(Source):
         threading.Thread(target=self._read_loop, daemon=True,
                          name=f"edge-src:{self.name}").start()
 
+    class _Closing(Exception):
+        """Teardown raced a resubscribe: abort the retry loop (not an
+        OSError, so RetryPolicy.run doesn't keep dialing)."""
+
+    def _subscribe(self) -> None:
+        """Dial the broker and announce the sub role (used at start and
+        after a broker restart — the retained topic caps are redelivered
+        on the new link, so a resubscribed source keeps streaming)."""
+        if self._closing:
+            raise EdgeSrc._Closing()
+        old = self._sock
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        sock = checked_connect(
+            (str(self.host), int(self.port)), timeout=10)
+        # the connect timeout must NOT persist as an idle-read timeout: a
+        # subscriber legitimately sits idle until the first publish (e.g.
+        # while a downstream model compiles), and _recv_exact would treat
+        # the timeout as EOF, silently killing the subscription — the
+        # round-2 edge-bench deadline failure
+        sock.settimeout(None)
+        send_msg(sock, Message(T_HELLO,
+                               payload=f"sub:{self.topic}".encode()))
+        self._sock = sock
+        if self._closing:
+            # stop() may have closed the OLD socket while we dialed; it
+            # must not leave this fresh one (and a reader blocked on it)
+            shutdown_close(sock)
+            raise EdgeSrc._Closing()
+
     def stop(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._closing = True
+        # shutdown-then-close wakes the read loop blocked in recv
+        # (protocol.py) so teardown doesn't leak a subscriber thread
+        shutdown_close(self._sock)
         super()._halt()
 
     def _read_loop(self) -> None:
@@ -395,6 +522,25 @@ class EdgeSrc(Source):
                              self.name, e)
                 msg = None
             if msg is None:
+                # link dropped: resubscribe with backoff unless this is
+                # element teardown (broker-restart survival; the broker
+                # pushes the retained caps again once a publisher
+                # re-announces them)
+                if not self._closing and not self._halted.is_set():
+                    try:
+                        self._retry.run(self._subscribe,
+                                        retry_on=(OSError,
+                                                  ConnectionError),
+                                        counter="edge.resubscribe")
+                        STATS.incr("edge.resubscribes")
+                        continue
+                    except EdgeSrc._Closing:
+                        pass   # teardown raced the redial
+                    except RetryExhausted as e:
+                        from ..utils.log import logger
+
+                        logger.error("edge src %s: broker gone, giving "
+                                     "up: %s", self.name, e)
                 self._fifo.put(None)
                 return
             if msg.type == T_HELLO:

@@ -41,8 +41,22 @@ from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import caps_from_config, tensors_template_caps
 from ..tensor.info import TensorInfo, TensorsConfig, TensorsInfo
 from ..utils.log import logger
+from .resilience import STATS, RetryPolicy
 
 _EOS = object()  # in-queue end-of-stream sentinel
+
+
+def _redial_client(elem) -> None:
+    """Swap ``elem._client`` for a freshly-dialed :class:`GrpcTensorClient`
+    (same host/port/IDL) and close the broken one, counting the redial.
+    Shared by the src pull loop and the sink send loop."""
+    old, elem._client = elem._client, GrpcTensorClient(
+        str(elem.host), int(elem.port), elem._codec.idl)
+    STATS.incr("grpc.redials")
+    try:
+        old.close()
+    except Exception:  # noqa: BLE001 - channel already broken
+        pass
 
 
 def _method(idl: str, rpc: str) -> str:
@@ -217,6 +231,12 @@ class GrpcTensorSrc(Source):
                            "queue-blocking with a halt check)"),
         "out": (0, "reference READABLE counter: output buffers "
                    "generated so far"),
+        "retry": (None, "client mode: redial policy spec 'attempts=4,"
+                        "base=0.05,cap=0.5,…' applied when the pulled "
+                        "stream breaks mid-run (query/resilience.py); "
+                        "unset = a broken stream is end-of-stream (the "
+                        "pre-resilience behavior, and the only correct "
+                        "one when the server signals EOS by closing)"),
     }
 
     def _make_pads(self):
@@ -234,6 +254,8 @@ class GrpcTensorSrc(Source):
             self._client = None
         else:
             self._grpc_server = None
+            self._retry = (RetryPolicy.parse(self.retry)
+                           if self.retry not in (None, "") else None)
             self._client = GrpcTensorClient(str(self.host), int(self.port),
                                             self._codec.idl)
             self._fifo = _queue.Queue()
@@ -241,19 +263,48 @@ class GrpcTensorSrc(Source):
                              name=f"grpc-src:{self.name}").start()
 
     def _pull_loop(self) -> None:
-        try:
-            for blob in self._client.recv_stream():
-                self._fifo.put(blob)
-        except Exception as e:  # noqa: BLE001 - stream end/teardown
-            logger.debug("grpc src %s: recv stream ended: %r", self.name, e)
+        import time as _time
+
+        # a clean server-side finish ends the iterator without raising;
+        # only the error path is retryable.  Channel creation is lazy
+        # (grpcio never fails at dial time), so the backoff loop is
+        # driven here: each broken stream costs one delay step, a
+        # delivered frame resets the budget.
+        attempt = 0
+        while True:
+            try:
+                for blob in self._client.recv_stream():
+                    attempt = 0
+                    self._fifo.put(blob)
+            except Exception as e:  # noqa: BLE001 - stream broke
+                if (self._retry is not None and not self._halted.is_set()
+                        and attempt + 1 < self._retry.max_attempts):
+                    logger.warning("grpc src %s: stream broke (%r), "
+                                   "redialing", self.name, e)
+                    STATS.incr("grpc.reconnect.retries")
+                    _time.sleep(self._retry.delay(attempt))
+                    attempt += 1
+                    if self._halted.is_set():
+                        break   # stop() raced the backoff sleep: a
+                                # redial now would leak a live channel
+                                # pulling into an unconsumed fifo
+                    _redial_client(self)
+                    continue
+                logger.debug("grpc src %s: recv stream ended: %r",
+                             self.name, e)
+            break
         self._fifo.put(_EOS)
 
     def stop(self):
+        # halt BEFORE closing the client: closing first makes
+        # recv_stream raise while _halted is still clear, and a
+        # configured retry policy would redial a live server from a
+        # stopped element (leaked channel + unconsumed fifo growth)
+        super()._halt()
         if self._grpc_server is not None:
             self._grpc_server.close()
         if self._client is not None:
             self._client.close()
-        super()._halt()
 
     def _next_blob(self):
         while not self._halted.is_set():
@@ -304,6 +355,11 @@ class GrpcTensorSink(Element):
         "port": (55115, "bind/dial port (0 = ephemeral when serving)"),
         "server": (False, "host the service (else dial as client)"),
         "idl": ("protobuf", "message IDL: protobuf|flatbuf"),
+        "retry": (None, "client mode: redial policy spec 'attempts=4,"
+                        "base=0.05,cap=0.5,…' applied when the push "
+                        "stream breaks mid-run (frames in flight are "
+                        "lost, QoS-0 style); unset = log and stop "
+                        "sending (the pre-resilience behavior)"),
     }
 
     def _make_pads(self):
@@ -321,6 +377,8 @@ class GrpcTensorSink(Element):
             self._send_thread = None
         else:
             self._grpc_server = None
+            self._retry = (RetryPolicy.parse(self.retry)
+                           if self.retry not in (None, "") else None)
             self._client = GrpcTensorClient(str(self.host), int(self.port),
                                             self._codec.idl)
             self._sendq: _queue.Queue = _queue.Queue()
@@ -330,17 +388,50 @@ class GrpcTensorSink(Element):
             self._send_thread.start()
 
     def _send_loop(self) -> None:
-        def gen():
-            while True:
-                item = self._sendq.get()
-                if item is _EOS:
-                    return
-                yield item
-        try:
-            self._client.send_stream(gen())
-        except Exception as e:  # noqa: BLE001 - peer gone at teardown
-            logger.warning("grpc sink %s: send stream failed: %r",
-                           self.name, e)
+        import time as _time
+
+        attempt = 0
+        while True:
+            # per-attempt state and queue binding: after a broken RPC,
+            # grpcio's consumer thread may still sit in the OLD gen()'s
+            # queue.get(); it must not share state (or steal frames /
+            # the _EOS sentinel) with the replacement stream
+            state = {"eos": False}
+            sendq = self._sendq
+
+            def gen(q=sendq, s=state):
+                while True:
+                    item = q.get()
+                    if item is _EOS:
+                        s["eos"] = True
+                        return
+                    yield item
+
+            try:
+                self._client.send_stream(gen())
+                return
+            except Exception as e:  # noqa: BLE001 - stream broke
+                # retryable only when a redial policy is set and the
+                # stream didn't already consume its EOS sentinel (frames
+                # in flight are lost — QoS-0 semantics, like the
+                # reference's paho publishes)
+                if (self._retry is not None and not state["eos"]
+                        and attempt + 1 < self._retry.max_attempts):
+                    logger.warning("grpc sink %s: send stream broke "
+                                   "(%r), redialing", self.name, e)
+                    STATS.incr("grpc.reconnect.retries")
+                    # retire the old queue: chain()/stop() move to the
+                    # fresh one, and an _EOS posted to the old unblocks
+                    # the zombie consumer so it can't swallow new items
+                    self._sendq = _queue.Queue()
+                    sendq.put(_EOS)
+                    _time.sleep(self._retry.delay(attempt))
+                    attempt += 1
+                    _redial_client(self)
+                    continue
+                logger.warning("grpc sink %s: send stream failed: %r",
+                               self.name, e)
+                return
 
     def stop(self):
         if self._sendq is not None:

@@ -124,15 +124,25 @@ def _mqtt_str(s: str) -> bytes:
 
 class MqttClient:
     """Blocking MQTT 3.1.1 client, QoS 0 (the reference publishes QoS-0
-    data frames the same way)."""
+    data frames the same way).
+
+    ``keepalive`` (seconds) is a REAL keepalive: it is declared in
+    CONNECT (so a spec-conforming broker may drop us at 1.5× silence)
+    and honored by a background pinger sending PINGREQ every
+    ``keepalive/2`` seconds — the liveness role the reference delegates
+    to paho's keepAliveInterval (mqttsink.c).  0 disables both (the old
+    behavior, still used by one-shot discovery reads)."""
 
     def __init__(self, host: str, port: int, client_id: str,
-                 timeout: float = 5.0) -> None:
+                 timeout: float = 5.0, keepalive: int = 30,
+                 publish_only: bool = False) -> None:
+        self._publish_only = bool(publish_only)
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.settimeout(timeout)
+        self.keepalive = max(0, int(keepalive))
         var = (_mqtt_str("MQTT") + bytes([4])    # protocol level 3.1.1
                + bytes([0x02])                   # clean session
-               + struct.pack(">H", 0))           # keepalive 0 = no timeout
+               + struct.pack(">H", self.keepalive))
         payload = _mqtt_str(client_id)
         pkt = bytes([0x10]) + _remaining_len(len(var) + len(payload)) \
             + var + payload
@@ -145,6 +155,42 @@ class MqttClient:
         self._lock = threading.Lock()
         self._early: List = []   # PUBLISHes delivered before SUBACK
         self._closed = False
+        self._ping_stop = threading.Event()
+        self.pings_sent = 0
+        if self.keepalive:
+            threading.Thread(target=self._ping_loop, daemon=True,
+                             name=f"mqtt-keepalive:{client_id}").start()
+
+    def _ping_loop(self) -> None:
+        # half the declared interval keeps us safely inside the broker's
+        # 1.5×-keepalive disconnect window even if one PINGREQ is lost
+        while not self._ping_stop.wait(self.keepalive / 2.0):
+            try:
+                with self._lock:
+                    self._sock.sendall(bytes([0xC0, 0]))  # PINGREQ
+                self.pings_sent += 1
+                if self._publish_only:
+                    self._drain_unread()
+            except OSError:
+                return   # link gone; reader surfaces the disconnect
+
+    def _drain_unread(self) -> None:
+        """Discard pending inbound bytes (PINGRESPs and stray packets) on
+        a publish-only link: nothing else ever reads this socket, so
+        without this the receive buffer eventually fills and the broker's
+        send side wedges.  Never used when a reader consumes the stream —
+        the two would steal each other's bytes."""
+        import select
+
+        while True:
+            r, _, _ = select.select([self._sock], [], [], 0)
+            if not r:
+                return
+            try:
+                if not self._sock.recv(4096):
+                    return   # EOF: the ping send will surface the close
+            except OSError:
+                return
 
     @staticmethod
     def _split_publish(ptype: int, data: bytes):
@@ -213,6 +259,7 @@ class MqttClient:
 
     def close(self) -> None:
         self._closed = True
+        self._ping_stop.set()
         try:
             with self._lock:
                 self._sock.sendall(bytes([0xE0, 0]))  # DISCONNECT
@@ -230,7 +277,7 @@ def fetch_retained_record(host: str, port: int, topic: str,
     or None when the broker has no record.  Shared by edge_src and
     tensor_query_client HYBRID discovery (one copy of the
     subscribe/wait/parse sequence to keep in sync)."""
-    client = MqttClient(host, port, client_id)
+    client = MqttClient(host, port, client_id, keepalive=0)
     try:
         client._sock.settimeout(timeout)
         client.subscribe(topic)
@@ -311,8 +358,9 @@ class MqttBroker:
                 elif code == 3:     # PUBLISH → fan out (downgraded to QoS 0)
                     topic, pid, body = MqttClient._split_publish(ptype, data)
                     if pid is not None:   # QoS-1 sender needs a PUBACK
-                        conn.sendall(bytes([0x40, 2])
-                                     + struct.pack(">H", pid))
+                        with self._locks[conn]:   # see PINGREQ below
+                            conn.sendall(bytes([0x40, 2])
+                                         + struct.pack(">H", pid))
                     if ptype & 0x01:      # retain flag
                         with self._lock:
                             if body:
@@ -337,7 +385,12 @@ class MqttBroker:
                             with self._lock:
                                 self._subs.get(topic, set()).discard(s)
                 elif code == 12:    # PINGREQ
-                    conn.sendall(bytes([0xD0, 0]))
+                    # under the conn's send lock: this client may also be
+                    # a subscriber receiving a concurrent fanout, and a
+                    # PINGRESP spliced into a partially-sent PUBLISH
+                    # would corrupt its stream
+                    with self._locks[conn]:
+                        conn.sendall(bytes([0xD0, 0]))
                 elif code == 14:    # DISCONNECT
                     return
         finally:
@@ -381,6 +434,8 @@ class MqttSink(Element):
         "port": (1883, "broker port"),
         "pub-topic": ("nnstreamer", "topic to publish"),
         "ntp-host": (None, "NTP server(s) for epoch alignment, comma-sep"),
+        "keepalive": (30, "MQTT keepalive seconds declared in CONNECT "
+                          "and honored by a PINGREQ pinger (0 = off)"),
     }
 
     def _make_pads(self):
@@ -390,7 +445,9 @@ class MqttSink(Element):
         from ..utils.ntp import stream_origin_epoch_us
 
         self._client = MqttClient(str(self.host), int(self.port),
-                                  f"nns-sink-{self.name}")
+                                  f"nns-sink-{self.name}",
+                                  keepalive=int(self.keepalive),
+                                  publish_only=True)
         self._base_epoch_us = stream_origin_epoch_us(self.ntp_host,
                                                      self.name)
         self._caps_str = ""
@@ -437,6 +494,8 @@ class MqttSrc(Source):
         "debug": (False, "reference mqttsrc debug flag"),
         "is-live": (True, "reference live-source flag (always live "
                           "here)"),
+        "keepalive": (30, "MQTT keepalive seconds declared in CONNECT "
+                          "and honored by a PINGREQ pinger (0 = off)"),
     }
 
     def _make_pads(self):
@@ -448,7 +507,8 @@ class MqttSrc(Source):
         self._base_epoch_us = stream_origin_epoch_us(self.ntp_host,
                                                      self.name)
         self._client = MqttClient(str(self.host), int(self.port),
-                                  f"nns-src-{self.name}")
+                                  f"nns-src-{self.name}",
+                                  keepalive=int(self.keepalive))
         self._client.subscribe(str(self.sub_topic))
         self._fifo: _queue.Queue = _queue.Queue()
         self._count = 0

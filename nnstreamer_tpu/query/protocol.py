@@ -20,7 +20,13 @@ tensorwire kernels (0 = unchecked — the pure-Python CRC would serialize
 the hot path); receivers verify only nonzero values, so mixed
 native/fallback hosts interoperate.
 Types: 1=HELLO (payload = caps string utf8), 2=DATA, 3=REPLY, 4=BYE,
-5=ERROR (payload = message).
+5=ERROR (payload = message), 6=PING, 7=PONG.
+``PING``/``PONG`` are the liveness heartbeat (query/resilience.py): any
+peer may send PING at any time; the receiver echoes seq and payload back
+as PONG immediately, out of band with DATA/REPLY.  The sender matches
+PONGs by seq and derives RTT — the keep-alive role of libnnstreamer-edge's
+connection monitoring.  Both types are additive: a rev-3 frame stream
+without them is still valid, so the magic is unchanged.
 """
 
 from __future__ import annotations
@@ -43,7 +49,55 @@ from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 MAGIC = 0x4E4E5353  # 'NNSS'
 HEADER = struct.Struct("<IBQQqqII")
 
-T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR = 1, 2, 3, 4, 5
+T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR, T_PING, T_PONG = \
+    1, 2, 3, 4, 5, 6, 7
+
+
+def create_connection(address, timeout=None):
+    """``socket.create_connection`` with a loopback self-connect guard.
+
+    A connect retried against a local port with no listener (every
+    reconnect/resubscribe loop in this package does exactly that while
+    the peer is down) can be assigned that very port as its ephemeral
+    local port and "succeed" via TCP simultaneous open — the socket is
+    connected to itself, reads back its own writes, and squats on the
+    peer's port without SO_REUSEADDR so the real server can't bind when
+    it restarts.  Detect it and fail like the refused connect it should
+    have been, so retry policies keep backing off.
+    """
+    sock = socket.create_connection(address, timeout=timeout)
+    try:
+        self_connected = sock.getsockname() == sock.getpeername()
+    except OSError:        # reset under us: let the caller's I/O surface it
+        self_connected = False
+    if self_connected:
+        sock.close()
+        raise ConnectionRefusedError(
+            f"self-connect to {address[0]}:{address[1]} "
+            "(no listener on port)")
+    return sock
+
+
+def shutdown_close(sock) -> None:
+    """Tear a socket down so every observer notices immediately.
+
+    ``close()`` alone does not wake a thread blocked in ``recv`` on the
+    same fd — the in-flight syscall keeps the kernel socket alive, no FIN
+    is sent, and both that thread and the remote peer block forever (it
+    also keeps an accepted socket squatting on the listener's port, so a
+    restarted server can't bind).  ``shutdown(SHUT_RDWR)`` delivers EOF
+    to local readers and a FIN to the peer first; then the fd closes.
+    """
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 _CRC_FN = None  # resolved once: callable | False (unavailable)

@@ -24,8 +24,9 @@ from ..pipeline.graph import Source
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import tensors_template_caps
-from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_REPLY,
-                       decode_tensors, encode_tensors, recv_msg, send_msg)
+from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
+                       T_REPLY, decode_tensors, encode_tensors, recv_msg,
+                       send_msg, shutdown_close)
 
 
 class QueryServer:
@@ -44,6 +45,10 @@ class QueryServer:
         self._sock.listen(16)
         self.incoming: _queue.Queue = _queue.Queue()
         self._clients: Dict[int, socket.socket] = {}
+        # per-client send locks: the reader thread's handshake/pong
+        # replies must not interleave with a partially-written T_REPLY
+        # from the pipeline thread (mirror of the client's _send_lock)
+        self._send_locks: Dict[int, threading.Lock] = {}
         self._caps_str: Optional[str] = None
         self._next_id = 1
         self._lock = threading.Lock()
@@ -65,10 +70,14 @@ class QueryServer:
                 cid = self._next_id
                 self._next_id += 1
                 self._clients[cid] = conn
+                self._send_locks[cid] = threading.Lock()
             threading.Thread(target=self._client_loop, args=(cid, conn),
                              daemon=True, name=f"query-client-{cid}").start()
 
     def _client_loop(self, cid: int, conn: socket.socket) -> None:
+        # snapshot: stop() clears the dict concurrently, and a KeyError
+        # here would escape the except-OSError below
+        slock = self._send_locks.get(cid) or threading.Lock()
         try:
             while not self._stop.is_set():
                 try:
@@ -79,9 +88,18 @@ class QueryServer:
                     break
                 if msg.type == T_HELLO:
                     # capability handshake: reply with server caps string
-                    send_msg(conn, Message(T_HELLO, client_id=cid,
-                                           payload=(self._caps_str or "")
-                                           .encode()))
+                    with slock:
+                        send_msg(conn, Message(T_HELLO, client_id=cid,
+                                               payload=(self._caps_str
+                                                        or "").encode()))
+                    continue
+                if msg.type == T_PING:
+                    # liveness heartbeat: echo seq+payload immediately,
+                    # out of band with DATA/REPLY (query/resilience.py)
+                    with slock:
+                        send_msg(conn, Message(T_PONG, client_id=cid,
+                                               seq=msg.seq,
+                                               payload=msg.payload))
                     continue
                 if msg.type == T_DATA:
                     buf = TensorBuffer(tensors=decode_tensors(msg.payload),
@@ -89,22 +107,30 @@ class QueryServer:
                     buf.extra["query_client_id"] = cid
                     buf.extra["query_seq"] = msg.seq
                     self.incoming.put(buf)
+        except OSError:
+            pass   # link reset under us (recv, or a handshake/pong send)
         finally:
             with self._lock:
                 self._clients.pop(cid, None)
+                self._send_locks.pop(cid, None)
             conn.close()
 
     def reply(self, buf: TensorBuffer) -> bool:
         cid = buf.extra.get("query_client_id")
         with self._lock:
             conn = self._clients.get(cid)
+            slock = self._send_locks.get(cid)
         if conn is None:
             return False
         msg = Message(T_REPLY, client_id=cid,
                       seq=buf.extra.get("query_seq", 0),
                       pts=buf.pts or 0, payload=encode_tensors(buf))
         try:
-            send_msg(conn, msg)
+            if slock is not None:
+                with slock:
+                    send_msg(conn, msg)
+            else:
+                send_msg(conn, msg)
             return True
         except OSError:
             return False
@@ -116,12 +142,13 @@ class QueryServer:
         except OSError:
             pass
         with self._lock:
-            for conn in self._clients.values():
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+            conns = list(self._clients.values())
             self._clients.clear()
+            self._send_locks.clear()
+        for conn in conns:
+            # shutdown-then-close: a plain close of a socket another
+            # thread is blocked reading sends no FIN (protocol.py)
+            shutdown_close(conn)
 
 
 #: server table: id → QueryServer (pairs serversrc/serversink)

@@ -1,0 +1,12 @@
+"""Deterministic fault-injection helpers for exercising the resilience
+substrate (query/resilience.py) without flaky-network luck.
+
+:mod:`nnstreamer_tpu.testing.faults` ships the chaos TCP proxy the
+``tests/test_resilience.py`` suite drives; it is importable from
+production code too (e.g. a staging soak harness) but is never on the
+streaming hot path.
+"""
+
+from .faults import ChaosProxy
+
+__all__ = ["ChaosProxy"]

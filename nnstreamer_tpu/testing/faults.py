@@ -1,0 +1,186 @@
+"""Chaos TCP proxy: deterministic fault injection between two peers.
+
+Sits between a client and a real server (``client → proxy → upstream``)
+and injects the failure modes a flaky edge link produces, each
+toggleable at runtime while connections are live:
+
+- ``refuse``      — accept then immediately close (dial succeeds, link
+  dies before the first byte: the half-open-connect failure mode)
+- ``blackhole``   — keep connections open but silently discard every
+  byte in both directions (dead peer that still ACKs: forces reply
+  timeouts instead of fast connection errors)
+- ``delay``       — sleep N seconds before forwarding each chunk
+  (congested link; drives deadline-budget paths)
+- ``corrupt``     — flip one byte per forwarded chunk (bit rot on the
+  wire; drives CRC / bad-magic rejection)
+- ``truncate_after`` — forward only the first N bytes of each
+  connection, then cut it (mid-frame stream truncation)
+- ``disconnect_once`` — cut the connection after the next forwarded
+  chunk, then auto-clear (the classic one-shot mid-stream drop)
+- :meth:`kill_connections` — drop every live connection now (server
+  kill / link reset), leaving the listener up for reconnects
+
+The listener port is stable across :meth:`set_upstream` retargets, so a
+"server killed and restarted on a new port" scenario is: kill the
+server, ``kill_connections()``, start the replacement, retarget.
+Threads only, no sleeps besides the explicit ``delay`` fault; the only
+package dependency is the shared socket-teardown helper
+(query/protocol.py ``shutdown_close``).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from time import sleep as _sleep
+from typing import Dict, List, Tuple
+
+from ..query.protocol import shutdown_close as _shutdown_close
+
+
+class ChaosProxy:
+    """TCP fault-injection proxy (see module docstring for the fault
+    vocabulary).  Fault attributes are plain booleans/floats assigned at
+    runtime; each forwarded chunk re-reads them, so a toggle takes
+    effect on in-flight connections immediately."""
+
+    def __init__(self, upstream: Tuple[str, int],
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.upstream: Tuple[str, int] = (str(upstream[0]),
+                                          int(upstream[1]))
+        self.refuse = False
+        self.blackhole = False
+        self.delay = 0.0
+        self.corrupt = False
+        self.truncate_after = 0
+        self.disconnect_once = False
+        self.stats: Dict[str, int] = {
+            "accepted": 0, "refused": 0, "killed": 0, "corrupted": 0,
+            "truncated": 0, "blackholed_bytes": 0, "forwarded_bytes": 0,
+        }
+        self._lock = threading.Lock()
+        self._live: List[socket.socket] = []
+        self._stop = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self.host = host
+        self.port = self._listener.getsockname()[1]
+        self._listener.listen(32)
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"chaos-proxy:{self.port}").start()
+
+    # -- control -------------------------------------------------------------
+    def set_upstream(self, host: str, port: int) -> None:
+        """Retarget NEW connections (the listener port never changes —
+        kill+restart scenarios keep the client's address stable)."""
+        self.upstream = (str(host), int(port))
+
+    def kill_connections(self) -> int:
+        """Drop every live connection now; returns how many died."""
+        with self._lock:
+            victims, self._live = self._live, []
+        for s in victims:
+            _shutdown_close(s)
+        self.stats["killed"] += len(victims) // 2 or len(victims)
+        return len(victims)
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.kill_connections()
+
+    # -- data path -----------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            if self.refuse:
+                self.stats["refused"] += 1
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            self.stats["accepted"] += 1
+            try:
+                server = socket.create_connection(self.upstream,
+                                                  timeout=5.0)
+                server.settimeout(None)
+            except OSError:
+                if self.blackhole:
+                    # dead upstream behind a blackhole: keep the client
+                    # side open and swallow its bytes anyway
+                    with self._lock:
+                        self._live.append(client)
+                    threading.Thread(target=self._pump,
+                                     args=(client, None), daemon=True,
+                                     name="chaos-pump").start()
+                else:
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
+                continue
+            with self._lock:
+                self._live.extend((client, server))
+            threading.Thread(target=self._pump, args=(client, server),
+                             daemon=True, name="chaos-pump-c2s").start()
+            threading.Thread(target=self._pump, args=(server, client),
+                             daemon=True, name="chaos-pump-s2c").start()
+
+    def _pump(self, src: socket.socket,
+              dst: "socket.socket | None") -> None:
+        forwarded = 0
+        while not self._stop.is_set():
+            try:
+                data = src.recv(65536)
+            except OSError:
+                break
+            if not data:
+                break
+            if self.blackhole or dst is None:
+                self.stats["blackholed_bytes"] += len(data)
+                continue
+            if self.delay:
+                _sleep(self.delay)
+            if self.corrupt:
+                mutated = bytearray(data)
+                mutated[len(mutated) // 2] ^= 0xFF
+                data = bytes(mutated)
+                self.stats["corrupted"] += 1
+            cut = False
+            if self.truncate_after:
+                budget = self.truncate_after - forwarded
+                if budget <= 0:
+                    self.stats["truncated"] += 1
+                    break
+                if len(data) > budget:
+                    data = data[:budget]
+                    self.stats["truncated"] += 1
+                    cut = True
+            try:
+                dst.sendall(data)
+            except OSError:
+                break
+            forwarded += len(data)
+            self.stats["forwarded_bytes"] += len(data)
+            if cut:
+                break
+            if self.disconnect_once:
+                self.disconnect_once = False
+                self.stats["killed"] += 1
+                break
+        for s in (src, dst):
+            if s is None:
+                continue
+            with self._lock:
+                if s in self._live:
+                    self._live.remove(s)
+            _shutdown_close(s)

@@ -22,6 +22,17 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # mirror the pyproject.toml marker registry so the suite stays
+    # --strict-markers-clean even when run from another rootdir
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 "
+                   "gate (ROADMAP.md runs -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests driving the "
+                   "nnstreamer_tpu.testing.faults proxy")
+
+
 @pytest.fixture(scope="session")
 def jax_cpu_devices():
     import jax
