@@ -564,8 +564,15 @@ def bench_edge(dtype_prop: str) -> dict:
     # (that's the reference-parity transport).
     try:
         ring = f"nns-bench-{os.getpid()}"
+        # prefetch=1: drain the ring from a reader thread (the SAME
+        # decoupling the TCP row gets from edge_src's broker-reader +
+        # unbounded fifo) so the producer pipeline front-loads its work
+        # and stops contending with the consumer's compute — without it
+        # the bounded ring keeps both pipelines interleaved for the
+        # whole window and the comparison measures GIL contention, not
+        # the transport
         recv = parse_launch(
-            f"tensor_shm_src path={ring} timeout=60 "
+            f"tensor_shm_src path={ring} timeout=60 prefetch=1 "
             f"num-buffers={N_FRAMES} ! "
             "tensor_filter framework=xla model=mobilenet_v2"
             f" custom=seed:0{dtype_prop} batch={STREAM_BATCH} name=f ! "
@@ -578,8 +585,10 @@ def bench_edge(dtype_prop: str) -> dict:
             "tensor_converter ! "
             # push timeout must ride out the consumer's one-time model
             # compile (the ring fills long before the filter's first
-            # drain on a cold cache)
-            f"tensor_shm_sink path={ring} slots=64 timeout=300")
+            # drain on a cold cache); 256 KiB slots fit the 147 KiB
+            # frame without the default 1 MiB over-allocation
+            f"tensor_shm_sink path={ring} slots=64 slot-bytes=262144 "
+            "timeout=300")
         try:
             fps_shm, _ = _measure(recv, "out", feeders=(send,))
             out["fps_shm_transport"] = round(fps_shm, 2)

@@ -49,6 +49,16 @@ class OctetStreamDecoder(Decoder):
             "framerate": config.rate or Fraction(0, 1)})])
 
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
+        from ..pipeline.tracing import record_copy
+
+        if buf.num_tensors == 1:
+            arr = buf.np(0)
+            if arr.flags.c_contiguous:
+                # single contiguous tensor: the raw bytes ARE the
+                # payload — reinterpret, don't concatenate
+                return buf.with_tensors(
+                    [arr.reshape(-1).view(np.uint8)])
         chunks = [np.ascontiguousarray(buf.np(i)).reshape(-1).view(np.uint8)
                   for i in range(buf.num_tensors)]
+        record_copy(sum(c.nbytes for c in chunks))
         return buf.with_tensors([np.concatenate(chunks)])

@@ -37,6 +37,8 @@ class FlexbufDecoder(Decoder):
             "framerate": config.rate or Fraction(0, 1)})])
 
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
+        from ..pipeline.tracing import record_copy
+
         parts = []
         for i in range(buf.num_tensors):
             arr = buf.np(i)
@@ -44,6 +46,7 @@ class FlexbufDecoder(Decoder):
             parts.append(meta.to_bytes())
             parts.append(np.ascontiguousarray(arr).tobytes())
         blob = b"".join(parts)
+        record_copy(len(blob))   # serialization output IS a materialize
         return buf.with_tensors([np.frombuffer(blob, np.uint8)])
 
 

@@ -53,6 +53,9 @@ class _Adapter:
 
     def take(self, n: int) -> np.ndarray:
         assert n <= self.available
+        from ..pipeline.tracing import record_copy
+
+        record_copy(n)   # re-chunking is a real copy: keep it observable
         out = np.empty(n, np.uint8)
         filled = 0
         while filled < n:
@@ -72,9 +75,14 @@ class _Adapter:
         producer arrays, valid only within the chain call that pushed them —
         a producer reusing its scratch buffer would otherwise corrupt bytes
         still queued here.  Call at the end of each chain call."""
+        if not self._chunks:
+            return
+        from ..pipeline.tracing import record_copy
+
+        record_copy(self.available)
         if len(self._chunks) == 1:
             self._chunks[0] = self._chunks[0].copy()
-        elif self._chunks:
+        else:
             self._chunks = [np.concatenate(self._chunks)]
 
     def clear(self) -> None:
@@ -96,12 +104,8 @@ class TensorConverter(Element):
                               "returns the live list)"),
     }
 
-    def set_property(self, key, value):
-        if key == "sub-plugins":
-            # reference G_PARAM_READABLE-only: writing is an error
-            raise ValueError(f"{self.FACTORY}: property {key!r} is "
-                             "read-only")
-        super().set_property(key, value)
+    #: reference G_PARAM_READABLE-only (enforced by Element.set_property)
+    READONLY_PROPERTIES = ("sub-plugins",)
 
     def get_property(self, key):
         if key in ("sub-plugins", "sub_plugins"):

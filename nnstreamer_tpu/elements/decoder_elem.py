@@ -28,11 +28,8 @@ class TensorDecoder(Element):
                                "decoder modes")},
         **{f"option{i}": (None, f"decoder option {i}") for i in range(1, 10)})
 
-    def set_property(self, key, value):
-        if key == "sub-plugins":
-            raise ValueError(f"{self.FACTORY}: property {key!r} is "
-                             "read-only")
-        super().set_property(key, value)
+    #: reference G_PARAM_READABLE-only (enforced by Element.set_property)
+    READONLY_PROPERTIES = ("sub-plugins",)
 
     def get_property(self, key):
         if key in ("sub-plugins", "sub_plugins"):

@@ -74,6 +74,18 @@ class TensorFilter(Element):
         "latency-report": (False, "report invoke latency"),
         "batch": (1, "micro-batch N frames into one device invoke "
                      "(latency/throughput trade; backend-gated)"),
+        "batch-timeout-ms": (0.0, "adaptive micro-batch deadline: with "
+                                  "batch>1, dispatch the collecting "
+                                  "bucket when it FILLS or when the "
+                                  "oldest queued frame has waited this "
+                                  "long — and flush in-flight results "
+                                  "whose frames' budget expired — so "
+                                  "one launch line serves both "
+                                  "throughput (bucket fills fast, "
+                                  "deadline never fires) and latency "
+                                  "(underrun dispatches partial "
+                                  "buckets).  0 = fixed batching (wait "
+                                  "for a full bucket / EOS)"),
         "inflight": (1, "dispatched micro-batches kept in flight before "
                         "the oldest is awaited (pipeline depth).  1 = "
                         "double-buffered (one collecting, one dispatched)"
@@ -101,14 +113,13 @@ class TensorFilter(Element):
     }
 
     #: reference G_PARAM_READABLE-only properties — a write is an
-    #: error there (critical warning), not a silent no-op
+    #: error there (critical warning), not a silent no-op; enforced by
+    #: Element.set_property (aliases never map TO a read-only name, so
+    #: mapping first preserves the same behavior)
     READONLY_PROPERTIES = ("sub-plugins", "inputranks", "outputranks",
                            "latency", "throughput")
 
     def set_property(self, key, value):
-        if key in self.READONLY_PROPERTIES:
-            raise ValueError(f"{self.FACTORY}: property {key!r} is "
-                             "read-only")
         super().set_property(self.REFERENCE_PROP_ALIASES.get(key, key),
                              value)
 
@@ -194,8 +205,9 @@ class TensorFilter(Element):
                 "cannot shard")
         self._pending: list = []        # per-frame input lists, collecting
         self._pending_bufs: list = []
-        # FIFO of dispatched (bufs, handle) batches; stream order is the
-        # queue order.  Depth 1 keeps the historical double-buffering
+        self._pending_t0 = 0.0          # arrival of the oldest pending frame
+        # FIFO of dispatched (bufs, handle, t0) batches; stream order is
+        # the queue order.  Depth 1 keeps the historical double-buffering
         # (one collecting + one dispatched)
         from collections import deque
 
@@ -209,10 +221,38 @@ class TensorFilter(Element):
             self._inflight_depth = 1
         self._rewarm = False            # re-compile owed after pushdown
         self._pushdown = None           # fn of a fused device reduction
+        # adaptive micro-batching: a deadline-driven coalescer.  With
+        # batch-timeout-ms set, a partial bucket no longer waits for the
+        # stream to fill it — the watcher thread dispatches it (and
+        # flushes expired in-flight results) once the OLDEST queued
+        # frame's latency budget runs out, so throughput configs and
+        # latency configs share one launch line.
+        self._batch_deadline = max(0.0,
+                                   float(self.batch_timeout_ms or 0)) / 1e3
+        if self._batch_deadline > 0 and self._batch <= 1:
+            from ..utils.log import ml_logw
+
+            ml_logw("%s: batch-timeout-ms needs micro-batching (batch>1);"
+                    " ignored", self.name)
+            self._batch_deadline = 0.0
+        import threading
+
+        self._coalesce_lock = threading.Lock()
+        self._deadline_stop = threading.Event()
+        self._deadline_thread = None
         if self._batch > 1:
             self.fw.warmup_batched(self._batch)
+        if self._batch_deadline > 0:
+            self._deadline_thread = threading.Thread(
+                target=self._deadline_loop, daemon=True,
+                name=f"batch-deadline:{self.name}")
+            self._deadline_thread.start()
 
     def stop(self):
+        self._deadline_stop.set()
+        if self._deadline_thread is not None:
+            self._deadline_thread.join(timeout=10)
+            self._deadline_thread = None
         close_backend(getattr(self, "fw", None), self._props)
         self.fw = None
 
@@ -281,11 +321,13 @@ class TensorFilter(Element):
         if self._in_comb is not None:
             tensors = [tensors[i] for i in self._in_comb]
         if self._batch > 1:
-            self._pending.append(list(tensors))
-            self._pending_bufs.append(buf)
-            if len(self._pending) >= self._batch:
-                return self._dispatch_pending()
-            return FlowReturn.OK
+            if self._batch_deadline > 0:
+                # coalescer path: the deadline watcher dispatches/flushes
+                # concurrently, so collection and dispatch serialize on
+                # the coalesce lock (stream order is the lock order)
+                with self._coalesce_lock:
+                    return self._collect_frame(tensors, buf)
+            return self._collect_frame(tensors, buf)
         if self._emit_device:
             outs = fw.invoke(list(tensors), emit_device=True)
         else:
@@ -301,6 +343,20 @@ class TensorFilter(Element):
         return self.push(buf.with_tensors(out_tensors))
 
     # -- micro-batching ------------------------------------------------------
+    def _collect_frame(self, tensors, buf: TensorBuffer) -> FlowReturn:
+        """Append one frame to the collecting bucket; dispatch when it
+        fills.  Caller holds the coalesce lock when the deadline watcher
+        is active."""
+        if not self._pending:
+            import time
+
+            self._pending_t0 = time.monotonic()
+        self._pending.append(list(tensors))
+        self._pending_bufs.append(buf)
+        if len(self._pending) >= self._batch:
+            return self._dispatch_pending()
+        return FlowReturn.OK
+
     def _dispatch_pending(self) -> FlowReturn:
         """Dispatch the collecting batch, then — once the in-flight queue
         is at depth — push the OLDEST batch's results (d2h copies of
@@ -311,14 +367,15 @@ class TensorFilter(Element):
                                             emit_device=True)
         else:
             handle = self.fw.invoke_batched(self._pending, self._batch)
-        self._inflight.append((self._pending_bufs, handle))
+        self._inflight.append((self._pending_bufs, handle,
+                               self._pending_t0))
         self._pending, self._pending_bufs = [], []
         if len(self._inflight) > self._inflight_depth:
             return self._push_inflight(self._inflight.popleft())
         return FlowReturn.OK
 
     def _push_inflight(self, inflight) -> FlowReturn:
-        bufs, handle = inflight
+        bufs, handle, _t0 = inflight
         per_frame = handle.views() if self._emit_device else handle.wait()
         ret = FlowReturn.OK
         for buf, outs in zip(bufs, per_frame):
@@ -328,6 +385,63 @@ class TensorFilter(Element):
             ret = r
         return ret
 
+    def _deadline_loop(self) -> None:
+        """Coalescer watcher: dispatch a partial bucket (and flush
+        expired in-flight batches) once the oldest queued frame has
+        waited batch-timeout-ms.  Under throughput load buckets fill
+        before their deadline and this thread just sleeps; on underrun
+        it bounds per-frame latency."""
+        import time
+
+        to = self._batch_deadline
+        while not self._deadline_stop.is_set():
+            try:
+                with self._coalesce_lock:
+                    now = time.monotonic()
+                    oldest = self._oldest_t0()
+                    if oldest is not None and now - oldest >= to:
+                        self._flush_expired(now)
+                        oldest = self._oldest_t0()
+                wait = (to / 2 if oldest is None
+                        else oldest + to - time.monotonic())
+            except Exception as exc:  # noqa: BLE001 — becomes pipeline err
+                if self.pipeline is not None:
+                    self.pipeline.post_error(self, exc)
+                return
+            self._deadline_stop.wait(max(0.001, min(wait, to / 2)))
+
+    def _oldest_t0(self):
+        """Arrival time of the oldest un-pushed frame (None when idle).
+        Caller holds the coalesce lock."""
+        if self._inflight:
+            return self._inflight[0][2]
+        if self._pending:
+            return self._pending_t0
+        return None
+
+    def _flush_expired(self, now: float) -> None:
+        """Push every batch whose oldest frame's budget expired, oldest
+        first; dispatch the partial bucket if ITS budget expired.  Caller
+        holds the coalesce lock; stream order is preserved because both
+        this thread and chain() push under it."""
+        to = self._batch_deadline
+        while self._inflight and now - self._inflight[0][2] >= to:
+            if self._push_inflight(self._inflight.popleft()) \
+                    is FlowReturn.ERROR:
+                raise RuntimeError(
+                    f"{self.name}: downstream error on deadline flush")
+        if self._pending and now - self._pending_t0 >= to:
+            # _dispatch_pending may itself push an over-depth batch:
+            # its ERROR must propagate like the loop pushes' do
+            if self._dispatch_pending() is FlowReturn.ERROR:
+                raise RuntimeError(
+                    f"{self.name}: downstream error on deadline flush")
+            while self._inflight and now - self._inflight[0][2] >= to:
+                if self._push_inflight(self._inflight.popleft()) \
+                        is FlowReturn.ERROR:
+                    raise RuntimeError(
+                        f"{self.name}: downstream error on deadline flush")
+
     def _drain_batches(self) -> None:
         """Flush the collecting partial batch and the in-flight batch, in
         stream order (EOS, renegotiation, model swap).  A downstream ERROR
@@ -335,6 +449,13 @@ class TensorFilter(Element):
         per-frame path's propagation."""
         if self._batch <= 1:
             return
+        if self._batch_deadline > 0:
+            with self._coalesce_lock:
+                self._drain_batches_locked()
+        else:
+            self._drain_batches_locked()
+
+    def _drain_batches_locked(self) -> None:
         ret = FlowReturn.OK
         if self._pending:
             ret = self._dispatch_pending()

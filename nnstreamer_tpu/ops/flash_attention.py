@@ -109,18 +109,38 @@ def flash_win_table():
         return ()
 
 
+#: Sequence length where naive attention's O(T²) score matrix enters
+#: OOM territory regardless of speed (≈2 GiB/head bf16 at 32k — see
+#: FLASH_MIN_T_DEFAULT): beyond it the kernel is the only feasible
+#: choice, so a measured LOSS at a shorter length stops extrapolating
+#: and the threshold gate (memory regime) takes over.
+MEM_REGIME_MIN_T = 32768
+
+
 def _table_verdict(table, t: int):
     """Kernel-vs-naive verdict for length ``t`` from the measured win
-    table, or None when the table has no say (empty, or ``t`` outside
-    its measured span — the threshold gate decides out-of-span lengths,
-    so the memory-regime fallback survives beyond the longest
-    measurement).  Within the span: an exact hit returns that row;
-    between two measured lengths the kernel is selected only when BOTH
-    neighbors won — hardware data is non-monotonic in T, and an
-    unmeasured interior length must not inherit a win across a loss."""
+    table, or None when the table has no say (empty; ``t`` below its
+    first row, where the threshold gate decides; or ``t`` past the
+    memory-regime bound, where naive's O(T²) scores stop being feasible
+    and the threshold gate's memory fallback takes over).  Within the
+    span: an exact hit returns that row; between two measured lengths
+    the kernel is selected only when BOTH neighbors won — hardware data
+    is non-monotonic in T, and an unmeasured interior length must not
+    inherit a win across a loss.  Just ABOVE the span the carry is
+    ASYMMETRIC, both directions conservative: a trailing LOSS extends
+    (a 0.795x loss measured at 16384 keeps 16385..32767 on the naive
+    path instead of falling through to a threshold that would route
+    them to the kernel — ADVICE r5) until the memory-regime bound where
+    naive stops being feasible; a trailing WIN does not extend (wins
+    are non-monotonic in T, so past the evidence the threshold gate
+    decides, as ever)."""
     rows = sorted((int(T), bool(w)) for T, w in table)
-    if not rows or t < rows[0][0] or t > rows[-1][0]:
+    if not rows or t < rows[0][0]:
         return None
+    if t > rows[-1][0]:
+        if not rows[-1][1] and t < MEM_REGIME_MIN_T:
+            return False         # measured trailing loss carries
+        return None              # threshold / memory gate decides
     below = above = None
     for T, w in rows:
         if T <= t:

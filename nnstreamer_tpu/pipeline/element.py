@@ -189,6 +189,12 @@ class Element:
     #: element type name used in launch strings (override)
     FACTORY: str = ""
     PROPERTIES: Dict[str, Any] = {}
+    #: reference G_PARAM_READABLE-only property names: a write raises
+    #: ValueError (the reference emits a critical warning), reads go
+    #: through get_property as usual.  Entries need not appear in
+    #: PROPERTIES (python-property readouts like tensor_filter's
+    #: latency/throughput belong here too).
+    READONLY_PROPERTIES: "tuple" = ()
 
     def __init__(self, name: Optional[str] = None, **props):
         self.name = name or f"{self.FACTORY or self.__class__.__name__.lower()}{id(self) & 0xffff}"
@@ -254,6 +260,10 @@ class Element:
 
     def set_property(self, key: str, value: Any) -> None:
         attr = key.replace("-", "_")
+        if key in self.READONLY_PROPERTIES \
+                or attr in self.READONLY_PROPERTIES:
+            raise ValueError(f"{self.FACTORY}: property {key!r} is "
+                             "read-only")
         if (key not in self.PROPERTIES and attr not in self.PROPERTIES
                 and key not in self.UNIVERSAL_PROPERTIES):
             raise AttributeError(f"{self.FACTORY}: no property {key!r}")

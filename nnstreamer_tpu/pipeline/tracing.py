@@ -22,6 +22,15 @@ chain), ``proctime_avg_us``, ``fps`` (buffers/sec over the element's
 active window) — the proctime/framerate tracer pair.  ``interlatency``
 (source-to-element transit) is derivable from per-element first/last
 timestamps included as ``window_s``.
+
+Dataflow-copy observability (the zero-copy hot path's regression gate):
+serialize/convert code reports every payload byte it MATERIALIZES into a
+new host buffer via :func:`record_copy`, and pool acquires report
+hits/misses via :func:`record_pool`.  Both attribute to the element whose
+``chain()`` is on the current thread's trace stack, surfacing as
+``bytes_copied`` / ``pool_hits`` / ``pool_misses`` in the report — so a
+re-introduced full-frame copy shows up per element instead of hiding in
+wall time.  With no tracer attached both calls are a single dict lookup.
 """
 
 from __future__ import annotations
@@ -32,13 +41,76 @@ from typing import Dict, Optional
 
 
 class _ElementStats:
-    __slots__ = ("buffers", "proc_ns", "first_ts", "last_ts")
+    __slots__ = ("buffers", "proc_ns", "first_ts", "last_ts",
+                 "bytes_copied", "pool_hits", "pool_misses")
 
     def __init__(self) -> None:
         self.buffers = 0
         self.proc_ns = 0
         self.first_ts: Optional[float] = None
         self.last_ts: Optional[float] = None
+        self.bytes_copied = 0
+        self.pool_hits = 0
+        self.pool_misses = 0
+
+
+#: process-wide per-thread trace frame stack.  Each entry is one live
+#: ``chain()``: [tracer, start_ns, child_ns, bytes_copied, pool_hits,
+#: pool_misses].  Module-level (not per-Tracer) so record_copy /
+#: record_pool reach the active frame without any registry lookups.
+_TLS = threading.local()
+
+
+def _stack():
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+def record_copy(nbytes: int) -> None:
+    """Report ``nbytes`` of payload materialized into a fresh host buffer
+    (``tobytes``/``ascontiguousarray``/adapter compaction...).  Attributes
+    to the element currently in ``chain()`` on this thread; no-op (one
+    getattr) when no tracer is active."""
+    stack = getattr(_TLS, "stack", None)
+    if stack:
+        stack[-1][3] += nbytes
+
+
+def record_pool(hit: bool) -> None:
+    """Report one pool acquire (hit = served from the free list)."""
+    stack = getattr(_TLS, "stack", None)
+    if stack:
+        stack[-1][4 if hit else 5] += 1
+
+
+class copy_probe:
+    """Standalone copy/pool counter for code that isn't a pipeline
+    element (microbenches, unit tests)::
+
+        with copy_probe() as probe:
+            send_tensors(...)
+        assert probe.bytes_copied <= header_bytes
+
+    Pushes a synthetic frame on this thread's trace stack, so
+    record_copy / record_pool attribute to it.
+    """
+
+    def __init__(self) -> None:
+        self.bytes_copied = 0
+        self.pool_hits = 0
+        self.pool_misses = 0
+
+    def __enter__(self) -> "copy_probe":
+        _stack().append([None, 0, 0, 0, 0, 0])
+        return self
+
+    def __exit__(self, *exc) -> None:
+        frame = _stack().pop()
+        self.bytes_copied += frame[3]
+        self.pool_hits += frame[4]
+        self.pool_misses += frame[5]
 
 
 class Tracer:
@@ -54,7 +126,6 @@ class Tracer:
     def __init__(self) -> None:
         self._stats: Dict[str, _ElementStats] = {}
         self._lock = threading.Lock()
-        self._tls = threading.local()
         # resilience counters (query/resilience.py STATS) are process-wide
         # and monotonic; snapshot at attach so the report shows only THIS
         # run's retries/failures/breaker transitions.  Lazy import: the
@@ -66,20 +137,19 @@ class Tracer:
 
     # called from Element._chain_entry — keep it lean
     def enter(self) -> None:
-        stack = getattr(self._tls, "stack", None)
-        if stack is None:
-            stack = self._tls.stack = []
-        stack.append([time.monotonic_ns(), 0])   # [start, child_ns]
+        _stack().append([self, time.monotonic_ns(), 0, 0, 0, 0])
 
     def exit(self, element_name: str) -> None:
-        stack = self._tls.stack
-        start, child_ns = stack.pop()
-        total = time.monotonic_ns() - start
+        stack = _TLS.stack
+        frame = stack.pop()
+        total = time.monotonic_ns() - frame[1]
         if stack:                    # attribute our total to the parent
-            stack[-1][1] += total
-        self._record(element_name, total - child_ns)
+            stack[-1][2] += total
+        self._record(element_name, total - frame[2], frame[3], frame[4],
+                     frame[5])
 
-    def _record(self, element_name: str, proc_ns: int) -> None:
+    def _record(self, element_name: str, proc_ns: int, copied: int,
+                hits: int, misses: int) -> None:
         now = time.monotonic()
         with self._lock:
             st = self._stats.get(element_name)
@@ -89,6 +159,9 @@ class Tracer:
             st.buffers += 1
             st.proc_ns += proc_ns
             st.last_ts = now
+            st.bytes_copied += copied
+            st.pool_hits += hits
+            st.pool_misses += misses
 
     def report(self) -> Dict[str, Dict[str, float]]:
         out: Dict[str, Dict[str, float]] = {}
@@ -104,7 +177,11 @@ class Tracer:
                     "fps": round((st.buffers - 1) / window, 2)
                     if window > 0 else 0.0,
                     "window_s": round(window, 4),
+                    "bytes_copied": st.bytes_copied,
                 }
+                if st.pool_hits or st.pool_misses:
+                    out[name]["pool_hits"] = st.pool_hits
+                    out[name]["pool_misses"] = st.pool_misses
         return out
 
     def resilience_report(self) -> Dict[str, int]:

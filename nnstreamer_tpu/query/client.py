@@ -27,11 +27,11 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
-from ..tensor.buffer import TensorBuffer
+from ..tensor.buffer import TensorBuffer, default_pool
 from ..tensor.caps_util import tensors_template_caps
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
-                       T_REPLY, decode_tensors, encode_tensors, recv_msg,
-                       send_msg, shutdown_close)
+                       T_REPLY, decode_tensors, recv_msg, send_msg,
+                       send_tensors, shutdown_close)
 from .protocol import create_connection as checked_connect
 from .resilience import (STATS, CircuitBreaker, CircuitOpenError,
                          HealthMonitor, RetryExhausted, RetryPolicy)
@@ -56,6 +56,7 @@ class QueryConnection:
                                           base_delay=0.05, max_delay=0.5)
         self.replies: _queue.Queue = _queue.Queue()
         self.server_caps: Optional[str] = None
+        self._pool = default_pool()   # reply payloads land in recycled slabs
         self._sock: Optional[socket.socket] = None
         self._reader: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -111,7 +112,7 @@ class QueryConnection:
         sock = self._sock
         while not self._stop.is_set():
             try:
-                msg = recv_msg(sock)
+                msg = recv_msg(sock, pool=self._pool)
             except ValueError as e:   # bad magic / CRC: stream corrupt
                 from ..utils.log import logger
 
@@ -161,12 +162,14 @@ class QueryConnection:
         reply)."""
         self._seq += 1
         seq = self._seq
-        msg = Message(T_DATA, seq=seq, pts=buf.pts or 0,
-                      payload=encode_tensors(buf))
         deadline = time.monotonic() + self.timeout
         for attempt in (0, 1):
             try:
-                self._send(msg)
+                # scatter-gather framing: tensor payloads go to the
+                # kernel as views, no per-frame blob materialization
+                with self._send_lock:
+                    send_tensors(self._sock, T_DATA, buf, seq=seq,
+                                 pts=buf.pts or 0)
             except (OSError, AttributeError):
                 if attempt:
                     raise
@@ -182,6 +185,7 @@ class QueryConnection:
                 continue
             out = buf.with_tensors(decode_tensors(reply.payload))
             out.pts = reply.pts
+            out.lease = reply.lease   # views alias the pooled slab
             return out
         return None
 

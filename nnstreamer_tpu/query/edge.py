@@ -23,11 +23,11 @@ from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
 from ..pipeline.registry import (register_element,
                                  register_element_alias)
-from ..tensor.buffer import TensorBuffer
+from ..tensor.buffer import TensorBuffer, default_pool
 from ..tensor.caps_util import tensors_template_caps
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
-                       decode_tensors, encode_tensors, recv_msg, send_msg,
-                       shutdown_close)
+                       decode_tensors, recv_msg, send_msg, send_msg_zc,
+                       send_tensors, shutdown_close)
 from .protocol import create_connection as checked_connect
 from .resilience import STATS, RetryExhausted, RetryPolicy
 
@@ -82,10 +82,11 @@ class EdgeBroker:
 
     def _conn_loop(self, conn: socket.socket) -> None:
         role, topic = None, None
+        pool = default_pool()
         try:
             while not self._stop.is_set():
                 try:
-                    msg = recv_msg(conn)
+                    msg = recv_msg(conn, pool=pool)
                 except ValueError:   # bad magic / CRC: drop the connection
                     break
                 if msg is None or msg.type == T_BYE:
@@ -141,6 +142,13 @@ class EdgeBroker:
                             send_msg(conn, pong)
                 elif msg.type == T_DATA and role == "pub":
                     self._fanout(topic, msg)
+                    if msg.lease is not None:
+                        # fan-out copies nothing and keeps no views:
+                        # drop the payload view, then release so the
+                        # slab recycles for the next recv
+                        msg.payload = b""
+                        msg.lease.release()
+                        msg.lease = None
         finally:
             with self._lock:
                 if role == "sub" and topic is not None:
@@ -155,11 +163,13 @@ class EdgeBroker:
                     self._subs.get(topic, ())]
         for s, slock in subs:
             try:
+                # zero-copy relay: header + received payload view as one
+                # sendmsg iovec (no pack() flattening per subscriber)
                 if slock is None:
-                    send_msg(s, msg)
+                    send_msg_zc(s, msg)
                 else:
                     with slock:
-                        send_msg(s, msg)
+                        send_msg_zc(s, msg)
             except OSError:
                 with self._lock:
                     self._subs.get(topic, set()).discard(s)
@@ -355,18 +365,22 @@ class EdgeSink(Element):
                 T_HELLO, payload=f"pub:{self.topic}".encode()))
 
     def _send_resilient(self, msg: Message) -> None:
-        """Send, reconnecting with backoff on failure (satellite fix:
-        a publisher socket used to die permanently on the first send
-        error — one broker restart killed the pipeline)."""
+        self._send_resilient_fn(lambda sock: send_msg(sock, msg))
+
+    def _send_resilient_fn(self, send_fn) -> None:
+        """Send via ``send_fn(sock)``, reconnecting with backoff on
+        failure (satellite fix: a publisher socket used to die
+        permanently on the first send error — one broker restart killed
+        the pipeline)."""
         try:
-            send_msg(self._sock, msg)
+            send_fn(self._sock)
             return
         except OSError:
             STATS.incr("edge.send_failures")
 
         def _redial_and_send():
             self._dial_broker()
-            send_msg(self._sock, msg)
+            send_fn(self._sock)
             STATS.incr("edge.pub_reconnects")
 
         try:
@@ -389,9 +403,11 @@ class EdgeSink(Element):
             self._send_resilient(Message(
                 T_HELLO, payload=f"pub:{self.topic}".encode()))
             self._caps_sent = True
-        self._send_resilient(Message(T_DATA, pts=buf.pts or 0,
-                                     epoch_us=self._base_epoch_us,
-                                     payload=encode_tensors(buf)))
+        # scatter-gather publish: tensor views go straight to sendmsg
+        self._send_resilient_fn(
+            lambda sock: send_tensors(sock, T_DATA, buf,
+                                      pts=buf.pts or 0,
+                                      epoch_us=self._base_epoch_us))
         return FlowReturn.OK
 
     def on_event(self, pad, event):
@@ -512,9 +528,10 @@ class EdgeSrc(Source):
         super()._halt()
 
     def _read_loop(self) -> None:
+        pool = default_pool()
         while True:
             try:
-                msg = recv_msg(self._sock)
+                msg = recv_msg(self._sock, pool=pool)
             except ValueError as e:   # bad magic / CRC: stream corrupt
                 from ..utils.log import logger
 
@@ -554,7 +571,7 @@ class EdgeSrc(Source):
                     # shift by the epoch difference (µs → ns)
                     pts = msg.pts + (msg.epoch_us - self._base_epoch_us) * 1000
                 buf = TensorBuffer(tensors=decode_tensors(msg.payload),
-                                   pts=pts)
+                                   pts=pts, lease=msg.lease)
                 self._fifo.put(buf)
 
     def negotiate(self) -> Caps:

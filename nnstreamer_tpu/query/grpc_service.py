@@ -239,6 +239,11 @@ class GrpcTensorSrc(Source):
                         "one when the server signals EOS by closing)"),
     }
 
+    #: reference G_PARAM_READABLE-only buffer counter — a write is an
+    #: error there (critical warning), matching tensor_converter/
+    #: decoder/filter; enforced by Element.set_property
+    READONLY_PROPERTIES = ("out",)
+
     def _make_pads(self):
         self.add_src_pad(tensors_template_caps(), "src")
 

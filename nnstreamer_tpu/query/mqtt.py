@@ -459,11 +459,14 @@ class MqttSink(Element):
         self._caps_str = str(caps)
 
     def chain(self, pad, buf):
+        from ..pipeline.tracing import record_copy
+
         mems = [np.ascontiguousarray(buf.np(i)).tobytes()
                 for i in range(buf.num_tensors)]
         hdr = pack_header([len(m) for m in mems], self._base_epoch_us,
                           int(time.time() * 1e6), buf.duration, None,
                           buf.pts, self._caps_str)
+        record_copy(len(hdr) + sum(len(m) for m in mems))
         self._client.publish(str(self.pub_topic), hdr + b"".join(mems))
         return FlowReturn.OK
 

@@ -34,11 +34,12 @@ from __future__ import annotations
 import dataclasses
 import socket
 import struct
-from typing import List, Optional
+from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
-from ..tensor.buffer import TensorBuffer
+from ..pipeline.tracing import record_copy
+from ..tensor.buffer import TensorBuffer, TensorBufferPool
 from ..tensor.info import TensorInfo
 from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 
@@ -137,40 +138,135 @@ class Message:
     seq: int = 0
     pts: int = 0
     epoch_us: int = 0
-    payload: bytes = b""
+    #: bytes for control messages; may be a memoryview into a pooled
+    #: slab when received via ``recv_msg(sock, pool=...)``
+    payload: Any = b""
+    #: pool ownership handle for a pooled payload (attach to the
+    #: TensorBuffer built from this message so the slab outlives the
+    #: zero-copy tensor views)
+    lease: Any = dataclasses.field(default=None, repr=False)
+    #: received payload CRC (kept so a relay — the edge broker — can
+    #: forward the payload without recomputing or re-materializing it)
+    crc: int = 0
 
 
 def pack(msg: Message) -> bytes:
+    payload = msg.payload
+    if not isinstance(payload, bytes):
+        payload = bytes(payload)
     return HEADER.pack(MAGIC, msg.type, msg.client_id, msg.seq,
-                       msg.pts, msg.epoch_us, _payload_crc(msg.payload),
-                       len(msg.payload)) + msg.payload
+                       msg.pts, msg.epoch_us, _payload_crc(payload),
+                       len(payload)) + payload
 
 
-def encode_tensors(buf: TensorBuffer) -> bytes:
-    """Serialize all tensors with per-tensor meta headers."""
-    parts = [struct.pack("<I", buf.num_tensors)]
+def tensor_parts(buf: TensorBuffer) -> List[Any]:
+    """DATA payload as an iovec: ``[count_u32, meta, view, meta, view…]``.
+
+    Tensor payloads stay zero-copy memoryviews over the source arrays
+    (device arrays materialize on host here — that is a transfer, not a
+    framing copy; a non-contiguous host array pays one compaction copy,
+    reported via tracing.record_copy).  Only the 4-byte count and the
+    128-byte per-tensor meta headers are fresh bytes.
+    """
+    parts: List[Any] = [struct.pack("<I", buf.num_tensors)]
     for i in range(buf.num_tensors):
         arr = buf.np(i)
         meta = TensorMetaInfo.from_info(TensorInfo.from_np(arr))
         parts.append(meta.to_bytes())
-        parts.append(np.ascontiguousarray(arr).tobytes())
-    return b"".join(parts)
+        if not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)
+            record_copy(arr.nbytes)
+        parts.append(arr.reshape(-1).view(np.uint8).data)
+    return parts
 
 
-def decode_tensors(payload: bytes) -> List[np.ndarray]:
+def _parts_crc(parts: Sequence[Any]) -> int:
+    """Incremental CRC-32C over the iovec (native kernels chain via the
+    seed argument; 0 = unchecked without them)."""
+    fn = _crc_fn()
+    if fn is None:
+        return 0
+    crc = 0
+    for p in parts:
+        crc = fn(p, crc)
+    return crc or 1  # reserve 0 for "absent"
+
+
+def sendmsg_all(sock: socket.socket, parts: Sequence[Any]) -> None:
+    """``sendall`` for an iovec: one ``socket.sendmsg`` gathers every
+    part in kernel space — no ``b"".join`` flattening — looping on
+    partial sends."""
+    parts = [p if isinstance(p, (bytes, memoryview)) else memoryview(p)
+             for p in parts]
+    total = sum(len(p) for p in parts)
+    sent = 0
+    while sent < total:
+        n = sock.sendmsg(parts)
+        sent += n
+        if sent >= total:
+            return
+        # partial send: drop whole parts, slice the straddling one
+        while n > 0 and n >= len(parts[0]):
+            n -= len(parts[0])
+            parts.pop(0)
+        if n:
+            head = parts[0]
+            if isinstance(head, bytes):
+                head = memoryview(head)
+            parts[0] = head[n:]
+
+
+def send_tensors(sock: socket.socket, msg_type: int, buf: TensorBuffer,
+                 client_id: int = 0, seq: int = 0, pts: int = 0,
+                 epoch_us: int = 0) -> None:
+    """Scatter-gather DATA/REPLY send: header + count + per-tensor
+    (meta, payload view) as one ``sendmsg`` iovec.  The tensor payload
+    bytes are handed to the kernel straight from the source arrays —
+    the serialize path's only fresh bytes are the 48-byte wire header,
+    the count word, and the 128-byte metas."""
+    parts = tensor_parts(buf)
+    plen = sum(len(p) if isinstance(p, bytes) else p.nbytes for p in parts)
+    header = HEADER.pack(MAGIC, msg_type, client_id, seq, pts, epoch_us,
+                         _parts_crc(parts), plen)
+    record_copy(len(header))   # header+metas are the copy budget
+    record_copy(4 + META_HEADER_SIZE * buf.num_tensors)
+    sendmsg_all(sock, [header] + parts)
+
+
+def encode_tensors(buf: TensorBuffer) -> bytes:
+    """Serialize all tensors with per-tensor meta headers into one
+    contiguous blob.  This MATERIALIZES every payload byte — transports
+    on the hot path use :func:`tensor_parts` / :func:`send_tensors`
+    instead; this stays for single-blob consumers (mqtt, files) and
+    reports itself to the copy tracer."""
+    parts = tensor_parts(buf)
+    blob = b"".join(bytes(p) if not isinstance(p, bytes) else p
+                    for p in parts)
+    record_copy(len(blob))
+    return blob
+
+
+def decode_tensors(payload) -> List[np.ndarray]:
+    """Zero-copy decode: tensors are views into ``payload`` (bytes or a
+    pooled-slab memoryview).  Views are read-only — pooled payloads are
+    shared (tee contract); attach the message's lease to the
+    TensorBuffer that carries them."""
     (n,) = struct.unpack_from("<I", payload, 0)
     off = 4
     tensors = []
+    from ..tensor.types import dim_to_np_shape
+
     for _ in range(n):
         meta = TensorMetaInfo.from_bytes(payload[off:off + META_HEADER_SIZE])
         off += META_HEADER_SIZE
         size = meta.data_size
         raw = np.frombuffer(payload, np.uint8, count=size, offset=off)
         off += size
-        from ..tensor.types import dim_to_np_shape
-
-        tensors.append(raw.view(meta.dtype.np_dtype)
-                       .reshape(dim_to_np_shape(meta.dims)))
+        arr = (raw.view(meta.dtype.np_dtype)
+               .reshape(dim_to_np_shape(meta.dims)))
+        if arr.flags.writeable:
+            arr.flags.writeable = False
+        tensors.append(arr)
     return tensors
 
 
@@ -178,26 +274,56 @@ def send_msg(sock: socket.socket, msg: Message) -> None:
     sock.sendall(pack(msg))
 
 
-def recv_msg(sock: socket.socket) -> Optional[Message]:
+def send_msg_zc(sock: socket.socket, msg: Message) -> None:
+    """Relay a received message without flattening its payload: header
+    and payload view go out as one ``sendmsg`` iovec, reusing the
+    already-verified CRC (the edge broker's fan-out hot path)."""
+    payload = msg.payload
+    if isinstance(payload, bytes):
+        sock.sendall(pack(msg))
+        return
+    header = HEADER.pack(MAGIC, msg.type, msg.client_id, msg.seq,
+                         msg.pts, msg.epoch_us, msg.crc, len(payload))
+    sendmsg_all(sock, [header, payload])
+
+
+def recv_msg(sock: socket.socket,
+             pool: Optional[TensorBufferPool] = None) -> Optional[Message]:
+    """Receive one message.  With ``pool``, DATA/REPLY payloads land via
+    ``recv_into`` in a recycled :class:`BufferLease` slab (zero
+    intermediate chunk list, zero ``b"".join``) and ``msg.payload`` is a
+    memoryview with ``msg.lease`` holding the slab."""
     hdr = _recv_exact(sock, HEADER.size)
     if hdr is None:
         return None
     magic, typ, cid, seq, pts, epoch, crc, plen = HEADER.unpack(hdr)
     if magic != MAGIC:
         raise ValueError(f"bad magic 0x{magic:08x}")
-    payload = _recv_exact(sock, plen) if plen else b""
-    if plen and payload is None:
-        return None
-    if crc and payload:
+    lease = None
+    if not plen:
+        payload = b""
+    elif pool is not None and typ in (T_DATA, T_REPLY):
+        lease = pool.acquire(plen)
+        payload = lease.memory()
+        if not _recv_exact_into(sock, payload):
+            lease.release()
+            return None
+    else:
+        payload = _recv_exact(sock, plen)
+        if payload is None:
+            return None
+    if crc and plen:
         fn = _crc_fn()
         if fn is not None:
             got = fn(payload) or 1
             if got != crc:
+                if lease is not None:
+                    lease.release()
                 raise ValueError(
                     f"payload CRC mismatch: frame seq={seq} declared "
                     f"0x{crc:08x}, computed 0x{got:08x} (corrupt stream)")
     return Message(type=typ, client_id=cid, seq=seq, pts=pts,
-                   epoch_us=epoch, payload=payload or b"")
+                   epoch_us=epoch, payload=payload, lease=lease, crc=crc)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -213,3 +339,18 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
         chunks.append(chunk)
         got += len(chunk)
     return b"".join(chunks)
+
+
+def _recv_exact_into(sock: socket.socket, mv: memoryview) -> bool:
+    """Fill ``mv`` completely from the socket (True on success)."""
+    got = 0
+    n = len(mv)
+    while got < n:
+        try:
+            k = sock.recv_into(mv[got:])
+        except (ConnectionResetError, OSError):
+            return False
+        if not k:
+            return False
+        got += k
+    return True

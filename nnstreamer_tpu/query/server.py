@@ -22,11 +22,11 @@ from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
 from ..pipeline.registry import register_element
-from ..tensor.buffer import TensorBuffer
+from ..tensor.buffer import TensorBuffer, default_pool
 from ..tensor.caps_util import tensors_template_caps
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
-                       T_REPLY, decode_tensors, encode_tensors, recv_msg,
-                       send_msg, shutdown_close)
+                       T_REPLY, decode_tensors, recv_msg, send_msg,
+                       send_tensors, shutdown_close)
 
 
 class QueryServer:
@@ -78,10 +78,11 @@ class QueryServer:
         # snapshot: stop() clears the dict concurrently, and a KeyError
         # here would escape the except-OSError below
         slock = self._send_locks.get(cid) or threading.Lock()
+        pool = default_pool()
         try:
             while not self._stop.is_set():
                 try:
-                    msg = recv_msg(conn)
+                    msg = recv_msg(conn, pool=pool)
                 except ValueError:   # bad magic / CRC: drop the connection
                     break
                 if msg is None or msg.type == T_BYE:
@@ -103,7 +104,7 @@ class QueryServer:
                     continue
                 if msg.type == T_DATA:
                     buf = TensorBuffer(tensors=decode_tensors(msg.payload),
-                                       pts=msg.pts)
+                                       pts=msg.pts, lease=msg.lease)
                     buf.extra["query_client_id"] = cid
                     buf.extra["query_seq"] = msg.seq
                     self.incoming.put(buf)
@@ -122,15 +123,15 @@ class QueryServer:
             slock = self._send_locks.get(cid)
         if conn is None:
             return False
-        msg = Message(T_REPLY, client_id=cid,
-                      seq=buf.extra.get("query_seq", 0),
-                      pts=buf.pts or 0, payload=encode_tensors(buf))
+        seq = buf.extra.get("query_seq", 0)
         try:
             if slock is not None:
                 with slock:
-                    send_msg(conn, msg)
+                    send_tensors(conn, T_REPLY, buf, client_id=cid,
+                                 seq=seq, pts=buf.pts or 0)
             else:
-                send_msg(conn, msg)
+                send_tensors(conn, T_REPLY, buf, client_id=cid,
+                             seq=seq, pts=buf.pts or 0)
             return True
         except OSError:
             return False

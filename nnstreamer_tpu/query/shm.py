@@ -30,13 +30,17 @@ import struct
 import time
 from typing import Optional, Tuple
 
+import numpy as np
+
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
 from ..pipeline.registry import register_element
-from ..tensor.buffer import TensorBuffer
+from ..pipeline.tracing import record_copy
+from ..tensor.buffer import (BufferLease, TensorBuffer, TensorBufferPool,
+                             default_pool)
 from ..tensor.caps_util import tensors_template_caps
-from .protocol import decode_tensors, encode_tensors
+from .protocol import decode_tensors, tensor_parts
 
 # region layout constants — must match native/tensorwire/shmring.cc
 _MAGIC = 0x4E545352  # 'NTSR'
@@ -72,6 +76,12 @@ def _native_lib():
         lib.tw_shm_push.restype = ctypes.c_int
         lib.tw_shm_push.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64,
                                     ctypes.c_int64, ctypes.c_uint32]
+        if hasattr(lib, "tw_shm_push2"):
+            lib.tw_shm_push2.restype = ctypes.c_int
+            lib.tw_shm_push2.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint32,
+                ctypes.c_int64, ctypes.c_uint32]
         lib.tw_shm_pop.restype = ctypes.c_int64
         lib.tw_shm_pop.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64,
                                    ctypes.POINTER(ctypes.c_int64),
@@ -186,6 +196,17 @@ class ShmRing:
     def _py_u64(self, off: int) -> int:
         return struct.unpack("<Q", self._mm[off:off + 8])[0]
 
+    # Blocked-side wait pacing (mirror of shmring.cc backoff_us): start
+    # near-spin for latency, back off exponentially to 2 ms.  The flat
+    # 100 us sleep this replaces woke the blocked side 10k times/s for
+    # the whole stall — on a CPU-only host that steals cycles from the
+    # very peer being waited on (the round-5 shm-slower-than-TCP
+    # inversion; kernel sockets block properly and never paid this).
+    @staticmethod
+    def _backoff(delay: float) -> float:
+        time.sleep(delay)
+        return delay * 2 if delay < 0.002 else delay
+
     # -- API -------------------------------------------------------------
     def caps(self) -> str:
         if self._lib is not None:
@@ -196,64 +217,121 @@ class ShmRing:
         return bytes(self._mm[_OFF_CAPS:_OFF_CAPS + n]).decode()
 
     def push(self, payload: bytes, pts: int, timeout: float = 10.0) -> None:
-        if self._lib is not None:
-            # zero-copy view of the immutable bytes (C side only reads)
-            buf = ctypes.cast(ctypes.c_char_p(payload),
-                              ctypes.POINTER(ctypes.c_uint8))
-            rc = self._lib.tw_shm_push(self._h, buf, len(payload), pts,
-                                       int(timeout * 1000))
+        self.push_parts([payload], pts, timeout)
+
+    def push_parts(self, parts, pts: int, timeout: float = 10.0) -> None:
+        """Scatter-gather push: writes the iovec straight into the slot
+        — ONE copy from the tensor views to shared memory, no staging
+        blob (the old ``push(encode_tensors(buf))`` paid two)."""
+        arrs = [np.frombuffer(p, np.uint8) for p in parts]
+        total = sum(a.nbytes for a in arrs)
+        record_copy(total)   # the slot write is the transport's one copy
+        if self._lib is not None and hasattr(self._lib, "tw_shm_push2"):
+            n = len(arrs)
+            ptrs = (ctypes.c_void_p * n)(
+                *(a.ctypes.data for a in arrs))
+            lens = (ctypes.c_uint64 * n)(*(a.nbytes for a in arrs))
+            rc = self._lib.tw_shm_push2(self._h, ptrs, lens, n, pts,
+                                        int(timeout * 1000))
             if rc == -2:
-                raise ValueError(f"record {len(payload)} B exceeds slot "
+                raise ValueError(f"record {total} B exceeds slot "
                                  f"size {self.slot_bytes}")
             if rc != 0:
                 raise TimeoutError("shm ring full (consumer stalled?)")
             return
-        if len(payload) > self.slot_bytes:
-            raise ValueError(f"record {len(payload)} B exceeds slot "
+        if self._lib is not None:
+            # old .so without the scatter entry: push a single part
+            # zero-copy (the pre-scatter behavior); stage only when
+            # there is genuinely more than one part to gather
+            if len(arrs) == 1:
+                flat, blob_len = arrs[0], arrs[0].nbytes
+                buf = flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            else:
+                blob = b"".join(a.tobytes() for a in arrs)
+                blob_len = len(blob)
+                buf = ctypes.cast(ctypes.c_char_p(blob),
+                                  ctypes.POINTER(ctypes.c_uint8))
+            rc = self._lib.tw_shm_push(self._h, buf, blob_len, pts,
+                                       int(timeout * 1000))
+            if rc == -2:
+                raise ValueError(f"record {total} B exceeds slot "
+                                 f"size {self.slot_bytes}")
+            if rc != 0:
+                raise TimeoutError("shm ring full (consumer stalled?)")
+            return
+        if total > self.slot_bytes:
+            raise ValueError(f"record {total} B exceeds slot "
                              f"size {self.slot_bytes}")
         deadline = time.monotonic() + timeout
+        delay = 5e-5
         while (self._py_u64(_OFF_HEAD) - self._py_u64(_OFF_TAIL)
                >= self._n_slots):
             if time.monotonic() > deadline:
                 raise TimeoutError("shm ring full (consumer stalled?)")
-            time.sleep(0.0001)
+            delay = self._backoff(delay)
         head = self._py_u64(_OFF_HEAD)
         off = _OFF_SLOTS + (head % self._n_slots) * (_SLOT_HDR
                                                     + self.slot_bytes)
-        self._mm[off:off + 16] = struct.pack("<Qq", len(payload), pts)
-        self._mm[off + 16:off + 16 + len(payload)] = payload
+        self._mm[off:off + 16] = struct.pack("<Qq", total, pts)
+        pos = off + 16
+        for a in arrs:
+            self._mm[pos:pos + a.nbytes] = a.data
+            pos += a.nbytes
         self._mm[_OFF_HEAD:_OFF_HEAD + 8] = struct.pack("<Q", head + 1)
 
     def pop(self, timeout: float = 10.0
             ) -> Optional[Tuple[bytes, int]]:
         """(payload, pts) — or None on EOS-and-drained."""
+        got = self.pop_into(None, timeout)
+        if got is None:
+            return None
+        lease, n, pts = got
+        payload = bytes(lease.memory()[:n])
+        lease.release()
+        return payload, pts
+
+    def pop_into(self, pool: Optional[TensorBufferPool],
+                 timeout: float = 10.0
+                 ) -> Optional[Tuple[BufferLease, int, int]]:
+        """Pop the next record into a pooled slab: ``(lease, nbytes,
+        pts)`` — or None on EOS-and-drained.  ONE copy out of the ring;
+        the consumer decodes zero-copy views over the lease."""
+        if pool is None:
+            pool = default_pool()
         if self._lib is not None:
-            if not hasattr(self, "_pop_buf"):
-                self._pop_buf = (ctypes.c_uint8 * self.slot_bytes)()
-            out = self._pop_buf
+            # full-slot-capacity lease (record length unknown until the
+            # native pop); exact-size bucketing still recycles it
+            lease = pool.acquire(self.slot_bytes)
+            dst = np.frombuffer(lease.memory(), np.uint8)
             pts = ctypes.c_int64()
-            n = self._lib.tw_shm_pop(self._h, out, self.slot_bytes,
-                                     ctypes.byref(pts),
-                                     int(timeout * 1000))
+            n = self._lib.tw_shm_pop(
+                self._h, dst.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint8)),
+                self.slot_bytes, ctypes.byref(pts), int(timeout * 1000))
+            del dst
             if n == -3:
+                lease.release()
                 return None
             if n < 0:
+                lease.release()
                 raise TimeoutError("shm ring empty (producer stalled?)")
-            return ctypes.string_at(out, n), pts.value
+            return lease, int(n), pts.value
         deadline = time.monotonic() + timeout
+        delay = 5e-5
         while self._py_u64(_OFF_HEAD) == self._py_u64(_OFF_TAIL):
             if struct.unpack("<I", self._mm[_OFF_EOS:_OFF_EOS + 4])[0]:
                 return None
             if time.monotonic() > deadline:
                 raise TimeoutError("shm ring empty (producer stalled?)")
-            time.sleep(0.0001)
+            delay = self._backoff(delay)
         tail = self._py_u64(_OFF_TAIL)
         off = _OFF_SLOTS + (tail % self._n_slots) * (_SLOT_HDR
                                                      + self.slot_bytes)
         ln, pts = struct.unpack("<Qq", self._mm[off:off + 16])
-        payload = bytes(self._mm[off + 16:off + 16 + ln])
+        lease = pool.acquire(ln)
+        lease.memory()[:] = self._mm[off + 16:off + 16 + ln]
         self._mm[_OFF_TAIL:_OFF_TAIL + 8] = struct.pack("<Q", tail + 1)
-        return payload, pts
+        return lease, ln, pts
 
     def eos(self) -> None:
         if self._lib is not None:
@@ -336,8 +414,10 @@ class ShmSink(Element):
             # the ring); a buffer without caps is a bug upstream — fail
             # loudly rather than publish an un-negotiable capsless ring
             raise RuntimeError(f"{self.name}: buffer before caps")
-        self._ring.push(encode_tensors(buf), buf.pts or 0,
-                        float(self.timeout))
+        # scatter-gather: tensor views land in the slot directly (one
+        # copy into shared memory, no staging blob)
+        self._ring.push_parts(tensor_parts(buf), buf.pts or 0,
+                              float(self.timeout))
         return FlowReturn.OK
 
     def on_event(self, pad, event):
@@ -357,6 +437,13 @@ class ShmSrc(Source):
         "caps": (None, "override caps (else the ring header's)"),
         "timeout": (10.0, "open/pop timeout (s)"),
         "num-buffers": (-1, "stop after N buffers, -1 unlimited"),
+        "prefetch": (0, "drain the ring from a reader thread into an "
+                        "unbounded local fifo (1 = on).  Decouples the "
+                        "producer from this pipeline's processing rate "
+                        "— the same structure edge_src/tensor_query use "
+                        "— at the cost of unbounded consumer-side "
+                        "memory.  0 (default) pops on demand, keeping "
+                        "the ring's bounded-backpressure contract"),
     }
 
     def _make_pads(self):
@@ -365,9 +452,15 @@ class ShmSrc(Source):
     def start(self):
         self._ring: Optional[ShmRing] = None
         self._count = 0
+        self._pool = default_pool()
+        self._fifo = None
+        self._reader = None
 
     def stop(self):
         self._halt()
+        if self._reader is not None:
+            self._reader.join(timeout=10)
+            self._reader = None
         if self._ring is not None:
             self._ring.close()   # consumer side unlinks
             self._ring = None
@@ -378,6 +471,15 @@ class ShmSrc(Source):
         # not-yet-up producer must not stall the whole pipeline's startup
         self._ring = ShmRing(str(self.path), create=False,
                              timeout=float(self.timeout))
+        if int(self.prefetch or 0):
+            import queue as _queue
+            import threading
+
+            self._fifo = _queue.Queue()
+            self._reader = threading.Thread(
+                target=self._drain_loop, daemon=True,
+                name=f"shm-src:{self.name}")
+            self._reader.start()
         if self.caps:
             c = self.caps
             return Caps.from_string(c) if isinstance(c, str) else c
@@ -387,25 +489,68 @@ class ShmSrc(Source):
                              "caps; set the caps property")
         return Caps.from_string(caps)
 
+    def _drain_loop(self) -> None:
+        """prefetch=1 reader: pop the ring as fast as the producer fills
+        it, park records in the local fifo.  The producer never blocks
+        on THIS pipeline's processing rate (the decoupling edge_src gets
+        from its broker-reader thread)."""
+        deadline = time.monotonic() + float(self.timeout)
+        while not self._halted.is_set():
+            try:
+                got = self._ring.pop_into(self._pool, timeout=0.1)
+            except TimeoutError:
+                if time.monotonic() > deadline:
+                    self._fifo.put(TimeoutError(
+                        f"{self.name}: no data on ring {self.path!r} "
+                        f"for {self.timeout}s and no EOS "
+                        "(producer gone?)"))
+                    return
+                continue
+            except Exception as exc:  # noqa: BLE001 — any reader death
+                # must surface on the streaming thread, not strand
+                # create() polling an empty fifo forever (the on-demand
+                # branch propagates the same exception directly)
+                self._fifo.put(exc)
+                return
+            deadline = time.monotonic() + float(self.timeout)
+            self._fifo.put(got)
+            if got is None:      # EOS and drained
+                return
+
     def create(self) -> Optional[TensorBuffer]:
         n = int(self.num_buffers)
         if n >= 0 and self._count >= n:
             return None
         deadline = time.monotonic() + float(self.timeout)
         while not self._halted.is_set():
-            try:
-                got = self._ring.pop(timeout=0.1)
-            except TimeoutError:
-                # honor the documented bound: a producer that vanished
-                # without EOS must not hang the pipeline forever
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"{self.name}: no data on ring {self.path!r} for "
-                        f"{self.timeout}s and no EOS (producer gone?)")
-                continue
+            if self._fifo is not None:
+                import queue as _queue
+
+                try:
+                    got = self._fifo.get(timeout=0.1)
+                except _queue.Empty:
+                    continue
+                if isinstance(got, BaseException):
+                    raise got
+            else:
+                try:
+                    got = self._ring.pop_into(self._pool, timeout=0.1)
+                except TimeoutError:
+                    # honor the documented bound: a producer that
+                    # vanished without EOS must not hang the pipeline
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"{self.name}: no data on ring "
+                            f"{self.path!r} for {self.timeout}s and no "
+                            "EOS (producer gone?)")
+                    continue
             if got is None:
                 return None
-            payload, pts = got
+            lease, n, pts = got
             self._count += 1
-            return TensorBuffer(tensors=decode_tensors(payload), pts=pts)
+            # zero-copy decode over the pooled slab; the lease rides the
+            # buffer so the slab outlives every downstream view
+            return TensorBuffer(
+                tensors=decode_tensors(lease.memory()[:n]), pts=pts,
+                lease=lease)
         return None

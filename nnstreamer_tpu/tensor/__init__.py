@@ -7,7 +7,8 @@ from .types import (TENSOR_RANK_LIMIT, TENSOR_SIZE_EXTRA_LIMIT,
                     np_shape_to_dim)
 from .info import TensorInfo, TensorsConfig, TensorsInfo
 from .meta import (META_HEADER_SIZE, TensorMetaInfo, unwrap_flex, wrap_flex)
-from .buffer import CLOCK_TIME_NONE, SECOND, TensorBuffer, frames_to_ns
+from .buffer import (BufferLease, CLOCK_TIME_NONE, SECOND, TensorBuffer,
+                     TensorBufferPool, default_pool, frames_to_ns)
 
 __all__ = [
     "TENSOR_RANK_LIMIT", "TENSOR_SIZE_LIMIT", "TENSOR_SIZE_EXTRA_LIMIT",
@@ -16,4 +17,5 @@ __all__ = [
     "CLOCK_TIME_NONE", "SECOND", "dim_parse", "dim_to_string", "dim_padded",
     "dims_equal", "dim_is_static", "dim_element_count", "dim_to_np_shape",
     "np_shape_to_dim", "wrap_flex", "unwrap_flex", "frames_to_ns",
+    "BufferLease", "TensorBufferPool", "default_pool",
 ]

@@ -18,12 +18,240 @@ Design differences, deliberately TPU-first:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional, Sequence
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 #: Sentinel for "no timestamp" (GStreamer GST_CLOCK_TIME_NONE analogue).
 CLOCK_TIME_NONE: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# pool refcount baselines, calibrated at import.  The no-alias guarantee
+# rides on sys.getrefcount: a slab is recycled only when nothing outside
+# the pool machinery can reach it.  How many references the machinery
+# itself holds at the check sites depends on the interpreter (CPython
+# 3.10 keeps call arguments alive on the evaluation stack; 3.11+
+# doesn't), so measure the exact call shapes instead of hardcoding.
+# ---------------------------------------------------------------------------
+
+def _probe_refcount(x) -> int:
+    return sys.getrefcount(x)
+
+
+def _calibrate_reclaim() -> int:
+    # shape of _reclaim/__del__: caller local → callee param → getrefcount
+    local = bytearray(1)
+    return _probe_refcount(local)
+
+
+def _calibrate_sweep() -> int:
+    # shape of _sweep_pending_locked: list entry → loop var → getrefcount
+    lst = [bytearray(1)]
+    for slab in lst:
+        return sys.getrefcount(slab)
+    return 3
+
+
+#: refcount a slab shows inside ``_reclaim`` when ONLY the caller holds
+#: it — anything above means external views are alive
+_RECLAIM_BASELINE = _calibrate_reclaim()
+#: same for the pending-list sweep
+_SWEEP_BASELINE = _calibrate_sweep()
+
+
+class BufferLease:
+    """One leased slab of a :class:`TensorBufferPool`.
+
+    The lease is the ownership handle for a pooled payload: transports
+    receive wire bytes into :meth:`memory` and decode zero-copy numpy
+    views over it; the slab returns to the pool's free list when the
+    last reference lets go (explicit :meth:`release`, or the lease
+    being dropped — CPython refcounting makes the drop path prompt).
+
+    Recycling is SAFE BY CONSTRUCTION, not by convention: a slab is
+    only reused when nothing else can still see it.  At reclaim time
+    the pool checks the slab's external reference count — any live
+    numpy view / memoryview over the slab keeps a reference chain to
+    it — and a slab with outstanding views is parked on a pending list
+    instead of the free list (re-checked on later acquires), so a
+    writer can never scribble over bytes an old view still aliases.
+    """
+
+    __slots__ = ("_pool", "_slab", "size", "_refs", "_lock")
+
+    def __init__(self, pool: "TensorBufferPool", slab: bytearray,
+                 size: int) -> None:
+        self._pool = pool
+        self._slab = slab
+        self.size = size
+        self._refs = 1
+        self._lock = threading.Lock()
+
+    @property
+    def nbytes(self) -> int:
+        return self.size
+
+    def memory(self) -> memoryview:
+        """Writable memoryview of exactly ``size`` bytes."""
+        slab = self._slab
+        if slab is None:
+            raise RuntimeError("BufferLease used after release")
+        return memoryview(slab)[:self.size]
+
+    def view(self, dtype, shape, offset: int = 0) -> np.ndarray:
+        """Zero-copy ndarray over the payload (marked read-only: pooled
+        payloads are shared, same contract as tee fan-out)."""
+        count = 1
+        for d in shape:
+            count *= int(d)
+        arr = np.frombuffer(self.memory(), dtype=dtype, count=count,
+                            offset=offset).reshape(shape)
+        arr.flags.writeable = False
+        return arr
+
+    def retain(self) -> "BufferLease":
+        with self._lock:
+            if self._slab is None:
+                raise RuntimeError("BufferLease retained after release")
+            self._refs += 1
+        return self
+
+    def release(self) -> None:
+        with self._lock:
+            self._refs -= 1
+            if self._refs > 0:
+                return
+            slab, self._slab = self._slab, None
+        if slab is not None:
+            self._pool._reclaim(slab)
+
+    def __del__(self):
+        # safety net: an unreleased lease dying returns its slab (the
+        # common pipeline flow never calls release explicitly — the
+        # buffer wrapper dropping at the sink is the release)
+        slab = getattr(self, "_slab", None)
+        if slab is not None:
+            self._slab = None
+            self._pool._reclaim(slab)
+
+
+class TensorBufferPool:
+    """Recycled payload slabs for the dataflow hot path.
+
+    The role of GStreamer's GstBufferPool for this framework's wire /
+    ring transports: ``acquire(n)`` hands out a :class:`BufferLease`
+    over a ``bytearray`` slab, exact-size free lists make same-shaped
+    streams hit the pool every frame, and ``stats`` exposes
+    ``hits``/``misses`` so copy and allocation behavior is observable
+    (surfaced per element as ``pool_hit`` by pipeline/tracing.py).
+    """
+
+    def __init__(self, max_per_bucket: int = 16,
+                 max_free_bytes: int = 128 << 20) -> None:
+        self.max_per_bucket = max_per_bucket
+        #: cap on TOTAL retained free bytes across all size buckets —
+        #: per-bucket caps alone would let a variable-size stream
+        #: (flex tensors, renegotiating caps) grow one bucket per
+        #: distinct payload size without bound.  At the cap, reclaim
+        #: evicts the largest free bucket before retaining.
+        self.max_free_bytes = max_free_bytes
+        self._free: Dict[int, List[bytearray]] = {}
+        self._free_bytes = 0
+        self._pending: List[bytearray] = []   # slabs with live views
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self, nbytes: int) -> BufferLease:
+        nbytes = int(nbytes)
+        with self._lock:
+            self._sweep_pending_locked()
+            bucket = self._free.get(nbytes)
+            if bucket:
+                slab = bucket.pop()
+                self._free_bytes -= nbytes
+                self.hits += 1
+                hit = True
+            else:
+                slab = None
+                self.misses += 1
+                hit = False
+        if slab is None:
+            slab = bytearray(nbytes)
+        from ..pipeline import tracing
+
+        tracing.record_pool(hit)
+        return BufferLease(self, slab, nbytes)
+
+    def _sweep_pending_locked(self) -> None:
+        """Move parked slabs whose last external view died back to the
+        free lists (refcount 2 = the pending list + getrefcount's
+        argument: nothing else can reach the slab)."""
+        if not self._pending:
+            return
+        still = []
+        for slab in self._pending:
+            if sys.getrefcount(slab) <= _SWEEP_BASELINE:
+                self._retain_free_locked(slab)
+            else:
+                still.append(slab)
+        self._pending = still
+
+    def _retain_free_locked(self, slab: bytearray) -> None:
+        """Add a quiescent slab to the free lists, respecting both the
+        per-bucket cap and the pool-wide byte cap (evicting the largest
+        other bucket once before giving up)."""
+        n = len(slab)
+        bucket = self._free.setdefault(n, [])
+        if len(bucket) >= self.max_per_bucket:
+            return
+        if self._free_bytes + n > self.max_free_bytes:
+            victim = max(self._free, key=lambda s: s * len(self._free[s]),
+                         default=None)
+            if victim is None or victim == n:
+                return
+            self._free_bytes -= victim * len(self._free.pop(victim))
+            if self._free_bytes + n > self.max_free_bytes:
+                return
+        bucket.append(slab)
+        self._free_bytes += n
+
+    def _reclaim(self, slab: bytearray) -> None:
+        with self._lock:
+            # a live numpy view / memoryview over the slab holds a
+            # reference chain to it; recycling now would let the next
+            # writer alias it.  Park such slabs; they rejoin the free
+            # list once the views die (checked on later acquires).
+            if sys.getrefcount(slab) > _RECLAIM_BASELINE:
+                if len(self._pending) < 4 * self.max_per_bucket:
+                    self._pending.append(slab)
+                return
+            self._retain_free_locked(slab)
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "free": sum(len(b) for b in self._free.values()),
+                    "free_bytes": self._free_bytes,
+                    "pending": len(self._pending)}
+
+
+_DEFAULT_POOL: Optional[TensorBufferPool] = None
+_DEFAULT_POOL_LOCK = threading.Lock()
+
+
+def default_pool() -> TensorBufferPool:
+    """Process-wide pool shared by the query/edge/shm transports."""
+    global _DEFAULT_POOL
+    if _DEFAULT_POOL is None:
+        with _DEFAULT_POOL_LOCK:
+            if _DEFAULT_POOL is None:
+                _DEFAULT_POOL = TensorBufferPool()
+    return _DEFAULT_POOL
 
 
 def is_device_array(x: Any) -> bool:
@@ -121,6 +349,11 @@ class TensorBuffer:
     #: free-form per-buffer metadata (e.g. query client id — reference
     #: tensor_meta.c query_client_id_t).
     extra: dict = dataclasses.field(default_factory=dict)
+    #: pool ownership handle when ``tensors`` are zero-copy views into a
+    #: :class:`BufferLease` slab (transports attach it so the slab lives
+    #: as long as any wrapper/branch still references the frame; the
+    #: slab recycles when the last holder drops — see BufferLease)
+    lease: Any = dataclasses.field(default=None, repr=False)
 
     @property
     def num_tensors(self) -> int:
@@ -148,11 +381,12 @@ class TensorBuffer:
     def copy(self) -> "TensorBuffer":
         """Shallow copy: a new wrapper with independent ``extra``/``metas``
         containers but the SAME tensor payload handles — no tensor bytes are
-        copied, and device arrays stay on device."""
+        copied, and device arrays stay on device.  A pooled lease is shared
+        by reference (tee fan-out: N branches, one payload slab)."""
         return TensorBuffer(tensors=list(self.tensors), pts=self.pts,
                             duration=self.duration,
                             metas=list(self.metas) if self.metas else None,
-                            extra=dict(self.extra))
+                            extra=dict(self.extra), lease=self.lease)
 
     def __repr__(self) -> str:
         shapes = ",".join(str(getattr(t, "shape", "?")) for t in self.tensors)

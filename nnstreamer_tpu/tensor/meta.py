@@ -122,8 +122,11 @@ class TensorMetaInfo:
 
 def wrap_flex(arr: np.ndarray, meta: Optional[TensorMetaInfo] = None) -> bytes:
     """Prefix a raw tensor payload with its flexible meta header."""
+    from ..pipeline.tracing import record_copy
+
     if meta is None:
         meta = TensorMetaInfo.from_info(TensorInfo.from_np(arr))
+    record_copy(META_HEADER_SIZE + arr.nbytes)
     return meta.to_bytes() + np.ascontiguousarray(arr).tobytes()
 
 

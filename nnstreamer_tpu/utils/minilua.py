@@ -1091,6 +1091,12 @@ def _lua_rawmod(a, b):
     a, b = float(a), float(b)
     if b == 0.0 or not math.isfinite(a):
         return math.nan
+    if math.isinf(b):
+        # C-Lua luai_nummod: m = fmod(a, b) (= a for finite a), then
+        # m += b when m*b < 0 — so an opposite-sign infinite divisor
+        # yields b itself: -5 % inf = inf, 5 % -inf = -inf.  Same-sign
+        # (and ±0 numerators: 0*inf is nan, not < 0) keep a.
+        return b if a != 0.0 and (a < 0.0) != (b < 0.0) else a
     q = a / b
     if not math.isfinite(q):
         return math.nan

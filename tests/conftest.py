@@ -31,6 +31,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injection tests driving the "
                    "nnstreamer_tpu.testing.faults proxy")
+    config.addinivalue_line(
+        "markers", "perf: hot-path regression smokes (copy gates via "
+                   "tools/hotpath_bench.py --assert; fast, "
+                   "counter-based, tier-1 runs them)")
 
 
 @pytest.fixture(scope="session")

@@ -264,6 +264,31 @@ class TestLengthGatedSelection:
             tuned, "FLASH_WIN_TABLE", ((1024, True), (2048, True)))
         assert not fa.flash_wins(4096)
 
+    def test_trailing_loss_carries_above_span(self, monkeypatch):
+        """ADVICE r5: lengths just above the table's last row inherit a
+        trailing LOSS (16385..32767 must not route to the kernel that
+        measured 0.795x at 16384) until the memory-regime bound, where
+        naive's O(T^2) scores stop being feasible and the threshold
+        gate takes back over."""
+        from nnstreamer_tpu.ops import flash_attention as fa
+        from nnstreamer_tpu.utils import tuned
+
+        monkeypatch.delenv("NNS_TPU_FLASH_MIN_T", raising=False)
+        monkeypatch.setattr(fa, "flash_is_default", lambda: True)
+        monkeypatch.setattr(tuned, "FLASH_MIN_T", 16384)
+        monkeypatch.setattr(
+            tuned, "FLASH_WIN_TABLE",
+            ((2048, True), (8192, True), (16384, False)))
+        assert not fa.flash_wins(16385)            # inherits the loss
+        assert not fa.flash_wins(24576)
+        assert not fa.flash_wins(fa.MEM_REGIME_MIN_T - 1)
+        assert fa.flash_wins(fa.MEM_REGIME_MIN_T)  # naive infeasible
+        # a trailing WIN still defers to the threshold (non-monotonic
+        # hardware: 2k winning says nothing about 4k)
+        monkeypatch.setattr(
+            tuned, "FLASH_WIN_TABLE", ((1024, True), (2048, True)))
+        assert not fa.flash_wins(4096)
+
     def test_env_override_beats_win_table(self, monkeypatch):
         from nnstreamer_tpu.ops import flash_attention as fa
         from nnstreamer_tpu.utils import tuned
@@ -698,6 +723,34 @@ class TestMeasuredCrossover:
              "naive_error": "HTTP 500: tpu_compile_helper"},
         ]
         assert tool.measured_crossover(timings2) == 8192
+
+    def test_transient_kernel_infra_error_is_no_evidence(self):
+        """ADVICE r5: kernel-side failures get the SAME infra-vs-device
+        triage as naive-side ones — a tunnel flake during the kernel
+        run is evidence-free (no durable wins=False row, no broken
+        suffix), while a real kernel failure stays a durable loss."""
+        tool = self._tool()
+        flake = {"T": 16384,
+                 "error": "ConnectionError('tunnel reset by peer')"}
+        assert tool._row_evidence(flake)[0] is None
+        timings = [
+            {"T": 2048, "speedup": 1.2},
+            {"T": 8192, "speedup": 1.1},
+            flake,
+            {"T": 32768, "flash_only": True,
+             "naive_error": "RESOURCE_EXHAUSTED"},
+        ]
+        # the flake neither breaks the win suffix nor lands in the table
+        assert tool.measured_crossover(timings) == 2048
+        assert tool.measured_win_table(timings) == (
+            (2048, True), (8192, True), (32768, True))
+        # a deterministic kernel failure is still a durable loss
+        hard = {"T": 16384, "error": "Mosaic lowering failed: ..."}
+        assert tool._row_evidence(hard)[0] is False
+        assert tool.measured_crossover(
+            [{"T": 8192, "speedup": 1.1}, hard,
+             {"T": 32768, "flash_only": True,
+              "naive_error": "RESOURCE_EXHAUSTED"}]) == 32768
 
     def _proof_row(self, **over):
         row = {"metric": "flash_attention_tpu_proof", "value": 1.0,

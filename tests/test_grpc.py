@@ -39,6 +39,25 @@ def _feed(p, frames):
     src.end_of_stream()
 
 
+class TestReadonlyProperties:
+    def test_out_counter_rejects_writes(self):
+        """ADVICE r5: `out` is the reference's G_PARAM_READABLE buffer
+        counter — writing it is an error (like tensor_converter/
+        decoder/filter reference read-only properties), not a silent
+        reassignment of the live count."""
+        from nnstreamer_tpu.query.grpc_service import GrpcTensorSrc
+
+        el = GrpcTensorSrc(name="g")
+        with pytest.raises(ValueError, match="read-only"):
+            el.set_property("out", 5)
+        with pytest.raises(ValueError, match="read-only"):
+            GrpcTensorSrc(name="g2", out=5)
+        assert el.get_property("out") == 0   # reads still work
+        # launch-line writes go through set_property too
+        with pytest.raises(ValueError, match="read-only"):
+            parse_launch("tensor_src_grpc out=3 ! tensor_sink")
+
+
 class TestRoundTrip:
     @pytest.mark.parametrize("idl", ["protobuf", "flatbuf"])
     def test_sink_client_to_src_server(self, idl):

@@ -988,6 +988,26 @@ class TestErrorDomainAndStringMethods:
         assert math.isnan(ev("(-2)^0.5"))
         assert ev("1e308*10/1") == math.inf or ev("2^2048") == math.inf
 
+    def test_lua_mod_infinite_divisor_golden(self):
+        """C-Lua luai_nummod (fmod plus sign correction): with an
+        INFINITE divisor, fmod returns the finite numerator unchanged,
+        then m += b fires when the signs differ — so -5 % math.huge is
+        inf (not -5, the pre-fix leak) and 5 % -math.huge is -inf.
+        Golden values from `lua -e 'print(-5 % math.huge)'` (5.1/5.4
+        agree)."""
+        import math
+
+        def ev(expr):
+            return LuaState(f"function f() return {expr} end").call("f")
+
+        assert ev("5 % math.huge") == 5.0
+        assert ev("-5 % math.huge") == math.inf
+        assert ev("5 % -math.huge") == -math.inf
+        assert ev("-5 % -math.huge") == -5.0
+        assert ev("0 % math.huge") == 0.0
+        assert math.isnan(ev("math.huge % math.huge"))
+        assert math.isnan(ev("(0/0) % math.huge"))
+
     def test_overflow_in_stdlib_is_lua_error(self):
         with pytest.raises(LuaError, match="bad argument"):
             LuaState("return string.rep('x', math.huge)")

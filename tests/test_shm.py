@@ -213,6 +213,47 @@ class TestShmPipeline:
                 prod.kill()
 
 
+class TestPrefetch:
+    def test_prefetch_drains_ahead_of_consumer(self):
+        """prefetch=1: the reader thread drains the ring into the local
+        fifo faster than the pipeline consumes, so a producer bounded
+        by ring capacity never waits on THIS pipeline's processing rate
+        — frames, order, and PTS are identical to the on-demand path."""
+        from nnstreamer_tpu import parse_launch
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        name = _unique("t-prefetch")
+        n = 12
+        prod = ShmRing(name, True, slot_bytes=4096, n_slots=4,
+                       caps="other/tensors,format=static,num_tensors=1,"
+                            "dimensions=8,types=uint8,framerate=0/1")
+        try:
+            p = parse_launch(
+                f"tensor_shm_src path={name} timeout=30 prefetch=1 ! "
+                "queue max-size-buffers=64 ! tensor_sink name=out")
+            got = []
+            p.get("out").connect("new-data",
+                                 lambda b: got.append((b.pts, b.np(0))))
+            p.play()
+            from nnstreamer_tpu.query.protocol import tensor_parts
+
+            for i in range(n):
+                buf = TensorBuffer(
+                    tensors=[np.full(8, i, np.uint8)], pts=i)
+                # 4-slot ring, 12 records: only a draining reader lets
+                # this loop complete without a ring-full timeout while
+                # the sink is still warming up
+                prod.push_parts(tensor_parts(buf), i, timeout=10)
+            prod.eos()
+            p.wait(timeout=30)
+            p.stop()
+            assert [pts for pts, _ in got] == list(range(n))
+            for i, (_, arr) in enumerate(got):
+                np.testing.assert_array_equal(arr, np.full(8, i, np.uint8))
+        finally:
+            prod.close(unlink=False)
+
+
 class TestHeaderSafety:
     def test_py_oversized_caps_rejected(self):
         """Pure-Python producer must mirror the native reject: a caps

@@ -177,17 +177,42 @@ def _naive_infeasible(err: str) -> bool:
     return any(m in (err or "") for m in _NAIVE_INFEASIBLE_MARKERS)
 
 
+_INFRA_TRANSIENT_MARKERS = (
+    # remote-compile / tunnel / RPC plumbing signatures — failures of
+    # the PATH to the device, not of the kernel on it.  Deliberately
+    # narrow, mirroring _NAIVE_INFEASIBLE_MARKERS: an unrecognized
+    # kernel error stays durable evidence (naive must serve that
+    # length) rather than being waved off as a flake.
+    "ConnectionError", "ConnectionReset", "Connection reset",
+    "ConnectionRefused", "Connection refused", "BrokenPipe",
+    "Broken pipe", "timed out", "TimeoutError", "DEADLINE_EXCEEDED",
+    "UNAVAILABLE", "Unavailable", "Socket closed", "EOFError",
+    "HTTP error", "HTTP 5", "Remote disconnected", "RemoteDisconnected")
+
+
+def _infra_transient(err: str) -> bool:
+    """True when an error string reads like transient infra (the tunnel
+    or remote-compile helper dying), not a deterministic device/kernel
+    failure."""
+    return any(m in (err or "") for m in _INFRA_TRANSIENT_MARKERS)
+
+
 def _row_evidence(row):
     """Single classification of one timing row, shared by the
     crossover, the win table, and the provenance stamp (three consumers
     of one rule set must not drift): returns (verdict, label) where
     verdict is True (kernel wins: speedup > 1, or naive hit a DEVICE
     capacity wall while the kernel ran), False (kernel loses: measured
-    slower, or the kernel itself errored — naive has to serve that
-    length), or None (no evidence: naive failed for reasons that read
-    like transient infra, not capacity)."""
+    slower, or the kernel itself failed deterministically — naive has
+    to serve that length), or None (no evidence: EITHER side failed for
+    reasons that read like transient infra — a tunnel flake during the
+    kernel run must not enshrine a durable wins=False row via
+    --apply-crossover any more than one during the naive run may
+    enshrine a win; ADVICE r5)."""
     t = row.get("T")
     if row.get("error"):
+        if _infra_transient(row.get("error", "")):
+            return None, "%s:kernel-no-evidence" % t
         return False, "%s:kernel-error" % t
     if row.get("flash_only"):
         if _naive_infeasible(row.get("naive_error", "")):

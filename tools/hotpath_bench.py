@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Hot-path microbench: copy cost per dataflow stage, isolated.
+
+The streaming gap (ROADMAP north star vs measured fps) is glue-bound,
+not compute-bound — so this tool measures the GLUE, one stage at a
+time, with no model in the loop:
+
+  - ``pool``:      TensorBufferPool acquire/release rate and hit ratio;
+  - ``serialize``: wire framing cost — the scatter-gather iovec path
+                   (``tensor_parts``) against the legacy single-blob
+                   path (``encode_tensors``) — with per-frame
+                   ``bytes_copied`` from the copy tracer;
+  - ``wire``:      TCP-loopback frame round trip through
+                   ``send_tensors`` / ``recv_msg(pool=...)``;
+  - ``shm``:       shared-memory ring round trip through
+                   ``push_parts`` / ``pop_into``.
+
+Prints ONE JSON line per stage (schema mirrors bench.py).
+
+``--assert`` is the copy-regression gate (tier-1 ``perf`` smoke): it
+fails (exit 1) when the serialize path materializes more than the
+frame's header budget — 48 B wire header + 4 B count + 128 B meta per
+tensor.  A re-introduced ``tobytes``/``b"".join`` on the hot path trips
+it immediately; it is NOT an fps gate (timings vary with the host, copy
+counts do not).
+"""
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from nnstreamer_tpu.pipeline.tracing import copy_probe  # noqa: E402
+from nnstreamer_tpu.query import protocol  # noqa: E402
+from nnstreamer_tpu.tensor.buffer import (TensorBuffer,  # noqa: E402
+                                          TensorBufferPool)
+
+#: serialize-path copy budget per frame: wire header + tensor count +
+#: one meta header per tensor.  Payload bytes must NOT appear here.
+HEADER_BUDGET = protocol.HEADER.size + 4   # + META_HEADER_SIZE * n below
+
+
+def _frame(n_tensors: int = 1, side: int = 224) -> TensorBuffer:
+    rng = np.random.default_rng(11)
+    tensors = [rng.integers(0, 255, (side, side, 3), dtype=np.uint8)
+               for _ in range(n_tensors)]
+    return TensorBuffer(tensors=tensors, pts=0)
+
+
+def _budget(buf: TensorBuffer) -> int:
+    from nnstreamer_tpu.tensor.meta import META_HEADER_SIZE
+
+    return HEADER_BUDGET + META_HEADER_SIZE * buf.num_tensors
+
+
+def bench_pool(frames: int) -> dict:
+    pool = TensorBufferPool()
+    nbytes = 224 * 224 * 3
+    t0 = time.perf_counter()
+    for _ in range(frames):
+        lease = pool.acquire(nbytes)
+        lease.release()
+    dt = time.perf_counter() - t0
+    stats = pool.stats
+    return {"metric": "hotpath_pool_acquires_per_s",
+            "value": round(frames / dt, 1), "unit": "acquires/s",
+            "hit_rate": round(stats["hits"] / max(1, frames), 4),
+            "frames": frames}
+
+
+def bench_serialize(frames: int) -> dict:
+    buf = _frame()
+    payload_bytes = sum(t.nbytes for t in buf.tensors)
+    with copy_probe() as iov_probe:
+        t0 = time.perf_counter()
+        for _ in range(frames):
+            parts = protocol.tensor_parts(buf)
+        iov_dt = time.perf_counter() - t0
+    with copy_probe() as blob_probe:
+        t0 = time.perf_counter()
+        for _ in range(frames):
+            blob = protocol.encode_tensors(buf)  # noqa: F841
+        blob_dt = time.perf_counter() - t0
+    del parts
+    return {"metric": "hotpath_serialize_MBps",
+            "value": round(payload_bytes * frames / 2**20 / iov_dt, 1),
+            "unit": "MB/s_framed",
+            "iovec_us_per_frame": round(iov_dt / frames * 1e6, 2),
+            "blob_us_per_frame": round(blob_dt / frames * 1e6, 2),
+            "iovec_bytes_copied_per_frame": iov_probe.bytes_copied // frames,
+            "blob_bytes_copied_per_frame": blob_probe.bytes_copied // frames,
+            "payload_bytes": payload_bytes, "frames": frames}
+
+
+def bench_wire(frames: int) -> dict:
+    buf = _frame()
+    payload_bytes = sum(t.nbytes for t in buf.tensors)
+    pool = TensorBufferPool()
+    a, b = socket.socketpair()
+    got = []
+
+    def _reader():
+        while len(got) < frames:
+            msg = protocol.recv_msg(b, pool=pool)
+            if msg is None:
+                return
+            tensors = protocol.decode_tensors(msg.payload)
+            del tensors
+            if msg.lease is not None:
+                msg.payload = b""
+                msg.lease.release()
+            got.append(msg.seq)
+
+    rd = threading.Thread(target=_reader, daemon=True)
+    rd.start()
+    with copy_probe() as probe:
+        t0 = time.perf_counter()
+        for i in range(frames):
+            protocol.send_tensors(a, protocol.T_DATA, buf, seq=i)
+        rd.join(timeout=60)
+        dt = time.perf_counter() - t0
+    a.close()
+    b.close()
+    stats = pool.stats
+    return {"metric": "hotpath_wire_fps",
+            "value": round(frames / dt, 1), "unit": "fps",
+            "MBps": round(payload_bytes * frames / 2**20 / dt, 1),
+            "send_bytes_copied_per_frame": probe.bytes_copied // frames,
+            "recv_pool_hit_rate": round(
+                stats["hits"] / max(1, stats["hits"] + stats["misses"]), 4),
+            "received": len(got), "frames": frames}
+
+
+def bench_shm(frames: int) -> dict:
+    from nnstreamer_tpu.query.shm import ShmRing
+
+    buf = _frame()
+    payload_bytes = sum(t.nbytes for t in buf.tensors)
+    pool = TensorBufferPool()
+    name = f"nns-hotpath-{os.getpid()}"
+    prod = ShmRing(name, create=True, slot_bytes=payload_bytes + 4096,
+                   n_slots=8, caps="bench")
+    cons = ShmRing(name, create=False)
+    done = threading.Event()
+
+    def _consumer():
+        for _ in range(frames):
+            got = cons.pop_into(pool, timeout=30)
+            if got is None:
+                return
+            lease, n, _pts = got
+            tensors = protocol.decode_tensors(lease.memory()[:n])
+            del tensors
+            lease.release()
+        done.set()
+
+    th = threading.Thread(target=_consumer, daemon=True)
+    th.start()
+    t0 = time.perf_counter()
+    for i in range(frames):
+        prod.push_parts(protocol.tensor_parts(buf), i, timeout=30)
+    done.wait(timeout=60)
+    dt = time.perf_counter() - t0
+    stats = pool.stats
+    prod.eos()
+    th.join(timeout=10)
+    prod.close(unlink=False)
+    cons.close()
+    return {"metric": "hotpath_shm_fps",
+            "value": round(frames / dt, 1), "unit": "fps",
+            "MBps": round(payload_bytes * frames / 2**20 / dt, 1),
+            "native_ring": prod.is_native,
+            "pool_hit_rate": round(
+                stats["hits"] / max(1, stats["hits"] + stats["misses"]), 4),
+            "frames": frames}
+
+
+def run_assert() -> int:
+    """Copy-regression gate: serialize + wire-send must stay within the
+    header budget per frame (zero full-tensor-payload copies)."""
+    buf = _frame(n_tensors=2)
+    budget = _budget(buf)
+    failures = []
+
+    with copy_probe() as probe:
+        parts = protocol.tensor_parts(buf)
+    total = sum(len(p) if isinstance(p, bytes) else p.nbytes
+                for p in parts)
+    expect = 4 + sum(t.nbytes for t in buf.tensors) \
+        + 128 * buf.num_tensors
+    if total != expect:
+        failures.append(f"tensor_parts framed {total} B, want {expect}")
+    if probe.bytes_copied > budget:
+        failures.append(
+            f"tensor_parts copied {probe.bytes_copied} B/frame "
+            f"(> header budget {budget}): a full-payload copy is back "
+            "on the framing path")
+    del parts
+
+    a, b = socket.socketpair()
+    pool = TensorBufferPool()
+    msgs = []
+    rd = threading.Thread(
+        target=lambda: msgs.append(protocol.recv_msg(b, pool=pool)),
+        daemon=True)
+    rd.start()
+    with copy_probe() as probe:
+        protocol.send_tensors(a, protocol.T_DATA, buf, seq=1)
+    rd.join(timeout=30)
+    a.close()
+    b.close()
+    if probe.bytes_copied > budget:
+        failures.append(
+            f"send_tensors copied {probe.bytes_copied} B/frame "
+            f"(> header budget {budget}): serialize path regressed "
+            "from iovec to blob")
+    if not msgs or msgs[0] is None:
+        failures.append("wire roundtrip produced no message")
+    else:
+        out = protocol.decode_tensors(msgs[0].payload)
+        for i, t in enumerate(buf.tensors):
+            if not np.array_equal(out[i], t):
+                failures.append(f"tensor {i} corrupt after roundtrip")
+
+    result = {"metric": "hotpath_copy_gate", "unit": "ok",
+              "value": 0 if failures else 1,
+              "budget_bytes_per_frame": budget,
+              "bytes_copied_per_frame": probe.bytes_copied,
+              "failures": failures}
+    print(json.dumps(result), flush=True)
+    return 1 if failures else 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--frames", type=int, default=200)
+    ap.add_argument("--stage", choices=["pool", "serialize", "wire", "shm",
+                                        "all"], default="all")
+    ap.add_argument("--assert", dest="assert_gate", action="store_true",
+                    help="copy-regression gate (exit 1 when the "
+                         "serialize path copies more than the header "
+                         "budget)")
+    args = ap.parse_args()
+    if args.assert_gate:
+        return run_assert()
+    stages = {"pool": bench_pool, "serialize": bench_serialize,
+              "wire": bench_wire, "shm": bench_shm}
+    picks = stages if args.stage == "all" else {args.stage:
+                                               stages[args.stage]}
+    for fn in picks.values():
+        print(json.dumps(fn(args.frames)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
