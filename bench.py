@@ -1189,8 +1189,16 @@ def main() -> None:
     ap.add_argument("--sweep-batch", default=None,
                     help="comma list of stream micro-batch sizes; benches "
                          "--config once per size (batch-tuning mode)")
+    ap.add_argument("--no-fuse", action="store_true",
+                    help="disable the fused-segment scheduler (sets "
+                         "NNS_FUSE=0, inherited by child runs): measures "
+                         "the interpreted-dispatch baseline so the "
+                         "scheduler's delta is attributable")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.no_fuse:
+        os.environ["NNS_FUSE"] = "0"
 
     if args._child:
         print(json.dumps(run_child(args.config)), flush=True)

@@ -241,16 +241,32 @@ class TensorConverter(Element):
             return self._chain_flex(buf)
         raise RuntimeError(f"no caps negotiated on {self.name}")
 
+    def plan_step(self):
+        # fused dispatch covers the stateless 1:1 conversions; the
+        # accumulating paths (frames-per-tensor>1, audio/text adapters,
+        # flex promotion) keep interpreted dispatch
+        if self._custom is not None and hasattr(self._custom, "convert"):
+            return self._custom.convert
+        if self._media == "video/x-raw" \
+                and int(self.frames_per_tensor) == 1:
+            return self._video_frame
+        return None
+
+    def _video_frame(self, buf: TensorBuffer) -> TensorBuffer:
+        t = buf.tensors[0]
+        return buf.with_tensors(
+            [t if is_device_array(t) else buf.np(0)])
+
     def _chain_video(self, buf: TensorBuffer) -> FlowReturn:
         fpt = int(self.frames_per_tensor)
         # (h,w,c) video IS the tensor layout: pass the payload handle
         # through untouched -- a device-resident frame (HBM handle from
         # ``videotestsrc device-cache``) must NOT be synced to host here,
         # that's the whole point of the device path
+        if fpt == 1:
+            return self.push(self._video_frame(buf))
         frame = buf.tensors[0] if is_device_array(buf.tensors[0]) \
             else buf.np(0)
-        if fpt == 1:
-            return self.push(buf.with_tensors([frame]))
         # accumulate frames → one tensor of dims (c,w,h,fpt); device
         # payloads accumulate as handles and stack ON DEVICE, keeping the
         # zero-h2d property of the device path for frames-per-tensor > 1

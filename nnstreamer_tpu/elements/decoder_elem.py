@@ -96,9 +96,13 @@ class TensorDecoder(Element):
                 "application/octet-stream",
                 {"framerate": self._config.rate or Fraction(0, 1)})]))
 
-    def chain(self, pad, buf):
+    def _decode_one(self, buf):
         if self._custom_fn is not None:
-            out = self._custom_fn(buf, self._config)
-        else:
-            out = self._decoder.decode(buf, self._config)
-        return self.push(out)
+            return self._custom_fn(buf, self._config)
+        return self._decoder.decode(buf, self._config)
+
+    def chain(self, pad, buf):
+        return self.push(self._decode_one(buf))
+
+    def plan_step(self):
+        return self._decode_one

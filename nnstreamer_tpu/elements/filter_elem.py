@@ -93,6 +93,17 @@ class TensorFilter(Element):
                         "lever when dispatch latency, not device compute,"
                         " bounds throughput (remote/tunneled chips); "
                         "costs K batches of output HBM+latency"),
+        "workers": (1, "parallel invoke workers: N>1 spawns a pool that "
+                       "consumes frames concurrently (per-worker backend "
+                       "instance unless the backend declares "
+                       "THREADSAFE_INVOKE) and reassembles results in "
+                       "sequence order before pushing downstream.  The "
+                       "lever when per-frame invoke latency (CPU model, "
+                       "remote call) bounds throughput and the backend "
+                       "releases the GIL; composes with per-frame QoS/"
+                       "combination properties.  With batch>1 the "
+                       "micro-batch+inflight machinery already overlaps "
+                       "dispatch, so workers is forced to 1 there"),
         "output-device": (False, "emit device-resident outputs (BatchView/"
                                  "jax.Array payloads): a downstream batched "
                                  "filter consumes them without any host "
@@ -240,6 +251,31 @@ class TensorFilter(Element):
         self._coalesce_lock = threading.Lock()
         self._deadline_stop = threading.Event()
         self._deadline_thread = None
+        # parallel invoke workers: a pool of N invoke threads fed from
+        # chain(), with a dedicated pusher reassembling results in strict
+        # sequence order before pushing downstream.  Orthogonal to the
+        # micro-batch machinery: batch>1 already overlaps dispatch via
+        # inflight, so workers collapses to 1 there.
+        self._workers_n = max(1, int(self.workers or 1))
+        if self._workers_n > 1 and self._batch > 1:
+            from ..utils.log import ml_logw
+
+            ml_logw("%s: workers=%d with batch>1: micro-batching already "
+                    "overlaps dispatch (use inflight=); running workers=1",
+                    self.name, self._workers_n)
+            self._workers_n = 1
+        thread_safe = bool(getattr(type(self.fw), "THREADSAFE_INVOKE",
+                                   False))
+        if self._workers_n > 1 and props.shared_key and not thread_safe:
+            from ..utils.log import ml_logw
+
+            ml_logw("%s: workers=%d needs per-worker backend instances, "
+                    "which shared-tensor-filter-key forbids (backend not "
+                    "THREADSAFE_INVOKE); running workers=1",
+                    self.name, self._workers_n)
+            self._workers_n = 1
+        if self._workers_n > 1:
+            self._start_workers(thread_safe)
         if self._batch > 1:
             self.fw.warmup_batched(self._batch)
         if self._batch_deadline > 0:
@@ -253,6 +289,7 @@ class TensorFilter(Element):
         if self._deadline_thread is not None:
             self._deadline_thread.join(timeout=10)
             self._deadline_thread = None
+        self._stop_workers()
         close_backend(getattr(self, "fw", None), self._props)
         self.fw = None
 
@@ -261,6 +298,7 @@ class TensorFilter(Element):
         from ..tensor.caps_util import config_from_caps
 
         self._drain_batches()   # renegotiation must not reorder frames
+        self._drain_workers()
         in_cfg = config_from_caps(caps)
         model_in, model_out = self.fw.get_model_info()
         expect = model_in
@@ -279,6 +317,13 @@ class TensorFilter(Element):
                 raise ValueError(
                     f"{self.name}: incoming {in_cfg.info} != model "
                     f"input {expect}") from None
+            # per-worker backend instances serve the same stream: they
+            # must renegotiate too, or workers 1..N-1 keep invoking
+            # against the stale input config (same propagation the
+            # reload_model event path does)
+            for wfw in getattr(self, "_wk_backends", []):
+                if wfw is not self.fw:
+                    wfw.set_input_info(in_cfg.info)
         self._in_config = in_cfg
         out_infos = model_out
         if self._out_comb is not None:
@@ -290,17 +335,11 @@ class TensorFilter(Element):
         self.announce_src_caps(caps_from_config(self._out_config))
 
     # -- hot loop ------------------------------------------------------------
-    def chain(self, pad, buf: TensorBuffer) -> FlowReturn:
-        fw = self.fw
-        if fw is None or not fw.opened:
-            raise RuntimeError(f"{self.name}: not started")
-        if self._rewarm:
-            # deferred from the pushdown-fusion event handler (compiling
-            # there deadlocks the downstream queue's drain thread): pay
-            # both executable compiles here, before the stream is deep,
-            # so neither a mid-stream batch nor the EOS flush tail does
-            self._rewarm = False
-            fw.warmup_batched(self._batch)
+    def _preprocess(self, buf: TensorBuffer):
+        """QoS throttle-drop + per-buffer validation + input-combination.
+        Returns the selected input tensor list, or ``FlowReturn.DROPPED``.
+        Shared by interpreted chain, the fused plan step, and the worker
+        submit path."""
         # QoS throttle-drop (reference :609): after a downstream QoS event,
         # drop frames arriving faster than the reported consumption rate
         if self._throttle_ns and buf.pts is not None:
@@ -320,6 +359,22 @@ class TensorFilter(Element):
         tensors = buf.tensors
         if self._in_comb is not None:
             tensors = [tensors[i] for i in self._in_comb]
+        return tensors
+
+    def chain(self, pad, buf: TensorBuffer) -> FlowReturn:
+        fw = self.fw
+        if fw is None or not fw.opened:
+            raise RuntimeError(f"{self.name}: not started")
+        if self._rewarm:
+            # deferred from the pushdown-fusion event handler (compiling
+            # there deadlocks the downstream queue's drain thread): pay
+            # both executable compiles here, before the stream is deep,
+            # so neither a mid-stream batch nor the EOS flush tail does
+            self._rewarm = False
+            fw.warmup_batched(self._batch)
+        tensors = self._preprocess(buf)
+        if tensors.__class__ is FlowReturn:
+            return tensors
         if self._batch > 1:
             if self._batch_deadline > 0:
                 # coalescer path: the deadline watcher dispatches/flushes
@@ -328,19 +383,195 @@ class TensorFilter(Element):
                 with self._coalesce_lock:
                     return self._collect_frame(tensors, buf)
             return self._collect_frame(tensors, buf)
+        if self._workers_n > 1:
+            return self._submit_frame(tensors, buf)
         if self._emit_device:
             outs = fw.invoke(list(tensors), emit_device=True)
         else:
             outs = fw.invoke(list(tensors))
         return self._push_result(buf, outs)
 
-    def _push_result(self, buf: TensorBuffer, outs) -> FlowReturn:
+    def plan_step(self):
+        """Fused-dispatch hook: the per-frame synchronous path flattens
+        into an upstream segment plan; micro-batching and the worker pool
+        push from their own threads, so they keep interpreted dispatch."""
+        if self._batch > 1 or self._workers_n > 1:
+            return None
+        return self._plan_invoke
+
+    def _plan_invoke(self, buf: TensorBuffer):
+        fw = self.fw
+        if fw is None or not fw.opened:
+            raise RuntimeError(f"{self.name}: not started")
+        tensors = self._preprocess(buf)
+        if tensors.__class__ is FlowReturn:
+            return tensors
+        if self._emit_device:
+            outs = fw.invoke(list(tensors), emit_device=True)
+        else:
+            outs = fw.invoke(list(tensors))
+        return self._compose_output(buf, list(outs))
+
+    def _compose_output(self, buf: TensorBuffer, outs) -> TensorBuffer:
         out_tensors = outs
         if self._out_comb is not None:
             ins, sel = self._out_comb
             out_tensors = [buf.tensors[i] for i in ins] + \
                           [outs[i] for i in sel]
-        return self.push(buf.with_tensors(out_tensors))
+        return buf.with_tensors(out_tensors)
+
+    def _push_result(self, buf: TensorBuffer, outs) -> FlowReturn:
+        return self.push(self._compose_output(buf, outs))
+
+    # -- parallel invoke workers ---------------------------------------------
+    def _start_workers(self, thread_safe: bool) -> None:
+        """Spawn the invoke pool + ordered pusher.  Where the backend is
+        not thread-safe each worker gets its OWN backend instance (same
+        props, so same model/weights); a THREADSAFE_INVOKE backend (e.g.
+        the jit-executable family — concurrent jax dispatch is supported)
+        is shared, so compiled executables and device params exist once."""
+        import queue as _q
+        import threading
+
+        from ..filter.framework import open_backend
+
+        backends = []
+        for i in range(self._workers_n):
+            if thread_safe or i == 0:
+                backends.append(self.fw)
+            else:
+                import dataclasses as _dc
+
+                backends.append(open_backend(_dc.replace(self._props)))
+        self._wk_backends = backends
+        self._wk_tasks: _q.Queue = _q.Queue()
+        self._wk_cv = threading.Condition()
+        self._wk_results: dict = {}     # seq -> (buf, outs, exc)
+        self._wk_seq = 0                # frames submitted
+        self._wk_pushed = 0             # frames pushed (or error-skipped)
+        self._wk_error = None
+        self._wk_stop = False
+        # in-flight bound: backpressure so a slow downstream or a burst
+        # does not queue unbounded frames inside the element
+        self._wk_sem = threading.Semaphore(self._workers_n * 2)
+        self._wk_threads = [
+            threading.Thread(target=self._worker_loop, args=(fw,),
+                             daemon=True, name=f"invoke:{self.name}:{i}")
+            for i, fw in enumerate(backends)]
+        self._wk_pusher = threading.Thread(
+            target=self._pusher_loop, daemon=True,
+            name=f"invoke-push:{self.name}")
+        for t in self._wk_threads:
+            t.start()
+        self._wk_pusher.start()
+
+    def _submit_frame(self, tensors, buf: TensorBuffer) -> FlowReturn:
+        self._wk_sem.acquire()
+        with self._wk_cv:
+            if self._wk_stop:
+                self._wk_sem.release()
+                return FlowReturn.EOS
+            if self._wk_error is not None:
+                self._wk_sem.release()
+                return FlowReturn.ERROR
+            seq = self._wk_seq
+            self._wk_seq += 1
+            # enqueue under the cv: _stop_workers sets _wk_stop under the
+            # same lock BEFORE queueing the pool's exit sentinels, so a
+            # task can never land behind a sentinel (it would be dropped
+            # by the exiting workers while counted in _wk_seq, wedging
+            # the pushed>=seq drain condition)
+            self._wk_tasks.put((seq, list(tensors), buf))
+        return FlowReturn.OK
+
+    def _worker_loop(self, fw) -> None:
+        while True:
+            item = self._wk_tasks.get()
+            if item is None:
+                return
+            seq, tensors, buf = item
+            try:
+                if self._emit_device:
+                    outs = fw.invoke(tensors, emit_device=True)
+                else:
+                    outs = fw.invoke(tensors)
+                res = (buf, list(outs), None)
+            except Exception as exc:  # noqa: BLE001 — surfaced by pusher
+                res = (buf, None, exc)
+            with self._wk_cv:
+                self._wk_results[seq] = res
+                self._wk_cv.notify_all()
+
+    def _pusher_loop(self) -> None:
+        """Reassemble worker results in strict sequence order and push
+        downstream — output order is exactly arrival order regardless of
+        per-frame invoke latency jitter."""
+        while True:
+            with self._wk_cv:
+                self._wk_cv.wait_for(
+                    lambda: self._wk_pushed in self._wk_results
+                    or (self._wk_stop
+                        and self._wk_pushed >= self._wk_seq))
+                if self._wk_pushed not in self._wk_results:
+                    return              # stopped and fully drained
+                buf, outs, exc = self._wk_results.pop(self._wk_pushed)
+                failed = self._wk_error is not None
+            if not failed:
+                try:
+                    if exc is not None:
+                        raise exc
+                    if self._push_result(buf, outs) is FlowReturn.ERROR:
+                        raise RuntimeError(
+                            f"{self.name}: downstream error from invoke "
+                            "worker")
+                except Exception as err:  # noqa: BLE001
+                    with self._wk_cv:
+                        self._wk_error = err
+                    if self.pipeline is not None:
+                        self.pipeline.post_error(self, err)
+            # count the frame pushed (or skipped after an error, so
+            # draining still converges) and free a submit slot
+            with self._wk_cv:
+                self._wk_pushed += 1
+                self._wk_cv.notify_all()
+            self._wk_sem.release()
+
+    def _drain_workers(self) -> None:
+        """Block until every submitted frame has been pushed, in order
+        (EOS, renegotiation, model swap).  Raises on a worker/downstream
+        failure so the event path posts a pipeline error."""
+        if getattr(self, "_workers_n", 1) <= 1:
+            return
+        with self._wk_cv:
+            self._wk_cv.wait_for(
+                lambda: self._wk_pushed >= self._wk_seq)
+            if self._wk_error is not None:
+                raise RuntimeError(
+                    f"{self.name}: invoke worker failed while draining"
+                ) from self._wk_error
+
+    def unblock(self):
+        if getattr(self, "_workers_n", 1) > 1:
+            with self._wk_cv:
+                self._wk_stop = True
+                self._wk_cv.notify_all()
+            self._wk_sem.release()   # wake a producer blocked on the bound
+
+    def _stop_workers(self) -> None:
+        if getattr(self, "_workers_n", 1) <= 1:
+            return
+        with self._wk_cv:
+            self._wk_stop = True
+            self._wk_cv.notify_all()
+        for _ in self._wk_threads:
+            self._wk_tasks.put(None)
+        for t in self._wk_threads:
+            t.join(timeout=10)
+        self._wk_pusher.join(timeout=10)
+        for fw in self._wk_backends:
+            if fw is not self.fw:
+                fw.close()
+        self._workers_n = 1
 
     # -- micro-batching ------------------------------------------------------
     def _collect_frame(self, tensors, buf: TensorBuffer) -> FlowReturn:
@@ -517,6 +748,13 @@ class TensorFilter(Element):
                 # AFTER invoke; a reduction computed against the combined
                 # view cannot be fused onto the raw outputs
                 return False
+            if getattr(self, "_workers_n", 1) > 1:
+                # the worker pool invokes concurrently, possibly on
+                # per-worker backend instances: fusing the reduction into
+                # self.fw alone would emit mixed output shapes under the
+                # reduced caps (and mutate a shared backend mid-invoke).
+                # Refusing keeps correctness — the decoder host-decodes.
+                return False
             if not self.fw.set_postprocess(fn):
                 return False
             # remember the fusion: a model reload rebuilds the backend
@@ -540,13 +778,21 @@ class TensorFilter(Element):
 
         if isinstance(event, EOSEvent):
             self._drain_batches()
+            self._drain_workers()   # all in-flight frames precede EOS
         if isinstance(event, CustomEvent) and \
                 event.name == "tensor_filter_update_model":
             if not self.is_updatable:
                 raise RuntimeError(f"{self.name}: not is-updatable")
             self._drain_batches()  # frames of the old model flush first
+            self._drain_workers()
             try:
                 self.fw.handle_event("reload_model", event.data)
+                # per-worker backend instances serve the same model: a
+                # reload that only swapped self.fw would leave workers
+                # 1..N-1 silently answering with the OLD weights
+                for wfw in getattr(self, "_wk_backends", []):
+                    if wfw is not self.fw:
+                        wfw.handle_event("reload_model", event.data)
             except Exception as exc:  # noqa: BLE001
                 # a rejected reload keeps the old model serving — log and
                 # keep streaming instead of erroring the pipeline (unless

@@ -32,6 +32,35 @@ from ..tensor.types import TensorType, dim_parse
 
 
 @register_element
+class Identity(Element):
+    """Pass-through element (GStreamer ``identity`` role): forwards every
+    buffer untouched.  Fusable — the unit of per-element dispatch-overhead
+    measurement in ``tools/hotpath_bench.py --stage dispatch``.
+    ``sleep-us`` emulates a fixed per-buffer cost (test/bench hook, the
+    gst identity ``sleep-time`` analogue)."""
+
+    FACTORY = "identity"
+    PROPERTIES = {"sleep-us": (0, "sleep per buffer, microseconds")}
+
+    def _make_pads(self):
+        self.add_sink_pad(Caps.any(), "sink")
+        self.add_src_pad(Caps.any(), "src")
+
+    def _forward(self, buf):
+        if self.sleep_us:
+            import time as _time
+
+            _time.sleep(int(self.sleep_us) / 1e6)
+        return buf
+
+    def chain(self, pad, buf):
+        return self.push(self._forward(buf))
+
+    def plan_step(self):
+        return self._forward
+
+
+@register_element
 class TensorDebug(Element):
     """Logs caps/buffer meta in-band (console-output parity with
     gsttensor_debug.c)."""
@@ -52,10 +81,16 @@ class TensorDebug(Element):
         self._note(f"caps: {caps}")
         self.src_pad.push_event(CapsEvent(caps))
 
-    def chain(self, pad, buf):
+    def _observe(self, buf):
         shapes = [tuple(getattr(t, "shape", ())) for t in buf.tensors]
         self._note(f"buffer pts={buf.pts} n={buf.num_tensors} shapes={shapes}")
-        return self.push(buf)
+        return buf
+
+    def chain(self, pad, buf):
+        return self.push(self._observe(buf))
+
+    def plan_step(self):
+        return self._observe
 
     def _note(self, msg: str) -> None:
         if bool(self.capture):

@@ -150,7 +150,7 @@ class TensorTransform(Element):
         return info.copy()  # clamp keeps type/shape
 
     # -- dataflow ------------------------------------------------------------
-    def chain(self, pad, buf: TensorBuffer) -> FlowReturn:
+    def _apply(self, buf: TensorBuffer) -> TensorBuffer:
         outs = []
         for i in range(buf.num_tensors):
             t = buf.tensors[i]
@@ -161,7 +161,13 @@ class TensorTransform(Element):
                 outs.append(self._transform(t, target))
             else:
                 outs.append(t)
-        return self.push(buf.with_tensors(outs))
+        return buf.with_tensors(outs)
+
+    def chain(self, pad, buf: TensorBuffer) -> FlowReturn:
+        return self.push(self._apply(buf))
+
+    def plan_step(self):
+        return self._apply
 
     def _transform(self, arr: Any, target=None) -> Any:
         xp = _xp(arr)

@@ -130,6 +130,11 @@ class JitExecMixin:
     ``self._device`` (set by :meth:`_setup_exec`)."""
 
     SUPPORTS_BATCHING = True
+    #: concurrent jax dispatch on one jitted executable is supported (the
+    #: default_device context and trace caches are thread-local/locked),
+    #: so tensor_filter workers share ONE instance: executables compile
+    #: once and params live in HBM once
+    THREADSAFE_INVOKE = True
 
     def _setup_exec(self, forward_fn, params, device, warmup_inputs=None,
                     compute_dtype=None, mesh=None):

@@ -138,6 +138,7 @@ class DummyFilter(FilterFramework):
 
     NAME = "dummy"
     SUPPORTED_ACCELERATORS = (Accelerator.CPU, Accelerator.TPU)
+    THREADSAFE_INVOKE = True   # stateless zeros + locked stats counter
 
     def __init__(self) -> None:
         super().__init__()

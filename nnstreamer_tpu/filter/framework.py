@@ -140,6 +140,14 @@ class FilterFramework:
     #: True when :meth:`invoke_batched` coalesces frames into one device
     #: dispatch (tensor_filter's ``batch`` property gates on this)
     SUPPORTS_BATCHING: bool = False
+    #: True when :meth:`invoke` may be called from multiple threads on ONE
+    #: instance (tensor_filter's ``workers`` property shares the backend —
+    #: compiled executables and device-resident params exist once).  False
+    #: (default) makes ``workers=N`` open one backend instance per worker
+    #: instead, which isolates per-instance state but multiplies open cost;
+    #: user-supplied models (custom/python) stay False because their
+    #: thread-safety is unknowable here.
+    THREADSAFE_INVOKE: bool = False
 
     def __init__(self) -> None:
         self.props: Optional[FilterProperties] = None

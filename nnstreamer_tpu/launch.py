@@ -83,7 +83,13 @@ def main(argv=None) -> int:
                          "(per-element invoke latency contributions)")
     ap.add_argument("--trace", action="store_true",
                     help="record per-element proctime/framerate (GstShark "
-                         "tracer role) and print the report at EOS")
+                         "tracer role) and print the report at EOS "
+                         "(includes the fused segment plan)")
+    ap.add_argument("--no-fuse", action="store_true",
+                    help="disable the segment compiler: interpreted "
+                         "per-pad dispatch (the baseline "
+                         "tools/hotpath_bench.py --stage dispatch "
+                         "compares against)")
     ap.add_argument("--jax-trace", default=None, metavar="DIR",
                     help="record a device-level JAX/XLA profiler trace "
                          "into DIR (TensorBoard profile format): per-op "
@@ -104,7 +110,12 @@ def main(argv=None) -> int:
 
     t0 = time.time()
     try:
-        p = parse_launch(args.pipeline)
+        if args.no_fuse:
+            from .pipeline.graph import Pipeline
+
+            p = parse_launch(args.pipeline, Pipeline(fuse=False))
+        else:
+            p = parse_launch(args.pipeline)
         if args.print_sink:
             sink = p.get(args.print_sink)
             sink.connect("new-data", _print_buffer)
@@ -113,6 +124,7 @@ def main(argv=None) -> int:
                 if hasattr(el, "latency_report"):
                     el.latency_report = True
         tracer = p.enable_tracing() if args.trace else None
+        plans = None
         if args.jax_trace:
             import jax
 
@@ -120,6 +132,8 @@ def main(argv=None) -> int:
         try:
             p.play()
             p.wait(args.timeout)
+            if tracer is not None and p.planner is not None:
+                plans = p.planner.plans()   # snapshot before stop() drops it
             if args.stats:
                 total, per = p.query_latency()
                 for name, ns in sorted(per.items()):
@@ -150,6 +164,10 @@ def main(argv=None) -> int:
                 import json as _json
 
                 report = {"trace": tracer.report()}
+                if plans is not None:
+                    # which element runs the scheduler fused, and where
+                    # each fused segment pushes (its thread boundary)
+                    report["plan"] = plans
                 resilience = tracer.resilience_report()
                 if resilience:
                     # retry/failure/breaker/heartbeat counters from the

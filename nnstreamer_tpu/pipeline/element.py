@@ -133,6 +133,13 @@ class Pad:
                 f"{sink.full_name} ({sink.template}): no common caps")
         self.peer = sink
         sink.peer = self
+        # request-pad link after play: the fused-dispatch head set changed
+        # (a new tee branch is a new head) — rescan (schedule.py)
+        for el in (self.element, sink.element):
+            pl = getattr(el, "pipeline", None)
+            if pl is not None and getattr(pl, "planner", None) is not None:
+                pl.planner.rescan()
+                break
 
     # -- dataflow (called on src pads) --------------------------------------
     def push(self, buf: TensorBuffer) -> FlowReturn:
@@ -306,6 +313,14 @@ class Element:
     def _event_entry(self, pad: Pad, event: Event) -> None:
         if isinstance(event, CapsEvent):
             pad.caps = event.caps
+            # caps (re)negotiation changes what fused dispatch plans may
+            # assume around THIS element: drop the affected plans; the
+            # next buffer recompiles against the new state (schedule.py).
+            # No-op when not fused; scoped so an event crossing a queue
+            # late never wipes unrelated segments' plans.
+            pl = self.pipeline
+            if pl is not None and getattr(pl, "planner", None) is not None:
+                pl.planner.invalidate(element=self)
             try:
                 self.set_caps(pad, event.caps)
             except Exception as exc:  # noqa: BLE001
@@ -316,6 +331,13 @@ class Element:
             return
         if isinstance(event, EOSEvent):
             pad.eos = True
+        if isinstance(event, CustomEvent):
+            # model-update and friends can change an element's fusability
+            # (e.g. a filter swapping backends mid-stream); scoped to the
+            # plans this element participates in
+            pl = self.pipeline
+            if pl is not None and getattr(pl, "planner", None) is not None:
+                pl.planner.invalidate(element=self)
         self.on_event(pad, event)
 
     # -- overridables --------------------------------------------------------
@@ -361,6 +383,23 @@ class Element:
             if sp.push_upstream_event(event):
                 return True
         return False
+
+    def plan_step(self):
+        """Fused-dispatch hook (schedule.py segment compiler).
+
+        Return a callable ``step(buf) -> TensorBuffer | None | FlowReturn``
+        to let this element be flattened into a fused segment plan — the
+        steady-state path then calls ``step`` in a flat loop instead of
+        dispatching ``Pad.push → _chain_entry → chain`` per element.  The
+        step must NOT push downstream itself; it returns the output buffer
+        (``None`` = consumed, e.g. accumulating; a ``FlowReturn`` =
+        terminal, e.g. ``DROPPED``).  Return ``None`` from *this method*
+        to opt out of fusion (the default): the element keeps interpreted
+        per-pad dispatch.  Only 1-sink/1-src elements are ever fused;
+        the returned callable is re-queried on every plan (re)build, so
+        an element may change its answer when its configuration changes
+        (e.g. tensor_filter with batch>1 or workers>1 opts out)."""
+        return None
 
     def get_allowed_caps(self, sink_pad: Pad) -> Caps:
         """Answer a downstream caps query on ``sink_pad``.  Default: the pad

@@ -10,6 +10,7 @@ plays between decoupled segments.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import queue as _queue
@@ -41,7 +42,7 @@ class Pipeline:
         p.run()          # play + wait EOS + stop
     """
 
-    def __init__(self, name: str = "pipeline"):
+    def __init__(self, name: str = "pipeline", fuse: Optional[bool] = None):
         self.name = name
         self.tracer = None          # set by enable_tracing()
         self.elements: List[Element] = []
@@ -50,6 +51,13 @@ class Pipeline:
         self._eos_sinks: set = set()
         self._cv = threading.Condition()
         self._playing = False
+        #: fused segment dispatch (schedule.py): compile maximal linear
+        #: element runs into flat plans at play().  On by default;
+        #: ``fuse=False`` (or NNS_FUSE=0) keeps interpreted per-pad
+        #: dispatch — the baseline the dispatch bench compares against.
+        self.fuse = (os.environ.get("NNS_FUSE", "1") != "0"
+                     if fuse is None else bool(fuse))
+        self.planner = None         # SegmentPlanner while playing
 
     # -- construction --------------------------------------------------------
     def add(self, *elements: Element):
@@ -143,6 +151,11 @@ class Pipeline:
                 raise PipelineError(el, exc) from exc
             el._started = True
         self._playing = True
+        if self.fuse:
+            from .schedule import SegmentPlanner
+
+            self.planner = SegmentPlanner(self)
+            self.planner.install()
         #: running-time origin: sinks with sync=true render buffer PTS
         #: against this (GStreamer base-time role)
         self.base_time_ns = time.monotonic_ns()
@@ -167,6 +180,9 @@ class Pipeline:
         from .tracing import Tracer
 
         self.tracer = Tracer()
+        if self.planner is not None:
+            # compiled executors bind the tracer at compile time: rebuild
+            self.planner.invalidate()
         return self.tracer
 
     def query_latency(self) -> "tuple[int, Dict[str, int]]":
@@ -197,7 +213,10 @@ class Pipeline:
                 lambda: self._error is not None
                 or sink_names <= self._eos_sinks, timeout)
         if self._error is not None:
-            raise self._error
+            # raise a FRESH chained copy: re-raising the stored object on a
+            # second wait() would keep appending traceback frames to it
+            err = PipelineError(self._error.element, self._error.cause)
+            raise err from self._error
         if not ok:
             raise TimeoutError(f"pipeline {self.name}: EOS not reached")
 
@@ -217,6 +236,9 @@ class Pipeline:
                 el.stop()
                 el._started = False
                 stopped_any = True
+        if self.planner is not None:
+            self.planner.uninstall()
+            self.planner = None
         if stopped_any:
             # the element/pad graph is cyclic, so DROPPED pipelines from
             # earlier runs (and the buffers their sinks retained) linger
@@ -305,21 +327,30 @@ class Queue(Element):
         self.add_src_pad(Caps.any(), "src")
 
     def start(self):
-        # capacity bounds DATA buffers only (the semaphore); the queue
+        # capacity bounds DATA buffers only (the _used counter); the queue
         # itself is unbounded so control markers (caps/events/EOS) can
         # always be enqueued — a caps announcement arriving from the
         # drain thread of a downstream queue must never block on data
         # capacity (that is a self-deadlock: the would-be consumer is
         # the blocked thread)
         self._q: _queue.Queue = _queue.Queue()
-        self._slots = threading.Semaphore(int(self.max_size_buffers))
+        self._cap = max(1, int(self.max_size_buffers))
+        self._used = 0
+        self._space = threading.Condition()
+        self._drain_done = False
         self._worker = threading.Thread(target=self._drain,
                                         name=f"queue:{self.name}", daemon=True)
         self._stop = threading.Event()
         self._worker.start()
 
+    def unblock(self):
+        with self._space:
+            self._space.notify_all()
+
     def stop(self):
         self._stop.set()
+        with self._space:
+            self._space.notify_all()
         # drain so the sentinel always fits even if the worker died with a
         # full queue (upstream error case)
         while True:
@@ -334,15 +365,21 @@ class Queue(Element):
         return self.src_pad.peer_allowed_caps()
 
     def _enqueue(self, buf) -> FlowReturn:
-        """Slot-bounded data put that can't deadlock: gives up when the
-        queue is being stopped or the drain worker died."""
-        while not self._stop.is_set():
-            if self._slots.acquire(timeout=0.1):
-                self._q.put(("buf", buf))
-                return FlowReturn.OK
-            if not self._worker.is_alive():
-                return FlowReturn.ERROR
-        return FlowReturn.EOS
+        """Slot-bounded data put that can't deadlock: purely event-driven
+        (no poll) — woken by the drain worker freeing a slot, by stop(),
+        or by the worker exiting (EOS drained / downstream error)."""
+        with self._space:
+            while True:
+                if self._stop.is_set():
+                    return FlowReturn.EOS
+                if self._used < self._cap:
+                    break
+                if self._drain_done:
+                    return FlowReturn.ERROR
+                self._space.wait()
+            self._used += 1
+        self._q.put(("buf", buf))
+        return FlowReturn.OK
 
     def _enqueue_event(self, event) -> None:
         if not self._stop.is_set():
@@ -357,26 +394,40 @@ class Queue(Element):
     def on_event(self, pad, event):
         self._enqueue_event(event)
 
+    def _release_slot(self):
+        with self._space:
+            self._used -= 1
+            self._space.notify()
+
     def _drain(self):
-        while not self._stop.is_set():
-            item = self._q.get()
-            if item is None:
-                return
-            kind, payload = item
-            try:
-                if kind == "buf":
-                    try:
-                        self.src_pad.push(payload)
-                    finally:
-                        self._slots.release()
-                else:
-                    self.src_pad.push_event(payload)
-                    if isinstance(payload, EOSEvent):
-                        return
-            except Exception as exc:  # noqa: BLE001
-                if self.pipeline is not None:
-                    self.pipeline.post_error(self, exc)
-                return
+        try:
+            while not self._stop.is_set():
+                item = self._q.get()
+                if item is None:
+                    return
+                kind, payload = item
+                try:
+                    if kind == "buf":
+                        try:
+                            self.src_pad.push(payload)
+                        finally:
+                            self._release_slot()
+                    else:
+                        self.src_pad.push_event(payload)
+                        if isinstance(payload, EOSEvent):
+                            return
+                except Exception as exc:  # noqa: BLE001
+                    if self.pipeline is not None:
+                        self.pipeline.post_error(self, exc)
+                    return
+        finally:
+            # wake any producer blocked on a full queue: _drain_done is the
+            # worker-exited signal _enqueue checks (its is-the-thread-alive
+            # poll is gone), set under the lock so a waiter can't re-check
+            # and sleep between the flag write and the notify
+            with self._space:
+                self._drain_done = True
+                self._space.notify_all()
 
 
 @register_element
@@ -391,11 +442,18 @@ class Tee(Element):
 
     FACTORY = "tee"
 
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self._done: set = set()     # branch pads that returned EOS
+
     def _make_pads(self):
         self.add_sink_pad(Caps.any(), "sink")
 
     def request_src_pad(self) -> Pad:
         return self.add_src_pad(Caps.any())
+
+    def start(self):
+        self._done = set()
 
     def get_allowed_caps(self, sink_pad):
         allowed = Caps.any()
@@ -404,11 +462,23 @@ class Tee(Element):
         return allowed
 
     def chain(self, pad, buf):
-        for sp in self.src_pads:
-            ret = sp.push(buf.copy())
+        # a branch that answered EOS is done for good: drop it from the
+        # fan-out instead of re-offering every frame; the LAST live branch
+        # gets the original wrapper (no copy) — only the other branches
+        # need a fresh wrapper for branch-local meta mutations
+        done = self._done
+        live = [sp for sp in self.src_pads if sp not in done]
+        if not live:
+            return FlowReturn.EOS
+        last = len(live) - 1
+        for i, sp in enumerate(live):
+            ret = sp.push(buf if i == last else buf.copy())
             if ret is FlowReturn.ERROR:
                 return ret
-        return FlowReturn.OK
+            if ret is FlowReturn.EOS:
+                done.add(sp)
+        return FlowReturn.EOS if len(done) >= len(self.src_pads) \
+            else FlowReturn.OK
 
 
 @register_element
@@ -420,6 +490,11 @@ class AppSrc(Source):
 
     FACTORY = "appsrc"
     PROPERTIES = {"caps": (None, "fixed caps to announce")}
+
+    #: in-band wake marker: create() blocks on the fifo with NO timeout
+    #: (event-driven, zero idle wakeups); unblock()/_halt() enqueue this
+    #: so teardown can interrupt the blocking get
+    _WAKE = object()
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
@@ -449,14 +524,25 @@ class AppSrc(Source):
             raise ValueError("appsrc requires caps property")
         return caps
 
+    def unblock(self):
+        self._fifo.put(self._WAKE)
+
+    def _halt(self) -> None:
+        # order matters: set the flag BEFORE the wake marker, so a create()
+        # that consumes the marker observes halted and exits (the reverse
+        # order could consume the wake, see un-halted, and block forever)
+        self._halted.set()
+        self._fifo.put(self._WAKE)
+        super()._halt()
+
     def create(self) -> Optional[TensorBuffer]:
-        while not self._halted.is_set():
-            try:
-                item = self._fifo.get(timeout=0.1)
-            except _queue.Empty:
-                continue
+        while True:
+            item = self._fifo.get()
+            if item is self._WAKE:
+                if self._halted.is_set():
+                    return None
+                continue            # pre-halt unblock(): spurious, re-wait
             if isinstance(item, Event):
                 self.src_pad.push_event(item)
                 continue
             return item
-        return None

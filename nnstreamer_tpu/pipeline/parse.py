@@ -72,6 +72,14 @@ class CapsFilter(Element):
     def chain(self, pad, buf):
         return self.src_pad.push(buf)
 
+    def _passthrough(self, buf):
+        return buf
+
+    def plan_step(self):
+        # negotiation work all happens at caps time; per-buffer this is a
+        # pure passthrough, so fused dispatch elides it entirely
+        return self._passthrough
+
 
 def _coerce(value: str):
     try:

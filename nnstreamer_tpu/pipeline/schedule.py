@@ -1,0 +1,246 @@
+"""Segment compiler: fused dispatch plans for linear pipeline runs.
+
+The per-buffer element-graph tax is the streaming bottleneck once payloads
+are zero-copy: every frame crosses ``Pad.push → peer._chain_entry → chain``
+(plus a tracer test and a try/except) for every element in the chain, even
+when each element is a trivial transform.  The NNStreamer paper's pipeline
+parallelism (Ham et al., arXiv:1901.04985) decides *where* thread
+boundaries go; StreamTensor (arXiv:2509.13694) shows the complementary win
+of compiling linear dataflow *segments* into one fused kernel instead of
+interpreting the graph per item.  This module does the latter at the
+scheduling layer:
+
+- At ``Pipeline.play()`` a :class:`SegmentPlanner` walks the pad graph and
+  finds every **head pad** — a src pad whose owning element is a thread/
+  topology boundary (Source, Queue, Tee branch, mux, demux, any opt-out
+  element).  Linear 1-sink/1-src elements downstream of a head that
+  expose :meth:`~nnstreamer_tpu.pipeline.element.Element.plan_step` are
+  **fused**: the head pad's ``push`` becomes one flat loop over bound
+  step callables, ending in the boundary element's ``_chain_entry``.
+- Plans compile **lazily on the first buffer** (caps have been negotiated
+  by then — buffers follow caps in-band) and cache the negotiated state
+  inside the bound closures.
+- Plans **invalidate** on caps renegotiation, on custom events
+  (model-update), on request-pad linking after play, and on
+  ``enable_tracing`` — the head falls back to a compile stub and the next
+  buffer rebuilds against current state.  Elements that opt out
+  (``plan_step() -> None``) simply terminate the fused run; dataflow
+  continues interpreted, bit-for-bit identical.
+- Tracing: with a tracer attached, the compiled executor wraps each step
+  in the same ``enter``/``exit(name)`` pair ``_chain_entry`` uses, so
+  per-element proctime/buffers counters are exactly those of interpreted
+  dispatch.  With no tracer the executor contains **zero** tracer
+  references — fusion is how tracing costs nothing when off.
+
+Install/uninstall works by shadowing ``Pad.push`` with an instance
+attribute on head pads only: interpreted pipelines never pay a check, and
+``uninstall()`` (at ``Pipeline.stop``) restores the class method.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .element import Element, FlowReturn, Pad
+
+
+def _is_linear_fusable(el: Element) -> bool:
+    """Can ``el`` appear *inside* a fused run?  Exactly one sink and one
+    src pad, and the element offers a plan step."""
+    return (len(el.sink_pads) == 1 and len(el.src_pads) == 1
+            and el.plan_step() is not None)
+
+
+class SegmentPlanner:
+    """Owns the fused dispatch plans of one playing pipeline."""
+
+    def __init__(self, pipeline) -> None:
+        self.pipeline = pipeline
+        self._lock = threading.RLock()
+        self._heads: List[Pad] = []
+        self._plans: Dict[str, Dict] = {}   # head full_name -> plan info
+        #: bumped on every invalidate/rescan; tests assert rebuilds happened
+        self.epoch = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def install(self) -> None:
+        """Compute the head-pad set and arm every head with a lazy compile
+        stub.  Called from ``Pipeline.play()``."""
+        with self._lock:
+            self._heads = self._find_heads()
+            for head in self._heads:
+                self._install_stub(head)
+
+    def uninstall(self) -> None:
+        """Restore interpreted dispatch everywhere (``Pipeline.stop``)."""
+        with self._lock:
+            for head in self._heads:
+                head.__dict__.pop("push", None)
+            self._heads = []
+            self._plans.clear()
+
+    def invalidate(self, element: Optional[Element] = None) -> None:
+        """Drop the compiled plans ``element``'s state change can affect
+        and reconcile the head set; affected heads recompile on their
+        next buffer.  Called per element an event traverses (caps
+        renegotiation, custom events) — SCOPED, so an event delivered
+        late on a queue's drain thread does not wipe an upstream
+        segment's plan that will never see another buffer (and unrelated
+        segments never pay a recompile).  ``element=None`` (tracer
+        attach, graph change) drops everything.
+
+        Head-set reconciliation matters because ``plan_step`` answers are
+        state-dependent: an element that could not fuse before
+        negotiation (so its src pad was a head) may be interior to a
+        longer run afterwards — and vice versa."""
+        with self._lock:
+            self.epoch += 1
+            for name, plan in list(self._plans.items()):
+                if element is not None \
+                        and element.name not in plan["elements"] \
+                        and plan["tail"] != element.name \
+                        and plan["_pad"].element is not element:
+                    continue
+                plan["_pad"].__dict__.pop("push", None)
+                del self._plans[name]
+            heads = self._find_heads()
+            live = {p["head"] for p in self._plans.values()}
+            for old in self._heads:
+                if old not in heads and old.full_name not in live:
+                    old.__dict__.pop("push", None)
+            for head in heads:
+                if head.full_name not in live \
+                        and "push" not in head.__dict__:
+                    self._install_stub(head)
+            self._heads = heads
+
+    def rescan(self) -> None:
+        """The graph changed (request pad linked after play): full drop
+        + head-set rebuild."""
+        self.invalidate()
+
+    def plans(self) -> List[Dict]:
+        """Snapshot of the compiled plans (observability / tests / bench):
+        one dict per fused segment with ``head``, ``elements`` (fused
+        element names in order) and ``tail`` (the boundary element the
+        segment pushes into)."""
+        with self._lock:
+            return [{k: v for k, v in p.items() if not k.startswith("_")}
+                    for p in self._plans.values()]
+
+    # -- graph walk ----------------------------------------------------------
+    def _find_heads(self) -> List[Pad]:
+        """Every linked src pad whose owner cannot itself be fused as an
+        intermediate: sources, queues, tees, muxes, sinks of runs, and
+        opt-out elements.  Src pads of fusable linear elements are interior
+        to some other head's run and are never pushed directly."""
+        heads: List[Pad] = []
+        for el in self.pipeline.elements:
+            if _is_linear_fusable(el):
+                continue
+            for pad in el.src_pads:
+                if pad.peer is not None:
+                    heads.append(pad)
+        return heads
+
+    def _walk(self, head: Pad) -> Tuple[List[Tuple[Callable, Element]],
+                                        Optional[Pad]]:
+        """Collect the maximal fusable run downstream of ``head``.
+        Returns (steps, tail sink pad); empty steps = nothing to fuse."""
+        steps: List[Tuple[Callable, Element]] = []
+        pad = head.peer
+        limit = len(self.pipeline.elements)   # cycle guard
+        while pad is not None and len(steps) < limit:
+            el = pad.element
+            if len(el.sink_pads) != 1 or len(el.src_pads) != 1:
+                break
+            fn = el.plan_step()
+            if fn is None:
+                break
+            steps.append((fn, el))
+            pad = el.src_pads[0].peer
+        return steps, pad
+
+    # -- compilation ---------------------------------------------------------
+    def _install_stub(self, head: Pad) -> None:
+        def compile_and_push(buf, _head=head):
+            return self._compile(_head)(buf)
+
+        head.push = compile_and_push
+
+    def _compile(self, head: Pad) -> Callable:
+        """Build (and install) the executor for ``head``.  Runs on the
+        segment's own streaming thread, serialized against invalidation by
+        the planner lock."""
+        with self._lock:
+            steps, tail_pad = self._walk(head)
+            if not steps or tail_pad is None:
+                # nothing fusable downstream: restore interpreted dispatch
+                # for this head (an invalidate re-arms the stub, so a later
+                # renegotiation can still make the run fusable)
+                head.__dict__.pop("push", None)
+                return lambda buf, _h=head: Pad.push(_h, buf)
+            executor = self._make_executor(head, steps, tail_pad)
+            head.push = executor
+            self._plans[head.full_name] = {
+                "head": head.full_name,
+                "elements": [el.name for _, el in steps],
+                "tail": tail_pad.element.name,
+                "epoch": self.epoch,
+                "_pad": head,           # stripped from plans() snapshots
+            }
+            return executor
+
+    def _make_executor(self, head: Pad, steps, tail_pad: Pad) -> Callable:
+        pipeline = self.pipeline
+        tracer = pipeline.tracer
+        tail_entry = tail_pad.element._chain_entry
+        plan = tuple(steps)
+        OK, EOS, ERROR = FlowReturn.OK, FlowReturn.EOS, FlowReturn.ERROR
+        FR = FlowReturn
+
+        if tracer is None:
+            def run(buf, _plan=plan, _head=head, _tail=tail_entry,
+                    _tp=tail_pad):
+                if _head.eos:
+                    return EOS
+                el = None
+                try:
+                    for fn, el in _plan:
+                        out = fn(buf)
+                        if out is None:
+                            return OK
+                        if out.__class__ is FR:
+                            return out
+                        buf = out
+                except Exception as exc:  # noqa: BLE001 — pipeline error
+                    pipeline.post_error(el, exc)
+                    return ERROR
+                return _tail(_tp, buf)
+
+            return run
+
+        def run_traced(buf, _plan=plan, _head=head, _tail=tail_entry,
+                       _tp=tail_pad, _tracer=tracer):
+            if _head.eos:
+                return EOS
+            el = None
+            try:
+                for fn, el in _plan:
+                    _tracer.enter()
+                    try:
+                        out = fn(buf)
+                    finally:
+                        _tracer.exit(el.name)
+                    if out is None:
+                        return OK
+                    if out.__class__ is FR:
+                        return out
+                    buf = out
+            except Exception as exc:  # noqa: BLE001 — pipeline error
+                pipeline.post_error(el, exc)
+                return ERROR
+            return _tail(_tp, buf)
+
+        return run_traced

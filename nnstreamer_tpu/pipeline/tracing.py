@@ -23,6 +23,16 @@ active window) — the proctime/framerate tracer pair.  ``interlatency``
 (source-to-element transit) is derivable from per-element first/last
 timestamps included as ``window_s``.
 
+Fused segment plans (pipeline/schedule.py) keep these semantics exactly:
+a compiled executor calls the same :meth:`Tracer.enter` /
+:meth:`Tracer.exit` pair around each fused step that
+``Element._chain_entry`` uses around ``chain()``, so per-element
+``buffers``/``proctime`` are identical under fusion — and with no tracer
+attached the compiled executor contains NO tracer references at all
+(plans rebuild when ``enable_tracing`` attaches one), which is how
+tracing costs zero calls when off instead of one test per element per
+buffer.
+
 Dataflow-copy observability (the zero-copy hot path's regression gate):
 serialize/convert code reports every payload byte it MATERIALIZES into a
 new host buffer via :func:`record_copy`, and pool acquires report

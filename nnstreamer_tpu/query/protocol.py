@@ -32,6 +32,7 @@ without them is still valid, so the magic is unchanged.
 from __future__ import annotations
 
 import dataclasses
+import os
 import socket
 import struct
 from typing import Any, List, Optional, Sequence
@@ -49,6 +50,12 @@ from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 # desynchronizing the stream.
 MAGIC = 0x4E4E5353  # 'NNSS'
 HEADER = struct.Struct("<IBQQqqII")
+#: upper bound on a wire-declared payload (default 1 GiB, env-overridable):
+#: receives reject anything larger before allocating, so a corrupted
+#: length field cannot OOM the receiver (a 4K RGB uncompressed frame is
+#: ~25 MB; 1 GiB leaves 40x headroom for batched/multi-tensor frames)
+MAX_WIRE_PAYLOAD = int(os.environ.get("NNS_MAX_WIRE_PAYLOAD",
+                                      str(1 << 30)))
 
 T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR, T_PING, T_PONG = \
     1, 2, 3, 4, 5, 6, 7
@@ -299,6 +306,14 @@ def recv_msg(sock: socket.socket,
     magic, typ, cid, seq, pts, epoch, crc, plen = HEADER.unpack(hdr)
     if magic != MAGIC:
         raise ValueError(f"bad magic 0x{magic:08x}")
+    if plen > MAX_WIRE_PAYLOAD:
+        # sanity-bound the wire-declared length BEFORE allocating: a
+        # corrupted header (chaos 'corrupt' mode / bit-flip / malicious
+        # peer) must fail like a CRC mismatch, not as an up-to-4 GiB
+        # upfront bytearray allocation in pool.acquire
+        raise ValueError(
+            f"payload length {plen} exceeds wire bound "
+            f"{MAX_WIRE_PAYLOAD} (corrupt header?)")
     lease = None
     if not plen:
         payload = b""

@@ -162,16 +162,29 @@ class TensorBufferPool:
         self._free_bytes = 0
         self._pending: List[bytearray] = []   # slabs with live views
         self._lock = threading.Lock()
+        # slabs whose reclaim found the lock held (see _reclaim); deque
+        # append/popleft are atomic under the GIL, so __del__ can park
+        # here without taking any lock
+        import collections
+
+        self._deferred: "collections.deque" = collections.deque()
         self.hits = 0
         self.misses = 0
 
     def acquire(self, nbytes: int) -> BufferLease:
         nbytes = int(nbytes)
         with self._lock:
+            self._drain_deferred_locked()
             self._sweep_pending_locked()
             bucket = self._free.get(nbytes)
             if bucket:
                 slab = bucket.pop()
+                if not bucket:
+                    # drop the emptied bucket: variable-size streams must
+                    # not accrete one dict entry per distinct payload size
+                    # (the byte-cap eviction scores empty buckets 0, so
+                    # they would never be evicted)
+                    del self._free[nbytes]
                 self._free_bytes -= nbytes
                 self.hits += 1
                 hit = True
@@ -205,8 +218,11 @@ class TensorBufferPool:
         per-bucket cap and the pool-wide byte cap (evicting the largest
         other bucket once before giving up)."""
         n = len(slab)
-        bucket = self._free.setdefault(n, [])
-        if len(bucket) >= self.max_per_bucket:
+        # look up WITHOUT creating: a cap-rejected retention of a new size
+        # must not leave a permanently-empty bucket behind (empty buckets
+        # score 0 in the eviction key below, so they'd never be evicted)
+        bucket = self._free.get(n)
+        if bucket is not None and len(bucket) >= self.max_per_bucket:
             return
         if self._free_bytes + n > self.max_free_bytes:
             victim = max(self._free, key=lambda s: s * len(self._free[s]),
@@ -216,20 +232,48 @@ class TensorBufferPool:
             self._free_bytes -= victim * len(self._free.pop(victim))
             if self._free_bytes + n > self.max_free_bytes:
                 return
+        if bucket is None:
+            bucket = self._free.setdefault(n, [])
         bucket.append(slab)
         self._free_bytes += n
 
     def _reclaim(self, slab: bytearray) -> None:
-        with self._lock:
+        # non-blocking acquire: _reclaim is reachable from
+        # BufferLease.__del__, and cyclic GC can fire that __del__ on the
+        # very thread currently INSIDE a locked pool section (the lock is
+        # not reentrant — a blocking acquire would self-deadlock).  When
+        # the lock is unavailable, park the slab on the lock-free deferred
+        # queue; the next locked section routes it through _pending.
+        if not self._lock.acquire(blocking=False):
+            self._deferred.append(slab)
+            return
+        try:
             # a live numpy view / memoryview over the slab holds a
             # reference chain to it; recycling now would let the next
             # writer alias it.  Park such slabs; they rejoin the free
             # list once the views die (checked on later acquires).
+            # NOTE: body stays inline — _RECLAIM_BASELINE is calibrated
+            # for exactly this caller-local → param → getrefcount shape.
             if sys.getrefcount(slab) > _RECLAIM_BASELINE:
                 if len(self._pending) < 4 * self.max_per_bucket:
                     self._pending.append(slab)
                 return
             self._retain_free_locked(slab)
+        finally:
+            self._lock.release()
+
+    def _drain_deferred_locked(self) -> None:
+        """Move lock-contended reclaims into the pending list: the sweep
+        that follows applies its own calibrated view-aliasing check, so
+        deferred slabs take the conservative park-then-sweep route
+        instead of re-deriving a refcount baseline for this call shape."""
+        while True:
+            try:
+                slab = self._deferred.popleft()
+            except IndexError:
+                return
+            if len(self._pending) < 4 * self.max_per_bucket:
+                self._pending.append(slab)
 
     @property
     def stats(self) -> Dict[str, int]:

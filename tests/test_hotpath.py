@@ -466,8 +466,26 @@ def test_hotpath_bench_copy_gate():
     tier-1 red here, not in a quarterly bench capture."""
     tool = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "tools", "hotpath_bench.py")
-    r = subprocess.run([sys.executable, tool, "--assert"],
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "serialize"],
                        capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, (
         f"copy gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
     assert '"hotpath_copy_gate"' in r.stdout
+
+
+@pytest.mark.perf
+def test_hotpath_bench_dispatch_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage dispatch fails
+    when the segment compiler stops fusing a linear identity chain or
+    when fused dispatch loses its >=2x per-element overhead win over
+    interpreted Pad.push dispatch (measured margin ~5-13x, so the gate
+    trips on a real scheduling regression, not machine noise)."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "dispatch"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (
+        f"dispatch gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_dispatch_gate"' in r.stdout

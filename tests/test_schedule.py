@@ -1,0 +1,633 @@
+"""Pipeline execution engine v2: fused-segment scheduler + parallel
+tensor_filter workers.
+
+The segment compiler (pipeline/schedule.py) flattens maximal linear
+element runs into per-head dispatch plans at play(); these tests pin its
+CORRECTNESS contract — identical dataflow, ordering, EOS and error
+semantics as interpreted dispatch — plus plan lifecycle (lazy compile,
+invalidation on renegotiation, rescan on link-after-play) and the
+``tensor_filter workers=N`` ordered parallel invoke pool.  The perf claim
+itself is gated by ``tools/hotpath_bench.py --assert --stage dispatch``
+(see test_hotpath.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.pipeline.element import CapsEvent
+from nnstreamer_tpu.pipeline.graph import Pipeline
+from nnstreamer_tpu.tensor.buffer import TensorBuffer
+from nnstreamer_tpu.tensor.info import TensorInfo, TensorsInfo
+from nnstreamer_tpu.tensor.types import TensorType
+
+CAPS4 = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+         "types=float32,framerate=0/1")
+CAPS8 = ("other/tensors,format=static,num_tensors=1,dimensions=8,"
+         "types=float32,framerate=0/1")
+
+
+def _feed(src, n, dim=4):
+    for i in range(n):
+        src.push_buffer(TensorBuffer(
+            tensors=[np.full(dim, i, np.float32)], pts=i))
+
+
+def _collector(p, name="out"):
+    got = []
+    p.get(name).connect("new-data", lambda b: got.append(b))
+    return got
+
+
+class TestSegmentFusion:
+    def test_linear_chain_fuses_and_flows(self):
+        p = parse_launch(f"appsrc caps={CAPS4} name=in ! identity ! "
+                         "identity ! identity ! tensor_sink name=out")
+        got = _collector(p)
+        p.play()
+        _feed(p.get("in"), 10)
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        plans = p.planner.plans()
+        p.stop()
+        assert [b.pts for b in got] == list(range(10))
+        (plan,) = [pl for pl in plans if pl["head"] == "in.src"]
+        assert len(plan["elements"]) == 3
+        assert plan["tail"] == "out"
+
+    def test_queue_is_a_segment_boundary(self):
+        """A queue decouples streaming threads: fused runs stop at its
+        sink pad and a NEW run heads at its src pad."""
+        p = parse_launch(f"appsrc caps={CAPS4} name=in ! identity ! "
+                         "identity ! queue name=q ! identity ! identity ! "
+                         "tensor_sink name=out")
+        got = _collector(p)
+        p.play()
+        _feed(p.get("in"), 16)
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        plans = {pl["head"]: pl for pl in p.planner.plans()}
+        p.stop()
+        assert [b.pts for b in got] == list(range(16))
+        assert plans["in.src"]["tail"] == "q"
+        assert len(plans["in.src"]["elements"]) == 2
+        assert plans["q.src"]["tail"] == "out"
+        assert len(plans["q.src"]["elements"]) == 2
+
+    def test_tee_branches_head_their_own_segments(self):
+        p = parse_launch(
+            f"appsrc caps={CAPS4} name=in ! identity ! tee name=t "
+            "t. ! identity ! tensor_sink name=a "
+            "t. ! identity ! identity ! tensor_sink name=b")
+        got_a, got_b = _collector(p, "a"), _collector(p, "b")
+        p.play()
+        _feed(p.get("in"), 8)
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        plans = {pl["head"]: pl for pl in p.planner.plans()}
+        p.stop()
+        assert [b.pts for b in got_a] == list(range(8))
+        assert [b.pts for b in got_b] == list(range(8))
+        assert plans["in.src"]["tail"] == "t"
+        tee_heads = [h for h in plans if h.startswith("t.")]
+        assert len(tee_heads) == 2
+        assert {plans[h]["tail"] for h in tee_heads} == {"a", "b"}
+
+    def test_mux_is_a_boundary_and_heads_downstream_run(self):
+        p = parse_launch(
+            "tensor_mux name=mux sync-mode=nosync ! identity ! identity ! "
+            "tensor_sink name=out "
+            f"appsrc name=s1 caps={CAPS4} ! mux.sink_0 "
+            f"appsrc name=s2 caps={CAPS4} ! mux.sink_1")
+        got = _collector(p)
+        p.play()
+        _feed(p.get("s1"), 6)
+        _feed(p.get("s2"), 6)
+        p.get("s1").end_of_stream()
+        p.get("s2").end_of_stream()
+        p.wait(timeout=30)
+        plans = {pl["head"]: pl for pl in p.planner.plans()}
+        p.stop()
+        assert len(got) == 6
+        assert "mux.src" in plans and plans["mux.src"]["tail"] == "out"
+        # mux has two sink pads: it must never appear INSIDE a plan
+        for pl in plans.values():
+            assert "mux" not in pl["elements"]
+
+    def test_tensor_filter_fuses_on_per_frame_path(self):
+        info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))])
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+
+        register_custom_easy("sched_x3", lambda ins: [ins[0] * 3.0],
+                             info, info)
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS4} name=in ! identity ! tensor_filter "
+                "framework=custom-easy model=sched_x3 name=f ! identity ! "
+                "tensor_sink name=out")
+            got = _collector(p)
+            p.play()
+            _feed(p.get("in"), 6)
+            p.get("in").end_of_stream()
+            p.wait(timeout=30)
+            plans = {pl["head"]: pl for pl in p.planner.plans()}
+            p.stop()
+        finally:
+            unregister_custom_easy("sched_x3")
+        assert len(got) == 6
+        for b in got:
+            np.testing.assert_allclose(np.asarray(b.tensors[0]),
+                                       np.full(4, b.pts * 3.0))
+        assert plans["in.src"]["elements"][1] == "f"
+        assert len(plans["in.src"]["elements"]) == 3
+
+    def test_no_fuse_pipeline_has_no_planner(self):
+        p = parse_launch(f"appsrc caps={CAPS4} name=in ! identity ! "
+                         "tensor_sink name=out", Pipeline(fuse=False))
+        got = _collector(p)
+        p.play()
+        assert p.planner is None
+        _feed(p.get("in"), 4)
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        p.stop()
+        assert len(got) == 4
+
+    def test_eos_ordering_through_fused_segments(self):
+        """Every buffer pushed before end_of_stream() arrives before the
+        sink observes EOS — fusion must not reorder data vs events."""
+        p = parse_launch(f"appsrc caps={CAPS4} name=in ! identity ! "
+                         "identity ! queue ! identity ! "
+                         "tensor_sink name=out")
+        sink = p.get("out")
+        seen_at_eos = []
+        orig = sink.post_eos_reached
+
+        def probe():
+            seen_at_eos.append(len(sink.results))
+            orig()
+
+        sink.post_eos_reached = probe
+        p.play()
+        _feed(p.get("in"), 25)
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        p.stop()
+        assert seen_at_eos == [25]
+        assert [b.pts for b in sink.results] == list(range(25))
+
+    def test_error_in_fused_step_posts_pipeline_error(self):
+        from nnstreamer_tpu.pipeline.graph import PipelineError
+
+        info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))])
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+
+        def boom(ins):
+            raise RuntimeError("fused boom")
+
+        register_custom_easy("sched_boom", boom, info, info)
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS4} name=in ! identity ! tensor_filter "
+                "framework=custom-easy model=sched_boom name=f ! "
+                "tensor_sink name=out")
+            p.play()
+            _feed(p.get("in"), 1)
+            with pytest.raises(PipelineError) as ei:
+                p.wait(timeout=30)
+            assert ei.value.element.name == "f"
+            p.stop()
+        finally:
+            unregister_custom_easy("sched_boom")
+
+    def test_traced_fused_proctime_matches_interpreted_counters(self):
+        """With a tracer attached, fused segments report the same
+        per-element buffers counters as interpreted dispatch."""
+        reports = {}
+        for fuse in (True, False):
+            p = parse_launch(
+                f"appsrc caps={CAPS4} name=in ! identity name=i1 ! "
+                "identity name=i2 ! tensor_sink name=out",
+                Pipeline(fuse=fuse))
+            tracer = p.enable_tracing()
+            p.play()
+            _feed(p.get("in"), 12)
+            p.get("in").end_of_stream()
+            p.wait(timeout=30)
+            p.stop()
+            reports[fuse] = tracer.report()
+        for name in ("i1", "i2", "out"):
+            assert reports[True][name]["buffers"] == 12
+            assert reports[True][name]["buffers"] == \
+                reports[False][name]["buffers"]
+            assert reports[True][name]["proctime_ms"] >= 0
+
+
+class TestPlanLifecycle:
+    def test_renegotiation_invalidates_and_rebuilds(self):
+        p = parse_launch(f"appsrc caps={CAPS4} name=in ! identity ! "
+                         "identity ! tensor_sink name=out")
+        got = _collector(p)
+        p.play()
+        src = p.get("in")
+        _feed(src, 3, dim=4)
+        epoch_before = None
+
+        # sample the epoch once steady state is reached
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            plans = p.planner.plans()
+            if plans:
+                epoch_before = plans[0]["epoch"]
+                break
+            time.sleep(0.005)
+        assert epoch_before is not None
+
+        from nnstreamer_tpu.pipeline.caps import Caps
+
+        src.push_event(CapsEvent(Caps.from_string(CAPS8)))   # in-band
+        _feed(src, 3, dim=8)
+        src.end_of_stream()
+        p.wait(timeout=30)
+        plans_after = p.planner.plans()
+        epoch_after = max(pl["epoch"] for pl in plans_after)
+        p.stop()
+        assert len(got) == 6
+        assert [b.tensors[0].shape for b in got] == [(4,)] * 3 + [(8,)] * 3
+        assert epoch_after > epoch_before
+
+    def test_request_pad_link_after_play_rescans(self):
+        p = parse_launch(
+            f"appsrc caps={CAPS4} name=in ! identity ! tee name=t "
+            "t. ! identity ! tensor_sink name=a")
+        got_a = _collector(p, "a")
+        p.play()
+        src = p.get("in")
+        _feed(src, 3)
+        deadline = time.monotonic() + 10    # pre-link frames must drain
+        while len(got_a) < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(got_a) == 3
+        epoch0 = p.planner.epoch
+
+        # grow a second branch mid-stream (GStreamer request-pad role)
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.elements.misc import Identity
+
+        ident, sink_b = p.add(Identity("ib"), TensorSink("b"))
+        ident.start()
+        sink_b.start()
+        ident._started = sink_b._started = True
+        p.link(p.get("t"), ident, sink_b)
+        assert p.planner.epoch > epoch0     # link triggered a rescan
+
+        for i in range(3, 6):
+            src.push_buffer(TensorBuffer(
+                tensors=[np.full(4, i, np.float32)], pts=i))
+        src.end_of_stream()
+        p.wait(timeout=30)
+        plans = {pl["head"]: pl for pl in p.planner.plans()}
+        p.stop()
+        assert [b.pts for b in got_a] == list(range(6))
+        # the new branch saw only post-link frames, through its own plan
+        assert [b.pts for b in sink_b.results] == [3, 4, 5]
+        new_heads = [h for h in plans if h.startswith("t.")]
+        assert len(new_heads) == 2
+
+    def test_stop_restores_interpreted_dispatch(self):
+        p = parse_launch(f"appsrc caps={CAPS4} name=in ! identity ! "
+                         "tensor_sink name=out")
+        p.play()
+        _feed(p.get("in"), 2)
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        heads = [pad for el in p.elements for pad in el.src_pads]
+        assert any("push" in pad.__dict__ for pad in heads)
+        p.stop()
+        assert p.planner is None
+        assert all("push" not in pad.__dict__ for pad in heads)
+
+
+class TestTeeSatellites:
+    def test_last_branch_gets_original_wrapper(self):
+        p = parse_launch(
+            f"appsrc caps={CAPS4} name=in ! tee name=t "
+            "t. ! tensor_sink name=a t. ! tensor_sink name=b")
+        p.play()
+        buf = TensorBuffer(tensors=[np.zeros(4, np.float32)], pts=0)
+        p.get("in").push_buffer(buf)
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        a, b = p.get("a").results, p.get("b").results
+        p.stop()
+        assert b[0] is buf          # last live branch: no copy
+        assert a[0] is not buf      # earlier branches: fresh wrapper
+        assert a[0].tensors[0] is buf.tensors[0]   # payload still shared
+
+    def test_eos_branch_is_not_reoffered(self):
+        p = parse_launch(
+            f"appsrc caps={CAPS4} name=in ! tee name=t "
+            "t. ! tensor_sink name=a t. ! tensor_sink name=b")
+        p.play()
+        src, tee = p.get("in"), p.get("t")
+        src.push_buffer(TensorBuffer(
+            tensors=[np.zeros(4, np.float32)], pts=0))
+
+        def _await(cond, timeout=10.0):
+            end = time.monotonic() + timeout
+            while time.monotonic() < end:
+                if cond():
+                    return True
+                time.sleep(0.005)
+            return False
+
+        assert _await(lambda: len(p.get("a").results) == 1)
+        # branch a refuses further dataflow (its pad saw EOS)
+        pad_a = [sp for sp in tee.src_pads
+                 if sp.peer.element.name == "a"][0]
+        pad_a.eos = True
+        src.push_buffer(TensorBuffer(
+            tensors=[np.zeros(4, np.float32)], pts=1))   # marks branch done
+        src.push_buffer(TensorBuffer(
+            tensors=[np.zeros(4, np.float32)], pts=2))
+        src.end_of_stream()
+        p.get("b").wait_eos(timeout=10)
+        assert _await(lambda: len(p.get("b").results) == 3)
+        assert pad_a in tee._done
+        assert len(p.get("a").results) == 1
+        p.stop()
+
+
+class TestWaitErrorSatellite:
+    def test_repeated_wait_raises_fresh_chained_copies(self):
+        from nnstreamer_tpu.pipeline.graph import PipelineError
+
+        info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))])
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+
+        def boom(ins):
+            raise ValueError("wait boom")
+
+        register_custom_easy("sched_wait_boom", boom, info, info)
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS4} name=in ! tensor_filter "
+                "framework=custom-easy model=sched_wait_boom ! "
+                "tensor_sink name=out")
+            p.play()
+            _feed(p.get("in"), 1)
+            errs = []
+            for _ in range(2):
+                with pytest.raises(PipelineError) as ei:
+                    p.wait(timeout=30)
+                errs.append(ei.value)
+            p.stop()
+        finally:
+            unregister_custom_easy("sched_wait_boom")
+        assert errs[0] is not errs[1]          # fresh copy per wait()
+        assert errs[0] is not p._error and errs[1] is not p._error
+        assert errs[0].__cause__ is p._error   # chained to the original
+        assert type(errs[0].cause) is ValueError
+        # the stored error's traceback was never touched by the re-raises
+        assert p._error.__traceback__ is None
+
+
+class TestFilterWorkers:
+    def _register_slow(self, name, sleep_lo=0.004, sleep_hi=0.02):
+        import random
+
+        info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))])
+        rng = random.Random(1234)
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy)
+
+        def slow(ins):
+            time.sleep(rng.uniform(sleep_lo, sleep_hi))
+            return [np.asarray(ins[0]) * 2.0]
+
+        register_custom_easy(name, slow, info, info)
+
+    def _run(self, model, workers, n):
+        p = parse_launch(
+            f"appsrc caps={CAPS4} name=in ! tensor_filter "
+            f"framework=custom-easy model={model} workers={workers} "
+            "name=f ! tensor_sink name=out")
+        got = _collector(p)
+        p.play()
+        t0 = time.perf_counter()
+        _feed(p.get("in"), n)
+        p.get("in").end_of_stream()
+        p.wait(timeout=120)
+        dt = time.perf_counter() - t0
+        p.stop()
+        return got, dt
+
+    def test_ordering_exact_under_jittered_invoke_latency(self):
+        from nnstreamer_tpu.filter.backends.custom import (
+            unregister_custom_easy)
+
+        self._register_slow("sched_jitter")
+        try:
+            got, _ = self._run("sched_jitter", workers=4, n=40)
+        finally:
+            unregister_custom_easy("sched_jitter")
+        assert [b.pts for b in got] == list(range(40))
+        for b in got:
+            np.testing.assert_allclose(np.asarray(b.tensors[0]),
+                                       np.full(4, b.pts * 2.0))
+
+    def test_workers2_beats_workers1_wallclock(self):
+        """CPU invoke-bound stream: two workers overlap invokes (the
+        sleep stands in for a GIL-releasing model) and must win
+        wall-clock while the ordered pusher keeps exact sequence."""
+        from nnstreamer_tpu.filter.backends.custom import (
+            unregister_custom_easy)
+
+        self._register_slow("sched_wall", sleep_lo=0.01, sleep_hi=0.01)
+        try:
+            # min-of-2 per config: the serial floor is 30*10ms = 300 ms
+            # and two workers halve it, but a loaded CI host can stall
+            # either run — the min filters one bad sample per side
+            runs1 = [self._run("sched_wall", workers=1, n=30)
+                     for _ in range(2)]
+            runs2 = [self._run("sched_wall", workers=2, n=30)
+                     for _ in range(2)]
+        finally:
+            unregister_custom_easy("sched_wall")
+        for got, _ in runs1 + runs2:
+            assert [b.pts for b in got] == list(range(30))
+        t1 = min(t for _, t in runs1)
+        t2 = min(t for _, t in runs2)
+        assert t2 < t1 * 0.8, (t1, t2)
+
+    def test_workers_share_threadsafe_backend_instance(self):
+        p = parse_launch(
+            f"appsrc caps={CAPS4} name=in ! tensor_filter framework=dummy "
+            "input-dim=4 input-type=float32 output-dim=4 "
+            "output-type=float32 workers=3 name=f ! tensor_sink name=out")
+        got = _collector(p)
+        p.play()
+        f = p.get("f")
+        assert f._workers_n == 3
+        assert all(fw is f.fw for fw in f._wk_backends)   # shared: 1 open
+        _feed(p.get("in"), 9)
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        p.stop()
+        assert [b.pts for b in got] == list(range(9))
+
+    def test_workers_get_private_instances_for_unsafe_backend(self):
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+
+        info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))])
+        register_custom_easy("sched_unsafe", lambda ins: [ins[0] + 1.0],
+                             info, info)
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS4} name=in ! tensor_filter "
+                "framework=custom-easy model=sched_unsafe workers=2 "
+                "name=f ! tensor_sink name=out")
+            got = _collector(p)
+            p.play()
+            f = p.get("f")
+            assert f._workers_n == 2
+            others = [fw for fw in f._wk_backends if fw is not f.fw]
+            assert len(others) == 1 and others[0].opened
+            _feed(p.get("in"), 6)
+            p.get("in").end_of_stream()
+            p.wait(timeout=30)
+            p.stop()
+            assert not others[0].opened        # private instance closed
+        finally:
+            unregister_custom_easy("sched_unsafe")
+        assert [b.pts for b in got] == list(range(6))
+
+    def test_workers_forced_serial_with_batching(self):
+        """batch>1 already overlaps dispatch via inflight: workers must
+        degrade to 1 (documented interaction), not fight the coalescer."""
+        pytest.importorskip("jax")
+        from nnstreamer_tpu.models.registry import (_MODELS, Model,
+                                                    register_model)
+
+        import jax.numpy as jnp
+
+        w = np.eye(4, dtype=np.float32)
+
+        def build(custom):
+            def forward(params, x):
+                return (jnp.asarray(x, jnp.float32) @ params,)
+
+            return Model(name="sched_tiny", forward=forward, params=w,
+                         in_info=TensorsInfo(
+                             [TensorInfo(TensorType.FLOAT32, (4,))]),
+                         out_info=TensorsInfo(
+                             [TensorInfo(TensorType.FLOAT32, (4,))]))
+
+        register_model("sched_tiny")(build)
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS4} name=in ! tensor_filter "
+                "framework=xla model=sched_tiny batch=4 workers=8 name=f "
+                "! tensor_sink name=out")
+            got = _collector(p)
+            p.play()
+            assert p.get("f")._workers_n == 1
+            _feed(p.get("in"), 8)
+            p.get("in").end_of_stream()
+            p.wait(timeout=60)
+            p.stop()
+        finally:
+            _MODELS.pop("sched_tiny", None)
+        assert [b.pts for b in got] == list(range(8))
+
+    def test_worker_error_posts_pipeline_error(self):
+        from nnstreamer_tpu.pipeline.graph import PipelineError
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+
+        info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))])
+        calls = []
+
+        def flaky(ins):
+            calls.append(1)
+            if len(calls) == 3:
+                raise RuntimeError("worker boom")
+            return [np.asarray(ins[0])]
+
+        register_custom_easy("sched_flaky", flaky, info, info)
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS4} name=in ! tensor_filter "
+                "framework=custom-easy model=sched_flaky workers=2 "
+                "name=f ! tensor_sink name=out")
+            p.play()
+            _feed(p.get("in"), 8)
+            with pytest.raises(PipelineError) as ei:
+                p.wait(timeout=30)
+            assert ei.value.element.name == "f"
+            p.stop()
+        finally:
+            unregister_custom_easy("sched_flaky")
+
+
+class TestEventDrivenWakeups:
+    def test_appsrc_idle_is_blocking_not_polling(self):
+        """create() blocks on the fifo (no 0.1 s poll): an idle pipeline
+        stops and joins promptly via the wake sentinel."""
+        p = parse_launch(f"appsrc caps={CAPS4} name=in ! "
+                         "tensor_sink name=out")
+        p.play()
+        src = p.get("in")
+        time.sleep(0.05)            # source thread parked in fifo.get()
+        t0 = time.perf_counter()
+        p.stop()
+        assert time.perf_counter() - t0 < 5.0
+        assert not src._thread.is_alive()
+
+    def test_queue_full_producer_wakes_on_drain(self):
+        """A producer blocked on a full queue resumes as soon as the
+        drain frees a slot — no timeout tick involved."""
+        p = parse_launch(f"appsrc caps={CAPS4} name=in ! "
+                         "queue max-size-buffers=2 ! identity sleep-us=2000"
+                         " ! tensor_sink name=out")
+        got = _collector(p)
+        p.play()
+        _feed(p.get("in"), 20)
+        p.get("in").end_of_stream()
+        p.wait(timeout=30)
+        p.stop()
+        assert [b.pts for b in got] == list(range(20))
+
+    def test_queue_producer_unblocks_when_downstream_errors(self):
+        from nnstreamer_tpu.pipeline.graph import PipelineError
+        from nnstreamer_tpu.filter.backends.custom import (
+            register_custom_easy, unregister_custom_easy)
+
+        info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (4,))])
+
+        def boom(ins):
+            time.sleep(0.01)
+            raise RuntimeError("drain boom")
+
+        register_custom_easy("sched_qboom", boom, info, info)
+        try:
+            p = parse_launch(
+                f"appsrc caps={CAPS4} name=in ! queue max-size-buffers=2 "
+                "! tensor_filter framework=custom-easy model=sched_qboom "
+                "! tensor_sink name=out")
+            p.play()
+            _feed(p.get("in"), 40)
+            p.get("in").end_of_stream()
+            with pytest.raises(PipelineError):
+                p.wait(timeout=30)
+            t0 = time.perf_counter()
+            p.stop()
+            assert time.perf_counter() - t0 < 10.0
+        finally:
+            unregister_custom_easy("sched_qboom")

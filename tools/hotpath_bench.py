@@ -13,16 +13,25 @@ time, with no model in the loop:
   - ``wire``:      TCP-loopback frame round trip through
                    ``send_tensors`` / ``recv_msg(pool=...)``;
   - ``shm``:       shared-memory ring round trip through
-                   ``push_parts`` / ``pop_into``.
+                   ``push_parts`` / ``pop_into``;
+  - ``dispatch``:  per-frame per-element graph-dispatch overhead — a
+                   5-element identity chain under the fused segment
+                   plan (pipeline/schedule.py) vs interpreted
+                   ``Pad.push → _chain_entry → chain`` dispatch, with
+                   an empty chain as the transport baseline.
 
 Prints ONE JSON line per stage (schema mirrors bench.py).
 
-``--assert`` is the copy-regression gate (tier-1 ``perf`` smoke): it
-fails (exit 1) when the serialize path materializes more than the
-frame's header budget — 48 B wire header + 4 B count + 128 B meta per
-tensor.  A re-introduced ``tobytes``/``b"".join`` on the hot path trips
-it immediately; it is NOT an fps gate (timings vary with the host, copy
-counts do not).
+``--assert`` is the regression gate (tier-1 ``perf`` smoke):
+
+- the COPY gate fails (exit 1) when the serialize path materializes
+  more than the frame's header budget — 48 B wire header + 4 B count +
+  128 B meta per tensor.  A re-introduced ``tobytes``/``b"".join`` on
+  the hot path trips it immediately;
+- the DISPATCH gate (``--assert --stage dispatch``; bare ``--assert``
+  runs both) fails when the segment compiler no longer fuses the
+  identity chain, or when fused per-element overhead is no longer at
+  least 2x below interpreted dispatch (min-of-3 timing).
 """
 
 import argparse
@@ -182,6 +191,90 @@ def bench_shm(frames: int) -> dict:
             "frames": frames}
 
 
+DISPATCH_CAPS = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+                 "types=float32,framerate=0/1")
+
+
+def _dispatch_run(n_idents: int, fuse: bool, frames: int):
+    """One identity-chain run: pre-fill appsrc, time play→EOS.  Returns
+    (seconds, compiled plans snapshot)."""
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+
+    mid = "identity ! " * n_idents
+    p = parse_launch(
+        f"appsrc caps={DISPATCH_CAPS} name=in ! {mid}"
+        "tensor_sink name=out collect=false",
+        Pipeline(fuse=fuse))
+    src = p.get("in")
+    buf = TensorBuffer(tensors=[np.zeros(4, np.float32)], pts=0)
+    for _ in range(frames):
+        src.push_buffer(buf)
+    src.end_of_stream()
+    t0 = time.perf_counter()
+    p.play()
+    p.wait(timeout=120)
+    dt = time.perf_counter() - t0
+    plans = p.planner.plans() if p.planner is not None else []
+    p.stop()
+    return dt, plans
+
+
+def _dispatch_measure(frames: int, n: int = 5, reps: int = 3):
+    """min-of-reps timings for baseline (empty chain), fused, interpreted;
+    returns (fused_ns_per_elem, interp_ns_per_elem, plans)."""
+    base = min(_dispatch_run(0, False, frames)[0] for _ in range(reps))
+    plans = None
+    fused = None
+    for _ in range(reps):
+        dt, pl = _dispatch_run(n, True, frames)
+        if fused is None or dt < fused:
+            fused, plans = dt, pl
+    interp = min(_dispatch_run(n, False, frames)[0] for _ in range(reps))
+    per = 1e9 / frames / n
+    fused_ns = max((fused - base) * per, 0.001)
+    interp_ns = max((interp - base) * per, 0.001)
+    return fused_ns, interp_ns, plans
+
+
+def bench_dispatch(frames: int) -> dict:
+    frames = max(frames, 1500)
+    fused_ns, interp_ns, plans = _dispatch_measure(frames)
+    fused_elems = max((len(p["elements"]) for p in plans), default=0)
+    return {"metric": "hotpath_dispatch_ns_per_elem",
+            "value": round(fused_ns, 1), "unit": "ns/frame/elem_fused",
+            "interp_ns_per_elem": round(interp_ns, 1),
+            "ratio": round(interp_ns / fused_ns, 2),
+            "fused_elements": fused_elems, "frames": frames}
+
+
+def run_assert_dispatch() -> int:
+    """Dispatch-regression gate: the segment compiler must fuse the
+    5-identity chain into one plan, and fused per-element overhead must
+    stay >= 2x below interpreted dispatch (min-of-3; the measured margin
+    is ~5-10x, so 2x trips on a real regression, not scheduler noise)."""
+    failures = []
+    fused_ns, interp_ns, plans = _dispatch_measure(1500)
+    runs = [p for p in plans if len(p["elements"]) == 5]
+    if not runs:
+        failures.append(
+            f"segment compiler did not fuse the 5-identity chain "
+            f"(plans: {plans})")
+    ratio = interp_ns / fused_ns
+    if ratio < 2.0:
+        failures.append(
+            f"fused dispatch only {ratio:.2f}x below interpreted "
+            f"({fused_ns:.0f} vs {interp_ns:.0f} ns/frame/elem): "
+            "per-element overhead is back on the fused path")
+    result = {"metric": "hotpath_dispatch_gate", "unit": "ok",
+              "value": 0 if failures else 1,
+              "fused_ns_per_elem": round(fused_ns, 1),
+              "interp_ns_per_elem": round(interp_ns, 1),
+              "ratio": round(ratio, 2), "failures": failures}
+    print(json.dumps(result), flush=True)
+    return 1 if failures else 0
+
+
 def run_assert() -> int:
     """Copy-regression gate: serialize + wire-send must stay within the
     header budget per frame (zero full-tensor-payload copies)."""
@@ -189,12 +282,14 @@ def run_assert() -> int:
     budget = _budget(buf)
     failures = []
 
+    from nnstreamer_tpu.tensor.meta import META_HEADER_SIZE
+
     with copy_probe() as probe:
         parts = protocol.tensor_parts(buf)
     total = sum(len(p) if isinstance(p, bytes) else p.nbytes
                 for p in parts)
     expect = 4 + sum(t.nbytes for t in buf.tensors) \
-        + 128 * buf.num_tensors
+        + META_HEADER_SIZE * buf.num_tensors
     if total != expect:
         failures.append(f"tensor_parts framed {total} B, want {expect}")
     if probe.bytes_copied > budget:
@@ -242,16 +337,24 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--frames", type=int, default=200)
     ap.add_argument("--stage", choices=["pool", "serialize", "wire", "shm",
-                                        "all"], default="all")
+                                        "dispatch", "all"], default="all")
     ap.add_argument("--assert", dest="assert_gate", action="store_true",
-                    help="copy-regression gate (exit 1 when the "
-                         "serialize path copies more than the header "
-                         "budget)")
+                    help="regression gates (exit 1): copy gate (serialize "
+                         "path must stay within the header budget) and "
+                         "dispatch gate (segment fusion must hold its "
+                         ">=2x per-element overhead win); --stage "
+                         "narrows to one gate")
     args = ap.parse_args()
     if args.assert_gate:
-        return run_assert()
+        rc = 0
+        if args.stage in ("all", "pool", "serialize", "wire", "shm"):
+            rc |= run_assert()
+        if args.stage in ("all", "dispatch"):
+            rc |= run_assert_dispatch()
+        return rc
     stages = {"pool": bench_pool, "serialize": bench_serialize,
-              "wire": bench_wire, "shm": bench_shm}
+              "wire": bench_wire, "shm": bench_shm,
+              "dispatch": bench_dispatch}
     picks = stages if args.stage == "all" else {args.stage:
                                                stages[args.stage]}
     for fn in picks.values():
