@@ -1,0 +1,33 @@
+"""Correctness tooling for the fused parallel core.
+
+Three layers, one goal — find pipeline and concurrency bugs BEFORE they
+surface as a rare hang or a silently corrupted frame:
+
+- :mod:`~nnstreamer_tpu.analysis.verify` — static pipeline verifier.
+  Walks the pad graph of a constructed (not yet playing) pipeline and
+  reports caps incompatibilities, dataflow cycles that deadlock, dead
+  branches, and scheduler misconfigurations with element-path
+  diagnostics.  Runs automatically at ``Pipeline.play()`` (gate:
+  ``NNS_VERIFY=0`` disables) and from ``launch.py --check``.
+- :mod:`~nnstreamer_tpu.analysis.lockorder` — the package's DECLARED
+  lock hierarchy.  One canonical acquisition order for every lock class
+  in the codebase; both the static lint (``tools/nnslint.py``) and the
+  runtime sanitizer check real acquisitions against it.
+- :mod:`~nnstreamer_tpu.analysis.sanitizer` — runtime sanitizer, on
+  under ``NNS_DEBUG=1`` (or :func:`sanitizer.enable` in tests).
+  Instruments lock acquisition into a per-thread graph and reports
+  potential-deadlock cycles and hierarchy inversions with both stacks;
+  its :class:`BufferLease` aliasing checker catches writes to pooled
+  slabs that still have live shared views.
+
+The NNStreamer papers' core claim (arXiv:1901.04985, arXiv:2101.06371)
+is that the stream paradigm lets pipeline correctness be checked before
+data flows; this package is that claim applied to our own reproduction,
+including the concurrency machinery (worker pools, fused segments,
+zero-copy leases) the papers' GStreamer substrate got for free.
+"""
+
+from . import lockorder, sanitizer  # noqa: F401  (verify imports pipeline
+#                                     modules; keep it lazy to avoid cycles)
+
+__all__ = ["lockorder", "sanitizer"]

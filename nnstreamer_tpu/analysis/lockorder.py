@@ -1,0 +1,91 @@
+"""Declared lock hierarchy for the whole package.
+
+Every lock in the codebase belongs to a named CLASS of locks (all
+per-connection send locks are one class, every queue's slot condition is
+one class, ...).  The hierarchy assigns each class a rank; the invariant
+is:
+
+    A thread holding a lock of rank R may only acquire locks of rank
+    strictly GREATER than R (same-rank re-acquisition is allowed only
+    for classes in SAME_NAME_OK, where distinct instances nest strictly
+    along the dataflow DAG and therefore cannot invert).
+
+Ranks encode the real acquisition chains of the streaming hot path: a
+``tensor_filter`` deadline flush pushes downstream while holding its
+coalesce lock, so everything a downstream ``chain()`` can take — queue
+slot conditions, collectpads, send locks, the buffer pool, the tracer,
+the pipeline state condition — must rank above ``filter.coalesce``.
+
+Creation sites register by name through
+:func:`nnstreamer_tpu.analysis.sanitizer.make_lock` /
+``make_rlock`` / ``make_condition``; ``tools/nnslint.py`` resolves the
+same names statically from those calls, so the static checker and the
+runtime sanitizer enforce one registry.  Adding a lock to the codebase
+means adding (or reusing) a class here — an unranked name is itself a
+lint warning.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+#: name -> rank.  Lower rank = acquired earlier (outermost).  Gaps are
+#: deliberate: new classes slot in without renumbering.
+HIERARCHY: Dict[str, int] = {
+    # scheduling layer -----------------------------------------------------
+    "planner": 10,          # SegmentPlanner._lock (plan compile/invalidate)
+    "element": 20,          # Element._lock (per-element state guard)
+    "filter.coalesce": 30,  # tensor_filter micro-batch coalescer
+    "filter.workers": 32,   # tensor_filter worker-pool condition
+    # thread boundaries ----------------------------------------------------
+    "queue.space": 40,      # Queue slot condition (bounded-buffer wait)
+    "collectpads": 42,      # mux/merge N-pad sync engine
+    "repo": 44,             # tensor_repo slot/caps table
+    "shm.ring": 46,         # shm ring local wakeup condition
+    # query / transport layer ----------------------------------------------
+    "query.registry": 50,   # server/broker connection registries
+    "query.client": 52,     # FailoverConnection endpoint state
+    "query.send": 60,       # per-connection/stream send locks
+    # observability / memory -----------------------------------------------
+    "tracer": 70,           # Tracer stats table
+    "pool": 80,             # TensorBufferPool free lists
+    "lease": 85,            # BufferLease refcount
+    "pipeline.state": 90,   # Pipeline error/EOS condition (post_error
+    #                         is reachable from under most of the above)
+    "leaf": 95,             # one-shot module registries (default pool,
+    #                         server/broker tables, native loader, conf)
+}
+
+#: classes whose distinct INSTANCES may nest (always along the dataflow
+#: DAG, upstream instance acquired first — a reverse edge would need a
+#: dataflow cycle, which the static verifier rejects as an error).
+SAME_NAME_OK = frozenset({
+    "element", "filter.coalesce", "filter.workers", "queue.space",
+    "collectpads", "repo", "shm.ring", "query.send", "lease",
+    "pipeline.state",
+})
+
+
+def rank_of(name: str) -> Optional[int]:
+    """Rank of a lock class, or None when unregistered (unregistered
+    locks are exempt from ordering checks but reported by the lint)."""
+    return HIERARCHY.get(name)
+
+
+def check_order(held_name: str, acquiring_name: str) -> Optional[str]:
+    """Return a violation description when acquiring ``acquiring_name``
+    while holding ``held_name`` breaks the hierarchy, else None."""
+    held = rank_of(held_name)
+    acq = rank_of(acquiring_name)
+    if held is None or acq is None:
+        return None
+    if held_name == acquiring_name:
+        if held_name in SAME_NAME_OK:
+            return None
+        return (f"same-class nesting of {held_name!r} (rank {held}) is "
+                "not declared instance-safe (SAME_NAME_OK)")
+    if acq < held:
+        return (f"acquired {acquiring_name!r} (rank {acq}) while holding "
+                f"{held_name!r} (rank {held}); hierarchy requires "
+                f"{acquiring_name!r} first")
+    return None

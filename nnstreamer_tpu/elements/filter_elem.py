@@ -153,6 +153,79 @@ class TensorFilter(Element):
         self.add_sink_pad(static_tensors_caps(), "sink")
         self.add_src_pad(static_tensors_caps(), "src")
 
+    def static_check(self):
+        """Pre-play verifier hook: surface the scheduler decisions
+        ``start()`` would make silently (forced workers=1, ignored
+        inflight/deadline) and the configs it would reject outright
+        (mesh without micro-batching) — same rules, before any thread
+        exists."""
+        out = []
+
+        def _num(key):
+            raw = self.get_property(key)
+            if raw in (None, ""):
+                return 1
+            try:
+                return int(raw)
+            except (TypeError, ValueError):
+                # start()'s int() would raise: a genuine reject
+                out.append(("error", f"{self.name}: {key}={raw!r} is not "
+                                     "an integer"))
+                return 1
+
+        batch = _num("batch")
+        workers = _num("workers")
+        inflight = _num("inflight")
+        if batch < 1 or workers < 1 or inflight < 1:
+            # start() clamps with max(1, ...): the pipeline runs, the
+            # value is silently overridden — report, don't reject
+            out.append(("warning",
+                        f"{self.name}: batch/workers/inflight below 1 "
+                        f"(got {batch}/{workers}/{inflight}) is clamped "
+                        "to 1 at start"))
+        batch, workers, inflight = (max(1, batch), max(1, workers),
+                                    max(1, inflight))
+        if workers > 1 and batch > 1:
+            out.append(("warning",
+                        f"{self.name}: workers={workers} with "
+                        f"batch={batch}: micro-batching already overlaps "
+                        "dispatch (use inflight=); the scheduler forces "
+                        "workers=1"))
+        if inflight > 1 and batch <= 1:
+            out.append(("warning",
+                        f"{self.name}: inflight={inflight} needs "
+                        "micro-batching (batch>1); runs per-frame"))
+        try:
+            deadline = float(self.batch_timeout_ms or 0)
+        except (TypeError, ValueError):
+            deadline = 0
+            out.append(("error", f"{self.name}: batch-timeout-ms="
+                                 f"{self.batch_timeout_ms!r} is not a "
+                                 "number"))
+        if deadline > 0 and batch <= 1:
+            out.append(("warning",
+                        f"{self.name}: batch-timeout-ms needs "
+                        "micro-batching (batch>1); ignored"))
+        if workers > 1 and self.shared_tensor_filter_key:
+            out.append(("warning",
+                        f"{self.name}: workers={workers} with "
+                        "shared-tensor-filter-key may force workers=1 "
+                        "(per-worker instances impossible unless the "
+                        "backend declares THREADSAFE_INVOKE)"))
+        if "mesh:" in str(self.custom or "") and batch <= 1:
+            out.append(("error",
+                        f"{self.name}: custom=mesh:... requires "
+                        "micro-batching (set batch= to a multiple of "
+                        "dp); per-frame dispatch cannot shard"))
+        pl = self.pipeline
+        if (pl is not None and getattr(pl, "fuse", False)
+                and (workers > 1 or batch > 1)):
+            out.append(("info",
+                        f"{self.name}: workers/batch push from their own "
+                        "threads, so this element opts out of fused "
+                        "dispatch (the segment splits here)"))
+        return out
+
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         in_info = out_info = None
@@ -248,7 +321,9 @@ class TensorFilter(Element):
             self._batch_deadline = 0.0
         import threading
 
-        self._coalesce_lock = threading.Lock()
+        from ..analysis.sanitizer import make_lock
+
+        self._coalesce_lock = make_lock("filter.coalesce")
         self._deadline_stop = threading.Event()
         self._deadline_thread = None
         # parallel invoke workers: a pool of N invoke threads fed from
@@ -444,8 +519,10 @@ class TensorFilter(Element):
 
                 backends.append(open_backend(_dc.replace(self._props)))
         self._wk_backends = backends
+        from ..analysis.sanitizer import make_condition
+
         self._wk_tasks: _q.Queue = _q.Queue()
-        self._wk_cv = threading.Condition()
+        self._wk_cv = make_condition("filter.workers")
         self._wk_results: dict = {}     # seq -> (buf, outs, exc)
         self._wk_seq = 0                # frames submitted
         self._wk_pushed = 0             # frames pushed (or error-skipped)

@@ -123,11 +123,32 @@ class TensorDemux(Element):
     def request_src_pad(self) -> Pad:
         return self.add_src_pad(static_tensors_caps())
 
+    def _parse_picks(self) -> Optional[List[List[int]]]:
+        """One parser for start() AND static_check(): the verifier must
+        judge exactly the syntax the runtime accepts."""
+        if self.tensorpick in (None, ""):
+            return None
+        return [[int(x) for x in grp.split(":")]
+                for grp in str(self.tensorpick).split(",")]
+
     def start(self):
-        self._picks: Optional[List[List[int]]] = None
-        if self.tensorpick not in (None, ""):
-            self._picks = [[int(x) for x in grp.split(":")]
-                           for grp in str(self.tensorpick).split(",")]
+        self._picks = self._parse_picks()
+
+    def static_check(self):
+        """Verifier hook: a tensorpick that declares fewer groups than
+        this demux has linked src pads is the exact mismatch set_caps
+        rejects at negotiation — catch it pre-play."""
+        try:
+            picks = self._parse_picks()
+        except ValueError:
+            return [("error", f"{self.name}: unparsable tensorpick "
+                              f"{self.tensorpick!r}")]
+        if picks is not None and len(picks) < len(self.src_pads):
+            return [("error",
+                     f"{self.name}: {len(self.src_pads)} src pads but "
+                     f"tensorpick declares only {len(picks)} tensor "
+                     "groups — negotiation would fail")]
+        return []
 
     def _groups(self, num_tensors: int) -> List[List[int]]:
         if self._picks is not None:

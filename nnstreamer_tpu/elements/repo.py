@@ -8,9 +8,10 @@ its own upstream (recurrent topologies) without a direct element link.
 from __future__ import annotations
 
 import queue as _queue
-import threading
+import time
 from typing import Dict, Optional
 
+from ..analysis.sanitizer import make_condition
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
@@ -20,31 +21,60 @@ from ..tensor.caps_util import tensors_template_caps
 
 
 class _Repo:
-    """Process-global slot table (reference gsttensor_repo.c table)."""
+    """Process-global slot table (reference gsttensor_repo.c table).
+
+    Caps registration is condition-driven: a reposrc waiting for the
+    writer's caps blocks on the table condition and wakes the moment
+    ``set_caps`` lands (the 0.02 s poll this replaces burned 50 wakeups
+    per second of startup skew for a median wait of one)."""
 
     def __init__(self) -> None:
         self._slots: Dict[int, _queue.Queue] = {}
         self._caps: Dict[int, Caps] = {}
-        self._lock = threading.Lock()
+        self._cv = make_condition("repo")
 
     def slot(self, index: int) -> _queue.Queue:
-        with self._lock:
+        with self._cv:
             if index not in self._slots:
                 self._slots[index] = _queue.Queue(maxsize=32)
             return self._slots[index]
 
     def set_caps(self, index: int, caps: Caps) -> None:
-        with self._lock:
+        with self._cv:
             self._caps[index] = caps
+            self._cv.notify_all()
 
     def get_caps(self, index: int) -> Optional[Caps]:
-        with self._lock:
+        with self._cv:
             return self._caps.get(index)
 
+    def wait_caps(self, index: int, timeout: float,
+                  cancelled=lambda: False) -> Optional[Caps]:
+        """Block until slot ``index`` has caps (the writer's set_caps
+        wakes us), the deadline passes, or ``cancelled()`` turns true
+        (re-checked on each wakeup; :func:`wake` forces one)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                caps = self._caps.get(index)
+                if caps is not None or cancelled():
+                    return caps
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+
+    def wake(self) -> None:
+        """Interrupt waiters so they re-check their cancel condition
+        (element teardown)."""
+        with self._cv:
+            self._cv.notify_all()
+
     def clear(self) -> None:
-        with self._lock:
+        with self._cv:
             self._slots.clear()
             self._caps.clear()
+            self._cv.notify_all()
 
 
 repo = _Repo()
@@ -91,21 +121,24 @@ class TensorRepoSrc(Source):
     def _make_pads(self):
         self.add_src_pad(tensors_template_caps(), "src")
 
+    #: in-band wake marker for the blocking slot-queue get in create()
+    #: (same treatment as AppSrc._WAKE: teardown enqueues it so the
+    #: reader never needs a timeout poll)
+    _WAKE = object()
+
     def negotiate(self) -> Caps:
         if self.caps is not None:
             c = self.caps
             caps = Caps.from_string(c) if isinstance(c, str) else c
             self._neg_caps = caps
             return caps
-        # wait briefly for the writer to register caps
-        import time
-
-        for _ in range(100):
-            c = repo.get_caps(int(self.slot_index))
-            if c is not None:
-                self._neg_caps = c
-                return c
-            time.sleep(0.02)
+        # wait (event-driven) for the writer to register caps; _halt()
+        # wakes the condition so teardown never rides out the deadline
+        c = repo.wait_caps(int(self.slot_index), timeout=2.0,
+                           cancelled=self._halted.is_set)
+        if c is not None:
+            self._neg_caps = c
+            return c
         raise RuntimeError(f"{self.name}: no caps in slot {self.slot_index}")
 
     def _dummy_buffer(self) -> Optional[TensorBuffer]:
@@ -120,6 +153,18 @@ class TensorRepoSrc(Source):
         except Exception:
             return None  # flexible/unparseable caps: wait for real data
 
+    def _halt(self) -> None:
+        # flag first, then wake both wait sites: the caps condition (a
+        # negotiate still waiting re-checks cancelled) and the slot
+        # queue (create's blocking get consumes the marker and exits)
+        self._halted.set()
+        repo.wake()
+        try:
+            repo.slot(int(self.slot_index)).put_nowait(self._WAKE)
+        except _queue.Full:
+            pass   # reader isn't blocked on an empty queue: no wake needed
+        super()._halt()
+
     def create(self) -> Optional[TensorBuffer]:
         q = repo.slot(int(self.slot_index))
         if not getattr(self, "_ini", True):
@@ -127,10 +172,12 @@ class TensorRepoSrc(Source):
             dummy = self._dummy_buffer()
             if dummy is not None:
                 return dummy
+        # blocking get with NO timeout: event-driven (the 0.1 s poll this
+        # replaces woke 10x/s for the whole stream); _halt()'s in-band
+        # _WAKE marker interrupts it at teardown
         while not self._halted.is_set():
-            try:
-                item = q.get(timeout=0.1)
-            except _queue.Empty:
-                continue
-            return item  # None = EOS sentinel from reposink
+            item = q.get()
+            if item is self._WAKE:
+                continue   # teardown (or stale) marker: re-check halted
+            return item    # None = EOS sentinel from reposink
         return None

@@ -74,6 +74,12 @@ def main(argv=None) -> int:
                     metavar="FACTORY",
                     help="list element factories (or one factory's "
                          "properties) instead of running a pipeline")
+    ap.add_argument("--check", action="store_true",
+                    help="statically verify the pipeline graph and exit "
+                         "without playing: caps dead-ends, deadlock "
+                         "cycles, dead branches and scheduler "
+                         "misconfigurations are reported with element "
+                         "paths (analysis/verify.py); exit 1 on errors")
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--print-sink", default=None,
                     help="tensor_sink name whose outputs to print")
@@ -107,6 +113,9 @@ def main(argv=None) -> int:
     honor_jax_platforms()
 
     from . import parse_launch
+
+    if args.check:
+        return check(args.pipeline)
 
     t0 = time.time()
     try:
@@ -180,6 +189,37 @@ def main(argv=None) -> int:
     if not args.quiet:
         print(f"pipeline finished in {time.time() - t0:.2f}s",
               file=sys.stderr)
+    return 0
+
+
+def check(description: str, out=None) -> int:
+    """``--check``: build the pipeline graph and run the static verifier
+    WITHOUT playing — no element starts, no thread spawns, no buffer
+    flows.  Prints every finding (errors first, element-path
+    diagnostics) plus the streaming-thread structure; returns 1 when
+    the graph has error-severity findings, else 0."""
+    out = out or sys.stderr
+    from .analysis.verify import thread_segments, verify_pipeline
+    from .pipeline.parse import ParseError
+
+    from . import parse_launch
+
+    try:
+        p = parse_launch(description)
+    except ParseError as exc:
+        print(f"check: FAIL (parse): {exc}", file=out)
+        return 1
+    findings = verify_pipeline(p)
+    for f in findings:
+        print(f"check: {f}", file=out)
+    for seg in thread_segments(p):
+        members = " -> ".join(seg["elements"]) or "(boundary only)"
+        print(f"check: thread {seg['thread']}: {members}", file=out)
+    errors = [f for f in findings if f.severity == "error"]
+    if errors:
+        print(f"check: FAIL ({len(errors)} error(s))", file=out)
+        return 1
+    print("check: OK", file=out)
     return 0
 
 

@@ -17,9 +17,9 @@ which per-pad buffers form one output frame and what PTS it carries.
 from __future__ import annotations
 
 import enum
-import threading
 from typing import Dict, List, Optional
 
+from ..analysis.sanitizer import make_lock
 from ..tensor.buffer import TensorBuffer
 
 
@@ -74,7 +74,7 @@ class CollectPads:
         self._latest: Dict[int, Optional[TensorBuffer]] = {
             i: None for i in range(num_pads)}
         self._eos: Dict[int, bool] = {i: False for i in range(num_pads)}
-        self._lock = threading.Lock()
+        self._lock = make_lock("collectpads")
 
     def add_pad(self) -> int:
         with self._lock:

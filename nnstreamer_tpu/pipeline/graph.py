@@ -16,6 +16,7 @@ import time
 import queue as _queue
 from typing import Dict, List, Optional
 
+from ..analysis.sanitizer import make_condition
 from ..tensor.buffer import TensorBuffer
 from .caps import Caps
 from .element import (CapsEvent, Element, EOSEvent, Event,
@@ -28,6 +29,23 @@ class PipelineError(RuntimeError):
         super().__init__(f"element {element.name}: {cause!r}")
         self.element = element
         self.cause = cause
+
+
+class VerifyError(PipelineError):
+    """Static verification rejected the graph at ``play()`` — before
+    any thread spawned or buffer flowed (analysis/verify.py).  Subclasses
+    :class:`PipelineError` so callers treating play/run failures
+    uniformly keep working; ``findings`` carries the full diagnostics."""
+
+    def __init__(self, findings):
+        self.findings = list(findings)
+        self.element = next((f.element for f in self.findings
+                             if f.element is not None), None)
+        self.cause = None
+        detail = "; ".join(str(f) for f in self.findings)
+        RuntimeError.__init__(
+            self, f"pipeline verification failed ({len(self.findings)} "
+                  f"error(s)): {detail}")
 
 
 class Pipeline:
@@ -49,7 +67,7 @@ class Pipeline:
         self._by_name: Dict[str, Element] = {}
         self._error: Optional[PipelineError] = None
         self._eos_sinks: set = set()
-        self._cv = threading.Condition()
+        self._cv = make_condition("pipeline.state")
         self._playing = False
         #: fused segment dispatch (schedule.py): compile maximal linear
         #: element runs into flat plans at play().  On by default;
@@ -142,7 +160,22 @@ class Pipeline:
     def sinks(self) -> List[Element]:
         return [e for e in self.elements if not e.src_pads]
 
+    def verify(self):
+        """Run the static pipeline verifier (analysis/verify.py) on the
+        current graph and return its findings — the programmatic face of
+        ``launch.py --check``."""
+        from ..analysis.verify import verify_pipeline
+
+        return verify_pipeline(self)
+
     def play(self) -> None:
+        # static verification first: caps dead-ends, dataflow cycles and
+        # scheduler misconfigs fail HERE, with element-path diagnostics,
+        # instead of crashing a streaming thread on the first buffer
+        # (NNS_VERIFY=0 opts out; _check_links stays as the backstop)
+        from ..analysis.verify import preflight
+
+        preflight(self)
         self._check_links()
         for el in self.elements:
             try:
@@ -336,7 +369,7 @@ class Queue(Element):
         self._q: _queue.Queue = _queue.Queue()
         self._cap = max(1, int(self.max_size_buffers))
         self._used = 0
-        self._space = threading.Condition()
+        self._space = make_condition("queue.space")
         self._drain_done = False
         self._worker = threading.Thread(target=self._drain,
                                         name=f"queue:{self.name}", daemon=True)
@@ -363,6 +396,17 @@ class Queue(Element):
 
     def get_allowed_caps(self, sink_pad):
         return self.src_pad.peer_allowed_caps()
+
+    def static_check(self):
+        try:
+            cap = int(self.max_size_buffers)
+        except (TypeError, ValueError):
+            return [("error", f"{self.name}: max-size-buffers="
+                              f"{self.max_size_buffers!r} is not an int")]
+        if cap < 1:
+            return [("warning", f"{self.name}: max-size-buffers={cap} "
+                                "is clamped to 1 at start")]
+        return []
 
     def _enqueue(self, buf) -> FlowReturn:
         """Slot-bounded data put that can't deadlock: purely event-driven

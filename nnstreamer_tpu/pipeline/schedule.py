@@ -39,9 +39,9 @@ attribute on head pads only: interpreted pipelines never pay a check, and
 
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis.sanitizer import make_rlock
 from .element import Element, FlowReturn, Pad
 
 
@@ -57,7 +57,7 @@ class SegmentPlanner:
 
     def __init__(self, pipeline) -> None:
         self.pipeline = pipeline
-        self._lock = threading.RLock()
+        self._lock = make_rlock("planner")
         self._heads: List[Pad] = []
         self._plans: Dict[str, Dict] = {}   # head full_name -> plan info
         #: bumped on every invalidate/rescan; tests assert rebuilds happened

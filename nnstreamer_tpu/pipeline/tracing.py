@@ -134,8 +134,10 @@ class Tracer:
     semantics (time inside ONE element)."""
 
     def __init__(self) -> None:
+        from ..analysis.sanitizer import make_lock
+
         self._stats: Dict[str, _ElementStats] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("tracer")
         # resilience counters (query/resilience.py STATS) are process-wide
         # and monotonic; snapshot at attach so the report shows only THIS
         # run's retries/failures/breaker transitions.  Lazy import: the

@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis.sanitizer import make_lock, make_rlock
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
@@ -61,9 +62,10 @@ class QueryConnection:
         self._reader: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._seq = 0
-        self._send_lock = threading.Lock()   # query+ping share the stream
+        self._send_lock = make_lock("query.send")  # query+ping share the
+        #                                            stream
         self._pong_waiters: Dict[int, threading.Event] = {}
-        self._waiters_lock = threading.Lock()
+        self._waiters_lock = make_lock("query.registry")
 
     def connect(self) -> None:
         def _dial():
@@ -290,7 +292,7 @@ class FailoverConnection:
         self._active_idx: Optional[int] = None
         self._active_key: Optional[str] = None   # lock-free monitor read
         self._dead = threading.Event()   # heartbeat verdict on active
-        self._lock = threading.RLock()
+        self._lock = make_rlock("query.client")
         self.monitor: Optional[HealthMonitor] = None
         if heartbeat_interval > 0:
             self.monitor = HealthMonitor(

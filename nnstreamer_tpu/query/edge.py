@@ -18,6 +18,7 @@ import socket
 import threading
 from typing import Dict, Optional, Set
 
+from ..analysis.sanitizer import make_lock
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
@@ -64,7 +65,7 @@ class EdgeBroker:
         # per-subscriber-socket send locks: concurrent publishers must not
         # interleave partial frames on one subscriber stream
         self._send_locks: Dict[socket.socket, threading.Lock] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("query.registry")
         self._stop = threading.Event()
         threading.Thread(target=self._accept_loop, daemon=True,
                          name="edge-broker").start()
@@ -98,7 +99,8 @@ class EdgeBroker:
                     if role == "sub":
                         with self._lock:
                             self._subs.setdefault(topic, set()).add(conn)
-                            slock = self._send_locks[conn] = threading.Lock()
+                            slock = self._send_locks[conn] = \
+                                make_lock("query.send")
                             retained = self._topic_caps.get(topic, "")
                             # Take this conn's send lock before releasing the
                             # broker lock: a publisher recording new caps B
@@ -197,7 +199,7 @@ class EdgeBroker:
 
 
 _BROKERS: Dict[int, EdgeBroker] = {}
-_BROKERS_LOCK = threading.Lock()
+_BROKERS_LOCK = make_lock("leaf")
 
 
 def get_broker(port: int = 0, host: str = "127.0.0.1") -> EdgeBroker:

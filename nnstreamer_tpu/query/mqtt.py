@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Set
 
 import numpy as np
 
+from ..analysis.sanitizer import make_lock
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
@@ -152,7 +153,8 @@ class MqttClient:
             raise ConnectionError(f"mqtt: CONNACK refused: {resp}")
         self._sock.settimeout(None)
         self._pid = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("query.send")   # one writer at a time on
+        #                                        the broker stream
         self._early: List = []   # PUBLISHes delivered before SUBACK
         self._closed = False
         self._ping_stop = threading.Event()
@@ -301,7 +303,7 @@ class MqttBroker:
         self._subs: Dict[str, Set[socket.socket]] = {}
         self._locks: Dict[socket.socket, threading.Lock] = {}
         self._retained: Dict[str, bytes] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("query.registry")
         self._stop = threading.Event()
         threading.Thread(target=self._accept, daemon=True,
                          name="mqtt-broker").start()
@@ -322,7 +324,7 @@ class MqttBroker:
             if pkt is None or pkt[0] >> 4 != 1:
                 return
             conn.sendall(bytes([0x20, 2, 0, 0]))  # CONNACK accepted
-            self._locks[conn] = threading.Lock()
+            self._locks[conn] = make_lock("query.send")
             while not self._stop.is_set():
                 pkt = _read_packet(conn)
                 if pkt is None:
@@ -409,7 +411,7 @@ class MqttBroker:
 
 
 _BROKERS: Dict[int, MqttBroker] = {}
-_BROKERS_LOCK = threading.Lock()
+_BROKERS_LOCK = make_lock("leaf")
 
 
 def get_mqtt_broker(port: int = 0, host: str = "127.0.0.1") -> MqttBroker:

@@ -257,12 +257,18 @@ def decode_tensors(payload) -> List[np.ndarray]:
     """Zero-copy decode: tensors are views into ``payload`` (bytes or a
     pooled-slab memoryview).  Views are read-only — pooled payloads are
     shared (tee contract); attach the message's lease to the
-    TensorBuffer that carries them."""
+    TensorBuffer that carries them.  ``writeable=False`` survives numpy
+    view/reshape derivation, so downstream transform/decoder reshapes
+    stay non-writable; under the sanitizer (``NNS_DEBUG=1``) a write
+    attempt raises a contract-naming AliasingError instead of numpy's
+    bare read-only ValueError (analysis/sanitizer.py guard_readonly)."""
     (n,) = struct.unpack_from("<I", payload, 0)
     off = 4
     tensors = []
+    from ..analysis import sanitizer as _san
     from ..tensor.types import dim_to_np_shape
 
+    guard = _san._ENABLED
     for _ in range(n):
         meta = TensorMetaInfo.from_bytes(payload[off:off + META_HEADER_SIZE])
         off += META_HEADER_SIZE
@@ -273,6 +279,8 @@ def decode_tensors(payload) -> List[np.ndarray]:
                .reshape(dim_to_np_shape(meta.dims)))
         if arr.flags.writeable:
             arr.flags.writeable = False
+        if guard:
+            arr = _san.guard_readonly(arr)
         tensors.append(arr)
     return tensors
 

@@ -18,6 +18,7 @@ import socket
 import threading
 from typing import Dict, Optional
 
+from ..analysis.sanitizer import make_lock
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
@@ -51,7 +52,7 @@ class QueryServer:
         self._send_locks: Dict[int, threading.Lock] = {}
         self._caps_str: Optional[str] = None
         self._next_id = 1
-        self._lock = threading.Lock()
+        self._lock = make_lock("query.registry")
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="query-accept")
@@ -70,14 +71,14 @@ class QueryServer:
                 cid = self._next_id
                 self._next_id += 1
                 self._clients[cid] = conn
-                self._send_locks[cid] = threading.Lock()
+                self._send_locks[cid] = make_lock("query.send")
             threading.Thread(target=self._client_loop, args=(cid, conn),
                              daemon=True, name=f"query-client-{cid}").start()
 
     def _client_loop(self, cid: int, conn: socket.socket) -> None:
         # snapshot: stop() clears the dict concurrently, and a KeyError
         # here would escape the except-OSError below
-        slock = self._send_locks.get(cid) or threading.Lock()
+        slock = self._send_locks.get(cid) or make_lock("query.send")
         pool = default_pool()
         try:
             while not self._stop.is_set():
@@ -154,7 +155,7 @@ class QueryServer:
 
 #: server table: id → QueryServer (pairs serversrc/serversink)
 _SERVERS: Dict[int, QueryServer] = {}
-_SERVERS_LOCK = threading.Lock()
+_SERVERS_LOCK = make_lock("leaf")
 
 
 def get_server(server_id: int, host: str = "127.0.0.1",

@@ -27,11 +27,13 @@ import ctypes
 import mmap
 import os
 import struct
+import threading
 import time
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.sanitizer import make_condition, make_lock
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
@@ -55,6 +57,36 @@ _SLOT_HDR = 16  # u64 len + s64 pts
 
 DEFAULT_SLOT_BYTES = 1 << 20
 DEFAULT_SLOTS = 16
+
+# -- process-local ring wakeups (pure-Python fallback) ----------------------
+# A blocked pure-Python endpoint cannot be notified by a REMOTE process
+# (no futex without the native lib), but the common test/bench topology
+# runs both pipelines in ONE process.  Rings share a per-name condition:
+# push/pop/eos notify it, so a same-process peer wakes immediately
+# (event-driven, zero idle wakeups) while a cross-process peer degrades
+# to the bounded timed re-check of the wait loop — never a busy spin.
+_WAKEUPS: Dict[str, "tuple[threading.Condition, int]"] = {}
+_WAKEUPS_LOCK = make_lock("leaf")
+
+
+def _wakeup_acquire(name: str) -> threading.Condition:
+    with _WAKEUPS_LOCK:
+        cond, refs = _WAKEUPS.get(name, (None, 0))
+        if cond is None:
+            cond = make_condition("shm.ring")
+        _WAKEUPS[name] = (cond, refs + 1)
+        return cond
+
+
+def _wakeup_release(name: str) -> None:
+    with _WAKEUPS_LOCK:
+        cond, refs = _WAKEUPS.get(name, (None, 0))
+        if cond is None:
+            return
+        if refs <= 1:
+            del _WAKEUPS[name]
+        else:
+            _WAKEUPS[name] = (cond, refs - 1)
 
 
 def _native_lib():
@@ -113,6 +145,7 @@ class ShmRing:
         self._lib = _native_lib()
         self._h = None
         self._mm: Optional[mmap.mmap] = None
+        self._wake: Optional[threading.Condition] = None
         self._owner = create
         if self._lib is not None:
             if create:
@@ -189,23 +222,47 @@ class ShmRing:
                 if time.monotonic() > deadline:
                     raise ConnectionError(f"shm ring {self.name!r}: "
                                           "open timed out")
+                # cross-PROCESS file-appearance wait: no local producer
+                # exists yet to signal, so a timed re-check is the only
+                # pure-Python option  # nnslint: allow(sleep-poll)
                 time.sleep(0.002)
         self.slot_bytes = struct.unpack("<Q", self._mm[8:16])[0]
         self._n_slots = struct.unpack("<I", self._mm[16:20])[0]
+        self._wake = _wakeup_acquire(self.name)
 
     def _py_u64(self, off: int) -> int:
         return struct.unpack("<Q", self._mm[off:off + 8])[0]
 
-    # Blocked-side wait pacing (mirror of shmring.cc backoff_us): start
-    # near-spin for latency, back off exponentially to 2 ms.  The flat
-    # 100 us sleep this replaces woke the blocked side 10k times/s for
-    # the whole stall — on a CPU-only host that steals cycles from the
-    # very peer being waited on (the round-5 shm-slower-than-TCP
-    # inversion; kernel sockets block properly and never paid this).
-    @staticmethod
-    def _backoff(delay: float) -> float:
-        time.sleep(delay)
+    # Blocked-side waiting (pure-Python fallback): condition-driven.
+    # ``_wait_change`` blocks on the ring's process-local condition, so a
+    # same-process peer's push/pop/eos wakes it IMMEDIATELY; the timeout
+    # only bounds the re-check for cross-process peers (which cannot
+    # notify) — exponential 50 µs → 2 ms, the pacing of shmring.cc's
+    # native backoff.  This replaces the time.sleep backoff loop (and
+    # before that a flat 100 µs spin), so a local stall costs zero
+    # wakeups instead of 500+/s.
+    def _wait_change(self, blocked, deadline: float, delay: float,
+                     stalled: str) -> float:
+        """One bounded wait while ``blocked()`` holds; raises
+        TimeoutError(``stalled``) past ``deadline``.  Returns the next
+        re-check delay.  The blocked-state re-check happens UNDER the
+        condition, so a local peer's notify between check and wait is
+        never lost."""
+        with self._wake:
+            if not blocked():
+                return delay
+            if time.monotonic() > deadline:
+                raise TimeoutError(stalled)
+            self._wake.wait(delay)
         return delay * 2 if delay < 0.002 else delay
+
+    def _notify(self) -> None:
+        """Ring state changed (slot filled/freed, EOS): wake any
+        same-process peer blocked in ``_wait_change``."""
+        wake = self._wake
+        if wake is not None:
+            with wake:
+                wake.notify_all()
 
     # -- API -------------------------------------------------------------
     def caps(self) -> str:
@@ -264,11 +321,15 @@ class ShmRing:
                              f"size {self.slot_bytes}")
         deadline = time.monotonic() + timeout
         delay = 5e-5
-        while (self._py_u64(_OFF_HEAD) - self._py_u64(_OFF_TAIL)
-               >= self._n_slots):
-            if time.monotonic() > deadline:
-                raise TimeoutError("shm ring full (consumer stalled?)")
-            delay = self._backoff(delay)
+
+        def _full() -> bool:
+            return (self._py_u64(_OFF_HEAD) - self._py_u64(_OFF_TAIL)
+                    >= self._n_slots)
+
+        while _full():
+            delay = self._wait_change(
+                _full, deadline, delay,
+                "shm ring full (consumer stalled?)")
         head = self._py_u64(_OFF_HEAD)
         off = _OFF_SLOTS + (head % self._n_slots) * (_SLOT_HDR
                                                     + self.slot_bytes)
@@ -278,6 +339,7 @@ class ShmRing:
             self._mm[pos:pos + a.nbytes] = a.data
             pos += a.nbytes
         self._mm[_OFF_HEAD:_OFF_HEAD + 8] = struct.pack("<Q", head + 1)
+        self._notify()   # slot filled: wake a same-process consumer
 
     def pop(self, timeout: float = 10.0
             ) -> Optional[Tuple[bytes, int]]:
@@ -318,12 +380,16 @@ class ShmRing:
             return lease, int(n), pts.value
         deadline = time.monotonic() + timeout
         delay = 5e-5
-        while self._py_u64(_OFF_HEAD) == self._py_u64(_OFF_TAIL):
+
+        def _empty() -> bool:
+            return self._py_u64(_OFF_HEAD) == self._py_u64(_OFF_TAIL)
+
+        while _empty():
             if struct.unpack("<I", self._mm[_OFF_EOS:_OFF_EOS + 4])[0]:
                 return None
-            if time.monotonic() > deadline:
-                raise TimeoutError("shm ring empty (producer stalled?)")
-            delay = self._backoff(delay)
+            delay = self._wait_change(
+                _empty, deadline, delay,
+                "shm ring empty (producer stalled?)")
         tail = self._py_u64(_OFF_TAIL)
         off = _OFF_SLOTS + (tail % self._n_slots) * (_SLOT_HDR
                                                      + self.slot_bytes)
@@ -331,6 +397,7 @@ class ShmRing:
         lease = pool.acquire(ln)
         lease.memory()[:] = self._mm[off + 16:off + 16 + ln]
         self._mm[_OFF_TAIL:_OFF_TAIL + 8] = struct.pack("<Q", tail + 1)
+        self._notify()   # slot freed: wake a same-process producer
         return lease, ln, pts
 
     def eos(self) -> None:
@@ -338,6 +405,7 @@ class ShmRing:
             self._lib.tw_shm_eos(self._h)
         else:
             self._mm[_OFF_EOS:_OFF_EOS + 4] = struct.pack("<I", 1)
+            self._notify()   # consumers blocked on empty re-check EOS
 
     def close(self, unlink: Optional[bool] = None) -> None:
         """Unmap; unlink the shm name when ``unlink`` (default: consumer
@@ -355,6 +423,10 @@ class ShmRing:
             self._mm.close()
             self._mm = None
             os.close(self._fd)
+            if self._wake is not None:
+                self._notify()   # peers re-check state one last time
+                _wakeup_release(self.name)
+                self._wake = None
             if unlink:
                 try:
                     os.unlink("/dev/shm" + self.name)
@@ -446,6 +518,11 @@ class ShmSrc(Source):
                         "the ring's bounded-backpressure contract"),
     }
 
+    #: in-band wake marker for the blocking prefetch-fifo get in
+    #: create() (AppSrc._WAKE treatment: teardown enqueues it instead of
+    #: the reader polling with a timeout)
+    _WAKE = object()
+
     def _make_pads(self):
         self.add_src_pad(tensors_template_caps(), "src")
 
@@ -455,6 +532,18 @@ class ShmSrc(Source):
         self._pool = default_pool()
         self._fifo = None
         self._reader = None
+
+    def unblock(self):
+        if self._fifo is not None:
+            self._fifo.put(self._WAKE)
+
+    def _halt(self) -> None:
+        # flag before marker, AppSrc-style: a create() that consumes the
+        # marker must observe halted and exit
+        self._halted.set()
+        if self._fifo is not None:
+            self._fifo.put(self._WAKE)
+        super()._halt()
 
     def stop(self):
         self._halt()
@@ -524,12 +613,11 @@ class ShmSrc(Source):
         deadline = time.monotonic() + float(self.timeout)
         while not self._halted.is_set():
             if self._fifo is not None:
-                import queue as _queue
-
-                try:
-                    got = self._fifo.get(timeout=0.1)
-                except _queue.Empty:
-                    continue
+                # blocking get, no timeout: the reader thread (or the
+                # _halt/unblock wake marker) is the only wake source
+                got = self._fifo.get()
+                if got is self._WAKE:
+                    continue   # teardown marker: re-check halted
                 if isinstance(got, BaseException):
                     raise got
             else:

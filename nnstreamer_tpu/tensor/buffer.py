@@ -19,10 +19,11 @@ from __future__ import annotations
 
 import dataclasses
 import sys
-import threading
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
+
+from ..analysis import sanitizer as _san
 
 #: Sentinel for "no timestamp" (GStreamer GST_CLOCK_TIME_NONE analogue).
 CLOCK_TIME_NONE: Optional[int] = None
@@ -88,7 +89,7 @@ class BufferLease:
         self._slab = slab
         self.size = size
         self._refs = 1
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("lease")
 
     @property
     def nbytes(self) -> int:
@@ -99,6 +100,10 @@ class BufferLease:
         slab = self._slab
         if slab is None:
             raise RuntimeError("BufferLease used after release")
+        if _san._ENABLED:
+            # writable grant while decoded views are alive = the
+            # aliasing bug the pool exists to prevent (sanitizer)
+            _san.check_writable_grant(slab, "BufferLease.memory")
         return memoryview(slab)[:self.size]
 
     def view(self, dtype, shape, offset: int = 0) -> np.ndarray:
@@ -161,7 +166,7 @@ class TensorBufferPool:
         self._free: Dict[int, List[bytearray]] = {}
         self._free_bytes = 0
         self._pending: List[bytearray] = []   # slabs with live views
-        self._lock = threading.Lock()
+        self._lock = _san.make_lock("pool")
         # slabs whose reclaim found the lock held (see _reclaim); deque
         # append/popleft are atomic under the GIL, so __del__ can park
         # here without taking any lock
@@ -194,6 +199,10 @@ class TensorBufferPool:
                 hit = False
         if slab is None:
             slab = bytearray(nbytes)
+        elif _san._ENABLED:
+            # a recycled slab must have NO live views (sanitizer cross-
+            # checks the refcount reclaim invariant independently)
+            _san.check_slab_reissue(slab)
         from ..pipeline import tracing
 
         tracing.record_pool(hit)
@@ -285,7 +294,7 @@ class TensorBufferPool:
 
 
 _DEFAULT_POOL: Optional[TensorBufferPool] = None
-_DEFAULT_POOL_LOCK = threading.Lock()
+_DEFAULT_POOL_LOCK = _san.make_lock("leaf")
 
 
 def default_pool() -> TensorBufferPool:
@@ -398,6 +407,15 @@ class TensorBuffer:
     #: as long as any wrapper/branch still references the frame; the
     #: slab recycles when the last holder drops — see BufferLease)
     lease: Any = dataclasses.field(default=None, repr=False)
+
+    def __post_init__(self):
+        # sanitizer hook (one branch per buffer when off): a leased
+        # buffer's ndarray payloads are zero-copy views over the pooled
+        # slab — register them so writable grants / pool re-issues with
+        # live views are caught (analysis/sanitizer.py aliasing checker)
+        if _san._ENABLED and self.lease is not None:
+            _san.note_views(getattr(self.lease, "_slab", None),
+                            self.tensors)
 
     @property
     def num_tensors(self) -> int:

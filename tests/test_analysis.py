@@ -1,0 +1,732 @@
+"""nnsverify + nnslint + runtime sanitizer (ISSUE 4).
+
+Three layers of correctness tooling for the fused parallel core:
+
+- the static pipeline verifier (analysis/verify.py) must reject the
+  bad-graph fixtures — caps dead-ends, deadlock cycles, scheduler
+  misconfigurations — BEFORE any buffer flows, with element-path
+  diagnostics, both programmatically and through ``launch.py --check``;
+- the AST lint (tools/nnslint.py) must be clean on the package itself
+  (this is the standing gate for future concurrency PRs) and must catch
+  one seeded violation per rule;
+- the runtime sanitizer (analysis/sanitizer.py) must detect a seeded
+  lock-order inversion (with both stacks) and a seeded aliasing write,
+  and must stay silent on a real pipeline run (the declared hierarchy
+  matches reality).
+"""
+
+import ast
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.analysis import lockorder, sanitizer
+from nnstreamer_tpu.analysis.verify import thread_segments, verify_pipeline
+from nnstreamer_tpu.launch import check as launch_check
+from nnstreamer_tpu.pipeline.graph import Pipeline, PipelineError, VerifyError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import nnslint  # noqa: E402
+
+TENSOR_CAPS = ("other/tensors,format=static,num_tensors=1,dimensions=4:4,"
+               "types=float32,framerate=0/1")
+
+
+def _rules(findings):
+    return {(f.severity, f.rule) for f in findings}
+
+
+@pytest.fixture
+def clean_sanitizer():
+    sanitizer.reset()
+    yield
+    sanitizer.disable()
+    sanitizer.reset()
+
+
+# ==========================================================================
+# static verifier
+# ==========================================================================
+
+class TestVerifier:
+    def test_caps_mismatch_found_with_element_path(self):
+        p = parse_launch("videotestsrc num-buffers=1 ! audio/x-raw ! "
+                         "tensor_sink name=out")
+        findings = verify_pipeline(p)
+        errs = [f for f in findings
+                if f.severity == "error" and f.rule == "caps-mismatch"]
+        assert errs, findings
+        # the diagnostic names the element path, not just one element
+        assert "->" in errs[0].path and "out" in errs[0].path
+
+    def test_caps_mismatch_rejected_at_play(self):
+        p = parse_launch("videotestsrc num-buffers=1 ! audio/x-raw ! "
+                         "tensor_sink name=out")
+        with pytest.raises(VerifyError, match="caps-mismatch"):
+            p.play()
+        p.stop()
+
+    def test_verify_error_is_pipeline_error(self):
+        """Callers treating play/run failures uniformly keep working."""
+        p = parse_launch("videotestsrc num-buffers=1 ! audio/x-raw ! "
+                         "tensor_sink name=out")
+        with pytest.raises(PipelineError):
+            p.run(timeout=10)
+
+    def test_compatible_pipeline_is_clean(self):
+        p = parse_launch(
+            "videotestsrc num-buffers=1 ! "
+            "video/x-raw,format=RGB,width=8,height=8,framerate=30/1 ! "
+            "tensor_converter ! queue ! tensor_sink name=out")
+        findings = verify_pipeline(p)
+        assert not [f for f in findings if f.severity == "error"], findings
+
+    def test_deadlock_cycle_found(self):
+        # mux -> tee -> queue -> mux: a dataflow cycle that wedges once
+        # the queue fills
+        p = parse_launch(
+            f"appsrc caps={TENSOR_CAPS} name=in ! m.sink_0 "
+            "tensor_mux name=m sync-mode=nosync ! tee name=t "
+            "t. ! queue ! m.sink_1 "
+            "t. ! tensor_sink name=out")
+        findings = verify_pipeline(p)
+        errs = [f for f in findings if f.rule == "deadlock-cycle"]
+        assert errs and errs[0].severity == "error", findings
+        # the cycle path names the participants
+        for name in ("m", "t"):
+            assert name in errs[0].path
+        with pytest.raises(VerifyError, match="deadlock-cycle"):
+            p.play()
+        p.stop()
+
+    def test_workers_with_batch_misconfig_caught(self):
+        p = parse_launch(
+            f"appsrc caps={TENSOR_CAPS} name=in ! "
+            "tensor_filter framework=custom-easy model=x batch=4 workers=2 "
+            "! tensor_sink name=out")
+        findings = verify_pipeline(p)
+        warns = [f for f in findings
+                 if f.rule == "misconfig" and "workers" in f.message]
+        assert warns and warns[0].severity == "warning", findings
+
+    def test_sub_one_batch_is_warning_not_error(self):
+        """start() CLAMPS batch/workers/inflight below 1 (the pipeline
+        runs) — the verifier must report the silent override as a
+        warning, never reject a config that plays."""
+        p = parse_launch(
+            f"appsrc caps={TENSOR_CAPS} name=in ! "
+            "tensor_filter framework=custom-easy model=x batch=-1 "
+            "workers=0 ! tensor_sink name=out")
+        findings = verify_pipeline(p)
+        assert not [f for f in findings if f.severity == "error"], findings
+        warns = [f for f in findings
+                 if f.rule == "misconfig" and "clamped" in f.message]
+        assert warns, findings
+
+    def test_mesh_without_batch_is_error(self):
+        p = parse_launch(
+            f"appsrc caps={TENSOR_CAPS} name=in ! "
+            "tensor_filter framework=xla model=m custom=mesh:dp=2 ! "
+            "tensor_sink name=out")
+        findings = verify_pipeline(p)
+        errs = [f for f in findings
+                if f.severity == "error" and f.rule == "misconfig"]
+        assert errs and "micro-batching" in errs[0].message, findings
+
+    def test_demux_tensorpick_group_shortage_is_error(self):
+        p = parse_launch(
+            f"appsrc caps={TENSOR_CAPS} name=in ! "
+            "tensor_demux name=d tensorpick=0 "
+            "d.src_0 ! tensor_sink name=a  d.src_1 ! tensor_sink name=b")
+        findings = verify_pipeline(p)
+        errs = [f for f in findings
+                if f.severity == "error" and f.rule == "misconfig"]
+        assert errs and "tensorpick" in errs[0].message, findings
+
+    def test_unlinked_pad_and_dead_branch(self):
+        from nnstreamer_tpu.elements.sink import TensorSink
+        from nnstreamer_tpu.pipeline.graph import Queue
+
+        p = Pipeline()
+        q, s = p.add(Queue("q"), TensorSink("s"))
+        p.link(q, s)          # q.sink stays unlinked, nothing feeds it
+        findings = verify_pipeline(p)
+        assert ("error", "unlinked-pad") in _rules(findings)
+        assert ("warning", "dead-branch") in _rules(findings)
+
+    def test_recurrent_repo_topology_is_info_not_error(self):
+        caps = ("other/tensors,format=static,num_tensors=1,dimensions=1,"
+                "types=float32,framerate=0/1")
+        p = parse_launch(
+            f"appsrc caps={caps} name=in ! mux.sink_0 "
+            f"tensor_reposrc slot-index=9 caps={caps} ! mux.sink_1 "
+            "tensor_mux name=mux sync-mode=nosync ! tee name=t "
+            "t. ! queue ! tensor_reposink slot-index=9 "
+            "t. ! queue ! tensor_sink name=out")
+        findings = verify_pipeline(p)
+        assert not [f for f in findings if f.severity == "error"], findings
+        infos = [f for f in findings if f.rule == "recurrent-topology"]
+        assert infos and "slot 9" in infos[0].path
+
+    def test_thread_segments_structure(self):
+        p = parse_launch(
+            "videotestsrc num-buffers=1 name=src ! "
+            "video/x-raw,format=RGB,width=8,height=8,framerate=30/1 ! "
+            "tensor_converter name=conv ! queue name=q ! "
+            "tensor_sink name=out")
+        segs = {s["thread"]: s["elements"] for s in thread_segments(p)}
+        assert "conv" in segs["src:src"]
+        assert "out" not in segs["src:src"]      # queue is the boundary
+        assert segs["queue:q"] == ["out"]
+
+    def test_nns_verify_0_disables_preflight(self, monkeypatch):
+        monkeypatch.setenv("NNS_VERIFY", "0")
+        p = parse_launch("videotestsrc num-buffers=1 ! audio/x-raw ! "
+                         "tensor_sink name=out")
+        # verification skipped: the failure surfaces the old way, from
+        # the streaming thread at negotiation time
+        with pytest.raises(PipelineError):
+            p.run(timeout=10)
+
+
+# ==========================================================================
+# launch.py --check (CLI surface) + examples gate
+# ==========================================================================
+
+class TestCheckCLI:
+    def test_check_rejects_bad_graphs(self, capsys):
+        assert launch_check("videotestsrc num-buffers=1 ! audio/x-raw ! "
+                            "tensor_sink name=out", out=sys.stdout) == 1
+        out = capsys.readouterr().out
+        assert "caps-mismatch" in out and "->" in out and "FAIL" in out
+
+    def test_check_rejects_cycle(self, capsys):
+        assert launch_check(
+            f"appsrc caps={TENSOR_CAPS} name=in ! m.sink_0 "
+            "tensor_mux name=m sync-mode=nosync ! tee name=t "
+            "t. ! queue ! m.sink_1  t. ! tensor_sink name=out",
+            out=sys.stdout) == 1
+        assert "deadlock-cycle" in capsys.readouterr().out
+
+    def test_check_rejects_parse_error(self, capsys):
+        assert launch_check("no_such_element_xyz ! tensor_sink",
+                            out=sys.stdout) == 1
+        assert "parse" in capsys.readouterr().out
+
+    def test_check_accepts_good_graph(self, capsys):
+        assert launch_check(
+            "videotestsrc num-buffers=1 ! "
+            "video/x-raw,format=RGB,width=8,height=8,framerate=30/1 ! "
+            "tensor_converter ! tensor_sink name=out",
+            out=sys.stdout) == 0
+        out = capsys.readouterr().out
+        assert "check: OK" in out and "thread src:" in out
+
+
+def _const_table(tree):
+    consts = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            try:
+                consts[node.targets[0].id] = ast.literal_eval(node.value)
+            except (ValueError, TypeError, SyntaxError):
+                pass
+    return consts
+
+
+def _string_of(node, consts):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                expr = v.value
+                if isinstance(expr, ast.Name) and expr.id in consts:
+                    parts.append(str(consts[expr.id]))
+                else:
+                    parts.append("")   # runtime value: neutral placeholder
+        return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _string_of(node.left, consts)
+        right = _string_of(node.right, consts)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def example_launch_strings(path):
+    """Extract the parse_launch() strings of an example file, with
+    module-level constants substituted and runtime-only placeholders
+    blanked (the graph structure — elements, links, pads — survives
+    verbatim; only runtime values like ports and file paths blank)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    consts = _const_table(tree)
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "parse_launch" and node.args:
+            s = _string_of(node.args[0], consts)
+            if s:
+                out.append(s)
+    return out
+
+
+class TestExamplesGate:
+    """CI satellite: every example pipeline graph must verify clean —
+    an unverifiable example is a broken tutorial."""
+
+    EXAMPLES = sorted(
+        f for f in os.listdir(os.path.join(REPO, "examples"))
+        if f.endswith(".py"))
+
+    def test_examples_found(self):
+        assert len(self.EXAMPLES) >= 8
+
+    @pytest.mark.parametrize("fname", EXAMPLES)
+    def test_example_graphs_verify(self, fname):
+        path = os.path.join(REPO, "examples", fname)
+        strings = example_launch_strings(path)
+        for s in strings:
+            p = parse_launch(s)
+            findings = verify_pipeline(p)
+            errors = [f for f in findings if f.severity == "error"]
+            assert not errors, (s, errors)
+
+
+# ==========================================================================
+# nnslint
+# ==========================================================================
+
+class TestNnslint:
+    def test_self_run_is_clean(self):
+        """The standing gate: the package itself must pass its own lint
+        (every future concurrency PR inherits this bar)."""
+        violations = nnslint.lint_paths(
+            [os.path.join(REPO, "nnstreamer_tpu")])
+        assert violations == [], "\n".join(str(v) for v in violations)
+
+    def test_seeded_violations_all_fire(self, tmp_path):
+        bad = tmp_path / "seeded.py"
+        bad.write_text(
+            "import threading\n"
+            "import time\n"
+            "from nnstreamer_tpu.analysis.sanitizer import make_lock\n"
+            "class Bad:\n"
+            "    def __init__(self):\n"
+            "        self._lock = make_lock('query.registry')\n"
+            "        self._send_lock = make_lock('query.send')\n"
+            "        self._odd = make_lock('no-such-class')\n"
+            "    def poll(self):\n"
+            "        while True:\n"
+            "            time.sleep(0.01)\n"
+            "    def send_under_registry(self, sock, data):\n"
+            "        with self._lock:\n"
+            "            sock.sendall(data)\n"
+            "    def inverted(self):\n"
+            "        with self._send_lock:\n"
+            "            with self._lock:\n"
+            "                pass\n"
+            "    def scribble(self, payload):\n"
+            "        from nnstreamer_tpu.query.protocol import "
+            "decode_tensors\n"
+            "        views = decode_tensors(payload)\n"
+            "        views[0].flags.writeable = True\n"
+            "        views[0][0] = 1\n")
+        got = {v.rule for v in nnslint.lint_paths([str(bad)])}
+        assert {"sleep-poll", "io-under-lock", "lock-order",
+                "unknown-lock", "readonly-view-mutation"} <= got
+
+    def test_pragma_suppresses(self, tmp_path):
+        bad = tmp_path / "pragma.py"
+        bad.write_text(
+            "import time\n"
+            "def poll():\n"
+            "    while True:\n"
+            "        # cross-process wait  # nnslint: allow(sleep-poll)\n"
+            "        time.sleep(0.01)\n")
+        assert nnslint.lint_paths([str(bad)]) == []
+
+    def test_backoff_sleeps_allowed(self, tmp_path):
+        ok = tmp_path / "backoff.py"
+        ok.write_text(
+            "import time\n"
+            "def retry(policy):\n"
+            "    for attempt in range(3):\n"
+            "        time.sleep(policy.delay(attempt))\n")
+        assert nnslint.lint_paths([str(ok)]) == []
+
+    def test_tracer_rule_guards_untraced_executor(self, tmp_path):
+        sched = tmp_path / "pipeline"
+        sched.mkdir()
+        bad = sched / "schedule.py"
+        bad.write_text(
+            "class P:\n"
+            "    def _make_executor(self, head, steps, tail_pad):\n"
+            "        tracer = self.pipeline.tracer\n"
+            "        def run(buf, _tracer=tracer):\n"
+            "            return _tracer\n"
+            "        return run\n")
+        got = {v.rule for v in nnslint.lint_paths([str(bad)])}
+        assert "tracer-in-untraced-plan" in got
+
+
+# ==========================================================================
+# runtime sanitizer
+# ==========================================================================
+
+class TestSanitizerLocks:
+    def test_seeded_inversion_reports_cycle_with_both_stacks(
+            self, clean_sanitizer):
+        sanitizer.enable(strict=False)
+        a = sanitizer.make_lock("query.registry")
+        b = sanitizer.make_lock("query.send")
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        def backward():
+            with b:
+                with a:
+                    pass
+
+        for fn in (forward, backward):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        kinds = {f.kind for f in sanitizer.findings()}
+        assert "lock-hierarchy" in kinds      # inversion vs hierarchy
+        assert "lock-cycle" in kinds          # a->b AND b->a observed
+        cycle = [f for f in sanitizer.findings()
+                 if f.kind == "lock-cycle"][0]
+        assert len(cycle.stacks) == 2         # both directions' stacks
+        assert "query.send" in cycle.message \
+            and "query.registry" in cycle.message
+
+    def test_strict_mode_raises_at_the_inversion_site(
+            self, clean_sanitizer):
+        sanitizer.enable(strict=True)
+        outer = sanitizer.make_lock("pool")      # rank 80
+        inner = sanitizer.make_lock("planner")   # rank 10: must come first
+        with outer:
+            with pytest.raises(sanitizer.LockOrderError,
+                               match="hierarchy"):
+                inner.acquire()
+
+    def test_same_class_nesting_is_instance_safe(self, clean_sanitizer):
+        sanitizer.enable(strict=True)
+        up = sanitizer.make_lock("queue.space")
+        down = sanitizer.make_lock("queue.space")
+        with up:       # upstream queue holds its slot condition...
+            with down:  # ...while a downstream queue takes its own
+                pass
+        assert sanitizer.findings() == []
+
+    def test_pipeline_run_under_sanitizer_is_finding_free(
+            self, clean_sanitizer):
+        """The declared hierarchy matches the real acquisition order of
+        a streaming pipeline crossing a queue boundary (instrumented
+        conditions must also keep Condition.wait semantics intact)."""
+        sanitizer.enable(strict=False)
+        p = parse_launch(
+            "videotestsrc num-buffers=8 ! "
+            "video/x-raw,format=RGB,width=8,height=8,framerate=30/1 ! "
+            "tensor_converter ! queue max-size-buffers=2 ! "
+            "tensor_transform mode=typecast option=float32 ! "
+            "tensor_sink name=out")
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b.pts))
+        p.run(timeout=60)
+        assert len(got) == 8
+        assert sanitizer.findings() == [], sanitizer.report()
+
+
+class TestSanitizerAliasing:
+    def _leased_views(self, pool):
+        from nnstreamer_tpu.query.protocol import (decode_tensors,
+                                                   encode_tensors)
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        src = TensorBuffer(
+            tensors=[np.arange(12, dtype=np.float32).reshape(3, 4)])
+        blob = encode_tensors(src)
+        lease = pool.acquire(len(blob))
+        lease.memory()[:] = blob
+        views = decode_tensors(lease.memory())
+        buf = TensorBuffer(tensors=views, pts=0, lease=lease)
+        return lease, views, buf
+
+    def test_seeded_aliasing_write_detected(self, clean_sanitizer):
+        from nnstreamer_tpu.tensor.buffer import TensorBufferPool
+
+        sanitizer.enable(strict=False)
+        pool = TensorBufferPool()
+        lease, views, buf = self._leased_views(pool)
+        lease.memory()            # writable grant with live views
+        finds = [f for f in sanitizer.findings() if f.kind == "aliasing"]
+        assert finds, "aliasing write grant not detected"
+        assert "live zero-copy view" in finds[0].message
+        assert len(finds[0].stacks) == 2   # view creation + grant site
+
+    def test_strict_mode_raises_aliasing_error(self, clean_sanitizer):
+        from nnstreamer_tpu.tensor.buffer import TensorBufferPool
+
+        sanitizer.enable(strict=True)
+        pool = TensorBufferPool()
+        lease, views, buf = self._leased_views(pool)
+        with pytest.raises(sanitizer.AliasingError, match="live"):
+            lease.memory()
+
+    def test_write_attempt_raises_clear_error(self, clean_sanitizer):
+        from nnstreamer_tpu.tensor.buffer import TensorBufferPool
+
+        sanitizer.enable(strict=True)
+        pool = TensorBufferPool()
+        lease, views, buf = self._leased_views(pool)
+        with pytest.raises(sanitizer.AliasingError, match="zero-copy"):
+            views[0][0, 0] = 5.0
+
+    def test_slab_reissue_with_live_view_detected(self, clean_sanitizer):
+        sanitizer.enable(strict=False)
+        slab = bytearray(16)
+        view = np.frombuffer(slab, np.uint8)
+        sanitizer.note_views(slab, [view])
+        sanitizer.check_slab_reissue(slab)
+        finds = [f for f in sanitizer.findings() if f.kind == "aliasing"]
+        assert finds and "re-issue" in finds[0].stacks[1]
+        del view
+
+    def test_pool_recycles_under_sanitizer(self, clean_sanitizer):
+        """The instrumented lock must honor acquire(blocking=False) —
+        the pool's __del__-safe reclaim depends on it (a plain Lock
+        forbids a timeout with blocking=False)."""
+        from nnstreamer_tpu.tensor.buffer import TensorBufferPool
+
+        sanitizer.enable(strict=True)
+        lock = sanitizer.make_lock("pool")
+        assert lock.acquire(blocking=False) is True
+        assert lock.acquire(False) is False   # contended, no deadlock
+        lock.release()
+        pool = TensorBufferPool()
+        for _ in range(3):
+            lease = pool.acquire(64)
+            lease.memory()[:] = b"x" * 64
+            lease.release()
+        assert pool.stats["hits"] >= 1, pool.stats
+        assert sanitizer.findings() == [], sanitizer.report()
+
+    def test_normal_transport_flow_is_clean(self, clean_sanitizer):
+        """recv-into-slab then decode then drop: the pool's refcount
+        parking keeps reuse safe; the sanitizer must agree."""
+        from nnstreamer_tpu.tensor.buffer import TensorBufferPool
+
+        sanitizer.enable(strict=True)
+        pool = TensorBufferPool()
+        for _ in range(4):
+            lease, views, buf = self._leased_views(pool)
+            assert float(np.asarray(views[0]).sum()) == 66.0
+            del lease, views, buf
+        assert sanitizer.findings() == [], sanitizer.report()
+
+
+# ==========================================================================
+# decode_tensors read-only contract (satellite)
+# ==========================================================================
+
+class TestReadOnlyViews:
+    def _decoded(self):
+        from nnstreamer_tpu.query.protocol import (decode_tensors,
+                                                   encode_tensors)
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        src = TensorBuffer(
+            tensors=[np.arange(12, dtype=np.float32).reshape(3, 4)])
+        return decode_tensors(encode_tensors(src))
+
+    def test_views_are_readonly_and_numpy_rejects_writes(self):
+        views = self._decoded()
+        assert not views[0].flags.writeable
+        with pytest.raises(ValueError):
+            views[0][0, 0] = 1.0
+
+    def test_readonly_sticks_through_reshape(self):
+        arr = self._decoded()[0]
+        reshaped = arr.reshape(4, 3)
+        assert not reshaped.flags.writeable
+        with pytest.raises(ValueError):
+            reshaped[0, 0] = 1.0
+
+    def test_readonly_survives_tensor_transform(self):
+        """tensor_transform must stay out-of-place on shared views: the
+        transform succeeds AND the input view stays untouched."""
+        from nnstreamer_tpu.elements.transform import TensorTransform
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+        from nnstreamer_tpu.tensor.info import (TensorInfo, TensorsConfig,
+                                                TensorsInfo)
+        from nnstreamer_tpu.tensor.types import TensorType
+
+        views = self._decoded()
+        t = TensorTransform("t", mode="arithmetic",
+                            option="per-channel:true@0,add:1@0")
+        t.start()
+        t._out_config = TensorsConfig(
+            info=TensorsInfo([TensorInfo(TensorType.FLOAT32, (4, 3))]),
+            rate=None)
+        out = t._transform(views[0], TensorType.FLOAT32)
+        assert out[0, 0] == views[0][0, 0] + 1.0
+        assert not views[0].flags.writeable
+        assert float(views[0][0, 0]) == 0.0   # input untouched
+
+    def test_transform_dimchg_keeps_readonly(self):
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        views = self._decoded()
+        t = TensorTransform("t", mode="dimchg", option="0:1")
+        t.start()
+        out = t._transform(views[0])
+        # a pure view transform keeps the read-only flag: nothing may
+        # ever flip it back on the shared payload
+        assert not out.flags.writeable or out.base is None
+
+
+# ==========================================================================
+# event-driven waits (satellite: repo.py spin + shm fallback waits)
+# ==========================================================================
+
+class TestEventDrivenWaits:
+    def test_repo_caps_wait_wakes_on_registration(self):
+        from nnstreamer_tpu.elements.repo import repo
+
+        repo.clear()
+        t0 = time.monotonic()
+        threading.Timer(0.15, lambda: repo.set_caps(
+            77, "other/tensors,format=static")).start()
+        got = repo.wait_caps(77, timeout=5.0)
+        elapsed = time.monotonic() - t0
+        assert got is not None
+        # event-driven: wakes on notify, far below the 5 s deadline (the
+        # old 20 ms poll would also pass this, but the point is the
+        # no-deadline-ride-out on the cancel path below)
+        assert elapsed < 2.0
+        repo.clear()
+
+    def test_repo_caps_wait_cancellable(self):
+        from nnstreamer_tpu.elements.repo import repo
+
+        repo.clear()
+        cancelled = threading.Event()
+
+        def cancel():
+            cancelled.set()
+            repo.wake()
+
+        t0 = time.monotonic()
+        threading.Timer(0.1, cancel).start()
+        got = repo.wait_caps(78, timeout=10.0,
+                             cancelled=cancelled.is_set)
+        assert got is None
+        assert time.monotonic() - t0 < 5.0   # did not ride out 10 s
+        repo.clear()
+
+    def test_shm_fallback_pop_wakes_on_same_process_push(
+            self, monkeypatch, tmp_path):
+        from nnstreamer_tpu.query import shm as shm_mod
+
+        monkeypatch.setattr(shm_mod, "_native_lib", lambda: None)
+        name = f"nns-test-evt-{os.getpid()}"
+        prod = shm_mod.ShmRing(name, create=True, slot_bytes=1024,
+                               n_slots=4, caps="c")
+        cons = shm_mod.ShmRing(name, create=False, timeout=5.0)
+        assert not prod.is_native and not cons.is_native
+        out = {}
+
+        def consume():
+            out["rec"] = cons.pop(timeout=10.0)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.1)            # consumer parks on the empty ring
+        t0 = time.monotonic()
+        prod.push(b"hello", pts=7)
+        t.join(timeout=5.0)
+        latency = time.monotonic() - t0
+        assert out["rec"] == (b"hello", 7)
+        assert latency < 1.0       # notify, not a timed-poll ride-out
+        prod.eos()
+        cons.close()
+        prod.close(unlink=True)
+
+    def test_shm_fallback_eos_wakes_blocked_consumer(
+            self, monkeypatch):
+        from nnstreamer_tpu.query import shm as shm_mod
+
+        monkeypatch.setattr(shm_mod, "_native_lib", lambda: None)
+        name = f"nns-test-eos-{os.getpid()}"
+        prod = shm_mod.ShmRing(name, create=True, slot_bytes=1024,
+                               n_slots=4, caps="c")
+        cons = shm_mod.ShmRing(name, create=False, timeout=5.0)
+        out = {}
+
+        def consume():
+            out["rec"] = cons.pop(timeout=10.0)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.1)
+        prod.eos()
+        t.join(timeout=5.0)
+        assert not t.is_alive() and out["rec"] is None
+        cons.close()
+        prod.close(unlink=True)
+
+
+# ==========================================================================
+# lock hierarchy registry
+# ==========================================================================
+
+class TestLockOrderRegistry:
+    def test_every_make_lock_site_is_declared(self):
+        """Scan the package for make_lock/make_rlock/make_condition
+        call sites: every name must have a rank (nnslint enforces this
+        too; this is the direct registry check)."""
+        pkg = os.path.join(REPO, "nnstreamer_tpu")
+        names = set()
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                with open(os.path.join(dirpath, fn), encoding="utf-8") \
+                        as fh:
+                    tree = ast.parse(fh.read())
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.Call):
+                        f = node.func
+                        fname = (f.id if isinstance(f, ast.Name)
+                                 else getattr(f, "attr", ""))
+                        if fname in ("make_lock", "make_rlock",
+                                     "make_condition") and node.args \
+                                and isinstance(node.args[0], ast.Constant):
+                            names.add(node.args[0].value)
+        assert names, "no instrumented lock sites found"
+        undeclared = {n for n in names if lockorder.rank_of(n) is None}
+        assert not undeclared, undeclared
+
+    def test_check_order_direction(self):
+        assert lockorder.check_order("planner", "pool") is None
+        assert lockorder.check_order("pool", "planner") is not None
+        assert lockorder.check_order("queue.space", "queue.space") is None
+        assert lockorder.check_order("pool", "pool") is not None
