@@ -371,6 +371,28 @@ def _effective_inflight(pipeline=None) -> int:
     return max(1, INFLIGHT) if STREAM_BATCH > 1 else 1
 
 
+def _trace_breakdown(model_name, size, decoder, dtype_prop,
+                     decoder_opts, src_cache) -> dict:
+    """Per-element proctime/interlatency breakdown from ONE short traced
+    pass — a separate run so the headline fps numbers stay untraced
+    (fused plans with zero tracer references).  Attached to BENCH rows
+    as ``trace`` so artifacts carry where the time went, not just the
+    end-to-end fps."""
+    p = _model_pipeline(model_name, size, decoder, dtype_prop,
+                        decoder_opts, src_cache,
+                        n_frames=max(30, min(N_FRAMES, 120)))
+    tracer = p.enable_tracing()
+    try:
+        p.run(timeout=_extras_budget() + 60)
+    finally:
+        p.stop()
+    keep = ("buffers", "proctime_avg_us", "proctime_p50_us",
+            "proctime_p95_us", "proctime_p99_us", "fps",
+            "interlatency_avg_us", "interlatency_p99_us")
+    return {el: {k: v for k, v in row.items() if k in keep}
+            for el, row in tracer.report().items()}
+
+
 def bench_model(name: str, model_name: str, size: int, decoder: str,
                 dtype_prop: str, decoder_opts: str = "",
                 emit=None, src_cache: str = "cache-frames",
@@ -415,6 +437,18 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
             # LAST parsed line, so a completed enriched line supersedes
             # this one)
             emit(out)
+        from nnstreamer_tpu.utils.conf import parse_bool
+
+        if parse_bool(os.environ.get("NNS_TPU_BENCH_TRACE", "1")) \
+                and _extras_budget() > 30:
+            try:
+                out["trace"] = _trace_breakdown(
+                    model_name, size, decoder, dtype_prop, decoder_opts,
+                    src_cache)
+                if emit is not None:
+                    emit(out)
+            except Exception:   # the breakdown is a bonus column; its
+                pass            # failure must never cost the fps row
         if fps2 and abs(fps1 - fps2) / max(fps1, fps2) > 0.2:
             # the stability bar is two runs within 20%; when a window
             # misses it, re-profile the link so the artifact itself

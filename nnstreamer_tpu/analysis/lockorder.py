@@ -48,6 +48,11 @@ HIERARCHY: Dict[str, int] = {
     "query.send": 60,       # per-connection/stream send locks
     # observability / memory -----------------------------------------------
     "tracer": 70,           # Tracer stats table
+    "obs.ring": 72,         # SpanRing append/snapshot (obs/span.py)
+    "obs.metrics": 74,      # metrics registry + per-metric state
+    #                         (obs/metrics.py; scrape snapshots under the
+    #                         registry lock, then evaluates gauges
+    #                         outside it)
     "pool": 80,             # TensorBufferPool free lists
     "lease": 85,            # BufferLease refcount
     "pipeline.state": 90,   # Pipeline error/EOS condition (post_error

@@ -358,8 +358,27 @@ class TensorFilter(Element):
                 target=self._deadline_loop, daemon=True,
                 name=f"batch-deadline:{self.name}")
             self._deadline_thread.start()
+        # scheduler-state gauges, evaluated only at /metrics scrape time
+        # (obs/metrics.py lazy-callable contract: zero per-frame cost);
+        # pipeline-labeled + identity-unregistered so concurrent
+        # pipelines with same-named filters don't fight over keys
+        from ..obs.metrics import REGISTRY, Gauge
+
+        labels = {"element": self.name,
+                  "pipeline": getattr(self.pipeline, "name", "") or ""}
+        self._obs_gauges = [REGISTRY.register(Gauge(n, labels, fn=f))
+                            for n, f in (
+            ("nns_filter_batch_size", lambda: self._batch),
+            ("nns_filter_inflight", lambda: len(self._inflight)),
+            ("nns_filter_pending", lambda: len(self._pending)),
+            ("nns_filter_dropped", lambda: self.dropped))]
 
     def stop(self):
+        from ..obs.metrics import REGISTRY
+
+        for gauge in getattr(self, "_obs_gauges", ()):
+            REGISTRY.unregister(gauge)
+        self._obs_gauges = []
         self._deadline_stop.set()
         if self._deadline_thread is not None:
             self._deadline_thread.join(timeout=10)

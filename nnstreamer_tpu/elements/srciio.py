@@ -107,6 +107,7 @@ class TensorSrcIIO(Source):
         base = str(self.base_dir)
         self._dev_dir = self._find_device(base)
         self._count = 0
+        self._pace_origin_ns = None   # first-sample monotonic anchor
         self._chardev = None
         if str(self.mode) == "buffer":
             self._channels = self._scan_buffer_channels(self._dev_dir)
@@ -366,7 +367,18 @@ class TensorSrcIIO(Source):
         buf = TensorBuffer(tensors=tensors, pts=pts,
                            duration=SECOND // freq)
         self._count += 1
-        # pace to the requested frequency (reference polls at trigger rate)
-        if limit < 0 or self._count < limit:
-            time.sleep(1.0 / freq if freq < 1000 else 0)
+        # pace to the requested frequency against an ABSOLUTE monotonic
+        # deadline ladder: relative time.sleep(1/freq) drifts by the
+        # per-sample processing time (so rate metrics read low), and a
+        # plain sleep is uncancellable — the event wait returns the
+        # moment stop() sets _halted, and a late sample shortens the
+        # next wait instead of pushing every later deadline out
+        if (limit < 0 or self._count < limit) and freq < 1000:
+            if self._pace_origin_ns is None:
+                self._pace_origin_ns = time.monotonic_ns()
+            deadline_ns = (self._pace_origin_ns
+                           + self._count * SECOND // freq)
+            wait_s = (deadline_ns - time.monotonic_ns()) / 1e9
+            if wait_s > 0:
+                self._halted.wait(wait_s)
         return buf

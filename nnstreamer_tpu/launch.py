@@ -90,7 +90,27 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="record per-element proctime/framerate (GstShark "
                          "tracer role) and print the report at EOS "
-                         "(includes the fused segment plan)")
+                         "(includes the fused segment plan, p50/p95/p99 "
+                         "latency percentiles, source→element "
+                         "interlatency, and the live metrics snapshot)")
+    ap.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="write the --trace report as JSON to FILE "
+                         "(machine-readable twin of the stderr report; "
+                         "implies tracing)")
+    ap.add_argument("--timeline", default=None, metavar="FILE",
+                    help="record per-buffer timeline spans and write a "
+                         "Chrome trace_event JSON to FILE at EOS "
+                         "(Perfetto/chrome://tracing renders streaming "
+                         "threads, queue handoffs and filter-worker "
+                         "overlap; spans harvested from remote "
+                         "tensor_query servers merge in under their own "
+                         "process row)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve live Prometheus metrics on "
+                         "127.0.0.1:PORT while the pipeline runs "
+                         "(GET /metrics; same effect as "
+                         "NNS_METRICS_PORT)")
     ap.add_argument("--no-fuse", action="store_true",
                     help="disable the segment compiler: interpreted "
                          "per-pad dispatch (the baseline "
@@ -132,8 +152,15 @@ def main(argv=None) -> int:
             for el in p.elements:
                 if hasattr(el, "latency_report"):
                     el.latency_report = True
-        tracer = p.enable_tracing() if args.trace else None
+        if args.metrics_port is not None:
+            from .obs.httpd import start_metrics_server
+
+            start_metrics_server(args.metrics_port)
+        want_trace = args.trace or args.trace_out or args.timeline
+        tracer = (p.enable_tracing(spans=bool(args.timeline))
+                  if want_trace else None)
         plans = None
+        metrics = None
         if args.jax_trace:
             import jax
 
@@ -143,6 +170,13 @@ def main(argv=None) -> int:
             p.wait(args.timeout)
             if tracer is not None and p.planner is not None:
                 plans = p.planner.plans()   # snapshot before stop() drops it
+            if tracer is not None:
+                # snapshot the LIVE registry before stop(): element
+                # teardown unregisters the queue/filter gauges, and the
+                # report should show the running pipeline's state
+                from .obs.metrics import REGISTRY
+
+                metrics = REGISTRY.report()
             if args.stats:
                 total, per = p.query_latency()
                 for name, ns in sorted(per.items()):
@@ -182,7 +216,26 @@ def main(argv=None) -> int:
                     # retry/failure/breaker/heartbeat counters from the
                     # query layer (query/resilience.py), this run only
                     report["resilience"] = resilience
-                print(_json.dumps(report, indent=2), file=sys.stderr)
+                if metrics is None:   # error/timeout path: post-stop view
+                    from .obs.metrics import REGISTRY
+
+                    metrics = REGISTRY.report()
+                if metrics:
+                    # the live-endpoint view embedded in the report:
+                    # queue depths, pool occupancy, filter scheduler
+                    # state, per-element latency summaries
+                    report["metrics"] = metrics
+                if args.timeline:
+                    tracer.export_chrome(args.timeline)
+                    print(f"timeline written to {args.timeline}",
+                          file=sys.stderr)
+                if args.trace_out:
+                    with open(args.trace_out, "w",
+                              encoding="utf-8") as fh:
+                        _json.dump(report, fh, indent=2)
+                if args.trace or not (args.trace_out or args.timeline):
+                    print(_json.dumps(report, indent=2),
+                          file=sys.stderr)
     except Exception as exc:  # noqa: BLE001
         print(f"ERROR: {exc}", file=sys.stderr)
         return 1

@@ -1,0 +1,32 @@
+"""Observability layer: spans, trace context, metrics, live endpoint.
+
+The reference's profiling story is external GstShark tracers bolted onto
+GStreamer (``proctime``/``framerate``/``interlatency``); the NNStreamer
+papers (arXiv:1901.04985, arXiv:2101.06371) make per-element pipeline
+profiling the core argument for the stream paradigm.  This package is
+the built-in equivalent, designed around the same zero-cost-when-off
+discipline as ``pipeline/tracing.py``:
+
+- :mod:`~nnstreamer_tpu.obs.clock` — monotonic/wall clock helpers and
+  the peer clock-offset estimator (NTP-midpoint style, over the query
+  heartbeat/reply stamps).
+- :mod:`~nnstreamer_tpu.obs.span` — per-buffer timeline spans, the
+  bounded span ring, the compact wire trace-context, and Chrome
+  ``trace_event`` export (Perfetto-renderable).
+- :mod:`~nnstreamer_tpu.obs.metrics` — counters / gauges / log-bucket
+  latency histograms with p50/p95/p99, a process-wide registry, and
+  Prometheus text rendering.
+- :mod:`~nnstreamer_tpu.obs.httpd` — the pull-based ``NNS_METRICS_PORT``
+  HTTP endpoint serving the registry.
+
+Nothing in this package runs on the dataflow hot path unless a tracer
+with span recording is attached: metrics are lazy callable gauges
+evaluated at scrape time, and untraced compiled plans contain zero obs
+references (enforced by ``tools/hotpath_bench.py --stage obs --assert``).
+"""
+
+from .clock import OffsetEstimator, mono_ns, wall_us  # noqa: F401
+from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry)
+from .span import (Span, SpanRing, TraceContext,  # noqa: F401
+                   chrome_trace_events, new_trace_id)

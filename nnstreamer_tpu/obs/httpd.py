@@ -1,0 +1,101 @@
+"""Live metrics endpoint: ``GET /metrics`` in Prometheus text format.
+
+Pull-based by design (the zero-hot-path-cost contract of obs/metrics.py
+only holds when evaluation happens at scrape time): a tiny
+``ThreadingHTTPServer`` on ``NNS_METRICS_PORT`` serves
+
+- ``/metrics`` — Prometheus text exposition of the process registry
+  (plus the PR 1 resilience counters), and
+- ``/healthz`` — ``200 ok`` liveness.
+
+Activation is explicit (``start_metrics_server``) or environmental
+(``maybe_start_from_env`` — called once from ``Pipeline.play()`` and
+``launch.py``): an unset ``NNS_METRICS_PORT`` costs one cached getenv
+per process, nothing per pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..analysis.sanitizer import make_lock
+from .metrics import REGISTRY, MetricsRegistry
+
+_STATE_LOCK = make_lock("leaf")
+_SERVER: Optional[ThreadingHTTPServer] = None
+_ENV_TRIED = False
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry = REGISTRY
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        if self.path.split("?", 1)[0] == "/metrics":
+            body = self.registry.render_prometheus().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif self.path == "/healthz":
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-scrape stderr spam
+        pass
+
+
+def start_metrics_server(port: int, host: str = "127.0.0.1",
+                         registry: MetricsRegistry = REGISTRY
+                         ) -> ThreadingHTTPServer:
+    """Start the endpoint on ``host:port`` (port 0 = ephemeral; read the
+    bound port from ``server.server_address[1]``).  Idempotent per
+    process: a second call returns the running server."""
+    global _SERVER
+    with _STATE_LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        handler = type("_BoundHandler", (_Handler,),
+                       {"registry": registry})
+        server = ThreadingHTTPServer((host, int(port)), handler)
+        server.daemon_threads = True
+        threading.Thread(target=server.serve_forever, daemon=True,
+                         name="nns-metrics").start()
+        _SERVER = server
+        return server
+
+
+def stop_metrics_server() -> None:
+    global _SERVER
+    with _STATE_LOCK:
+        server, _SERVER = _SERVER, None
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+
+
+def maybe_start_from_env() -> Optional[ThreadingHTTPServer]:
+    """Start the endpoint when ``NNS_METRICS_PORT`` is set (once per
+    process; a malformed value logs and disables rather than killing
+    the pipeline that happened to trigger the first check)."""
+    global _ENV_TRIED
+    if _ENV_TRIED:
+        return _SERVER
+    _ENV_TRIED = True
+    raw = os.environ.get("NNS_METRICS_PORT")
+    if not raw:
+        return None
+    try:
+        return start_metrics_server(int(raw))
+    except (ValueError, OSError) as exc:
+        from ..utils.log import ml_logw
+
+        ml_logw("NNS_METRICS_PORT=%r: metrics endpoint disabled (%s)",
+                raw, exc)
+        return None

@@ -1,0 +1,312 @@
+"""Metrics registry: counters, gauges, log-bucket latency histograms.
+
+The pull model is the design constraint: the dataflow hot path must not
+pay for metrics it is not producing.  So:
+
+- **Gauges are lazy**: register a callable (queue depth, pool
+  occupancy, filter inflight) and it is evaluated at *scrape* time —
+  zero instructions per buffer.
+- **Histograms are push** but only written by code that is already
+  observing (a :class:`~nnstreamer_tpu.pipeline.tracing.Tracer` with
+  its per-element clock reads); no tracer, no writes.
+- **Counters** wrap the same monotonic-int contract as
+  ``query/resilience.py`` STATS (which the registry bridges at render
+  time rather than duplicating).
+
+Histogram buckets are fixed log-spaced: ``factor = 2**(1/4)`` (~19 %
+relative width), so quantiles interpolated at the geometric bucket
+midpoint land within ~9 % of the true value — tight enough for p50/p95/
+p99 latency reporting with a 128-slot fixed footprint and O(1) observe.
+
+``render_prometheus()`` emits Prometheus text exposition (counters and
+gauges as-is, histograms as summaries with quantile labels) — the
+``NNS_METRICS_PORT`` endpoint (obs/httpd.py) serves exactly this.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..analysis.sanitizer import make_lock
+
+#: buckets per factor-of-2 (quarter-octave): bucket i covers
+#: [2**(i/4), 2**((i+1)/4))
+_SUB = 4
+_LOG2_SUB = _SUB / math.log(2.0)
+_NBUCKETS = 128            # covers [1, 2**32) — 1 µs .. ~71 min in µs
+
+
+def _bucket_of(value: float) -> int:
+    if value <= 1.0:
+        return 0
+    i = int(math.log(value) * _LOG2_SUB)
+    return i if i < _NBUCKETS else _NBUCKETS - 1
+
+
+def _bucket_mid(i: int) -> float:
+    """Geometric midpoint of bucket ``i`` (the quantile interpolant)."""
+    return 2.0 ** ((i + 0.5) / _SUB)
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter."""
+
+    def __init__(self, name: str, labels: Dict[str, str]) -> None:
+        self.name = name
+        self.labels = labels
+        self._value = 0
+        self._lock = make_lock("obs.metrics")
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value: either explicitly ``set()`` or backed by a
+    callable evaluated at scrape time (the zero-hot-path-cost form)."""
+
+    def __init__(self, name: str, labels: Dict[str, str],
+                 fn: Optional[Callable[[], float]] = None) -> None:
+        self.name = name
+        self.labels = labels
+        self.fn = fn
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        if self.fn is not None:
+            try:
+                return float(self.fn())
+            except Exception:   # noqa: BLE001 — a dead provider (stopped
+                return float("nan")   # element) must not break the scrape
+        return self._value
+
+
+class Histogram:
+    """Fixed log-bucket histogram with quantile estimation.
+
+    ``observe`` is O(1): one log, one increment.  128 quarter-octave
+    buckets cover 1 µs .. ~71 min when observations are microseconds
+    (the unit every caller in this package uses).
+    """
+
+    __slots__ = ("name", "labels", "counts", "count", "total", "vmin",
+                 "vmax", "_lock")
+
+    def __init__(self, name: str, labels: Dict[str, str]) -> None:
+        self.name = name
+        self.labels = labels
+        self.counts = [0] * _NBUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = 0.0
+        self._lock = make_lock("obs.metrics")
+
+    def observe(self, value: float) -> None:
+        i = _bucket_of(value)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.total += value
+            if value < self.vmin:
+                self.vmin = value
+            if value > self.vmax:
+                self.vmax = value
+
+    def quantile(self, q: float) -> float:
+        """Estimated ``q``-quantile (geometric bucket-midpoint
+        interpolation); 0.0 when empty."""
+        with self._lock:
+            n = self.count
+            if n == 0:
+                return 0.0
+            target = q * n
+            seen = 0
+            for i, c in enumerate(self.counts):
+                seen += c
+                if seen >= target:
+                    mid = _bucket_mid(i)
+                    # clamp to observed range: the edge buckets would
+                    # otherwise report midpoints outside the data
+                    return min(max(mid, self.vmin), self.vmax)
+            return self.vmax
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            n = self.count
+            if n == 0:
+                return {"count": 0}
+            mean = self.total / n
+        return {"count": n, "mean": round(mean, 2),
+                "min": round(self.vmin, 2), "max": round(self.vmax, 2),
+                "p50": round(self.quantile(0.50), 2),
+                "p95": round(self.quantile(0.95), 2),
+                "p99": round(self.quantile(0.99), 2)}
+
+
+class MetricsRegistry:
+    """Process-wide metric table.
+
+    Metrics are identified by (name, labels); re-registering returns
+    the existing instance so call sites need no get-or-create dance.
+    ``unregister_matching`` lets elements drop their gauges at stop.
+    """
+
+    def __init__(self) -> None:
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                            Any] = {}
+        self._lock = make_lock("obs.metrics")
+
+    def _key(self, name: str, labels: Dict[str, str]):
+        return (name, tuple(sorted(labels.items())))
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get_or_make(Counter, name, labels)
+
+    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None,
+              **labels: str) -> Gauge:
+        g = self._get_or_make(Gauge, name, labels, fn=fn)
+        if fn is not None:
+            g.fn = fn           # re-registration rebinds the provider
+        return g
+
+    def histogram(self, name: str, **labels: str) -> Histogram:
+        return self._get_or_make(Histogram, name, labels)
+
+    def _get_or_make(self, cls, name: str, labels: Dict[str, str],
+                     **kw) -> Any:
+        key = self._key(name, labels)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None or m.__class__ is not cls:
+                m = self._metrics[key] = cls(name, dict(labels), **kw)
+            return m
+
+    def register(self, metric: Any) -> Any:
+        """Install (or REPLACE) a caller-constructed metric under its
+        (name, labels) key.  Replacement is the point: a freshly
+        attached tracer's per-element histograms must supersede a prior
+        run's instances so the endpoint serves the live distributions,
+        not an accumulation across runs."""
+        key = self._key(metric.name, metric.labels)
+        with self._lock:
+            self._metrics[key] = metric
+        return metric
+
+    def unregister(self, metric: Any) -> bool:
+        """Remove ``metric`` ONLY if it is still the registered instance
+        for its key.  The identity check is what makes element teardown
+        safe when names collide: if a later pipeline re-registered the
+        same (name, labels) key, stopping the earlier element must not
+        delete the live provider."""
+        key = self._key(metric.name, metric.labels)
+        with self._lock:
+            if self._metrics.get(key) is metric:
+                del self._metrics[key]
+                return True
+            return False
+
+    def unregister_matching(self, name: str, **labels: str) -> int:
+        """Drop every metric with this name whose labels are a superset
+        of ``labels``; returns how many were removed."""
+        want = set(labels.items())
+        with self._lock:
+            victims = [k for k, m in self._metrics.items()
+                       if m.name == name and want <= set(k[1])]
+            for k in victims:
+                del self._metrics[k]
+            return len(victims)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def _snapshot(self) -> List[Any]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- rendering -----------------------------------------------------------
+    def report(self) -> Dict[str, Any]:
+        """JSON-friendly snapshot (embedded in ``launch.py --trace``
+        reports next to the tracer's per-element table)."""
+        out: Dict[str, Any] = {}
+        for m in self._snapshot():
+            key = m.name + _label_str(m.labels)
+            if isinstance(m, Histogram):
+                out[key] = m.snapshot()
+            else:
+                v = m.value
+                out[key] = round(v, 4) if isinstance(v, float) else v
+        for name, value in _resilience_items():
+            out.setdefault(name, value)
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format, v0.0.4."""
+        lines: List[str] = []
+        seen_families = set()
+
+        def family(name: str, kind: str, help_: str) -> None:
+            if name not in seen_families:
+                seen_families.add(name)
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {kind}")
+
+        for m in self._snapshot():
+            if isinstance(m, Counter):
+                family(m.name, "counter", "nnstreamer_tpu counter")
+                lines.append(f"{m.name}{_label_str(m.labels)} {m.value}")
+            elif isinstance(m, Gauge):
+                family(m.name, "gauge", "nnstreamer_tpu gauge")
+                v = m.value
+                val = "NaN" if v != v else repr(round(v, 6))
+                lines.append(f"{m.name}{_label_str(m.labels)} {val}")
+            elif isinstance(m, Histogram):
+                family(m.name, "summary", "nnstreamer_tpu latency summary")
+                base = dict(m.labels)
+                for q in (0.5, 0.95, 0.99):
+                    lbl = _label_str({**base, "quantile": str(q)})
+                    lines.append(f"{m.name}{lbl} "
+                                 f"{round(m.quantile(q), 3)}")
+                ls = _label_str(base)
+                lines.append(f"{m.name}_sum{ls} {round(m.total, 3)}")
+                lines.append(f"{m.name}_count{ls} {m.count}")
+        for name, value in _resilience_items():
+            family(name, "counter", "query resilience counter")
+            lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"
+
+
+def _resilience_items() -> List[Tuple[str, int]]:
+    """The PR 1 resilience counters (process-wide STATS), bridged into
+    the exposition under ``nns_resilience_*`` — the registry does not
+    duplicate their accounting, it renders their live snapshot."""
+    from ..query.resilience import STATS
+
+    out = []
+    for key, value in sorted(STATS.snapshot().items()):
+        name = "nns_resilience_" + key.replace(".", "_").replace("-", "_")
+        out.append((name, value))
+    return out
+
+
+#: process-wide registry (the endpoint serves this; elements register
+#: their gauges here)
+REGISTRY = MetricsRegistry()

@@ -299,11 +299,11 @@ class Element:
         try:
             if tracer is None:
                 return self.chain(pad, buf)
-            tracer.enter()
+            tracer.enter(self.name, buf)
             try:
                 return self.chain(pad, buf)
             finally:
-                tracer.exit(self.name)
+                tracer.exit()
         except Exception as exc:  # noqa: BLE001 - becomes pipeline error
             if self.pipeline is not None:
                 self.pipeline.post_error(self, exc)

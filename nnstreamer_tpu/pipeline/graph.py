@@ -177,6 +177,11 @@ class Pipeline:
 
         preflight(self)
         self._check_links()
+        # live metrics endpoint (obs/httpd.py): a no-op unless
+        # NNS_METRICS_PORT is set; checked once per process
+        from ..obs.httpd import maybe_start_from_env
+
+        maybe_start_from_env()
         for el in self.elements:
             try:
                 el.start()
@@ -205,14 +210,16 @@ class Pipeline:
                         "created sequentially: naming sink_N also creates "
                         "sink_0..sink_N-1, which must all be linked)")
 
-    def enable_tracing(self):
+    def enable_tracing(self, spans: bool = False):
         """Attach a dataflow tracer (proctime/framerate per element — the
         GstShark tracer role, tools/tracing/README.md).  Returns the
         :class:`~nnstreamer_tpu.pipeline.tracing.Tracer`; call
-        ``tracer.report()`` after the run."""
+        ``tracer.report()`` after the run.  ``spans=True`` additionally
+        records per-buffer timeline spans for Chrome-trace export
+        (``tracer.export_chrome``)."""
         from .tracing import Tracer
 
-        self.tracer = Tracer()
+        self.tracer = Tracer(spans=spans)
         if self.planner is not None:
             # compiled executors bind the tracer at compile time: rebuild
             self.planner.invalidate()
@@ -327,10 +334,35 @@ class Source(Element):
         try:
             caps = self.negotiate()
             self.announce_src_caps(caps)
+            seq = 0
             while not self._halted.is_set():
                 buf = self.create()
                 if buf is None:
                     break
+                pl = self.pipeline
+                if pl is not None and pl.tracer is not None:
+                    # source stamp: seq + birth time (the interlatency
+                    # origin, GstShark interlatency role) + the trace
+                    # context every transport sink forwards on the wire
+                    # (obs/span.py).  Only when a tracer is attached.
+                    tr = pl.tracer
+                    extra = buf.extra
+                    # seq/birth are overwritten per push: an app reusing
+                    # ONE buffer object for many frames (hotpath bench
+                    # style) must not measure frame k's interlatency
+                    # against frame 0's birth.  The trace context stays
+                    # first-writer-wins: a wire-restored context (query
+                    # server, edge/shm/mqtt src) must survive.
+                    extra["nns_seq"] = seq
+                    src_ns = extra["nns_src_ns"] = time.monotonic_ns()
+                    if "nns_trace" not in extra:
+                        from ..obs.span import TraceContext
+
+                        extra["nns_trace"] = TraceContext(
+                            tr.trace_id, 0,
+                            tr.anchor_wall_us
+                            + (src_ns - tr.anchor_mono_ns) // 1000)
+                seq += 1
                 ret = self.push(buf)
                 if ret in (FlowReturn.ERROR, FlowReturn.EOS):
                     break
@@ -374,6 +406,22 @@ class Queue(Element):
         self._worker = threading.Thread(target=self._drain,
                                         name=f"queue:{self.name}", daemon=True)
         self._stop = threading.Event()
+        # scrape-time depth gauges (obs/metrics.py lazy-callable
+        # contract: nothing on the buffer path, evaluated only when
+        # /metrics pulls).  Labeled with the owning pipeline and
+        # unregistered by IDENTITY at stop, so concurrent pipelines
+        # with same-named queues neither collide nor tear down each
+        # other's live gauges.
+        from ..obs.metrics import REGISTRY, Gauge
+
+        labels = {"queue": self.name,
+                  "pipeline": getattr(self.pipeline, "name", "") or ""}
+        self._obs_gauges = [
+            REGISTRY.register(Gauge("nns_queue_depth", labels,
+                                    fn=lambda: self._used)),
+            REGISTRY.register(Gauge("nns_queue_capacity", labels,
+                                    fn=lambda: self._cap)),
+        ]
         self._worker.start()
 
     def unblock(self):
@@ -381,6 +429,11 @@ class Queue(Element):
             self._space.notify_all()
 
     def stop(self):
+        from ..obs.metrics import REGISTRY
+
+        for gauge in getattr(self, "_obs_gauges", ()):
+            REGISTRY.unregister(gauge)
+        self._obs_gauges = []
         self._stop.set()
         with self._space:
             self._space.notify_all()

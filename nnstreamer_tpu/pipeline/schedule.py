@@ -228,11 +228,11 @@ class SegmentPlanner:
             el = None
             try:
                 for fn, el in _plan:
-                    _tracer.enter()
+                    _tracer.enter(el.name, buf)
                     try:
                         out = fn(buf)
                     finally:
-                        _tracer.exit(el.name)
+                        _tracer.exit()
                     if out is None:
                         return OK
                     if out.__class__ is FR:

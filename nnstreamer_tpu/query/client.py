@@ -25,17 +25,30 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis.sanitizer import make_lock, make_rlock
+from ..obs.clock import OffsetEstimator, wall_us
+from ..obs.span import TraceContext
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer, default_pool
 from ..tensor.caps_util import tensors_template_caps
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
-                       T_REPLY, decode_tensors, recv_msg, send_msg,
-                       send_tensors, shutdown_close)
+                       T_REPLY, T_TRACE, decode_tensors, recv_msg,
+                       send_msg, send_tensors, shutdown_close)
 from .protocol import create_connection as checked_connect
 from .resilience import (STATS, CircuitBreaker, CircuitOpenError,
                          HealthMonitor, RetryExhausted, RetryPolicy)
+
+
+class _PongWaiter:
+    """One outstanding ping: completion event + the pong's wall-clock
+    stamp (0 = peer predates the stamp)."""
+
+    __slots__ = ("evt", "epoch_us")
+
+    def __init__(self) -> None:
+        self.evt = threading.Event()
+        self.epoch_us = 0
 
 
 class QueryConnection:
@@ -64,8 +77,17 @@ class QueryConnection:
         self._seq = 0
         self._send_lock = make_lock("query.send")  # query+ping share the
         #                                            stream
-        self._pong_waiters: Dict[int, threading.Event] = {}
+        self._pong_waiters: Dict[int, "_PongWaiter"] = {}
         self._waiters_lock = make_lock("query.registry")
+        self._offset_sampled = float("-inf")   # last ping-sample time
+        #: server clock offset (NTP-midpoint over reply epoch stamps)
+        self.offset = OffsetEstimator()
+        #: T_TRACE span-batch payloads from the server, drained by the
+        #: client element into its pipeline tracer (bounded: a client
+        #: with no tracer silently ages them out)
+        import collections
+
+        self._trace_in: "collections.deque" = collections.deque(maxlen=256)
 
     def connect(self) -> None:
         def _dial():
@@ -127,51 +149,98 @@ class QueryConnection:
                 self.server_caps = msg.payload.decode()
             elif msg.type == T_REPLY:
                 self.replies.put(msg)
+            elif msg.type == T_TRACE:
+                # server timeline piggyback: park the raw JSON batch;
+                # the element thread parses and merges it (or it ages
+                # out of the bounded deque when no tracer wants it)
+                self._trace_in.append(bytes(msg.payload))
             elif msg.type == T_PONG:
                 with self._waiters_lock:
-                    evt = self._pong_waiters.pop(msg.seq, None)
-                if evt is not None:
-                    evt.set()
+                    waiter = self._pong_waiters.pop(msg.seq, None)
+                if waiter is not None:
+                    waiter.epoch_us = msg.epoch_us
+                    waiter.evt.set()
 
     def ping(self, timeout: float = 1.0) -> float:
         """Heartbeat probe: send ``T_PING``, await the matching
         ``T_PONG``.  Returns the RTT in seconds; raises ``TimeoutError``
-        / ``OSError`` on a dead or silent peer."""
-        self._seq += 1
-        seq = self._seq
-        evt = threading.Event()
+        / ``OSError`` on a dead or silent peer.
+
+        A pong's wall-clock stamp feeds the clock-offset estimator:
+        ping service time is near zero, so these are the samples that
+        bound the offset error by rtt/2 (a REPLY stamp rides on top of
+        model latency — its bias equals half the service time, which
+        min-RTT filtering then discards once a ping sample exists)."""
+        waiter = _PongWaiter()
         with self._waiters_lock:
-            self._pong_waiters[seq] = evt
+            # seq allocation must be atomic with waiter registration:
+            # the monitor probe thread, the element thread's offset
+            # sampler and query() all share this counter — a lost
+            # update would give two pings one seq and strand a waiter
+            self._seq += 1
+            seq = self._seq
+            self._pong_waiters[seq] = waiter
         try:
             t0 = time.monotonic()
+            t_send_us = wall_us()
             try:
                 self._send(Message(T_PING, seq=seq))
             except AttributeError:   # _sock is None: closed under us
                 raise ConnectionError("not connected") from None
-            if not evt.wait(timeout):
+            if not waiter.evt.wait(timeout):
                 raise TimeoutError(
                     f"no pong from {self.host}:{self.port} "
                     f"within {timeout}s")
+            if waiter.epoch_us:
+                self.offset.add_sample(t_send_us, wall_us(),
+                                       waiter.epoch_us)
             return time.monotonic() - t0
         finally:
             with self._waiters_lock:
                 self._pong_waiters.pop(seq, None)
+
+    def sample_clock_offset(self, max_age_s: float = 2.0,
+                            timeout: float = 1.0) -> None:
+        """Refresh the offset estimate with a ping sample unless a
+        recent one exists.  The ping runs on a short-lived daemon
+        thread: the caller is the STREAMING thread mid-chain, and a
+        degraded peer must cost it nothing (failures are ignored — the
+        reply-stamp fallback samples keep the estimator populated)."""
+        now = time.monotonic()
+        if now - self._offset_sampled < max_age_s:
+            return
+        self._offset_sampled = now
+
+        def _probe():
+            try:
+                self.ping(timeout=timeout)
+            except (TimeoutError, ConnectionError, OSError):
+                pass
+
+        threading.Thread(target=_probe, daemon=True,
+                         name="query-offset-probe").start()
 
     def query(self, buf: TensorBuffer) -> Optional[TensorBuffer]:
         """Send one frame, await ITS reply (matched by seq; stale replies
         from timed-out requests are discarded), reconnecting within the
         request's deadline budget (``timeout`` covers send + reconnect +
         reply)."""
-        self._seq += 1
-        seq = self._seq
+        with self._waiters_lock:   # shared with ping allocations
+            self._seq += 1
+            seq = self._seq
         deadline = time.monotonic() + self.timeout
+        ctx = buf.extra.get("nns_trace") or TraceContext()
         for attempt in (0, 1):
+            t_send_us = wall_us()
             try:
                 # scatter-gather framing: tensor payloads go to the
                 # kernel as views, no per-frame blob materialization
                 with self._send_lock:
                     send_tensors(self._sock, T_DATA, buf, seq=seq,
-                                 pts=buf.pts or 0)
+                                 pts=buf.pts or 0,
+                                 trace_id=ctx.trace_id,
+                                 span_id=ctx.span_id,
+                                 origin_us=ctx.origin_us)
             except (OSError, AttributeError):
                 if attempt:
                     raise
@@ -185,11 +254,25 @@ class QueryConnection:
                 STATS.incr("query.reconnects")
                 self._reconnect(deadline)
                 continue
+            if reply.epoch_us:
+                # reply stamps carry the server wall clock: one offset
+                # sample per round trip, min-RTT filtered (obs/clock.py)
+                self.offset.add_sample(t_send_us, wall_us(),
+                                       reply.epoch_us)
             out = buf.with_tensors(decode_tensors(reply.payload))
             out.pts = reply.pts
             out.lease = reply.lease   # views alias the pooled slab
             return out
         return None
+
+    def drain_traces(self) -> List[bytes]:
+        """Pending T_TRACE span batches (raw JSON), oldest first."""
+        out: List[bytes] = []
+        while True:
+            try:
+                out.append(self._trace_in.popleft())
+            except IndexError:
+                return out
 
     def _await_reply(self, seq: int,
                      deadline: Optional[float] = None) -> Optional[Message]:
@@ -331,6 +414,27 @@ class FailoverConnection:
 
     def health_report(self) -> Dict[str, Dict[str, object]]:
         return self.monitor.report() if self.monitor is not None else {}
+
+    def sample_clock_offset(self) -> None:
+        """Rate-limited ping-based offset refresh on the active
+        connection (traced clients call this per frame; it no-ops
+        within the sample window)."""
+        with self._lock:
+            conn = self._active
+        if conn is not None:
+            conn.sample_clock_offset()
+
+    def drain_remote_traces(self) -> List[Tuple[bytes, int, str]]:
+        """Pending server span batches from the active connection:
+        ``(raw_json, offset_us, endpoint_key)`` triples, offset already
+        min-RTT-filtered per connection."""
+        with self._lock:
+            conn = self._active
+        if conn is None:
+            return []
+        off = conn.offset.offset_us or 0
+        key = f"{conn.host}:{conn.port}"
+        return [(raw, off, key) for raw in conn.drain_traces()]
 
     # -- lifecycle -----------------------------------------------------------
     def connect(self) -> None:
@@ -634,7 +738,40 @@ class TensorQueryClient(Element):
             return True
         return str(Caps.from_string(sc)) == str(Caps.from_string(sk))
 
+    def _stamp_trace(self, buf, tracer) -> None:
+        """Attach the wire trace context (obs/span.py) so the serving
+        pipeline's spans land under THIS run's trace id.  origin_us is
+        the buffer's source stamp re-based onto the wall clock — the
+        cross-process interlatency origin."""
+        if "nns_trace" in buf.extra:
+            return
+        from ..obs.span import new_trace_id
+
+        src_ns = buf.extra.get("nns_src_ns")
+        if src_ns is not None:
+            origin = (tracer.anchor_wall_us
+                      + (src_ns - tracer.anchor_mono_ns) // 1000)
+        else:
+            origin = wall_us()
+        buf.extra["nns_trace"] = TraceContext(tracer.trace_id,
+                                              new_trace_id(), origin)
+
+    def _merge_remote_spans(self, tracer) -> None:
+        import json as _json
+
+        for raw, off, key in self.conn.drain_remote_traces():
+            try:
+                payload = _json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                continue
+            tracer.add_remote_spans(payload, offset_us=off,
+                                    process=f"server:{key}")
+
     def chain(self, pad, buf):
+        tracer = (self.pipeline.tracer
+                  if self.pipeline is not None else None)
+        if tracer is not None:
+            self._stamp_trace(buf, tracer)
         try:
             out = self.conn.query(buf)
         except (TimeoutError, ConnectionError, OSError) as exc:
@@ -661,6 +798,12 @@ class TensorQueryClient(Element):
                 f"{exc!r}") from exc
         if out is None:
             return FlowReturn.ERROR
+        if tracer is not None:
+            # refresh the clock offset from a ping sample (unbiased by
+            # model latency; rate-limited inside), then harvest the
+            # server's T_TRACE piggyback into one merged timeline
+            self.conn.sample_clock_offset()
+            self._merge_remote_spans(tracer)
         if not getattr(self, "_announced_server_caps", True):
             # degraded start negotiated the passthrough shape; the
             # recovery that served this frame learned the server's real

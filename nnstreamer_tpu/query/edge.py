@@ -135,7 +135,12 @@ class EdgeBroker:
                     # interleaves with a fanout frame)
                     with self._lock:
                         slock = self._send_locks.get(conn)
+                    # pong wall-clock stamp = unbiased offset sample for
+                    # the peer (query/server.py does the same)
+                    from ..obs.clock import wall_us
+
                     pong = Message(T_PONG, seq=msg.seq,
+                                   epoch_us=wall_us(),
                                    payload=msg.payload)
                     if slock is None:
                         send_msg(conn, pong)
@@ -405,11 +410,19 @@ class EdgeSink(Element):
             self._send_resilient(Message(
                 T_HELLO, payload=f"pub:{self.topic}".encode()))
             self._caps_sent = True
+        # trace propagation (obs/span.py): the publisher's trace context
+        # rides the rev-4 header so subscriber-side spans join the trace
+        from ..obs.span import TraceContext
+
+        ctx = buf.extra.get("nns_trace") or TraceContext()
         # scatter-gather publish: tensor views go straight to sendmsg
         self._send_resilient_fn(
             lambda sock: send_tensors(sock, T_DATA, buf,
                                       pts=buf.pts or 0,
-                                      epoch_us=self._base_epoch_us))
+                                      epoch_us=self._base_epoch_us,
+                                      trace_id=ctx.trace_id,
+                                      span_id=ctx.span_id,
+                                      origin_us=ctx.origin_us))
         return FlowReturn.OK
 
     def on_event(self, pad, event):
@@ -574,6 +587,11 @@ class EdgeSrc(Source):
                     pts = msg.pts + (msg.epoch_us - self._base_epoch_us) * 1000
                 buf = TensorBuffer(tensors=decode_tensors(msg.payload),
                                    pts=pts, lease=msg.lease)
+                if msg.trace_id:
+                    from ..obs.span import TraceContext
+
+                    buf.extra["nns_trace"] = TraceContext(
+                        msg.trace_id, msg.span_id, msg.origin_us)
                 self._fifo.put(buf)
 
     def negotiate(self) -> Caps:

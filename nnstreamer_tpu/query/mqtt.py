@@ -55,7 +55,7 @@ _HDR_PAD = HDR_LEN - struct.calcsize(_HDR_FMT)
 
 def pack_header(sizes: List[int], base_epoch_us: int, sent_epoch_us: int,
                 duration: Optional[int], dts: Optional[int],
-                pts: Optional[int], caps_str: str) -> bytes:
+                pts: Optional[int], caps_str: str, ctx=None) -> bytes:
     if len(sizes) > MAX_NUM_MEMS:
         raise ValueError(f"mqtt: {len(sizes)} memories > {MAX_NUM_MEMS}")
     caps_b = caps_str.encode()
@@ -68,7 +68,26 @@ def pack_header(sizes: List[int], base_epoch_us: int, sent_epoch_us: int,
                       CLOCK_NONE if duration is None else duration,
                       CLOCK_NONE if dts is None else dts,
                       CLOCK_NONE if pts is None else pts, caps_b)
+    if ctx is not None and ctx.trace_id:
+        # trace context rides the zero-pad region after the reference
+        # fields (obs/span.py trailer blob, self-identifying by magic):
+        # a context-unaware reference peer sees it as padding
+        from ..obs.span import pack_ctx_trailer
+
+        blob = pack_ctx_trailer(ctx)
+        return hdr + blob + b"\x00" * (_HDR_PAD - len(blob))
     return hdr + b"\x00" * _HDR_PAD
+
+
+def header_trace_ctx(blob: bytes):
+    """Trace context stashed in the header's pad region by
+    :func:`pack_header`, or None (reference-compatible zero padding)."""
+    from ..obs.span import TRAILER_SIZE, unpack_ctx_trailer
+
+    base = struct.calcsize(_HDR_FMT)
+    if len(blob) < base + TRAILER_SIZE:
+        return None
+    return unpack_ctx_trailer(blob, base + TRAILER_SIZE)
 
 
 def unpack_header(blob: bytes):
@@ -465,9 +484,12 @@ class MqttSink(Element):
 
         mems = [np.ascontiguousarray(buf.np(i)).tobytes()
                 for i in range(buf.num_tensors)]
+        from ..obs.clock import wall_us
+
         hdr = pack_header([len(m) for m in mems], self._base_epoch_us,
-                          int(time.time() * 1e6), buf.duration, None,
-                          buf.pts, self._caps_str)
+                          wall_us(), buf.duration, None,
+                          buf.pts, self._caps_str,
+                          ctx=buf.extra.get("nns_trace"))
         record_copy(len(hdr) + sum(len(m) for m in mems))
         self._client.publish(str(self.pub_topic), hdr + b"".join(mems))
         return FlowReturn.OK
@@ -541,6 +563,7 @@ class MqttSrc(Source):
     def _parse(self, payload: bytes):
         sizes, base_us, _sent, duration, _dts, pts, caps_str = \
             unpack_header(payload)
+        ctx = header_trace_ctx(payload)
         body = payload[HDR_LEN:]
         if sum(sizes) > len(body):
             raise ValueError(f"truncated frame: header declares "
@@ -551,7 +574,7 @@ class MqttSrc(Source):
             off += s
         if self.sync_pts and pts is not None:
             pts = pts + (base_us - self._base_epoch_us) * 1000
-        return mems, duration, pts, caps_str
+        return mems, duration, pts, caps_str, ctx
 
     def _next(self):
         while not self._halted.is_set():
@@ -584,7 +607,7 @@ class MqttSrc(Source):
         else:
             item = self._next()
         while item is not None:
-            mems, duration, pts, _caps = item
+            mems, duration, pts, _caps, ctx = item
             infos = self._config.info
             try:
                 if len(mems) != infos.num_tensors:
@@ -601,6 +624,9 @@ class MqttSrc(Source):
                 item = self._next()
                 continue
             self._count += 1
-            return TensorBuffer(tensors=tensors, pts=pts,
-                                duration=duration)
+            out = TensorBuffer(tensors=tensors, pts=pts,
+                               duration=duration)
+            if ctx is not None:
+                out.extra["nns_trace"] = ctx
+            return out
         return None

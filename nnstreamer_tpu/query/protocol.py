@@ -9,24 +9,34 @@ framework's 128-byte meta header (nnstreamer_tpu.tensor.meta), so both
 static and flexible streams ride the same format.
 
 Message layout (little endian):
-  u32 magic 'NNSS' | u8 type | u64 client_id | u64 seq | i64 pts
-  | i64 epoch_us | u32 payload_crc | u32 payload_len | payload
+  u32 magic 'NNST' | u8 type | u64 client_id | u64 seq | i64 pts
+  | i64 epoch_us | u64 trace_id | u64 span_id | i64 origin_us
+  | u32 payload_crc | u32 payload_len | payload
 ``epoch_us`` is the sender's stream-origin wall clock (NTP-aligned unix
 epoch µs, 0 = unknown) — the role of the reference mqtt header's
 ``base_time_epoch`` (gst/mqtt/mqttcommon.h:54) that lets a receiving
 pipeline re-base PTS from another device onto its own clock.
+``trace_id``/``span_id``/``origin_us`` are the distributed trace
+context (obs/span.py TraceContext; all zeros = untraced): the trace id
+names the whole distributed trace so client and server spans merge
+under one timeline, the span id is the sender-side parent span, and
+origin_us is the source stamp (sender wall µs at buffer birth) that
+makes cross-process interlatency computable after clock-offset
+estimation (obs/clock.py).
 ``payload_crc`` is CRC-32C of the payload when the sender has the native
 tensorwire kernels (0 = unchecked — the pure-Python CRC would serialize
 the hot path); receivers verify only nonzero values, so mixed
 native/fallback hosts interoperate.
 Types: 1=HELLO (payload = caps string utf8), 2=DATA, 3=REPLY, 4=BYE,
-5=ERROR (payload = message), 6=PING, 7=PONG.
+5=ERROR (payload = message), 6=PING, 7=PONG, 8=TRACE (payload = JSON
+span batch — the server's timeline piggyback, sent right after a REPLY
+when the serving pipeline records spans; clients without a tracer just
+discard it).
 ``PING``/``PONG`` are the liveness heartbeat (query/resilience.py): any
 peer may send PING at any time; the receiver echoes seq and payload back
 as PONG immediately, out of band with DATA/REPLY.  The sender matches
 PONGs by seq and derives RTT — the keep-alive role of libnnstreamer-edge's
-connection monitoring.  Both types are additive: a rev-3 frame stream
-without them is still valid, so the magic is unchanged.
+connection monitoring.
 """
 
 from __future__ import annotations
@@ -44,12 +54,13 @@ from ..tensor.buffer import TensorBuffer, TensorBufferPool
 from ..tensor.info import TensorInfo
 from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 
-# Wire revision 3 ('NNSS'): + payload_crc ('NNSR' lacked it, 'NNSQ' also
-# lacked epoch_us).  The magic doubles as the version stamp — a peer
-# speaking another revision fails immediately with "bad magic" instead of
+# Wire revision 4 ('NNST'): + trace_id/span_id/origin_us trace context
+# ('NNSS' lacked it, 'NNSR' lacked payload_crc, 'NNSQ' also lacked
+# epoch_us).  The magic doubles as the version stamp — a peer speaking
+# another revision fails immediately with "bad magic" instead of
 # desynchronizing the stream.
-MAGIC = 0x4E4E5353  # 'NNSS'
-HEADER = struct.Struct("<IBQQqqII")
+MAGIC = 0x4E4E5354  # 'NNST'
+HEADER = struct.Struct("<IBQQqqQQqII")
 #: upper bound on a wire-declared payload (default 1 GiB, env-overridable):
 #: receives reject anything larger before allocating, so a corrupted
 #: length field cannot OOM the receiver (a 4K RGB uncompressed frame is
@@ -57,8 +68,8 @@ HEADER = struct.Struct("<IBQQqqII")
 MAX_WIRE_PAYLOAD = int(os.environ.get("NNS_MAX_WIRE_PAYLOAD",
                                       str(1 << 30)))
 
-T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR, T_PING, T_PONG = \
-    1, 2, 3, 4, 5, 6, 7
+T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR, T_PING, T_PONG, T_TRACE = \
+    1, 2, 3, 4, 5, 6, 7, 8
 
 
 def create_connection(address, timeout=None):
@@ -145,6 +156,10 @@ class Message:
     seq: int = 0
     pts: int = 0
     epoch_us: int = 0
+    #: distributed trace context (obs/span.py; all zeros = untraced)
+    trace_id: int = 0
+    span_id: int = 0
+    origin_us: int = 0
     #: bytes for control messages; may be a memoryview into a pooled
     #: slab when received via ``recv_msg(sock, pool=...)``
     payload: Any = b""
@@ -162,7 +177,8 @@ def pack(msg: Message) -> bytes:
     if not isinstance(payload, bytes):
         payload = bytes(payload)
     return HEADER.pack(MAGIC, msg.type, msg.client_id, msg.seq,
-                       msg.pts, msg.epoch_us, _payload_crc(payload),
+                       msg.pts, msg.epoch_us, msg.trace_id, msg.span_id,
+                       msg.origin_us, _payload_crc(payload),
                        len(payload)) + payload
 
 
@@ -225,15 +241,17 @@ def sendmsg_all(sock: socket.socket, parts: Sequence[Any]) -> None:
 
 def send_tensors(sock: socket.socket, msg_type: int, buf: TensorBuffer,
                  client_id: int = 0, seq: int = 0, pts: int = 0,
-                 epoch_us: int = 0) -> None:
+                 epoch_us: int = 0, trace_id: int = 0, span_id: int = 0,
+                 origin_us: int = 0) -> None:
     """Scatter-gather DATA/REPLY send: header + count + per-tensor
     (meta, payload view) as one ``sendmsg`` iovec.  The tensor payload
     bytes are handed to the kernel straight from the source arrays —
-    the serialize path's only fresh bytes are the 48-byte wire header,
-    the count word, and the 128-byte metas."""
+    the serialize path's only fresh bytes are the wire header, the
+    count word, and the 128-byte metas."""
     parts = tensor_parts(buf)
     plen = sum(len(p) if isinstance(p, bytes) else p.nbytes for p in parts)
     header = HEADER.pack(MAGIC, msg_type, client_id, seq, pts, epoch_us,
+                         trace_id, span_id, origin_us,
                          _parts_crc(parts), plen)
     record_copy(len(header))   # header+metas are the copy budget
     record_copy(4 + META_HEADER_SIZE * buf.num_tensors)
@@ -298,7 +316,9 @@ def send_msg_zc(sock: socket.socket, msg: Message) -> None:
         sock.sendall(pack(msg))
         return
     header = HEADER.pack(MAGIC, msg.type, msg.client_id, msg.seq,
-                         msg.pts, msg.epoch_us, msg.crc, len(payload))
+                         msg.pts, msg.epoch_us, msg.trace_id,
+                         msg.span_id, msg.origin_us, msg.crc,
+                         len(payload))
     sendmsg_all(sock, [header, payload])
 
 
@@ -311,7 +331,8 @@ def recv_msg(sock: socket.socket,
     hdr = _recv_exact(sock, HEADER.size)
     if hdr is None:
         return None
-    magic, typ, cid, seq, pts, epoch, crc, plen = HEADER.unpack(hdr)
+    (magic, typ, cid, seq, pts, epoch, trace_id, span_id, origin_us,
+     crc, plen) = HEADER.unpack(hdr)
     if magic != MAGIC:
         raise ValueError(f"bad magic 0x{magic:08x}")
     if plen > MAX_WIRE_PAYLOAD:
@@ -346,7 +367,9 @@ def recv_msg(sock: socket.socket,
                     f"payload CRC mismatch: frame seq={seq} declared "
                     f"0x{crc:08x}, computed 0x{got:08x} (corrupt stream)")
     return Message(type=typ, client_id=cid, seq=seq, pts=pts,
-                   epoch_us=epoch, payload=payload, lease=lease, crc=crc)
+                   epoch_us=epoch, trace_id=trace_id, span_id=span_id,
+                   origin_us=origin_us, payload=payload, lease=lease,
+                   crc=crc)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:

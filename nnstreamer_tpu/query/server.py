@@ -19,6 +19,8 @@ import threading
 from typing import Dict, Optional
 
 from ..analysis.sanitizer import make_lock
+from ..obs.clock import wall_us
+from ..obs.span import TraceContext
 from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
@@ -26,8 +28,8 @@ from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer, default_pool
 from ..tensor.caps_util import tensors_template_caps
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
-                       T_REPLY, decode_tensors, recv_msg, send_msg,
-                       send_tensors, shutdown_close)
+                       T_REPLY, T_TRACE, decode_tensors, recv_msg,
+                       send_msg, send_tensors, shutdown_close)
 
 
 class QueryServer:
@@ -52,6 +54,11 @@ class QueryServer:
         self._send_locks: Dict[int, threading.Lock] = {}
         self._caps_str: Optional[str] = None
         self._next_id = 1
+        #: serving pipeline's tracer (set by the serversink element);
+        #: when it records spans, replies piggyback them as T_TRACE so
+        #: the client merges both processes into one timeline
+        self.obs_tracer = None
+        self._span_cursors: Dict[int, int] = {}   # client id -> ring pos
         self._lock = make_lock("query.registry")
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(
@@ -97,10 +104,15 @@ class QueryServer:
                     continue
                 if msg.type == T_PING:
                     # liveness heartbeat: echo seq+payload immediately,
-                    # out of band with DATA/REPLY (query/resilience.py)
+                    # out of band with DATA/REPLY (query/resilience.py).
+                    # The pong also stamps this host's wall clock: a
+                    # ping round trip has near-zero service time, so it
+                    # is the UNBIASED clock-offset sample (obs/clock.py)
+                    # — a reply stamp rides on top of model latency.
                     with slock:
                         send_msg(conn, Message(T_PONG, client_id=cid,
                                                seq=msg.seq,
+                                               epoch_us=wall_us(),
                                                payload=msg.payload))
                     continue
                 if msg.type == T_DATA:
@@ -108,6 +120,12 @@ class QueryServer:
                                        pts=msg.pts, lease=msg.lease)
                     buf.extra["query_client_id"] = cid
                     buf.extra["query_seq"] = msg.seq
+                    if msg.trace_id:
+                        # restore the client's trace context: spans this
+                        # buffer produces in the serving pipeline record
+                        # under the client's trace id (obs/span.py)
+                        buf.extra["nns_trace"] = TraceContext(
+                            msg.trace_id, msg.span_id, msg.origin_us)
                     self.incoming.put(buf)
         except OSError:
             pass   # link reset under us (recv, or a handshake/pong send)
@@ -115,7 +133,34 @@ class QueryServer:
             with self._lock:
                 self._clients.pop(cid, None)
                 self._send_locks.pop(cid, None)
+                # client ids are never reused: an unreaped cursor per
+                # connection ever made is a slow leak on a long server
+                self._span_cursors.pop(cid, None)
             conn.close()
+
+    def _trace_piggyback(self, cid: int, ctx: TraceContext
+                         ) -> Optional[Message]:
+        """T_TRACE message carrying this pipeline's new spans for the
+        client's trace, or None when there is nothing to send (no
+        span-recording tracer attached, or no new spans)."""
+        tracer = self.obs_tracer
+        if tracer is None or getattr(tracer, "ring", None) is None \
+                or not ctx.trace_id:
+            return None
+        import json as _json
+
+        with self._lock:
+            cursor = self._span_cursors.get(cid, 0)
+        payload, cursor = tracer.publish_spans(cursor,
+                                               trace_id=ctx.trace_id)
+        with self._lock:
+            self._span_cursors[cid] = cursor
+        if not payload["spans"]:
+            return None
+        return Message(T_TRACE, client_id=cid,
+                       trace_id=ctx.trace_id,
+                       epoch_us=wall_us(),
+                       payload=_json.dumps(payload).encode())
 
     def reply(self, buf: TensorBuffer) -> bool:
         cid = buf.extra.get("query_client_id")
@@ -125,14 +170,22 @@ class QueryServer:
         if conn is None:
             return False
         seq = buf.extra.get("query_seq", 0)
+        ctx = buf.extra.get("nns_trace") or TraceContext()
+        trace_msg = self._trace_piggyback(cid, ctx)
         try:
-            if slock is not None:
-                with slock:
-                    send_tensors(conn, T_REPLY, buf, client_id=cid,
-                                 seq=seq, pts=buf.pts or 0)
-            else:
+            if slock is None:
+                slock = make_lock("query.send")   # teardown race: one-shot
+            with slock:
+                # reply stamps: echo the trace context, carry this
+                # host's wall clock so the client estimates the offset
+                # (obs/clock.py) from the very frames it already sends
                 send_tensors(conn, T_REPLY, buf, client_id=cid,
-                             seq=seq, pts=buf.pts or 0)
+                             seq=seq, pts=buf.pts or 0,
+                             epoch_us=wall_us(),
+                             trace_id=ctx.trace_id, span_id=ctx.span_id,
+                             origin_us=ctx.origin_us)
+                if trace_msg is not None:
+                    send_msg(conn, trace_msg)
             return True
         except OSError:
             return False
@@ -272,6 +325,11 @@ class TensorQueryServerSink(Element):
         pass
 
     def chain(self, pad, buf):
+        # publish the serving pipeline's tracer (one attr store per
+        # reply): when it records spans, QueryServer.reply piggybacks
+        # them to the requesting client as T_TRACE
+        self.server.obs_tracer = (self.pipeline.tracer
+                                  if self.pipeline is not None else None)
         self.server.reply(buf)
         return FlowReturn.OK
 

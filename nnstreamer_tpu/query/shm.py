@@ -488,7 +488,24 @@ class ShmSink(Element):
             raise RuntimeError(f"{self.name}: buffer before caps")
         # scatter-gather: tensor views land in the slot directly (one
         # copy into shared memory, no staging blob)
-        self._ring.push_parts(tensor_parts(buf), buf.pts or 0,
+        parts = tensor_parts(buf)
+        ctx = buf.extra.get("nns_trace")
+        if ctx is not None and ctx.trace_id:
+            # trace context rides a self-identifying trailer AFTER the
+            # tensors (obs/span.py): the fixed 16-byte slot header is
+            # shared with the native ring and cannot grow, and
+            # decode_tensors never reads past the declared tensors, so
+            # context-unaware consumers are unaffected.  A frame sized
+            # right up to slot-bytes ships WITHOUT the trailer instead
+            # of erroring: attaching a tracer must never turn a working
+            # pipeline into a failing one.
+            from ..obs.span import TRAILER_SIZE, pack_ctx_trailer
+
+            total = sum(len(p) if isinstance(p, bytes) else p.nbytes
+                        for p in parts)
+            if total + TRAILER_SIZE <= self._ring.slot_bytes:
+                parts.append(pack_ctx_trailer(ctx))
+        self._ring.push_parts(parts, buf.pts or 0,
                               float(self.timeout))
         return FlowReturn.OK
 
@@ -638,7 +655,13 @@ class ShmSrc(Source):
             self._count += 1
             # zero-copy decode over the pooled slab; the lease rides the
             # buffer so the slab outlives every downstream view
-            return TensorBuffer(
-                tensors=decode_tensors(lease.memory()[:n]), pts=pts,
-                lease=lease)
+            payload = lease.memory()[:n]
+            out = TensorBuffer(tensors=decode_tensors(payload), pts=pts,
+                               lease=lease)
+            from ..obs.span import unpack_ctx_trailer
+
+            ctx = unpack_ctx_trailer(payload)
+            if ctx is not None:
+                out.extra["nns_trace"] = ctx
+            return out
         return None

@@ -304,7 +304,26 @@ def default_pool() -> TensorBufferPool:
         with _DEFAULT_POOL_LOCK:
             if _DEFAULT_POOL is None:
                 _DEFAULT_POOL = TensorBufferPool()
+                _register_pool_gauges(_DEFAULT_POOL)
     return _DEFAULT_POOL
+
+
+def _register_pool_gauges(pool: TensorBufferPool) -> None:
+    """Occupancy/hit-rate gauges for the shared pool — lazy callables,
+    evaluated only when the metrics endpoint scrapes (obs/metrics.py)."""
+    from ..obs.metrics import REGISTRY
+
+    REGISTRY.gauge("nns_pool_free_bytes",
+                   fn=lambda: pool._free_bytes, pool="default")
+    REGISTRY.gauge("nns_pool_free_slabs",
+                   fn=lambda: sum(len(b) for b in pool._free.values()),
+                   pool="default")
+    REGISTRY.gauge("nns_pool_pending_slabs",
+                   fn=lambda: len(pool._pending), pool="default")
+    REGISTRY.gauge(
+        "nns_pool_hit_rate",
+        fn=lambda: pool.hits / max(1, pool.hits + pool.misses),
+        pool="default")
 
 
 def is_device_array(x: Any) -> bool:

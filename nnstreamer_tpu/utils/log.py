@@ -1,16 +1,105 @@
-"""Logging with backtrace support.
+"""Structured logging with backtrace support.
 
 Parity with the reference logging layer (gst/nnstreamer/nnstreamer_log.h:
 ml_logi/w/e/d macros + ml_loge_stacktrace): standard logging channel
-``nnstreamer_tpu`` plus an error-with-backtrace helper.
+``nnstreamer_tpu`` plus an error-with-backtrace helper.  The ``ml_log*``
+shims are unchanged call-site-compatible aliases.
+
+Structured context (observability layer): every record emitted from
+inside a traced ``chain()`` carries the active trace frame's context —
+``element`` (whose chain is running on this thread), ``buffer_seq``, and
+the emitting thread's name — injected by a :class:`logging.Filter` so
+existing ``logger.warning("...", args)`` call sites pick it up without
+changes.  Untraced pipelines pay one empty-stack check per record, and
+only when a record is actually emitted.
+
+``NNS_LOG=json`` switches the channel to one-JSON-object-per-line
+(machine-parseable for log aggregation)::
+
+    {"ts": 1722700000.123, "level": "WARNING", "logger": "nnstreamer_tpu",
+     "msg": "...", "thread": "src:videotestsrc0", "element": "f",
+     "buffer_seq": 17}
+
+Any other ``NNS_LOG`` value sets the channel's level by name (e.g.
+``NNS_LOG=debug``); both may be combined as ``NNS_LOG=json,debug``.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import traceback
 
 logger = logging.getLogger("nnstreamer_tpu")
+
+#: context keys the trace-frame filter may attach to a record
+_CONTEXT_KEYS = ("element", "buffer_seq")
+
+
+class _TraceContextFilter(logging.Filter):
+    """Attach the active trace frame's pipeline context to each record
+    (pipeline/tracing.py active_frame_context)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            from ..pipeline.tracing import active_frame_context
+
+            for key, value in active_frame_context().items():
+                setattr(record, key, value)
+        except Exception:   # noqa: BLE001 — logging must never raise
+            pass
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line (``NNS_LOG=json``)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+            "thread": record.threadName,
+        }
+        for key in _CONTEXT_KEYS:
+            value = getattr(record, key, None)
+            if value is not None:
+                out[key] = value
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def configure_from_env(env: "str | None" = None) -> None:
+    """Apply ``NNS_LOG`` (idempotent): ``json`` installs the JSON
+    formatter on a dedicated handler for the channel; a level name sets
+    the channel level.  Comma-separated to combine."""
+    spec = os.environ.get("NNS_LOG", "") if env is None else env
+    if not spec:
+        return
+    for token in str(spec).split(","):
+        token = token.strip().lower()
+        if not token:
+            continue
+        if token == "json":
+            for h in logger.handlers:
+                if isinstance(h.formatter, JsonFormatter):
+                    break
+            else:
+                handler = logging.StreamHandler()
+                handler.setFormatter(JsonFormatter())
+                logger.addHandler(handler)
+                logger.propagate = False   # no double-emit via root
+        else:
+            level = logging.getLevelName(token.upper())
+            if isinstance(level, int):
+                logger.setLevel(level)
+
+
+logger.addFilter(_TraceContextFilter())
+configure_from_env()
 
 ml_logd = logger.debug
 ml_logi = logger.info

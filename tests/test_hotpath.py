@@ -489,3 +489,20 @@ def test_hotpath_bench_dispatch_gate():
     assert r.returncode == 0, (
         f"dispatch gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
     assert '"hotpath_dispatch_gate"' in r.stdout
+
+
+@pytest.mark.perf
+def test_hotpath_bench_obs_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage obs fails when
+    an untraced compiled plan references obs/tracer state (the
+    zero-cost-when-off contract) or when metrics-off dispatch overhead
+    exceeds 2% — the observability layer must stay free until a tracer
+    or scrape actually asks for data."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "obs"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (
+        f"obs gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_obs_gate"' in r.stdout

@@ -1,0 +1,763 @@
+"""Observability layer tests (ISSUE 5).
+
+- trace context survives a full tensor_query client→server round trip:
+  both processes' spans share ONE trace id, the server's spans arrive
+  over the T_TRACE piggyback, and the estimated clock offset is sane
+  (loopback: near zero);
+- log-bucket histogram quantiles track numpy percentiles within the
+  bucket's relative width;
+- Chrome trace_event export is schema-valid and time-monotonic;
+- interlatency >= proctime per element (the transit includes the
+  element's own processing);
+- metrics registry / Prometheus endpoint / lazy gauges;
+- structured JSON logging with trace-frame context;
+- srciio absolute-deadline pacing (rate holds, stop is prompt).
+
+All tier-1-fast: loopback sockets, no models, no sleeps beyond pacing.
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.elements import TensorSink, TensorTransform
+from nnstreamer_tpu.obs.clock import OffsetEstimator
+from nnstreamer_tpu.obs.metrics import Histogram, MetricsRegistry
+from nnstreamer_tpu.obs.span import (Span, SpanRing, TraceContext,
+                                     new_trace_id, pack_ctx_trailer,
+                                     unpack_ctx_trailer)
+from nnstreamer_tpu.pipeline import AppSrc, Pipeline
+from nnstreamer_tpu.query import (TensorQueryClient, TensorQueryServerSink,
+                                  TensorQueryServerSrc, shutdown_server)
+from nnstreamer_tpu.tensor import TensorBuffer
+
+
+def tcaps(dims="4", types="float32", rate="0/1"):
+    return (f"other/tensors,format=static,num_tensors=1,dimensions={dims},"
+            f"types={types},framerate={rate}")
+
+
+# ---------------------------------------------------------------------------
+# trace context primitives
+# ---------------------------------------------------------------------------
+
+class TestTraceContext:
+    def test_new_trace_id_nonzero_unique(self):
+        ids = {new_trace_id() for _ in range(64)}
+        assert 0 not in ids and len(ids) == 64
+
+    def test_trailer_round_trip(self):
+        ctx = TraceContext(0x1234, 0xABCD, 777_000_111)
+        blob = b"payload-bytes" + pack_ctx_trailer(ctx)
+        assert unpack_ctx_trailer(blob) == ctx
+
+    def test_trailer_absent_on_plain_payload(self):
+        assert unpack_ctx_trailer(b"no trailer here at all........") is None
+        assert unpack_ctx_trailer(b"short") is None
+
+    def test_mqtt_header_carries_ctx_in_pad(self):
+        from nnstreamer_tpu.query.mqtt import (HDR_LEN, header_trace_ctx,
+                                               pack_header, unpack_header)
+
+        ctx = TraceContext(99, 7, 123456)
+        hdr = pack_header([16], 1, 2, None, None, 5, "caps", ctx=ctx)
+        assert len(hdr) == HDR_LEN
+        assert header_trace_ctx(hdr) == ctx
+        # reference fields unaffected by the pad stash
+        sizes, base, sent, dur, dts, pts, caps = unpack_header(hdr)
+        assert sizes == [16] and pts == 5 and caps == "caps"
+        plain = pack_header([16], 1, 2, None, None, 5, "caps")
+        assert header_trace_ctx(plain) is None
+
+
+class TestSpanRing:
+    def test_bounded_overwrite_oldest(self):
+        ring = SpanRing(16)
+        for i in range(40):
+            ring.append(Span("e", 1, i, 1, i, 9))
+        spans = ring.snapshot()
+        assert len(spans) == 16
+        assert [s.start_ns for s in spans] == list(range(24, 40))
+        assert ring.dropped == 24
+
+    def test_snapshot_since_cursor(self):
+        ring = SpanRing(64)
+        for i in range(5):
+            ring.append(Span("e", 1, i, 1, i, 9))
+        first, cur = ring.snapshot_since(0)
+        assert len(first) == 5 and cur == 5
+        nothing, cur2 = ring.snapshot_since(cur)
+        assert nothing == [] and cur2 == 5
+        ring.append(Span("e", 1, 99, 1, 99, 9))
+        more, _ = ring.snapshot_since(cur)
+        assert [s.start_ns for s in more] == [99]
+
+
+class TestOffsetEstimator:
+    def test_midpoint_and_min_rtt_filter(self):
+        est = OffsetEstimator()
+        # peer clock runs +500us ahead; first sample rtt=100
+        est.add_sample(1000, 1100, 1050 + 500)
+        assert est.offset_us == 500 and est.rtt_us == 100
+        # worse-rtt sample with a crazier offset must NOT win
+        est.add_sample(2000, 2900, 2450 + 9999)
+        assert est.offset_us == 500
+        # better-rtt sample refines
+        est.add_sample(3000, 3010, 3005 + 480)
+        assert est.offset_us == 480 and est.rtt_us == 10
+        assert est.to_local_us(10_480) == 10_000
+
+
+# ---------------------------------------------------------------------------
+# histogram quantile accuracy
+# ---------------------------------------------------------------------------
+
+class TestHistogramQuantiles:
+    @pytest.mark.parametrize("dist", ["uniform", "lognormal"])
+    def test_quantiles_track_numpy_percentiles(self, dist):
+        rng = np.random.default_rng(7)
+        if dist == "uniform":
+            samples = rng.uniform(10.0, 50_000.0, 4000)
+        else:
+            samples = np.exp(rng.normal(6.0, 1.5, 4000))  # ~40us..~20ms
+        h = Histogram("t", {})
+        for v in samples:
+            h.observe(float(v))
+        for q in (0.50, 0.95, 0.99):
+            want = float(np.percentile(samples, q * 100))
+            got = h.quantile(q)
+            # quarter-octave buckets: midpoint interpolation is within
+            # ~9% of the bucket, leave headroom for sampling noise
+            assert abs(got - want) / want < 0.2, (q, got, want)
+
+    def test_snapshot_fields(self):
+        h = Histogram("t", {})
+        for v in (10, 20, 30):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["count"] == 3
+        assert snap["min"] == 10 and snap["max"] == 30
+        assert 10 <= snap["p50"] <= 30
+        assert Histogram("e", {}).snapshot() == {"count": 0}
+
+
+# ---------------------------------------------------------------------------
+# metrics registry + endpoint
+# ---------------------------------------------------------------------------
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_render(self):
+        reg = MetricsRegistry()
+        reg.counter("nns_test_total", kind="a").inc(3)
+        reg.gauge("nns_test_depth", fn=lambda: 7, q="q0")
+        reg.histogram("nns_test_lat").observe(100.0)
+        text = reg.render_prometheus()
+        assert 'nns_test_total{kind="a"} 3' in text
+        assert 'nns_test_depth{q="q0"} 7' in text
+        assert 'nns_test_lat{quantile="0.5"}' in text
+        assert "nns_test_lat_count 1" in text
+        # resilience counters bridge in under nns_resilience_*
+        assert "# TYPE" in text
+
+    def test_lazy_gauge_evaluated_at_scrape(self):
+        reg = MetricsRegistry()
+        box = {"v": 1}
+        reg.gauge("g", fn=lambda: box["v"])
+        assert reg.report()["g"] == 1
+        box["v"] = 5
+        assert reg.report()["g"] == 5
+
+    def test_dead_gauge_provider_does_not_break_scrape(self):
+        reg = MetricsRegistry()
+
+        def boom():
+            raise RuntimeError("stopped element")
+        reg.gauge("g", fn=boom)
+        assert "g NaN" in reg.render_prometheus()
+
+    def test_register_replaces(self):
+        reg = MetricsRegistry()
+        h1 = reg.register(Histogram("h", {"e": "x"}))
+        h1.observe(5)
+        h2 = reg.register(Histogram("h", {"e": "x"}))
+        assert reg._snapshot() == [h2]
+
+    def test_unregister_matching(self):
+        reg = MetricsRegistry()
+        reg.gauge("d", fn=lambda: 1, queue="q1")
+        reg.gauge("d", fn=lambda: 2, queue="q2")
+        assert reg.unregister_matching("d", queue="q1") == 1
+        assert len(reg._snapshot()) == 1
+
+
+class TestMetricsEndpoint:
+    def test_http_scrape(self):
+        from nnstreamer_tpu.obs.httpd import (start_metrics_server,
+                                              stop_metrics_server)
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+
+        REGISTRY.counter("nns_endpoint_smoke_total").inc()
+        server = start_metrics_server(0)
+        try:
+            port = server.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5).read()
+            assert b"nns_endpoint_smoke_total 1" in body
+            ok = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5).read()
+            assert ok == b"ok\n"
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=5)
+        finally:
+            stop_metrics_server()
+            REGISTRY.unregister_matching("nns_endpoint_smoke_total")
+
+    def test_queue_and_pool_gauges_appear_during_run(self):
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+
+        p = parse_launch(
+            f"appsrc caps={tcaps()} name=in ! queue name=q77 ! "
+            "tensor_sink name=out")
+        src = p.get("in")
+        src.push_buffer(TensorBuffer(tensors=[np.zeros(4, np.float32)]))
+        src.end_of_stream()
+        p.play()
+        try:
+            report = REGISTRY.report()
+            depth_keys = [k for k in report
+                          if k.startswith("nns_queue_depth")
+                          and 'queue="q77"' in k]
+            assert depth_keys, report
+            assert any(k.startswith("nns_queue_capacity")
+                       and 'queue="q77"' in k for k in report)
+        finally:
+            p.wait(timeout=15)
+            p.stop()
+        # gauges drop at stop — no dangling providers for dead elements
+        assert not any('queue="q77"' in k for k in REGISTRY.report())
+
+    def test_same_named_queues_in_two_pipelines_coexist(self):
+        """Identity unregistration: stopping pipeline A must not tear
+        down pipeline B's live gauge for a same-named queue."""
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+
+        def build(pname):
+            p = Pipeline(pname)
+            src = AppSrc("src", caps=tcaps())
+            from nnstreamer_tpu.pipeline.graph import Queue
+
+            q = Queue("sameq")
+            sink = TensorSink("out")
+            p.add(src, q, sink)
+            p.link(src, q, sink)
+            src.push_buffer(TensorBuffer(
+                tensors=[np.zeros(4, np.float32)]))
+            src.end_of_stream()
+            return p
+
+        a, b = build("pa"), build("pb")
+        a.play()
+        b.play()
+        try:
+            keys = [k for k in REGISTRY.report()
+                    if k.startswith("nns_queue_depth")
+                    and 'queue="sameq"' in k]
+            assert len(keys) == 2, keys    # pipeline label disambiguates
+            a.wait(timeout=15)
+            a.stop()
+            keys = [k for k in REGISTRY.report()
+                    if k.startswith("nns_queue_depth")
+                    and 'queue="sameq"' in k]
+            assert keys == [k for k in keys if 'pipeline="pb"' in k], keys
+            assert len(keys) == 1
+        finally:
+            b.wait(timeout=15)
+            a.stop()
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# tracer: percentiles, interlatency, spans, chrome export
+# ---------------------------------------------------------------------------
+
+def _run_traced_pipeline(spans=False, n=20):
+    p = Pipeline("obs-local")
+    src = AppSrc("src", caps=tcaps())
+    t = TensorTransform("t", mode="arithmetic", option="add:1")
+    sink = TensorSink("out")
+    p.add(src, t, sink)
+    p.link(src, t, sink)
+    for i in range(n):
+        src.push_buffer(TensorBuffer(
+            tensors=[np.full(4, i, np.float32)], pts=i * 10))
+    src.end_of_stream()
+    tracer = p.enable_tracing(spans=spans)
+    p.run(timeout=30)
+    return p, tracer
+
+
+class TestTracerObservability:
+    def test_report_has_percentiles_and_interlatency(self):
+        _, tracer = _run_traced_pipeline()
+        rep = tracer.report()
+        row = rep["out"]
+        assert row["buffers"] == 20
+        for k in ("proctime_p50_us", "proctime_p95_us",
+                  "proctime_p99_us", "interlatency_avg_us",
+                  "interlatency_p50_us"):
+            assert k in row, (k, row)
+
+    def test_interlatency_geq_proctime_per_element(self):
+        """Transit (source stamp → element exit) includes the element's
+        own processing, so it can never read below proctime."""
+        _, tracer = _run_traced_pipeline()
+        rep = tracer.report()
+        for name, row in rep.items():
+            assert "interlatency_avg_us" in row, name
+            assert row["interlatency_avg_us"] >= row["proctime_avg_us"], \
+                (name, row)
+
+    def test_spans_recorded_with_seq_and_trace_id(self):
+        _, tracer = _run_traced_pipeline(spans=True)
+        spans = tracer.ring.snapshot()
+        by_el = {}
+        for s in spans:
+            by_el.setdefault(s.name, []).append(s)
+        assert set(by_el) == {"t", "out"}
+        assert all(s.trace_id == tracer.trace_id for s in spans)
+        assert sorted(s.seq for s in by_el["out"]) == list(range(20))
+        assert all(s.dur_ns > 0 for s in spans)
+
+    def test_counters_only_mode_records_no_spans(self):
+        _, tracer = _run_traced_pipeline(spans=False)
+        assert tracer.ring is None
+
+    def test_chrome_export_schema_valid_and_monotonic(self, tmp_path):
+        _, tracer = _run_traced_pipeline(spans=True)
+        out = tmp_path / "timeline.json"
+        tracer.export_chrome(str(out), process_name="unit")
+        doc = json.loads(out.read_text())
+        assert doc["displayTimeUnit"] == "ms"
+        assert doc["metadata"]["trace_id"] == f"{tracer.trace_id:x}"
+        events = doc["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert xs, "no complete events exported"
+        for e in xs:
+            assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid",
+                              "tid", "args"}
+            assert e["dur"] >= 0
+        ts = [e["ts"] for e in xs]
+        assert ts == sorted(ts), "export not time-monotonic"
+        metas = [e for e in events if e["ph"] == "M"]
+        assert any(e["name"] == "process_name"
+                   and e["args"]["name"] == "unit" for e in metas)
+
+    def test_remote_span_rebase(self):
+        """add_remote_spans re-bases a peer's mono timeline through the
+        wall clock and the estimated offset."""
+        from nnstreamer_tpu.pipeline.tracing import Tracer
+
+        local = Tracer(spans=True)
+        payload = {"anchor_mono_ns": 1_000_000,
+                   "anchor_wall_us": local.anchor_wall_us + 500,
+                   "spans": [["remote_el", 7, 2_000_000, 5_000, 3, 42]]}
+        # peer clock = local clock + 500us; perfect offset estimate
+        assert local.add_remote_spans(payload, offset_us=500) == 1
+        (span,) = local._remote["remote"]
+        # peer span started 1ms after its anchor → 1ms after OUR anchor
+        assert span.start_ns == local.anchor_mono_ns + 1_000_000
+        assert span.trace_id == 42 and span.dur_ns == 5_000
+        doc = local.chrome_trace()
+        pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert pids == {2}   # remote-only: local ring is empty
+
+
+# ---------------------------------------------------------------------------
+# distributed: client→server round trip under one trace id
+# ---------------------------------------------------------------------------
+
+SERVER_ID = 41
+
+
+class TestDistributedTrace:
+    def test_round_trip_single_trace_merged_timeline(self, tmp_path):
+        server = Pipeline("server")
+        ssrc = TensorQueryServerSrc("qsrc", id=SERVER_ID, port=0,
+                                    caps=tcaps())
+        st = TensorTransform("st", mode="arithmetic", option="mul:2")
+        ssink = TensorQueryServerSink("qsink", id=SERVER_ID)
+        server.add(ssrc, st, ssink)
+        server.link(ssrc, st, ssink)
+        server_tracer = server.enable_tracing(spans=True)
+        server.play()
+        try:
+            client = Pipeline("client")
+            src = AppSrc("src", caps=tcaps())
+            qc = TensorQueryClient("qc", port=ssrc.bound_port,
+                                   timeout=10.0)
+            sink = TensorSink("out")
+            client.add(src, qc, sink)
+            client.link(src, qc, sink)
+            n = 6
+            for i in range(n):
+                src.push_buffer(TensorBuffer(
+                    tensors=[np.full(4, i, np.float32)], pts=i * 10))
+            src.end_of_stream()
+            client_tracer = client.enable_tracing(spans=True)
+            client.play()
+            try:
+                client.wait(timeout=30)
+                # offsets sane: loopback, same clock → well under 5 s
+                # (checked before stop() drops the active connection)
+                conn = qc.conn._active
+                assert conn is not None \
+                    and conn.offset.offset_us is not None
+                assert abs(conn.offset.offset_us) < 5_000_000
+            finally:
+                client.stop()
+
+            assert len(sink.results) == n
+            # one trace id across BOTH pipelines' spans
+            tid = client_tracer.trace_id
+            client_spans = client_tracer.ring.snapshot()
+            assert client_spans and all(s.trace_id == tid
+                                        for s in client_spans)
+            server_spans = server_tracer.ring.snapshot()
+            server_for_trace = [s for s in server_spans
+                                if s.trace_id == tid]
+            assert server_for_trace, (
+                "server recorded no spans under the client's trace id: "
+                f"{server_spans[:5]}")
+            # the T_TRACE piggyback merged server spans into the CLIENT
+            # tracer (the single-merged-timeline acceptance criterion)
+            remote = [s for spans in client_tracer._remote.values()
+                      for s in spans]
+            assert remote and all(s.trace_id == tid for s in remote)
+            assert {s.name for s in remote} & {"qsrc", "st"}
+            # merged chrome export carries BOTH processes
+            out = tmp_path / "merged.json"
+            client_tracer.export_chrome(str(out))
+            doc = json.loads(out.read_text())
+            pids = {e["pid"] for e in doc["traceEvents"]
+                    if e["ph"] == "X"}
+            assert {1, 2} <= pids
+            names = {e["name"] for e in doc["traceEvents"]
+                     if e["ph"] == "X"}
+            assert "qc" in names and ("st" in names or "qsrc" in names)
+        finally:
+            server.stop()
+            shutdown_server(SERVER_ID)
+
+    def test_untraced_client_pays_no_trace_fields(self):
+        """With no tracer attached the wire context stays zero and the
+        server stamps nothing."""
+        server = Pipeline("server")
+        ssrc = TensorQueryServerSrc("qsrc", id=SERVER_ID + 1, port=0,
+                                    caps=tcaps())
+        ssink = TensorQueryServerSink("qsink", id=SERVER_ID + 1)
+        seen = []
+        ssrc_create = ssrc.create
+
+        def spy():
+            buf = ssrc_create()
+            if buf is not None:
+                seen.append(dict(buf.extra))
+            return buf
+        ssrc.create = spy
+        server.add(ssrc, ssink)
+        server.link(ssrc, ssink)
+        server.play()
+        try:
+            client = Pipeline("client")
+            src = AppSrc("src", caps=tcaps())
+            qc = TensorQueryClient("qc", port=ssrc.bound_port,
+                                   timeout=10.0)
+            sink = TensorSink("out")
+            client.add(src, qc, sink)
+            client.link(src, qc, sink)
+            src.push_buffer(TensorBuffer(
+                tensors=[np.zeros(4, np.float32)], pts=0))
+            src.end_of_stream()
+            client.run(timeout=30)
+            assert len(sink.results) == 1
+            assert seen and all("nns_trace" not in e for e in seen)
+        finally:
+            server.stop()
+            shutdown_server(SERVER_ID + 1)
+
+
+# ---------------------------------------------------------------------------
+# trace propagation over the shm and edge paths
+# ---------------------------------------------------------------------------
+
+class TestTransportPropagation:
+    def test_shm_ring_carries_trace_ctx(self, tmp_path):
+        """The trailer rides the slot payload: a traced producer's
+        context is restored on the consumer's buffers."""
+        from nnstreamer_tpu.query.shm import ShmSink, ShmSrc
+
+        name = f"nns-obs-{id(self) & 0xffff}"
+        prod = Pipeline("prod")
+        src = AppSrc("src", caps=tcaps())
+        ssink = ShmSink("ssink", path=name)
+        prod.add(src, ssink)
+        prod.link(src, ssink)
+        prod_tracer = prod.enable_tracing(spans=True)
+
+        cons = Pipeline("cons")
+        ssrc = ShmSrc("ssrc", path=name, **{"num-buffers": 3})
+        out = TensorSink("out")
+        cons.add(ssrc, out)
+        cons.link(ssrc, out)
+
+        prod.play()
+        for i in range(3):
+            src.push_buffer(TensorBuffer(
+                tensors=[np.full(4, i, np.float32)], pts=i))
+        src.end_of_stream()
+        cons.play()
+        try:
+            prod.wait(timeout=15)
+            cons.wait(timeout=15)
+        finally:
+            prod.stop()
+            cons.stop()
+        assert len(out.results) == 3
+        for buf in out.results:
+            ctx = buf.extra.get("nns_trace")
+            assert ctx is not None
+            assert ctx.trace_id == prod_tracer.trace_id
+        np.testing.assert_array_equal(out.results[2].np(0),
+                                      np.full(4, 2, np.float32))
+
+    def test_untraced_shm_payload_has_no_ctx(self, tmp_path):
+        from nnstreamer_tpu.query.shm import ShmSink, ShmSrc
+
+        name = f"nns-obs-plain-{id(self) & 0xffff}"
+        prod = Pipeline("prod")
+        src = AppSrc("src", caps=tcaps())
+        ssink = ShmSink("ssink", path=name)
+        prod.add(src, ssink)
+        prod.link(src, ssink)
+        cons = Pipeline("cons")
+        ssrc = ShmSrc("ssrc", path=name, **{"num-buffers": 1})
+        out = TensorSink("out")
+        cons.add(ssrc, out)
+        cons.link(ssrc, out)
+        prod.play()
+        src.push_buffer(TensorBuffer(
+            tensors=[np.zeros(4, np.float32)], pts=0))
+        src.end_of_stream()
+        cons.play()
+        try:
+            prod.wait(timeout=15)
+            cons.wait(timeout=15)
+        finally:
+            prod.stop()
+            cons.stop()
+        assert "nns_trace" not in out.results[0].extra
+
+    def test_edge_pub_sub_carries_trace_ctx(self):
+        """The rev-4 header fields survive the broker's zero-copy relay
+        (send_msg_zc repacks them verbatim)."""
+        from nnstreamer_tpu.query.edge import EdgeSink, EdgeSrc, get_broker
+
+        broker = get_broker()
+        try:
+            pub = Pipeline("pub")
+            src = AppSrc("src", caps=tcaps())
+            esink = EdgeSink("esink", port=broker.port, topic="obs-t")
+            pub.add(src, esink)
+            pub.link(src, esink)
+            pub_tracer = pub.enable_tracing(spans=True)
+
+            sub = Pipeline("sub")
+            esrc = EdgeSrc("esrc", port=broker.port, topic="obs-t",
+                           caps=tcaps(), **{"num-buffers": 2})
+            out = TensorSink("out")
+            sub.add(esrc, out)
+            sub.link(esrc, out)
+
+            sub.play()
+            time.sleep(0.3)   # let the subscription register
+            pub.play()
+            for i in range(2):
+                src.push_buffer(TensorBuffer(
+                    tensors=[np.full(4, i, np.float32)], pts=i))
+            src.end_of_stream()
+            try:
+                pub.wait(timeout=15)
+                sub.wait(timeout=15)
+            finally:
+                pub.stop()
+                sub.stop()
+            assert len(out.results) == 2
+            for buf in out.results:
+                ctx = buf.extra.get("nns_trace")
+                assert ctx is not None
+                assert ctx.trace_id == pub_tracer.trace_id
+        finally:
+            broker.close()
+
+
+# ---------------------------------------------------------------------------
+# structured logging
+# ---------------------------------------------------------------------------
+
+class TestStructuredLogging:
+    def test_json_lines_with_trace_context(self):
+        from nnstreamer_tpu.pipeline.tracing import Tracer
+        from nnstreamer_tpu.utils.log import JsonFormatter, logger
+
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        logger.addHandler(handler)
+        try:
+            tracer = Tracer()
+            buf = TensorBuffer(tensors=[np.zeros(1, np.float32)])
+            buf.extra["nns_seq"] = 17
+            tracer.enter("myelement", buf)
+            try:
+                logger.warning("inside chain %d", 1)
+            finally:
+                tracer.exit()
+            logger.warning("outside chain")
+        finally:
+            logger.removeHandler(handler)
+        fmt = JsonFormatter()
+        inside = json.loads(fmt.format(records[0]))
+        assert inside["msg"] == "inside chain 1"
+        assert inside["element"] == "myelement"
+        assert inside["buffer_seq"] == 17
+        assert inside["level"] == "WARNING"
+        outside = json.loads(fmt.format(records[1]))
+        assert "element" not in outside and "buffer_seq" not in outside
+
+    def test_configure_from_env_json_and_level(self):
+        from nnstreamer_tpu.utils.log import (JsonFormatter,
+                                              configure_from_env, logger)
+
+        before = list(logger.handlers)
+        configure_from_env("json,debug")
+        try:
+            added = [h for h in logger.handlers if h not in before]
+            assert any(isinstance(h.formatter, JsonFormatter)
+                       for h in added)
+            assert logger.level == logging.DEBUG
+            # idempotent: a second call adds no duplicate handler
+            configure_from_env("json")
+            assert len([h for h in logger.handlers
+                        if isinstance(h.formatter, JsonFormatter)]) == 1
+        finally:
+            for h in [h for h in logger.handlers if h not in before]:
+                logger.removeHandler(h)
+            logger.setLevel(logging.NOTSET)
+            logger.propagate = True
+
+    def test_ml_log_shims_unchanged(self):
+        from nnstreamer_tpu.utils import log
+
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        log.logger.addHandler(handler)
+        try:
+            log.ml_logw("warn %s", "x")
+            log.ml_loge_stacktrace("boom")
+        finally:
+            log.logger.removeHandler(handler)
+        assert records[0].getMessage() == "warn x"
+        assert "Backtrace" in records[1].getMessage()
+
+
+# ---------------------------------------------------------------------------
+# srciio pacing
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fake_iio_tree(tmp_path):
+    dev = tmp_path / "iio:device0"
+    dev.mkdir()
+    (dev / "name").write_text("test-accel\n")
+    (dev / "in_accel0_raw").write_text("100\n")
+    (dev / "in_accel0_scale").write_text("0.5\n")
+    (dev / "in_accel0_offset").write_text("10\n")
+    return tmp_path
+
+
+class TestSrcIioPacing:
+    def test_absolute_deadline_rate_holds(self, fake_iio_tree):
+        """10 buffers at 50 Hz = 9 inter-buffer gaps ≈ 180 ms; relative
+        sleep pacing would ALSO pass this, but drift-free absolute
+        pacing must not run fast (the old bug direction is slow drift,
+        checked by the upper bound)."""
+        p = parse_launch(
+            f"tensor_src_iio device=test-accel base-dir={fake_iio_tree} "
+            "frequency=50 num-buffers=10 ! tensor_sink name=out")
+        t0 = time.monotonic()
+        p.run(timeout=15)
+        dt = time.monotonic() - t0
+        assert len(p.get("out").results) == 10
+        assert 0.15 < dt < 1.0, dt
+
+    def test_stop_is_prompt_mid_wait(self, fake_iio_tree):
+        """An unbounded stream pacing at 1 Hz must tear down in far less
+        than a period: the event wait is cancellable, a bare
+        time.sleep(1.0) was not."""
+        p = parse_launch(
+            f"tensor_src_iio device=test-accel base-dir={fake_iio_tree} "
+            "frequency=1 num-buffers=-1 ! tensor_sink name=out")
+        p.play()
+        try:
+            # let the source emit its first buffer and enter the paced
+            # wait
+            deadline = time.monotonic() + 5
+            while not p.get("out").results \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            src = p.elements[0]
+            t0 = time.monotonic()
+            # halt the source directly: this joins its streaming thread,
+            # which is exactly the cancellable-wait property under test
+            # (Pipeline.stop() would fold in a gc.collect pass whose
+            # cost scales with the whole process heap)
+            src._halt()
+            assert time.monotonic() - t0 < 0.9
+        finally:
+            p.stop()
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-off: no obs refs in untraced plans (in-process twin of the
+# tools/hotpath_bench.py --stage obs gate)
+# ---------------------------------------------------------------------------
+
+class TestZeroCostOff:
+    def test_untraced_plan_holds_no_obs_state(self):
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import hotpath_bench
+
+        assert hotpath_bench._plan_obs_refs(frames=8) == []
+
+    def test_source_stamps_only_when_traced(self):
+        p = Pipeline("untraced")
+        src = AppSrc("src", caps=tcaps())
+        sink = TensorSink("out")
+        p.add(src, sink)
+        p.link(src, sink)
+        src.push_buffer(TensorBuffer(tensors=[np.zeros(4, np.float32)]))
+        src.end_of_stream()
+        p.run(timeout=15)
+        extra = sink.results[0].extra
+        assert "nns_src_ns" not in extra and "nns_seq" not in extra

@@ -19,19 +19,28 @@ time, with no model in the loop:
                    plan (pipeline/schedule.py) vs interpreted
                    ``Pad.push → _chain_entry → chain`` dispatch, with
                    an empty chain as the transport baseline.
+  - ``obs``:       observability-layer cost with nothing attached —
+                   fused dispatch wall time with the metrics registry
+                   populated + endpoint up vs cleared, and a
+                   structural scan proving untraced compiled plans
+                   hold zero obs/tracer references.
 
 Prints ONE JSON line per stage (schema mirrors bench.py).
 
 ``--assert`` is the regression gate (tier-1 ``perf`` smoke):
 
 - the COPY gate fails (exit 1) when the serialize path materializes
-  more than the frame's header budget — 48 B wire header + 4 B count +
+  more than the frame's header budget — wire header + 4 B count +
   128 B meta per tensor.  A re-introduced ``tobytes``/``b"".join`` on
   the hot path trips it immediately;
 - the DISPATCH gate (``--assert --stage dispatch``; bare ``--assert``
-  runs both) fails when the segment compiler no longer fuses the
+  runs all gates) fails when the segment compiler no longer fuses the
   identity chain, or when fused per-element overhead is no longer at
-  least 2x below interpreted dispatch (min-of-3 timing).
+  least 2x below interpreted dispatch (min-of-3 timing);
+- the OBS gate (``--assert --stage obs``) fails when an untraced
+  compiled plan references obs/tracer state, or when metrics-off
+  dispatch overhead exceeds 2% (min-of-3 interleaved, one re-measure
+  on a miss to reject scheduler noise).
 """
 
 import argparse
@@ -248,6 +257,133 @@ def bench_dispatch(frames: int) -> dict:
             "fused_elements": fused_elems, "frames": frames}
 
 
+_OBS_SUSPICIOUS = ("tracer", "metric", "span", "obs")
+
+
+def _closure_obs_refs(fn) -> list:
+    """Obs/tracer references inside a compiled executor: suspicious
+    identifiers in its code object, or closure cells holding obs-layer
+    objects.  The untraced plan must yield NONE — that is the
+    zero-cost-when-off contract (pipeline/schedule.py)."""
+    bad = []
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return bad
+    for name in (tuple(code.co_names) + tuple(code.co_freevars)
+                 + tuple(code.co_varnames)):
+        if any(s in name.lower() for s in _OBS_SUSPICIOUS):
+            bad.append(f"{fn.__qualname__}: identifier {name!r}")
+    for cell in fn.__closure__ or ():
+        try:
+            val = cell.cell_contents
+        except ValueError:
+            continue
+        mod = getattr(type(val), "__module__", "") or ""
+        if mod.startswith("nnstreamer_tpu.obs") \
+                or type(val).__name__ == "Tracer":
+            bad.append(f"{fn.__qualname__}: closure holds "
+                       f"{type(val).__name__}")
+    return bad
+
+
+def _plan_obs_refs(frames: int = 32) -> list:
+    """Compile an UNTRACED fused pipeline's plans and scan every
+    installed head executor for obs references."""
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+
+    p = parse_launch(
+        f"appsrc caps={DISPATCH_CAPS} name=in ! " + "identity ! " * 5
+        + "tensor_sink name=out collect=false", Pipeline(fuse=True))
+    src = p.get("in")
+    buf = TensorBuffer(tensors=[np.zeros(4, np.float32)], pts=0)
+    for _ in range(frames):
+        src.push_buffer(buf)
+    src.end_of_stream()
+    bad = []
+    try:
+        p.play()
+        p.wait(timeout=60)
+        for el in p.elements:
+            for pad in el.src_pads:
+                fn = pad.__dict__.get("push")
+                if fn is not None:
+                    bad.extend(_closure_obs_refs(fn))
+    finally:
+        p.stop()
+    return bad
+
+
+def _obs_overhead_pct(frames: int, reps: int = 3) -> float:
+    """Fused-dispatch wall time with the obs layer armed-but-idle
+    (registry populated, endpoint serving) vs cleared, interleaved
+    min-of-reps.  The code paths are identical by design, so this
+    measures that no one re-introduced per-buffer metrics work."""
+    from nnstreamer_tpu.obs.httpd import (start_metrics_server,
+                                          stop_metrics_server)
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+
+    off = on = None
+    server = None
+    try:
+        for _ in range(reps):
+            REGISTRY.clear()
+            dt = _dispatch_run(5, True, frames)[0]
+            off = dt if off is None else min(off, dt)
+            server = start_metrics_server(0)
+            for i in range(8):
+                REGISTRY.gauge("nns_obs_gate_gauge",
+                               fn=lambda: 1.0, idx=str(i))
+            dt = _dispatch_run(5, True, frames)[0]
+            on = dt if on is None else min(on, dt)
+    finally:
+        if server is not None:
+            stop_metrics_server()
+        REGISTRY.unregister_matching("nns_obs_gate_gauge")
+    return (on - off) / off * 100.0
+
+
+def bench_obs(frames: int) -> dict:
+    frames = max(frames, 1500)
+    refs = _plan_obs_refs()
+    pct = _obs_overhead_pct(frames)
+    return {"metric": "hotpath_obs_overhead_pct",
+            "value": round(pct, 2), "unit": "pct_vs_metrics_off",
+            "untraced_plan_obs_refs": refs, "frames": frames}
+
+
+def run_assert_obs() -> int:
+    """Obs-regression gate: untraced compiled plans must hold zero obs
+    references, and metrics-off dispatch overhead must stay under 2%
+    (the PR 4 untraced-dispatch baseline; one re-measure on a miss so
+    a scheduler hiccup doesn't fail CI)."""
+    failures = []
+    refs = _plan_obs_refs()
+    if refs:
+        failures.append("untraced compiled plan references obs state: "
+                        + "; ".join(refs))
+    # the true overhead is ~0% (identical code paths), so keep the min
+    # over up to 3 attempts: a loaded CI box can blow a single
+    # interleaved measurement past 2% on scheduler noise alone, but
+    # noise is one-sided — a genuine per-buffer cost survives every
+    # re-measure
+    pct = _obs_overhead_pct(3000)
+    for _ in range(2):
+        if pct <= 2.0:
+            break
+        pct = min(pct, _obs_overhead_pct(3000))
+    if pct > 2.0:
+        failures.append(
+            f"metrics-off dispatch overhead {pct:.2f}% > 2%: the obs "
+            "layer grew a per-buffer cost with nothing attached")
+    result = {"metric": "hotpath_obs_gate", "unit": "ok",
+              "value": 0 if failures else 1,
+              "overhead_pct": round(pct, 2),
+              "untraced_plan_obs_refs": refs, "failures": failures}
+    print(json.dumps(result), flush=True)
+    return 1 if failures else 0
+
+
 def run_assert_dispatch() -> int:
     """Dispatch-regression gate: the segment compiler must fuse the
     5-identity chain into one plan, and fused per-element overhead must
@@ -337,13 +473,15 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--frames", type=int, default=200)
     ap.add_argument("--stage", choices=["pool", "serialize", "wire", "shm",
-                                        "dispatch", "all"], default="all")
+                                        "dispatch", "obs", "all"],
+                    default="all")
     ap.add_argument("--assert", dest="assert_gate", action="store_true",
                     help="regression gates (exit 1): copy gate (serialize "
-                         "path must stay within the header budget) and "
+                         "path must stay within the header budget), "
                          "dispatch gate (segment fusion must hold its "
-                         ">=2x per-element overhead win); --stage "
-                         "narrows to one gate")
+                         ">=2x per-element overhead win), and obs gate "
+                         "(untraced plans hold no obs refs; metrics-off "
+                         "overhead <2%%); --stage narrows to one gate")
     args = ap.parse_args()
     if args.assert_gate:
         rc = 0
@@ -351,10 +489,12 @@ def main() -> int:
             rc |= run_assert()
         if args.stage in ("all", "dispatch"):
             rc |= run_assert_dispatch()
+        if args.stage in ("all", "obs"):
+            rc |= run_assert_obs()
         return rc
     stages = {"pool": bench_pool, "serialize": bench_serialize,
               "wire": bench_wire, "shm": bench_shm,
-              "dispatch": bench_dispatch}
+              "dispatch": bench_dispatch, "obs": bench_obs}
     picks = stages if args.stage == "all" else {args.stage:
                                                stages[args.stage]}
     for fn in picks.values():

@@ -44,6 +44,14 @@ This tool checks exactly those repo rules:
     True, or store/augmented-assign into a ``decode_tensors`` result,
     corrupts frames other consumers already hold.
 
+``wallclock-in-chain``
+    Direct ``time.time()``/``time.time_ns()`` in a chain-path method
+    (``chain``/``create``/``plan_step``/``_chain_entry``).  Latency and
+    pacing math on the wall clock silently breaks under NTP slew; the
+    obs clock helpers (``obs/clock.py``) keep the monotonic/wall split
+    explicit — ``mono_ns()`` for durations and deadlines, ``wall_us()``
+    for cross-host stamps.
+
 Pragma: append ``# nnslint: allow(<rule>)`` to the offending line or
 the comment line directly above it (give a reason in the comment).
 
@@ -69,7 +77,12 @@ from typing import Dict, List, Optional, Set, Tuple
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 RULES = ("sleep-poll", "io-under-lock", "lock-order", "unknown-lock",
-         "tracer-in-untraced-plan", "readonly-view-mutation")
+         "tracer-in-untraced-plan", "readonly-view-mutation",
+         "wallclock-in-chain")
+
+#: method names that are per-buffer dataflow paths for wallclock-in-chain
+_CHAIN_PATH_FUNCS = frozenset({"chain", "create", "plan_step",
+                               "_chain_entry"})
 
 #: call names treated as blocking socket I/O for io-under-lock
 _IO_CALLS = frozenset({
@@ -148,6 +161,8 @@ class _FileLinter(ast.NodeVisitor):
         #: recur across classes with DIFFERENT ranks: scope them)
         self.class_lock_names: Dict[str, Dict[str, str]] = {}
         self._class_stack: List[str] = []
+        #: enclosing function-name stack (wallclock-in-chain scoping)
+        self._func_stack: List[str] = []
         #: per-function local name -> lock class (reset per FunctionDef)
         self._locals: Dict[str, str] = {}
         #: stack of (lock class, line) currently held lexically
@@ -248,7 +263,9 @@ class _FileLinter(ast.NodeVisitor):
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         saved_locals, saved_views = self._locals, self._view_names
         self._locals, self._view_names = dict(self._locals), set()
+        self._func_stack.append(node.name)
         self.generic_visit(node)
+        self._func_stack.pop()
         self._locals, self._view_names = saved_locals, saved_views
 
     visit_AsyncFunctionDef = visit_FunctionDef
@@ -320,6 +337,19 @@ class _FileLinter(ast.NodeVisitor):
                       "condition / blocking get with a wake sentinel "
                       "(pipeline/graph.py AppSrc/Queue pattern), or a "
                       "RetryPolicy.delay for backoff")
+        # wallclock-in-chain: time.time()/time.time_ns() on a per-buffer
+        # dataflow path (obs/clock.py is exempt: it IS the helper)
+        if name in ("time", "time_ns") \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in ("time", "_time") \
+                and any(f in _CHAIN_PATH_FUNCS for f in self._func_stack) \
+                and not self.rel.endswith(os.path.join("obs", "clock.py")):
+            self._add(node, "wallclock-in-chain",
+                      f"time.{name}() in a chain-path method: the wall "
+                      "clock slews under NTP — use obs.clock.mono_ns() "
+                      "for durations/deadlines or obs.clock.wall_us() "
+                      "for cross-host stamps")
         # io-under-lock
         if name in _IO_CALLS and self._with_stack:
             for held, held_line in self._with_stack:
