@@ -4,15 +4,24 @@
 Driver contract: the default invocation benches the flagship MobileNetV2
 224x224 image-labeling pipeline (BASELINE config 1, north star >=30 fps on
 TPU v5e-1) and prints ONE JSON line:
-  {"metric": ..., "value": fps, "unit": "fps", "vs_baseline": fps/30, ...}
+  {"metric": ..., "value": fps, "unit": "fps", "vs_baseline": fps/30,
+   "status": "live", ...}
 
 Robustness contract (the round-1 failure mode was an indefinite hang inside
 tunneled-TPU backend init, unkillable by SIGTERM): ALL jax work happens in a
 child subprocess with a hard wall-clock deadline enforced by this parent
 (SIGKILL after grace), with retry-and-backoff for transient device-grant
 failures.  Whatever happens, the parent prints one parsed JSON line per
-requested config and exits 0 — on unrecoverable failure the line is
-  {"metric": ..., "value": 0, "unit": "fps", "vs_baseline": 0, "error": ...}
+requested config and exits 0.  Every row carries an explicit verdict:
+  status: "live"       — measured on this tree, this run
+  status: "infra_dead" — the tunnel/link was dead; value 0 and
+                         vs_baseline NULL (nothing was measured; an
+                         attached "cached_green" block is committed
+                         evidence from a prior run, an annotation that
+                         never substitutes for a live number)
+  status: "regression" — the link was alive and the run still failed:
+  {"metric": ..., "value": 0, "unit": "fps", "vs_baseline": 0,
+   "status": "regression", "error": ...}
 
 Extra measurements per model config: p50 single-invoke latency, model FLOPs
 (XLA cost analysis), streaming MFU, and a vmap-batched invoke mode
@@ -1054,15 +1063,32 @@ def emit_dead_row_if_gated(metric: str, unit: str, extra: dict = None,
     when the link gate trips, print the tool's red row (shared message
     format, metric-specific fields via ``extra``) and return exit code
     2; else return None and the tool proceeds.  Keeps the row schema
-    and exit-code convention from drifting across tools."""
+    and exit-code convention from drifting across tools.
+
+    Dead rows carry ``status: "infra_dead"`` and ``vs_baseline: null``
+    unconditionally (``extra`` cannot override either): an infra
+    failure is NOT a 0x-vs-baseline measurement, and downstream
+    tooling must be able to filter on the status field alone."""
     dead = tunnel_gate(timeout)
     if dead is None:
         return None
+    print(json.dumps(dead_row(metric, unit, dead, extra)), flush=True)
+    return 2
+
+
+def dead_row(metric: str, unit: str, dead: dict, extra: dict = None
+             ) -> dict:
+    """THE infra-dead row shape (single source — every capture tool's
+    red row goes through here so the schema cannot drift): value 0,
+    shared dead-link message, ``status: infra_dead``, ``vs_baseline:
+    null`` — both enforced after ``extra`` so no caller can weaken
+    the taxonomy."""
     row = {"metric": metric, "value": 0, "unit": unit,
            "error": dead_link_error(dead)}
     row.update(extra or {})
-    print(json.dumps(row), flush=True)
-    return 2
+    row["status"] = "infra_dead"
+    row["vs_baseline"] = None
+    return row
 
 
 def _cached_green(metric: str) -> dict:
@@ -1111,22 +1137,39 @@ def _cached_green(metric: str) -> dict:
     return best
 
 
-def _failure_row(config: str, error: str, cpu: bool = False) -> dict:
+def _failure_row(config: str, error: str, cpu: bool = False,
+                 status: str = "regression") -> dict:
     """Value-0 failure row sharing the success schema (single source for
-    both the dead-tunnel gate and post-retries failures)."""
+    both the dead-tunnel gate and post-retries failures).
+
+    ``status`` is the row taxonomy every bench artifact now carries:
+    ``live`` (measured on this tree, this run), ``infra_dead`` (the
+    LINK was dead — nothing was measured, ``vs_baseline`` is null
+    because 0x-vs-baseline would be a lie), or ``regression`` (the
+    link was alive and the code failed — this one IS a 0)."""
     metric = (CONFIG_METRICS[config] + _pd_suffix(config)
               + ("_cpu" if cpu else ""))
     unit, base = (("decode_tok_s", None) if config == "lm" else ("fps", 0))
+    if status == "infra_dead":
+        base = None
     return {"metric": metric, "value": 0, "unit": unit,
-            "vs_baseline": base, "error": error, "device": "unavailable"}
+            "vs_baseline": base, "error": error, "status": status,
+            "device": "unavailable"}
 
 
 def _attach_cached_green(row: dict) -> dict:
     """Attach the round's best committed green capture to a failure row
     (single spot: every failure path must point the driver at committed
-    evidence, never an unexplained 0)."""
+    evidence, never an unexplained 0).
+
+    The cached row is an ANNOTATION, never a substitute: the failure
+    row's own value stays 0 with its ``status`` naming why, and the
+    nested copy is explicitly marked so no JSON-tail consumer can
+    mistake prior-round evidence for this run's measurement."""
     cached = _cached_green(row["metric"])
     if cached:
+        cached["role"] = ("annotation: best committed green capture "
+                          "from a prior run, NOT this run's result")
         row["cached_green"] = cached
     return row
 
@@ -1141,7 +1184,7 @@ def dead_link_error(probe: dict) -> str:
 def _dead_tunnel_row(config: str, probe: dict, cpu: bool = False) -> dict:
     return _attach_cached_green(_failure_row(
         config, dead_link_error(probe) + "; backend init not attempted",
-        cpu))
+        cpu, status="infra_dead"))
 
 
 def orchestrate(config: str, cpu: bool, deadline: float,
@@ -1165,6 +1208,7 @@ def orchestrate(config: str, cpu: bool, deadline: float,
             # before the optional extras, so a deadline kill mid-extras
             # still delivered a measured number
             result["attempt"] = attempt + 1
+            result.setdefault("status", "live")
             if rc != 0:
                 rc_note = (f"child rc={rc} after emitting result "
                            "(killed during optional extras?)")
@@ -1189,7 +1233,8 @@ def orchestrate(config: str, cpu: bool, deadline: float,
                         f"re-probe found the link dead in "
                         f"{probe.get('elapsed_s', 0)}s "
                         f"({probe.get('detail', '')}); "
-                        + "; ".join(errors)[-600:], cpu))
+                        + "; ".join(errors)[-600:], cpu,
+                        status="infra_dead"))
                     row["tunnel_dead"] = True
                     return row
         else:

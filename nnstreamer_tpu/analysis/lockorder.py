@@ -47,6 +47,10 @@ HIERARCHY: Dict[str, int] = {
     "query.client": 52,     # FailoverConnection endpoint state
     "query.send": 60,       # per-connection/stream send locks
     # observability / memory -----------------------------------------------
+    "slo": 66,              # SLO evaluator window store + flight-recorder
+    #                         ring (slo/): held while snapshotting the
+    #                         registry and exporting the span ring, so it
+    #                         ranks below tracer/obs.ring/obs.metrics
     "tracer": 70,           # Tracer stats table
     "obs.ring": 72,         # SpanRing append/snapshot (obs/span.py)
     "obs.metrics": 74,      # metrics registry + per-metric state

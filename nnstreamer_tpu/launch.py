@@ -121,6 +121,23 @@ def main(argv=None) -> int:
                          "into DIR (TensorBoard profile format): per-op "
                          "device timeline under the element-granular "
                          "--trace report")
+    ap.add_argument("--soak", type=float, default=None, metavar="SECONDS",
+                    help="soak mode: run the pipeline for SECONDS and "
+                         "treat not-reaching-EOS as success (the soak "
+                         "IS the workload); combine with --slo to gate "
+                         "the run on burn-rate objectives")
+    ap.add_argument("--slo", default=None, metavar="FILE",
+                    help="evaluate the run against an SLO spec JSON "
+                         "(slo/spec.py; the literal value 'demo' uses "
+                         "the built-in demo spec): multi-window "
+                         "burn-rate gating over the live metrics "
+                         "registry, verdict JSON on stderr at exit, "
+                         "exit code 3 on FAIL; breaches dump "
+                         "flight-recorder bundles (with the span "
+                         "timeline when --timeline is active)")
+    ap.add_argument("--slo-out", default="flightrec", metavar="DIR",
+                    help="flight-recorder bundle dir for --slo "
+                         "breaches (default: ./flightrec)")
     args = ap.parse_args(argv)
 
     if args.inspect is not None:
@@ -138,6 +155,7 @@ def main(argv=None) -> int:
         return check(args.pipeline)
 
     t0 = time.time()
+    slo_failed = False
     try:
         if args.no_fuse:
             from .pipeline.graph import Pipeline
@@ -161,13 +179,34 @@ def main(argv=None) -> int:
                   if want_trace else None)
         plans = None
         metrics = None
+        slo_monitor = slo_evaluator = None
+        if args.slo:
+            from .slo import Evaluator, FlightRecorder, SLOMonitor
+            from .slo.spec import load_spec
+
+            spec = load_spec(None if args.slo == "demo" else args.slo,
+                             duration_s=args.soak or 60.0)
+            recorder = FlightRecorder(args.slo_out, tracer=tracer)
+            slo_evaluator = Evaluator(spec,
+                                      on_breach=recorder.on_breach)
+            slo_evaluator.on_tick = recorder.record
+            slo_monitor = SLOMonitor(slo_evaluator)
         if args.jax_trace:
             import jax
 
             jax.profiler.start_trace(args.jax_trace)
         try:
             p.play()
-            p.wait(args.timeout)
+            if slo_monitor is not None:
+                slo_monitor.start()
+            if args.soak is not None:
+                try:
+                    p.wait(args.soak)
+                except TimeoutError:
+                    pass    # soak: surviving until the deadline IS the
+                    #         success condition; the SLO verdict judges
+            else:
+                p.wait(args.timeout)
             if tracer is not None and p.planner is not None:
                 plans = p.planner.plans()   # snapshot before stop() drops it
             if tracer is not None:
@@ -194,7 +233,17 @@ def main(argv=None) -> int:
                         print(f"executor {el.name}: {executor}{note}",
                               file=sys.stderr)
         finally:
+            if slo_monitor is not None:
+                # final tick BEFORE element teardown: the verdict must
+                # see the run's last requests while gauges are live
+                slo_monitor.stop(final_tick=True)
             p.stop()
+            if slo_evaluator is not None:
+                import json as _json
+
+                verdict = slo_evaluator.verdict()
+                slo_failed = not verdict["pass"]
+                print(_json.dumps(verdict, indent=2), file=sys.stderr)
             if args.jax_trace:
                 import jax
 
@@ -242,7 +291,7 @@ def main(argv=None) -> int:
     if not args.quiet:
         print(f"pipeline finished in {time.time() - t0:.2f}s",
               file=sys.stderr)
-    return 0
+    return 3 if slo_failed else 0
 
 
 def check(description: str, out=None) -> int:

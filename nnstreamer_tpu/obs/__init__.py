@@ -14,10 +14,13 @@ discipline as ``pipeline/tracing.py``:
   bounded span ring, the compact wire trace-context, and Chrome
   ``trace_event`` export (Perfetto-renderable).
 - :mod:`~nnstreamer_tpu.obs.metrics` — counters / gauges / log-bucket
-  latency histograms with p50/p95/p99, a process-wide registry, and
-  Prometheus text rendering.
+  latency histograms with p50/p95/p99, a process-wide registry with a
+  snapshot/diff API (``snapshot_state``/``state_delta`` — windowed
+  rates and quantiles for the SLO evaluator), and Prometheus text
+  rendering.
 - :mod:`~nnstreamer_tpu.obs.httpd` — the pull-based ``NNS_METRICS_PORT``
-  HTTP endpoint serving the registry.
+  HTTP endpoint serving the registry, plus the ``/healthz`` readiness
+  aggregate (``starting|serving|degraded|draining`` health sources).
 
 Nothing in this package runs on the dataflow hot path unless a tracer
 with span recording is attached: metrics are lazy callable gauges
@@ -27,6 +30,7 @@ references (enforced by ``tools/hotpath_bench.py --stage obs --assert``).
 
 from .clock import OffsetEstimator, mono_ns, wall_us  # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
-                      MetricsRegistry)
+                      MetricsRegistry, count_over_threshold,
+                      quantile_from_counts, state_delta)
 from .span import (Span, SpanRing, TraceContext,  # noqa: F401
                    chrome_trace_events, new_trace_id)

@@ -6,7 +6,16 @@ only holds when evaluation happens at scrape time): a tiny
 
 - ``/metrics`` — Prometheus text exposition of the process registry
   (plus the PR 1 resilience counters), and
-- ``/healthz`` — ``200 ok`` liveness.
+- ``/healthz`` — readiness JSON: the worst state across every
+  registered health source (``starting < serving < degraded <
+  draining``), HTTP 200 while ``starting``/``serving`` and 503 while
+  ``degraded``/``draining`` — load-balancer-pollable without parsing.
+
+Health sources are callables returning a state string; pipelines
+register one at ``play()`` (lifecycle + per-element degradation — a
+``tensor_query_client`` with an OPEN circuit breaker reports
+``degraded``) and unregister at ``stop()``.  With no sources the
+process reports ``starting``: up, serving nothing yet.
 
 Activation is explicit (``start_metrics_server``) or environmental
 (``maybe_start_from_env`` — called once from ``Pipeline.play()`` and
@@ -28,20 +37,80 @@ _STATE_LOCK = make_lock("leaf")
 _SERVER: Optional[ThreadingHTTPServer] = None
 _ENV_TRIED = False
 
+#: readiness states ordered by severity: /healthz reports the WORST
+#: state any registered source claims (a process serving one healthy
+#: and one degraded pipeline is degraded)
+HEALTH_STATES = ("starting", "serving", "degraded", "draining")
+_SEVERITY = {s: i for i, s in enumerate(HEALTH_STATES)}
+#: states the endpoint answers 200 for; degraded/draining answer 503
+#: so a load balancer drains traffic without parsing the JSON body
+_READY_STATES = frozenset({"starting", "serving"})
+
+_HEALTH_LOCK = make_lock("leaf")
+_HEALTH_SOURCES: dict = {}      # token -> (label, provider callable)
+_HEALTH_NEXT = 1
+
+
+def register_health_source(provider, label: str = "") -> int:
+    """Register a readiness provider (a callable returning one of
+    :data:`HEALTH_STATES`); returns a token for unregistration.
+    Pipelines call this from ``play()``."""
+    global _HEALTH_NEXT
+    with _HEALTH_LOCK:
+        token = _HEALTH_NEXT
+        _HEALTH_NEXT += 1
+        _HEALTH_SOURCES[token] = (label or f"source-{token}", provider)
+        return token
+
+
+def unregister_health_source(token: int) -> None:
+    with _HEALTH_LOCK:
+        _HEALTH_SOURCES.pop(token, None)
+
+
+def health_report() -> dict:
+    """Aggregate readiness: worst state across sources, plus the
+    per-source breakdown.  A provider that raises (element stopped
+    under the scrape) is skipped rather than failing the probe."""
+    with _HEALTH_LOCK:
+        sources = list(_HEALTH_SOURCES.values())
+    per = {}
+    worst = "starting"
+    for label, provider in sources:
+        try:
+            state = str(provider())
+        except Exception:   # noqa: BLE001 — dead provider, skip
+            continue
+        if state not in _SEVERITY:
+            continue
+        per[label] = state
+        if _SEVERITY[state] > _SEVERITY[worst]:
+            worst = state
+    return {"state": worst, "ready": worst in _READY_STATES,
+            "sources": per}
+
 
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = REGISTRY
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        if self.path.split("?", 1)[0] == "/metrics":
+        import json as _json
+
+        status = 200
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
             body = self.registry.render_prometheus().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
-        elif self.path == "/healthz":
-            body, ctype = b"ok\n", "text/plain"
+        elif path == "/healthz":
+            report = health_report()
+            body = (_json.dumps(report) + "\n").encode()
+            ctype = "application/json"
+            if not report["ready"]:
+                status = 503
         else:
             self.send_error(404)
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

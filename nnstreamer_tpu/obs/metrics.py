@@ -160,6 +160,44 @@ class Histogram:
                 "p95": round(self.quantile(0.95), 2),
                 "p99": round(self.quantile(0.99), 2)}
 
+    def state(self) -> Tuple[int, float, Tuple[int, ...]]:
+        """Point-in-time ``(count, total, bucket counts)`` — the
+        window-diff primitive: two states subtracted bucket-wise give a
+        windowed distribution (``quantile_from_counts``) without
+        resetting the live histogram."""
+        with self._lock:
+            return (self.count, self.total, tuple(self.counts))
+
+
+def quantile_from_counts(counts, q: float) -> float:
+    """``q``-quantile of a (possibly diff'd) bucket-count vector, using
+    the same geometric-midpoint interpolation as
+    :meth:`Histogram.quantile`; 0.0 when the vector is empty.  This is
+    how a WINDOWED p99 is computed from two :meth:`Histogram.state`
+    snapshots without any per-observation timestamping."""
+    n = sum(counts)
+    if n <= 0:
+        return 0.0
+    target = q * n
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= target:
+            return _bucket_mid(i)
+    return _bucket_mid(len(counts) - 1)
+
+
+def count_over_threshold(counts, threshold: float) -> int:
+    """Observations in a bucket-count vector whose bucket lies entirely
+    at-or-above ``threshold``.  Bucket boundaries are log-spaced, so the
+    answer is exact up to the bucket containing the threshold (that
+    bucket is counted as over iff its geometric midpoint is over) —
+    within the histogram's documented ~9 % quantile error."""
+    lo = _bucket_of(threshold)
+    if _bucket_mid(lo) < threshold:
+        lo += 1
+    return sum(counts[lo:])
+
 
 class MetricsRegistry:
     """Process-wide metric table.
@@ -242,6 +280,31 @@ class MetricsRegistry:
         with self._lock:
             return list(self._metrics.values())
 
+    # -- state snapshot / diff (the SLO evaluator's substrate) ---------------
+    def snapshot_state(self, prefix: str = "") -> Dict[str, Any]:
+        """Numeric state of every metric (optionally name-filtered by
+        ``prefix``), keyed like :meth:`report`.  Counters/gauges carry
+        their value, histograms their full ``(count, total, buckets)``
+        state — so two snapshots taken at different times diff into
+        exact windowed rates and windowed quantiles
+        (:func:`state_delta`).  Taken under the registry lock only for
+        the metric list; per-metric state reads take each metric's own
+        lock, gauges evaluate their provider (scrape semantics)."""
+        out: Dict[str, Any] = {}
+        for m in self._snapshot():
+            if prefix and not m.name.startswith(prefix):
+                continue
+            key = m.name + _label_str(m.labels)
+            if isinstance(m, Histogram):
+                count, total, counts = m.state()
+                out[key] = {"kind": "histogram", "count": count,
+                            "total": total, "counts": counts}
+            elif isinstance(m, Counter):
+                out[key] = {"kind": "counter", "value": m.value}
+            else:
+                out[key] = {"kind": "gauge", "value": m.value}
+        return out
+
     # -- rendering -----------------------------------------------------------
     def report(self) -> Dict[str, Any]:
         """JSON-friendly snapshot (embedded in ``launch.py --trace``
@@ -292,6 +355,45 @@ class MetricsRegistry:
             family(name, "counter", "query resilience counter")
             lines.append(f"{name} {value}")
         return "\n".join(lines) + "\n"
+
+
+def state_delta(new: Dict[str, Any], old: Dict[str, Any]
+                ) -> Dict[str, Any]:
+    """Window diff of two :meth:`MetricsRegistry.snapshot_state` maps:
+    counters/histograms subtract (monotonic — a metric absent from
+    ``old`` counts from zero, covering mid-window registration),
+    gauges keep the NEW point-in-time value.  Histogram deltas carry
+    the diff'd bucket vector, ready for :func:`quantile_from_counts` /
+    :func:`count_over_threshold` — windowed rates and quantiles with no
+    per-observation timestamping."""
+    out: Dict[str, Any] = {}
+    for key, cur in new.items():
+        kind = cur.get("kind")
+        prev = old.get(key)
+        if prev is not None and prev.get("kind") != kind:
+            prev = None         # re-registered as a different type
+        if kind == "counter":
+            base = prev["value"] if prev else 0
+            out[key] = {"kind": "counter",
+                        "value": max(0, cur["value"] - base)}
+        elif kind == "histogram":
+            if prev:
+                # per-bucket clamp: a same-key histogram re-registered
+                # mid-window (register() REPLACES — tracer re-attach)
+                # resets counts below the base; a negative bucket would
+                # poison windowed quantiles and burn rates
+                counts = tuple(max(0, c - p) for c, p in
+                               zip(cur["counts"], prev["counts"]))
+                count = cur["count"] - prev["count"]
+                total = cur["total"] - prev["total"]
+            else:
+                counts, count, total = (cur["counts"], cur["count"],
+                                        cur["total"])
+            out[key] = {"kind": "histogram", "count": max(0, count),
+                        "total": max(0.0, total), "counts": counts}
+        else:
+            out[key] = dict(cur)
+    return out
 
 
 def _resilience_items() -> List[Tuple[str, int]]:

@@ -443,6 +443,13 @@ class Element:
         latency-report=1, tensor_filter.c:1313-1377).  Default: 0."""
         return 0
 
+    def health_state(self) -> "Optional[str]":
+        """Readiness hook for the /healthz endpoint (obs/httpd.py):
+        return ``"degraded"`` while this element is running in a
+        reduced mode (open circuit breakers, lost endpoints, fallback
+        serving), else None.  Called at scrape time only."""
+        return None
+
     # -- helpers -------------------------------------------------------------
     def announce_src_caps(self, caps: Caps, pad: Optional[Pad] = None) -> None:
         """Fixate-check and send a CAPS event downstream."""

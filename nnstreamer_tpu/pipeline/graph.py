@@ -76,6 +76,11 @@ class Pipeline:
         self.fuse = (os.environ.get("NNS_FUSE", "1") != "0"
                      if fuse is None else bool(fuse))
         self.planner = None         # SegmentPlanner while playing
+        #: readiness lifecycle surfaced by the /healthz endpoint
+        #: (obs/httpd.py): starting -> serving -> draining; "degraded"
+        #: is computed per scrape from element health (health_state)
+        self._lifecycle = "starting"
+        self._health_token: Optional[int] = None
 
     # -- construction --------------------------------------------------------
     def add(self, *elements: Element):
@@ -197,9 +202,28 @@ class Pipeline:
         #: running-time origin: sinks with sync=true render buffer PTS
         #: against this (GStreamer base-time role)
         self.base_time_ns = time.monotonic_ns()
+        self._lifecycle = "serving"
+        from ..obs.httpd import register_health_source
+
+        self._health_token = register_health_source(
+            self.health_state, label=f"pipeline:{self.name}")
         for el in self.elements:
             if isinstance(el, Source):
                 el._spawn()
+
+    def health_state(self) -> str:
+        """Readiness state for /healthz (obs/httpd.py): the lifecycle
+        phase, demoted to ``degraded`` while any element reports it —
+        e.g. a ``tensor_query_client`` whose endpoint breakers are OPEN
+        or whose degraded start never reached a server.  Evaluated at
+        scrape time only; costs nothing per buffer."""
+        if self._lifecycle == "serving":
+            if self._error is not None:
+                return "degraded"
+            for el in self.elements:
+                if el.health_state() == "degraded":
+                    return "degraded"
+        return self._lifecycle
 
     def _check_links(self) -> None:
         for el in self.elements:
@@ -262,6 +286,14 @@ class Pipeline:
 
     def stop(self) -> None:
         self._playing = False
+        self._lifecycle = "draining"
+        if self._health_token is not None:
+            # unregister FIRST: a /healthz scrape racing element
+            # teardown must not walk half-stopped elements
+            from ..obs.httpd import unregister_health_source
+
+            unregister_health_source(self._health_token)
+            self._health_token = None
         # phase 0: release blocking waits (a sync sink's PTS wait holds
         # the very streaming thread _halt() is about to join)
         for el in self.elements:

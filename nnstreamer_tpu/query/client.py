@@ -88,6 +88,11 @@ class QueryConnection:
         import collections
 
         self._trace_in: "collections.deque" = collections.deque(maxlen=256)
+        #: loadgen hook (slo/loadgen.py): called as ``(request_class,
+        #: latency_s, ok)`` after every query() — service latency from
+        #: send to reply, per-class via ``buf.extra["nns_class"]``.
+        #: None (the default) costs one attribute test per query.
+        self.on_outcome: Optional[Callable[[str, float, bool], None]] = None
 
     def connect(self) -> None:
         def _dial():
@@ -224,7 +229,27 @@ class QueryConnection:
         """Send one frame, await ITS reply (matched by seq; stale replies
         from timed-out requests are discarded), reconnecting within the
         request's deadline budget (``timeout`` covers send + reconnect +
-        reply)."""
+        reply).
+
+        When :attr:`on_outcome` is set (the loadgen hook), the request's
+        class tag (``buf.extra["nns_class"]``, default ``"default"``),
+        service latency and success flag are reported after every
+        attempt — including raising ones, so error accounting sees
+        timeouts and dead endpoints, not just clean replies."""
+        hook = self.on_outcome
+        if hook is None:
+            return self._query(buf)
+        cls = str(buf.extra.get("nns_class", "default"))
+        t0 = time.monotonic()
+        try:
+            out = self._query(buf)
+        except BaseException:
+            hook(cls, time.monotonic() - t0, False)
+            raise
+        hook(cls, time.monotonic() - t0, True)
+        return out
+
+    def _query(self, buf: TensorBuffer) -> Optional[TensorBuffer]:
         with self._waiters_lock:   # shared with ping allocations
             self._seq += 1
             seq = self._seq
@@ -414,6 +439,17 @@ class FailoverConnection:
 
     def health_report(self) -> Dict[str, Dict[str, object]]:
         return self.monitor.report() if self.monitor is not None else {}
+
+    def degraded(self) -> bool:
+        """True while this connection runs in a reduced mode: no live
+        endpoint (degraded start / mid-stream loss awaiting the next
+        frame's redial) or any endpoint breaker OPEN.  Scrape-time read
+        for the /healthz readiness state — deliberately lock-free
+        (a torn read costs one conservative scrape, not a stall behind
+        a seconds-long dial holding self._lock)."""
+        if self._active is None:
+            return True
+        return any(b.state == "open" for b in self.breakers)
 
     def sample_clock_offset(self) -> None:
         """Rate-limited ping-based offset refresh on the active
@@ -713,6 +749,12 @@ class TensorQueryClient(Element):
         conn = getattr(self, "conn", None)
         if conn is not None:
             conn.close()
+
+    def health_state(self):
+        conn = getattr(self, "conn", None)
+        if conn is not None and conn.degraded():
+            return "degraded"
+        return None
 
     def set_caps(self, pad, caps):
         # announce the server's answer caps when it advertised them,

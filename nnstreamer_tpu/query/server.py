@@ -61,6 +61,16 @@ class QueryServer:
         self._span_cursors: Dict[int, int] = {}   # client id -> ring pos
         self._lock = make_lock("query.registry")
         self._stop = threading.Event()
+        # scrape-time gauges for the soak harness: connected-client
+        # count is a lazy callable (zero per-frame cost); accepts are a
+        # per-connection counter, not per-buffer
+        from ..obs.metrics import REGISTRY
+
+        self._m_clients = REGISTRY.gauge(
+            "nns_query_server_clients", fn=lambda: len(self._clients),
+            port=str(self.port))
+        self._m_accepted = REGISTRY.counter(
+            "nns_query_server_accepted_total", port=str(self.port))
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="query-accept")
         self._accept_thread.start()
@@ -79,6 +89,7 @@ class QueryServer:
                 self._next_id += 1
                 self._clients[cid] = conn
                 self._send_locks[cid] = make_lock("query.send")
+            self._m_accepted.inc()
             threading.Thread(target=self._client_loop, args=(cid, conn),
                              daemon=True, name=f"query-client-{cid}").start()
 
@@ -192,6 +203,9 @@ class QueryServer:
 
     def close(self) -> None:
         self._stop.set()
+        from ..obs.metrics import REGISTRY
+
+        REGISTRY.unregister(self._m_clients)
         try:
             self._sock.close()
         except OSError:

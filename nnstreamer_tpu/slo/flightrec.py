@@ -1,0 +1,133 @@
+"""Flight recorder: always-on bounded triage ring, dumped at SLO breach.
+
+A failed multi-minute soak must be triaged from an ARTIFACT, not rerun:
+by the time a human looks, the tunnel window is gone and the breach is
+unreproducible.  So the recorder runs for the whole soak at bounded
+cost — a deque of recent per-tick metric snapshots (the evaluator's
+``on_tick`` feed) riding next to the serving pipeline's bounded span
+ring (``Tracer(spans=True)``, obs/span.py, overwrite-oldest) — and
+converts itself into a bundle the moment the evaluator reports a breach
+onset (``on_breach``):
+
+``bundle-<n>-<objective>/``
+    ``manifest.json``   — breach event, wall/mono stamps, file inventory
+    ``trace.json``      — Chrome ``trace_event`` export of the span ring
+                          (the breaching window's spans: the ring holds
+                          the most recent spans, which at dump time ARE
+                          the breach neighborhood) — open in Perfetto
+    ``breach.json``     — the triggering evaluation (both windows'
+                          burn-rate evidence)
+    ``metrics_timeline.jsonl`` — one line per recorded tick: metric
+                          snapshot + objective burn rates (the time
+                          series leading INTO the breach)
+    ``metrics_final.json`` — full registry report at dump time
+
+Dumps are capped (``max_dumps``) so a flapping objective cannot fill a
+disk; every breach past the cap still lands in the evaluator's verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..analysis.sanitizer import make_lock
+from ..obs.clock import mono_ns, wall_us
+from ..obs.metrics import REGISTRY, MetricsRegistry
+
+
+class FlightRecorder:
+    """Bounded snapshot ring + breach-triggered bundle writer.
+
+    Wire it up with::
+
+        rec = FlightRecorder(out_dir, tracer=server_tracer)
+        evaluator.on_tick = rec.record
+        evaluator.on_breach = rec.on_breach
+    """
+
+    def __init__(self, out_dir: str, tracer: Optional[Any] = None,
+                 registry: MetricsRegistry = REGISTRY,
+                 capacity: int = 512, max_dumps: int = 3) -> None:
+        self.out_dir = out_dir
+        self.tracer = tracer
+        self.registry = registry
+        self.max_dumps = int(max_dumps)
+        self._lock = make_lock("slo")
+        self._ring: "deque[Dict[str, Any]]" = deque(
+            maxlen=max(8, int(capacity)))
+        self.dumps: List[str] = []
+
+    # -- feed ----------------------------------------------------------------
+    def record(self, evaluation: Optional[Dict[str, Any]] = None) -> None:
+        """Append one tick to the ring: wall/mono stamps, the registry
+        report (cheap: values + histogram summaries, not full bucket
+        vectors), and the evaluation's per-objective burn rates."""
+        entry: Dict[str, Any] = {"wall_us": wall_us(),
+                                 "mono_s": round(mono_ns() / 1e9, 3),
+                                 "metrics": self.registry.report()}
+        if evaluation is not None:
+            entry["burn"] = {
+                o["name"]: {"fast": o["fast"]["burn_rate"],
+                            "slow": o["slow"]["burn_rate"],
+                            "breached": o["breached"]}
+                for o in evaluation.get("objectives", ())}
+        with self._lock:
+            self._ring.append(entry)
+
+    # -- dump ----------------------------------------------------------------
+    def on_breach(self, event: Dict[str, Any],
+                  evaluation: Dict[str, Any]) -> Optional[str]:
+        """Evaluator breach-onset hook: write one bundle (up to
+        ``max_dumps``); returns the bundle dir, or None past the cap."""
+        with self._lock:
+            if len(self.dumps) >= self.max_dumps:
+                return None
+            n = len(self.dumps)
+        path = self.dump(f"{n}-{event.get('objective', 'breach')}",
+                         breach={"event": event,
+                                 "evaluation": evaluation})
+        return path
+
+    def dump(self, tag: str,
+             breach: Optional[Dict[str, Any]] = None) -> str:
+        """Write a bundle now (breach hook or operator-forced); returns
+        the bundle directory path."""
+        bundle = os.path.join(self.out_dir, f"bundle-{tag}")
+        os.makedirs(bundle, exist_ok=True)
+        with self._lock:
+            timeline = list(self._ring)
+        files = {}
+
+        def _write(name: str, obj: Any) -> None:
+            p = os.path.join(bundle, name)
+            with open(p, "w", encoding="utf-8") as fh:
+                if name.endswith(".jsonl"):
+                    for row in obj:
+                        fh.write(json.dumps(row) + "\n")
+                else:
+                    json.dump(obj, fh, indent=2)
+            files[name] = os.path.getsize(p)
+
+        if breach is not None:
+            _write("breach.json", breach)
+        if self.tracer is not None and \
+                getattr(self.tracer, "ring", None) is not None:
+            _write("trace.json", self.tracer.chrome_trace())
+        _write("metrics_timeline.jsonl", timeline)
+        _write("metrics_final.json", self.registry.report())
+        manifest = {"tag": tag, "wall_us": wall_us(),
+                    "mono_s": round(mono_ns() / 1e9, 3),
+                    "recorded_ticks": len(timeline),
+                    "files": files}
+        if self.tracer is not None and \
+                getattr(self.tracer, "ring", None) is not None:
+            manifest["span_ring"] = {
+                "capacity": self.tracer.ring.capacity,
+                "dropped": self.tracer.ring.dropped}
+        _write("manifest.json", manifest)
+        with self._lock:
+            self.dumps.append(bundle)
+        return bundle

@@ -33,7 +33,7 @@ from __future__ import annotations
 import socket
 import threading
 from time import sleep as _sleep
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..query.protocol import shutdown_close as _shutdown_close
 
@@ -184,3 +184,143 @@ class ChaosProxy:
                 if s in self._live:
                     self._live.remove(s)
             _shutdown_close(s)
+
+
+class ChaosStage:
+    """One scheduled fault on a soak timeline: at ``at_s`` seconds into
+    the run apply ``fault``, and (for the toggling faults) clear it
+    ``duration`` seconds later.
+
+    Faults map onto the :class:`ChaosProxy` vocabulary:
+
+    - ``kill`` — one-shot ``kill_connections()`` (duration ignored)
+    - ``disconnect_once`` — arm the one-shot mid-stream drop
+    - ``blackhole`` / ``corrupt`` / ``refuse`` — toggle on for
+      ``duration`` seconds (default 1.0)
+    - ``delay`` — set per-chunk delay to ``value`` seconds for
+      ``duration`` seconds
+    """
+
+    FAULTS = ("kill", "disconnect_once", "blackhole", "corrupt",
+              "refuse", "delay")
+    _ONESHOT = frozenset({"kill", "disconnect_once"})
+
+    def __init__(self, at_s: float, fault: str, duration: float = 1.0,
+                 value: float = 0.0) -> None:
+        if fault not in self.FAULTS:
+            raise ValueError(f"unknown fault {fault!r} "
+                             f"(want one of {self.FAULTS})")
+        if at_s < 0 or duration <= 0:
+            raise ValueError("at_s >= 0 and duration > 0 required")
+        self.at_s = float(at_s)
+        self.fault = fault
+        self.duration = float(duration)
+        self.value = float(value)
+
+    def __repr__(self) -> str:
+        extra = "" if self.fault in self._ONESHOT \
+            else f" for {self.duration}s"
+        return f"ChaosStage({self.at_s}s: {self.fault}{extra})"
+
+
+class ChaosSchedule:
+    """Staged chaos along a soak timeline: applies each
+    :class:`ChaosStage` to a :class:`ChaosProxy` at its offset, from
+    one scheduler thread waiting on event deadlines (no polling — a
+    ``stop()`` mid-soak returns immediately and clears every toggled
+    fault so the proxy is left clean).
+
+    ``parse`` reads the ``tools/soak.py --chaos`` grammar::
+
+        "25:disconnect_once;40:blackhole:3;50:delay:2:0.25"
+        #  at_s:fault[:duration[:value]] entries, ';'-separated
+    """
+
+    def __init__(self, proxy: ChaosProxy,
+                 stages: "List[ChaosStage]") -> None:
+        self.proxy = proxy
+        self.stages = sorted(stages, key=lambda s: s.at_s)
+        self.log: List[Dict[str, object]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def parse(cls, proxy: ChaosProxy, spec: str) -> "ChaosSchedule":
+        stages = []
+        for part in str(spec).split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            bits = part.split(":")
+            if len(bits) < 2:
+                raise ValueError(f"chaos stage {part!r}: want "
+                                 "at_s:fault[:duration[:value]]")
+            stages.append(ChaosStage(
+                float(bits[0]), bits[1].strip(),
+                duration=float(bits[2]) if len(bits) > 2 else 1.0,
+                value=float(bits[3]) if len(bits) > 3 else 0.0))
+        return cls(proxy, stages)
+
+    def start(self) -> "ChaosSchedule":
+        if self._thread is None and self.stages:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="chaos-schedule")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+        # leave the proxy clean: a toggled fault — or an armed-but-
+        # unfired one-shot — must not outlive the schedule that
+        # applied it (a later run reusing the proxy would get a
+        # surprise disconnect attributed to no chaos event)
+        self.proxy.blackhole = False
+        self.proxy.corrupt = False
+        self.proxy.refuse = False
+        self.proxy.delay = 0.0
+        self.proxy.disconnect_once = False
+
+    # -- scheduler -----------------------------------------------------------
+    def _loop(self) -> None:
+        from ..obs.clock import mono_ns
+
+        t0 = mono_ns() / 1e9
+        # expand toggling stages into (offset, action) pairs so clears
+        # are just later actions on one sorted timeline
+        timeline: List[Tuple[float, str, ChaosStage]] = []
+        for st in self.stages:
+            timeline.append((st.at_s, "apply", st))
+            if st.fault not in ChaosStage._ONESHOT:
+                timeline.append((st.at_s + st.duration, "clear", st))
+        timeline.sort(key=lambda e: e[0])
+        for offset, action, st in timeline:
+            wait = t0 + offset - mono_ns() / 1e9
+            if wait > 0 and self._stop.wait(wait):
+                return
+            if self._stop.is_set():
+                return
+            self._fire(action, st, mono_ns() / 1e9 - t0)
+
+    def _fire(self, action: str, st: ChaosStage, at: float) -> None:
+        entry = {"t_s": round(at, 3), "action": action,
+                 "fault": st.fault}
+        if action == "apply":
+            if st.fault == "kill":
+                entry["killed"] = self.proxy.kill_connections()
+            elif st.fault == "disconnect_once":
+                self.proxy.disconnect_once = True
+            elif st.fault == "delay":
+                self.proxy.delay = st.value
+            else:
+                setattr(self.proxy, st.fault, True)
+        else:
+            if st.fault == "delay":
+                self.proxy.delay = 0.0
+            else:
+                setattr(self.proxy, st.fault, False)
+        self.log.append(entry)

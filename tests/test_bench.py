@@ -39,6 +39,8 @@ def test_orchestrate_emits_error_json_after_retries(monkeypatch):
     r = bench.orchestrate("mobilenet", cpu=False, deadline=1, retries=2)
     assert len(calls) == 3
     assert r["value"] == 0 and r["vs_baseline"] == 0
+    # the link was alive: this failure is the code's, not the infra's
+    assert r["status"] == "regression"
     assert r["metric"] == bench.CONFIG_METRICS["mobilenet"]
     assert "deadline" in r["error"]
     # even the all-retries-burned row points at committed green evidence
@@ -69,6 +71,9 @@ def test_orchestrate_midrun_tunnel_death_short_circuits(monkeypatch):
     r = bench.orchestrate("mobilenet", cpu=False, deadline=1, retries=2)
     assert len(calls) == 1         # no second deadline burned
     assert r["value"] == 0
+    # infra verdict: nothing was measured, so no 0x-vs-baseline claim
+    assert r["status"] == "infra_dead"
+    assert r["vs_baseline"] is None
     assert "tunnel died mid-run" in r["error"]
     # structured flag: --all / --sweep re-gate later configs on this,
     # not on the human-readable error text
@@ -105,6 +110,7 @@ def test_orchestrate_recovers_on_retry(monkeypatch):
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     r = bench.orchestrate("mobilenet", cpu=False, deadline=1, retries=2)
     assert r["value"] == 42.0 and r["attempt"] == 2
+    assert r["status"] == "live"   # a measured row says so explicitly
 
 
 def test_orchestrate_keeps_core_result_from_killed_child(monkeypatch):
@@ -140,11 +146,17 @@ def test_preprobe_dead_tunnel_fails_fast_with_cached_green(monkeypatch):
     row = json.loads(out.stdout.strip().splitlines()[-1])
     assert row["value"] == 0
     assert "preprobe" in row["error"]
+    # satellite fix (cached_green masking): the row IS infra_dead with
+    # a null vs_baseline — a dead link is not a 0x measurement — and
+    # the attached green capture is explicitly an annotation
+    assert row["status"] == "infra_dead"
+    assert row["vs_baseline"] is None
     # the repo carries round-4 green captures for this metric; the
     # failure row must point at the best one
     cg = row.get("cached_green")
     assert cg and cg["value"] > 0 and cg["file"].startswith("BENCH_")
     assert cg["metric"] == bench.CONFIG_METRICS["mobilenet"]
+    assert "annotation" in cg["role"]
 
 
 def test_preprobe_dead_tunnel_sweep_rows(monkeypatch):

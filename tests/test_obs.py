@@ -195,6 +195,23 @@ class TestMetricsRegistry:
         assert reg.unregister_matching("d", queue="q1") == 1
         assert len(reg._snapshot()) == 1
 
+    def test_state_delta_clamps_reregistered_histogram(self):
+        """register() REPLACES same-key histograms (tracer re-attach);
+        a window diff across the replacement must clamp at zero, not
+        emit negative buckets that poison windowed quantiles."""
+        from nnstreamer_tpu.obs.metrics import state_delta
+
+        reg = MetricsRegistry()
+        h = reg.histogram("h")
+        for _ in range(5):
+            h.observe(100.0)
+        s0 = reg.snapshot_state()
+        h2 = reg.register(Histogram("h", {}))   # replacement resets
+        h2.observe(100.0)
+        d = state_delta(reg.snapshot_state(), s0)
+        assert all(c >= 0 for c in d["h"]["counts"])
+        assert d["h"]["count"] >= 0
+
 
 class TestMetricsEndpoint:
     def test_http_scrape(self):
@@ -211,7 +228,13 @@ class TestMetricsEndpoint:
             assert b"nns_endpoint_smoke_total 1" in body
             ok = urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/healthz", timeout=5).read()
-            assert ok == b"ok\n"
+            # readiness JSON (was a bare 200 "ok"): worst state across
+            # registered health sources; deeper coverage in test_slo.py
+            import json as _json
+
+            health = _json.loads(ok)
+            assert health["ready"] is True
+            assert health["state"] in ("starting", "serving")
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(
                     f"http://127.0.0.1:{port}/nope", timeout=5)

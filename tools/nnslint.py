@@ -13,7 +13,11 @@ This tool checks exactly those repo rules:
     ``query/resilience.py`` (THE backoff module), sleeps whose duration
     comes from a retry policy (``*.delay(...)``), and pragma'd lines
     (cross-process mmap waits that genuinely cannot block on a local
-    primitive).
+    primitive).  In ``slo/`` the rule tightens to ANY ``time.sleep``
+    (loop or not): the SLO harness is deadline-driven by contract —
+    open-loop arrival schedules and evaluator ticks pace on
+    ``Event.wait`` against absolute monotonic deadlines, because a
+    load generator that drifts under load measures its own jitter.
 
 ``io-under-lock``
     Blocking socket send/recv while holding a lock that is not the
@@ -324,15 +328,22 @@ class _FileLinter(ast.NodeVisitor):
             lock = self._resolve_lock(node.func.value)
             if lock is not None and self._with_stack:
                 self._note_acquire(lock, node, push=False)
-        # sleep-poll: time.sleep inside a lexical loop
+        # sleep-poll: time.sleep inside a lexical loop — and ANY
+        # time.sleep in slo/ (loop or not): the SLO harness is
+        # deadline-driven by contract; a generator that sleeps measures
+        # its own scheduling jitter, not the server's latency
+        in_slo = (os.sep + "slo" + os.sep) in self.rel
         if name == "sleep" and isinstance(node.func, ast.Attribute) \
                 and isinstance(node.func.value, ast.Name) \
                 and node.func.value.id in ("time", "_time") \
-                and self._in_loop(node) \
+                and (self._in_loop(node) or in_slo) \
                 and not self._is_backoff_sleep(node) \
                 and not self.rel.endswith(os.path.join("query",
                                                        "resilience.py")):
             self._add(node, "sleep-poll",
+                      "time.sleep in slo/ is banned: pace on "
+                      "Event.wait against absolute deadlines "
+                      "(slo/loadgen.py pattern)" if in_slo else
                       "time.sleep in a loop is a polling wait: use a "
                       "condition / blocking get with a wake sentinel "
                       "(pipeline/graph.py AppSrc/Queue pattern), or a "

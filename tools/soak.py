@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Scripted SLO soak: open-loop load + staged chaos + burn-rate verdict.
+
+Composes the ``nnstreamer_tpu.slo`` harness end to end:
+
+1. **Target** — either an existing ``QueryServer`` (``--host/--port``)
+   or, with ``--demo`` (default when no port is given), a loopback
+   serving pipeline built in-process (``tensor_query_serversrc !
+   tensor_transform ! tensor_query_serversink``) with span recording
+   enabled so the flight recorder has a timeline to dump.
+2. **Infra gate** — the shared infra-dead detector
+   (``tools/tunnel_probe.py diagnose_endpoint``): a dead target yields
+   a ``status: infra_dead`` verdict row (same taxonomy as bench.py) and
+   exit 2, never a FAIL that would read as a regression.
+3. **Chaos** — a ``testing/faults.py`` :class:`ChaosProxy` between the
+   clients and the server, driven by a staged
+   :class:`ChaosSchedule` (``--chaos "21:kill;36:disconnect_once"``).
+4. **Load** — ``slo/loadgen.py`` open-loop Poisson/constant arrivals
+   over ``--clients`` concurrent query connections.
+5. **Gate** — ``slo/evaluator.py`` multi-window burn rates against the
+   ``--slo`` spec (default: the demo spec scaled to ``--duration``),
+   with the flight recorder armed on breach onset.
+
+Prints ONE verdict JSON line (plus a ``verdict.json`` artifact under
+``--out``); exit 0 = PASS, 1 = FAIL, 2 = infra dead.
+
+The acceptance demo::
+
+    python tools/soak.py --demo            # 64 clients x 60 s, chaos on
+    python tools/soak.py --demo --force-breach   # prove the recorder
+
+``--force-breach`` adds an impossible latency objective (1 µs) so the
+breach path — burn-rate alert, flight-recorder bundle with the
+breaching window's spans — is exercised on demand.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))   # repo root: nnstreamer_tpu
+sys.path.insert(0, _HERE)                    # sibling tools (tunnel_probe)
+
+DEMO_CAPS = ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+             "types=float32,framerate=0/1")
+DEMO_SERVER_ID = 91
+
+
+def build_demo_server(server_id: int = DEMO_SERVER_ID):
+    """Loopback serving pipeline with span recording on; returns
+    ``(pipeline, data_port, tracer)``."""
+    from nnstreamer_tpu import parse_launch
+
+    p = parse_launch(
+        f"tensor_query_serversrc name=qsrc id={server_id} port=0 "
+        f"caps={DEMO_CAPS} ! "
+        "tensor_transform mode=arithmetic option=mul:2 ! "
+        f"tensor_query_serversink id={server_id}")
+    tracer = p.enable_tracing(spans=True)
+    p.play()
+    return p, p.get("qsrc").bound_port, tracer
+
+
+def default_chaos(duration_s: float) -> str:
+    """Demo chaos: a full connection kill at 35 % and a one-shot
+    mid-stream disconnect at 60 % of the soak — both recoverable, so a
+    healthy harness PASSES through them (the false-positive gate)."""
+    return (f"{duration_s * 0.35:.1f}:kill;"
+            f"{duration_s * 0.60:.1f}:disconnect_once")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="soak", description="open-loop SLO soak harness")
+    ap.add_argument("--demo", action="store_true",
+                    help="run against an in-process loopback serving "
+                         "pipeline (default when --port is not given)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="existing QueryServer data port (0 = demo)")
+    ap.add_argument("--clients", type=int, default=64)
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--rate", type=float, default=1.0,
+                    help="arrivals/s PER CLIENT (offered load = "
+                         "clients * rate).  The default sizes the demo "
+                         "at ~50%% of the loopback reference server's "
+                         "measured ~2 ms/query single-stream capacity; "
+                         "raising it past saturation is itself a useful "
+                         "experiment — the open-loop harness will show "
+                         "the queueing collapse a closed-loop one hides")
+    ap.add_argument("--schedule", choices=("poisson", "constant"),
+                    default="poisson")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-request reply budget (seconds)")
+    ap.add_argument("--slo", default=None, metavar="FILE",
+                    help="SLO spec JSON (default: demo spec scaled to "
+                         "--duration)")
+    ap.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="staged chaos 'at_s:fault[:duration[:value]];"
+                         "...' (default: kill@35%% + disconnect@60%%; "
+                         "'' disables)")
+    ap.add_argument("--out", default="soak_out", metavar="DIR",
+                    help="artifact dir (verdict.json + flight-recorder "
+                         "bundles)")
+    ap.add_argument("--force-breach", action="store_true",
+                    help="add an impossible latency objective so the "
+                         "breach/flight-recorder path fires")
+    args = ap.parse_args(argv)
+
+    from nnstreamer_tpu.slo import (Evaluator, FlightRecorder,
+                                    LoadGenerator, SLOMonitor, load_spec)
+    from nnstreamer_tpu.slo.spec import Objective, SLOSpec
+    from nnstreamer_tpu.testing.faults import ChaosProxy, ChaosSchedule
+    from tunnel_probe import diagnose_endpoint
+
+    os.makedirs(args.out, exist_ok=True)
+    demo = args.demo or not args.port
+    server = tracer = None
+    try:
+        if demo:
+            server, port, tracer = build_demo_server()
+            host = "127.0.0.1"
+        else:
+            host, port = args.host, args.port
+
+        # shared infra-dead detector (satellite: one taxonomy with
+        # bench.py) — a dead target is status infra_dead, exit 2, and
+        # must never masquerade as an SLO FAIL
+        diagnosis = diagnose_endpoint(host, port,
+                                      timeout=min(5.0, args.timeout * 2))
+        if not diagnosis["ok"]:
+            row = {"metric": "soak_verdict", "verdict": "INFRA_DEAD",
+                   "pass": False, "status": "infra_dead",
+                   "vs_baseline": None, "diagnosis": diagnosis}
+            print(json.dumps(row), flush=True)
+            return 2
+
+        spec = load_spec(args.slo, duration_s=args.duration)
+        if args.force_breach:
+            spec = SLOSpec(
+                name=spec.name + "+forced-breach",
+                objectives=spec.objectives + (Objective(
+                    "forced_p99", "latency", target=0.9,
+                    threshold_us=1.0),),
+                window_fast_s=spec.window_fast_s,
+                window_slow_s=spec.window_slow_s,
+                burn_threshold=spec.burn_threshold,
+                tick_s=spec.tick_s)
+
+        proxy = ChaosProxy((host, port))
+        chaos_spec = (default_chaos(args.duration)
+                      if args.chaos is None else args.chaos)
+        schedule = ChaosSchedule.parse(proxy, chaos_spec)
+
+        recorder = FlightRecorder(args.out, tracer=tracer)
+        evaluator = Evaluator(spec, on_breach=recorder.on_breach)
+        evaluator.on_tick = recorder.record
+        monitor = SLOMonitor(evaluator)
+
+        gen = LoadGenerator(
+            proxy.host, proxy.port, clients=args.clients,
+            rate_hz=args.rate, duration_s=args.duration,
+            schedule=args.schedule, seed=args.seed,
+            timeout=args.timeout,
+            classes=(("interactive", 0.75), ("batch", 0.25)))
+
+        schedule.start()
+        monitor.start()
+        try:
+            summary = gen.run()
+        finally:
+            monitor.stop(final_tick=True)
+            schedule.stop()
+            proxy.close()
+
+        verdict = evaluator.verdict()
+        verdict["status"] = "live"
+        verdict["loadgen"] = summary
+        verdict["chaos"] = schedule.log
+        verdict["flight_recorder"] = {"bundles": recorder.dumps}
+        with open(os.path.join(args.out, "verdict.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(verdict, fh, indent=2)
+        print(json.dumps({
+            "metric": "soak_verdict", "verdict": verdict["verdict"],
+            "pass": verdict["pass"], "status": "live",
+            "clients": summary["clients"],
+            "peak_live_clients": summary["peak_live_clients"],
+            "duration_s": summary["duration_s"],
+            "sent": summary["sent"], "errors": summary["errors"],
+            "error_fraction": summary["error_fraction"],
+            "latency_us": summary["latency_us"],
+            "breaches": len(verdict["breaches"]),
+            "chaos_events": len(schedule.log),
+            "bundles": recorder.dumps,
+            "artifact": os.path.join(args.out, "verdict.json"),
+        }), flush=True)
+        return 0 if verdict["pass"] else 1
+    finally:
+        if server is not None:
+            server.stop()
+            from nnstreamer_tpu.query.server import shutdown_server
+
+            shutdown_server(DEMO_SERVER_ID)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -176,7 +176,152 @@ def probe(reps_rtt: int = 30, sizes_mib=(1, 4, 16)) -> dict:
     return out
 
 
-if __name__ == "__main__":
+def _diagnose_once(host: str, port: int, timeout: float,
+                   stages: dict) -> "str | None":
+    """One staged pass over a TCP endpoint; fills ``stages`` and
+    returns the name of the FIRST failed stage (or None when healthy).
+    Stages mirror the link anatomy so the artifact names what broke:
+
+    - ``dns``        — name resolution
+    - ``connect``    — TCP dial
+    - ``rtt``        — T_PING/T_PONG round trips over the query
+      protocol (fails on a port that accepts but isn't a live
+      ``QueryServer`` — the half-up failure mode)
+    - ``throughput`` — one 256 KiB ping payload echo (the server echoes
+      ping payloads), a bulk-bytes sanity number
+    """
+    import socket
+    import time as _time
+
+    def _ms(t0):
+        return round((_time.monotonic() - t0) * 1e3, 2)
+
+    t0 = _time.monotonic()
+    try:
+        infos = socket.getaddrinfo(str(host), int(port),
+                                   type=socket.SOCK_STREAM)
+    except OSError as exc:
+        stages["dns"] = {"ok": False, "ms": _ms(t0),
+                         "error": f"{type(exc).__name__}: {exc}"[:200]}
+        return "dns"
+    stages["dns"] = {"ok": True, "ms": _ms(t0), "addrs": len(infos)}
+
+    t0 = _time.monotonic()
+    try:
+        sock = socket.create_connection((str(host), int(port)),
+                                        timeout=timeout)
+    except OSError as exc:
+        stages["connect"] = {"ok": False, "ms": _ms(t0),
+                             "error":
+                                 f"{type(exc).__name__}: {exc}"[:200]}
+        return "connect"
+    stages["connect"] = {"ok": True, "ms": _ms(t0)}
+
+    from nnstreamer_tpu.query.protocol import (Message, T_PING, T_PONG,
+                                               recv_msg, send_msg,
+                                               shutdown_close)
+
+    try:
+        sock.settimeout(timeout)
+
+        def _ping(payload: bytes, seq: int) -> float:
+            t = _time.monotonic()
+            send_msg(sock, Message(T_PING, seq=seq, payload=payload))
+            msg = recv_msg(sock)
+            if msg is None or msg.type != T_PONG or msg.seq != seq:
+                raise ConnectionError("no matching T_PONG "
+                                      "(not a live QueryServer?)")
+            return _time.monotonic() - t
+
+        t0 = _time.monotonic()
+        try:
+            rtts = [_ping(b"", seq) for seq in (1, 2, 3)]
+        except (OSError, ValueError, ConnectionError) as exc:
+            stages["rtt"] = {"ok": False, "ms": _ms(t0),
+                             "error":
+                                 f"{type(exc).__name__}: {exc}"[:200]}
+            return "rtt"
+        stages["rtt"] = {"ok": True,
+                         "rtt_ms_p50": round(
+                             _percentile(rtts, 0.5) * 1e3, 2)}
+
+        blob = b"\x5a" * (256 << 10)
+        t0 = _time.monotonic()
+        try:
+            took = _ping(blob, 4)
+        except (OSError, ValueError, ConnectionError) as exc:
+            stages["throughput"] = {
+                "ok": False, "ms": _ms(t0),
+                "error": f"{type(exc).__name__}: {exc}"[:200]}
+            return "throughput"
+        stages["throughput"] = {
+            "ok": True,
+            "MBps": round(2 * len(blob) / (1 << 20) / max(took, 1e-9),
+                          2)}
+        return None
+    finally:
+        shutdown_close(sock)
+
+
+def diagnose_endpoint(host: str, port: int, timeout: float = 2.0,
+                      retries: int = 0, backoff: float = 1.0) -> dict:
+    """Structured infra-dead diagnosis of a ``QueryServer`` endpoint —
+    the detector ``tools/soak.py`` and the bench taxonomy share: the
+    returned dict names the exact stage that failed
+    (dns/connect/rtt/throughput) instead of a bare refused-connection
+    string.  ``retries``/``backoff`` retry the whole staged pass with
+    exponential spacing (a soak launched while a server restarts should
+    wait out the restart, not report it dead)."""
+    import time as _time
+
+    out = {"metric": "endpoint_diagnosis", "target": f"{host}:{port}",
+           "ok": False, "stage_failed": None, "attempts": 0,
+           "stages": {}}
+    for attempt in range(max(0, int(retries)) + 1):
+        out["attempts"] = attempt + 1
+        out["stages"] = {}
+        out["stage_failed"] = _diagnose_once(host, int(port),
+                                             float(timeout),
+                                             out["stages"])
+        if out["stage_failed"] is None:
+            out["ok"] = True
+            return out
+        if attempt <= retries - 1:
+            _time.sleep(min(30.0, float(backoff) * (2 ** attempt)))
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tunnel_probe",
+        description="host<->TPU link profile, or staged TCP endpoint "
+                    "diagnosis (--endpoint)")
+    ap.add_argument("--retries", type=int, default=0,
+                    help="retry a dead gate/diagnosis N times")
+    ap.add_argument("--backoff", type=float, default=2.0,
+                    help="base seconds between retries (exponential)")
+    ap.add_argument("--endpoint", default=None, metavar="HOST:PORT",
+                    help="diagnose a QueryServer endpoint "
+                         "(dns/connect/rtt/throughput stages) instead "
+                         "of profiling the jax link")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="--endpoint: per-stage timeout seconds")
+    args = ap.parse_args(argv)
+
+    if args.endpoint:
+        host, _, port = args.endpoint.rpartition(":")
+        if not port.isdigit():
+            ap.error("--endpoint wants HOST:PORT")
+        diagnosis = diagnose_endpoint(host or "127.0.0.1", int(port),
+                                      timeout=args.timeout,
+                                      retries=args.retries,
+                                      backoff=args.backoff)
+        diagnosis["status"] = "live" if diagnosis["ok"] else "infra_dead"
+        print(json.dumps(diagnosis))
+        return 0
+
     try:
         # cheap liveness gate first (INSIDE the one-JSON-line contract:
         # even a gate-side crash must yield an error row): a dead
@@ -186,17 +331,35 @@ if __name__ == "__main__":
         # twice as fast.  CPU-host profiling (probe() supports it for
         # tests) bypasses the gate via JAX_PLATFORMS=cpu.  Exit is 0
         # either way: this tool's contract is the ROW, not the rc.
-        from bench import emit_dead_row_if_gated
+        # --retries N --backoff S re-runs a dead gate with exponential
+        # spacing before conceding the row (capture loops launched into
+        # a closing window get the next window instead of a dead cycle).
+        from bench import dead_row, tunnel_gate
 
-        if emit_dead_row_if_gated(
-                "tpu_tunnel_profile", "profile",
-                {"vs_baseline": 0,
+        dead = None
+        for attempt in range(max(0, args.retries) + 1):
+            dead = tunnel_gate(timeout=45.0)
+            if dead is None:
+                break
+            if attempt < args.retries:
+                time.sleep(min(300.0, args.backoff * (2 ** attempt)))
+        if dead is not None:
+            print(json.dumps(dead_row(
+                "tpu_tunnel_profile", "profile", dead,
+                {"attempts": args.retries + 1,
                  "hint": "JAX_PLATFORMS=cpu bypasses the gate for a "
-                         "CPU-host profile"},
-                timeout=45.0) is None:
-            print(json.dumps(probe()))
+                         "CPU-host profile"})), flush=True)
+        else:
+            row = probe()
+            row["status"] = "live"
+            print(json.dumps(row))
     except Exception as exc:  # noqa: BLE001 - one-line contract
         print(json.dumps({"metric": "tpu_tunnel_profile", "value": 0,
                           "unit": "profile", "vs_baseline": 0,
+                          "status": "regression",
                           "error": f"{type(exc).__name__}: {exc}"[:300]}))
-    sys.exit(0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
