@@ -45,6 +45,10 @@ HIERARCHY: Dict[str, int] = {
     # query / transport layer ----------------------------------------------
     "query.registry": 50,   # server/broker connection registries
     "query.client": 52,     # FailoverConnection endpoint state
+    "query.overload": 54,   # admission controller / shed policy /
+    #                         token bucket state (query/overload.py;
+    #                         may evaluate metric gauges, so it ranks
+    #                         below obs.metrics)
     "query.send": 60,       # per-connection/stream send locks
     # observability / memory -----------------------------------------------
     "slo": 66,              # SLO evaluator window store + flight-recorder

@@ -138,6 +138,14 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-out", default="flightrec", metavar="DIR",
                     help="flight-recorder bundle dir for --slo "
                          "breaches (default: ./flightrec)")
+    ap.add_argument("--drain-grace", type=float, default=5.0,
+                    metavar="SECONDS",
+                    help="graceful-drain budget for SIGTERM: on TERM "
+                         "the pipeline flips /healthz to draining "
+                         "(503), serving elements shed new requests "
+                         "with retry-after and finish in-flight "
+                         "replies, then the process exits 0 "
+                         "(Pipeline.drain)")
     args = ap.parse_args(argv)
 
     if args.inspect is not None:
@@ -195,6 +203,7 @@ def main(argv=None) -> int:
             import jax
 
             jax.profiler.start_trace(args.jax_trace)
+        _install_sigterm_drain(p, args.drain_grace)
         try:
             p.play()
             if slo_monitor is not None:
@@ -292,6 +301,33 @@ def main(argv=None) -> int:
         print(f"pipeline finished in {time.time() - t0:.2f}s",
               file=sys.stderr)
     return 3 if slo_failed else 0
+
+
+def _install_sigterm_drain(pipeline, grace_s: float) -> None:
+    """SIGTERM → graceful drain: the orchestrator's stop signal flips
+    the pipeline to ``draining`` (healthz 503 routes the load balancer
+    away), serving elements answer new requests with explicit sheds
+    while in-flight replies finish, then the process exits 0 — clients
+    see retry-after hints, never mid-reply connection resets."""
+    import signal
+
+    fired = []
+
+    def _on_term(signum, frame):
+        if fired:           # re-delivery while the first drain unwinds
+            raise SystemExit(0)
+        fired.append(signum)
+        print(f"SIGTERM: draining pipeline (grace {grace_s:.1f}s)...",
+              file=sys.stderr)
+        try:
+            pipeline.drain(grace_s)
+        finally:
+            raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass    # not the main thread (embedded use): caller owns signals
 
 
 def check(description: str, out=None) -> int:

@@ -1,0 +1,45 @@
+"""jax API-drift shims for the multi-chip layer.
+
+``jax.shard_map`` graduated out of ``jax.experimental.shard_map``
+upstream, and the two revisions disagree on both the attribute path
+and one keyword (``check_vma`` is the graduated spelling of the
+experimental ``check_rep``).  Every ``parallel/`` call site imports
+:func:`shard_map` from here so the layer runs on either revision
+instead of dying with ``AttributeError: module 'jax' has no attribute
+'shard_map'`` on hosts that ship the experimental-only API.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _experimental_shard_map():
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        # graduated-API spelling → experimental spelling
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        return _sm(f, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, **kw)
+
+    return shard_map
+
+
+#: ``jax.shard_map`` when this jax has it, else the experimental one
+#: behind a keyword-translating wrapper
+shard_map = getattr(jax, "shard_map", None) or _experimental_shard_map()
+
+
+def _axis_size_fallback(axis_name):
+    # pre-graduation jax has no jax.lax.axis_size; psum of the constant
+    # 1 over the axis constant-folds to a static Python int inside
+    # shard_map, which is exactly what the ring/all-to-all loop bounds
+    # need
+    return jax.lax.psum(1, axis_name)
+
+
+#: ``jax.lax.axis_size`` when present, else the psum(1) fold
+axis_size = getattr(jax.lax, "axis_size", None) or _axis_size_fallback

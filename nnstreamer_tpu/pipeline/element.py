@@ -447,8 +447,16 @@ class Element:
         """Readiness hook for the /healthz endpoint (obs/httpd.py):
         return ``"degraded"`` while this element is running in a
         reduced mode (open circuit breakers, lost endpoints, fallback
-        serving), else None.  Called at scrape time only."""
+        serving) or ``"draining"`` while it is refusing new work ahead
+        of a shutdown, else None.  Called at scrape time only."""
         return None
+
+    def drain(self, deadline: float = 5.0) -> None:
+        """Graceful-drain hook (``Pipeline.drain``): stop accepting new
+        work, finish what is in flight, within ``deadline`` seconds.
+        Elements that front external clients (tensor_query_serversrc)
+        override this; the default is a no-op — ordinary elements
+        finish naturally when upstream stops feeding them."""
 
     # -- helpers -------------------------------------------------------------
     def announce_src_caps(self, caps: Caps, pad: Optional[Pad] = None) -> None:

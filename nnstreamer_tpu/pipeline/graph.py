@@ -220,9 +220,17 @@ class Pipeline:
         if self._lifecycle == "serving":
             if self._error is not None:
                 return "degraded"
+            worst = "serving"
             for el in self.elements:
-                if el.health_state() == "degraded":
-                    return "degraded"
+                state = el.health_state()
+                if state == "draining":
+                    # a serving element already refusing new work
+                    # (QueryServer.drain in progress) makes the whole
+                    # pipeline draining: load balancers must route away
+                    return "draining"
+                if state == "degraded":
+                    worst = "degraded"
+            return worst
         return self._lifecycle
 
     def _check_links(self) -> None:
@@ -283,6 +291,28 @@ class Pipeline:
             raise err from self._error
         if not ok:
             raise TimeoutError(f"pipeline {self.name}: EOS not reached")
+
+    def drain(self, deadline: float = 5.0) -> None:
+        """Graceful drain, then stop: flip /healthz to ``draining``
+        (503 — load balancers route away), let elements that front
+        external clients refuse new work with explicit retry-after
+        answers and finish their in-flight replies (``Element.drain``,
+        e.g. ``tensor_query_serversrc`` → ``QueryServer.drain``), then
+        tear the pipeline down.  The ``launch.py`` SIGTERM handler
+        calls this — kill -TERM a serving pipeline and clients see
+        sheds, not mid-reply resets."""
+        self._lifecycle = "draining"
+        for el in self.elements:
+            if el._started:
+                try:
+                    el.drain(deadline)
+                except Exception as exc:   # noqa: BLE001 — drain is
+                    # best-effort: one element's failure must not block
+                    # the teardown of the rest
+                    from ..utils.log import logger
+
+                    logger.warning("%s: drain failed: %r", el.name, exc)
+        self.stop()
 
     def stop(self) -> None:
         self._playing = False
@@ -429,7 +459,9 @@ class Queue(Element):
         # always be enqueued — a caps announcement arriving from the
         # drain thread of a downstream queue must never block on data
         # capacity (that is a self-deadlock: the would-be consumer is
-        # the blocked thread)
+        # the blocked thread).  DATA admission blocks on the _space
+        # condition below, so depth is bounded by construction.
+        # nnslint: allow(unbounded-queue)
         self._q: _queue.Queue = _queue.Queue()
         self._cap = max(1, int(self.max_size_buffers))
         self._used = 0
@@ -627,6 +659,10 @@ class AppSrc(Source):
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
+        # app-side producer owns the pacing: the prefill-before-play
+        # contract (benches queue thousands of frames before the first
+        # consumer exists) rules out a blocking bound here
+        # nnslint: allow(unbounded-queue)
         self._fifo: _queue.Queue = _queue.Queue()
 
     def _make_pads(self):

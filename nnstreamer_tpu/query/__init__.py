@@ -2,6 +2,8 @@
 
 from .client import (FailoverConnection, QueryConnection, TensorQueryClient,
                      parse_endpoints)
+from .overload import (AdmissionController, ShedError, ShedPolicy,
+                       TokenBucket, WatermarkShedPolicy, qos_of_class)
 from .protocol import (Message, decode_tensors, encode_tensors, recv_msg,
                        send_msg)
 from .resilience import (STATS, CircuitBreaker, CircuitOpenError,
@@ -17,4 +19,6 @@ __all__ = [
     "send_msg", "recv_msg",
     "STATS", "RetryPolicy", "RetryExhausted", "CircuitBreaker",
     "CircuitOpenError", "HealthMonitor",
+    "ShedError", "ShedPolicy", "WatermarkShedPolicy",
+    "AdmissionController", "TokenBucket", "qos_of_class",
 ]

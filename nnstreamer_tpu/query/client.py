@@ -14,6 +14,16 @@ to the next entry of the ``dest-hosts`` list.  The ``fallback`` property
 picks what a frame does when every endpoint is down: ``error`` (pipeline
 error, the reference default), ``passthrough`` (push the input frame
 unchanged — graceful degradation), or ``drop``.
+
+Overload (query/overload.py): the connection declares a QoS class
+(``qos`` property, or inherited from the first frame's ``nns_class``
+tag) in the T_HELLO handshake; a ``T_SHED`` answer from the server's
+admission control surfaces as :class:`ShedError` and maps into the
+same fallback machinery — but breakers record SUCCESS on a shed (the
+server is alive and protecting itself); with alternates the client
+rotates to the next endpoint immediately (routing away is what an
+overloaded or draining server asked for), alone it floors its retry
+backoff at the server's retry-after hint capped by the request budget.
 """
 
 from __future__ import annotations
@@ -32,8 +42,9 @@ from ..pipeline.element import Element, FlowReturn
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer, default_pool
 from ..tensor.caps_util import tensors_template_caps
+from .overload import ShedError, qos_of_class
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
-                       T_REPLY, T_TRACE, decode_tensors, recv_msg,
+                       T_REPLY, T_SHED, T_TRACE, decode_tensors, recv_msg,
                        send_msg, send_tensors, shutdown_close)
 from .protocol import create_connection as checked_connect
 from .resilience import (STATS, CircuitBreaker, CircuitOpenError,
@@ -62,12 +73,22 @@ class QueryConnection:
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
                  max_retries: int = 3,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 qos: Optional[str] = None):
         self.host, self.port = host, port
         self.timeout = timeout
         self.max_retries = max_retries
+        #: QoS class declared to the server in the T_HELLO handshake
+        #: (query/overload.py admission control: bronze sheds first,
+        #: gold last).  None = unnegotiated; the first query whose
+        #: ``buf.extra["nns_class"]`` implies a class negotiates it
+        #: late (the loadgen's class tagging becomes the QoS default)
+        self.qos = qos
         self.retry = retry or RetryPolicy(max_attempts=max(1, max_retries),
                                           base_delay=0.05, max_delay=0.5)
+        # bounded by the request protocol: at most one outstanding
+        # reply (plus a disconnect sentinel) per in-flight query
+        # nnslint: allow(unbounded-queue)
         self.replies: _queue.Queue = _queue.Queue()
         self.server_caps: Optional[str] = None
         self._pool = default_pool()   # reply payloads land in recycled slabs
@@ -91,6 +112,9 @@ class QueryConnection:
         #: loadgen hook (slo/loadgen.py): called as ``(request_class,
         #: latency_s, ok)`` after every query() — service latency from
         #: send to reply, per-class via ``buf.extra["nns_class"]``.
+        #: Fires on raising paths too (timeouts, dead endpoints) but
+        #: NOT on sheds: a T_SHED's near-instant round trip would
+        #: flatter the admitted-traffic service distribution.
         #: None (the default) costs one attribute test per query.
         self.on_outcome: Optional[Callable[[str, float, bool], None]] = None
 
@@ -106,8 +130,10 @@ class QueryConnection:
             self._reader = reader
             reader.start()
             try:
-                # caps handshake
-                self._send(Message(T_HELLO))
+                # caps handshake; declares this connection's QoS class
+                # when one is set (server-side admission control)
+                self._send(Message(T_HELLO, payload=(
+                    f"qos={self.qos}".encode() if self.qos else b"")))
             except OSError:
                 # tear this half-made connection down before the retry:
                 # otherwise every failed attempt leaks a socket and a
@@ -152,7 +178,9 @@ class QueryConnection:
                 return
             if msg.type == T_HELLO:
                 self.server_caps = msg.payload.decode()
-            elif msg.type == T_REPLY:
+            elif msg.type in (T_REPLY, T_SHED):
+                # a shed is a first-class answer: it rides the reply
+                # queue so _await_reply matches it to ITS request by seq
                 self.replies.put(msg)
             elif msg.type == T_TRACE:
                 # server timeline piggyback: park the raw JSON batch;
@@ -243,13 +271,36 @@ class QueryConnection:
         t0 = time.monotonic()
         try:
             out = self._query(buf)
+        except ShedError:
+            # a shed is not a service outcome: its ~instant round trip
+            # in the service histogram would flatter the admitted
+            # traffic's latency — the caller's shed accounting owns it
+            raise
         except BaseException:
             hook(cls, time.monotonic() - t0, False)
             raise
         hook(cls, time.monotonic() - t0, True)
         return out
 
+    def _negotiate_qos_late(self, buf: TensorBuffer) -> None:
+        """Default the connection's QoS class from the request's class
+        tag: the first ``buf.extra["nns_class"]`` that implies a QoS
+        class re-announces the handshake with it (servers accept a
+        fresh T_HELLO at any time), so loadgen-tagged traffic gets
+        tiered shedding without explicit configuration."""
+        implied = qos_of_class(buf.extra.get("nns_class"))
+        if implied is None:
+            return
+        self.qos = implied
+        try:
+            self._send(Message(T_HELLO,
+                               payload=f"qos={implied}".encode()))
+        except (OSError, AttributeError):
+            pass   # connection is down: connect() re-announces
+
     def _query(self, buf: TensorBuffer) -> Optional[TensorBuffer]:
+        if self.qos is None:
+            self._negotiate_qos_late(buf)
         with self._waiters_lock:   # shared with ping allocations
             self._seq += 1
             seq = self._seq
@@ -279,6 +330,19 @@ class QueryConnection:
                 STATS.incr("query.reconnects")
                 self._reconnect(deadline)
                 continue
+            if reply.type == T_SHED:
+                # explicit load shed: the server refused this request
+                # by admission control and told us when to come back.
+                # NOT a failure — the caller's resilience layer must
+                # keep breakers closed and honor the retry-after.
+                try:
+                    retry_after = int(bytes(reply.payload) or b"100") / 1e3
+                except ValueError:
+                    retry_after = 0.1
+                qos = self.qos or "default"
+                STATS.incr("query.sheds")
+                STATS.incr(f"query.sheds.{qos}")
+                raise ShedError(retry_after, qos=qos)
             if reply.epoch_us:
                 # reply stamps carry the server wall clock: one offset
                 # sample per round trip, min-RTT filtered (obs/clock.py)
@@ -383,12 +447,14 @@ class FailoverConnection:
                  breaker_cooldown: float = 30.0,
                  heartbeat_interval: float = 0.0,
                  heartbeat_max_missed: int = 3,
-                 name: str = "query"):
+                 name: str = "query",
+                 qos: Optional[str] = None):
         if not endpoints:
             raise ValueError("FailoverConnection needs >= 1 endpoint")
         self.endpoints = list(endpoints)
         self.timeout = timeout
         self.max_retries = max_retries
+        self.qos = qos
         self.retry = retry or RetryPolicy(max_attempts=max(1, max_retries),
                                           base_delay=0.05, max_delay=0.5)
         self.breakers = [CircuitBreaker(failure_threshold=breaker_failures,
@@ -519,7 +585,8 @@ class FailoverConnection:
             # inside chain() before the fallback can fire
             conn = QueryConnection(
                 host, port, self.timeout, self.max_retries,
-                retry=self.retry.with_deadline(self.timeout))
+                retry=self.retry.with_deadline(self.timeout),
+                qos=self.qos)
             try:
                 conn.connect()
             except ConnectionError as exc:
@@ -569,7 +636,12 @@ class FailoverConnection:
         mid-stream server kill+restart is survived within the retry
         budget)."""
         last: Optional[BaseException] = None
+        #: per-REQUEST budget for honoring retry-after hints: capping
+        #: each gap alone would still let max_attempts gaps sum to
+        #: multiples of the element timeout
+        shed_budget = self.timeout
         for attempt in range(self.retry.max_attempts):
+            shed_wait: Optional[float] = None
             with self._lock:
                 try:
                     conn = self._ensure_active()
@@ -585,6 +657,28 @@ class FailoverConnection:
                     out = conn.query(buf)
                     breaker.record_success()
                     return out
+                except ShedError as exc:
+                    # shed ≠ failure: the server is alive and
+                    # protecting itself.  The breaker records SUCCESS
+                    # (a shed proves liveness — tripping it would turn
+                    # transient overload into a 30 s outage).  With
+                    # alternates available, routing away IS honoring
+                    # the hint — rotate immediately so a draining or
+                    # overloaded primary hands traffic to a healthy
+                    # secondary instead of stalling the stream; alone,
+                    # honor the retry-after capped by the request
+                    # budget (a drain-sized hint must not block
+                    # chain() for multiples of the element timeout).
+                    last = exc
+                    breaker.record_success()
+                    if len(self.endpoints) > 1:
+                        with self._lock:
+                            self._demote("shed")
+                    elif shed_budget <= 0:
+                        raise          # budget spent honoring hints
+                    else:
+                        shed_wait = min(exc.retry_after_s, shed_budget)
+                        shed_budget -= shed_wait
                 except self._FAILURE as exc:
                     last = exc
                     breaker.record_failure()
@@ -593,7 +687,12 @@ class FailoverConnection:
                         self._demote("error")
             if attempt + 1 < self.retry.max_attempts:
                 STATS.incr("query.retries")
-                time.sleep(self.retry.delay(attempt))
+                delay = self.retry.delay(attempt)
+                if shed_wait is not None:
+                    delay = max(delay, shed_wait)
+                # retry-after-honoring backoff (delay from the policy,
+                # floored by the server's T_SHED hint)
+                time.sleep(delay)   # nnslint: allow(sleep-poll)
         if isinstance(last, (TimeoutError, ConnectionError, OSError)):
             raise last
         if last is not None:
@@ -664,6 +763,12 @@ class TensorQueryClient(Element):
                                     "over to the next dest-hosts entry"),
         "heartbeat-max-missed": (3, "missed pongs before an endpoint "
                                     "is declared dead"),
+        "qos": (None, "QoS class declared to the server in the "
+                      "handshake: gold | silver | bronze (admission "
+                      "control sheds bronze first, gold last — "
+                      "query/overload.py).  Unset: inherited from the "
+                      "first frame's nns_class tag, else the server's "
+                      "silver default"),
     }
 
     def _make_pads(self):
@@ -717,6 +822,12 @@ class TensorQueryClient(Element):
         if self._fallback not in ("error", "passthrough", "drop"):
             raise ValueError(f"{self.name}: fallback={self.fallback!r} "
                              "(want error | passthrough | drop)")
+        qos = None
+        if self.qos not in (None, ""):
+            qos = qos_of_class(self.qos)
+            if qos is None:
+                raise ValueError(f"{self.name}: qos={self.qos!r} "
+                                 "(want gold | silver | bronze)")
         self.conn = FailoverConnection(
             self._endpoints(), float(self.timeout),
             int(self.max_retries),
@@ -729,7 +840,8 @@ class TensorQueryClient(Element):
             breaker_cooldown=float(self.breaker_cooldown),
             heartbeat_interval=float(self.heartbeat_interval),
             heartbeat_max_missed=int(self.heartbeat_max_missed),
-            name=self.name)
+            name=self.name,
+            qos=qos)
         try:
             self.conn.connect()
         except ConnectionError:

@@ -495,6 +495,11 @@ class EdgeSrc(Source):
             self._discover_hybrid()
         self._sock = None
         self._subscribe()
+        # paced by the broker's TCP stream and drained every create();
+        # bounding needs a stop-cancellable put in the reader thread —
+        # the serving-plane admission story (query/overload.py) covers
+        # the query path, pub/sub keeps QoS-0 semantics for now
+        # nnslint: allow(unbounded-queue)
         self._fifo: _queue.Queue = _queue.Queue()
         self._retained_caps: Optional[str] = None
         self._caps_evt = threading.Event()

@@ -103,6 +103,9 @@ class _BytesService:
 
     def __init__(self, idl: str) -> None:
         self.idl = idl
+        # paced by the gRPC stream's flow control; drained every
+        # create() on the element streaming thread
+        # nnslint: allow(unbounded-queue)
         self.recv_q: _queue.Queue = _queue.Queue()
         self._subs: List[_queue.Queue] = []
         self._lock = threading.Lock()
@@ -114,6 +117,9 @@ class _BytesService:
         return b""  # google.protobuf.Empty
 
     def _recv_tensors(self, request, context):
+        # per-subscriber relay fifo, drained by the subscriber's own
+        # RPC response stream (gRPC flow control backpressures it)
+        # nnslint: allow(unbounded-queue)
         q: _queue.Queue = _queue.Queue()
         with self._lock:
             self._subs.append(q)
@@ -263,6 +269,8 @@ class GrpcTensorSrc(Source):
                            if self.retry not in (None, "") else None)
             self._client = GrpcTensorClient(str(self.host), int(self.port),
                                             self._codec.idl)
+            # paced by the gRPC stream; drained every create()
+            # nnslint: allow(unbounded-queue)
             self._fifo = _queue.Queue()
             threading.Thread(target=self._pull_loop, daemon=True,
                              name=f"grpc-src:{self.name}").start()
@@ -386,6 +394,10 @@ class GrpcTensorSink(Element):
                            if self.retry not in (None, "") else None)
             self._client = GrpcTensorClient(str(self.host), int(self.port),
                                             self._codec.idl)
+            # fed by chain() on the streaming thread, drained by the
+            # send loop: depth is bounded by the pipeline's own
+            # upstream queue capacities
+            # nnslint: allow(unbounded-queue)
             self._sendq: _queue.Queue = _queue.Queue()
             self._send_thread = threading.Thread(
                 target=self._send_loop, daemon=True,
@@ -428,6 +440,8 @@ class GrpcTensorSink(Element):
                     # retire the old queue: chain()/stop() move to the
                     # fresh one, and an _EOS posted to the old unblocks
                     # the zombie consumer so it can't swallow new items
+                    # fresh queue per redial (same bound as above)
+                    # nnslint: allow(unbounded-queue)
                     self._sendq = _queue.Queue()
                     sendq.put(_EOS)
                     _time.sleep(self._retry.delay(attempt))

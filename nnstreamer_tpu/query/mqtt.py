@@ -537,6 +537,10 @@ class MqttSrc(Source):
                                   f"nns-src-{self.name}",
                                   keepalive=int(self.keepalive))
         self._client.subscribe(str(self.sub_topic))
+        # paced by the broker's TCP stream and drained every create()
+        # (QoS-0 pub/sub transport; query-path overload is handled by
+        # admission control in query/overload.py)
+        # nnslint: allow(unbounded-queue)
         self._fifo: _queue.Queue = _queue.Queue()
         self._count = 0
         self._first = None

@@ -27,11 +27,17 @@ estimation (obs/clock.py).
 tensorwire kernels (0 = unchecked — the pure-Python CRC would serialize
 the hot path); receivers verify only nonzero values, so mixed
 native/fallback hosts interoperate.
-Types: 1=HELLO (payload = caps string utf8), 2=DATA, 3=REPLY, 4=BYE,
-5=ERROR (payload = message), 6=PING, 7=PONG, 8=TRACE (payload = JSON
-span batch — the server's timeline piggyback, sent right after a REPLY
-when the serving pipeline records spans; clients without a tracer just
-discard it).
+Types: 1=HELLO (payload = caps string utf8 server→client; client→server
+the payload may carry a ``qos=<gold|silver|bronze>`` QoS-class
+declaration for admission control — query/overload.py), 2=DATA,
+3=REPLY, 4=BYE, 5=ERROR (payload = message), 6=PING, 7=PONG, 8=TRACE
+(payload = JSON span batch — the server's timeline piggyback, sent
+right after a REPLY when the serving pipeline records spans; clients
+without a tracer just discard it), 9=SHED (explicit load-shed answer
+to a DATA frame refused by admission control: seq echoes the refused
+request, payload is the ASCII retry-after hint in milliseconds — an
+overloaded or draining server answers every rejected request, no
+silent drops).
 ``PING``/``PONG`` are the liveness heartbeat (query/resilience.py): any
 peer may send PING at any time; the receiver echoes seq and payload back
 as PONG immediately, out of band with DATA/REPLY.  The sender matches
@@ -54,12 +60,14 @@ from ..tensor.buffer import TensorBuffer, TensorBufferPool
 from ..tensor.info import TensorInfo
 from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 
-# Wire revision 4 ('NNST'): + trace_id/span_id/origin_us trace context
-# ('NNSS' lacked it, 'NNSR' lacked payload_crc, 'NNSQ' also lacked
+# Wire revision 5 ('NNSU'): + T_SHED explicit load-shed replies and
+# the HELLO qos declaration ('NNST' lacked them, 'NNSS' lacked the
+# trace context, 'NNSR' lacked payload_crc, 'NNSQ' also lacked
 # epoch_us).  The magic doubles as the version stamp — a peer speaking
 # another revision fails immediately with "bad magic" instead of
-# desynchronizing the stream.
-MAGIC = 0x4E4E5354  # 'NNST'
+# desynchronizing the stream (a rev-4 peer would silently treat a
+# shed as an unknown message and time out instead of backing off).
+MAGIC = 0x4E4E5355  # 'NNSU'
 HEADER = struct.Struct("<IBQQqqQQqII")
 #: upper bound on a wire-declared payload (default 1 GiB, env-overridable):
 #: receives reject anything larger before allocating, so a corrupted
@@ -68,8 +76,8 @@ HEADER = struct.Struct("<IBQQqqQQqII")
 MAX_WIRE_PAYLOAD = int(os.environ.get("NNS_MAX_WIRE_PAYLOAD",
                                       str(1 << 30)))
 
-T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR, T_PING, T_PONG, T_TRACE = \
-    1, 2, 3, 4, 5, 6, 7, 8
+(T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR, T_PING, T_PONG, T_TRACE,
+ T_SHED) = 1, 2, 3, 4, 5, 6, 7, 8, 9
 
 
 def create_connection(address, timeout=None):
@@ -328,7 +336,13 @@ def recv_msg(sock: socket.socket,
     ``recv_into`` in a recycled :class:`BufferLease` slab (zero
     intermediate chunk list, zero ``b"".join``) and ``msg.payload`` is a
     memoryview with ``msg.lease`` holding the slab."""
-    hdr = _recv_exact(sock, HEADER.size)
+    # the header's first byte is the only point where a socket timeout
+    # is benign (idle connection on a bounded-send socket —
+    # query/server.py sets one so a non-draining client cannot wedge
+    # the pipeline thread in reply()); it propagates as TimeoutError
+    # for the caller to retry.  Any LATER timeout is a mid-message
+    # stall: the stream is desynced and the peer is treated as gone.
+    hdr = _recv_exact(sock, HEADER.size, idle_ok=True)
     if hdr is None:
         return None
     (magic, typ, cid, seq, pts, epoch, trace_id, span_id, origin_us,
@@ -372,12 +386,17 @@ def recv_msg(sock: socket.socket,
                    crc=crc)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _recv_exact(sock: socket.socket, n: int,
+                idle_ok: bool = False) -> Optional[bytes]:
     chunks = []
     got = 0
     while got < n:
         try:
             chunk = sock.recv(n - got)
+        except socket.timeout:
+            if idle_ok and not chunks:
+                raise          # idle timeout before any byte: retryable
+            return None        # mid-read stall: desynced zombie peer
         except (ConnectionResetError, OSError):
             return None
         if not chunk:
@@ -394,6 +413,8 @@ def _recv_exact_into(sock: socket.socket, mv: memoryview) -> bool:
     while got < n:
         try:
             k = sock.recv_into(mv[got:])
+        except socket.timeout:
+            return False       # mid-payload stall: desynced zombie peer
         except (ConnectionResetError, OSError):
             return False
         if not k:

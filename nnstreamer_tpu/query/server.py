@@ -18,7 +18,7 @@ import socket
 import threading
 from typing import Dict, Optional
 
-from ..analysis.sanitizer import make_lock
+from ..analysis.sanitizer import make_condition, make_lock
 from ..obs.clock import wall_us
 from ..obs.span import TraceContext
 from ..pipeline.caps import Caps
@@ -27,9 +27,22 @@ from ..pipeline.graph import Source
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer, default_pool
 from ..tensor.caps_util import tensors_template_caps
+from ..utils.conf import parse_bool
+from .overload import (DEFAULT_QOS, QOS_CLASSES, AdmissionController,
+                       TokenBucket, qos_of_class)
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
-                       T_REPLY, T_TRACE, decode_tensors, recv_msg,
+                       T_REPLY, T_SHED, T_TRACE, decode_tensors, recv_msg,
                        send_msg, send_tensors, shutdown_close)
+
+#: default bound on the server's incoming frame queue (frames, not
+#: bytes): deep enough that bursty-but-sustainable traffic never sheds,
+#: shallow enough that queued latency stays bounded (256 frames at the
+#: measured ~2 ms/query loopback service time is ~0.5 s of backlog)
+DEFAULT_QUEUE_DEPTH = 256
+#: default per-connection socket send timeout: a client that stops
+#: draining replies for this long is a zombie and gets evicted, instead
+#: of wedging the serving pipeline thread inside reply()
+DEFAULT_SEND_TIMEOUT = 5.0
 
 
 class QueryServer:
@@ -37,21 +50,43 @@ class QueryServer:
 
     The shared table (reference tensor_query_server.c:76-238) pairs the
     serversrc and serversink elements of one serving pipeline.
+
+    Overload safety (query/overload.py): ``incoming`` is BOUNDED
+    (``queue_depth`` frames) and every DATA frame passes admission
+    control before its tensors pin a pooled slab — a refused request is
+    answered with an explicit ``T_SHED`` carrying a retry-after hint,
+    chosen by QoS class (bronze sheds first, gold last; per-connection
+    class negotiated in the T_HELLO handshake).  ``drain(deadline)``
+    stops admitting, finishes in-flight replies, then closes — the
+    server half of the pipeline ``draining`` lifecycle state.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 queue_depth: int = DEFAULT_QUEUE_DEPTH,
+                 admission: Optional[AdmissionController] = None,
+                 shed: bool = True,
+                 send_timeout: float = DEFAULT_SEND_TIMEOUT):
         self.host = host
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self.port = self._sock.getsockname()[1]
-        self._sock.listen(16)
-        self.incoming: _queue.Queue = _queue.Queue()
+        self._sock.listen(64)
+        self.queue_depth = max(1, int(queue_depth))
+        self.incoming: _queue.Queue = _queue.Queue(maxsize=self.queue_depth)
+        #: admit-or-shed decider; ``shed=False`` disables shedding
+        #: entirely (overload degrades to per-connection backpressure
+        #: on the bounded queue — the pre-overload-layer behavior,
+        #: minus the unbounded memory growth)
+        self.admission = (admission if admission is not None
+                          else AdmissionController()) if shed else None
+        self.send_timeout = float(send_timeout)
         self._clients: Dict[int, socket.socket] = {}
         # per-client send locks: the reader thread's handshake/pong
         # replies must not interleave with a partially-written T_REPLY
         # from the pipeline thread (mirror of the client's _send_lock)
         self._send_locks: Dict[int, threading.Lock] = {}
+        self._qos: Dict[int, str] = {}   # client id -> negotiated class
         self._caps_str: Optional[str] = None
         self._next_id = 1
         #: serving pipeline's tracer (set by the serversink element);
@@ -61,9 +96,14 @@ class QueryServer:
         self._span_cursors: Dict[int, int] = {}   # client id -> ring pos
         self._lock = make_lock("query.registry")
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        #: admitted-minus-replied frames; drain() waits for zero
+        self._inflight = 0
+        self._drain_cv = make_condition("query.registry")
+        self.peak_depth = 0
         # scrape-time gauges for the soak harness: connected-client
-        # count is a lazy callable (zero per-frame cost); accepts are a
-        # per-connection counter, not per-buffer
+        # count / queue depth / shed rate are lazy callables (zero
+        # per-frame cost); admit/shed counters are one inc per decision
         from ..obs.metrics import REGISTRY
 
         self._m_clients = REGISTRY.gauge(
@@ -71,9 +111,40 @@ class QueryServer:
             port=str(self.port))
         self._m_accepted = REGISTRY.counter(
             "nns_query_server_accepted_total", port=str(self.port))
+        self._m_depth = REGISTRY.gauge(
+            "nns_query_server_queue_depth",
+            fn=self.incoming.qsize, port=str(self.port))
+        self._m_peak = REGISTRY.gauge(
+            "nns_query_server_queue_peak",
+            fn=lambda: self.peak_depth, port=str(self.port))
+        self._m_admitted = {
+            c: REGISTRY.counter("nns_query_server_admitted_total",
+                                port=str(self.port), qos=c)
+            for c in QOS_CLASSES}
+        self._m_shed = {
+            c: REGISTRY.counter("nns_query_server_shed_total",
+                                port=str(self.port), qos=c)
+            for c in QOS_CLASSES}
+        self._m_shed_rate = REGISTRY.gauge(
+            "nns_query_server_shed_rate", fn=self._shed_rate,
+            port=str(self.port))
+        self._m_evicted = REGISTRY.counter(
+            "nns_query_server_evicted_total", port=str(self.port))
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="query-accept")
         self._accept_thread.start()
+
+    def _shed_rate(self) -> float:
+        shed = sum(c.value for c in self._m_shed.values())
+        admitted = sum(c.value for c in self._m_admitted.values())
+        return shed / max(1, shed + admitted)
+
+    def counters(self) -> Dict[str, Dict[str, int]]:
+        """Point-in-time admit/shed counts by QoS class (test/verdict
+        surface; the live metrics ride the registry)."""
+        return {"admitted": {c: m.value
+                             for c, m in self._m_admitted.items()},
+                "shed": {c: m.value for c, m in self._m_shed.items()}}
 
     def set_caps_string(self, caps: str) -> None:
         self._caps_str = caps
@@ -84,6 +155,14 @@ class QueryServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            # bound EVERY per-connection send path: a client that stops
+            # draining its socket can only stall a send for
+            # send_timeout before it is evicted, instead of wedging the
+            # pipeline thread inside reply() forever.  The same timeout
+            # applies to the reader's recv — protocol.recv_msg treats
+            # an idle timeout as retryable, so quiet clients survive.
+            if self.send_timeout > 0:
+                conn.settimeout(self.send_timeout)
             with self._lock:
                 cid = self._next_id
                 self._next_id += 1
@@ -92,6 +171,26 @@ class QueryServer:
             self._m_accepted.inc()
             threading.Thread(target=self._client_loop, args=(cid, conn),
                              daemon=True, name=f"query-client-{cid}").start()
+
+    def _admit_frame(self, cid: int, msg: Message) -> Optional[float]:
+        """Admission decision for one DATA frame: ``None`` admits, a
+        float sheds with that retry-after hint (seconds).  Header-only:
+        runs BEFORE the payload is decoded into tensors, so a shed
+        request's slab goes straight back to the pool."""
+        if self.admission is None:
+            return None
+        qos = self._qos.get(cid, DEFAULT_QOS)
+        return self.admission.admit(qos, self.incoming.qsize(),
+                                    self.queue_depth)
+
+    def _send_shed(self, conn, slock, cid: int, seq: int,
+                   retry_after_s: float) -> None:
+        qos = self._qos.get(cid, DEFAULT_QOS)
+        self._m_shed[qos].inc()
+        with slock:
+            send_msg(conn, Message(
+                T_SHED, client_id=cid, seq=seq, epoch_us=wall_us(),
+                payload=str(int(retry_after_s * 1000)).encode()))
 
     def _client_loop(self, cid: int, conn: socket.socket) -> None:
         # snapshot: stop() clears the dict concurrently, and a KeyError
@@ -102,12 +201,23 @@ class QueryServer:
             while not self._stop.is_set():
                 try:
                     msg = recv_msg(conn, pool=pool)
+                except TimeoutError:   # idle socket on a bounded-send
+                    continue           # connection: keep listening
                 except ValueError:   # bad magic / CRC: drop the connection
                     break
                 if msg is None or msg.type == T_BYE:
                     break
                 if msg.type == T_HELLO:
-                    # capability handshake: reply with server caps string
+                    # capability handshake: record the client's QoS
+                    # declaration (``qos=<class>`` payload —
+                    # query/overload.py), reply with server caps string
+                    payload = bytes(msg.payload or b"")
+                    if payload.startswith(b"qos="):
+                        qos = qos_of_class(payload[4:].decode(
+                            "utf-8", "replace"))
+                        if qos is not None:
+                            with self._lock:
+                                self._qos[cid] = qos
                     with slock:
                         send_msg(conn, Message(T_HELLO, client_id=cid,
                                                payload=(self._caps_str
@@ -127,27 +237,80 @@ class QueryServer:
                                                payload=msg.payload))
                     continue
                 if msg.type == T_DATA:
+                    # admission BEFORE tensor decode: a shed frame's
+                    # pooled payload slab releases immediately instead
+                    # of pinning memory through the serving pipeline
+                    retry_after = self._admit_frame(cid, msg)
+                    if retry_after is not None:
+                        if msg.lease is not None:
+                            msg.payload = b""
+                            msg.lease.release()
+                        self._send_shed(conn, slock, cid, msg.seq,
+                                        retry_after)
+                        continue
                     buf = TensorBuffer(tensors=decode_tensors(msg.payload),
                                        pts=msg.pts, lease=msg.lease)
                     buf.extra["query_client_id"] = cid
                     buf.extra["query_seq"] = msg.seq
+                    buf.extra["nns_class"] = qos = self._qos.get(
+                        cid, DEFAULT_QOS)
                     if msg.trace_id:
                         # restore the client's trace context: spans this
                         # buffer produces in the serving pipeline record
                         # under the client's trace id (obs/span.py)
                         buf.extra["nns_trace"] = TraceContext(
                             msg.trace_id, msg.span_id, msg.origin_us)
-                    self.incoming.put(buf)
+                    self._enqueue(conn, slock, cid, qos, buf)
         except OSError:
             pass   # link reset under us (recv, or a handshake/pong send)
         finally:
             with self._lock:
                 self._clients.pop(cid, None)
                 self._send_locks.pop(cid, None)
+                self._qos.pop(cid, None)
                 # client ids are never reused: an unreaped cursor per
                 # connection ever made is a slow leak on a long server
                 self._span_cursors.pop(cid, None)
             conn.close()
+
+    def _dec_inflight(self) -> None:
+        with self._drain_cv:
+            if self._inflight > 0:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._drain_cv.notify_all()
+
+    def _enqueue(self, conn, slock, cid: int, qos: str,
+                 buf: TensorBuffer) -> None:
+        """Admit ``buf`` into the bounded queue.  With shedding enabled
+        a full queue sheds (the queue bound is the hard watermark the
+        policy's soft watermarks sit under); without it, the put blocks
+        — per-connection backpressure, woken by stop().
+
+        The in-flight count is raised BEFORE the put: the pipeline
+        thread can dequeue and reply the instant the frame lands, and
+        a decrement racing ahead of the increment would leave a
+        permanent +1 skew that makes drain() time out forever."""
+        with self._drain_cv:
+            self._inflight += 1
+        while not self._stop.is_set():
+            try:
+                self.incoming.put(buf, timeout=0.25)
+            except _queue.Full:
+                if self.admission is not None:
+                    self._dec_inflight()   # refused after all
+                    buf.lease = None   # buffer dies here: drop its slab
+                    self._send_shed(conn, slock, cid,
+                                    buf.extra.get("query_seq", 0),
+                                    retry_after_s=0.25)
+                    return
+                continue
+            self._m_admitted[qos].inc()
+            depth = self.incoming.qsize()
+            if depth > self.peak_depth:
+                self.peak_depth = depth
+            return
+        self._dec_inflight()           # server stopped before the put
 
     def _trace_piggyback(self, cid: int, ctx: TraceContext
                          ) -> Optional[Message]:
@@ -174,6 +337,16 @@ class QueryServer:
                        payload=_json.dumps(payload).encode())
 
     def reply(self, buf: TensorBuffer) -> bool:
+        try:
+            return self._reply(buf)
+        finally:
+            # in-flight accounting runs on EVERY outcome — including a
+            # reply for a client that disconnected mid-request — so
+            # drain() converges exactly when the last admitted frame
+            # has been answered (or become unanswerable)
+            self._dec_inflight()
+
+    def _reply(self, buf: TensorBuffer) -> bool:
         cid = buf.extra.get("query_client_id")
         with self._lock:
             conn = self._clients.get(cid)
@@ -198,14 +371,53 @@ class QueryServer:
                 if trace_msg is not None:
                     send_msg(conn, trace_msg)
             return True
+        except socket.timeout:
+            # the bounded send path fired: this client stopped draining
+            # its socket.  Evict it — a zombie peer must cost one send
+            # timeout, not one timeout per reply forever.
+            self._m_evicted.inc()
+            with self._lock:
+                self._clients.pop(cid, None)
+            shutdown_close(conn)
+            return False
         except OSError:
             return False
+
+    def drain(self, deadline: float = 5.0) -> bool:
+        """Graceful drain: stop admitting (every new DATA frame sheds
+        with a retry-after sized past the drain), let in-flight frames
+        finish their replies, then close.  Returns True when the last
+        in-flight reply completed within ``deadline`` seconds, False on
+        a deadline cut (remaining frames are dropped by close()).
+
+        Wired to the pipeline ``draining`` lifecycle state: the
+        /healthz endpoint answers 503 while this runs, so load
+        balancers route away while existing requests complete.
+        """
+        self._draining.set()
+        if self.admission is None:
+            # drain must stop admitting even on a shed=False server:
+            # install a controller whose only act is the drain-mode
+            # shed-everything answer
+            self.admission = AdmissionController()
+        self.admission.start_drain(deadline)
+        with self._drain_cv:
+            ok = self._drain_cv.wait_for(
+                lambda: self._inflight <= 0, timeout=max(0.0, deadline))
+        self.close()
+        return bool(ok)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
 
     def close(self) -> None:
         self._stop.set()
         from ..obs.metrics import REGISTRY
 
-        REGISTRY.unregister(self._m_clients)
+        for g in (self._m_clients, self._m_depth, self._m_peak,
+                  self._m_shed_rate):
+            REGISTRY.unregister(g)
         try:
             self._sock.close()
         except OSError:
@@ -214,6 +426,7 @@ class QueryServer:
             conns = list(self._clients.values())
             self._clients.clear()
             self._send_locks.clear()
+            self._qos.clear()
         for conn in conns:
             # shutdown-then-close: a plain close of a socket another
             # thread is blocked reading sends no FIN (protocol.py)
@@ -226,10 +439,28 @@ _SERVERS_LOCK = make_lock("leaf")
 
 
 def get_server(server_id: int, host: str = "127.0.0.1",
-               port: int = 0) -> QueryServer:
+               port: int = 0,
+               queue_depth: Optional[int] = None,
+               shed: Optional[bool] = None,
+               capacity_rps: float = 0.0,
+               send_timeout: Optional[float] = None) -> QueryServer:
+    """Server-table lookup; overload-protection kwargs apply only when
+    this call CREATES the server (the serversink's bare lookup must not
+    reconfigure the serversrc's server)."""
     with _SERVERS_LOCK:
         if server_id not in _SERVERS:
-            _SERVERS[server_id] = QueryServer(host, port)
+            admission = None
+            if capacity_rps and float(capacity_rps) > 0:
+                admission = AdmissionController(
+                    bucket=TokenBucket(float(capacity_rps)))
+            _SERVERS[server_id] = QueryServer(
+                host, port,
+                queue_depth=(DEFAULT_QUEUE_DEPTH if queue_depth is None
+                             else int(queue_depth)),
+                admission=admission,
+                shed=(True if shed is None else bool(shed)),
+                send_timeout=(DEFAULT_SEND_TIMEOUT if send_timeout is None
+                              else float(send_timeout)))
         return _SERVERS[server_id]
 
 
@@ -261,6 +492,21 @@ class TensorQueryServerSrc(Source):
                                  "bound to 0.0.0.0, which is not a "
                                  "reachable address for remote "
                                  "clients)"),
+        "queue-depth": (256, "bound on the incoming frame queue; the "
+                             "hard watermark the shed policy's soft "
+                             "watermarks sit under"),
+        "shed": (True, "admission control: refused frames get explicit "
+                       "T_SHED answers with retry-after, QoS-tiered "
+                       "(bronze first, gold last — query/overload.py); "
+                       "false = pure per-connection backpressure on "
+                       "the bounded queue"),
+        "capacity-rps": (0.0, "token-bucket admission rate in "
+                              "requests/s across all clients "
+                              "(0 = depth/latency watermarks only)"),
+        "send-timeout": (5.0, "per-connection socket send bound in "
+                              "seconds; a client that stops draining "
+                              "replies for this long is evicted "
+                              "(0 = unbounded sends)"),
     }
 
     def _make_pads(self):
@@ -268,7 +514,11 @@ class TensorQueryServerSrc(Source):
 
     def start(self):
         self.server = get_server(int(self.id), str(self.host),
-                                 int(self.port))
+                                 int(self.port),
+                                 queue_depth=int(self.queue_depth),
+                                 shed=parse_bool(self.shed),
+                                 capacity_rps=float(self.capacity_rps),
+                                 send_timeout=float(self.send_timeout))
         if self.caps:
             self.server.set_caps_string(str(self.caps))
         self._mqtt = None
@@ -307,6 +557,22 @@ class TensorQueryServerSrc(Source):
     def bound_port(self) -> int:
         return self.server.port
 
+    def health_state(self):
+        srv = getattr(self, "server", None)
+        if srv is not None and srv.draining:
+            return "draining"
+        return None
+
+    def drain(self, deadline: float = 5.0) -> None:
+        """Pipeline.drain hook: stop admitting (new frames shed with a
+        retry-after), finish in-flight replies, close the server, and
+        drop it from the server table so a later play() gets a fresh
+        one."""
+        srv = getattr(self, "server", None)
+        if srv is not None:
+            srv.drain(deadline)
+            shutdown_server(int(self.id))
+
     def negotiate(self) -> Caps:
         if not self.caps:
             raise ValueError(f"{self.name}: caps property required")
@@ -333,18 +599,28 @@ class TensorQueryServerSink(Element):
         self.add_sink_pad(tensors_template_caps(), "sink")
 
     def start(self):
-        self.server = get_server(int(self.id))
+        # LAZY lookup: creating the server here would race the paired
+        # serversrc's start — if the sink started first, its bare
+        # get_server(id) would create the server with DEFAULT overload
+        # settings and silently discard the src's queue-depth / shed /
+        # capacity-rps / send-timeout properties.  Buffers only reach
+        # chain() after the src produced them, so by first use the
+        # src-configured server exists.
+        self.server = None
 
     def set_caps(self, pad, caps):
         pass
 
     def chain(self, pad, buf):
+        server = self.server
+        if server is None:
+            server = self.server = get_server(int(self.id))
         # publish the serving pipeline's tracer (one attr store per
         # reply): when it records spans, QueryServer.reply piggybacks
         # them to the requesting client as T_TRACE
-        self.server.obs_tracer = (self.pipeline.tracer
-                                  if self.pipeline is not None else None)
-        self.server.reply(buf)
+        server.obs_tracer = (self.pipeline.tracer
+                             if self.pipeline is not None else None)
+        server.reply(buf)
         return FlowReturn.OK
 
     def on_event(self, pad, event):

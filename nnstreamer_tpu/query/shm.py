@@ -581,6 +581,9 @@ class ShmSrc(Source):
             import queue as _queue
             import threading
 
+            # bounded upstream by the ring's fixed slot count: the
+            # prefetch reader can only get ahead by n_slots frames
+            # nnslint: allow(unbounded-queue)
             self._fifo = _queue.Queue()
             self._reader = threading.Thread(
                 target=self._drain_loop, daemon=True,

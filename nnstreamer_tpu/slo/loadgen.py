@@ -44,10 +44,15 @@ from ..analysis.sanitizer import make_lock
 from ..obs.clock import mono_ns
 from ..obs.metrics import REGISTRY, MetricsRegistry, quantile_from_counts
 from ..query.client import QueryConnection
+from ..query.overload import ShedError
 from ..tensor.buffer import TensorBuffer
 from .spec import ERRORS_TOTAL, LATENCY_US, REQUESTS_TOTAL
 
 SERVICE_US = "nns_query_service_us"
+#: requests refused by server admission control (explicit T_SHED) — a
+#: distinct family from errors: a shed is the overload layer WORKING,
+#: and its latency must not poison the admitted-traffic distribution
+SHED_TOTAL = "nns_slo_shed_total"
 
 
 def poisson_schedule(rate_hz: float, duration_s: float,
@@ -91,7 +96,8 @@ class LoadGenerator:
                  classes: Sequence[Tuple[str, float]] = (("default", 1.0),),
                  timeout: float = 2.0,
                  payload: Optional[np.ndarray] = None,
-                 registry: MetricsRegistry = REGISTRY) -> None:
+                 registry: MetricsRegistry = REGISTRY,
+                 qos: bool = False) -> None:
         if schedule not in ("poisson", "constant"):
             raise ValueError(f"schedule {schedule!r} "
                              "(want poisson | constant)")
@@ -108,6 +114,12 @@ class LoadGenerator:
         self.payload = (payload if payload is not None
                         else np.arange(4, dtype=np.float32))
         self.registry = registry
+        #: QoS mode: each WORKER is assigned one class from the mix
+        #: (largest-remainder apportionment over the weights) and
+        #: declares it as its connection's QoS class — the per-client
+        #: tiering the server's admission control sheds against.  Off:
+        #: classes stay weighted-random per request (PR 6 behavior).
+        self.qos = bool(qos)
         self._stop = threading.Event()
         self._lock = make_lock("slo")
         self._threads: List[threading.Thread] = []
@@ -115,7 +127,9 @@ class LoadGenerator:
         self._live = 0
         self._peak_live = 0
         self._lag_us = [0] * self.clients
-        self._counts = {"scheduled": 0, "sent": 0, "ok": 0, "errors": 0}
+        self._counts = {"scheduled": 0, "sent": 0, "ok": 0, "errors": 0,
+                        "shed": 0}
+        self._shed_by_class = {c: 0 for c, _ in self.classes}
         # class-labeled metric families (shared contract with the
         # evaluator); gauges are lazy — scrape-time reads of loadgen
         # state, nothing per request beyond the counter/hist writes
@@ -127,6 +141,8 @@ class LoadGenerator:
                        for c, _ in self.classes}
         self._m_srv = {c: registry.histogram(SERVICE_US, **{"class": c})
                        for c, _ in self.classes}
+        self._m_shed = {c: registry.counter(SHED_TOTAL, **{"class": c})
+                        for c, _ in self.classes}
         registry.gauge("nns_slo_active_clients", fn=lambda: self._live)
         registry.gauge("nns_slo_sched_lag_ms",
                        fn=lambda: max(self._lag_us) / 1e3)
@@ -145,10 +161,28 @@ class LoadGenerator:
             hist.observe(latency_s * 1e6)
 
     # -- workers -------------------------------------------------------------
+    def _qos_assignment(self) -> List[str]:
+        """Per-worker class assignment for QoS mode: largest-remainder
+        apportionment of ``clients`` workers over the class weights
+        (deterministic — a 1:2:5 gold:silver:bronze mix over 64 workers
+        is exactly 8/16/40)."""
+        total_w = sum(w for _, w in self.classes) or 1.0
+        exact = [(c, self.clients * w / total_w) for c, w in self.classes]
+        counts = {c: int(x) for c, x in exact}
+        remainder = self.clients - sum(counts.values())
+        for c, _ in sorted(exact, key=lambda cw: cw[1] - int(cw[1]),
+                           reverse=True)[:remainder]:
+            counts[c] += 1
+        out: List[str] = []
+        for c, _ in self.classes:
+            out.extend([c] * counts[c])
+        return out
+
     def _worker(self, idx: int, offsets: List[float],
-                cls_picks: List[str]) -> None:
+                cls_picks: List[str], worker_qos: Optional[str]) -> None:
         conn = QueryConnection(self.host, self.port,
-                               timeout=self.timeout, max_retries=2)
+                               timeout=self.timeout, max_retries=2,
+                               qos=worker_qos)
         conn.on_outcome = self._service_hook
         try:
             conn.connect()
@@ -159,6 +193,7 @@ class LoadGenerator:
             self._live += 1
             self._peak_live = max(self._peak_live, self._live)
         sent = ok = errors = 0
+        shed_by_class: Dict[str, int] = {}
         try:
             for i, off in enumerate(offsets):
                 target = self._t0 + off
@@ -171,14 +206,26 @@ class LoadGenerator:
                 buf = TensorBuffer(tensors=[self.payload])
                 buf.extra["nns_class"] = cls
                 sent += 1
+                shed = False
                 try:
                     out = conn.query(buf)
                     good = out is not None
+                except ShedError:
+                    # explicit server-side refusal: counted in its own
+                    # family — neither an error (the overload layer
+                    # answered, by design) nor an admitted-latency
+                    # observation (a fast shed must not flatter p99)
+                    good = False
+                    shed = True
                 except (TimeoutError, ConnectionError, OSError):
                     good = False
                 end = mono_ns() / 1e9
                 self._lag_us[idx] = max(0, int((end - target) * 1e6))
                 self._m_req[cls].inc()
+                if shed:
+                    shed_by_class[cls] = shed_by_class.get(cls, 0) + 1
+                    self._m_shed[cls].inc()
+                    continue
                 # schedule-anchored latency: queueing-behind-schedule
                 # time included (open-loop correction).  Failed
                 # requests observe too — the elapsed time (>= the
@@ -203,6 +250,10 @@ class LoadGenerator:
                 self._counts["sent"] += sent
                 self._counts["ok"] += ok
                 self._counts["errors"] += errors
+                self._counts["shed"] += sum(shed_by_class.values())
+                for c, n in shed_by_class.items():
+                    self._shed_by_class[c] = \
+                        self._shed_by_class.get(c, 0) + n
 
     # -- run -----------------------------------------------------------------
     def stop(self) -> None:
@@ -223,18 +274,27 @@ class LoadGenerator:
                           for c, h in self._m_srv.items()}
         names = [c for c, _ in self.classes]
         weights = [w for _, w in self.classes]
+        qos_by_worker = (self._qos_assignment() if self.qos
+                         else [None] * self.clients)
         schedules = []
         for idx in range(self.clients):
             offsets = self._make_schedule(idx)
-            picks = rng.choices(names, weights=weights,
-                                k=len(offsets)) if offsets else []
+            if self.qos:
+                # QoS mode: the worker's whole stream carries its
+                # assigned class — per-CLIENT tiering, matching the
+                # per-connection QoS the server admits against
+                picks = [qos_by_worker[idx]] * len(offsets)
+            else:
+                picks = rng.choices(names, weights=weights,
+                                    k=len(offsets)) if offsets else []
             schedules.append((offsets, picks))
             self._counts["scheduled"] += len(offsets)
         t_start = mono_ns() / 1e9
         self._t0 = t_start + max(0.0, warmup_s)
         self._threads = [
             threading.Thread(target=self._worker,
-                             args=(idx, offsets, picks), daemon=True,
+                             args=(idx, offsets, picks,
+                                   qos_by_worker[idx]), daemon=True,
                              name=f"loadgen-{idx}")
             for idx, (offsets, picks) in enumerate(schedules)]
         for t in self._threads:
@@ -251,13 +311,14 @@ class LoadGenerator:
         with self._lock:
             counts = dict(self._counts)
             peak = self._peak_live
+            shed_by_class = dict(self._shed_by_class)
         lat = self._quantiles(self._m_lat,
                               getattr(self, "_lat_base", {}))
         srv = self._quantiles(self._m_srv,
                               getattr(self, "_srv_base", {}))
         sent = counts["sent"]
         return {"clients": self.clients, "peak_live_clients": peak,
-                "schedule": self.schedule,
+                "schedule": self.schedule, "qos": self.qos,
                 "rate_hz_per_client": self.rate_hz,
                 "offered_rate_hz": round(self.clients * self.rate_hz, 2),
                 "duration_s": round(elapsed_s, 2), **counts,
@@ -265,6 +326,12 @@ class LoadGenerator:
                 if elapsed_s > 0 else 0.0,
                 "error_fraction": round(counts["errors"] / sent, 6)
                 if sent else 0.0,
+                # shed accounting: fraction of OFFERED traffic the
+                # server refused with explicit T_SHED, and its class
+                # split — admitted latency above excludes these
+                "shed_fraction": round(counts["shed"] / sent, 6)
+                if sent else 0.0,
+                "shed_by_class": shed_by_class,
                 "latency_us": lat, "service_us": srv,
                 "max_sched_lag_ms": round(max(self._lag_us) / 1e3, 1)}
 

@@ -17,6 +17,10 @@ toggleable at runtime while connections are live:
   connection, then cut it (mid-frame stream truncation)
 - ``disconnect_once`` — cut the connection after the next forwarded
   chunk, then auto-clear (the classic one-shot mid-stream drop)
+- ``flood`` — :class:`QueryFlood`: N rogue connections blasting valid
+  DATA frames at the upstream as fast as the sockets accept them (the
+  misbehaving-client overload the admission layer in
+  query/overload.py exists for; counts the T_SHED answers it gets)
 - :meth:`kill_connections` — drop every live connection now (server
   kill / link reset), leaving the listener up for reconnects
 
@@ -186,6 +190,104 @@ class ChaosProxy:
             _shutdown_close(s)
 
 
+class QueryFlood:
+    """Overload generator: ``conns`` rogue clients each blasting valid
+    wire-protocol DATA frames at ``target`` with NO pacing and NO reply
+    wait beyond keeping the socket drained — the misbehaving client
+    population that saturates a serving plane.  Per-frame accounting of
+    what came back (``replies`` / ``sheds``) lets tests assert the
+    no-silent-drops contract: every flooded frame is either answered or
+    explicitly shed.
+
+    Flood clients declare QoS class ``qos`` (default bronze — floods
+    should be first in line for shedding) in their T_HELLO handshake.
+    """
+
+    def __init__(self, target: Tuple[str, int], conns: int = 4,
+                 qos: str = "bronze", payload_floats: int = 4) -> None:
+        self.target = (str(target[0]), int(target[1]))
+        self.conns = int(conns)
+        self.qos = qos
+        self.payload_floats = int(payload_floats)
+        self.sent = 0
+        self.replies = 0
+        self.sheds = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> "QueryFlood":
+        self._stop.clear()
+        self._threads = [
+            threading.Thread(target=self._blast, daemon=True,
+                             name=f"query-flood-{i}")
+            for i in range(self.conns)]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def _blast(self) -> None:
+        import numpy as np
+
+        from ..query import protocol
+        from ..tensor.buffer import TensorBuffer
+
+        buf = TensorBuffer(
+            tensors=[np.arange(self.payload_floats, dtype=np.float32)])
+        sent = replies = sheds = errors = 0
+        sock = None
+        try:
+            sock = protocol.create_connection(self.target, timeout=2.0)
+            sock.settimeout(2.0)
+            protocol.send_msg(sock, protocol.Message(
+                protocol.T_HELLO, payload=f"qos={self.qos}".encode()))
+            hello = protocol.recv_msg(sock)     # caps answer
+            if hello is None:
+                return
+            seq = 0
+            pending = 0
+            while not self._stop.is_set():
+                seq += 1
+                protocol.send_tensors(sock, protocol.T_DATA, buf,
+                                      seq=seq)
+                sent += 1
+                pending += 1
+                # drain answers opportunistically so the server's send
+                # side never blocks on us, but never wait for them —
+                # open-loop misbehavior is the point of a flood
+                while pending > 8:
+                    msg = protocol.recv_msg(sock)
+                    if msg is None:
+                        return
+                    pending -= 1
+                    if msg.type == protocol.T_SHED:
+                        sheds += 1
+                    elif msg.type == protocol.T_REPLY:
+                        replies += 1
+                        if msg.lease is not None:
+                            msg.payload = b""
+                            msg.lease.release()
+        except (OSError, ValueError):
+            errors += 1
+        finally:
+            _shutdown_close(sock)
+            with self._lock:
+                self.sent += sent
+                self.replies += replies
+                self.sheds += sheds
+                self.errors += errors
+
+    def stop(self) -> Dict[str, int]:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+        with self._lock:
+            return {"sent": self.sent, "replies": self.replies,
+                    "sheds": self.sheds, "errors": self.errors}
+
+
 class ChaosStage:
     """One scheduled fault on a soak timeline: at ``at_s`` seconds into
     the run apply ``fault``, and (for the toggling faults) clear it
@@ -199,10 +301,13 @@ class ChaosStage:
       ``duration`` seconds (default 1.0)
     - ``delay`` — set per-chunk delay to ``value`` seconds for
       ``duration`` seconds
+    - ``flood`` — run a :class:`QueryFlood` of ``value`` (default 4)
+      rogue bronze connections through the proxy for ``duration``
+      seconds (overload chaos: drives the admission/shed layer)
     """
 
     FAULTS = ("kill", "disconnect_once", "blackhole", "corrupt",
-              "refuse", "delay")
+              "refuse", "delay", "flood")
     _ONESHOT = frozenset({"kill", "disconnect_once"})
 
     def __init__(self, at_s: float, fault: str, duration: float = 1.0,
@@ -284,6 +389,9 @@ class ChaosSchedule:
         self.proxy.refuse = False
         self.proxy.delay = 0.0
         self.proxy.disconnect_once = False
+        flood, self._flood = getattr(self, "_flood", None), None
+        if flood is not None:
+            flood.stop()
 
     # -- scheduler -----------------------------------------------------------
     def _loop(self) -> None:
@@ -316,11 +424,19 @@ class ChaosSchedule:
                 self.proxy.disconnect_once = True
             elif st.fault == "delay":
                 self.proxy.delay = st.value
+            elif st.fault == "flood":
+                self._flood = QueryFlood(
+                    (self.proxy.host, self.proxy.port),
+                    conns=int(st.value) or 4).start()
             else:
                 setattr(self.proxy, st.fault, True)
         else:
             if st.fault == "delay":
                 self.proxy.delay = 0.0
+            elif st.fault == "flood":
+                flood, self._flood = getattr(self, "_flood", None), None
+                if flood is not None:
+                    entry["flood"] = flood.stop()
             else:
                 setattr(self.proxy, st.fault, False)
         self.log.append(entry)

@@ -358,6 +358,44 @@ class TestNnslint:
             "        time.sleep(0.01)\n")
         assert nnslint.lint_paths([str(bad)]) == []
 
+    def test_unbounded_queue_rule(self, tmp_path):
+        """queue.Queue()/deque() without a bound in query//pipeline/ is
+        a finding; bounded construction and out-of-scope files are not;
+        the pragma (with a reason) exempts."""
+        qdir = tmp_path / "nnstreamer_tpu" / "query"
+        qdir.mkdir(parents=True)
+        bad = qdir / "seeded_q.py"
+        bad.write_text(
+            "import queue as _queue\n"
+            "import collections\n"
+            "class Srv:\n"
+            "    def __init__(self, items):\n"
+            "        self.incoming = _queue.Queue()\n"
+            "        self.backlog = collections.deque()\n"
+            "        self.sneaky = _queue.Queue(maxsize=0)\n"
+            "        self.sneaky2 = _queue.Queue(0)\n"
+            "        self.seeded = collections.deque(items)\n"
+            "        self.ok1 = _queue.Queue(maxsize=64)\n"
+            "        self.ok2 = collections.deque(maxlen=64)\n"
+            "        self.ok3 = collections.deque(items, 64)\n"
+            "        # replies: <=1 in flight by protocol\n"
+            "        # nnslint: allow(unbounded-queue)\n"
+            "        self.exempt = _queue.Queue()\n")
+        got = [v for v in nnslint.lint_paths([str(bad)])
+               if v.rule == "unbounded-queue"]
+        assert len(got) == 5, got
+        # incl. the maxsize=0 / Queue(0) "bounds" (infinite in queue
+        # semantics) and deque(iterable) (no maxlen = unbounded)
+        assert {v.line for v in got} == {5, 6, 7, 8, 9}
+        # out of scope: the same construct elsewhere is clean
+        other = tmp_path / "nnstreamer_tpu" / "slo"
+        other.mkdir()
+        ok = other / "free.py"
+        ok.write_text("import queue as _queue\n"
+                      "q = _queue.Queue()\n")
+        assert [v for v in nnslint.lint_paths([str(ok)])
+                if v.rule == "unbounded-queue"] == []
+
     def test_backoff_sleeps_allowed(self, tmp_path):
         ok = tmp_path / "backoff.py"
         ok.write_text(

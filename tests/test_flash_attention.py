@@ -6,6 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from nnstreamer_tpu.parallel.compat import shard_map
 from nnstreamer_tpu.ops.flash_attention import flash_attention
 from nnstreamer_tpu.parallel.ring_attention import local_attention
 
@@ -102,7 +103,7 @@ def test_ulysses_flash_path_matches_naive(jax_cpu_devices):
     q, k, v = _qkv(t, h, d, seed=4)
 
     def run(flash):
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda qq, kk, vv: ulysses_attention(qq, kk, vv, "sp",
                                                  causal=True, flash=flash),
             mesh=mesh, in_specs=(P("sp"), P("sp"), P("sp")),

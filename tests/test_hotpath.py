@@ -506,3 +506,20 @@ def test_hotpath_bench_obs_gate():
     assert r.returncode == 0, (
         f"obs gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
     assert '"hotpath_obs_gate"' in r.stdout
+
+
+@pytest.mark.perf
+def test_hotpath_bench_admit_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage admit fails
+    when the un-overloaded admission decision (query/overload.py —
+    the only overload-layer cost an ADMITTED frame pays) exceeds 2%
+    of the wire frame round trip it gates.  Overload protection must
+    not tax the protected path."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "admit"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (
+        f"admit gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_admit_gate"' in r.stdout

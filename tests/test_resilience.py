@@ -23,7 +23,8 @@ from nnstreamer_tpu.elements import TensorSink
 from nnstreamer_tpu.pipeline import AppSrc, Pipeline
 from nnstreamer_tpu.pipeline.graph import PipelineError
 from nnstreamer_tpu.query import (FailoverConnection, QueryConnection,
-                                  TensorQueryClient, parse_endpoints)
+                                  TensorQueryClient, TensorQueryServerSink,
+                                  TensorQueryServerSrc, parse_endpoints)
 from nnstreamer_tpu.query.protocol import (Message, T_BYE, T_DATA, T_HELLO,
                                            T_PING, T_PONG, T_REPLY,
                                            decode_tensors, encode_tensors,
@@ -994,3 +995,362 @@ class TestTracingSurface:
             assert '"query.connect.failures"' in err
         finally:
             srv.close()
+
+
+# ==========================================================================
+# overload protection: admission control, QoS-tiered shedding, drain
+# (query/overload.py + the bounded QueryServer serving plane)
+# ==========================================================================
+
+class _AlwaysShed:
+    """ShedPolicy that refuses everything (deterministic server-side
+    overload for client-behavior tests)."""
+
+    def __init__(self, retry_after_s=0.05):
+        self.retry_after_s = retry_after_s
+
+    def decide(self, qos, depth, capacity):
+        return self.retry_after_s
+
+
+def _echo_consumer(srv, gate=None):
+    """Server-side responder: drain ``srv.incoming`` and reply with the
+    tensors doubled; ``gate`` (an Event) pauses consumption while
+    clear."""
+    import queue as _q
+
+    import numpy as np
+
+    def _run():
+        while not srv._stop.is_set():
+            if gate is not None and not gate.wait(timeout=0.1):
+                continue
+            try:
+                buf = srv.incoming.get(timeout=0.1)
+            except _q.Empty:
+                continue
+            out = TensorBuffer(
+                tensors=[np.asarray(buf.tensors[0]) * 2], pts=buf.pts)
+            out.extra.update(buf.extra)
+            srv.reply(out)
+
+    t = threading.Thread(target=_run, daemon=True, name="echo-consumer")
+    t.start()
+    return t
+
+
+class TestOverloadUnits:
+    def test_token_bucket_refill_deterministic(self):
+        from nnstreamer_tpu.query.overload import TokenBucket
+
+        now = [0.0]
+        b = TokenBucket(rate=10.0, burst=2.0, clock=lambda: now[0])
+        assert b.take() == (True, 0.0)
+        assert b.take() == (True, 0.0)
+        ok, wait = b.take()
+        assert not ok and wait == pytest.approx(0.1)
+        now[0] += 0.1                      # one token refilled
+        assert b.take() == (True, 0.0)
+        now[0] += 10.0                     # refill clamps at burst
+        assert b.take() == (True, 0.0)
+        assert b.take() == (True, 0.0)
+        assert b.take()[0] is False
+
+    def test_watermark_hysteresis_and_tiering(self):
+        from nnstreamer_tpu.query.overload import WatermarkShedPolicy
+
+        pol = WatermarkShedPolicy(retry_after_s=0.1)
+        cap = 100
+        # bronze arms at 45, gold not until 90
+        assert pol.decide("bronze", 10, cap) is None
+        assert pol.decide("bronze", 45, cap) is not None
+        assert pol.decide("gold", 45, cap) is None
+        # hysteresis: bronze stays armed below the arm point...
+        assert pol.decide("bronze", 30, cap) is not None
+        # ...and disarms only under arm * disarm_ratio (22.5)
+        assert pol.decide("bronze", 20, cap) is None
+        assert pol.decide("bronze", 30, cap) is None   # re-arm needs 45
+        # retry-after is priority-ordered: bronze waits longest
+        gold_ra = pol.decide("gold", 95, cap)
+        bronze_ra = pol.decide("bronze", 95, cap)
+        assert bronze_ra > gold_ra > 0
+
+    def test_p99_signal_sheds_bronze_first(self):
+        from nnstreamer_tpu.query.overload import WatermarkShedPolicy
+
+        p99 = [0.0]
+        pol = WatermarkShedPolicy(p99_us_fn=lambda: p99[0],
+                                  p99_threshold_us=10_000.0)
+        assert pol.decide("bronze", 0, 100) is None
+        p99[0] = 50_000.0                   # latency overload, queue empty
+        assert pol.decide("bronze", 0, 100) is not None
+        assert pol.decide("gold", 0, 100) is None      # bronze-tier only
+        p99[0] = 9_000.0                    # over 80% of threshold: latched
+        assert pol.decide("bronze", 0, 100) is not None
+        p99[0] = 7_000.0                    # under 80%: released
+        assert pol.decide("bronze", 0, 100) is None
+
+    def test_qos_of_class_aliases(self):
+        from nnstreamer_tpu.query.overload import qos_of_class
+
+        assert qos_of_class("gold") == "gold"
+        assert qos_of_class("interactive") == "gold"
+        assert qos_of_class("batch") == "bronze"
+        assert qos_of_class("default") == "silver"
+        assert qos_of_class("frobnicate") is None
+        assert qos_of_class(None) is None
+
+
+class TestSheddingClient:
+    def _shedding_server(self, retry_after=0.05):
+        from nnstreamer_tpu.query.overload import AdmissionController
+        from nnstreamer_tpu.query.server import QueryServer
+
+        srv = QueryServer(
+            queue_depth=8,
+            admission=AdmissionController(
+                policy=_AlwaysShed(retry_after)))
+        srv.set_caps_string(tcaps())
+        return srv
+
+    def test_shed_raises_shed_error_with_retry_after(self):
+        from nnstreamer_tpu.query import ShedError
+
+        srv = self._shedding_server(retry_after=0.123)
+        conn = QueryConnection("127.0.0.1", srv.port, timeout=2.0,
+                               qos="bronze")
+        conn.connect()
+        try:
+            with pytest.raises(ShedError) as exc:
+                conn.query(TensorBuffer(
+                    tensors=[np.ones(4, np.float32)]))
+            assert exc.value.retry_after_s == pytest.approx(0.123)
+            assert exc.value.qos == "bronze"
+            counters = srv.counters()
+            assert counters["shed"]["bronze"] == 1
+            assert sum(counters["admitted"].values()) == 0
+        finally:
+            conn.close()
+            srv.close()
+
+    def test_shed_keeps_breaker_closed_and_honors_retry_after(self):
+        """A pure-shed server must never trip the circuit breaker (shed
+        proves liveness) and the retry spacing must honor the server's
+        retry-after hint, not just the policy backoff."""
+        from nnstreamer_tpu.query import ShedError
+
+        srv = self._shedding_server(retry_after=0.15)
+        fc = FailoverConnection(
+            [("127.0.0.1", srv.port)], timeout=2.0,
+            retry=RetryPolicy(max_attempts=3, base_delay=0.001,
+                              max_delay=0.002, jitter=0.0))
+        fc.connect()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(ShedError):
+                fc.query(TensorBuffer(
+                    tensors=[np.ones(4, np.float32)]))
+            elapsed = time.monotonic() - t0
+            # 3 attempts, 2 retry gaps floored by retry-after 0.15
+            assert elapsed >= 0.3
+            assert fc.breakers[0].state == CircuitBreaker.CLOSED
+            assert sum(srv.counters()["shed"].values()) == 3
+        finally:
+            fc.close()
+            srv.close()
+
+    def test_shed_maps_to_passthrough_fallback(self):
+        """ShedError rides the PR 1 fallback machinery: with
+        fallback=passthrough an all-shedding server degrades the stream
+        to passthrough instead of erroring it — and no breaker opens."""
+        srv = self._shedding_server()
+        sink = TensorSink("sink")
+        p = Pipeline("shed-fallback")
+        src = AppSrc("in", caps=tcaps())
+        client = TensorQueryClient(
+            "q", **{"dest-host": "127.0.0.1", "dest-port": srv.port,
+                    "fallback": "passthrough", "timeout": 2.0,
+                    "retry": "attempts=2,base=0.001,cap=0.002,jitter=0"})
+        p.add(src, client, sink)
+        p.link(src, client, sink)
+        try:
+            p.play()
+            for i in range(3):
+                buf = TensorBuffer(tensors=[np.full(4, i, np.float32)])
+                src.push_buffer(buf)
+            src.end_of_stream()
+            p.wait(timeout=30)
+            # passthrough: frames arrive UNSCALED (a served frame
+            # would be doubled by an echo pipeline; here the payload
+            # is identical because the query was shed)
+            assert len(sink.results) == 3
+            np.testing.assert_array_equal(
+                sink.results[1].np(0), np.full(4, 1, np.float32))
+            assert client.conn.breakers[0].state == CircuitBreaker.CLOSED
+        finally:
+            p.stop()
+            srv.close()
+
+    def test_shed_rotates_to_healthy_alternate(self):
+        """With dest-hosts alternates, a shed routes the very next
+        attempt to the secondary (routing away IS honoring the hint) —
+        the frame is served, the primary's breaker stays closed, and
+        no time is spent sleeping out the retry-after."""
+        from nnstreamer_tpu.query.server import QueryServer
+
+        shedding = self._shedding_server(retry_after=30.0)   # drain-sized
+        healthy = QueryServer(queue_depth=8)
+        healthy.set_caps_string(tcaps())
+        _echo_consumer(healthy)
+        fc = FailoverConnection(
+            [("127.0.0.1", shedding.port), ("127.0.0.1", healthy.port)],
+            timeout=2.0,
+            retry=RetryPolicy(max_attempts=3, base_delay=0.001,
+                              max_delay=0.002, jitter=0.0))
+        fc.connect()
+        try:
+            t0 = time.monotonic()
+            out = fc.query(TensorBuffer(
+                tensors=[np.ones(4, np.float32)]))
+            elapsed = time.monotonic() - t0
+            np.testing.assert_array_equal(
+                out.np(0), np.full(4, 2.0, np.float32))
+            # served via rotation, not by sleeping out the 30 s hint
+            assert elapsed < 5.0
+            assert fc.active_endpoint == ("127.0.0.1", healthy.port)
+            assert fc.breakers[0].state == CircuitBreaker.CLOSED
+        finally:
+            fc.close()
+            shedding.close()
+            healthy.close()
+
+    def test_late_qos_negotiation_from_nns_class(self):
+        """A connection with no explicit qos inherits one from the
+        first request's nns_class tag (the loadgen vocabulary), visible
+        server-side in the per-class counters."""
+        from nnstreamer_tpu.query.server import QueryServer
+
+        srv = QueryServer(queue_depth=8)
+        srv.set_caps_string(tcaps())
+        _echo_consumer(srv)
+        conn = QueryConnection("127.0.0.1", srv.port, timeout=2.0)
+        conn.connect()
+        try:
+            buf = TensorBuffer(tensors=[np.ones(4, np.float32)])
+            buf.extra["nns_class"] = "batch"     # alias of bronze
+            out = conn.query(buf)
+            assert out is not None
+            assert conn.qos == "bronze"
+            assert wait_until(
+                lambda: srv.counters()["admitted"]["bronze"] >= 1)
+        finally:
+            conn.close()
+            srv.close()
+
+
+class TestGracefulDrain:
+    def test_drain_finishes_inflight_then_closes(self):
+        """The drain contract end to end: in-flight replies complete,
+        concurrent new requests shed with a retry-after, and the
+        server closes only after the last in-flight reply."""
+        from nnstreamer_tpu.query import ShedError
+        from nnstreamer_tpu.query.server import QueryServer
+
+        srv = QueryServer(queue_depth=16)
+        srv.set_caps_string(tcaps())
+        gate = threading.Event()            # consumer paused while clear
+        _echo_consumer(srv, gate=gate)
+
+        conns = []
+        results = {}
+
+        def _one(i):
+            c = QueryConnection("127.0.0.1", srv.port, timeout=10.0,
+                                qos="gold")
+            c.connect()
+            conns.append(c)
+            try:
+                out = c.query(TensorBuffer(
+                    tensors=[np.full(4, i, np.float32)]))
+                results[i] = out.np(0).tolist() if out is not None \
+                    else None
+            except (ShedError, ConnectionError, TimeoutError) as exc:
+                results[i] = exc
+
+        workers = [threading.Thread(target=_one, args=(i,), daemon=True)
+                   for i in range(3)]
+        for w in workers:
+            w.start()
+        # all three admitted and parked in the queue (consumer gated)
+        assert wait_until(lambda: srv._inflight == 3, timeout=5)
+
+        drained = {}
+        dt = threading.Thread(
+            target=lambda: drained.update(ok=srv.drain(deadline=10)),
+            daemon=True)
+        dt.start()
+        assert wait_until(lambda: srv.draining, timeout=5)
+        # a NEW request during drain sheds with a retry-after
+        late = QueryConnection("127.0.0.1", srv.port, timeout=5.0,
+                               qos="gold")
+        late.connect()
+        with pytest.raises(ShedError) as exc:
+            late.query(TensorBuffer(tensors=[np.ones(4, np.float32)]))
+        assert exc.value.retry_after_s > 0
+        late.close()
+        # release the consumer: the three in-flight frames must be
+        # REPLIED (not dropped) and only then does drain complete
+        gate.set()
+        dt.join(timeout=10)
+        for w in workers:
+            w.join(timeout=10)
+        assert drained.get("ok") is True
+        assert results == {0: [0.0, 0.0, 0.0, 0.0],
+                           1: [2.0, 2.0, 2.0, 2.0],
+                           2: [4.0, 4.0, 4.0, 4.0]}
+        for c in conns:
+            c.close()
+
+    def test_pipeline_drain_hooks_serversrc(self):
+        """Pipeline.drain flips health to draining and tears the query
+        server down through the element hook (fresh table entry on the
+        next play)."""
+        from nnstreamer_tpu.query.server import _SERVERS
+
+        sid = 973
+        p = Pipeline("drainable")
+        qsrc = TensorQueryServerSrc("qsrc", id=sid, port=0, caps=tcaps())
+        from nnstreamer_tpu.elements import TensorSink  # noqa: F811
+        qsink = TensorQueryServerSink("qsink", id=sid)
+        p.add(qsrc, qsink)
+        p.link(qsrc, qsink)
+        p.play()
+        try:
+            assert p.health_state() == "serving"
+            srv = qsrc.server
+            p.drain(deadline=2.0)
+            assert srv._stop.is_set()         # server closed
+            assert p.health_state() == "draining"
+            assert sid not in _SERVERS        # table entry reaped
+        finally:
+            p.stop()
+
+    def test_draining_element_demotes_healthz(self):
+        """While QueryServer.drain is in progress the serving pipeline
+        reports draining (the /healthz 503 contract) even before
+        Pipeline.stop runs."""
+        sid = 974
+        p = Pipeline("drain-health")
+        qsrc = TensorQueryServerSrc("qsrc", id=sid, port=0, caps=tcaps())
+        qsink = TensorQueryServerSink("qsink", id=sid)
+        p.add(qsrc, qsink)
+        p.link(qsrc, qsink)
+        p.play()
+        try:
+            assert p.health_state() == "serving"
+            qsrc.server._draining.set()       # drain began
+            assert p.health_state() == "draining"
+        finally:
+            p.stop()
+            from nnstreamer_tpu.query.server import shutdown_server
+            shutdown_server(sid)

@@ -904,3 +904,193 @@ class TestEndpointDiagnosis:
             lst.close()
             for conn, _ in accepted:
                 conn.close()
+
+
+# ==========================================================================
+# overload protection (PR 7): flood chaos, QoS-tiered shed accounting,
+# bounded serving plane
+# ==========================================================================
+
+class _Slow5ms:
+    """Chain-path delay element factory is overkill for a test: a gated
+    consumer on a RAW QueryServer gives a deterministic service time."""
+
+
+def slow_serving_server(queue_depth=64, service_s=0.004):
+    """Raw QueryServer + echo consumer with a fixed service time: the
+    deterministic capacity (1/service_s rps) the overload tests drive
+    past."""
+    from nnstreamer_tpu.query.server import QueryServer
+
+    srv = QueryServer(queue_depth=queue_depth)
+    srv.set_caps_string(tcaps())
+
+    def _run():
+        import queue as _q
+        while not srv._stop.is_set():
+            try:
+                buf = srv.incoming.get(timeout=0.1)
+            except _q.Empty:
+                continue
+            # deterministic service time: Event.wait as the timer so a
+            # close() mid-sleep returns promptly
+            srv._stop.wait(service_s)
+            out = TensorBuffer(
+                tensors=[np.asarray(buf.tensors[0]) * 2], pts=buf.pts)
+            out.extra.update(buf.extra)
+            srv.reply(out)
+
+    threading.Thread(target=_run, daemon=True,
+                     name="slow-echo-consumer").start()
+    return srv
+
+
+class TestOverloadInvariants:
+    def test_qos_assignment_largest_remainder(self):
+        gen = LoadGenerator(
+            "127.0.0.1", 1, clients=64, rate_hz=1.0, duration_s=1.0,
+            classes=(("gold", 1.0), ("silver", 2.0), ("bronze", 5.0)),
+            qos=True, registry=MetricsRegistry())
+        assignment = gen._qos_assignment()
+        from collections import Counter
+        assert Counter(assignment) == {"gold": 8, "silver": 16,
+                                       "bronze": 40}
+
+    def test_flood_chaos_bounded_queue_no_silent_drops_no_leaks(self):
+        """The flood fault against a bounded shedding server: incoming
+        depth never exceeds the bound, every answer the flood saw was
+        a reply or an explicit T_SHED, bronze shed on the server, and
+        the slab pool reclaims everything (zero leaked slabs)."""
+        import gc
+
+        from nnstreamer_tpu.tensor.buffer import default_pool
+        from nnstreamer_tpu.testing.faults import QueryFlood
+
+        srv = slow_serving_server(queue_depth=16, service_s=0.003)
+        flood = QueryFlood(("127.0.0.1", srv.port), conns=6).start()
+        try:
+            deadline = time.monotonic() + 8.0
+            while time.monotonic() < deadline:
+                if sum(srv.counters()["shed"].values()) >= 20:
+                    break
+                time.sleep(0.05)
+            stats = flood.stop()
+            assert stats["sent"] > 0
+            # bounded: the hard queue bound held throughout
+            assert srv.peak_depth <= srv.queue_depth
+            # tiered: flood connections declared bronze, and bronze is
+            # what shed
+            counters = srv.counters()
+            assert counters["shed"]["bronze"] >= 20
+            assert counters["shed"]["gold"] == 0
+            # no silent drops: everything the flood got back was a
+            # REPLY or an explicit T_SHED, and the server's own
+            # bookkeeping covers every frame it read
+            assert stats["sheds"] > 0
+            read = (sum(counters["admitted"].values())
+                    + sum(counters["shed"].values()))
+            assert read >= stats["replies"] + stats["sheds"]
+        finally:
+            flood.stop()
+            srv.close()
+        # zero leaked slabs: after the flood and teardown settle, no
+        # slab stays parked with live external views.  Settle loop:
+        # the consumer thread's last buffer local pins one slab until
+        # the thread notices close() and exits.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            gc.collect()
+            if default_pool().stats["pending"] == 0:
+                break
+            time.sleep(0.1)
+        assert default_pool().stats["pending"] == 0
+
+    def test_loadgen_qos_sheds_bronze_first_counters_match(self):
+        """Open-loop QoS-mode loadgen at ~2x a slow server's capacity:
+        bronze absorbs the shedding, gold is untouched, client-observed
+        sheds equal the server's shed counters exactly, no errors, no
+        breaker trips."""
+        import gc
+
+        from nnstreamer_tpu.query.resilience import STATS
+        from nnstreamer_tpu.tensor.buffer import default_pool
+
+        # 48 concurrent connections against a 5 ms server: up to 48
+        # frames outstanding, so the queue crosses bronze's arm
+        # watermark (64 * 0.45 = 28.8) but can never reach gold's
+        # (57.6) — per-worker in-flight is 1, so depth <= clients
+        srv = slow_serving_server(queue_depth=64, service_s=0.005)
+        stats_before = STATS.snapshot()
+        registry = MetricsRegistry()
+        gen = LoadGenerator(
+            "127.0.0.1", srv.port, clients=48, rate_hz=15.0,
+            duration_s=1.5, schedule="constant", seed=7,
+            timeout=10.0, registry=registry,
+            classes=(("gold", 1.0), ("silver", 2.0), ("bronze", 5.0)),
+            qos=True)
+        try:
+            summary = gen.run(warmup_s=0.3)
+        finally:
+            srv.close()
+        assert summary["qos"] is True
+        assert summary["errors"] == 0, summary
+        # offered ~720 rps vs ~200 rps capacity: sheds happened
+        assert summary["shed"] > 0, summary
+        by_class = summary["shed_by_class"]
+        # bronze sheds first; gold never reaches its 0.9 watermark
+        assert by_class.get("bronze", 0) > 0
+        assert by_class.get("gold", 0) == 0, summary
+        assert by_class.get("bronze", 0) >= by_class.get("silver", 0)
+        # client-observed sheds == server shed counters (every refusal
+        # was an explicit T_SHED, none lost, none silent)
+        srv_shed = {c: n for c, n in srv.counters()["shed"].items() if n}
+        cli_shed = {c: n for c, n in by_class.items() if n}
+        assert srv_shed == cli_shed
+        # shed is not failure: zero breaker transitions
+        delta = STATS.delta(stats_before)
+        assert delta.get("breaker.open", 0) == 0
+        # the registry's shed family carries the same per-class counts
+        from nnstreamer_tpu.slo.loadgen import SHED_TOTAL
+        for cls, n in cli_shed.items():
+            assert registry.counter(SHED_TOTAL,
+                                    **{"class": cls}).value == n
+        # bounded pool: nothing leaked across the burst (settle loop —
+        # the echo consumer's last buffer local pins one slab until
+        # the thread notices close() and exits)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            gc.collect()
+            if default_pool().stats["pending"] == 0:
+                break
+            time.sleep(0.1)
+        assert default_pool().stats["pending"] == 0
+
+    def test_shed_latency_excluded_from_admitted_histogram(self):
+        """Shed requests must not contribute to the admitted-traffic
+        latency distribution (a fast shed would flatter p99; a slow one
+        would slander it)."""
+        from nnstreamer_tpu.query.overload import AdmissionController
+        from nnstreamer_tpu.query.server import QueryServer
+
+        class _ShedAll:
+            def decide(self, qos, depth, capacity):
+                return 0.01
+
+        srv = QueryServer(queue_depth=8,
+                          admission=AdmissionController(policy=_ShedAll()))
+        srv.set_caps_string(tcaps())
+        registry = MetricsRegistry()
+        gen = LoadGenerator(
+            "127.0.0.1", srv.port, clients=2, rate_hz=20.0,
+            duration_s=0.5, schedule="constant", seed=3,
+            timeout=5.0, registry=registry,
+            classes=(("bronze", 1.0),), qos=True)
+        try:
+            summary = gen.run(warmup_s=0.2)
+        finally:
+            srv.close()
+        assert summary["shed"] == summary["sent"] > 0
+        assert summary["errors"] == 0
+        # the admitted-latency histogram saw NOTHING
+        hist = registry.histogram(LATENCY_US, **{"class": "bronze"})
+        assert hist.count == 0

@@ -6,6 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from nnstreamer_tpu.parallel.compat import shard_map
 from nnstreamer_tpu.models.streamformer_lm import (decode_step,
                                                    forward_logits, generate,
                                                    init_cache)
@@ -66,7 +67,7 @@ class TestTrainingParity:
 
         mesh = Mesh(np.array(jax_cpu_devices[:1]).reshape(1, 1, 1, 1),
                     ("dp", "sp", "tp", "ep"))
-        fn = jax.shard_map(
+        fn = shard_map(
             lambda p, t: _forward_local(p, t, cfg)[0],
             mesh=mesh, in_specs=(P(), P()), out_specs=P(),
             check_vma=False)

@@ -24,6 +24,11 @@ time, with no model in the loop:
                    populated + endpoint up vs cleared, and a
                    structural scan proving untraced compiled plans
                    hold zero obs/tracer references.
+  - ``admit``:    per-request admission-control decision cost on the
+                   UN-overloaded path (query/overload.py: token bucket
+                   + watermark policy, queue under every watermark —
+                   the branch every admitted frame pays), against the
+                   measured wire round-trip it rides on.
 
 Prints ONE JSON line per stage (schema mirrors bench.py).
 
@@ -352,6 +357,61 @@ def bench_obs(frames: int) -> dict:
             "untraced_plan_obs_refs": refs, "frames": frames}
 
 
+def _admit_measure(decisions: int = 200_000):
+    """ns per admission decision on the un-overloaded path (queue well
+    under every watermark, bucket never empty)."""
+    from nnstreamer_tpu.query.overload import (AdmissionController,
+                                               TokenBucket)
+
+    ctrl = AdmissionController(bucket=TokenBucket(rate=1e9, burst=1e9))
+    t0 = time.perf_counter()
+    for _ in range(decisions):
+        ctrl.admit("silver", 3, 256)
+    dt = time.perf_counter() - t0
+    return dt / decisions * 1e9
+
+
+def bench_admit(frames: int) -> dict:
+    admit_ns = _admit_measure()
+    wire = bench_wire(max(frames, 100))
+    rt_ns = 1e9 / wire["value"]
+    return {"metric": "hotpath_admit_ns_per_decision",
+            "value": round(admit_ns, 1), "unit": "ns/decision",
+            "wire_roundtrip_ns": round(rt_ns, 1),
+            "overhead_pct_of_wire": round(admit_ns / rt_ns * 100, 3),
+            "decisions": 200_000}
+
+
+def run_assert_admit() -> int:
+    """Admission-overhead gate: the un-overloaded admission decision
+    (the only overload-layer cost an admitted frame pays) must stay
+    under 2% of the wire frame round trip it gates — overload
+    protection may not tax the protected path."""
+    failures = []
+    admit_ns = _admit_measure()
+    wire = bench_wire(200)
+    rt_ns = 1e9 / wire["value"]
+    pct = admit_ns / rt_ns * 100
+    for _ in range(2):       # re-measure on a miss: scheduler noise is
+        if pct <= 2.0:       # one-sided, a real cost survives retries
+            break
+        admit_ns = min(admit_ns, _admit_measure())
+        pct = admit_ns / rt_ns * 100
+    if pct > 2.0:
+        failures.append(
+            f"admission decision {admit_ns:.0f} ns = {pct:.2f}% of the "
+            f"wire round trip ({rt_ns:.0f} ns): the un-overloaded "
+            "admission path grew a real per-frame cost")
+    result = {"metric": "hotpath_admit_gate", "unit": "ok",
+              "value": 0 if failures else 1,
+              "admit_ns_per_decision": round(admit_ns, 1),
+              "wire_roundtrip_ns": round(rt_ns, 1),
+              "overhead_pct_of_wire": round(pct, 3),
+              "failures": failures}
+    print(json.dumps(result), flush=True)
+    return 1 if failures else 0
+
+
 def run_assert_obs() -> int:
     """Obs-regression gate: untraced compiled plans must hold zero obs
     references, and metrics-off dispatch overhead must stay under 2%
@@ -473,7 +533,7 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--frames", type=int, default=200)
     ap.add_argument("--stage", choices=["pool", "serialize", "wire", "shm",
-                                        "dispatch", "obs", "all"],
+                                        "dispatch", "obs", "admit", "all"],
                     default="all")
     ap.add_argument("--assert", dest="assert_gate", action="store_true",
                     help="regression gates (exit 1): copy gate (serialize "
@@ -491,10 +551,13 @@ def main() -> int:
             rc |= run_assert_dispatch()
         if args.stage in ("all", "obs"):
             rc |= run_assert_obs()
+        if args.stage in ("all", "admit"):
+            rc |= run_assert_admit()
         return rc
     stages = {"pool": bench_pool, "serialize": bench_serialize,
               "wire": bench_wire, "shm": bench_shm,
-              "dispatch": bench_dispatch, "obs": bench_obs}
+              "dispatch": bench_dispatch, "obs": bench_obs,
+              "admit": bench_admit}
     picks = stages if args.stage == "all" else {args.stage:
                                                stages[args.stage]}
     for fn in picks.values():

@@ -56,6 +56,16 @@ This tool checks exactly those repo rules:
     explicit — ``mono_ns()`` for durations and deadlines, ``wall_us()``
     for cross-host stamps.
 
+``unbounded-queue``
+    ``queue.Queue()`` without ``maxsize`` or ``deque()`` without
+    ``maxlen`` in the dataflow layers (``query/``, ``pipeline/``).  An
+    unbounded buffer on a data path absorbs overload as unbounded
+    memory growth and unbounded latency instead of explicit
+    backpressure or shedding — the failure mode the PR 7 admission
+    layer (query/overload.py) exists to prevent.  Queues that are
+    bounded by construction elsewhere (a slot condition, a ≤1-in-flight
+    protocol) take the pragma WITH the reason in the comment.
+
 Pragma: append ``# nnslint: allow(<rule>)`` to the offending line or
 the comment line directly above it (give a reason in the comment).
 
@@ -82,7 +92,14 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 RULES = ("sleep-poll", "io-under-lock", "lock-order", "unknown-lock",
          "tracer-in-untraced-plan", "readonly-view-mutation",
-         "wallclock-in-chain")
+         "wallclock-in-chain", "unbounded-queue")
+
+#: directories where unbounded queue/deque construction is a finding
+#: (the dataflow layers the overload story bounds)
+_BOUNDED_QUEUE_DIRS = (
+    os.path.join("nnstreamer_tpu", "query") + os.sep,
+    os.path.join("nnstreamer_tpu", "pipeline") + os.sep,
+)
 
 #: method names that are per-buffer dataflow paths for wallclock-in-chain
 _CHAIN_PATH_FUNCS = frozenset({"chain", "create", "plan_step",
@@ -361,6 +378,27 @@ class _FileLinter(ast.NodeVisitor):
                       "clock slews under NTP — use obs.clock.mono_ns() "
                       "for durations/deadlines or obs.clock.wall_us() "
                       "for cross-host stamps")
+        # unbounded-queue: queue.Queue() without maxsize / deque()
+        # without maxlen in the dataflow layers — unbounded buffers
+        # absorb overload as memory growth instead of backpressure or
+        # explicit shedding (query/overload.py)
+        if any(d in self.rel for d in _BOUNDED_QUEUE_DIRS):
+            if name == "Queue" and self._queue_unbounded(node):
+                self._add(node, "unbounded-queue",
+                          "queue.Queue() without a positive maxsize in "
+                          "a dataflow layer: overload becomes unbounded "
+                          "memory growth — bound it (the hard watermark "
+                          "admission control sheds under) or pragma "
+                          "WITH the reason it is bounded elsewhere")
+            elif name == "deque" and len(node.args) < 2 \
+                    and not any(kw.arg == "maxlen"
+                                for kw in node.keywords):
+                # deque() AND deque(iterable) are both unbounded; only
+                # a maxlen (kw or second positional) bounds one
+                self._add(node, "unbounded-queue",
+                          "deque() without maxlen in a dataflow layer: "
+                          "bound it or pragma WITH the reason it is "
+                          "bounded elsewhere")
         # io-under-lock
         if name in _IO_CALLS and self._with_stack:
             for held, held_line in self._with_stack:
@@ -373,6 +411,24 @@ class _FileLinter(ast.NodeVisitor):
                               "socket I/O — a stalled peer would wedge "
                               "every thread needing that lock")
         self.generic_visit(node)
+
+    @staticmethod
+    def _queue_unbounded(node: ast.Call) -> bool:
+        """True when a Queue(...) construction is unbounded: no maxsize
+        at all, or an explicit 0 / non-positive constant (queue.Queue
+        treats maxsize<=0 as infinite)."""
+        size = None
+        if node.args:
+            size = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                size = kw.value
+        if size is None:
+            return True
+        if isinstance(size, ast.Constant) \
+                and isinstance(size.value, (int, float)):
+            return size.value <= 0
+        return False       # computed bound: assume intentional
 
     def _in_loop(self, node: ast.AST) -> bool:
         # lexical ancestry via a parent walk (ast has no parent links:
