@@ -75,12 +75,13 @@ N_FRAMES = int(os.environ.get("NNS_TPU_BENCH_FRAMES",
                               if STREAM_BATCH > 1 else "150"))
 BASELINE_FPS = 30.0  # north-star target (BASELINE.json)
 BATCH = 64           # vmap-batched invoke mode
-# bf16 peak of one TPU v5e chip, for MFU; other platforms: no MFU claim.
-PEAK_FLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12,
-              "v4": 275e12, "v6e": 918e12}
-# HBM bandwidth (bytes/s) per chip, for the roofline bound
-PEAK_BW = {"v5e": 819e9, "v5litepod": 819e9, "v5p": 2765e9,
-           "v4": 1228e9, "v6e": 1640e9}
+# per-chip bf16 peak FLOP/s and HBM bandwidth for MFU/roofline: the ONE
+# source is obs/attrib.py — the live nns_mfu gauge and these BENCH rows
+# compute MFU from the same tables AND the same lookup (including the
+# NNS_PEAK_FLOPS/NNS_PEAK_BW assumed-chip overrides), so the two
+# surfaces cannot drift apart.
+from nnstreamer_tpu.obs.attrib import (PEAK_BW, PEAK_FLOPS,  # noqa: E402
+                                       device_peaks)
 
 CONFIG_METRICS = {
     "mobilenet": "mobilenet_v2_224_image_labeling_e2e_fps",
@@ -314,22 +315,11 @@ def _model_cost(model, device):
 
 
 def _peak_bw(device) -> float:
-    return _peak_lookup(device, PEAK_BW)
-
-
-def _peak_lookup(device, table) -> float:
-    """Per-chip peak from a {kind-substring: value} table; unknown TPU
-    kinds assume v5e, non-TPU platforms make no claim."""
-    kind = (getattr(device, "device_kind", "") or "").lower().replace(" ", "")
-    for key, peak in table.items():
-        if key in kind:
-            return peak
-    plat = getattr(device, "platform", "")
-    return table["v5e"] if plat == "tpu" else 0.0
+    return device_peaks(device)[1]
 
 
 def _peak_flops(device) -> float:
-    return _peak_lookup(device, PEAK_FLOPS)
+    return device_peaks(device)[0]
 
 
 def _batched_profile(model, device, size: int, batch: int = BATCH):
@@ -381,25 +371,33 @@ def _effective_inflight(pipeline=None) -> int:
 
 
 def _trace_breakdown(model_name, size, decoder, dtype_prop,
-                     decoder_opts, src_cache) -> dict:
-    """Per-element proctime/interlatency breakdown from ONE short traced
-    pass — a separate run so the headline fps numbers stay untraced
-    (fused plans with zero tracer references).  Attached to BENCH rows
-    as ``trace`` so artifacts carry where the time went, not just the
+                     decoder_opts, src_cache) -> "tuple[dict, dict]":
+    """Per-element proctime/interlatency breakdown plus the wait-state
+    attribution summary, from ONE short traced pass — a separate run so
+    the headline fps numbers stay untraced (fused plans with zero
+    tracer references).  Attached to BENCH rows as ``trace`` and
+    ``attribution``, so artifacts carry where the time went (and which
+    STATE ate it — the rows a batching PR must shrink), not just the
     end-to-end fps."""
+    from nnstreamer_tpu.obs.profile import Profiler, compact_blame
+
     p = _model_pipeline(model_name, size, decoder, dtype_prop,
                         decoder_opts, src_cache,
                         n_frames=max(30, min(N_FRAMES, 120)))
-    tracer = p.enable_tracing()
+    prof = Profiler(p, register_gauges=False)
+    tracer = p.tracer
     try:
         p.run(timeout=_extras_budget() + 60)
+        report = prof.report(metrics_report={}, top_n=5)
     finally:
+        prof.close()
         p.stop()
     keep = ("buffers", "proctime_avg_us", "proctime_p50_us",
             "proctime_p95_us", "proctime_p99_us", "fps",
             "interlatency_avg_us", "interlatency_p99_us")
-    return {el: {k: v for k, v in row.items() if k in keep}
-            for el, row in tracer.report().items()}
+    trace = {el: {k: v for k, v in row.items() if k in keep}
+             for el, row in tracer.report().items()}
+    return trace, compact_blame(report["blame"])
 
 
 def bench_model(name: str, model_name: str, size: int, decoder: str,
@@ -451,7 +449,7 @@ def bench_model(name: str, model_name: str, size: int, decoder: str,
         if parse_bool(os.environ.get("NNS_TPU_BENCH_TRACE", "1")) \
                 and _extras_budget() > 30:
             try:
-                out["trace"] = _trace_breakdown(
+                out["trace"], out["attribution"] = _trace_breakdown(
                     model_name, size, decoder, dtype_prop, decoder_opts,
                     src_cache)
                 if emit is not None:

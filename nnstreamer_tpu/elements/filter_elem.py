@@ -372,6 +372,78 @@ class TensorFilter(Element):
             ("nns_filter_inflight", lambda: len(self._inflight)),
             ("nns_filter_pending", lambda: len(self._pending)),
             ("nns_filter_dropped", lambda: self.dropped))]
+        self._register_device_gauges(labels)
+
+    def _register_device_gauges(self, labels) -> None:
+        """Device accounting for the jit-exec backend family: live
+        ``nns_mfu`` (achieved FLOP/s over the chip peak — the SAME
+        formula and peak tables as bench.py's mfu_stream, so the gauge
+        and the BENCH rows cannot disagree), achieved HBM bytes/s, and
+        device memory in use.  All lazy callables: the FLOPs/bytes cost
+        model (XLA cost analysis over the negotiated shapes) is
+        computed once at the first scrape that wants it, through the
+        backend's already-warm executable cache — zero per-frame cost,
+        no compile on the open path."""
+        fw = self.fw
+        if getattr(fw, "_jitted", None) is None:
+            return   # not a jit-exec backend: no cost model, no claim
+        from ..obs.attrib import device_peaks, estimate_jit_cost
+        from ..obs.metrics import REGISTRY, Gauge
+
+        el = self
+
+        def _make_rate():
+            # scrape-to-scrape frame rate (first scrape: lifetime).
+            # One state box per gauge so nns_mfu and bytes/s sampled in
+            # the same scrape each get a real window.
+            state = {"frames": None, "t": None}
+
+            def _frame_rate() -> float:
+                import time as _time
+
+                st = getattr(fw, "stats", None)
+                if st is None:
+                    return 0.0
+                # frames ~= invokes x micro-batch (batched dispatch
+                # records one stat per bucket; exact at batch=1)
+                frames = st.total_invokes * max(1, el._batch)
+                now = _time.monotonic()
+                prev_f, prev_t = state["frames"], state["t"]
+                state["frames"], state["t"] = frames, now
+                if prev_t is None or now - prev_t < 0.05:
+                    return st.throughput * max(1, el._batch)
+                return max(0.0, (frames - prev_f) / (now - prev_t))
+
+            return _frame_rate
+
+        mfu_rate, bw_rate = _make_rate(), _make_rate()
+
+        def _mfu() -> float:
+            flops, _ = estimate_jit_cost(fw)
+            peak, _ = device_peaks(fw._device)
+            if not flops or not peak:
+                return 0.0
+            return mfu_rate() * flops / peak
+
+        def _bytes_per_s() -> float:
+            _, nbytes = estimate_jit_cost(fw)
+            return bw_rate() * nbytes if nbytes else 0.0
+
+        def _mem_bytes() -> float:
+            stats_fn = getattr(fw._device, "memory_stats", None)
+            if stats_fn is None:
+                return 0.0
+            stats = stats_fn() or {}
+            return float(stats.get("bytes_in_use", 0))
+
+        dev = dict(labels)
+        dev["device"] = str(getattr(fw._device, "device_kind", "")
+                            or getattr(fw._device, "platform", ""))
+        self._obs_gauges.extend(
+            REGISTRY.register(Gauge(n, dev, fn=f)) for n, f in (
+                ("nns_mfu", _mfu),
+                ("nns_device_bytes_per_s", _bytes_per_s),
+                ("nns_device_mem_bytes", _mem_bytes)))
 
     def stop(self):
         from ..obs.metrics import REGISTRY
@@ -542,7 +614,7 @@ class TensorFilter(Element):
 
         self._wk_tasks: _q.Queue = _q.Queue()
         self._wk_cv = make_condition("filter.workers")
-        self._wk_results: dict = {}     # seq -> (buf, outs, exc)
+        self._wk_results: dict = {}   # seq -> (buf, outs, exc, ready_ns)
         self._wk_seq = 0                # frames submitted
         self._wk_pushed = 0             # frames pushed (or error-skipped)
         self._wk_error = None
@@ -581,19 +653,34 @@ class TensorFilter(Element):
         return FlowReturn.OK
 
     def _worker_loop(self, fw) -> None:
+        import time as _time
+
         while True:
             item = self._wk_tasks.get()
             if item is None:
                 return
             seq, tensors, buf = item
+            pl = self.pipeline
+            tracer = pl.tracer if pl is not None else None
             try:
-                if self._emit_device:
-                    outs = fw.invoke(tensors, emit_device=True)
-                else:
-                    outs = fw.invoke(tensors)
-                res = (buf, list(outs), None)
+                if tracer is not None:
+                    # per-invoke span on the worker thread: proctime
+                    # lands under "<name>:invoke" (chain() only covers
+                    # the submit), and the backend's device-invoke
+                    # annotation records inside this frame
+                    tracer.enter(self.name + ":invoke", buf)
+                try:
+                    if self._emit_device:
+                        outs = fw.invoke(tensors, emit_device=True)
+                    else:
+                        outs = fw.invoke(tensors)
+                finally:
+                    if tracer is not None:
+                        tracer.exit()
+                res = (buf, list(outs), None,
+                       _time.monotonic_ns() if tracer is not None else 0)
             except Exception as exc:  # noqa: BLE001 — surfaced by pusher
-                res = (buf, None, exc)
+                res = (buf, None, exc, 0)
             with self._wk_cv:
                 self._wk_results[seq] = res
                 self._wk_cv.notify_all()
@@ -610,8 +697,22 @@ class TensorFilter(Element):
                         and self._wk_pushed >= self._wk_seq))
                 if self._wk_pushed not in self._wk_results:
                     return              # stopped and fully drained
-                buf, outs, exc = self._wk_results.pop(self._wk_pushed)
+                buf, outs, exc, ready_ns = self._wk_results.pop(
+                    self._wk_pushed)
                 failed = self._wk_error is not None
+            if ready_ns:
+                # reorder-wait: the result was finished at ready_ns but
+                # held for strict stream order (obs/attrib.py state)
+                pl = self.pipeline
+                tracer = pl.tracer if pl is not None else None
+                if tracer is not None and tracer.ring is not None:
+                    import time as _time
+
+                    ctx = buf.extra.get("nns_trace")
+                    tracer.annotate_span(
+                        "reorder-wait", ready_ns, _time.monotonic_ns(),
+                        seq=buf.extra.get("nns_seq", -1),
+                        trace_id=ctx.trace_id if ctx else 0)
             if not failed:
                 try:
                     if exc is not None:
@@ -678,6 +779,16 @@ class TensorFilter(Element):
             import time
 
             self._pending_t0 = time.monotonic()
+        pl = self.pipeline
+        if pl is not None and pl.tracer is not None \
+                and pl.tracer.ring is not None:
+            # wait-state attribution (obs/attrib.py): bucket-coalescing
+            # arrival stamp; _push_inflight turns it into per-frame
+            # queue-wait + device-invoke spans.  One tracer test per
+            # frame on the (interpreted-only) batch path.
+            import time
+
+            buf.extra["nns_coll_ns"] = time.monotonic_ns()
         self._pending.append(list(tensors))
         self._pending_bufs.append(buf)
         if len(self._pending) >= self._batch:
@@ -689,6 +800,13 @@ class TensorFilter(Element):
         is at depth — push the OLDEST batch's results (d2h copies of
         every queued batch overlap this batch's collection; deeper
         queues overlap more dispatch round-trips)."""
+        if self._pending_bufs and "nns_coll_ns" in \
+                self._pending_bufs[0].extra:
+            import time
+
+            d0 = time.monotonic_ns()
+            for b in self._pending_bufs:
+                b.extra["nns_disp_ns"] = d0
         if self._emit_device:
             handle = self.fw.invoke_batched(self._pending, self._batch,
                                             emit_device=True)
@@ -704,6 +822,29 @@ class TensorFilter(Element):
     def _push_inflight(self, inflight) -> FlowReturn:
         bufs, handle, _t0 = inflight
         per_frame = handle.views() if self._emit_device else handle.wait()
+        pl = self.pipeline
+        tracer = pl.tracer if pl is not None else None
+        if tracer is not None and tracer.ring is not None:
+            # per-frame attribution of the shared batch (obs/attrib.py):
+            # arrival → dispatch is queue-wait (bucket fill + in-flight
+            # backlog), dispatch → host materialization is this frame's
+            # device window (every batch peer overlaps the same one —
+            # per-frame wall-clock truth, not a 1/n share)
+            import time
+
+            t1 = time.monotonic_ns()
+            for buf in bufs:
+                coll = buf.extra.pop("nns_coll_ns", None)
+                disp = buf.extra.pop("nns_disp_ns", None)
+                if coll is None or disp is None:
+                    continue
+                ctx = buf.extra.get("nns_trace")
+                tid = ctx.trace_id if ctx else 0
+                seq = buf.extra.get("nns_seq", -1)
+                tracer.annotate_span("queue-wait", coll, disp,
+                                     seq=seq, trace_id=tid)
+                tracer.annotate_span("device-invoke", disp, t1,
+                                     seq=seq, trace_id=tid)
         ret = FlowReturn.OK
         for buf, outs in zip(bufs, per_frame):
             r = self._push_result(buf, list(outs))

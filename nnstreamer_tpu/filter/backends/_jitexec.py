@@ -16,6 +16,7 @@ from typing import Any, List
 
 import numpy as np
 
+from ...pipeline.tracing import annotate, annotation_active
 from ...tensor.buffer import BatchView, is_device_array
 from ..framework import Accelerator, FilterError, start_output_transfers
 
@@ -171,6 +172,11 @@ class JitExecMixin:
         self._jitted = jax.jit(forward_fn)
         self._vjit = None
         self._mesh = mesh
+        # wait-state attribution (obs/attrib.py): the first dispatch of
+        # a cold executable is device-compile, not device-invoke — the
+        # warm-up below (when inputs are given) pays it outside the
+        # stream, so frame 1 annotates as a plain invoke
+        self._annot_cold = True
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -182,6 +188,7 @@ class JitExecMixin:
             return None
         outs = self._invoke_device(warmup_inputs)
         jax.block_until_ready(outs)
+        self._annot_cold = False
         return outs
 
     @staticmethod
@@ -314,7 +321,12 @@ class JitExecMixin:
         outs = self._invoke_device(inputs)
         if not emit_device:
             start_output_transfers(outs)
-        self.stats.record(time.monotonic_ns() - t0)
+        t1 = time.monotonic_ns()
+        self.stats.record(t1 - t0)
+        if annotation_active():
+            annotate("device-compile" if self._annot_cold
+                     else "device-invoke", t0, t1)
+        self._annot_cold = False
         return list(outs)
 
     def invoke_batched(self, frames, bucket: int, emit_device: bool = False):
@@ -337,13 +349,20 @@ class JitExecMixin:
             if not emit_device:
                 for o in outs:
                     start_output_transfers(o)
-            self.stats.record(time.monotonic_ns() - t0)
+            t1 = time.monotonic_ns()
+            self.stats.record(t1 - t0)
+            if annotation_active():
+                annotate("device-invoke", t0, t1)
             return _FlushHandle(outs)
         stacked = [self._stage_batch([f[k] for f in frames], bucket)
                    for k in range(len(frames[0]))]
+        cold = self._vjit is None
         t0 = time.monotonic_ns()
         outs = self._dispatch_batched(stacked, emit_device=emit_device)
-        self.stats.record(time.monotonic_ns() - t0)
+        t1 = time.monotonic_ns()
+        self.stats.record(t1 - t0)
+        if annotation_active():
+            annotate("device-compile" if cold else "device-invoke", t0, t1)
         return BatchHandle(list(outs), n)
 
     def _stage_batch(self, arrs, bucket: int):
@@ -456,6 +475,7 @@ class JitExecMixin:
         jax.block_until_ready(self._dispatch_batched(zeros))
         ones = [np.zeros(i.np_shape, i.np_dtype) for i in in_info]
         jax.block_until_ready(self._invoke_device(ones))
+        self._annot_cold = False
 
     def set_postprocess(self, fn) -> bool:
         """Compose a decoder-pushed reduction into the jitted forward: one
@@ -471,6 +491,8 @@ class JitExecMixin:
         self._forward_fn = fused
         self._jitted = jax.jit(fused)
         self._vjit = None  # rebuild the batched executable around the fusion
+        self._annot_cold = True   # next dispatch re-compiles
+        self._nns_cost_cache = None   # fused graph has a new cost model
         # marker for the element's post-reload re-apply: a backend that
         # still carries the fusion must NOT be fused again (set_postprocess
         # composes over _forward_fn — a second application would reduce

@@ -105,6 +105,21 @@ def main(argv=None) -> int:
                          "overlap; spans harvested from remote "
                          "tensor_query servers merge in under their own "
                          "process row)")
+    ap.add_argument("--profile", action="store_true",
+                    help="utilization attribution profile: record "
+                         "per-buffer spans, decompose every frame's "
+                         "end-to-end wall time into wait states "
+                         "(source-pacing / queue-wait / admission-wait "
+                         "/ serialize / wire / device-invoke / "
+                         "reorder-wait / sink — obs/attrib.py), print "
+                         "the blame table at EOS and write the profile "
+                         "artifacts (profile.json + Chrome trace + "
+                         "folded-stacks flamegraph) under "
+                         "--profile-out; live nns_mfu / occupancy "
+                         "gauges ride the metrics registry")
+    ap.add_argument("--profile-out", default="profile", metavar="DIR",
+                    help="artifact dir for --profile "
+                         "(default: ./profile)")
     ap.add_argument("--metrics-port", type=int, default=None,
                     metavar="PORT",
                     help="serve live Prometheus metrics on "
@@ -182,11 +197,19 @@ def main(argv=None) -> int:
             from .obs.httpd import start_metrics_server
 
             start_metrics_server(args.metrics_port)
-        want_trace = args.trace or args.trace_out or args.timeline
-        tracer = (p.enable_tracing(spans=bool(args.timeline))
+        want_trace = (args.trace or args.trace_out or args.timeline
+                      or args.profile)
+        tracer = (p.enable_tracing(
+                      spans=bool(args.timeline or args.profile))
                   if want_trace else None)
+        profiler = None
+        if args.profile:
+            from .obs.profile import Profiler
+
+            profiler = Profiler(p, tracer=tracer)
         plans = None
         metrics = None
+        prof_report = None
         slo_monitor = slo_evaluator = None
         if args.slo:
             from .slo import Evaluator, FlightRecorder, SLOMonitor
@@ -225,6 +248,11 @@ def main(argv=None) -> int:
                 from .obs.metrics import REGISTRY
 
                 metrics = REGISTRY.report()
+            if profiler is not None:
+                # report BEFORE stop(): the device/occupancy gauges
+                # (nns_mfu, nns_device_mem_bytes) unregister at element
+                # teardown and the profile must carry their live values
+                prof_report = profiler.report(metrics_report=metrics)
             if args.stats:
                 total, per = p.query_latency()
                 for name, ns in sorted(per.items()):
@@ -283,6 +311,31 @@ def main(argv=None) -> int:
                     # queue depths, pool occupancy, filter scheduler
                     # state, per-element latency summaries
                     report["metrics"] = metrics
+                if profiler is not None:
+                    import os as _os
+
+                    _os.makedirs(args.profile_out, exist_ok=True)
+                    if prof_report is None:   # error/timeout path
+                        prof_report = profiler.report(
+                            metrics_report=metrics)
+                    report["attribution"] = prof_report["blame"]
+                    print(profiler.blame_table(prof_report),
+                          file=sys.stderr)
+                    prof_path = _os.path.join(args.profile_out,
+                                              "profile.json")
+                    with open(prof_path, "w", encoding="utf-8") as fh:
+                        _json.dump({"pipeline": args.pipeline,
+                                    "profile": prof_report,
+                                    "trace": report["trace"]},
+                                   fh, indent=2)
+                    profiler.export_chrome(_os.path.join(
+                        args.profile_out, "trace.json"))
+                    profiler.export_folded(_os.path.join(
+                        args.profile_out, "flame.folded"))
+                    profiler.close()
+                    print(f"profile written to {args.profile_out}/"
+                          "{profile.json, trace.json, flame.folded}",
+                          file=sys.stderr)
                 if args.timeline:
                     tracer.export_chrome(args.timeline)
                     print(f"timeline written to {args.timeline}",
@@ -291,7 +344,8 @@ def main(argv=None) -> int:
                     with open(args.trace_out, "w",
                               encoding="utf-8") as fh:
                         _json.dump(report, fh, indent=2)
-                if args.trace or not (args.trace_out or args.timeline):
+                if args.trace or not (args.trace_out or args.timeline
+                                      or args.profile):
                     print(_json.dumps(report, indent=2),
                           file=sys.stderr)
     except Exception as exc:  # noqa: BLE001

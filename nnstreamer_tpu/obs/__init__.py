@@ -21,6 +21,16 @@ discipline as ``pipeline/tracing.py``:
 - :mod:`~nnstreamer_tpu.obs.httpd` — the pull-based ``NNS_METRICS_PORT``
   HTTP endpoint serving the registry, plus the ``/healthz`` readiness
   aggregate (``starting|serving|degraded|draining`` health sources).
+- :mod:`~nnstreamer_tpu.obs.attrib` — wait-state attribution: a traced
+  frame's end-to-end wall time decomposed into a closed state set
+  (source-pacing, queue-wait, admission-wait, serialize, wire,
+  device-invoke/compile, reorder-wait, sink, dispatch), with the
+  conservation guarantee that state sums equal e2e; plus the device
+  FLOPs/bytes cost model and the per-chip peak tables behind the live
+  ``nns_mfu`` gauge (the same tables bench.py imports).
+- :mod:`~nnstreamer_tpu.obs.profile` — the :class:`Profiler` surface
+  over all of it: blame tables, folded-stack flamegraphs, per-element
+  occupancy gauges (``launch.py --profile``).
 
 Nothing in this package runs on the dataflow hot path unless a tracer
 with span recording is attached: metrics are lazy callable gauges
@@ -28,6 +38,8 @@ evaluated at scrape time, and untraced compiled plans contain zero obs
 references (enforced by ``tools/hotpath_bench.py --stage obs --assert``).
 """
 
+from .attrib import (STATES, blame_from_spans,  # noqa: F401
+                     device_peaks, estimate_jit_cost)
 from .clock import OffsetEstimator, mono_ns, wall_us  # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, count_over_threshold,

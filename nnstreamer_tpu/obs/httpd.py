@@ -98,23 +98,37 @@ class _Handler(BaseHTTPRequestHandler):
 
         status = 200
         path = self.path.split("?", 1)[0]
-        if path == "/metrics":
-            body = self.registry.render_prometheus().encode()
-            ctype = "text/plain; version=0.0.4; charset=utf-8"
-        elif path == "/healthz":
-            report = health_report()
-            body = (_json.dumps(report) + "\n").encode()
-            ctype = "application/json"
-            if not report["ready"]:
-                status = 503
-        else:
-            self.send_error(404)
-            return
-        self.send_response(status)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        # the render walks live element state (lazy gauge providers)
+        # that a concurrent Pipeline.stop() is tearing down: dead
+        # providers yield dropped samples (obs/metrics.py Gauge
+        # contract), and anything that still escapes answers 503 —
+        # a scrape must never 500 or leak an exception into this
+        # serving thread
+        try:
+            if path == "/metrics":
+                body = self.registry.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/healthz":
+                report = health_report()
+                body = (_json.dumps(report) + "\n").encode()
+                ctype = "application/json"
+                if not report["ready"]:
+                    status = 503
+            else:
+                self.send_error(404)
+                return
+        except Exception:   # noqa: BLE001 — teardown race backstop
+            status = 503
+            body = b"scrape raced teardown; retry\n"
+            ctype = "text/plain; charset=utf-8"
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except OSError:
+            pass   # client hung up mid-reply: nothing to serve
 
     def log_message(self, fmt, *args):  # silence per-scrape stderr spam
         pass

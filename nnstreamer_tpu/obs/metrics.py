@@ -49,6 +49,13 @@ def _bucket_mid(i: int) -> float:
     return 2.0 ** ((i + 0.5) / _SUB)
 
 
+def _bucket_lo(i: int) -> float:
+    """Lower edge of bucket ``i`` — the reported value for mass in the
+    OVERFLOW bucket, whose upper edge is unbounded (a midpoint of an
+    open interval would be an invention, not an interpolation)."""
+    return 2.0 ** (i / _SUB)
+
+
 def _label_str(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
@@ -76,7 +83,15 @@ class Counter:
 
 class Gauge:
     """Point-in-time value: either explicitly ``set()`` or backed by a
-    callable evaluated at scrape time (the zero-hot-path-cost form)."""
+    callable evaluated at scrape time (the zero-hot-path-cost form).
+
+    Teardown contract: a lazy provider belonging to a stopping element
+    can be called by a concurrent scrape AFTER the element tore its
+    state down.  A provider that raises (or returns something
+    non-numeric) is a DEAD provider: :meth:`sample` answers ``None``
+    and every renderer drops the sample — the scrape never 500s, never
+    leaks an exception into the httpd thread, and never emits a bogus
+    value for a metric that no longer exists."""
 
     def __init__(self, name: str, labels: Dict[str, str],
                  fn: Optional[Callable[[], float]] = None) -> None:
@@ -88,14 +103,23 @@ class Gauge:
     def set(self, value: float) -> None:
         self._value = float(value)
 
-    @property
-    def value(self) -> float:
+    def sample(self) -> Optional[float]:
+        """The scrape read: the provider's value, or ``None`` when the
+        provider is dead (raised / non-numeric) — a dropped sample,
+        not an error."""
         if self.fn is not None:
             try:
                 return float(self.fn())
-            except Exception:   # noqa: BLE001 — a dead provider (stopped
-                return float("nan")   # element) must not break the scrape
+            except Exception:   # noqa: BLE001 — dead provider (element
+                return None     # stopped under the scrape): drop
         return self._value
+
+    @property
+    def value(self) -> float:
+        """Back-compat numeric read; dead providers read as NaN (use
+        :meth:`sample` to distinguish dead from NaN-valued)."""
+        v = self.sample()
+        return float("nan") if v is None else v
 
 
 class Histogram:
@@ -172,19 +196,36 @@ class Histogram:
 def quantile_from_counts(counts, q: float) -> float:
     """``q``-quantile of a (possibly diff'd) bucket-count vector, using
     the same geometric-midpoint interpolation as
-    :meth:`Histogram.quantile`; 0.0 when the vector is empty.  This is
-    how a WINDOWED p99 is computed from two :meth:`Histogram.state`
-    snapshots without any per-observation timestamping."""
+    :meth:`Histogram.quantile`.  This is how a WINDOWED p99 is computed
+    from two :meth:`Histogram.state` snapshots without any
+    per-observation timestamping.
+
+    Documented edge behavior (pinned by property tests against numpy
+    quantiles in tests/test_attrib.py):
+
+    - **empty window** (all-zero vector, or empty vector): ``0.0`` —
+      "no observations" reads as zero latency, never as an
+      interpolated fiction;
+    - **single-bucket mass**: every quantile answers that bucket's
+      geometric midpoint (the only value the histogram can still
+      distinguish — within the ~9 % bucket-resolution error);
+    - **mass in the overflow bucket** (observations at/beyond the last
+      bucket edge, ~71 min in µs): the overflow bucket's LOWER edge is
+      returned, never a midpoint interpolated off the end of the range
+      — the answer is a documented underestimate ("at least this"),
+      not an invented point in an unbounded interval.
+    """
     n = sum(counts)
     if n <= 0:
         return 0.0
+    last = len(counts) - 1
     target = q * n
     seen = 0
     for i, c in enumerate(counts):
         seen += c
         if seen >= target:
-            return _bucket_mid(i)
-    return _bucket_mid(len(counts) - 1)
+            return _bucket_lo(i) if i == last else _bucket_mid(i)
+    return _bucket_lo(last)
 
 
 def count_over_threshold(counts, threshold: float) -> int:
@@ -192,7 +233,15 @@ def count_over_threshold(counts, threshold: float) -> int:
     at-or-above ``threshold``.  Bucket boundaries are log-spaced, so the
     answer is exact up to the bucket containing the threshold (that
     bucket is counted as over iff its geometric midpoint is over) —
-    within the histogram's documented ~9 % quantile error."""
+    within the histogram's documented ~9 % quantile error.
+
+    Documented edge behavior: ``threshold <= 1`` counts everything
+    (bucket 0's lower edge is 1); an empty vector counts 0; a
+    threshold at or beyond the overflow bucket's midpoint counts 0 —
+    the histogram cannot distinguish values inside its open-ended last
+    bucket, so it makes no claim rather than a wrong one."""
+    if threshold <= 1.0:
+        return sum(counts)
     lo = _bucket_of(threshold)
     if _bucket_mid(lo) < threshold:
         lo += 1
@@ -302,7 +351,10 @@ class MetricsRegistry:
             elif isinstance(m, Counter):
                 out[key] = {"kind": "counter", "value": m.value}
             else:
-                out[key] = {"kind": "gauge", "value": m.value}
+                v = m.sample() if isinstance(m, Gauge) else m.value
+                if v is None:
+                    continue   # dead provider: dropped sample
+                out[key] = {"kind": "gauge", "value": v}
         return out
 
     # -- rendering -----------------------------------------------------------
@@ -315,7 +367,9 @@ class MetricsRegistry:
             if isinstance(m, Histogram):
                 out[key] = m.snapshot()
             else:
-                v = m.value
+                v = m.sample() if isinstance(m, Gauge) else m.value
+                if v is None:
+                    continue   # dead provider: dropped sample
                 out[key] = round(v, 4) if isinstance(v, float) else v
         for name, value in _resilience_items():
             out.setdefault(name, value)
@@ -337,8 +391,11 @@ class MetricsRegistry:
                 family(m.name, "counter", "nnstreamer_tpu counter")
                 lines.append(f"{m.name}{_label_str(m.labels)} {m.value}")
             elif isinstance(m, Gauge):
+                v = m.sample()
+                if v is None:
+                    continue   # dead provider (element stopped under
+                    #            the scrape): dropped sample, not a 500
                 family(m.name, "gauge", "nnstreamer_tpu gauge")
-                v = m.value
                 val = "NaN" if v != v else repr(round(v, 6))
                 lines.append(f"{m.name}{_label_str(m.labels)} {val}")
             elif isinstance(m, Histogram):

@@ -424,6 +424,28 @@ class Source(Element):
                             tr.trace_id, 0,
                             tr.anchor_wall_us
                             + (src_ns - tr.anchor_mono_ns) // 1000)
+                    if tr.ring is not None:
+                        # zero-duration birth marker: the frame
+                        # window's left edge for wait-state attribution
+                        # (obs/attrib.py) — the gap from here to the
+                        # first element span is source-pacing
+                        from ..obs.span import Span
+
+                        ctx = extra["nns_trace"]
+                        tid = ctx.trace_id or tr.trace_id
+                        tr.ring.append(Span(
+                            "src:" + self.name,
+                            threading.get_ident(), src_ns, 0, seq,
+                            tid))
+                        adm = extra.pop("nns_admission_ns", None)
+                        if adm is not None:
+                            # a serving source (serversrc) deferred its
+                            # admission-wait span to HERE — the one
+                            # place seq is assigned, so the span can
+                            # never mis-attach to a neighboring frame
+                            tr.annotate_span("admission-wait",
+                                             adm[0], adm[1], seq=seq,
+                                             trace_id=tid)
                 seq += 1
                 ret = self.push(buf)
                 if ret in (FlowReturn.ERROR, FlowReturn.EOS):

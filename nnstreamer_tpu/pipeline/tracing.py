@@ -113,6 +113,52 @@ def record_pool(hit: bool) -> None:
         stack[-1][4 if hit else 5] += 1
 
 
+def annotation_active() -> bool:
+    """True when a span-recording tracer owns this thread's innermost
+    live ``chain()`` — the pre-gate for state annotations, so untraced
+    hot paths never pay the clock reads an :func:`annotate` call would
+    need (one getattr + one truthiness test when off)."""
+    stack = getattr(_TLS, "stack", None)
+    if not stack:
+        return False
+    tracer = stack[-1][0]
+    return tracer is not None and tracer.ring is not None
+
+
+def annotate(state: str, start_ns: int, end_ns: int) -> None:
+    """Record a wait-state annotation span (``state:<state>``,
+    obs/attrib.py closed set) against the buffer currently in
+    ``chain()`` on this thread.  Callers pre-gate with
+    :func:`annotation_active` so the two clock reads bracketing the
+    annotated region cost nothing when tracing is off.  Used by the
+    wire framing path (``serialize``), the jit-exec dispatch
+    (``device-invoke`` / ``device-compile``) and the worker reorder
+    pusher (``reorder-wait``); the fused executor's ``enter``/``exit``
+    pairs push the same frames interpreted dispatch does, so
+    annotations emit identical state edges under both executors."""
+    stack = getattr(_TLS, "stack", None)
+    if not stack:
+        return
+    frame = stack[-1]
+    tracer = frame[0]
+    if tracer is None or tracer.ring is None:
+        return
+    seq = -1
+    trace_id = tracer.trace_id
+    buf = frame[6]
+    if buf is not None:
+        extra = buf.extra
+        seq = extra.get("nns_seq", -1)
+        ctx = extra.get("nns_trace")
+        if ctx is not None and ctx.trace_id:
+            trace_id = ctx.trace_id
+    from ..obs.span import Span
+
+    tracer.ring.append(Span("state:" + state, threading.get_ident(),
+                            start_ns, max(0, end_ns - start_ns), seq,
+                            trace_id))
+
+
 def active_frame_context() -> Dict[str, Any]:
     """Element/buffer context of this thread's innermost live traced
     ``chain()`` — the structured-logging hook (utils/log.py pulls
@@ -243,6 +289,20 @@ class Tracer:
                                   frame[1], total, seq, trace_id))
         self._record(name, total - frame[2], frame[3], frame[4],
                      frame[5], inter_ns)
+
+    def annotate_span(self, state: str, start_ns: int, end_ns: int,
+                      seq: int = -1, trace_id: int = 0) -> None:
+        """Ring-append a ``state:*`` annotation from a thread with no
+        live trace frame (queue drain, worker pusher, serversrc create).
+        No-op without span recording; callers gate on
+        ``tracer.ring is not None`` before reading any clock."""
+        if self.ring is None:
+            return
+        from ..obs.span import Span
+
+        self.ring.append(Span("state:" + state, threading.get_ident(),
+                              start_ns, max(0, end_ns - start_ns), seq,
+                              trace_id or self.trace_id))
 
     def _element_hists(self, name: str):
         hists = self._hists.get(name)

@@ -51,11 +51,12 @@ import dataclasses
 import os
 import socket
 import struct
+import time
 from typing import Any, List, Optional, Sequence
 
 import numpy as np
 
-from ..pipeline.tracing import record_copy
+from ..pipeline.tracing import annotate, annotation_active, record_copy
 from ..tensor.buffer import TensorBuffer, TensorBufferPool
 from ..tensor.info import TensorInfo
 from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
@@ -256,6 +257,7 @@ def send_tensors(sock: socket.socket, msg_type: int, buf: TensorBuffer,
     bytes are handed to the kernel straight from the source arrays —
     the serialize path's only fresh bytes are the wire header, the
     count word, and the 128-byte metas."""
+    t0 = time.monotonic_ns() if annotation_active() else 0
     parts = tensor_parts(buf)
     plen = sum(len(p) if isinstance(p, bytes) else p.nbytes for p in parts)
     header = HEADER.pack(MAGIC, msg_type, client_id, seq, pts, epoch_us,
@@ -263,6 +265,10 @@ def send_tensors(sock: socket.socket, msg_type: int, buf: TensorBuffer,
                          _parts_crc(parts), plen)
     record_copy(len(header))   # header+metas are the copy budget
     record_copy(4 + META_HEADER_SIZE * buf.num_tensors)
+    if t0:
+        # framing/CRC is serialize; the sendmsg below is transfer time
+        # and stays in the enclosing element span (wire)
+        annotate("serialize", t0, time.monotonic_ns())
     sendmsg_all(sock, [header] + parts)
 
 
@@ -288,6 +294,7 @@ def decode_tensors(payload) -> List[np.ndarray]:
     stay non-writable; under the sanitizer (``NNS_DEBUG=1``) a write
     attempt raises a contract-naming AliasingError instead of numpy's
     bare read-only ValueError (analysis/sanitizer.py guard_readonly)."""
+    t0 = time.monotonic_ns() if annotation_active() else 0
     (n,) = struct.unpack_from("<I", payload, 0)
     off = 4
     tensors = []
@@ -308,6 +315,8 @@ def decode_tensors(payload) -> List[np.ndarray]:
         if guard:
             arr = _san.guard_readonly(arr)
         tensors.append(arr)
+    if t0:
+        annotate("serialize", t0, time.monotonic_ns())
     return tensors
 
 

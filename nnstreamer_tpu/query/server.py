@@ -291,6 +291,16 @@ class QueryServer:
         thread can dequeue and reply the instant the frame lands, and
         a decrement racing ahead of the increment would leave a
         permanent +1 skew that makes drain() time out forever."""
+        tracer = self.obs_tracer
+        if tracer is not None and tracer.ring is not None:
+            # wait-state attribution (obs/attrib.py): arrival stamp so
+            # the serversrc can annotate this frame's admission-wait —
+            # the time it sat in the bounded queue before the serving
+            # pipeline picked it up.  Untraced servers pay one attr
+            # read + None test per frame.
+            from ..obs.clock import mono_ns
+
+            buf.extra["nns_enq_ns"] = mono_ns()
         with self._drain_cv:
             self._inflight += 1
         while not self._stop.is_set():
@@ -582,9 +592,23 @@ class TensorQueryServerSrc(Source):
     def create(self) -> Optional[TensorBuffer]:
         while not self._halted.is_set():
             try:
-                return self.server.incoming.get(timeout=0.1)
+                buf = self.server.incoming.get(timeout=0.1)
             except _queue.Empty:
                 continue
+            pl = self.pipeline
+            if pl is not None and pl.tracer is not None:
+                enq = buf.extra.pop("nns_enq_ns", None)
+                if enq is not None and pl.tracer.ring is not None:
+                    # admission-wait: arrival → dequeue.  The span is
+                    # DEFERRED to Source._loop, which emits it at the
+                    # one place the frame's seq is assigned — no shadow
+                    # counter to keep in lockstep.  The T_TRACE
+                    # piggyback then carves it out of the client's
+                    # wire time.
+                    from ..obs.clock import mono_ns
+
+                    buf.extra["nns_admission_ns"] = (enq, mono_ns())
+            return buf
         return None
 
 

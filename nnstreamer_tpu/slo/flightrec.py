@@ -17,6 +17,10 @@ onset (``on_breach``):
                           the breach neighborhood) — open in Perfetto
     ``breach.json``     — the triggering evaluation (both windows'
                           burn-rate evidence)
+    ``blame.json``      — wait-state attribution summary of the breach
+                          window's spans (obs/attrib.py): which states
+                          ate the breaching frames' time, without
+                          opening the trace
     ``metrics_timeline.jsonl`` — one line per recorded tick: metric
                           snapshot + objective burn rates (the time
                           series leading INTO the breach)
@@ -116,6 +120,15 @@ class FlightRecorder:
         if self.tracer is not None and \
                 getattr(self.tracer, "ring", None) is not None:
             _write("trace.json", self.tracer.chrome_trace())
+            from ..obs.profile import attribution_block
+
+            blame = attribution_block(self.tracer)
+            if blame:
+                # breach-window wait-state blame (obs/attrib.py): the
+                # ring holds the breach neighborhood, so this names the
+                # states that ate the breaching frames' time without
+                # opening the Chrome trace
+                _write("blame.json", blame)
         _write("metrics_timeline.jsonl", timeline)
         _write("metrics_final.json", self.registry.report())
         manifest = {"tag": tag, "wall_us": wall_us(),

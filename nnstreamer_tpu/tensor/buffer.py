@@ -442,10 +442,24 @@ class TensorBuffer:
 
     def np(self, i: int = 0) -> np.ndarray:
         """Materialize tensor ``i`` on host (device sync happens HERE and
-        only here)."""
+        only here).  Under a span-recording tracer the blocking wait on
+        a device array — pending async compute + d2h transfer — records
+        as a ``device-invoke`` state span (obs/attrib.py): the dispatch
+        annotation alone measures only the async enqueue, and the real
+        device time would otherwise be misattributed to whichever
+        element happened to materialize the output (serialize/decoder)."""
         t = self.tensors[i]
         if isinstance(t, np.ndarray):
             return t
+        from ..pipeline import tracing
+
+        if tracing.annotation_active():
+            import time as _time
+
+            t0 = _time.monotonic_ns()
+            out = np.asarray(t)
+            tracing.annotate("device-invoke", t0, _time.monotonic_ns())
+            return out
         return np.asarray(t)
 
     def nbytes(self) -> int:

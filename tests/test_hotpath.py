@@ -509,6 +509,24 @@ def test_hotpath_bench_obs_gate():
 
 
 @pytest.mark.perf
+def test_hotpath_bench_profile_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage profile fails
+    when an untraced compiled plan references profiler/attribution
+    state (extended PR 5 obs-ref scan) or when pure-dispatch overhead
+    after a full profile session exceeds 2% of the never-profiled
+    baseline — profiling is a per-pipeline session, never a process
+    tax."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "profile"],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (
+        f"profile gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_profile_gate"' in r.stdout
+
+
+@pytest.mark.perf
 def test_hotpath_bench_admit_gate():
     """CI gate: tools/hotpath_bench.py --assert --stage admit fails
     when the un-overloaded admission decision (query/overload.py —

@@ -173,13 +173,21 @@ class TestMetricsRegistry:
         box["v"] = 5
         assert reg.report()["g"] == 5
 
-    def test_dead_gauge_provider_does_not_break_scrape(self):
+    def test_dead_gauge_provider_is_a_dropped_sample(self):
+        """A provider whose element tore down must yield a DROPPED
+        sample: the scrape succeeds, live metrics still render, and
+        the dead gauge simply emits no line (not NaN, not a 500)."""
         reg = MetricsRegistry()
 
         def boom():
             raise RuntimeError("stopped element")
         reg.gauge("g", fn=boom)
-        assert "g NaN" in reg.render_prometheus()
+        reg.gauge("alive", fn=lambda: 7.0)
+        body = reg.render_prometheus()
+        assert "alive 7.0" in body
+        assert "\ng " not in body and not body.startswith("g ")
+        assert "g" not in reg.report()
+        assert reg.gauge("g").sample() is None
 
     def test_register_replaces(self):
         reg = MetricsRegistry()
@@ -350,13 +358,20 @@ class TestTracerObservability:
     def test_spans_recorded_with_seq_and_trace_id(self):
         _, tracer = _run_traced_pipeline(spans=True)
         spans = tracer.ring.snapshot()
+        # zero-duration src: birth markers anchor each frame's window
+        # for wait-state attribution (obs/attrib.py); element spans
+        # carry real durations
+        markers = [s for s in spans if s.name.startswith("src:")]
+        assert markers and all(s.dur_ns == 0 for s in markers)
         by_el = {}
         for s in spans:
-            by_el.setdefault(s.name, []).append(s)
+            if not s.name.startswith("src:"):
+                by_el.setdefault(s.name, []).append(s)
         assert set(by_el) == {"t", "out"}
         assert all(s.trace_id == tracer.trace_id for s in spans)
         assert sorted(s.seq for s in by_el["out"]) == list(range(20))
-        assert all(s.dur_ns > 0 for s in spans)
+        assert all(s.dur_ns > 0 for s in spans
+                   if not s.name.startswith("src:"))
 
     def test_counters_only_mode_records_no_spans(self):
         _, tracer = _run_traced_pipeline(spans=False)

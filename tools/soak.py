@@ -453,6 +453,15 @@ def main(argv=None) -> int:
         verdict = evaluator.verdict()
         verdict["status"] = "live"
         verdict["loadgen"] = summary
+        from nnstreamer_tpu.obs.profile import attribution_block
+
+        attribution = attribution_block(tracer)
+        if attribution:
+            # where the serving pipeline's frame time went during the
+            # soak (wait-state blame, obs/attrib.py): the queueing
+            # states here should explain any slo-vs-service latency
+            # divergence the objectives saw
+            verdict["attribution"] = attribution
         verdict["chaos"] = schedule.log
         verdict["flight_recorder"] = {"bundles": recorder.dumps}
         if overload:
@@ -489,6 +498,10 @@ def main(argv=None) -> int:
             "bundles": recorder.dumps,
             "artifact": os.path.join(args.out, "verdict.json"),
         }
+        if attribution:
+            line["attribution"] = {
+                "top": attribution["top"],
+                "attributed_pct": attribution["attributed_pct"]}
         if "overload" in verdict:
             ov = verdict["overload"]
             line["overload"] = {
