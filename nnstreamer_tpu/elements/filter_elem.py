@@ -36,6 +36,94 @@ def _parse_combination(s) -> Optional[List[int]]:
     return [int(x) for x in str(s).split(",")]
 
 
+class CrossStreamBatcher:
+    """Bucket/dispatch core of the ``batch-timeout-ms`` coalescer.
+
+    Extracted from :class:`TensorFilter`'s micro-batch discipline so the
+    query serving plane reuses the exact same rules for CROSS-STREAM
+    continuous batching (``query/server.py``): a collecting bucket of
+    opaque items dispatches when it FILLS (``add`` returns True) or when
+    the earliest resident deadline expires.  Deadlines are PER ITEM —
+    each ``add`` may carry its own residency budget (the QoS lever:
+    ``query/overload.py bucket_budget`` gives gold a quarter of the
+    configured timeout, so a gold frame landing in a bucket that bronze
+    traffic opened pulls the dispatch deadline in) — and the bucket's
+    effective deadline is the minimum over residents.
+
+    Threadless by design: the owner supplies the waiting and the
+    dispatch.  ``tensor_filter`` pairs it with its deadline-watcher
+    thread (push-style producers); ``tensor_query_serversrc`` drives it
+    from its own source thread's blocking collect loop (pull-style).
+    Not itself thread-safe — callers serialize ``add``/``take`` under
+    their own coalesce lock where producers and watchers race.
+    """
+
+    __slots__ = ("capacity", "timeout_s", "items", "_t0", "_deadline",
+                 "_clock")
+
+    def __init__(self, capacity: int, timeout_s: float = 0.0,
+                 clock=None) -> None:
+        import time as _time
+
+        self.capacity = max(1, int(capacity))
+        self.timeout_s = max(0.0, float(timeout_s))
+        self._clock = clock if clock is not None else _time.monotonic
+        self.items: list = []
+        self._t0: Optional[float] = None       # arrival of oldest item
+        self._deadline: Optional[float] = None  # min(arrival + budget)
+
+    @property
+    def fill(self) -> int:
+        return len(self.items)
+
+    def full(self) -> bool:
+        return len(self.items) >= self.capacity
+
+    def opened_at(self) -> Optional[float]:
+        """Arrival time of the oldest resident item (None when empty)."""
+        return self._t0
+
+    def deadline(self) -> Optional[float]:
+        """Absolute dispatch deadline (None when empty)."""
+        return self._deadline if self.items else None
+
+    def add(self, item, budget_s: Optional[float] = None) -> bool:
+        """Append one item; returns True when the bucket is now full
+        (caller must dispatch).  ``budget_s`` overrides the bucket-wide
+        ``timeout_s`` for this item's residency deadline."""
+        now = self._clock()
+        if not self.items:
+            self._t0 = now
+        budget = self.timeout_s if budget_s is None else max(0.0, budget_s)
+        deadline = now + budget
+        if self._deadline is None or deadline < self._deadline:
+            self._deadline = deadline
+        self.items.append(item)
+        return len(self.items) >= self.capacity
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True when a resident item's budget has run out (caller must
+        dispatch the partial bucket)."""
+        if not self.items or self._deadline is None:
+            return False
+        return (self._clock() if now is None else now) >= self._deadline
+
+    def remaining(self, now: Optional[float] = None) -> float:
+        """Seconds until the earliest resident deadline (0 when expired,
+        +inf when empty)."""
+        if not self.items or self._deadline is None:
+            return float("inf")
+        return max(0.0, self._deadline
+                   - (self._clock() if now is None else now))
+
+    def take(self) -> list:
+        """Pop every resident item (bucket order) and reset."""
+        items, self.items = self.items, []
+        self._t0 = None
+        self._deadline = None
+        return items
+
+
 @register_element
 class TensorFilter(Element):
     FACTORY = "tensor_filter"
@@ -287,9 +375,18 @@ class TensorFilter(Element):
                 f"{self.name}: custom=mesh:dp=N requires micro-batching "
                 f"(set batch= to a multiple of dp); per-frame dispatch "
                 "cannot shard")
-        self._pending: list = []        # per-frame input lists, collecting
-        self._pending_bufs: list = []
-        self._pending_t0 = 0.0          # arrival of the oldest pending frame
+        # collecting bucket of (tensors, buf) pairs — the shared
+        # bucket/dispatch core (also driven by the query serving
+        # plane's cross-stream batcher)
+        self._bucket = CrossStreamBatcher(
+            self._batch, max(0.0, float(self.batch_timeout_ms or 0)) / 1e3)
+        # cross-stream batch accounting: invokes/frames served through
+        # pre-batched buffers (query/server.py buckets) — feeds the
+        # nns_mfu frame-rate math, which would otherwise undercount a
+        # bucket of n frames as one
+        self._xb_invokes = 0
+        self._xb_frames = 0
+        self._xb_warm = 0      # capacity whose pad shapes are compiled
         # FIFO of dispatched (bufs, handle, t0) batches; stream order is
         # the queue order.  Depth 1 keeps the historical double-buffering
         # (one collecting + one dispatched)
@@ -319,6 +416,7 @@ class TensorFilter(Element):
             ml_logw("%s: batch-timeout-ms needs micro-batching (batch>1);"
                     " ignored", self.name)
             self._batch_deadline = 0.0
+        self._bucket.timeout_s = self._batch_deadline
         import threading
 
         from ..analysis.sanitizer import make_lock
@@ -370,8 +468,14 @@ class TensorFilter(Element):
                             for n, f in (
             ("nns_filter_batch_size", lambda: self._batch),
             ("nns_filter_inflight", lambda: len(self._inflight)),
-            ("nns_filter_pending", lambda: len(self._pending)),
-            ("nns_filter_dropped", lambda: self.dropped))]
+            ("nns_filter_pending", lambda: self._bucket.fill),
+            ("nns_filter_dropped", lambda: self.dropped),
+            # cross-stream (pre-batched) traffic: shared invokes and the
+            # frames they served — batched-vs-solo evidence for the
+            # profiler (query/server.py bucket dispatch counters are the
+            # serving-plane side of the same story)
+            ("nns_filter_xbatch_invokes", lambda: self._xb_invokes),
+            ("nns_filter_xbatch_frames", lambda: self._xb_frames))]
         self._register_device_gauges(labels)
 
     def _register_device_gauges(self, labels) -> None:
@@ -405,8 +509,13 @@ class TensorFilter(Element):
                 if st is None:
                     return 0.0
                 # frames ~= invokes x micro-batch (batched dispatch
-                # records one stat per bucket; exact at batch=1)
-                frames = st.total_invokes * max(1, el._batch)
+                # records one stat per bucket; exact at batch=1).
+                # Cross-stream buckets record one stat per shared
+                # invoke but serve a VARIABLE fill — count their real
+                # frames, or the MFU of a batching server understates
+                # by the fill factor
+                frames = ((st.total_invokes - el._xb_invokes)
+                          * max(1, el._batch) + el._xb_frames)
                 now = _time.monotonic()
                 prev_f, prev_t = state["frames"], state["t"]
                 state["frames"], state["t"] = frames, now
@@ -538,6 +647,14 @@ class TensorFilter(Element):
             # so neither a mid-stream batch nor the EOS flush tail does
             self._rewarm = False
             fw.warmup_batched(self._batch)
+        xb = buf.extra.get("nns_xbatch")
+        if xb is not None:
+            # cross-stream batch (query/server.py bucket): the frames
+            # arrive pre-coalesced, stacked along a leading axis — one
+            # shared device invoke serves the whole client population.
+            # Pre-batched traffic supersedes local micro-batching and
+            # the worker pool (it IS the batching).
+            return self.push(self._invoke_xbatch(buf, xb))
         tensors = self._preprocess(buf)
         if tensors.__class__ is FlowReturn:
             return tensors
@@ -569,6 +686,12 @@ class TensorFilter(Element):
         fw = self.fw
         if fw is None or not fw.opened:
             raise RuntimeError(f"{self.name}: not started")
+        xb = buf.extra.get("nns_xbatch")
+        if xb is not None:
+            # a cross-stream bucket traverses the fused segment as ONE
+            # plan execution — the per-frame dispatch tax is paid once
+            # per bucket, and the device sees the whole tile
+            return self._invoke_xbatch(buf, xb)
         tensors = self._preprocess(buf)
         if tensors.__class__ is FlowReturn:
             return tensors
@@ -576,6 +699,75 @@ class TensorFilter(Element):
             outs = fw.invoke(list(tensors), emit_device=True)
         else:
             outs = fw.invoke(list(tensors))
+        return self._compose_output(buf, list(outs))
+
+    def _invoke_xbatch(self, buf: TensorBuffer, xb) -> TensorBuffer:
+        """One shared device invoke for a cross-stream batch buffer
+        (``buf.extra["nns_xbatch"]``, query/server.py): tensors are
+        pre-stacked ``(n, *frame_shape)`` rows from up to ``xb.capacity``
+        client streams.  A batching-capable backend dispatches them
+        through the padded-bucket executable
+        (:meth:`~nnstreamer_tpu.filter.backends._jitexec.JitExecMixin.
+        invoke_stacked` — one warm shape regardless of fill); others
+        fall back to a row-wise invoke loop (correct, not faster).
+
+        No QoS throttle-drop here: every row is an ADMITTED client
+        request — silently dropping one would violate the overload
+        plane's every-refusal-is-explicit invariant (a drop would strand
+        its client's reply, not shed it)."""
+        in_info = self._in_config.info
+        if buf.num_tensors != in_info.num_tensors:
+            raise ValueError(
+                f"{self.name}: batch buffer has {buf.num_tensors} "
+                f"tensors, negotiated {in_info.num_tensors}")
+        tensors = buf.tensors
+        if self._in_comb is not None:
+            tensors = [tensors[i] for i in self._in_comb]
+        fw = self.fw
+        n = xb.n
+        pl = self.pipeline
+        tracer = pl.tracer if pl is not None else None
+        rec = tracer is not None and tracer.ring is not None
+        t0 = 0
+        if rec:
+            import time as _time
+
+            t0 = _time.monotonic_ns()
+        if getattr(fw, "SUPPORTS_BATCHING", False) \
+                and hasattr(fw, "invoke_stacked"):
+            if self._xb_warm != xb.capacity:
+                # first bucket (or a capacity change): pre-compile every
+                # pad shape NOW, not one compile-stall per shape spread
+                # across the serving steady state
+                fw.warmup_stacked(xb.capacity)
+                self._xb_warm = xb.capacity
+            outs = fw.invoke_stacked(list(tensors), n,
+                                     capacity=xb.capacity,
+                                     emit_device=self._emit_device)
+        else:
+            import numpy as _np
+
+            rows = [fw.invoke([t[i] for t in tensors]) for i in range(n)]
+            outs = [_np.stack([_np.asarray(r[k]) for r in rows])
+                    for k in range(len(rows[0]))]
+        self._xb_invokes += 1
+        self._xb_frames += n
+        if rec:
+            import time as _time
+
+            t1 = _time.monotonic_ns()
+            # the SHARED dispatch window, once per resident client trace:
+            # each client's merged timeline shows its frame inside the
+            # same device-invoke span its bucket peers overlap
+            # (obs/attrib.py — per-frame wall-clock truth, not a 1/n
+            # share).  The materialization sync point (TensorBuffer.np
+            # at the reply split) extends this with the real device time.
+            seq = buf.extra.get("nns_seq", -1)
+            for extra in xb.extras:
+                ctx = extra.get("nns_trace")
+                if ctx is not None and ctx.trace_id:
+                    tracer.annotate_span("device-invoke", t0, t1,
+                                         seq=seq, trace_id=ctx.trace_id)
         return self._compose_output(buf, list(outs))
 
     def _compose_output(self, buf: TensorBuffer, outs) -> TensorBuffer:
@@ -775,10 +967,6 @@ class TensorFilter(Element):
         """Append one frame to the collecting bucket; dispatch when it
         fills.  Caller holds the coalesce lock when the deadline watcher
         is active."""
-        if not self._pending:
-            import time
-
-            self._pending_t0 = time.monotonic()
         pl = self.pipeline
         if pl is not None and pl.tracer is not None \
                 and pl.tracer.ring is not None:
@@ -789,9 +977,7 @@ class TensorFilter(Element):
             import time
 
             buf.extra["nns_coll_ns"] = time.monotonic_ns()
-        self._pending.append(list(tensors))
-        self._pending_bufs.append(buf)
-        if len(self._pending) >= self._batch:
+        if self._bucket.add((list(tensors), buf)):
             return self._dispatch_pending()
         return FlowReturn.OK
 
@@ -800,21 +986,22 @@ class TensorFilter(Element):
         is at depth — push the OLDEST batch's results (d2h copies of
         every queued batch overlap this batch's collection; deeper
         queues overlap more dispatch round-trips)."""
-        if self._pending_bufs and "nns_coll_ns" in \
-                self._pending_bufs[0].extra:
+        t0 = self._bucket.opened_at()
+        items = self._bucket.take()
+        pending = [tensors for tensors, _ in items]
+        bufs = [b for _, b in items]
+        if bufs and "nns_coll_ns" in bufs[0].extra:
             import time
 
             d0 = time.monotonic_ns()
-            for b in self._pending_bufs:
+            for b in bufs:
                 b.extra["nns_disp_ns"] = d0
         if self._emit_device:
-            handle = self.fw.invoke_batched(self._pending, self._batch,
+            handle = self.fw.invoke_batched(pending, self._batch,
                                             emit_device=True)
         else:
-            handle = self.fw.invoke_batched(self._pending, self._batch)
-        self._inflight.append((self._pending_bufs, handle,
-                               self._pending_t0))
-        self._pending, self._pending_bufs = [], []
+            handle = self.fw.invoke_batched(pending, self._batch)
+        self._inflight.append((bufs, handle, t0))
         if len(self._inflight) > self._inflight_depth:
             return self._push_inflight(self._inflight.popleft())
         return FlowReturn.OK
@@ -883,9 +1070,7 @@ class TensorFilter(Element):
         Caller holds the coalesce lock."""
         if self._inflight:
             return self._inflight[0][2]
-        if self._pending:
-            return self._pending_t0
-        return None
+        return self._bucket.opened_at()
 
     def _flush_expired(self, now: float) -> None:
         """Push every batch whose oldest frame's budget expired, oldest
@@ -898,7 +1083,7 @@ class TensorFilter(Element):
                     is FlowReturn.ERROR:
                 raise RuntimeError(
                     f"{self.name}: downstream error on deadline flush")
-        if self._pending and now - self._pending_t0 >= to:
+        if self._bucket.expired(now):
             # _dispatch_pending may itself push an over-depth batch:
             # its ERROR must propagate like the loop pushes' do
             if self._dispatch_pending() is FlowReturn.ERROR:
@@ -925,7 +1110,7 @@ class TensorFilter(Element):
 
     def _drain_batches_locked(self) -> None:
         ret = FlowReturn.OK
-        if self._pending:
+        if self._bucket.fill:
             ret = self._dispatch_pending()
         while self._inflight:
             r = self._push_inflight(self._inflight.popleft())

@@ -365,6 +365,95 @@ class JitExecMixin:
             annotate("device-compile" if cold else "device-invoke", t0, t1)
         return BatchHandle(list(outs), n)
 
+    @staticmethod
+    def pad_rows(n: int, capacity: int = 0) -> int:
+        """Quantized pad target for an ``n``-row partial bucket: next
+        power of two up to 8, then multiples of 8, capped at
+        ``capacity`` — waste <= 7 rows above 8 (pow2 all the way up
+        would charge a 33-row fill a 64-row tile) and the executable
+        count stays bounded at ``4 + capacity/8``."""
+        cap = max(int(capacity), n, 1)
+        if n <= 8:
+            bucket = 1
+            while bucket < n:
+                bucket <<= 1
+        else:
+            bucket = (n + 7) & ~7
+        return min(bucket, cap)
+
+    def warmup_stacked(self, capacity: int) -> None:
+        """Pre-compile EVERY padded-bucket executable shape a
+        ``capacity``-sized cross-stream bucket can dispatch
+        (:meth:`pad_rows` quantization).  Called once, off the steady
+        state (tensor_filter does it on the first bucket it sees):
+        without this, each pad shape's first live bucket stalls the
+        serving thread for a full XLA compile — seconds-long latency
+        spikes landing mid-soak, exactly the tail a latency SLO
+        notices."""
+        import jax
+
+        in_info, _ = self.get_model_info()
+        shapes = sorted({self.pad_rows(n, capacity)
+                         for n in range(1, max(1, int(capacity)) + 1)})
+        for rows in shapes:
+            zeros = [np.zeros((rows,) + i.np_shape, i.np_dtype)
+                     for i in in_info]
+            jax.block_until_ready(self._dispatch_batched(zeros))
+
+    def invoke_stacked(self, stacked: List[Any], n: int,
+                       capacity: int = 0,
+                       emit_device: bool = False) -> List[Any]:
+        """Cross-stream batched invoke over PRE-STACKED ``(n, …)``
+        inputs (the query serving plane's bucket, query/server.py): pad
+        axis 0 up to the next power of two (capped at ``capacity``) so
+        a BOUNDED set of at most ``log2(capacity)+1`` vmapped
+        executables serves every partial fill — a fill-dependent
+        dispatch shape would JIT-compile once per distinct fill (up to
+        ``capacity`` compiles, each multi-second on a real chip) and a
+        fill-sized cache would thrash on bursty traffic, while padding
+        straight to ``capacity`` would charge a quarter-full bucket the
+        whole tile's FLOPs.  Power-of-two padding bounds the waste at
+        <2x the live rows and each shape is warm after its first use.
+        Padding repeats the last live row (the same policy
+        :meth:`_stage_batch` applies) and is sliced away by the caller
+        (rows past ``n`` are never replied — tensor/buffer.py
+        XBatchMeta).
+
+        Returns the PADDED stacked outputs as device handles with async
+        d2h transfers started (``emit_device=False``): the split point
+        materializes each output once per bucket and hands out zero-copy
+        row views, so the whole bucket pays one sync."""
+        import jax.numpy as jnp
+
+        bucket = self.pad_rows(n, capacity)
+        padded = []
+        for arr in stacked:
+            arr = arr.device_slice() if isinstance(arr, BatchView) else arr
+            rows = int(arr.shape[0])
+            if rows < bucket:
+                if is_device_array(arr):
+                    arr = self._ensure_device(arr)
+                    pad = arr[-1:]
+                    arr = jnp.concatenate(
+                        [arr, jnp.broadcast_to(
+                            pad, (bucket - rows,) + tuple(pad.shape[1:]))],
+                        axis=0)
+                else:
+                    arr = np.asarray(arr)
+                    arr = np.concatenate(
+                        [arr, np.broadcast_to(
+                            arr[-1:],
+                            (bucket - rows,) + arr.shape[1:])], axis=0)
+            padded.append(arr)
+        cold = self._vjit is None
+        t0 = time.monotonic_ns()
+        outs = self._dispatch_batched(padded, emit_device=emit_device)
+        t1 = time.monotonic_ns()
+        self.stats.record(t1 - t0)
+        if annotation_active():
+            annotate("device-compile" if cold else "device-invoke", t0, t1)
+        return list(outs)
+
     def _stage_batch(self, arrs, bucket: int):
         """One input's frames → one ``(bucket, …)`` batch array.
 

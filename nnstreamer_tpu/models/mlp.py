@@ -1,0 +1,77 @@
+"""Pure-matmul MLP — the batching-efficiency probe model.
+
+The cross-stream batching dispatcher (query/server.py) exists to turn
+per-frame GEMV-shaped serving into full-tile GEMM-shaped serving.  This
+model makes that effect directly measurable on any host: its FLOPs are
+entirely dense matmuls, so the per-row cost of a batched invoke drops
+exactly as much as the platform's GEMM beats its GEMV (MXU tiles on
+TPU, BLAS kernels on the CPU test hosts) — no conv/batch-norm noise in
+the measurement.  ``tools/soak.py --xbatch`` serves it behind per-frame
+and batching query servers and commits the ratio.
+
+Sizing is configurable through custom props so benches can pick an
+arithmetic intensity that suits the host::
+
+    tensor_filter framework=xla model=mlp custom=width:1024,depth:4
+
+- input: ``(in_dim,)`` float32 (default 64 — small on the wire, so the
+  loopback transport never becomes the bottleneck being measured);
+- ``depth`` hidden layers of ``width``×``width`` matmuls with a relu
+  (the FLOP body);
+- output: ``(out_dim,)`` float32 logits (default 16).
+
+Weights are deterministic random (``seed`` custom prop).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.info import TensorInfo, TensorsInfo
+from ..tensor.types import TensorType
+from .registry import Model, host_init, register_model
+
+
+def build_mlp(custom: Dict[str, str]) -> Model:
+    in_dim = int(custom.get("in_dim", 64))
+    width = int(custom.get("width", 1024))
+    depth = int(custom.get("depth", 4))
+    out_dim = int(custom.get("out_dim", 16))
+    seed = int(custom.get("seed", 0))
+    if min(in_dim, width, depth, out_dim) < 1:
+        raise ValueError("mlp: in_dim/width/depth/out_dim must be >= 1")
+
+    def _init():
+        key = jax.random.PRNGKey(seed)
+        dims = [in_dim] + [width] * depth + [out_dim]
+        layers = []
+        for i, (a, b) in enumerate(zip(dims, dims[1:])):
+            key, wk = jax.random.split(key)
+            layers.append({
+                "w": jax.random.normal(wk, (a, b), jnp.float32)
+                * (1.0 / jnp.sqrt(a)),
+                "b": jnp.zeros((b,), jnp.float32)})
+        return {"layers": layers}
+
+    params = host_init(_init)
+
+    def forward(p, x):
+        # unbatched frame contract: x is (in_dim,).  Row-vector matmuls
+        # keep the batched executable (vmap over axis 0) a plain GEMM.
+        h = x
+        layers = p["layers"]
+        for layer in layers[:-1]:
+            h = jax.nn.relu(h @ layer["w"] + layer["b"])
+        out = h @ layers[-1]["w"] + layers[-1]["b"]
+        return (out,)
+
+    in_info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (in_dim,))])
+    out_info = TensorsInfo([TensorInfo(TensorType.FLOAT32, (out_dim,))])
+    return Model(name="mlp", forward=forward, params=params,
+                 in_info=in_info, out_info=out_info)
+
+
+register_model("mlp")(build_mlp)

@@ -113,8 +113,8 @@ def graft_params(dst, src):
 
 
 def _ensure_loaded() -> None:
-    from . import (mobilenet_v2, ssd, deeplab_v3, posenet,  # noqa: F401
-                   streamformer_lm, vit)  # noqa: F401
+    from . import (mlp, mobilenet_v2, ssd, deeplab_v3,  # noqa: F401
+                   posenet, streamformer_lm, vit)  # noqa: F401
 
 
 def get_model(name: str, custom_props: Optional[Dict[str, str]] = None) -> Model:

@@ -20,14 +20,23 @@ lifetime maps to exactly one):
 ``serialize``             wire framing / tensor decode (protocol.py
                           annotations)
 ``queue-wait``            inside a ``queue`` element's chain (full-queue
-                          backpressure) or the residency gap crossing a
-                          queue thread boundary
+                          backpressure), the residency gap crossing a
+                          queue thread boundary, or a frame's residency
+                          in a COLLECTING batch bucket (tensor_filter
+                          micro-batch collect→dispatch, and the
+                          cross-stream bucket behind a batching
+                          tensor_query_serversrc — query/server.py)
 ``admission-wait``        server side: frame sat in the bounded incoming
                           queue before the serving pipeline picked it up
 ``wire``                  inside ``tensor_query_client``'s round trip,
                           minus everything the server's merged timeline
                           accounts for (transfer + protocol time)
-``device-invoke``         jitted executable dispatch (_jitexec annotation)
+``device-invoke``         jitted executable dispatch (_jitexec
+                          annotation).  Under cross-stream batching the
+                          window is SHARED: every frame of a bucket
+                          annotates the same dispatch+materialization
+                          interval — per-frame wall-clock truth, not a
+                          1/n share
 ``device-compile``        first-call JIT compilation (split from invoke)
 ``reorder-wait``          a finished result holding for stream order
                           (filter worker pool's strict-seq pusher)

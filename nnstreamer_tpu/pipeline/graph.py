@@ -209,7 +209,13 @@ class Pipeline:
             self.health_state, label=f"pipeline:{self.name}")
         for el in self.elements:
             if isinstance(el, Source):
-                el._spawn()
+                try:
+                    el._spawn()
+                except Exception as exc:  # noqa: BLE001
+                    # SYNC_NEGOTIATE sources negotiate HERE: a caps
+                    # failure surfaces as the same PipelineError start()
+                    # failures do, not as a raw ValueError
+                    raise PipelineError(el, exc) from exc
 
     def health_state(self) -> str:
         """Readiness state for /healthz (obs/httpd.py): the lifecycle
@@ -370,10 +376,21 @@ class Source(Element):
     mirroring GstPushSrc's create vfunc (reference datareposrc/srciio use
     this model)."""
 
+    #: sources whose negotiate() is pure (no I/O, no blocking) announce
+    #: caps from play()'s thread in _spawn, BEFORE the streaming thread
+    #: exists.  An app that calls element.push() right after play()
+    #: otherwise races the loop thread's announcement and can reach a
+    #: downstream chain() before set_caps() negotiated (seen as a flaky
+    #: AttributeError on tensor_filter._in_config under suite load).
+    #: Network-backed sources keep the in-thread announce: their
+    #: negotiate() may block on a peer and must not stall play().
+    SYNC_NEGOTIATE = False
+
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self._thread: Optional[threading.Thread] = None
         self._halted = threading.Event()
+        self._caps_announced = False
 
     def negotiate(self) -> Caps:
         raise NotImplementedError
@@ -383,6 +400,10 @@ class Source(Element):
 
     def _spawn(self) -> None:
         self._halted.clear()
+        self._caps_announced = False
+        if self.SYNC_NEGOTIATE:
+            self.announce_src_caps(self.negotiate())
+            self._caps_announced = True
         self._thread = threading.Thread(target=self._loop,
                                         name=f"src:{self.name}", daemon=True)
         self._thread.start()
@@ -394,8 +415,10 @@ class Source(Element):
 
     def _loop(self) -> None:
         try:
-            caps = self.negotiate()
-            self.announce_src_caps(caps)
+            if not self._caps_announced:
+                caps = self.negotiate()
+                self.announce_src_caps(caps)
+                self._caps_announced = True
             seq = 0
             while not self._halted.is_set():
                 buf = self.create()
@@ -446,6 +469,18 @@ class Source(Element):
                             tr.annotate_span("admission-wait",
                                              adm[0], adm[1], seq=seq,
                                              trace_id=tid)
+                        xb_spans = extra.pop("nns_xb_spans", None)
+                        if xb_spans is not None:
+                            # a cross-stream bucket carries PER-FRAME
+                            # residency spans (admission-wait +
+                            # queue-wait, query/server.py): emitted
+                            # under the batch buffer's seq, each with
+                            # its own client's trace id so the T_TRACE
+                            # piggyback routes it to the right merged
+                            # timeline
+                            for state, s0, s1, stid in xb_spans:
+                                tr.annotate_span(state, s0, s1, seq=seq,
+                                                 trace_id=stid or tid)
                 seq += 1
                 ret = self.push(buf)
                 if ret in (FlowReturn.ERROR, FlowReturn.EOS):
@@ -673,6 +708,9 @@ class AppSrc(Source):
 
     FACTORY = "appsrc"
     PROPERTIES = {"caps": (None, "fixed caps to announce")}
+    #: caps come from a property — negotiation is pure, so it runs in
+    #: play() before the app can push() (Source.SYNC_NEGOTIATE contract)
+    SYNC_NEGOTIATE = True
 
     #: in-band wake marker: create() blocks on the fifo with NO timeout
     #: (event-driven, zero idle wakeups); unblock()/_halt() enqueue this

@@ -123,11 +123,19 @@ class SegmentPlanner:
     def plans(self) -> List[Dict]:
         """Snapshot of the compiled plans (observability / tests / bench):
         one dict per fused segment with ``head``, ``elements`` (fused
-        element names in order) and ``tail`` (the boundary element the
-        segment pushes into)."""
+        element names in order), ``tail`` (the boundary element the
+        segment pushes into) and ``dispatches`` (plan executions so
+        far — a cross-stream batch buffer of N frames counts ONE: the
+        whole bucket traverses the fused segment as a single plan
+        execution, which is exactly the per-frame dispatch tax the
+        serving-plane batcher amortizes)."""
         with self._lock:
-            return [{k: v for k, v in p.items() if not k.startswith("_")}
-                    for p in self._plans.values()]
+            out = []
+            for p in self._plans.values():
+                row = {k: v for k, v in p.items() if not k.startswith("_")}
+                row["dispatches"] = p["_count"][0]
+                out.append(row)
+            return out
 
     # -- graph walk ----------------------------------------------------------
     def _find_heads(self) -> List[Pad]:
@@ -181,7 +189,8 @@ class SegmentPlanner:
                 # renegotiation can still make the run fusable)
                 head.__dict__.pop("push", None)
                 return lambda buf, _h=head: Pad.push(_h, buf)
-            executor = self._make_executor(head, steps, tail_pad)
+            count = [0]
+            executor = self._make_executor(head, steps, tail_pad, count)
             head.push = executor
             self._plans[head.full_name] = {
                 "head": head.full_name,
@@ -189,10 +198,12 @@ class SegmentPlanner:
                 "tail": tail_pad.element.name,
                 "epoch": self.epoch,
                 "_pad": head,           # stripped from plans() snapshots
+                "_count": count,        # plan executions (mutable cell)
             }
             return executor
 
-    def _make_executor(self, head: Pad, steps, tail_pad: Pad) -> Callable:
+    def _make_executor(self, head: Pad, steps, tail_pad: Pad,
+                       count: List[int]) -> Callable:
         pipeline = self.pipeline
         tracer = pipeline.tracer
         tail_entry = tail_pad.element._chain_entry
@@ -202,9 +213,10 @@ class SegmentPlanner:
 
         if tracer is None:
             def run(buf, _plan=plan, _head=head, _tail=tail_entry,
-                    _tp=tail_pad):
+                    _tp=tail_pad, _n=count):
                 if _head.eos:
                     return EOS
+                _n[0] += 1
                 el = None
                 try:
                     for fn, el in _plan:
@@ -222,9 +234,10 @@ class SegmentPlanner:
             return run
 
         def run_traced(buf, _plan=plan, _head=head, _tail=tail_entry,
-                       _tp=tail_pad, _tracer=tracer):
+                       _tp=tail_pad, _tracer=tracer, _n=count):
             if _head.eos:
                 return EOS
+            _n[0] += 1
             el = None
             try:
                 for fn, el in _plan:

@@ -65,12 +65,16 @@ from typing import Any, Dict, List, Optional, Tuple
 
 
 class _ElementStats:
-    __slots__ = ("buffers", "proc_ns", "first_ts", "last_ts",
+    __slots__ = ("buffers", "frames", "proc_ns", "first_ts", "last_ts",
                  "bytes_copied", "pool_hits", "pool_misses",
                  "inter_ns", "inter_n")
 
     def __init__(self) -> None:
         self.buffers = 0
+        #: frame-weighted count: a cross-stream batch buffer of N
+        #: frames (query/server.py bucket) counts N here and 1 in
+        #: ``buffers`` — per-frame rates must not undercount buckets
+        self.frames = 0
         self.proc_ns = 0
         self.first_ts: Optional[float] = None
         self.last_ts: Optional[float] = None
@@ -272,6 +276,7 @@ class Tracer:
         buf = frame[6]
         inter_ns = -1
         seq = -1
+        weight = 1
         trace_id = self.trace_id
         if buf is not None:
             extra = buf.extra
@@ -282,13 +287,19 @@ class Tracer:
             ctx = extra.get("nns_trace")
             if ctx is not None and ctx.trace_id:
                 trace_id = ctx.trace_id
+            xbm = extra.get("nns_xbatch")
+            if xbm is not None:
+                # a cross-stream bucket is ONE dispatch serving N
+                # client frames: count them, or per-frame rates read
+                # a batching server as 1/N of its real throughput
+                weight = len(xbm.extras) or 1
         if self.ring is not None:
             from ..obs.span import Span
 
             self.ring.append(Span(name, threading.get_ident(),
                                   frame[1], total, seq, trace_id))
         self._record(name, total - frame[2], frame[3], frame[4],
-                     frame[5], inter_ns)
+                     frame[5], inter_ns, weight)
 
     def annotate_span(self, state: str, start_ns: int, end_ns: int,
                       seq: int = -1, trace_id: int = 0) -> None:
@@ -322,7 +333,8 @@ class Tracer:
         return hists
 
     def _record(self, element_name: str, proc_ns: int, copied: int,
-                hits: int, misses: int, inter_ns: int = -1) -> None:
+                hits: int, misses: int, inter_ns: int = -1,
+                frames: int = 1) -> None:
         now = time.monotonic()
         with self._lock:
             st = self._stats.get(element_name)
@@ -330,6 +342,7 @@ class Tracer:
                 st = self._stats[element_name] = _ElementStats()
                 st.first_ts = now
             st.buffers += 1
+            st.frames += frames
             st.proc_ns += proc_ns
             st.last_ts = now
             st.bytes_copied += copied
@@ -361,6 +374,12 @@ class Tracer:
                 "window_s": round(window, 4),
                 "bytes_copied": st.bytes_copied,
             }
+            if st.frames != st.buffers:
+                # cross-stream buckets: per-frame truth next to the
+                # per-dispatch count (fps above stays per-dispatch)
+                row["frames"] = st.frames
+                if window > 0:
+                    row["frames_per_s"] = round(st.frames / window, 2)
             if st.pool_hits or st.pool_misses:
                 row["pool_hits"] = st.pool_hits
                 row["pool_misses"] = st.pool_misses

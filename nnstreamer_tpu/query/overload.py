@@ -71,6 +71,31 @@ def qos_of_class(name: Optional[str]) -> Optional[str]:
     return _CLASS_ALIASES.get(str(name).lower())
 
 
+#: cross-stream batching residency budgets, as a fraction of the
+#: bucket's ``batch-timeout-ms``: how long a frame of each class may sit
+#: in a COLLECTING bucket waiting for peers before the bucket must
+#: dispatch.  Gold waits a quarter of the configured deadline, bronze
+#: the whole of it — so a gold frame landing in a bucket that bronze
+#: traffic opened pulls the dispatch deadline IN (the bucket fires at
+#: the minimum over resident frames' budgets) and never waits out a
+#: bronze-sized fill window.  Admission (shed-or-admit) stays a separate,
+#: earlier decision — budgets only shape who waits for whom AFTER
+#: admission.
+XBATCH_BUDGET_FACTOR: Dict[str, float] = {
+    "gold": 0.25, "silver": 0.5, "bronze": 1.0}
+
+
+def bucket_budget(qos: Optional[str], timeout_s: float) -> float:
+    """Residency budget (seconds) of one admitted frame in a collecting
+    cross-stream bucket: the configured coalesce deadline scaled by the
+    frame's QoS class (:data:`XBATCH_BUDGET_FACTOR`).  ``timeout_s <= 0``
+    (greedy batching — dispatch whatever is queued, never wait) returns
+    0.0 for every class."""
+    if timeout_s <= 0:
+        return 0.0
+    return timeout_s * XBATCH_BUDGET_FACTOR.get(qos or DEFAULT_QOS, 1.0)
+
+
 class ShedError(ConnectionError):
     """The server answered ``T_SHED``: the request was refused by
     admission control, NOT failed.  ``retry_after_s`` is the server's

@@ -25,11 +25,11 @@ from ..pipeline.caps import Caps
 from ..pipeline.element import Element, EOSEvent, FlowReturn
 from ..pipeline.graph import Source
 from ..pipeline.registry import register_element
-from ..tensor.buffer import TensorBuffer, default_pool
+from ..tensor.buffer import TensorBuffer, XBatchMeta, default_pool
 from ..tensor.caps_util import tensors_template_caps
 from ..utils.conf import parse_bool
 from .overload import (DEFAULT_QOS, QOS_CLASSES, AdmissionController,
-                       TokenBucket, qos_of_class)
+                       TokenBucket, bucket_budget, qos_of_class)
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
                        T_REPLY, T_SHED, T_TRACE, decode_tensors, recv_msg,
                        send_msg, send_tensors, shutdown_close)
@@ -517,10 +517,60 @@ class TensorQueryServerSrc(Source):
                               "seconds; a client that stops draining "
                               "replies for this long is evicted "
                               "(0 = unbounded sends)"),
+        "batch": (1, "cross-stream continuous batching: coalesce up to "
+                     "N admitted frames from ALL connected clients into "
+                     "one stacked buffer that traverses the serving "
+                     "pipeline — and its fused segment plan — as a "
+                     "single dispatch, answered per client by the "
+                     "paired serversink.  The bucket never waits for "
+                     "more frames than the connected-client population "
+                     "can have outstanding (fill target = min(batch, "
+                     "clients)), so a lone client pays ~zero batching "
+                     "tax.  1 = per-frame serving (default)"),
+        "batch-timeout-ms": (0.0, "extra wait to FILL a cross-stream "
+                                  "bucket once it has a frame, scaled "
+                                  "per QoS class (gold waits 1/4 of "
+                                  "this, silver 1/2, bronze all — a "
+                                  "gold frame never waits out a "
+                                  "bronze-opened bucket's window; "
+                                  "query/overload.py bucket_budget).  "
+                                  "0 = greedy continuous batching: "
+                                  "dispatch whatever is queued the "
+                                  "moment the previous bucket clears "
+                                  "(the service time of bucket k is "
+                                  "bucket k+1's natural collect "
+                                  "window)"),
     }
 
     def _make_pads(self):
         self.add_src_pad(tensors_template_caps(), "src")
+
+    def static_check(self):
+        """Pre-play verifier hook: surface the batching decisions
+        ``start()`` would make silently (mirrors tensor_filter's
+        checks)."""
+        out = []
+        try:
+            batch = int(self.batch or 1)
+        except (TypeError, ValueError):
+            out.append(("error", f"{self.name}: batch={self.batch!r} is "
+                                 "not an integer"))
+            batch = 1
+        try:
+            timeout = float(self.batch_timeout_ms or 0)
+        except (TypeError, ValueError):
+            out.append(("error", f"{self.name}: batch-timeout-ms="
+                                 f"{self.batch_timeout_ms!r} is not a "
+                                 "number"))
+            timeout = 0.0
+        if batch < 1:
+            out.append(("warning", f"{self.name}: batch={batch} is "
+                                   "clamped to 1 at start"))
+        if timeout > 0 and batch <= 1:
+            out.append(("warning",
+                        f"{self.name}: batch-timeout-ms needs "
+                        "cross-stream batching (batch>1); ignored"))
+        return out
 
     def start(self):
         self.server = get_server(int(self.id), str(self.host),
@@ -531,6 +581,40 @@ class TensorQueryServerSrc(Source):
                                  send_timeout=float(self.send_timeout))
         if self.caps:
             self.server.set_caps_string(str(self.caps))
+        # cross-stream continuous batching (the one-TPU-per-client-
+        # population lever): a per-model bucket — one per server table
+        # id, which pairs exactly one serving pipeline / negotiated
+        # caps / model — coalescing admitted frames ACROSS client
+        # connections, reusing tensor_filter's bucket/dispatch core
+        self._xbatch = max(1, int(self.batch or 1))
+        self._xb_timeout = max(0.0, float(self.batch_timeout_ms or 0)) / 1e3
+        self._xb_hold = None          # shape-mismatch holdover frame
+        self._xb_last_fill = 0.0
+        self._xb_gauges = []
+        if self._xbatch > 1:
+            from ..elements.filter_elem import CrossStreamBatcher
+            from ..obs.metrics import REGISTRY
+
+            self._xb_bucket = CrossStreamBatcher(self._xbatch,
+                                                 self._xb_timeout)
+            labels = {"port": str(self.server.port)}
+            from ..obs.metrics import Gauge
+
+            self._xb_gauges = [
+                REGISTRY.register(Gauge(n, dict(labels), fn=f))
+                for n, f in (
+                    # fill fraction of the last dispatched bucket and
+                    # live bucket occupancy: the "is the device seeing
+                    # full tiles" evidence the profiler reads
+                    ("nns_xbatch_fill", lambda: self._xb_last_fill),
+                    ("nns_xbatch_occupancy",
+                     lambda: self._xb_bucket.fill))]
+            self._m_xb_batched = REGISTRY.counter(
+                "nns_xbatch_batched_total", **labels)
+            self._m_xb_solo = REGISTRY.counter(
+                "nns_xbatch_solo_total", **labels)
+            self._m_xb_frames = REGISTRY.counter(
+                "nns_xbatch_frames_total", **labels)
         self._mqtt = None
         if str(self.connect_type).lower() == "hybrid":
             # reference HYBRID (tensor_query_serversrc.c via
@@ -551,6 +635,12 @@ class TensorQueryServerSrc(Source):
                 f"{adv}:{self.server.port}".encode(), retain=True)
 
     def stop(self):
+        if getattr(self, "_xb_gauges", None):
+            from ..obs.metrics import REGISTRY
+
+            for g in self._xb_gauges:
+                REGISTRY.unregister(g)
+            self._xb_gauges = []
         if getattr(self, "_mqtt", None) is not None:
             try:
                 # clear the retained record: late clients must see "no
@@ -589,27 +679,148 @@ class TensorQueryServerSrc(Source):
         c = self.caps
         return Caps.from_string(c) if isinstance(c, str) else c
 
+    def _note_admission(self, buf: TensorBuffer,
+                        deq_ns: Optional[int] = None) -> TensorBuffer:
+        """Convert the server's arrival stamp into a deferred
+        admission-wait annotation (emitted by Source._loop at the one
+        place the frame's seq is assigned — no shadow counter to keep
+        in lockstep.  The T_TRACE piggyback then carves it out of the
+        client's wire time)."""
+        pl = self.pipeline
+        if pl is not None and pl.tracer is not None:
+            enq = buf.extra.pop("nns_enq_ns", None)
+            if enq is not None and pl.tracer.ring is not None:
+                from ..obs.clock import mono_ns
+
+                buf.extra["nns_admission_ns"] = (
+                    enq, mono_ns() if deq_ns is None else deq_ns)
+        return buf
+
     def create(self) -> Optional[TensorBuffer]:
+        if getattr(self, "_xbatch", 1) > 1:
+            return self._create_batched()
         while not self._halted.is_set():
             try:
                 buf = self.server.incoming.get(timeout=0.1)
             except _queue.Empty:
                 continue
-            pl = self.pipeline
-            if pl is not None and pl.tracer is not None:
-                enq = buf.extra.pop("nns_enq_ns", None)
-                if enq is not None and pl.tracer.ring is not None:
-                    # admission-wait: arrival → dequeue.  The span is
-                    # DEFERRED to Source._loop, which emits it at the
-                    # one place the frame's seq is assigned — no shadow
-                    # counter to keep in lockstep.  The T_TRACE
-                    # piggyback then carves it out of the client's
-                    # wire time.
-                    from ..obs.clock import mono_ns
-
-                    buf.extra["nns_admission_ns"] = (enq, mono_ns())
-            return buf
+            return self._note_admission(buf)
         return None
+
+    @staticmethod
+    def _frame_sig(buf: TensorBuffer):
+        return tuple((tuple(t.shape), str(getattr(t, "dtype", "")))
+                     for t in buf.tensors)
+
+    def _create_batched(self) -> Optional[TensorBuffer]:
+        """Cross-stream bucket collect: block for the first admitted
+        frame, then coalesce whatever the client population has queued —
+        greedily at ``batch-timeout-ms=0`` (the previous bucket's
+        service time is the collect window), or waiting up to the
+        residents' QoS-scaled budgets to fill the bucket.  The fill
+        TARGET is ``min(batch, connected clients)``: synchronous clients
+        hold at most one outstanding frame each, so waiting for more
+        than the population can deliver is provably pure latency.
+
+        A drain (``QueryServer.drain``) or pipeline halt flushes the
+        partial bucket immediately — resident frames are ADMITTED
+        (inflight-counted) and must be dispatched, never dropped.
+        Frames whose tensor signature differs from the bucket's (flex
+        caps) close the bucket and open the next one, preserving
+        arrival order."""
+        srv = self.server
+        bucket = self._xb_bucket
+        pl = self.pipeline
+        tracer = pl.tracer if pl is not None else None
+        rec = tracer is not None and tracer.ring is not None
+        mono_ns = None
+        if rec:
+            from ..obs.clock import mono_ns
+
+        first = self._xb_hold
+        self._xb_hold = None
+        while first is None:
+            if self._halted.is_set():
+                return None
+            try:
+                first = srv.incoming.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+        if rec:
+            first.extra["nns_deq_ns"] = mono_ns()
+        sig = self._frame_sig(first)
+        timeout = self._xb_timeout
+        bucket.add(first, bucket_budget(first.extra.get("nns_class"),
+                                        timeout))
+        while not bucket.full() and not self._halted.is_set() \
+                and not srv.draining:
+            # fill target: never wait for frames the connected-client
+            # population cannot have outstanding
+            if bucket.fill >= min(bucket.capacity,
+                                  max(1, len(srv._clients))):
+                break
+            wait = min(bucket.remaining(), 0.05)
+            try:
+                buf = (srv.incoming.get_nowait() if wait <= 0
+                       else srv.incoming.get(timeout=wait))
+            except _queue.Empty:
+                if wait <= 0 or bucket.expired():
+                    break
+                continue
+            if rec:
+                buf.extra["nns_deq_ns"] = mono_ns()
+            if self._frame_sig(buf) != sig:
+                self._xb_hold = buf    # opener of the NEXT bucket
+                break
+            bucket.add(buf, bucket_budget(buf.extra.get("nns_class"),
+                                          timeout))
+        bufs = bucket.take()
+        n = len(bufs)
+        self._xb_last_fill = n / bucket.capacity
+        if n == 1:
+            self._m_xb_solo.inc()
+            solo = bufs[0]
+            solo.extra.pop("nns_deq_ns", None)
+            return self._note_admission(solo)
+        self._m_xb_batched.inc()
+        self._m_xb_frames.inc(n)
+        import numpy as np
+
+        tensors = [np.stack([np.asarray(b.tensors[k]) for b in bufs])
+                   for k in range(bufs[0].num_tensors)]
+        spans = None
+        if rec:
+            # per-frame residency evidence (obs/attrib.py): arrival →
+            # dequeue is admission-wait, dequeue → bucket dispatch is
+            # queue-wait.  Deferred to Source._loop (nns_xb_spans) so
+            # every span carries the batch buffer's assigned seq; each
+            # frame's own trace id routes it to that client's merged
+            # timeline via the T_TRACE piggyback.
+            disp_ns = mono_ns()
+            spans = []
+            for b in bufs:
+                enq = b.extra.pop("nns_enq_ns", None)
+                deq = b.extra.pop("nns_deq_ns", disp_ns)
+                ctx = b.extra.get("nns_trace")
+                tid = ctx.trace_id if ctx is not None else 0
+                if enq is not None:
+                    spans.append(("admission-wait", enq, deq, tid))
+                spans.append(("queue-wait", deq, disp_ns, tid))
+        else:
+            for b in bufs:
+                b.extra.pop("nns_enq_ns", None)
+                b.extra.pop("nns_deq_ns", None)
+        out = TensorBuffer(tensors=tensors, pts=bufs[0].pts)
+        # the per-frame extras (client id, wire seq, QoS class, trace
+        # context) ride the meta to the serversink split; the stacked
+        # copy above is the bucket's h2d staging, so the per-frame
+        # pooled slabs release right here
+        out.extra["nns_xbatch"] = XBatchMeta(
+            [b.extra for b in bufs], [b.pts for b in bufs],
+            bucket.capacity)
+        if spans:
+            out.extra["nns_xb_spans"] = spans
+        return out
 
 
 @register_element
@@ -617,7 +828,19 @@ class TensorQueryServerSink(Element):
     """Sends pipeline results back to the originating client."""
 
     FACTORY = "tensor_query_serversink"
-    PROPERTIES = {"id": (0, "server table id")}
+    PROPERTIES = {
+        "id": (0, "server table id"),
+        "async-replies": (False, "cross-stream batching: move the "
+                                 "reply split (host materialization + "
+                                 "per-row sends) onto ONE ordered "
+                                 "pusher thread so the serving thread "
+                                 "collects/dispatches the next bucket "
+                                 "meanwhile.  Wins when device dispatch "
+                                 "is truly asynchronous (accelerators); "
+                                 "on small CPU hosts the two threads "
+                                 "contend for the same cores and "
+                                 "latency suffers — hence opt-in"),
+    }
 
     def _make_pads(self):
         self.add_sink_pad(tensors_template_caps(), "sink")
@@ -631,6 +854,54 @@ class TensorQueryServerSink(Element):
         # chain() after the src produced them, so by first use the
         # src-configured server exists.
         self.server = None
+        # async reply worker (opt-in via async-replies, spawned at the
+        # first cross-stream batch buffer): the reply split — host materialization (the
+        # device sync), per-row framing, N socket sends — moves off the
+        # serving thread onto ONE ordered pusher (the PR 3 reorder-
+        # pusher shape: strict FIFO, so per-client seq order is
+        # untouched).  The serving thread is then free to collect and
+        # dispatch bucket k+1 while the device computes bucket k and
+        # the pusher answers bucket k-1 — the stages overlap instead of
+        # serializing into one long cycle.  Depth 1 (double buffering):
+        # one bucket being answered while one is collected/dispatched —
+        # deeper queues stack concurrent device executions, which
+        # oversubscribes the backend's intra-op pool and inflates
+        # latency without adding throughput.
+        self._rq: Optional[_queue.Queue] = None
+        self._rthread: Optional[threading.Thread] = None
+
+    def stop(self):
+        self._stop_reply_worker()
+        super().stop()
+
+    def _start_reply_worker(self) -> None:
+        self._rq = _queue.Queue(maxsize=1)
+        self._rthread = threading.Thread(
+            target=self._reply_loop, daemon=True,
+            name=f"reply-push:{self.name}")
+        self._rthread.start()
+
+    def _stop_reply_worker(self) -> None:
+        rq, self._rq = self._rq, None
+        if rq is not None:
+            rq.put(None)
+            if self._rthread is not None:
+                self._rthread.join(timeout=10)
+                self._rthread = None
+
+    def _reply_loop(self) -> None:
+        while True:
+            buf = self._rq.get()
+            try:
+                if buf is None:
+                    return
+                xb = buf.extra.get("nns_xbatch")
+                if xb is None:
+                    self.server.reply(buf)
+                else:
+                    self._reply_batch(self.server, buf, xb)
+            finally:
+                self._rq.task_done()
 
     def set_caps(self, pad, caps):
         pass
@@ -644,9 +915,64 @@ class TensorQueryServerSink(Element):
         # them to the requesting client as T_TRACE
         server.obs_tracer = (self.pipeline.tracer
                              if self.pipeline is not None else None)
-        server.reply(buf)
+        xb = buf.extra.get("nns_xbatch")
+        if xb is not None and self._rq is None \
+                and parse_bool(self.async_replies):
+            self._start_reply_worker()
+        if self._rq is not None:
+            # once the worker exists EVERY buffer rides it (a solo
+            # frame jumping the queue would answer ahead of an earlier
+            # bucket's rows); chain() was serial before the switch, so
+            # order across the transition holds too
+            self._rq.put(buf)
+            return FlowReturn.OK
+        if xb is None:
+            server.reply(buf)
+            return FlowReturn.OK
+        self._reply_batch(server, buf, xb)
         return FlowReturn.OK
+
+    def _reply_batch(self, server, buf: TensorBuffer, xb) -> None:
+        """Split a cross-stream batch back into per-client replies, in
+        bucket (= per-client arrival) order — exact per-client seq order
+        by construction: one serving thread collects, dispatches and
+        splits, so client *c*'s row *i* is always answered before its
+        row *i+1*.  Padding rows (``>= xb.n``, partial-bucket padded
+        invokes) are never replied."""
+        tracer = server.obs_tracer
+        rec = tracer is not None and getattr(tracer, "ring", None) \
+            is not None
+        t0 = 0
+        if rec:
+            import time as _time
+
+            t0 = _time.monotonic_ns()
+        # ONE host materialization per output tensor for the whole
+        # bucket (TensorBuffer.np is the device sync point — the shared
+        # device window every bucket peer overlaps); rows are zero-copy
+        # views into it
+        mats = [buf.np(k) for k in range(buf.num_tensors)]
+        if rec:
+            import time as _time
+
+            t1 = _time.monotonic_ns()
+            seq = buf.extra.get("nns_seq", -1)
+            for extra in xb.extras:
+                ctx = extra.get("nns_trace")
+                if ctx is not None and ctx.trace_id:
+                    tracer.annotate_span("device-invoke", t0, t1,
+                                         seq=seq, trace_id=ctx.trace_id)
+        for i in range(xb.n):
+            frame = TensorBuffer(tensors=[m[i] for m in mats],
+                                 pts=xb.pts[i], extra=xb.extras[i])
+            server.reply(frame)
 
     def on_event(self, pad, event):
         if isinstance(event, EOSEvent):
+            rq = self._rq
+            if rq is not None:
+                # every queued reply precedes EOS: admitted frames must
+                # be ANSWERED, and drain's inflight accounting only
+                # converges once the pusher has sent them
+                rq.join()
             self.post_eos_reached()

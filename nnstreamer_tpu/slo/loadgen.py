@@ -180,6 +180,13 @@ class LoadGenerator:
 
     def _worker(self, idx: int, offsets: List[float],
                 cls_picks: List[str], worker_qos: Optional[str]) -> None:
+        # staggered dial-in: a fleet of clients connecting in the same
+        # instant overruns the server's accept/HELLO turnover, and the
+        # colliding dials land in connect-retry backoff — seconds of
+        # it, charged to the first scheduled arrivals (observed as a
+        # one-bad-request-per-client 10 s latency tail).  Spreading the
+        # dials costs nothing: arrivals are still anchored to t0.
+        self._stop.wait(idx * 0.025)
         conn = QueryConnection(self.host, self.port,
                                timeout=self.timeout, max_retries=2,
                                qos=worker_qos)
@@ -259,10 +266,14 @@ class LoadGenerator:
     def stop(self) -> None:
         self._stop.set()
 
-    def run(self, warmup_s: float = 0.5) -> Dict[str, Any]:
+    def run(self, warmup_s: Optional[float] = None) -> Dict[str, Any]:
         """Precompute every schedule, anchor a shared t0 ``warmup_s``
         out (all workers spawn and dial before the first arrival), run
-        the schedules to exhaustion, and return the summary."""
+        the schedules to exhaustion, and return the summary.  The
+        default warmup scales with the fleet so the staggered dial-in
+        (25 ms/client) completes before the first arrival."""
+        if warmup_s is None:
+            warmup_s = max(0.5, 0.03 * self.clients)
         rng = random.Random(self.seed ^ 0x5105)
         # baseline the shared histograms: registry.histogram() returns
         # the same instance across LoadGenerator runs in one process,

@@ -405,6 +405,47 @@ class BatchView:
                 f"{tuple(self.batch.shape)} {self.batch.dtype})")
 
 
+class XBatchMeta:
+    """Descriptor of a cross-stream batch buffer (rides
+    ``buf.extra["nns_xbatch"]``).
+
+    The query serving plane's continuous-batching dispatcher
+    (``query/server.py``) coalesces admitted frames from MANY client
+    connections into ONE :class:`TensorBuffer` whose tensors are stacked
+    along a new leading axis (``(n, *frame_shape)`` per tensor index) so
+    the whole bucket traverses the serving pipeline — and the fused
+    segment plan — as a single dispatch.  This meta carries what the
+    split point (``tensor_query_serversink``) needs to hand each row
+    back to its own client, in bucket order:
+
+    - ``extras[i]``: row *i*'s original per-frame ``buf.extra`` dict
+      (client id, wire seq, QoS class, restored trace context);
+    - ``pts[i]``: row *i*'s presentation timestamp;
+    - ``capacity``: the bucket size the batcher collects toward — the
+      PAD target for partial-bucket device invokes
+      (``JitExecMixin.invoke_stacked``), so exactly one executable
+      shape ever compiles regardless of fill.
+
+    ``n`` (the live row count) is ``len(extras)``; stacked tensors may
+    carry MORE than ``n`` rows after a padded invoke — rows past ``n``
+    are padding and must never be replied.
+    """
+
+    __slots__ = ("extras", "pts", "capacity")
+
+    def __init__(self, extras, pts, capacity: int) -> None:
+        self.extras = list(extras)
+        self.pts = list(pts)
+        self.capacity = int(capacity)
+
+    @property
+    def n(self) -> int:
+        return len(self.extras)
+
+    def __repr__(self) -> str:
+        return f"XBatchMeta(n={self.n}, capacity={self.capacity})"
+
+
 @dataclasses.dataclass
 class TensorBuffer:
     """One frame of a tensor stream: N tensor payloads + timestamps.

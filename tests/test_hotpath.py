@@ -527,6 +527,24 @@ def test_hotpath_bench_profile_gate():
 
 
 @pytest.mark.perf
+def test_hotpath_bench_xbatch_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage xbatch fails
+    when cross-stream batching (tensor_query_serversrc batch=N) no
+    longer sustains >= 2x the per-frame server's throughput with 8
+    concurrent clients at bucket 8, or when a SINGLE connected client
+    pays > 2% for the batching config (the solo fast path + fill-target
+    rule must keep a lone client at per-frame cost)."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "xbatch"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (
+        f"xbatch gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_xbatch_gate"' in r.stdout
+
+
+@pytest.mark.perf
 def test_hotpath_bench_admit_gate():
     """CI gate: tools/hotpath_bench.py --assert --stage admit fails
     when the un-overloaded admission decision (query/overload.py —

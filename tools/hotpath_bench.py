@@ -29,6 +29,12 @@ time, with no model in the loop:
                    + watermark policy, queue under every watermark —
                    the branch every admitted frame pays), against the
                    measured wire round-trip it rides on.
+  - ``xbatch``:   cross-stream continuous batching
+                   (tensor_query_serversrc batch=N): closed-loop
+                   requests/s of a loopback MLP serving pipeline,
+                   per-frame vs bucket-8 batching with 8 concurrent
+                   clients, plus the single-client overhead of the
+                   batching config (the solo fast path).
 
 Prints ONE JSON line per stage (schema mirrors bench.py).
 
@@ -452,6 +458,127 @@ def run_assert_profile() -> int:
     return 1 if failures else 0
 
 
+def _xbatch_measure(bucket: int = 8, concurrency: int = 8):
+    """(solo_rps, batched_rps, pf_1client_rps, xb_1client_rps), each
+    probed against an OUT-OF-PROCESS serving pipeline (tools/soak.py
+    ``ServerProc``: launch.py in its own interpreter, the same MLP the
+    committed soak artifact serves).  In-process, the probe's own
+    client threads share the GIL and both CPU cores with the serving
+    thread, and that contention — not the dispatch being gated —
+    bounds the batched/per-frame ratio at ~1.8x on a 2-core host; out
+    of process the serving plane is what's measured (the ROADMAP
+    item 5 reasoning that shaped the soak harness).  One server per
+    config, two probes each (N-conn + 1-conn).
+
+    The servers run in the ACCEPTANCE configuration (tools/soak.py
+    run_xbatch): untraced — ``profile=True`` span tracing halves
+    serving-row throughput on small CPU hosts, an observer tax that
+    lands harder on the batching server (per-frame residency spans per
+    bucket row) and corrupts the very ratio being gated — and with the
+    soak's 30 ms fill window rather than pure greedy.  Greedy
+    (``batch-timeout-ms=0``) only coalesces what is ALREADY queued when
+    the bucket opens, and against closed-loop probe clients whose sends
+    race the server's collect loop that measures ~half-filled buckets
+    with frequent solo dispatches — the fill window is part of the
+    serving configuration the committed artifact gates."""
+    import tempfile
+
+    from soak import ServerProc, measure_capacity
+
+    payload = np.random.default_rng(5).standard_normal(
+        64).astype(np.float32)
+    out = []
+    for batch in (0, bucket):
+        sp = ServerProc(tempfile.mkdtemp(prefix="xbgate_"), batch=batch,
+                        timeout_ms=30.0 if batch else 0.0,
+                        soak_s=600.0, profile=False)
+        try:
+            if not sp.wait_ready(payload, timeout_s=240.0):
+                raise RuntimeError("xbatch gate: serving pipeline "
+                                   f"(batch={batch}) never came up")
+            # 1-conn BEFORE the multi-conn probe: the solo-path number
+            # must not be taken right after eight connections closed —
+            # until their reader threads reap, a stale client count
+            # holds the fill target above 1 and the lone client waits
+            # out fill windows it can never satisfy (measured as a
+            # spurious ~50% "solo overhead")
+            measure_capacity("127.0.0.1", sp.port, seconds=2.0,
+                             payload=payload, concurrency=1)
+            out.append(measure_capacity(
+                "127.0.0.1", sp.port, seconds=4.0,
+                payload=payload, concurrency=1))
+            time.sleep(0.75)   # let the probe's readers reap
+            measure_capacity("127.0.0.1", sp.port, seconds=2.0,
+                             payload=payload, concurrency=concurrency)
+            out.append(measure_capacity(
+                "127.0.0.1", sp.port, seconds=3.0,
+                payload=payload, concurrency=concurrency))
+        finally:
+            sp.stop()
+    pf1, solo, xb1, batched = out
+    return solo, batched, pf1, xb1
+
+
+def bench_xbatch(frames: int) -> dict:
+    solo, batched, pf1, xb1 = _xbatch_measure()
+    return {"metric": "hotpath_xbatch_rps",
+            "value": round(batched, 1), "unit": "rps",
+            "solo_rps": round(solo, 1),
+            "ratio": round(batched / max(1e-9, solo), 2),
+            "single_client_perframe_rps": round(pf1, 1),
+            "single_client_xbatch_rps": round(xb1, 1),
+            "single_client_overhead_pct": round(
+                (pf1 / max(1e-9, xb1) - 1.0) * 100.0, 2),
+            "bucket": 8, "concurrency": 8}
+
+
+def run_assert_xbatch() -> int:
+    """Cross-stream batching gate: with 8 concurrent clients and
+    bucket 8, the batching server must sustain >= 2x the per-frame
+    server's requests/s (measured margin ~3-5x on the MLP probe, so 2x
+    trips on a real coalescing regression, not noise) — and with ONE
+    client connected the batching config must cost < 2% (the
+    solo fast path + fill-target rule: a lone synchronous client never
+    waits on a fill window).  Min-of-retries on a miss: scheduler noise
+    is one-sided, a real regression survives."""
+    failures = []
+    solo, batched, pf1, xb1 = _xbatch_measure()
+    ratio = batched / max(1e-9, solo)
+    overhead = (pf1 / max(1e-9, xb1) - 1.0) * 100.0
+    for _ in range(2):
+        if ratio >= 2.0 and overhead <= 2.0:
+            break
+        # best-of retries on every side (each side's fastest run —
+        # min-of-times, the same shape the other gates use): probe
+        # noise is one-sided — a background burst on a shared 2-core
+        # host can halve one 3 s window — and a real regression
+        # survives every retry
+        s2, b2, p2, x2 = _xbatch_measure()
+        solo, batched = max(solo, s2), max(batched, b2)
+        pf1, xb1 = max(pf1, p2), max(xb1, x2)
+        ratio = batched / max(1e-9, solo)
+        overhead = (pf1 / max(1e-9, xb1) - 1.0) * 100.0
+    if ratio < 2.0:
+        failures.append(
+            f"batched dispatch only {ratio:.2f}x solo per-frame "
+            f"({batched:.0f} vs {solo:.0f} rps at bucket 8): the "
+            "cross-stream coalescing win is gone")
+    if overhead > 2.0:
+        failures.append(
+            f"single-client overhead {overhead:.2f}% > 2% "
+            f"({pf1:.0f} per-frame vs {xb1:.0f} rps batching-enabled): "
+            "a lone client is paying for the bucket")
+    result = {"metric": "hotpath_xbatch_gate", "unit": "ok",
+              "value": 0 if failures else 1,
+              "ratio": round(ratio, 2),
+              "solo_rps": round(solo, 1),
+              "batched_rps": round(batched, 1),
+              "single_client_overhead_pct": round(overhead, 2),
+              "failures": failures}
+    print(json.dumps(result), flush=True)
+    return 1 if failures else 0
+
+
 def _admit_measure(decisions: int = 200_000):
     """ns per admission decision on the un-overloaded path (queue well
     under every watermark, bucket never empty)."""
@@ -629,7 +756,7 @@ def main() -> int:
     ap.add_argument("--frames", type=int, default=200)
     ap.add_argument("--stage", choices=["pool", "serialize", "wire", "shm",
                                         "dispatch", "obs", "admit",
-                                        "profile", "all"],
+                                        "profile", "xbatch", "all"],
                     default="all")
     ap.add_argument("--assert", dest="assert_gate", action="store_true",
                     help="regression gates (exit 1): copy gate (serialize "
@@ -651,11 +778,14 @@ def main() -> int:
             rc |= run_assert_admit()
         if args.stage in ("all", "profile"):
             rc |= run_assert_profile()
+        if args.stage in ("all", "xbatch"):
+            rc |= run_assert_xbatch()
         return rc
     stages = {"pool": bench_pool, "serialize": bench_serialize,
               "wire": bench_wire, "shm": bench_shm,
               "dispatch": bench_dispatch, "obs": bench_obs,
-              "admit": bench_admit, "profile": bench_profile}
+              "admit": bench_admit, "profile": bench_profile,
+              "xbatch": bench_xbatch}
     picks = stages if args.stage == "all" else {args.stage:
                                                stages[args.stage]}
     for fn in picks.values():
