@@ -874,8 +874,16 @@ def run_child(config: str) -> dict:
         # keeps the full default count)
         N_FRAMES = _auto_frames(CONFIG_SIZE[config], link, _CHILD_DEADLINE)
 
+    # which segment-compiler lowering tier served this row (NNS_FUSE /
+    # --fuse): interpret | python | xla — rows must name the dispatch
+    # configuration they measured, like stream_batch already does
+    from nnstreamer_tpu.pipeline.schedule import resolve_tier
+
+    lowering = resolve_tier(None)
+
     def emit(core: dict) -> None:
-        print(json.dumps(dict(core, device=str(device), **link)),
+        print(json.dumps(dict(core, device=str(device),
+                              lowering=lowering, **link)),
               flush=True)
 
     if config == "mobilenet":
@@ -931,6 +939,7 @@ def run_child(config: str) -> dict:
     else:
         result = bench_edge(dtype_prop)
     result["device"] = str(device)
+    result["lowering"] = lowering
     result.update(link)
     return result
 
@@ -1271,11 +1280,20 @@ def main() -> None:
                          "NNS_FUSE=0, inherited by child runs): measures "
                          "the interpreted-dispatch baseline so the "
                          "scheduler's delta is attributable")
+    ap.add_argument("--fuse", default=None,
+                    choices=["interpret", "python", "xla"],
+                    help="segment-compiler lowering tier (sets NNS_FUSE, "
+                         "inherited by child runs); rows carry it as "
+                         "'lowering' so fuse-python vs fuse-xla captures "
+                         "stay distinguishable")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
     if args.no_fuse:
         os.environ["NNS_FUSE"] = "0"
+    if args.fuse is not None:
+        os.environ["NNS_FUSE"] = {"interpret": "0", "python": "1",
+                                  "xla": "xla"}[args.fuse]
 
     if args._child:
         print(json.dumps(run_child(args.config)), flush=True)

@@ -69,6 +69,7 @@ def verify_pipeline(pipeline) -> List[Finding]:
     _check_caps(pipeline, findings)
     _check_element_configs(pipeline, findings)
     _check_thread_structure(pipeline, findings)
+    _check_lowering(pipeline, findings)
     findings.sort(key=lambda f: _SEV_ORDER.get(f.severity, 3))
     return findings
 
@@ -272,6 +273,38 @@ def _check_element_configs(pipeline, findings: List[Finding]) -> None:
         for severity, message in checks:
             findings.append(Finding(
                 severity, "misconfig", _chain_path(el), message, el))
+
+
+def _check_lowering(pipeline, findings: List[Finding]) -> None:
+    """``fuse=xla`` requested: warn for every linear element whose
+    :meth:`~nnstreamer_tpu.pipeline.element.Element.lower_reason` says
+    it cannot join a whole-segment XLA computation — its segment will
+    silently run at the fuse-python tier.  Property-level (pre-start)
+    assessment, so ``launch.py --check`` reports it without playing;
+    the compiled plan's ``fallback`` row is the runtime twin."""
+    if getattr(pipeline, "fuse_tier", None) != "xla":
+        return
+    for el in pipeline.elements:
+        if len(el.sink_pads) != 1 or len(el.src_pads) != 1:
+            continue
+        try:
+            # boundary elements (queue etc.) never fuse: no warning.
+            # A plan_step that needs started state (tensor_filter) is
+            # assumed fusable; lower_reason is property-level.
+            if el.plan_step() is None:
+                continue
+        except Exception:  # noqa: BLE001 — state-dependent plan_step
+            pass
+        try:
+            reason = el.lower_reason()
+        except Exception as exc:  # noqa: BLE001 — config so broken the
+            #                       assessment itself failed
+            reason = f"lower_reason failed: {exc!r}"
+        if reason:
+            findings.append(Finding(
+                "warning", "xla-fallback", _chain_path(el),
+                f"fuse=xla requested but {el.name} cannot lower: "
+                f"{reason} — its segment will run fuse-python", el))
 
 
 def _check_thread_structure(pipeline, findings: List[Finding]) -> None:

@@ -40,6 +40,17 @@ class Decoder:
         still carry the raw layout."""
         return None
 
+    def lower_decode(self, config: TensorsConfig):
+        """Whole-segment XLA lowering hook (fuse=xla, pipeline/schedule.py
+        via ``tensor_decoder.lower_step``): return ``(fn, needs_post)``
+        where ``fn(tensors) -> tensors`` is the decoder's PURE tensor
+        math (jax-traceable — it joins the segment's single jitted
+        computation), and ``needs_post`` says whether ``decode`` must
+        still run as a host finisher at segment exit over the reduced
+        tensors (label lookup, text formatting).  None (the default) =
+        not lowerable; the segment falls back to fuse-python."""
+        return None
+
 
 _DECODERS: Dict[str, Type[Decoder]] = {}
 

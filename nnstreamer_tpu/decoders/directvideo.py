@@ -37,6 +37,13 @@ class DirectVideoDecoder(Decoder):
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
         return buf.with_tensors([buf.np(0)])
 
+    def lower_decode(self, config: TensorsConfig):
+        """fuse=xla: direct_video is a pure payload passthrough (the
+        uint8/channel checks ran at caps time) — lowering it keeps the
+        frame device-resident to segment exit, where the consumer's
+        ``np()`` is the one sync point.  No host finisher needed."""
+        return (lambda ts: [ts[0]]), False
+
 
 @register_decoder
 class OctetStreamDecoder(Decoder):

@@ -52,16 +52,31 @@ class ImageLabelDecoder(Decoder):
         info = config.info[0]
         if int(np.prod(info.np_shape)) <= 1:    # already reduced
             return None
-        import jax.numpy as jnp
+        from ..ops.classify import top1
 
         from ..tensor.info import TensorInfo, TensorsInfo
         from ..tensor.types import TensorType
 
         def fn(outs):
-            return [jnp.argmax(outs[0].reshape(-1)).astype(
-                jnp.int32).reshape(1)]
+            return [top1(outs[0])]
 
         return fn, TensorsInfo([TensorInfo(TensorType.INT32, (1,))])
+
+    def lower_decode(self, config: TensorsConfig):
+        """fuse=xla: the argmax reduction (ops/classify.py ``top1``)
+        joins the segment's jitted computation; the label lookup stays a
+        host post-finisher over the reduced (1,) int32 — ``decode``
+        already dispatches on the reduced form (the pushdown contract).
+        When the reduction was ALREADY pushed into the upstream filter
+        (device_reduce_spec returns None on the reduced config), the
+        traced part is the identity."""
+        if config.info.num_tensors != 1:
+            return None
+        spec = self.device_reduce_spec(config)
+        if spec is None:
+            return (lambda ts: ts), True
+        red_fn, _ = spec
+        return (lambda ts: red_fn(ts)), True
 
     def decode(self, buf: TensorBuffer, config: TensorsConfig) -> TensorBuffer:
         scores = buf.np(0)

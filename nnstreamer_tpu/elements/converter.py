@@ -252,6 +252,28 @@ class TensorConverter(Element):
             return self._video_frame
         return None
 
+    def lower_reason(self):
+        if self.mode:
+            return "custom converter subplugins run host code"
+        if int(self.frames_per_tensor) != 1:
+            return "frames-per-tensor>1 accumulates state across buffers"
+        media = getattr(self, "_media", None)
+        if media not in (None, "video/x-raw"):
+            return (f"converting {media} re-chunks through the host "
+                    "adapter")
+        return None
+
+    def lower_step(self):
+        # only the video fpt=1 path is a pure payload passthrough; the
+        # pre-negotiation state (media unknown) also opts out — plans
+        # compile on the first buffer, after caps
+        if self.lower_reason() is not None \
+                or getattr(self, "_media", None) != "video/x-raw":
+            return None
+        from ..pipeline.element import LoweredStep
+
+        return LoweredStep(lambda params, ts: [ts[0]])
+
     def _video_frame(self, buf: TensorBuffer) -> TensorBuffer:
         t = buf.tensors[0]
         return buf.with_tensors(

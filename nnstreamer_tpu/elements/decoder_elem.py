@@ -106,3 +106,30 @@ class TensorDecoder(Element):
 
     def plan_step(self):
         return self._decode_one
+
+    def lower_reason(self):
+        mode = str(self.mode or "")
+        if mode == "custom-code":
+            return "custom-code decoders run arbitrary host callbacks"
+        try:
+            dec = find_decoder(mode) if mode else None
+        except KeyError:
+            dec = None
+        if dec is None or "lower_decode" not in vars(dec):
+            return (f"decoder mode {mode!r} has no lower_decode "
+                    "(pure-tensor lowering hook)")
+        return None
+
+    def lower_step(self):
+        if getattr(self, "_custom_fn", None) is not None \
+                or getattr(self, "_decoder", None) is None \
+                or getattr(self, "_config", None) is None:
+            return None
+        spec = self._decoder.lower_decode(self._config)
+        if spec is None:
+            return None
+        fn, needs_post = spec
+        from ..pipeline.element import LoweredStep
+
+        post = self._decode_one if needs_post else None
+        return LoweredStep(lambda params, ts: fn(ts), post=post)

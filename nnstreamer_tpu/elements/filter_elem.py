@@ -23,7 +23,8 @@ from typing import List, Optional
 
 from ..filter.framework import (Accelerator, FilterError, FilterProperties,
                                 close_backend, open_backend)
-from ..pipeline.element import CustomEvent, Element, FlowReturn, QoSEvent
+from ..pipeline.element import (CustomEvent, Element, FlowReturn,
+                                LoweredStep, QoSEvent)
 from ..pipeline.registry import register_element
 from ..tensor.buffer import TensorBuffer
 from ..tensor.caps_util import caps_from_config, static_tensors_caps
@@ -682,6 +683,51 @@ class TensorFilter(Element):
             return None
         return self._plan_invoke
 
+    def lower_reason(self):
+        if max(1, int(self.batch or 1)) > 1:
+            return "batch>1: the micro-batch coalescer owns dispatch"
+        if max(1, int(self.workers or 1)) > 1:
+            return "workers>1: the invoke pool owns dispatch"
+        fw = getattr(self, "fw", None)
+        if fw is not None:
+            if getattr(fw, "_forward_fn", None) is None \
+                    or getattr(fw, "_params_dev", None) is None \
+                    and getattr(fw, "_jitted", None) is None:
+                return (f"backend {self._props.framework!r} has no "
+                        "jit-exec forward (host-code invoke)")
+            if getattr(self, "_throttle_ns", 0):
+                return ("QoS throttling active: per-buffer drop state "
+                        "is host-side")
+        return None
+
+    def lower_step(self):
+        """fuse=xla: the jit-exec forward joins the segment's single
+        jitted computation — params ride as jit arguments (the
+        ``_jitexec`` warm-executable discipline), input/output
+        combination is pure index selection, and the PR 9 stacked-bucket
+        path is served by the segment compiler's vmapped executable
+        (``SegmentExec.run_stacked`` reuses the ``pad_rows``
+        padded-bucket policy, so fills never recompile)."""
+        if self.lower_reason() is not None \
+                or getattr(self, "fw", None) is None \
+                or getattr(self, "_in_config", None) is None:
+            return None
+        fw = self.fw
+        fwd = getattr(fw, "_forward_fn", None)
+        if fwd is None or not fw.opened:
+            return None
+        in_comb, out_comb = self._in_comb, self._out_comb
+
+        def fn(params, ts, _fwd=fwd, _in=in_comb, _out=out_comb):
+            xs = ts if _in is None else [ts[i] for i in _in]
+            outs = list(_fwd(params, *xs))
+            if _out is not None:
+                ins, sel = _out
+                outs = [ts[i] for i in ins] + [outs[k] for k in sel]
+            return outs
+
+        return LoweredStep(fn, params=fw._params_dev)
+
     def _plan_invoke(self, buf: TensorBuffer):
         fw = self.fw
         if fw is None or not fw.opened:
@@ -1144,6 +1190,14 @@ class TensorFilter(Element):
                 self._throttle_ns = int(frame_ns * max(1.0,
                                                        event.proportion))
                 self.latency_report = True
+            # a fuse=xla segment cannot express the per-buffer drop
+            # state: drop its plan so the next buffer recompiles at the
+            # fuse-python tier (and back, once a catch-up report clears
+            # the throttle) — lower_reason() answers per current state
+            pl = self.pipeline
+            if pl is not None and getattr(pl, "planner", None) is not None \
+                    and pl.planner.tier == "xla":
+                pl.planner.invalidate(element=self)
             # keep propagating so upstream adapters (tensor_rate, sources)
             # can throttle too — the filter is a participant, not the owner
             super().on_upstream_event(pad, event)

@@ -19,7 +19,7 @@ import numpy as np
 
 from ..pipeline.caps import Caps
 from ..pipeline.element import (CapsEvent, Element, EOSEvent, FlowReturn,
-                                Pad)
+                                LoweredStep, Pad)
 from ..pipeline.graph import Source
 from ..pipeline.registry import register_element
 from ..tensor.buffer import SECOND, TensorBuffer
@@ -59,6 +59,16 @@ class Identity(Element):
     def plan_step(self):
         return self._forward
 
+    def lower_reason(self):
+        if int(self.sleep_us or 0):
+            return "identity sleep-us emulates host work (untraceable)"
+        return None
+
+    def lower_step(self):
+        if self.lower_reason() is not None:
+            return None
+        return LoweredStep(lambda params, ts: ts)
+
 
 @register_element
 class TensorDebug(Element):
@@ -91,6 +101,17 @@ class TensorDebug(Element):
 
     def plan_step(self):
         return self._observe
+
+    def lower_reason(self):
+        if str(self.output) == "console" or bool(self.capture):
+            return ("tensor_debug output=console/capture has per-buffer "
+                    "side effects (set output=silent to lower)")
+        return None
+
+    def lower_step(self):
+        if self.lower_reason() is not None:
+            return None
+        return LoweredStep(lambda params, ts: ts)
 
     def _note(self, msg: str) -> None:
         if bool(self.capture):

@@ -20,7 +20,7 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-from ..pipeline.element import Element, FlowReturn
+from ..pipeline.element import Element, FlowReturn, LoweredStep
 from ..pipeline.registry import register_element
 from ..utils.log import ml_logw
 from ..tensor.buffer import TensorBuffer
@@ -168,6 +168,42 @@ class TensorTransform(Element):
 
     def plan_step(self):
         return self._apply
+
+    #: modes whose math is expressible as a pure jnp trace (the fuse=xla
+    #: lowering set the ISSUE named; stand/clamp/transpose stay host-side
+    #: for now and simply fall the segment back to fuse-python)
+    _LOWERABLE_MODES = ("typecast", "arithmetic", "dimchg")
+
+    def lower_reason(self):
+        mode = str(self.mode or "")
+        if mode not in self._LOWERABLE_MODES:
+            return (f"tensor_transform mode={mode!r} has no jnp lowering "
+                    f"(lowerable: {','.join(self._LOWERABLE_MODES)})")
+        return None
+
+    def lower_step(self):
+        if self.lower_reason() is not None \
+                or getattr(self, "_out_config", None) is None:
+            return None
+        # _transform is ALREADY jax-traceable for the lowerable modes:
+        # under jit every input is a tracer, so _xp() picks jnp and the
+        # per-channel writes take the ``.at`` branch — one math
+        # implementation serves interpret, fuse-python and fuse-xla
+        # (dtype caveat: the host path promotes uint8 arithmetic through
+        # float64, the traced path through float32; identical after the
+        # cast back for operands inside f32-exact range, see
+        # docs/PERFORMANCE.md)
+        n = self._out_config.info.num_tensors
+        applies = [self._applies(i) for i in range(n)]
+        targets = [self._out_config.info[i].dtype for i in range(n)]
+        transform = self._transform
+
+        def fn(params, ts, _applies=applies, _targets=targets,
+               _tf=transform):
+            return [_tf(t, _targets[i]) if _applies[i] else t
+                    for i, t in enumerate(ts)]
+
+        return LoweredStep(fn)
 
     def _transform(self, arr: Any, target=None) -> Any:
         xp = _xp(arr)

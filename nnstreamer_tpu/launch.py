@@ -126,11 +126,22 @@ def main(argv=None) -> int:
                          "127.0.0.1:PORT while the pipeline runs "
                          "(GET /metrics; same effect as "
                          "NNS_METRICS_PORT)")
+    ap.add_argument("--fuse", default=None,
+                    choices=["interpret", "python", "xla"],
+                    help="segment-compiler lowering tier "
+                         "(pipeline/schedule.py): 'interpret' = per-pad "
+                         "dispatch, 'python' = fused plan_step loops "
+                         "(default), 'xla' = whole-segment jitted XLA "
+                         "computations with double-buffered device "
+                         "pipelining (segments with non-lowerable steps "
+                         "fall back to python — --check reports them as "
+                         "xla-fallback warnings).  Same as NNS_FUSE="
+                         "0|1|xla")
     ap.add_argument("--no-fuse", action="store_true",
                     help="disable the segment compiler: interpreted "
                          "per-pad dispatch (the baseline "
                          "tools/hotpath_bench.py --stage dispatch "
-                         "compares against)")
+                         "compares against); same as --fuse interpret")
     ap.add_argument("--jax-trace", default=None, metavar="DIR",
                     help="record a device-level JAX/XLA profiler trace "
                          "into DIR (TensorBoard profile format): per-op "
@@ -168,6 +179,17 @@ def main(argv=None) -> int:
     if not args.pipeline:
         ap.error("pipeline launch string required (or use --inspect)")
 
+    if args.no_fuse:
+        args.fuse = "interpret"
+    if args.fuse is not None:
+        # via the env so every pipeline this process builds — including
+        # the --check graph and any serving sub-pipelines — inherits the
+        # requested lowering tier
+        import os as _os
+
+        _os.environ["NNS_FUSE"] = {"interpret": "0", "python": "1",
+                                   "xla": "xla"}[args.fuse]
+
     from .utils.platform import honor_jax_platforms
 
     honor_jax_platforms()
@@ -180,12 +202,7 @@ def main(argv=None) -> int:
     t0 = time.time()
     slo_failed = False
     try:
-        if args.no_fuse:
-            from .pipeline.graph import Pipeline
-
-            p = parse_launch(args.pipeline, Pipeline(fuse=False))
-        else:
-            p = parse_launch(args.pipeline)
+        p = parse_launch(args.pipeline)   # tier from NNS_FUSE (set above)
         if args.print_sink:
             sink = p.get(args.print_sink)
             sink.connect("new-data", _print_buffer)

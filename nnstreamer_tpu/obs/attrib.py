@@ -21,11 +21,15 @@ lifetime maps to exactly one):
                           annotations)
 ``queue-wait``            inside a ``queue`` element's chain (full-queue
                           backpressure), the residency gap crossing a
-                          queue thread boundary, or a frame's residency
+                          queue thread boundary, a frame's residency
                           in a COLLECTING batch bucket (tensor_filter
                           micro-batch collect→dispatch, and the
                           cross-stream bucket behind a batching
-                          tensor_query_serversrc — query/server.py)
+                          tensor_query_serversrc — query/server.py),
+                          or the fuse-xla double-buffer residency (a
+                          finished frame held one slot so downstream's
+                          D2H overlaps the next frame's compute —
+                          pipeline/schedule.py)
 ``admission-wait``        server side: frame sat in the bounded incoming
                           queue before the serving pipeline picked it up
 ``wire``                  inside ``tensor_query_client``'s round trip,
@@ -36,7 +40,10 @@ lifetime maps to exactly one):
                           window is SHARED: every frame of a bucket
                           annotates the same dispatch+materialization
                           interval — per-frame wall-clock truth, not a
-                          1/n share
+                          1/n share.  Under fuse-xla the window covers
+                          the WHOLE segment's single jitted
+                          computation: the per-element serialize/
+                          dispatch shares the lowering collapsed
 ``device-compile``        first-call JIT compilation (split from invoke)
 ``reorder-wait``          a finished result holding for stream order
                           (filter worker pool's strict-seq pusher)

@@ -184,6 +184,20 @@ class Profiler:
         evidence = attrib.queueing_evidence(metrics_report)
         if evidence:
             out["queueing_evidence"] = evidence
+        # segment-lowering evidence next to the blame: which elements
+        # run fused, at which tier, and whether the fuse-xla executable
+        # cache is serving warm (steady-state compiles are the
+        # recompile-churn smell the hotpath gate pins).  A profile of a
+        # fuse-xla pipeline is judged BY this pairing: the collapsed
+        # per-element shares in the blame table, the plan rows naming
+        # what collapsed them.
+        planner = getattr(self.pipeline, "planner", None)
+        if planner is not None:
+            plans = planner.plans()
+            if plans:
+                out["plans"] = plans
+                out["lowering"] = getattr(self.pipeline, "fuse_tier",
+                                          "python")
         # device gauges read RAW (snapshot_state), not through the
         # report's 4-decimal rounding: a streaming MFU of 5e-6 is the
         # entire point of the measurement, not a rounding victim

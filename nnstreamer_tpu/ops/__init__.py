@@ -1,5 +1,7 @@
 """TPU ops: pallas kernels + jitted primitives for stream hot paths."""
 
+from .classify import top1, topk_indices
 from .preprocess import normalize_frame, normalize_frame_reference
 
-__all__ = ["normalize_frame", "normalize_frame_reference"]
+__all__ = ["normalize_frame", "normalize_frame_reference", "top1",
+           "topk_indices"]

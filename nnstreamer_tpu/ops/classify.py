@@ -1,0 +1,39 @@
+"""Classifier post-processing reductions as pure jax ops.
+
+The argmax-style decoders (image_labeling and friends) reduce a score
+vector to one index; done on host they force a full d2h fetch of the
+logits (1001 floats for MobileNet) per frame.  Expressed here as pure
+jnp functions they serve BOTH device paths that keep the logits
+resident:
+
+- the decoder reduction pushdown (``Decoder.device_reduce_spec``):
+  the reduction composes into the upstream filter's jitted forward via
+  ``set_postprocess`` and only the (1,) int32 index crosses to host;
+- whole-segment XLA lowering (``Decoder.lower_decode``, fuse=xla):
+  the reduction is traced into the segment's single fused computation.
+
+Kept op-shaped (tensor in, tensor out, no config/buffer types) so the
+same kernels slot into future decoders — top-k detection heads, CTC
+collapse — without touching the decoder ABI.
+"""
+
+from __future__ import annotations
+
+
+def top1(scores):
+    """Flattened argmax as a ``(1,)`` int32 tensor — the image_labeling
+    reduction.  Pure jnp; traceable under jit/vmap (a vmapped segment
+    reduces every bucket row independently)."""
+    import jax.numpy as jnp
+
+    return jnp.argmax(scores.reshape(-1)).astype(jnp.int32).reshape(1)
+
+
+def topk_indices(scores, k: int):
+    """Top-k flattened indices, descending, as ``(k,)`` int32 — the
+    multi-label generalization (k is static under jit)."""
+    import jax.numpy as jnp
+
+    flat = scores.reshape(-1)
+    _, idx = __import__("jax").lax.top_k(flat, k)
+    return idx.astype(jnp.int32)

@@ -91,6 +91,38 @@ class QoSEvent(Event):
                 f"proportion={self.proportion:.2f})")
 
 
+class LoweredStep:
+    """One element's contribution to a whole-segment XLA computation
+    (``fuse=xla`` lowering tier, pipeline/schedule.py).
+
+    ``fn(params, tensors) -> tensors`` must be a PURE jax-traceable
+    function over a list of array payloads: no host materialization
+    (``TensorBuffer.np()``/``np.asarray`` — enforced by the nnslint
+    ``host-sync-in-lower`` rule), no buffer metadata access, no side
+    effects.  ``params`` is the element's device pytree (weights for a
+    filter, ``None`` for stateless transforms); the segment compiler
+    passes it as a jit ARGUMENT, not a closure constant, so weights are
+    never baked into the compiled graph (no constant-folding bloat, no
+    stale weights silently embedded).  A model update still drops the
+    plan via the custom-event invalidation (epoch machinery) and the
+    segment re-lowers against the new state on its next buffer.
+
+    ``post`` (optional) is a cheap host finisher ``post(buf) -> buf``
+    run at SEGMENT EXIT, outside the jitted region — the escape hatch
+    for decoders whose output is not a tensor (label lookup over a
+    device-reduced argmax index).  Only the LAST element of a segment
+    may carry one; an interior ``post`` makes the segment fall back to
+    fuse-python.
+    """
+
+    __slots__ = ("fn", "params", "post")
+
+    def __init__(self, fn, params=None, post=None) -> None:
+        self.fn = fn
+        self.params = params
+        self.post = post
+
+
 class PadDirection(enum.Enum):
     SRC = "src"
     SINK = "sink"
@@ -384,6 +416,19 @@ class Element:
                 return True
         return False
 
+    def has_pending_input(self) -> bool:
+        """Is another in-band item (buffer or event) ALREADY queued for
+        this element's streaming thread?  The fuse-xla double buffer
+        (schedule.py) holds a finished frame for compute/D2H overlap
+        only while this answers True — when the already-queued item is
+        processed it either pushes (buffer) or flushes (event) the held
+        slot, so a quiescent stream can never strand a frame: sparse
+        request/response traffic gets synchronous push, saturated
+        streams get the overlap.  Default False (no hold); boundary
+        elements with a visible input queue (appsrc fifo, queue)
+        override."""
+        return False
+
     def plan_step(self):
         """Fused-dispatch hook (schedule.py segment compiler).
 
@@ -400,6 +445,31 @@ class Element:
         an element may change its answer when its configuration changes
         (e.g. tensor_filter with batch>1 or workers>1 opts out)."""
         return None
+
+    def lower_step(self) -> "Optional[LoweredStep]":
+        """XLA-lowering hook (schedule.py ``fuse=xla`` tier).
+
+        Return a :class:`LoweredStep` whose ``fn(params, tensors)`` is a
+        pure jax-traceable twin of this element's per-buffer work, and
+        the whole fused segment compiles into ONE jitted computation —
+        every element boundary's serialize/dispatch cost collapses into
+        a single device invoke, and intermediate tensors never touch the
+        host.  Return ``None`` (the default) to keep the segment at the
+        ``fuse-python`` tier; :meth:`lower_reason` then names why.
+
+        Queried at plan-compile time (post-negotiation, like
+        :meth:`plan_step`), and re-queried on every plan rebuild, so the
+        answer may change with configuration, caps, or model state."""
+        return None
+
+    def lower_reason(self) -> "Optional[str]":
+        """Why this element cannot join a whole-segment XLA computation:
+        a reason string, or ``None`` when it is expected to lower.  Must
+        be safe to call BEFORE ``start()`` (property-level assessment) —
+        the static verifier reports these as ``xla-fallback`` warnings
+        from ``launch.py --check`` when ``fuse=xla`` is requested."""
+        return (f"{self.FACTORY or type(self).__name__} has no "
+                "lower_step implementation")
 
     def get_allowed_caps(self, sink_pad: Pad) -> Caps:
         """Answer a downstream caps query on ``sink_pad``.  Default: the pad

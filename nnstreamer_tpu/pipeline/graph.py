@@ -10,7 +10,6 @@ plays between decoupled segments.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 import queue as _queue
@@ -60,7 +59,7 @@ class Pipeline:
         p.run()          # play + wait EOS + stop
     """
 
-    def __init__(self, name: str = "pipeline", fuse: Optional[bool] = None):
+    def __init__(self, name: str = "pipeline", fuse=None):
         self.name = name
         self.tracer = None          # set by enable_tracing()
         self.elements: List[Element] = []
@@ -69,12 +68,16 @@ class Pipeline:
         self._eos_sinks: set = set()
         self._cv = make_condition("pipeline.state")
         self._playing = False
-        #: fused segment dispatch (schedule.py): compile maximal linear
-        #: element runs into flat plans at play().  On by default;
-        #: ``fuse=False`` (or NNS_FUSE=0) keeps interpreted per-pad
-        #: dispatch — the baseline the dispatch bench compares against.
-        self.fuse = (os.environ.get("NNS_FUSE", "1") != "0"
-                     if fuse is None else bool(fuse))
+        #: lowering tier of the segment compiler (schedule.py):
+        #: ``interpret`` (no fusion — the dispatch-bench baseline),
+        #: ``python`` (flat plan_step loops, the default), or ``xla``
+        #: (whole-segment jitted computations).  ``fuse`` accepts the
+        #: historical booleans, a tier name, or None = the NNS_FUSE env
+        #: ("0" | "1" | "xla"); ``self.fuse`` stays the boolean view.
+        from .schedule import resolve_tier
+
+        self.fuse_tier = resolve_tier(fuse)
+        self.fuse = self.fuse_tier != "interpret"
         self.planner = None         # SegmentPlanner while playing
         #: readiness lifecycle surfaced by the /healthz endpoint
         #: (obs/httpd.py): starting -> serving -> draining; "degraded"
@@ -259,8 +262,11 @@ class Pipeline:
 
         self.tracer = Tracer(spans=spans)
         if self.planner is not None:
-            # compiled executors bind the tracer at compile time: rebuild
-            self.planner.invalidate()
+            # compiled executors bind the tracer at compile time: swap
+            # the wrappers in place, keeping the cached step lists and
+            # warm fuse-xla executables (a profiler attaching to a warm
+            # pipeline must not trigger a cold device-compile)
+            self.planner.retrace()
         return self.tracer
 
     def query_latency(self) -> "tuple[int, Dict[str, int]]":
@@ -571,6 +577,12 @@ class Queue(Element):
     def get_allowed_caps(self, sink_pad):
         return self.src_pad.peer_allowed_caps()
 
+    def has_pending_input(self) -> bool:
+        # fuse-xla double-buffer gate (see Element.has_pending_input):
+        # the drain thread heads the downstream segment, and _q holds
+        # what it will process next
+        return not self._q.empty()
+
     def static_check(self):
         try:
             cap = int(self.max_size_buffers)
@@ -730,6 +742,12 @@ class AppSrc(Source):
 
     def push_buffer(self, buf: TensorBuffer) -> None:
         self._fifo.put(buf)
+
+    def has_pending_input(self) -> bool:
+        # fuse-xla double-buffer gate: hold a finished frame only while
+        # the fifo already carries the next item (buffer OR event — an
+        # event flushes the held slot when it drains)
+        return not self._fifo.empty()
 
     def push_event(self, event: Event) -> None:
         """Queue a downstream event IN-BAND: it is delivered from the

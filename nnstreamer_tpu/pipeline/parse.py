@@ -80,6 +80,14 @@ class CapsFilter(Element):
         # pure passthrough, so fused dispatch elides it entirely
         return self._passthrough
 
+    def lower_reason(self):
+        return None
+
+    def lower_step(self):
+        from .element import LoweredStep
+
+        return LoweredStep(lambda params, ts: ts)
+
 
 def _coerce(value: str):
     try:

@@ -7,30 +7,77 @@ when each element is a trivial transform.  The NNStreamer paper's pipeline
 parallelism (Ham et al., arXiv:1901.04985) decides *where* thread
 boundaries go; StreamTensor (arXiv:2509.13694) shows the complementary win
 of compiling linear dataflow *segments* into one fused kernel instead of
-interpreting the graph per item.  This module does the latter at the
-scheduling layer:
+interpreting the graph per item, and "Pushing Tensor Accelerators Beyond
+MatMul" (arXiv:2512.02371) shows pre/post-processing folding into the
+accelerated region.  This module does both at the scheduling layer,
+through a three-tier **lowering interface**:
+
+``interpret``
+    No segment compiler at all: per-pad ``Pad.push`` dispatch (the
+    baseline the dispatch bench compares against).  ``fuse=False`` /
+    ``NNS_FUSE=0``.
+``fuse-python``
+    The PR 3 tier (default): maximal linear element runs flatten into
+    one loop over bound :meth:`~nnstreamer_tpu.pipeline.element.Element.
+    plan_step` closures — the per-element *dispatch* cost is gone, but
+    each step still executes host Python and every device-touching step
+    pays its own dispatch/serialize boundary.
+``fuse-xla``
+    Net-new (``fuse="xla"`` / ``NNS_FUSE=xla``): a segment whose every
+    step also offers :meth:`~nnstreamer_tpu.pipeline.element.Element.
+    lower_step` compiles its whole transform→filter→decode chain into
+    ONE jitted XLA computation.  Per-element ``serialize``+``dispatch``
+    shares collapse into a single per-segment ``device-invoke`` window
+    (the PR 8 profiler adjudicates), intermediate tensors stay device-
+    resident, and the ``TensorBuffer.np()`` sync point moves to segment
+    exit.  Segments with any non-lowerable step fall back to
+    fuse-python automatically (the plan row records the element and
+    reason; ``launch.py --check`` warns via analysis/verify.py).
+
+Mechanics shared by the fused tiers:
 
 - At ``Pipeline.play()`` a :class:`SegmentPlanner` walks the pad graph and
   finds every **head pad** — a src pad whose owning element is a thread/
   topology boundary (Source, Queue, Tee branch, mux, demux, any opt-out
   element).  Linear 1-sink/1-src elements downstream of a head that
-  expose :meth:`~nnstreamer_tpu.pipeline.element.Element.plan_step` are
-  **fused**: the head pad's ``push`` becomes one flat loop over bound
-  step callables, ending in the boundary element's ``_chain_entry``.
+  expose ``plan_step`` are **fused**: the head pad's ``push`` becomes one
+  flat executor ending in the boundary element's ``_chain_entry``.
 - Plans compile **lazily on the first buffer** (caps have been negotiated
   by then — buffers follow caps in-band) and cache the negotiated state
   inside the bound closures.
 - Plans **invalidate** on caps renegotiation, on custom events
-  (model-update), on request-pad linking after play, and on
-  ``enable_tracing`` — the head falls back to a compile stub and the next
-  buffer rebuilds against current state.  Elements that opt out
-  (``plan_step() -> None``) simply terminate the fused run; dataflow
-  continues interpreted, bit-for-bit identical.
-- Tracing: with a tracer attached, the compiled executor wraps each step
-  in the same ``enter``/``exit(name)`` pair ``_chain_entry`` uses, so
-  per-element proctime/buffers counters are exactly those of interpreted
-  dispatch.  With no tracer the executor contains **zero** tracer
-  references — fusion is how tracing costs nothing when off.
+  (model-update), and on request-pad linking after play — the head falls
+  back to a compile stub and the next buffer rebuilds against current
+  state.  ``enable_tracing`` does NOT invalidate: :meth:`SegmentPlanner.
+  retrace` reuses the cached step list and compiled XLA executables and
+  swaps only the executor wrapper, so attaching a profiler to a warm
+  fuse-xla pipeline never triggers a cold ``device-compile``.
+- fuse-xla executables are cached per segment keyed on (plan epoch via
+  plan lifetime, input caps identity via the bound closures, concrete
+  stacked shape/dtype signature): a steady-state stream or a PR 9
+  padded-bucket stream compiles each distinct signature ONCE
+  (:class:`SegmentExec` counts ``compiles`` vs ``exec_cache_hits`` —
+  the hotpath gate pins a 100 % hit rate after warmup).
+- fuse-xla dispatch is **double-buffered** (``NNS_FUSE_DEPTH``, default
+  2): the executor holds the previous frame's in-flight output and
+  pushes it downstream only when the next frame has been dispatched, so
+  the consumer's D2H sync on frame k-1 overlaps frame k's compute (and
+  frame k+1's H2D rides jax's async dispatch).  The hold is gated on
+  ``Element.has_pending_input()`` — a frame is kept ONLY while the head
+  already has the next in-band item queued, so a quiescent stream
+  (sparse request/response traffic) pushes synchronously and can never
+  strand a reply in the slot.  Any in-band event flushes the pending
+  slot first, so data-vs-event order is exact.
+- Tracing: with a tracer attached, the fuse-python executor wraps each
+  step in the same ``enter``/``exit(name)`` pair ``_chain_entry`` uses,
+  so per-element proctime/buffers counters are exactly those of
+  interpreted dispatch.  The fuse-xla traced executor records one
+  ``device-invoke`` (or first-call ``device-compile``) state window for
+  the whole segment plus zero-duration per-element marks (buffers
+  counters survive; per-element proctime is structurally gone — the
+  elements executed jointly).  With no tracer the executors contain
+  **zero** tracer references — fusion is how tracing costs nothing
+  when off.
 
 Install/uninstall works by shadowing ``Pad.push`` with an instance
 attribute on head pads only: interpreted pipelines never pay a check, and
@@ -39,10 +86,38 @@ attribute on head pads only: interpreted pipelines never pay a check, and
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..analysis.sanitizer import make_rlock
-from .element import Element, FlowReturn, Pad
+from .element import Element, FlowReturn, LoweredStep, Pad
+
+#: the lowering tiers, in ascending order of ambition
+FUSE_TIERS = ("interpret", "python", "xla")
+
+
+def resolve_tier(value=None) -> str:
+    """Normalize a ``fuse=`` value (bool / str / None) to a tier name.
+
+    ``None`` reads ``NNS_FUSE`` (default ``python``); booleans keep the
+    historical ``fuse=False`` → interpret meaning; strings accept the
+    tier names plus ``0``/``1``/``fuse-python``/``fuse-xla`` spellings.
+    """
+    if value is None:
+        value = os.environ.get("NNS_FUSE", "1")
+    if isinstance(value, bool):
+        return "python" if value else "interpret"
+    v = str(value).strip().lower()
+    if v in ("0", "false", "no", "off", "interpret", "none"):
+        return "interpret"
+    if v in ("", "1", "true", "yes", "on", "python", "fuse-python"):
+        return "python"
+    if v in ("2", "xla", "fuse-xla"):
+        return "xla"
+    raise ValueError(
+        f"unknown fuse tier {value!r} (interpret | python | xla)")
 
 
 def _is_linear_fusable(el: Element) -> bool:
@@ -52,11 +127,132 @@ def _is_linear_fusable(el: Element) -> bool:
             and el.plan_step() is not None)
 
 
+class SegmentExec:
+    """Compiled whole-segment XLA computation + its executable cache.
+
+    One instance per fuse-xla plan.  ``fns`` are the per-element
+    :class:`~nnstreamer_tpu.pipeline.element.LoweredStep` functions in
+    segment order; ``params`` their device pytrees, passed as jit
+    ARGUMENTS (not closure constants) so weights never bake into the
+    compiled graph as constants (a model update drops the plan — and
+    this instance with it — via the epoch machinery; the point of the
+    argument plumbing is avoiding constant-folded weights, not
+    surviving the swap).  Executables are AOT-compiled
+    (``jax.jit(...).lower(...).compile()``) and cached per concrete
+    input signature — per-frame shape, and each padded-bucket stacked
+    shape (PR 9 ``pad_rows`` quantization) — so a steady-state stream
+    never re-traces: ``compiles``/``hits`` are the evidence the
+    hotpath ``fusexla`` gate pins (100 % hits after warmup).
+    """
+
+    __slots__ = ("fns", "params", "post", "compiles", "hits", "_cache")
+
+    def __init__(self, fns, params, post=None) -> None:
+        self.fns = tuple(fns)
+        self.params = tuple(params)
+        self.post = post
+        self.compiles = 0
+        self.hits = 0
+        self._cache: Dict[tuple, object] = {}
+
+    # pure; traced once per distinct input signature
+    def _composed(self, params, *arrays):
+        ts = list(arrays)
+        for fn, p in zip(self.fns, params):
+            ts = list(fn(p, ts))
+        return tuple(ts)
+
+    @staticmethod
+    def _materialize(arrays) -> list:
+        from ..tensor.buffer import BatchView
+
+        return [a.device_slice() if isinstance(a, BatchView) else a
+                for a in arrays]
+
+    @staticmethod
+    def _sig(arrays, extra: tuple = ()) -> tuple:
+        return extra + tuple(
+            (tuple(a.shape), str(a.dtype), bool(getattr(a, "weak_type",
+                                                        False)))
+            for a in arrays)
+
+    def _compile(self, key: tuple, fun, args) -> object:
+        import jax
+
+        self.compiles += 1
+        exe = jax.jit(fun).lower(self.params, *args).compile()
+        self._cache[key] = exe
+        return exe
+
+    def run(self, arrays) -> list:
+        """One per-frame dispatch through the fused executable."""
+        arrays = self._materialize(arrays)
+        key = self._sig(arrays)
+        exe = self._cache.get(key)
+        if exe is None:
+            exe = self._compile(key, self._composed, arrays)
+        else:
+            self.hits += 1
+        return list(exe(self.params, *arrays))
+
+    def run_stacked(self, arrays, n: int, capacity: int) -> list:
+        """One cross-stream bucket (PR 9 stacked ``(n, …)`` buffers)
+        through the vmapped fused executable: rows pad to the
+        ``pad_rows`` quantization (repeating the last live row, the
+        padded-bucket policy ``_jitexec.invoke_stacked`` set) so a
+        BOUNDED executable set serves every fill level — rows past
+        ``n`` are padding and are never replied (XBatchMeta contract).
+        Returns the PADDED stacked outputs."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..filter.backends._jitexec import JitExecMixin
+        from ..tensor.buffer import is_device_array
+
+        bucket = JitExecMixin.pad_rows(n, capacity)
+        padded = []
+        for arr in self._materialize(arrays):
+            rows = int(arr.shape[0])
+            if rows < bucket:
+                if is_device_array(arr):
+                    pad = arr[-1:]
+                    arr = jnp.concatenate(
+                        [arr, jnp.broadcast_to(
+                            pad, (bucket - rows,) + tuple(pad.shape[1:]))],
+                        axis=0)
+                else:
+                    arr = np.asarray(arr)
+                    arr = np.concatenate(
+                        [arr, np.broadcast_to(
+                            arr[-1:],
+                            (bucket - rows,) + arr.shape[1:])], axis=0)
+            padded.append(arr)
+        key = self._sig(padded, extra=("xb",))
+        exe = self._cache.get(key)
+        if exe is None:
+            vmapped = jax.vmap(self._composed,
+                               in_axes=(None,) + (0,) * len(padded))
+            exe = self._compile(key, vmapped, padded)
+        else:
+            self.hits += 1
+        return list(exe(self.params, *padded))
+
+
 class SegmentPlanner:
     """Owns the fused dispatch plans of one playing pipeline."""
 
     def __init__(self, pipeline) -> None:
         self.pipeline = pipeline
+        #: lowering tier ("python" | "xla"); "interpret" never constructs
+        #: a planner (Pipeline.play gates on pipeline.fuse)
+        self.tier = getattr(pipeline, "fuse_tier", "python")
+        #: fuse-xla double-buffer depth: 1 = synchronous push, 2 (the
+        #: default) holds one in-flight output so downstream D2H overlaps
+        #: the next frame's compute.  Tunable via NNS_FUSE_DEPTH.
+        try:
+            self.depth = max(1, int(os.environ.get("NNS_FUSE_DEPTH", "2")))
+        except ValueError:
+            self.depth = 2
         self._lock = make_rlock("planner")
         self._heads: List[Pad] = []
         self._plans: Dict[str, Dict] = {}   # head full_name -> plan info
@@ -77,6 +273,13 @@ class SegmentPlanner:
         with self._lock:
             for head in self._heads:
                 head.__dict__.pop("push", None)
+                head.__dict__.pop("push_event", None)
+                head.__dict__.pop("_nns_pending", None)
+            for plan in self._plans.values():
+                pad = plan["_pad"]
+                pad.__dict__.pop("push", None)
+                pad.__dict__.pop("push_event", None)
+                pad.__dict__.pop("_nns_pending", None)
             self._heads = []
             self._plans.clear()
 
@@ -87,8 +290,8 @@ class SegmentPlanner:
         renegotiation, custom events) — SCOPED, so an event delivered
         late on a queue's drain thread does not wipe an upstream
         segment's plan that will never see another buffer (and unrelated
-        segments never pay a recompile).  ``element=None`` (tracer
-        attach, graph change) drops everything.
+        segments never pay a recompile).  ``element=None`` (graph
+        change) drops everything.
 
         Head-set reconciliation matters because ``plan_step`` answers are
         state-dependent: an element that could not fuse before
@@ -120,20 +323,48 @@ class SegmentPlanner:
         + head-set rebuild."""
         self.invalidate()
 
+    def retrace(self) -> None:
+        """A tracer attached (or detached): swap every compiled plan's
+        executor wrapper in place, KEEPING the cached step list and the
+        fuse-xla executable cache.  The previous behavior (a full
+        ``invalidate``) forced a whole-plan recompile — for fuse-xla
+        that meant ``launch.py --profile`` against a warm pipeline paid
+        a cold XLA ``device-compile`` just to change the wrapper."""
+        with self._lock:
+            for plan in self._plans.values():
+                head = plan["_pad"]
+                seg = plan.get("_seg")
+                if seg is not None:
+                    executor = self._make_xla_executor(
+                        head, plan["_steps"], plan["_tail_pad"],
+                        plan["_count"], seg)
+                else:
+                    executor = self._make_executor(
+                        head, plan["_steps"], plan["_tail_pad"],
+                        plan["_count"])
+                head.push = executor
+
     def plans(self) -> List[Dict]:
         """Snapshot of the compiled plans (observability / tests / bench):
         one dict per fused segment with ``head``, ``elements`` (fused
         element names in order), ``tail`` (the boundary element the
-        segment pushes into) and ``dispatches`` (plan executions so
-        far — a cross-stream batch buffer of N frames counts ONE: the
-        whole bucket traverses the fused segment as a single plan
-        execution, which is exactly the per-frame dispatch tax the
-        serving-plane batcher amortizes)."""
+        segment pushes into), ``lowering`` (``python`` | ``xla``, plus
+        ``fallback`` diagnostics when an xla request fell back),
+        ``dispatches`` (plan executions so far — a cross-stream batch
+        buffer of N frames counts ONE: the whole bucket traverses the
+        fused segment as a single plan execution, which is exactly the
+        per-frame dispatch tax the serving-plane batcher amortizes) and,
+        for xla plans, ``compiles``/``exec_cache_hits`` — the
+        no-steady-state-recompiles evidence."""
         with self._lock:
             out = []
             for p in self._plans.values():
                 row = {k: v for k, v in p.items() if not k.startswith("_")}
                 row["dispatches"] = p["_count"][0]
+                seg = p.get("_seg")
+                if seg is not None:
+                    row["compiles"] = seg.compiles
+                    row["exec_cache_hits"] = seg.hits
                 out.append(row)
             return out
 
@@ -170,7 +401,58 @@ class SegmentPlanner:
             pad = el.src_pads[0].peer
         return steps, pad
 
+    # -- lowering ------------------------------------------------------------
+    def _lower_segment(self, steps) -> Tuple[Optional[SegmentExec],
+                                             List[Dict]]:
+        """Lower every step of a segment into one :class:`SegmentExec`,
+        or return the fallback diagnostics (element + reason per
+        non-lowerable step) — the whole segment then runs fuse-python.
+        A host ``post`` finisher is only legal on the tail element."""
+        try:
+            import jax  # noqa: F401  — availability probe
+        except Exception as exc:  # noqa: BLE001 — env without jax
+            return None, [{"element": "<jax>",
+                           "reason": f"jax unavailable: {exc!r}"}]
+        fallback: List[Dict] = []
+        lowered: List[LoweredStep] = []
+        for i, (_, el) in enumerate(steps):
+            try:
+                ls = el.lower_step()
+            except Exception as exc:  # noqa: BLE001 — element-specific
+                fallback.append({"element": el.name,
+                                 "reason": f"lower_step raised {exc!r}"})
+                continue
+            if ls is None:
+                fallback.append({
+                    "element": el.name,
+                    "reason": el.lower_reason() or "lower_step "
+                                                  "returned None"})
+                continue
+            if ls.post is not None and i != len(steps) - 1:
+                fallback.append({
+                    "element": el.name,
+                    "reason": "host post-finisher only allowed on the "
+                              "segment tail"})
+                continue
+            lowered.append(ls)
+        if fallback:
+            return None, fallback
+        return SegmentExec([ls.fn for ls in lowered],
+                           [ls.params for ls in lowered],
+                           post=lowered[-1].post if lowered else None), []
+
     # -- compilation ---------------------------------------------------------
+    @staticmethod
+    def _flush_pending(head: Pad) -> None:
+        """Push out any double-buffered frames a DROPPED xla plan left
+        behind, before a non-xla executor takes over the head — each
+        entry carries its own tail binding, so the frames reach the
+        tail they were produced for, in order."""
+        pend = head.__dict__.get("_nns_pending")
+        while pend:
+            entry, tp, out, _t = pend.pop(0)
+            entry(tp, out)
+
     def _install_stub(self, head: Pad) -> None:
         def compile_and_push(buf, _head=head):
             return self._compile(_head)(buf)
@@ -187,19 +469,40 @@ class SegmentPlanner:
                 # nothing fusable downstream: restore interpreted dispatch
                 # for this head (an invalidate re-arms the stub, so a later
                 # renegotiation can still make the run fusable)
+                self._flush_pending(head)
                 head.__dict__.pop("push", None)
                 return lambda buf, _h=head: Pad.push(_h, buf)
             count = [0]
-            executor = self._make_executor(head, steps, tail_pad, count)
-            head.push = executor
-            self._plans[head.full_name] = {
+            plan = {
                 "head": head.full_name,
                 "elements": [el.name for _, el in steps],
                 "tail": tail_pad.element.name,
                 "epoch": self.epoch,
+                "lowering": "python",
                 "_pad": head,           # stripped from plans() snapshots
                 "_count": count,        # plan executions (mutable cell)
+                "_steps": steps,        # cached: retrace() reuses
+                "_tail_pad": tail_pad,
             }
+            seg = None
+            if self.tier == "xla":
+                seg, fallback = self._lower_segment(steps)
+                if seg is not None:
+                    plan["lowering"] = "xla"
+                    plan["_seg"] = seg
+                else:
+                    plan["fallback"] = fallback
+            if seg is not None:
+                executor = self._make_xla_executor(head, steps, tail_pad,
+                                                   count, seg)
+                if self.depth > 1:
+                    self._install_event_flush(head)
+            else:
+                self._flush_pending(head)
+                executor = self._make_executor(head, steps, tail_pad,
+                                               count)
+            head.push = executor
+            self._plans[head.full_name] = plan
             return executor
 
     def _make_executor(self, head: Pad, steps, tail_pad: Pad,
@@ -255,5 +558,162 @@ class SegmentPlanner:
                 pipeline.post_error(el, exc)
                 return ERROR
             return _tail(_tp, buf)
+
+        return run_traced
+
+    # -- fuse-xla executors --------------------------------------------------
+    def _install_event_flush(self, head: Pad) -> None:
+        """Shadow ``push_event`` on a double-buffered head: any in-band
+        event (caps, EOS, custom) flushes the pending output slot FIRST,
+        so events never overtake data the executor is still holding.
+        Installed once; survives plan invalidation (the pending slot may
+        still hold a frame produced by the dropped plan — each entry
+        carries its own tail binding)."""
+        if "push_event" in head.__dict__:
+            return
+        pending = head.__dict__.setdefault("_nns_pending", [])
+
+        def push_event_flush(event, _head=head, _pend=pending):
+            while _pend:
+                entry, tp, out, _t = _pend.pop(0)
+                entry(tp, out)
+            return Pad.push_event(_head, event)
+
+        head.push_event = push_event_flush
+
+    def _make_xla_executor(self, head: Pad, steps, tail_pad: Pad,
+                           count: List[int], seg: SegmentExec) -> Callable:
+        """The whole-segment executor: one fused device dispatch per
+        buffer (stacked PR 9 buckets ride the vmapped executable), a
+        two-slot pending queue for compute/D2H overlap, and a python-
+        tier escape for the one shape the jitted region cannot express
+        (a stacked bucket whose tail carries a host post-finisher)."""
+        pipeline = self.pipeline
+        tracer = pipeline.tracer
+        tail_entry = tail_pad.element._chain_entry
+        OK, EOS, ERROR = FlowReturn.OK, FlowReturn.EOS, FlowReturn.ERROR
+        depth = self.depth
+        pending = head.__dict__.setdefault("_nns_pending", [])
+        last_el = steps[-1][1]
+        py_run = self._make_executor(head, steps, tail_pad, count)
+        els = tuple(el for _, el in steps)
+        # double-buffer gate: hold a finished frame ONLY while the head
+        # element already has the next in-band item queued — overlap
+        # exactly when there is back-to-back work, synchronous push on a
+        # quiescent stream (a sparse request/response flow must never
+        # strand its reply in the pending slot)
+        more = head.element.has_pending_input
+
+        if tracer is None:
+            def run(buf, _head=head, _seg=seg, _tail=tail_entry,
+                    _tp=tail_pad, _n=count, _pend=pending, _depth=depth,
+                    _py=py_run, _last=last_el, _more=more):
+                if _head.eos:
+                    return EOS
+                xb = buf.extra.get("nns_xbatch")
+                if xb is not None and _seg.post is not None:
+                    # a host post-finisher is per-frame (label lookup);
+                    # it cannot run over a stacked bucket — python tier
+                    while _pend:
+                        entry, tp, out, _t = _pend.pop(0)
+                        entry(tp, out)
+                    return _py(buf)
+                _n[0] += 1
+                try:
+                    if xb is not None:
+                        outs = _seg.run_stacked(buf.tensors, xb.n,
+                                                xb.capacity)
+                    else:
+                        outs = _seg.run(buf.tensors)
+                    out = buf.with_tensors(outs)
+                    if _seg.post is not None:
+                        out = _seg.post(out)
+                except Exception as exc:  # noqa: BLE001 — pipeline error
+                    pipeline.post_error(_last, exc)
+                    return ERROR
+                if _depth > 1:
+                    _pend.append((_tail, _tp, out, 0))
+                    keep = _depth - 1 if _more() else 0
+                    ret = OK
+                    while len(_pend) > keep:
+                        entry, tp, held, _t = _pend.pop(0)
+                        ret = entry(tp, held)
+                    return ret
+                return _tail(_tp, out)
+
+            return run
+
+        from .tracing import annotate
+
+        def run_traced(buf, _head=head, _seg=seg, _tail=tail_entry,
+                       _tp=tail_pad, _tracer=tracer, _n=count,
+                       _pend=pending, _depth=depth, _py=py_run,
+                       _last=last_el, _els=els, _annotate=annotate,
+                       _more=more):
+            if _head.eos:
+                return EOS
+            xb = buf.extra.get("nns_xbatch")
+            if xb is not None and _seg.post is not None:
+                while _pend:
+                    entry, tp, out, _t = _pend.pop(0)
+                    entry(tp, out)
+                return _py(buf)
+            _n[0] += 1
+            import time as _time
+
+            try:
+                # the tail-most fused element's span covers the shared
+                # dispatch; the state annotation nests inside it, so
+                # attribution reads the window as device-invoke (the
+                # per-element serialize/dispatch shares it replaced)
+                _tracer.enter(_last.name, buf)
+                try:
+                    before = _seg.compiles
+                    t0 = _time.monotonic_ns()
+                    if xb is not None:
+                        outs = _seg.run_stacked(buf.tensors, xb.n,
+                                                xb.capacity)
+                    else:
+                        outs = _seg.run(buf.tensors)
+                    t1 = _time.monotonic_ns()
+                    _annotate("device-compile" if _seg.compiles > before
+                              else "device-invoke", t0, t1)
+                finally:
+                    _tracer.exit()
+                # zero-duration marks for the other fused elements keep
+                # their buffers counters truthful (proctime is jointly
+                # spent inside the fused window and cannot be split)
+                for el in _els:
+                    if el is not _last:
+                        _tracer.enter(el.name, buf)
+                        _tracer.exit()
+                out = buf.with_tensors(outs)
+                if _seg.post is not None:
+                    out = _seg.post(out)
+            except Exception as exc:  # noqa: BLE001 — pipeline error
+                pipeline.post_error(_last, exc)
+                return ERROR
+            if _depth > 1:
+                _pend.append((_tail, _tp, out, _time.monotonic_ns()))
+                keep = _depth - 1 if _more() else 0
+                ret = OK
+                while len(_pend) > keep:
+                    entry, tp, held, t_held = _pend.pop(0)
+                    if t_held:
+                        # the double-buffer residency is deliberate
+                        # pipelining (downstream's D2H overlapped the
+                        # next frame's compute): attribute it as
+                        # queue-wait, the PR 9 convention for residency
+                        # windows — not an uncovered dispatch gap
+                        seq = held.extra.get("nns_seq", -1)
+                        ctx = held.extra.get("nns_trace")
+                        _tracer.annotate_span(
+                            "queue-wait", t_held, _time.monotonic_ns(),
+                            seq=seq,
+                            trace_id=(ctx.trace_id if ctx is not None
+                                      and ctx.trace_id else 0))
+                    ret = entry(tp, held)
+                return ret
+            return _tail(_tp, out)
 
         return run_traced

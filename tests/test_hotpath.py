@@ -559,3 +559,22 @@ def test_hotpath_bench_admit_gate():
     assert r.returncode == 0, (
         f"admit gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
     assert '"hotpath_admit_gate"' in r.stdout
+
+
+@pytest.mark.perf
+def test_hotpath_bench_fusexla_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage fusexla fails
+    when whole-segment XLA lowering (fuse=xla, pipeline/schedule.py)
+    no longer sustains >= 2x fuse-python on the bucket-8
+    transform→filter→decode chain, when the chain stops lowering
+    (fallback to python), or when the per-segment executable cache
+    recompiles in steady state (the 100%-hit-after-warmup contract:
+    no per-fill or per-frame recompiles)."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "fusexla"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (
+        f"fusexla gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_fusexla_gate"' in r.stdout

@@ -1,0 +1,669 @@
+"""Whole-segment XLA lowering (PR 12): the ``fuse=xla`` tier.
+
+pipeline/schedule.py's three-tier lowering interface compiles a fused
+segment's transform→filter→decode chain into ONE jitted XLA computation
+when every step offers ``lower_step()``.  These tests pin the
+CORRECTNESS contract — byte-identical outputs across all three tiers
+(interpret | fuse-python | fuse-xla) including the uint8 quant paths,
+plan-lifecycle invalidation (caps renegotiation, model update), the
+automatic per-segment fallback to fuse-python on any non-lowerable
+step, stacked PR 9 bucket buffers through the vmapped segment
+executable with exact per-row order, and the tracer-attach executor
+swap that keeps warm executables.  The perf claim itself is gated by
+``tools/hotpath_bench.py --assert --stage fusexla`` (test_hotpath.py).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.pipeline.element import CapsEvent, CustomEvent
+from nnstreamer_tpu.pipeline.graph import Pipeline
+from nnstreamer_tpu.pipeline.schedule import resolve_tier
+from nnstreamer_tpu.tensor.buffer import TensorBuffer, XBatchMeta
+
+TIERS = ("interpret", "python", "xla")
+
+F32_CAPS = ("other/tensors,format=static,num_tensors=1,dimensions=64,"
+            "types=float32,framerate=0/1")
+U8_CAPS = ("other/tensors,format=static,num_tensors=1,dimensions=64,"
+           "types=uint8,framerate=0/1")
+MLP = ("tensor_filter framework=xla model=mlp "
+       "custom=in_dim:64,width:32,depth:1,out_dim:8 name=f")
+
+
+def _run_tier(launch, tier, bufs, timeout=120):
+    """Run ``launch`` under one lowering tier, feed ``bufs``, return
+    (output buffers, plans snapshot)."""
+    p = parse_launch(launch, Pipeline(fuse=tier))
+    got = []
+    p.get("out").connect("new-data", lambda b: got.append(b))
+    p.play()
+    src = p.get("in")
+    for buf in bufs:
+        src.push_buffer(buf)
+    src.end_of_stream()
+    p.wait(timeout=timeout)
+    plans = p.planner.plans() if p.planner is not None else []
+    p.stop()
+    return got, plans
+
+
+def _frames(n, dim=64, dtype=np.float32, seed=3):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        if np.issubdtype(np.dtype(dtype), np.integer):
+            arr = rng.integers(0, 200, dim).astype(dtype)
+        else:
+            arr = rng.standard_normal(dim).astype(dtype)
+        out.append(TensorBuffer(tensors=[arr], pts=i))
+    return out
+
+
+def _tensor_bytes(buf, i=0):
+    arr = np.asarray(buf.tensors[i])
+    return arr.dtype.str, arr.shape, arr.tobytes()
+
+
+class TestTierResolution:
+    def test_resolve_tier_values(self):
+        assert resolve_tier(False) == "interpret"
+        assert resolve_tier(True) == "python"
+        assert resolve_tier("0") == "interpret"
+        assert resolve_tier("fuse-python") == "python"
+        assert resolve_tier("xla") == "xla"
+        assert resolve_tier("fuse-xla") == "xla"
+        with pytest.raises(ValueError):
+            resolve_tier("turbo")
+
+    def test_env_tier(self, monkeypatch):
+        monkeypatch.setenv("NNS_FUSE", "xla")
+        p = Pipeline()
+        assert p.fuse_tier == "xla" and p.fuse
+        monkeypatch.setenv("NNS_FUSE", "0")
+        p = Pipeline()
+        assert p.fuse_tier == "interpret" and not p.fuse
+
+    def test_explicit_fuse_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("NNS_FUSE", "0")
+        assert Pipeline(fuse="xla").fuse_tier == "xla"
+
+
+class TestGoldenEquivalence:
+    """interpret vs fuse-python vs fuse-xla: byte-identical outputs."""
+
+    def _golden(self, launch, bufs):
+        ref = None
+        for tier in TIERS:
+            got, plans = _run_tier(launch, tier,
+                                   [b.copy() for b in bufs])
+            sig = [_tensor_bytes(b) for b in got]
+            if ref is None:
+                ref = sig
+            else:
+                assert sig == ref, f"tier {tier} diverged"
+            if tier == "xla":
+                assert any(pl.get("lowering") == "xla" for pl in plans), \
+                    plans
+        return ref
+
+    def test_transform_arithmetic_float32(self):
+        self._golden(
+            f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+            "mode=arithmetic option=mul:2.0,add:1.0 ! "
+            "tensor_sink name=out", _frames(6))
+
+    def test_transform_uint8_quant_chain(self):
+        """The reference's quantized pre-processing shape: uint8 frames
+        through mul/add with a typecast back to uint8 — the dtype
+        round-trip must be bit-exact across tiers (operands chosen
+        inside f32-exact range, the documented lowering contract)."""
+        self._golden(
+            f"appsrc caps={U8_CAPS} name=in ! tensor_transform "
+            "mode=arithmetic option=mul:0.5,add:3.0,typecast:uint8 ! "
+            "tensor_sink name=out", _frames(6, dtype=np.uint8))
+
+    def test_transform_typecast_and_dimchg(self):
+        caps = ("other/tensors,format=static,num_tensors=1,"
+                "dimensions=4:3,types=uint8,framerate=0/1")
+        bufs = _frames(5, dim=(3, 4), dtype=np.uint8)
+        self._golden(
+            f"appsrc caps={caps} name=in ! tensor_transform "
+            "mode=typecast option=float32 ! tensor_transform "
+            "mode=dimchg option=0:1 ! tensor_sink name=out", bufs)
+
+    def test_filter_chain(self):
+        pytest.importorskip("jax")
+        self._golden(
+            f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+            f"mode=arithmetic option=mul:0.5 ! {MLP} ! "
+            "tensor_sink name=out", _frames(5))
+
+    def test_decoder_argmax_labels(self):
+        """image_labeling through the fused segment: the argmax reduces
+        on device (ops/classify.py top1 traced into the segment), the
+        label lookup runs as the host post-finisher — label and index
+        must match the host-decode tiers exactly."""
+        pytest.importorskip("jax")
+        results = {}
+        launch = (f"appsrc caps={F32_CAPS} name=in ! {MLP} ! "
+                  "tensor_decoder mode=image_labeling ! "
+                  "tensor_sink name=out")
+        for tier in TIERS:
+            got, _ = _run_tier(launch, tier, _frames(5))
+            results[tier] = [(b.extra["index"], b.extra["label"])
+                             for b in got]
+        assert results["interpret"] == results["python"] \
+            == results["xla"]
+
+    def test_direct_video_passthrough(self):
+        pytest.importorskip("jax")
+        caps = ("other/tensors,format=static,num_tensors=1,"
+                "dimensions=3,types=uint8,framerate=0/1")
+        self._golden(
+            f"appsrc caps={caps} name=in ! capsfilter ! "
+            "tensor_decoder mode=direct_video ! tensor_sink name=out",
+            _frames(4, dim=3, dtype=np.uint8))
+
+
+class TestMixedFallback:
+    def test_non_lowerable_step_falls_back_named(self):
+        """One non-lowerable element anywhere in the segment demotes
+        the WHOLE segment to fuse-python — correct dataflow, and the
+        plan row names the element and reason."""
+        got, plans = _run_tier(
+            f"appsrc caps={F32_CAPS} name=in ! identity ! "
+            "identity sleep-us=1 name=slow ! tensor_transform "
+            "mode=arithmetic option=add:1.0 ! tensor_sink name=out",
+            "xla", _frames(4))
+        assert [b.pts for b in got] == list(range(4))
+        (plan,) = [pl for pl in plans if pl["head"] == "in.src"]
+        assert plan["lowering"] == "python"
+        fb = {row["element"]: row["reason"] for row in plan["fallback"]}
+        assert "slow" in fb and "sleep-us" in fb["slow"]
+
+    def test_console_debug_falls_back_but_silent_lowers(self):
+        got, plans = _run_tier(
+            f"appsrc caps={F32_CAPS} name=in ! "
+            "tensor_debug output=silent ! tensor_sink name=out",
+            "xla", _frames(3))
+        assert len(got) == 3
+        (plan,) = plans
+        assert plan["lowering"] == "xla"
+        got, plans = _run_tier(
+            f"appsrc caps={F32_CAPS} name=in ! "
+            "tensor_debug output=silent capture=true name=dbg ! "
+            "tensor_sink name=out", "xla", _frames(3))
+        assert len(got) == 3
+        (plan,) = plans
+        assert plan["lowering"] == "python"
+        assert plan["fallback"][0]["element"] == "dbg"
+
+
+class TestPlanLifecycle:
+    def test_caps_renegotiation_rebuilds_executables(self):
+        caps8 = ("other/tensors,format=static,num_tensors=1,"
+                 "dimensions=8,types=float32,framerate=0/1")
+        p = parse_launch(
+            f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+            "mode=arithmetic option=mul:3.0 ! tensor_sink name=out",
+            Pipeline(fuse="xla"))
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b))
+        p.play()
+        src = p.get("in")
+        for buf in _frames(3):
+            src.push_buffer(buf)
+        deadline = time.monotonic() + 20
+        epoch0 = None
+        while time.monotonic() < deadline:
+            plans = p.planner.plans()
+            if plans and plans[0].get("lowering") == "xla":
+                epoch0 = plans[0]["epoch"]
+                break
+            time.sleep(0.005)
+        assert epoch0 is not None
+        from nnstreamer_tpu.pipeline.caps import Caps
+
+        src.push_event(CapsEvent(Caps.from_string(caps8)))
+        for i in range(3):
+            src.push_buffer(TensorBuffer(
+                tensors=[np.full(8, i, np.float32)], pts=10 + i))
+        src.end_of_stream()
+        p.wait(timeout=60)
+        plans = p.planner.plans()
+        p.stop()
+        assert len(got) == 6
+        assert [np.asarray(b.tensors[0]).shape for b in got] \
+            == [(64,)] * 3 + [(8,)] * 3
+        for i, b in enumerate(got[3:]):
+            np.testing.assert_allclose(np.asarray(b.tensors[0]),
+                                       np.full(8, i * 3.0))
+        assert plans[0]["epoch"] > epoch0
+        assert plans[0]["lowering"] == "xla"
+
+    def test_model_update_invalidates_cached_executables(self):
+        """tensor_filter_update_model swaps weights mid-stream: the
+        fused segment's cached executables must serve the NEW params —
+        outputs after the event match a fresh pipeline built on the
+        updated model."""
+        pytest.importorskip("jax")
+        launch = (f"appsrc caps={F32_CAPS} name=in ! tensor_filter "
+                  "framework=xla model=mlp "
+                  "custom=in_dim:64,width:32,depth:1,out_dim:8,seed:0 "
+                  "is-updatable=true name=f ! tensor_sink name=out")
+        frames = _frames(4, seed=11)
+        p = parse_launch(launch, Pipeline(fuse="xla"))
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b))
+        p.play()
+        src = p.get("in")
+        src.push_buffer(frames[0].copy())
+        src.push_buffer(frames[1].copy())
+        src.push_event(CustomEvent("tensor_filter_update_model",
+                                   {"seed": "7"}))
+        src.push_buffer(frames[2].copy())
+        src.push_buffer(frames[3].copy())
+        src.end_of_stream()
+        p.wait(timeout=120)
+        p.stop()
+        assert len(got) == 4
+        # reference: same frames through a seed-7 model from scratch
+        ref_launch = launch.replace("seed:0", "seed:7")
+        ref, _ = _run_tier(ref_launch, "xla",
+                           [f.copy() for f in frames])
+        np.testing.assert_allclose(np.asarray(got[2].tensors[0]),
+                                   np.asarray(ref[2].tensors[0]),
+                                   rtol=1e-6)
+        # and the pre-event frames served the OLD weights
+        assert not np.allclose(np.asarray(got[0].tensors[0]),
+                               np.asarray(ref[0].tensors[0]))
+
+    def test_tracer_attach_keeps_warm_executables(self):
+        """Satellite fix: enable_tracing used to invalidate the whole
+        plan — for fuse-xla that forced a cold XLA recompile just to
+        swap the executor wrapper.  retrace() must keep the compiled
+        executable cache (zero new compiles) while per-element buffers
+        counters and device-invoke state spans appear."""
+        pytest.importorskip("jax")
+        p = parse_launch(
+            f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+            f"mode=arithmetic option=mul:0.5 name=t ! {MLP} ! "
+            "tensor_sink name=out", Pipeline(fuse="xla"))
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b))
+        p.play()
+        src = p.get("in")
+
+        def feed(n, base):
+            for buf in _frames(n, seed=base):
+                src.push_buffer(buf)
+            deadline = time.monotonic() + 30
+            while len(got) < base + n - 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+
+        feed(6, 0)
+        plans = p.planner.plans()
+        (plan,) = plans
+        assert plan["lowering"] == "xla"
+        compiles0, epoch0 = plan["compiles"], plan["epoch"]
+        tracer = p.enable_tracing(spans=True)
+        feed(6, 6)
+        (plan,) = p.planner.plans()
+        assert plan["compiles"] == compiles0, \
+            "tracer attach recompiled the warm segment"
+        assert plan["epoch"] == epoch0
+        src.end_of_stream()
+        p.wait(timeout=60)
+        report = tracer.report()
+        spans = tracer.ring.snapshot()
+        p.stop()
+        assert len(got) == 12
+        assert report["t"]["buffers"] >= 5
+        assert report["f"]["buffers"] >= 5
+        assert any(s.name == "state:device-invoke" for s in spans)
+
+    def test_qos_throttle_demotes_then_restores(self):
+        """A QoS slowdown report makes the filter non-lowerable (the
+        drop state is host-side): the segment must fall back to
+        fuse-python and keep flowing; the catch-up report restores
+        lowerability on the next rebuild."""
+        pytest.importorskip("jax")
+        from nnstreamer_tpu.pipeline.element import QoSEvent
+
+        p = parse_launch(
+            f"appsrc caps={F32_CAPS} name=in ! {MLP} ! "
+            "tensor_sink name=out", Pipeline(fuse="xla"))
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b))
+        p.play()
+        src = p.get("in")
+        for buf in _frames(2):
+            src.push_buffer(buf)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            plans = p.planner.plans()
+            if plans and plans[0].get("lowering") == "xla":
+                break
+            time.sleep(0.005)
+        f = p.get("f")
+        # downstream reports it cannot keep up (jitter > 0): QoS events
+        # travel upstream from a consumer's SINK pad
+        p.get("out").sink_pad.push_upstream_event(
+            QoSEvent(timestamp=0, jitter_ns=50_000_000, proportion=2.0))
+        assert f._throttle_ns > 0
+        for buf in _frames(2, seed=9):
+            src.push_buffer(buf)
+        src.end_of_stream()
+        p.wait(timeout=60)
+        plans = p.planner.plans()
+        p.stop()
+        assert plans and plans[0]["lowering"] == "python"
+        assert any("QoS" in row["reason"]
+                   for row in plans[0]["fallback"])
+
+
+class TestAttributionCollapse:
+    def test_profiled_xla_run_conserves_and_collapses(self):
+        """The PR 8 adjudication: a profiled fuse-xla run keeps the
+        conservation guarantee (states sum to e2e wall time) while the
+        segment's work shows as device-invoke windows — and the profile
+        report carries the plan rows (lowering tier, cache counters)
+        next to the blame."""
+        pytest.importorskip("jax")
+        from nnstreamer_tpu.obs.profile import Profiler
+
+        p = parse_launch(
+            f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+            f"mode=arithmetic option=mul:0.5 ! {MLP} ! "
+            "tensor_sink name=out", Pipeline(fuse="xla"))
+        got = []
+        p.get("out").connect(
+            "new-data", lambda b: got.append(np.asarray(b.tensors[0])))
+        p.play()
+        src = p.get("in")
+        # warm first (compiles outside the profiled window), then attach
+        for buf in _frames(4):
+            src.push_buffer(buf)
+        deadline = time.monotonic() + 30
+        while len(got) < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        prof = Profiler(p)
+        try:
+            for buf in _frames(16, seed=5):
+                src.push_buffer(buf)
+            src.end_of_stream()
+            p.wait(timeout=120)
+            report = prof.report()
+        finally:
+            prof.close()
+            p.stop()
+        blame = report["blame"]
+        assert blame["frames"] >= 10
+        assert blame["conservation"]["attributed_pct"] >= 99.0
+        assert blame["states"].get("device-invoke", {}).get(
+            "total_ms", 0) > 0
+        assert report["lowering"] == "xla"
+        (plan,) = report["plans"]
+        assert plan["lowering"] == "xla"
+        assert plan["compiles"] >= 1
+        assert plan["exec_cache_hits"] >= 10
+
+
+class TestStackedBuckets:
+    """PR 9 cross-stream bucket buffers through the jitted segment."""
+
+    def _launch(self):
+        return (f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+                f"mode=arithmetic option=mul:2.0 ! {MLP} ! "
+                "tensor_sink name=out")
+
+    def _bucket_buf(self, rows, capacity, pts=0):
+        buf = TensorBuffer(tensors=[rows], pts=pts)
+        buf.extra["nns_xbatch"] = XBatchMeta(
+            [{"cid": i} for i in range(rows.shape[0])],
+            [pts] * rows.shape[0], capacity)
+        return buf
+
+    def test_full_bucket_exact_row_order(self):
+        pytest.importorskip("jax")
+        rng = np.random.default_rng(21)
+        rows = rng.standard_normal((8, 64)).astype(np.float32)
+        ref, _ = _run_tier(self._launch(), "python",
+                           [self._bucket_buf(rows.copy(), 8)])
+        got, plans = _run_tier(self._launch(), "xla",
+                               [self._bucket_buf(rows.copy(), 8)])
+        out = np.asarray(got[0].tensors[0])
+        np.testing.assert_allclose(out,
+                                   np.asarray(ref[0].tensors[0]),
+                                   rtol=1e-5, atol=1e-6)
+        # per-client split order: row i is exactly f(input row i)
+        solo_ref, _ = _run_tier(
+            self._launch(), "python",
+            [TensorBuffer(tensors=[rows[i]], pts=i) for i in range(8)])
+        for i in range(8):
+            np.testing.assert_allclose(
+                out[i], np.asarray(solo_ref[i].tensors[0]),
+                rtol=1e-4, atol=1e-5)
+        assert plans[0]["lowering"] == "xla"
+        assert got[0].extra["nns_xbatch"].n == 8
+
+    def test_partial_bucket_pads_without_recompile(self):
+        """Variable fills ride the pad_rows quantization: live rows are
+        exact, rows past n are padding, and two buckets of the same
+        padded shape share ONE executable (no per-fill recompiles)."""
+        pytest.importorskip("jax")
+        rng = np.random.default_rng(22)
+        rows5 = rng.standard_normal((5, 64)).astype(np.float32)
+        rows6 = rng.standard_normal((6, 64)).astype(np.float32)
+        bufs = [self._bucket_buf(rows5, 8, pts=0),
+                self._bucket_buf(rows6, 8, pts=1)]
+        got, plans = _run_tier(self._launch(), "xla", bufs)
+        ref5, _ = _run_tier(self._launch(), "python",
+                            [self._bucket_buf(rows5.copy(), 8)])
+        np.testing.assert_allclose(
+            np.asarray(got[0].tensors[0])[:5],
+            np.asarray(ref5[0].tensors[0])[:5], rtol=1e-5, atol=1e-6)
+        # 5 and 6 rows both pad to 8 (pad_rows): one executable, so the
+        # second bucket is a cache hit
+        (plan,) = plans
+        assert plan["compiles"] == 1
+        assert plan["exec_cache_hits"] == 1
+
+
+class TestDoubleBuffering:
+    def test_depth_env_and_eos_flush(self, monkeypatch):
+        """NNS_FUSE_DEPTH=1 disables pipelining; default depth 2 holds
+        one frame which any event (EOS here) flushes — no loss, exact
+        order either way."""
+        for depth in ("1", "2"):
+            monkeypatch.setenv("NNS_FUSE_DEPTH", depth)
+            got, plans = _run_tier(
+                f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+                "mode=arithmetic option=add:1.0 ! tensor_sink name=out",
+                "xla", _frames(7))
+            assert [b.pts for b in got] == list(range(7))
+            assert plans[0]["lowering"] == "xla"
+
+    def test_single_buffer_flushes_on_eos(self):
+        got, _ = _run_tier(
+            f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+            "mode=arithmetic option=add:1.0 ! tensor_sink name=out",
+            "xla", _frames(1))
+        assert len(got) == 1
+
+    def test_quiescent_stream_never_strands_a_frame(self):
+        """Sparse request/response traffic: a lone frame with NO
+        follow-up buffer and NO EOS must still be delivered promptly —
+        the double buffer holds only while ``has_pending_input`` says
+        the next item is already queued (a stranded reply here was the
+        failure mode of an unconditional two-slot hold)."""
+        p = parse_launch(
+            f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+            "mode=arithmetic option=add:1.0 ! tensor_sink name=out",
+            Pipeline(fuse="xla"))
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b))
+        p.play()
+        src = p.get("in")
+        try:
+            for i in range(3):      # one request at a time, stream open
+                src.push_buffer(TensorBuffer(
+                    tensors=[np.full(64, i, np.float32)], pts=i))
+                deadline = time.monotonic() + 10
+                while len(got) < i + 1 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.002)
+                assert len(got) == i + 1, \
+                    f"reply {i} stranded in the pending slot"
+        finally:
+            src.end_of_stream()
+            p.wait(timeout=30)
+            p.stop()
+        assert [b.pts for b in got] == [0, 1, 2]
+
+    def test_caps_event_flushes_pending_in_order(self):
+        """An in-band caps change must not overtake the held frame."""
+        caps8 = ("other/tensors,format=static,num_tensors=1,"
+                 "dimensions=8,types=float32,framerate=0/1")
+        p = parse_launch(
+            f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+            "mode=arithmetic option=add:0.0 ! tensor_sink name=out",
+            Pipeline(fuse="xla"))
+        got = []
+        p.get("out").connect("new-data", lambda b: got.append(b))
+        p.play()
+        src = p.get("in")
+        for buf in _frames(3):
+            src.push_buffer(buf)
+        from nnstreamer_tpu.pipeline.caps import Caps
+
+        src.push_event(CapsEvent(Caps.from_string(caps8)))
+        src.push_buffer(TensorBuffer(
+            tensors=[np.zeros(8, np.float32)], pts=3))
+        src.end_of_stream()
+        p.wait(timeout=60)
+        p.stop()
+        assert [b.pts for b in got] == [0, 1, 2, 3]
+        assert [np.asarray(b.tensors[0]).shape for b in got] \
+            == [(64,)] * 3 + [(8,)]
+
+
+class TestVerifierAndLint:
+    def test_verify_warns_xla_fallback_with_reason(self):
+        p = parse_launch(
+            f"appsrc caps={F32_CAPS} name=in ! identity sleep-us=5 "
+            "name=slow ! tensor_sink name=out", Pipeline(fuse="xla"))
+        findings = p.verify()
+        rows = [f for f in findings if f.rule == "xla-fallback"]
+        assert rows and "slow" in rows[0].path
+        assert "sleep-us" in rows[0].message
+        # python tier: no xla-fallback noise
+        p2 = parse_launch(
+            f"appsrc caps={F32_CAPS} name=in ! identity sleep-us=5 ! "
+            "tensor_sink name=out", Pipeline(fuse="python"))
+        assert not [f for f in p2.verify() if f.rule == "xla-fallback"]
+
+    def test_verify_quiet_when_chain_lowers(self):
+        p = parse_launch(
+            f"appsrc caps={F32_CAPS} name=in ! tensor_transform "
+            "mode=arithmetic option=add:1.0 ! tensor_sink name=out",
+            Pipeline(fuse="xla"))
+        assert not [f for f in p.verify() if f.rule == "xla-fallback"]
+
+    def test_nnslint_host_sync_in_lower(self, tmp_path):
+        import importlib.util
+        import sys
+
+        root = os.path.join(os.path.dirname(__file__), "..")
+        spec = importlib.util.spec_from_file_location(
+            "_nnslint_lowering_t", os.path.join(root, "tools",
+                                                "nnslint.py"))
+        nnslint = importlib.util.module_from_spec(spec)
+        # dataclass processing resolves the module via sys.modules
+        sys.modules[spec.name] = nnslint
+        try:
+            spec.loader.exec_module(nnslint)
+        finally:
+            sys.modules.pop(spec.name, None)
+        bad = tmp_path / "bad_lower.py"
+        bad.write_text(
+            "import numpy as np\n"
+            "class E:\n"
+            "    def lower_step(self):\n"
+            "        def fn(params, ts):\n"
+            "            host = np.asarray(ts[0])\n"
+            "            return [host]\n"
+            "        return fn\n"
+            "    def lower_decode(self, config):\n"
+            "        return lambda ts: [self.buf.np(0)]\n")
+        lockorder = nnslint._load_lockorder()
+        found = nnslint.lint_file(str(bad), lockorder, rel="bad_lower.py")
+        rules = [v.rule for v in found]
+        assert rules.count("host-sync-in-lower") == 2
+        # pragma exempts
+        ok = tmp_path / "ok_lower.py"
+        ok.write_text(
+            "import numpy as np\n"
+            "def lower_step():\n"
+            "    # calibration constant, computed at lower time\n"
+            "    scale = np.asarray([1.0])  # nnslint: allow(host-sync-in-lower)\n"
+            "    return scale\n")
+        found = nnslint.lint_file(str(ok), lockorder, rel="ok_lower.py")
+        assert not [v for v in found if v.rule == "host-sync-in-lower"]
+
+
+class TestFuseXlaPerfDiffPinned:
+    """Satellite: the committed fuse-python vs fuse-xla comparison rows
+    pin the perf_diff gate — an eroded lowering win FAILS and names the
+    dispatch stage."""
+
+    def _load(self):
+        import importlib.util
+        import json
+
+        root = os.path.join(os.path.dirname(__file__), "..")
+        spec = importlib.util.spec_from_file_location(
+            "perf_diff", os.path.join(root, "tools", "perf_diff.py"))
+        pd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pd)
+        with open(os.path.join(root, "BENCH_fusexla_r12.json"),
+                  encoding="utf-8") as fh:
+            rows = json.load(fh)["rows"]
+        return pd, rows
+
+    def test_committed_rows_self_pass(self):
+        pd, rows = self._load()
+        verdict = pd.diff([rows, rows], rows, margin_pct=10.0)
+        assert verdict["pass"], verdict
+
+    def test_committed_speedup_meets_gate(self):
+        _, rows = self._load()
+        speedup = [r for r in rows
+                   if r["metric"] == "hotpath_fusexla_speedup"]
+        assert speedup and speedup[0]["value"] >= 2.0
+        assert speedup[0]["lowering"] == "xla"
+
+    def test_eroded_win_regresses_and_names_dispatch(self):
+        import copy
+
+        pd, rows = self._load()
+        eroded = copy.deepcopy(rows)
+        for row in eroded:
+            if row["metric"] == "hotpath_fusexla_speedup":
+                row["value"] *= 0.4      # the fused win collapsed
+                attr = row.setdefault("attribution", {}).setdefault(
+                    "states", {})
+                attr["dispatch"] = attr.get("dispatch", 0.0) + 40.0
+        verdict = pd.diff([rows, rows], eroded, margin_pct=10.0)
+        assert not verdict["pass"]
+        reg = [r for r in verdict["regressions"]
+               if r["metric"] == "hotpath_fusexla_speedup"]
+        assert reg, verdict["regressions"]
+        blame = reg[0].get("attribution")
+        assert blame and blame["regressed_stage"] == "dispatch"

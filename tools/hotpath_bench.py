@@ -29,6 +29,12 @@ time, with no model in the loop:
                    + watermark policy, queue under every watermark —
                    the branch every admitted frame pays), against the
                    measured wire round-trip it rides on.
+  - ``fusexla``:  whole-segment XLA lowering (pipeline/schedule.py
+                   ``fuse=xla``): the transform→filter→decode chain fed
+                   bucket-8 stacked buffers, fuse-python vs fuse-xla
+                   wall time per bucket, plus the per-segment
+                   executable-cache hit rate (steady state must be
+                   100 % — no per-fill or per-frame recompiles).
   - ``xbatch``:   cross-stream continuous batching
                    (tensor_query_serversrc batch=N): closed-loop
                    requests/s of a loopback MLP serving pipeline,
@@ -458,6 +464,184 @@ def run_assert_profile() -> int:
     return 1 if failures else 0
 
 
+FUSEXLA_CAPS = ("other/tensors,format=static,num_tensors=1,"
+                "dimensions=1024,types=float32,framerate=0/1")
+#: the flagship-shaped transform→filter→decode chain the fuse-xla gate
+#: measures: arithmetic pre-processing, an MLP filter, a quantizing
+#: arithmetic post-stage and a direct_video decode — every step
+#: lowerable, so fuse=xla compiles the whole run into ONE jitted
+#: computation while fuse-python walks it as four Python closures with
+#: a separate device dispatch in the middle
+FUSEXLA_LAUNCH = (
+    f"appsrc caps={FUSEXLA_CAPS} name=in ! "
+    "tensor_transform mode=arithmetic option=mul:0.00390625,add:-0.5 "
+    "name=pre ! "
+    "tensor_filter framework=xla model=mlp "
+    "custom=in_dim:1024,width:64,depth:1,out_dim:3 name=f ! "
+    "tensor_transform mode=arithmetic "
+    "option=mul:20.0,add:128.0,typecast:uint8 name=quant ! "
+    "tensor_decoder mode=direct_video name=dec ! "
+    "tensor_sink name=out collect=false")
+_FUSEXLA_BUCKET = 8
+
+
+def _fusexla_session(tier: str, warmup: int, buckets: int):
+    """One pipeline per tier: feed ``warmup`` stacked bucket-8 buffers
+    (compiles happen here), snapshot the plan, then time ``buckets``
+    more.  The sink handler materializes every output (``np.asarray``)
+    so both tiers pay their real sync point — for fuse-xla that is the
+    single segment-exit D2H, which is the point.  Waits run to the full
+    push count: the fuse-xla double buffer holds a frame only while the
+    appsrc fifo carries the next item (``has_pending_input`` gate), so
+    the final bucket always flushes synchronously.
+    Returns (seconds_for_buckets, warm_plans, final_plans)."""
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.pipeline.graph import Pipeline
+    from nnstreamer_tpu.tensor.buffer import XBatchMeta
+
+    p = parse_launch(FUSEXLA_LAUNCH, Pipeline(fuse=tier))
+    n_got = [0]
+    target = [1 << 60]
+    done = threading.Event()
+
+    def on_data(b):
+        np.asarray(b.tensors[0])   # segment-exit materialization
+        n_got[0] += 1
+        if n_got[0] >= target[0]:
+            done.set()
+
+    p.get("out").connect("new-data", on_data)
+    p.play()
+    src = p.get("in")
+    rng = np.random.default_rng(17)
+    stacked = rng.standard_normal(
+        (_FUSEXLA_BUCKET, 1024)).astype(np.float32)
+    pushed = [0]
+
+    def push_and_wait(n):
+        # target BEFORE clear, then re-check: a straggler callback from
+        # the previous round must neither satisfy a stale target nor
+        # lose a wakeup that already happened
+        target[0] = pushed[0] + n
+        done.clear()
+        if n_got[0] >= target[0]:
+            done.set()
+        for _ in range(n):
+            buf = TensorBuffer(tensors=[stacked], pts=pushed[0])
+            buf.extra["nns_xbatch"] = XBatchMeta(
+                [{} for _ in range(_FUSEXLA_BUCKET)],
+                [pushed[0]] * _FUSEXLA_BUCKET, _FUSEXLA_BUCKET)
+            src.push_buffer(buf)
+            pushed[0] += 1
+        if not done.wait(timeout=300):
+            raise RuntimeError(f"fusexla bench stalled (tier={tier}, "
+                               f"got {n_got[0]}/{target[0]})")
+
+    try:
+        push_and_wait(warmup)
+        warm_plans = p.planner.plans()
+        t0 = time.perf_counter()
+        push_and_wait(buckets)
+        dt = time.perf_counter() - t0
+        final_plans = p.planner.plans()
+        src.end_of_stream()
+        p.wait(timeout=60)
+    finally:
+        p.stop()
+    return dt, warm_plans, final_plans
+
+
+def _fusexla_measure(buckets: int = 300, reps: int = 3):
+    """min-of-reps per tier; returns (python_us_per_bucket,
+    xla_us_per_bucket, warm_plans, final_plans) with the plan snapshots
+    from the best xla run (compile/hit counters feed the cache gate)."""
+    py = xla = None
+    warm = final = None
+    for _ in range(reps):
+        dt, _, _ = _fusexla_session("python", warmup=12, buckets=buckets)
+        py = dt if py is None else min(py, dt)
+        dt, w, f = _fusexla_session("xla", warmup=12, buckets=buckets)
+        if xla is None or dt < xla:
+            xla, warm, final = dt, w, f
+    return (py / buckets * 1e6, xla / buckets * 1e6, warm, final)
+
+
+def bench_fusexla(frames: int) -> dict:
+    buckets = max(100, frames)
+    py_us, xla_us, warm, final = _fusexla_measure(buckets)
+    seg = next((pl for pl in final if pl.get("lowering") == "xla"), {})
+    warm_seg = next((pl for pl in warm
+                     if pl.get("lowering") == "xla"), {})
+    steady_compiles = (seg.get("compiles", 0)
+                      - warm_seg.get("compiles", 0))
+    return {"metric": "hotpath_fusexla_speedup",
+            "value": round(py_us / max(1e-9, xla_us), 2), "unit": "x",
+            "python_us_per_bucket": round(py_us, 1),
+            "xla_us_per_bucket": round(xla_us, 1),
+            "bucket": _FUSEXLA_BUCKET,
+            "fused_elements": len(seg.get("elements", ())),
+            "warmup_compiles": warm_seg.get("compiles", 0),
+            "steady_state_compiles": steady_compiles,
+            "exec_cache_hits": seg.get("exec_cache_hits", 0),
+            "buckets": buckets}
+
+
+def run_assert_fusexla() -> int:
+    """fuse-xla gate: the whole-segment jitted computation must sustain
+    >= 2x fuse-python on the bucket-8 transform→filter→decode chain
+    (measured margin well above — the fused tier pays ONE dispatch
+    where python pays a device invoke plus per-element host math), the
+    chain must actually lower (4 fused elements, lowering=xla, no
+    fallback), and the per-segment executable cache must be 100% warm
+    in steady state: ZERO compiles after warmup, every timed bucket a
+    cache hit.  Min-of-reps with re-measure on a miss: scheduler noise
+    is one-sided, a real regression survives."""
+    failures = []
+    py_us, xla_us, warm, final = _fusexla_measure()
+    ratio = py_us / max(1e-9, xla_us)
+    for _ in range(2):
+        if ratio >= 2.0:
+            break
+        p2, x2, warm, final = _fusexla_measure()
+        py_us, xla_us = max(py_us, p2), min(xla_us, x2)
+        ratio = py_us / max(1e-9, xla_us)
+    seg = next((pl for pl in final if pl.get("lowering") == "xla"), None)
+    if seg is None or len(seg.get("elements", ())) != 4:
+        failures.append(
+            f"the 4-element chain did not lower to fuse-xla (plans: "
+            f"{final})")
+    else:
+        warm_seg = next((pl for pl in warm
+                         if pl.get("lowering") == "xla"), {})
+        steady = seg.get("compiles", 0) - warm_seg.get("compiles", 0)
+        if steady > 0:
+            failures.append(
+                f"{steady} XLA compile(s) AFTER warmup: the per-segment "
+                "executable cache is recompiling in steady state "
+                "(per-fill or per-frame cache-key churn)")
+        hits = seg.get("exec_cache_hits", 0) - \
+            warm_seg.get("exec_cache_hits", 0)
+        dispatched = seg.get("dispatches", 0) - \
+            warm_seg.get("dispatches", 0)
+        if hits < dispatched:
+            failures.append(
+                f"executable-cache hit rate {hits}/{dispatched} after "
+                "warmup (must be 100%)")
+    if ratio < 2.0:
+        failures.append(
+            f"fuse-xla only {ratio:.2f}x fuse-python "
+            f"({xla_us:.0f} vs {py_us:.0f} us/bucket at bucket 8): the "
+            "whole-segment lowering win is gone")
+    result = {"metric": "hotpath_fusexla_gate", "unit": "ok",
+              "value": 0 if failures else 1,
+              "ratio": round(ratio, 2),
+              "python_us_per_bucket": round(py_us, 1),
+              "xla_us_per_bucket": round(xla_us, 1),
+              "failures": failures}
+    print(json.dumps(result), flush=True)
+    return 1 if failures else 0
+
+
 def _xbatch_measure(bucket: int = 8, concurrency: int = 8):
     """(solo_rps, batched_rps, pf_1client_rps, xb_1client_rps), each
     probed against an OUT-OF-PROCESS serving pipeline (tools/soak.py
@@ -756,7 +940,8 @@ def main() -> int:
     ap.add_argument("--frames", type=int, default=200)
     ap.add_argument("--stage", choices=["pool", "serialize", "wire", "shm",
                                         "dispatch", "obs", "admit",
-                                        "profile", "xbatch", "all"],
+                                        "profile", "xbatch", "fusexla",
+                                        "all"],
                     default="all")
     ap.add_argument("--assert", dest="assert_gate", action="store_true",
                     help="regression gates (exit 1): copy gate (serialize "
@@ -778,6 +963,8 @@ def main() -> int:
             rc |= run_assert_admit()
         if args.stage in ("all", "profile"):
             rc |= run_assert_profile()
+        if args.stage in ("all", "fusexla"):
+            rc |= run_assert_fusexla()
         if args.stage in ("all", "xbatch"):
             rc |= run_assert_xbatch()
         return rc
@@ -785,7 +972,7 @@ def main() -> int:
               "wire": bench_wire, "shm": bench_shm,
               "dispatch": bench_dispatch, "obs": bench_obs,
               "admit": bench_admit, "profile": bench_profile,
-              "xbatch": bench_xbatch}
+              "xbatch": bench_xbatch, "fusexla": bench_fusexla}
     picks = stages if args.stage == "all" else {args.stage:
                                                stages[args.stage]}
     for fn in picks.values():

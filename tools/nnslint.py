@@ -56,6 +56,14 @@ This tool checks exactly those repo rules:
     explicit — ``mono_ns()`` for durations and deadlines, ``wall_us()``
     for cross-host stamps.
 
+``host-sync-in-lower``
+    ``lower_step`` / ``lower_decode`` implementations (the fuse=xla
+    whole-segment lowering hooks) must return PURE jax traces: a
+    ``buf.np()`` / ``np.asarray`` / ``jax.device_get`` /
+    ``block_until_ready`` inside one silently re-introduces the per-
+    element host sync the tier exists to remove (and breaks under
+    jit tracing anyway).  Host finishers belong in ``LoweredStep.post``.
+
 ``unbounded-queue``
     ``queue.Queue()`` without ``maxsize`` or ``deque()`` without
     ``maxlen`` in the dataflow layers (``query/``, ``pipeline/``).  An
@@ -92,7 +100,13 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 RULES = ("sleep-poll", "io-under-lock", "lock-order", "unknown-lock",
          "tracer-in-untraced-plan", "readonly-view-mutation",
-         "wallclock-in-chain", "unbounded-queue")
+         "wallclock-in-chain", "unbounded-queue", "host-sync-in-lower")
+
+#: function names whose bodies must stay pure jax traces (the fuse=xla
+#: lowering hooks — pipeline/element.py LoweredStep contract)
+_LOWER_FUNCS = frozenset({"lower_step", "lower_decode"})
+#: attribute calls that force a device→host sync or materialization
+_HOST_SYNC_ATTRS = frozenset({"np", "block_until_ready", "device_get"})
 
 #: directories where unbounded queue/deque construction is a finding
 #: (the dataflow layers the overload story bounds)
@@ -474,6 +488,7 @@ class _FileLinter(ast.NodeVisitor):
         # nodes; re-walk for them with function-local view tracking
         self._lint_view_stores()
         self._lint_untraced_executor()
+        self._lint_lower_purity()
         # the collection passes overlap (module walk + per-class walk):
         # dedupe by site+rule
         seen, unique = set(), []
@@ -518,30 +533,55 @@ class _FileLinter(ast.NodeVisitor):
     def _lint_untraced_executor(self) -> None:
         if not self.rel.endswith(os.path.join("pipeline", "schedule.py")):
             return
-        maker = None
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.FunctionDef) \
-                    and node.name == "_make_executor":
-                maker = node
-                break
-        if maker is None:
-            return
-        for node in ast.walk(maker):
-            if isinstance(node, ast.FunctionDef) and node.name == "run":
-                for sub in ast.walk(node):
-                    ident = None
-                    if isinstance(sub, ast.Name):
-                        ident = sub.id
-                    elif isinstance(sub, ast.arg):
-                        ident = sub.arg
-                    if ident is not None and "tracer" in ident:
-                        self._add(
-                            sub if hasattr(sub, "lineno") else node,
-                            "tracer-in-untraced-plan",
-                            "the untraced fused executor references "
-                            f"{ident!r}: the zero-cost-when-off tracing "
-                            "guarantee requires the untraced plan to "
-                            "hold no tracer state")
+        makers = [node for node in ast.walk(self.tree)
+                  if isinstance(node, ast.FunctionDef)
+                  and node.name in ("_make_executor",
+                                    "_make_xla_executor")]
+        for maker in makers:
+            for node in ast.walk(maker):
+                if isinstance(node, ast.FunctionDef) and node.name == "run":
+                    for sub in ast.walk(node):
+                        ident = None
+                        if isinstance(sub, ast.Name):
+                            ident = sub.id
+                        elif isinstance(sub, ast.arg):
+                            ident = sub.arg
+                        if ident is not None and "tracer" in ident:
+                            self._add(
+                                sub if hasattr(sub, "lineno") else node,
+                                "tracer-in-untraced-plan",
+                                "the untraced fused executor references "
+                                f"{ident!r}: the zero-cost-when-off "
+                                "tracing guarantee requires the untraced "
+                                "plan to hold no tracer state")
+
+    def _lint_lower_purity(self) -> None:
+        """host-sync-in-lower: ``lower_step``/``lower_decode`` bodies
+        (and their nested traced functions) must not materialize on
+        host — ``X.np()``, ``np.asarray``, ``jax.device_get``,
+        ``block_until_ready`` all force the device sync the fuse=xla
+        tier exists to collapse."""
+        for fn in ast.walk(self.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name not in _LOWER_FUNCS:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                bad = attr in _HOST_SYNC_ATTRS
+                if attr == "asarray" \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in ("np", "numpy"):
+                    bad = True
+                if bad:
+                    self._add(
+                        node, "host-sync-in-lower",
+                        f".{attr}() inside {fn.name}: lowered steps "
+                        "must be pure jax traces — host materialization "
+                        "belongs in LoweredStep.post (and would break "
+                        "under jit tracing)")
 
 
 def lint_file(path: str, lockorder, rel: Optional[str] = None
