@@ -55,6 +55,12 @@ HIERARCHY: Dict[str, int] = {
     #                         ring (slo/): held while snapshotting the
     #                         registry and exporting the span ring, so it
     #                         ranks below tracer/obs.ring/obs.metrics
+    "obs.timeseries": 67,   # time-series ring sample store + signal
+    #                         state (obs/timeseries.py); may evaluate
+    #                         registry snapshots, so below obs.metrics
+    "obs.federation": 68,   # federation collector origin table
+    #                         (obs/federation.py); merges local registry
+    #                         snapshots, so below obs.metrics
     "tracer": 70,           # Tracer stats table
     "obs.ring": 72,         # SpanRing append/snapshot (obs/span.py)
     "obs.metrics": 74,      # metrics registry + per-metric state

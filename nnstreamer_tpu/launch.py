@@ -125,7 +125,29 @@ def main(argv=None) -> int:
                     help="serve live Prometheus metrics on "
                          "127.0.0.1:PORT while the pipeline runs "
                          "(GET /metrics; same effect as "
-                         "NNS_METRICS_PORT)")
+                         "NNS_METRICS_PORT; PORT 0 binds an ephemeral "
+                         "port — the chosen port is logged and "
+                         "exported as NNS_METRICS_BOUND_PORT)")
+    ap.add_argument("--push-metrics", default=None, metavar="HOST:PORT",
+                    help="telemetry federation (obs/federation.py): "
+                         "push this process's metrics registry to a "
+                         "collector as T_METRICS deltas every "
+                         "--push-interval seconds, so a fleet of "
+                         "worker launches is scraped from ONE "
+                         "federated /metrics endpoint")
+    ap.add_argument("--push-interval", type=float, default=1.0,
+                    metavar="SECONDS",
+                    help="metrics push period for --push-metrics "
+                         "(default 1.0)")
+    ap.add_argument("--top", nargs="?", const=1.0, type=float,
+                    default=None, metavar="INTERVAL",
+                    help="live nns-top dashboard on stderr while the "
+                         "pipeline runs (obs/dashboard.py): "
+                         "per-element occupancy, queue depths, bucket "
+                         "fill, MFU, shed/admit rates and sustained "
+                         "signals, refreshed every INTERVAL seconds "
+                         "(default 1.0) from an in-process time-series "
+                         "ring")
     ap.add_argument("--fuse", default=None,
                     choices=["interpret", "python", "xla"],
                     help="segment-compiler lowering tier "
@@ -243,11 +265,44 @@ def main(argv=None) -> int:
             import jax
 
             jax.profiler.start_trace(args.jax_trace)
+        publisher = None
+        if args.push_metrics:
+            from .obs.federation import MetricsPublisher
+
+            host, _, port = str(args.push_metrics).rpartition(":")
+            if not port.isdigit():
+                ap.error(f"--push-metrics {args.push_metrics!r}: "
+                         "want HOST:PORT")
+            from .obs.httpd import health_report
+
+            publisher = MetricsPublisher(
+                host or "127.0.0.1", int(port),
+                interval_s=args.push_interval,
+                health_fn=lambda: health_report()["state"])
+        top_loop = top_sampler = top_ring = None
+        if args.top is not None:
+            from .obs.dashboard import RingSource, TopLoop
+            from .obs.timeseries import RingSampler, TimeSeriesRing
+
+            top_ring = TimeSeriesRing(interval_s=max(0.1, args.top))
+            top_sampler = RingSampler(top_ring)
+            # in-place redraw only on a real terminal: piped/captured
+            # stderr gets plain appended frames, not clear-screen
+            # escapes clobbering the log
+            top_loop = TopLoop(RingSource(top_ring, label="launch"),
+                               interval_s=max(0.1, args.top),
+                               out=sys.stderr,
+                               ansi=sys.stderr.isatty())
         _install_sigterm_drain(p, args.drain_grace)
         try:
             p.play()
             if slo_monitor is not None:
                 slo_monitor.start()
+            if publisher is not None:
+                publisher.start()
+            if top_loop is not None:
+                top_sampler.start()
+                top_loop.start()
             if args.soak is not None:
                 try:
                     p.wait(args.soak)
@@ -287,6 +342,15 @@ def main(argv=None) -> int:
                         print(f"executor {el.name}: {executor}{note}",
                               file=sys.stderr)
         finally:
+            if top_loop is not None:
+                top_loop.stop()
+                top_sampler.stop(final_capture=False)
+                top_ring.close()
+            if publisher is not None:
+                # final push BEFORE element teardown: the collector's
+                # last view of this worker must include the run's
+                # closing counters, not a half-torn registry
+                publisher.stop(final_push=True)
             if slo_monitor is not None:
                 # final tick BEFORE element teardown: the verdict must
                 # see the run's last requests while gauges are live

@@ -31,6 +31,18 @@ discipline as ``pipeline/tracing.py``:
 - :mod:`~nnstreamer_tpu.obs.profile` — the :class:`Profiler` surface
   over all of it: blame tables, folded-stack flamegraphs, per-element
   occupancy gauges (``launch.py --profile``).
+- :mod:`~nnstreamer_tpu.obs.timeseries` — bounded ring of periodic
+  registry snapshots (windowed rates / quantiles-over-window via
+  ``state_delta``) plus :class:`SustainedSignal` detection (threshold
+  × min-hold × disarm hysteresis) on a subscribable signal bus — the
+  substrate autoscaling decisions and soak verdicts read.
+- :mod:`~nnstreamer_tpu.obs.federation` — cross-process metric
+  federation: worker registries pushed as ``T_METRICS`` deltas over
+  the query wire into a collector that re-renders ONE origin-labeled
+  ``/metrics`` + worst-of ``/healthz`` for the whole fleet.
+- :mod:`~nnstreamer_tpu.obs.dashboard` — the ``nns-top`` live terminal
+  view over a time-series ring or a scraped endpoint
+  (``tools/nns_top.py``, ``launch.py --top``).
 
 Nothing in this package runs on the dataflow hot path unless a tracer
 with span recording is attached: metrics are lazy callable gauges
@@ -46,3 +58,11 @@ from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
                       quantile_from_counts, state_delta)
 from .span import (Span, SpanRing, TraceContext,  # noqa: F401
                    chrome_trace_events, new_trace_id)
+from .timeseries import (RingSampler, SignalBus,  # noqa: F401
+                         SustainedSignal, TimeSeriesRing)
+
+# federation imports query/protocol lazily at wire use, but the module
+# itself is import-light; exported here so consumers reach the fleet
+# plane through one namespace
+from .federation import (CollectorServer, MetricsCollector,  # noqa: F401
+                         MetricsPublisher, origin_id)

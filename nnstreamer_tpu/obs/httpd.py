@@ -137,9 +137,16 @@ class _Handler(BaseHTTPRequestHandler):
 def start_metrics_server(port: int, host: str = "127.0.0.1",
                          registry: MetricsRegistry = REGISTRY
                          ) -> ThreadingHTTPServer:
-    """Start the endpoint on ``host:port`` (port 0 = ephemeral; read the
-    bound port from ``server.server_address[1]``).  Idempotent per
-    process: a second call returns the running server."""
+    """Start the endpoint on ``host:port``.  Idempotent per process: a
+    second call returns the running server.
+
+    ``port=0`` binds an EPHEMERAL port — the multi-process contract: a
+    fleet of worker processes sharing one machine (or one test suite)
+    must never collide on a fixed 9090-style port.  The chosen port is
+    logged, readable via :func:`bound_metrics_port` (and
+    ``server.server_address[1]``), and exported as
+    ``NNS_METRICS_BOUND_PORT`` so subprocess tooling can discover it
+    from the environment."""
     global _SERVER
     with _STATE_LOCK:
         if _SERVER is not None:
@@ -151,7 +158,23 @@ def start_metrics_server(port: int, host: str = "127.0.0.1",
         threading.Thread(target=server.serve_forever, daemon=True,
                          name="nns-metrics").start()
         _SERVER = server
+        bound = server.server_address[1]
+        os.environ["NNS_METRICS_BOUND_PORT"] = str(bound)
+        if int(port) == 0:
+            from ..utils.log import logger
+
+            logger.info("metrics endpoint on ephemeral port: "
+                        "http://%s:%d/metrics", host, bound)
         return server
+
+
+def bound_metrics_port() -> Optional[int]:
+    """Port the running metrics endpoint is bound to (the answer to
+    "where did port 0 land"); None when no endpoint is running."""
+    with _STATE_LOCK:
+        if _SERVER is None:
+            return None
+        return _SERVER.server_address[1]
 
 
 def stop_metrics_server() -> None:
@@ -159,6 +182,7 @@ def stop_metrics_server() -> None:
     with _STATE_LOCK:
         server, _SERVER = _SERVER, None
     if server is not None:
+        os.environ.pop("NNS_METRICS_BOUND_PORT", None)
         server.shutdown()
         server.server_close()
 

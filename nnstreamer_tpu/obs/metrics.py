@@ -56,10 +56,20 @@ def _bucket_lo(i: int) -> float:
     return 2.0 ** (i / _SUB)
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote and newline are the three characters the text format reserves
+    (escaped as ``\\\\``, ``\\"`` and ``\\n`` — in that order, backslash
+    first, or the other escapes would double-escape)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _label_str(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -422,7 +432,17 @@ def state_delta(new: Dict[str, Any], old: Dict[str, Any]
     gauges keep the NEW point-in-time value.  Histogram deltas carry
     the diff'd bucket vector, ready for :func:`quantile_from_counts` /
     :func:`count_over_threshold` — windowed rates and quantiles with no
-    per-observation timestamping."""
+    per-observation timestamping.
+
+    Counter-reset hardening: a monotonic count going BACKWARDS between
+    the two snapshots is a restart (a federated worker process died and
+    came back under the same origin, or a same-key metric was
+    re-registered) — the naive subtraction would yield a negative
+    windowed rate that poisons burn rates and sustained-signal
+    detection.  The delta clamps to zero AND carries ``reset: True`` so
+    consumers that must not act on a restart artifact (the
+    ``SustainedSignal`` detector, obs/timeseries.py) can skip the
+    sample entirely instead of reading "zero traffic" as recovery."""
     out: Dict[str, Any] = {}
     for key, cur in new.items():
         kind = cur.get("kind")
@@ -431,9 +451,13 @@ def state_delta(new: Dict[str, Any], old: Dict[str, Any]
             prev = None         # re-registered as a different type
         if kind == "counter":
             base = prev["value"] if prev else 0
-            out[key] = {"kind": "counter",
-                        "value": max(0, cur["value"] - base)}
+            row = {"kind": "counter",
+                   "value": max(0, cur["value"] - base)}
+            if cur["value"] < base:
+                row["reset"] = True
+            out[key] = row
         elif kind == "histogram":
+            reset = False
             if prev:
                 # per-bucket clamp: a same-key histogram re-registered
                 # mid-window (register() REPLACES — tracer re-attach)
@@ -443,11 +467,15 @@ def state_delta(new: Dict[str, Any], old: Dict[str, Any]
                                zip(cur["counts"], prev["counts"]))
                 count = cur["count"] - prev["count"]
                 total = cur["total"] - prev["total"]
+                reset = cur["count"] < prev["count"]
             else:
                 counts, count, total = (cur["counts"], cur["count"],
                                         cur["total"])
-            out[key] = {"kind": "histogram", "count": max(0, count),
-                        "total": max(0.0, total), "counts": counts}
+            row = {"kind": "histogram", "count": max(0, count),
+                   "total": max(0.0, total), "counts": counts}
+            if reset:
+                row["reset"] = True
+            out[key] = row
         else:
             out[key] = dict(cur)
     return out

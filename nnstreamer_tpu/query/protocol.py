@@ -37,7 +37,11 @@ without a tracer just discard it), 9=SHED (explicit load-shed answer
 to a DATA frame refused by admission control: seq echoes the refused
 request, payload is the ASCII retry-after hint in milliseconds — an
 overloaded or draining server answers every rejected request, no
-silent drops).
+silent drops), 10=METRICS (payload = JSON metrics-snapshot delta from a
+worker process to a telemetry collector — obs/federation.py; seq is the
+publisher's push counter, epoch_us the publisher's wall clock at push.
+One-way: the collector never replies, so a publisher riding an existing
+query connection costs the serving path nothing).
 ``PING``/``PONG`` are the liveness heartbeat (query/resilience.py): any
 peer may send PING at any time; the receiver echoes seq and payload back
 as PONG immediately, out of band with DATA/REPLY.  The sender matches
@@ -61,14 +65,15 @@ from ..tensor.buffer import TensorBuffer, TensorBufferPool
 from ..tensor.info import TensorInfo
 from ..tensor.meta import META_HEADER_SIZE, TensorMetaInfo
 
-# Wire revision 5 ('NNSU'): + T_SHED explicit load-shed replies and
-# the HELLO qos declaration ('NNST' lacked them, 'NNSS' lacked the
+# Wire revision 6 ('NNSV'): + T_METRICS telemetry-federation pushes
+# ('NNSU' lacked them, 'NNST' lacked T_SHED/qos, 'NNSS' lacked the
 # trace context, 'NNSR' lacked payload_crc, 'NNSQ' also lacked
 # epoch_us).  The magic doubles as the version stamp — a peer speaking
 # another revision fails immediately with "bad magic" instead of
-# desynchronizing the stream (a rev-4 peer would silently treat a
-# shed as an unknown message and time out instead of backing off).
-MAGIC = 0x4E4E5355  # 'NNSU'
+# desynchronizing the stream (a rev-5 collector would silently drop a
+# worker's metric pushes and the fleet view would show a healthy-
+# looking hole exactly where the telemetry plane disagreed on dialect).
+MAGIC = 0x4E4E5356  # 'NNSV'
 HEADER = struct.Struct("<IBQQqqQQqII")
 #: upper bound on a wire-declared payload (default 1 GiB, env-overridable):
 #: receives reject anything larger before allocating, so a corrupted
@@ -78,7 +83,7 @@ MAX_WIRE_PAYLOAD = int(os.environ.get("NNS_MAX_WIRE_PAYLOAD",
                                       str(1 << 30)))
 
 (T_HELLO, T_DATA, T_REPLY, T_BYE, T_ERROR, T_PING, T_PONG, T_TRACE,
- T_SHED) = 1, 2, 3, 4, 5, 6, 7, 8, 9
+ T_SHED, T_METRICS) = 1, 2, 3, 4, 5, 6, 7, 8, 9, 10
 
 
 def create_connection(address, timeout=None):

@@ -30,9 +30,10 @@ from ..tensor.caps_util import tensors_template_caps
 from ..utils.conf import parse_bool
 from .overload import (DEFAULT_QOS, QOS_CLASSES, AdmissionController,
                        TokenBucket, bucket_budget, qos_of_class)
-from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
-                       T_REPLY, T_SHED, T_TRACE, decode_tensors, recv_msg,
-                       send_msg, send_tensors, shutdown_close)
+from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_METRICS,
+                       T_PING, T_PONG, T_REPLY, T_SHED, T_TRACE,
+                       decode_tensors, recv_msg, send_msg, send_tensors,
+                       shutdown_close)
 
 #: default bound on the server's incoming frame queue (frames, not
 #: bytes): deep enough that bursty-but-sustainable traffic never sheds,
@@ -93,6 +94,12 @@ class QueryServer:
         #: when it records spans, replies piggyback them as T_TRACE so
         #: the client merges both processes into one timeline
         self.obs_tracer = None
+        #: telemetry-federation collector (obs/federation.py): attach
+        #: one and every connection doubles as a metrics drain —
+        #: T_METRICS pushes from worker processes already connected to
+        #: this front-end merge into the federated view without a
+        #: second wire.  Unattached (the default), pushes are ignored.
+        self.collector = None
         self._span_cursors: Dict[int, int] = {}   # client id -> ring pos
         self._lock = make_lock("query.registry")
         self._stop = threading.Event()
@@ -235,6 +242,15 @@ class QueryServer:
                                                seq=msg.seq,
                                                epoch_us=wall_us(),
                                                payload=msg.payload))
+                    continue
+                if msg.type == T_METRICS:
+                    # telemetry piggyback (obs/federation.py): a worker
+                    # pushing its registry on the data wire.  One attr
+                    # read per push on unattached servers; the payload
+                    # is JSON, never tensors, so no slab is pinned.
+                    collector = self.collector
+                    if collector is not None:
+                        collector.ingest(bytes(msg.payload or b""))
                     continue
                 if msg.type == T_DATA:
                     # admission BEFORE tensor decode: a shed frame's

@@ -54,10 +54,16 @@ class FlightRecorder:
 
     def __init__(self, out_dir: str, tracer: Optional[Any] = None,
                  registry: MetricsRegistry = REGISTRY,
-                 capacity: int = 512, max_dumps: int = 3) -> None:
+                 capacity: int = 512, max_dumps: int = 3,
+                 collector: Optional[Any] = None) -> None:
         self.out_dir = out_dir
         self.tracer = tracer
         self.registry = registry
+        #: federation collector (obs/federation.py): when attached,
+        #: every recorded tick carries the per-origin federated view,
+        #: so a breach bundle from an N-process run shows ALL sides'
+        #: timelines, not just the process that happened to breach
+        self.collector = collector
         self.max_dumps = int(max_dumps)
         self._lock = make_lock("slo")
         self._ring: "deque[Dict[str, Any]]" = deque(
@@ -72,6 +78,12 @@ class FlightRecorder:
         entry: Dict[str, Any] = {"wall_us": wall_us(),
                                  "mono_s": round(mono_ns() / 1e9, 3),
                                  "metrics": self.registry.report()}
+        if self.collector is not None:
+            # the federated timeline: per-origin flattened metrics
+            # (remote workers' pushed state + the local registry under
+            # its own origin key), plus origin liveness rows
+            entry["origins"] = self.collector.report()
+            entry["origin_status"] = self.collector.origins()
         if evaluation is not None:
             entry["burn"] = {
                 o["name"]: {"fast": o["fast"]["burn_rate"],
@@ -135,6 +147,8 @@ class FlightRecorder:
                     "mono_s": round(mono_ns() / 1e9, 3),
                     "recorded_ticks": len(timeline),
                     "files": files}
+        if self.collector is not None:
+            manifest["origins"] = self.collector.origins()
         if self.tracer is not None and \
                 getattr(self.tracer, "ring", None) is not None:
             manifest["span_ring"] = {

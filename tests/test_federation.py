@@ -1,0 +1,523 @@
+"""obs/federation.py: collector merge semantics, wire round trips, and
+the two-real-process federated scrape.
+
+The edge cases the issue names are pinned here: stale-origin eviction,
+out-of-order/duplicate ``T_METRICS`` deltas, collector restart
+mid-push, and a two-process merged-scrape round trip driven through
+``launch.py --push-metrics`` (the PR 5 ``--timeline`` test pattern: the
+remote side is a REAL subprocess, not a mock)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.obs.federation import (CollectorServer,
+                                           MetricsCollector,
+                                           MetricsPublisher)
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def wait_for(cond, timeout=10.0):
+    """Spin until ``cond()`` (collector ingestion is async — the
+    reader thread processes a push after send_msg returns)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def payload(origin="w:1", seq=1, epoch="e1", full=True, state=None,
+            **extra):
+    return {"origin": origin, "seq": seq, "epoch": epoch, "full": full,
+            "wall_us": 1_000_000, "offset_us": 0, "health": "serving",
+            "state": state if state is not None else
+            {"nns_x_total": {"kind": "counter", "value": seq}},
+            **extra}
+
+
+# ---------------------------------------------------------------------------
+# collector merge semantics
+# ---------------------------------------------------------------------------
+
+class TestCollectorMerge:
+    def test_origin_labels_injected_everywhere(self):
+        local = MetricsRegistry()
+        local.counter("nns_mine_total", qos="gold").inc(2)
+        col = MetricsCollector(registry=local, local_origin="me:1")
+        col.ingest(payload(state={
+            'nns_theirs{a="b"}': {"kind": "gauge", "value": 4.0}}))
+        snap = col.snapshot_state(prefix="nns_")
+        # the origin label appends after the key's own sorted labels
+        assert snap['nns_theirs{a="b",origin="w:1"}']["value"] == 4.0
+        assert snap['nns_mine_total{qos="gold",origin="me:1"}'] \
+            ["value"] == 2
+        text = col.render_prometheus()
+        assert 'nns_theirs{a="b",origin="w:1"} 4.0' in text
+        assert 'nns_mine_total{qos="gold",origin="me:1"} 2' in text
+
+    def test_duplicate_and_out_of_order_pushes_dropped(self):
+        col = MetricsCollector(registry=None)
+        assert col.ingest(payload(seq=1))
+        assert col.ingest(payload(seq=3, full=False, state={
+            "nns_x_total": {"kind": "counter", "value": 30}}))
+        # duplicate of seq 3 with a STALE value: must not regress state
+        assert not col.ingest(payload(seq=3, full=False, state={
+            "nns_x_total": {"kind": "counter", "value": 7}}))
+        # late-arriving older push: dropped too
+        assert not col.ingest(payload(seq=2, full=False, state={
+            "nns_x_total": {"kind": "counter", "value": 20}}))
+        snap = col.snapshot_state()
+        assert snap['nns_x_total{origin="w:1"}']["value"] == 30
+        assert col.origins()[0]["rejected"] == 2
+
+    def test_new_epoch_replaces_state(self):
+        """A restarted worker (new epoch) starts from scratch: its old
+        incarnation's keys must not linger as ghosts.  A new
+        incarnation's first push is always FULL (the publisher's fresh
+        state forces one)."""
+        col = MetricsCollector(registry=None)
+        col.ingest(payload(seq=9, epoch="e1", state={
+            "nns_old_total": {"kind": "counter", "value": 9},
+            "nns_kept_total": {"kind": "counter", "value": 9}}))
+        # restart: fresh epoch, lower seq, partial key set, full push
+        assert col.ingest(payload(seq=1, epoch="e2", full=True, state={
+            "nns_kept_total": {"kind": "counter", "value": 1}}))
+        snap = col.snapshot_state()
+        assert 'nns_old_total{origin="w:1"}' not in snap
+        assert snap['nns_kept_total{origin="w:1"}']["value"] == 1
+
+    def test_late_old_epoch_delta_rejected(self):
+        """A DELTA from the previous incarnation arriving after the
+        restart (interleaved connection teardown) must not resurrect
+        stale state: epoch changes are only honored on full pushes."""
+        col = MetricsCollector(registry=None)
+        col.ingest(payload(seq=9, epoch="e1", state={
+            "nns_x_total": {"kind": "counter", "value": 900}}))
+        col.ingest(payload(seq=1, epoch="e2", full=True, state={
+            "nns_x_total": {"kind": "counter", "value": 1}}))
+        assert not col.ingest(payload(seq=10, epoch="e1", full=False,
+                                      state={"nns_x_total": {
+                                          "kind": "counter",
+                                          "value": 910}}))
+        snap = col.snapshot_state()
+        assert snap['nns_x_total{origin="w:1"}']["value"] == 1
+
+    def test_poisoned_values_dropped_not_merged(self):
+        """Non-dict metric entries and unconvertible fields reject or
+        drop cleanly — a push must never raise out of the reader
+        thread or poison later snapshot_state consumers."""
+        col = MetricsCollector(registry=None)
+        assert not col.ingest(payload(seq="x"))         # bad seq type
+        assert col.ingest(payload(seq=1, state={
+            "nns_ok": {"kind": "gauge", "value": 1.0},
+            "nns_bad": 5,                   # not a dict: dropped
+            "nns_also_bad": {"no_kind": 1},
+            "nns_none_gauge": {"kind": "gauge", "value": None},
+            "nns_str_counter": {"kind": "counter", "value": "9"},
+            "nns_half_hist": {"kind": "histogram"},     # no counts
+            "nns_bad_counts": {"kind": "histogram", "count": 1,
+                               "total": 1.0, "counts": ["x"]}}))
+        snap = col.snapshot_state()
+        assert list(snap) == ['nns_ok{origin="w:1"}']
+        # consumers survive: render + report + windowed diff over the
+        # merged state (the reviewer's repro: a None gauge or a
+        # counts-less histogram used to 503 every federated scrape)
+        col.render_prometheus()
+        col.report()
+        from nnstreamer_tpu.obs.metrics import state_delta
+
+        state_delta(snap, snap)
+
+    def test_delta_merge_keeps_unchanged_keys(self):
+        col = MetricsCollector(registry=None)
+        col.ingest(payload(seq=1, state={
+            "nns_a_total": {"kind": "counter", "value": 5},
+            "nns_b": {"kind": "gauge", "value": 1.0}}))
+        col.ingest(payload(seq=2, full=False, state={
+            "nns_b": {"kind": "gauge", "value": 2.0}}))
+        snap = col.snapshot_state()
+        assert snap['nns_a_total{origin="w:1"}']["value"] == 5
+        assert snap['nns_b{origin="w:1"}']["value"] == 2.0
+
+    def test_stale_origin_eviction(self):
+        from nnstreamer_tpu.obs.clock import mono_ns
+
+        # injected times anchored to the REAL monotonic clock: the
+        # snapshot_state read below re-checks staleness with real now
+        base = mono_ns() / 1e9
+        col = MetricsCollector(registry=None, stale_after_s=1000.0)
+        col.ingest(payload(origin="w:1"), now=base - 2000.0)
+        col.ingest(payload(origin="w:2"), now=base)
+        assert col.evict_stale(now=base) == ["w:1"]
+        snap = col.snapshot_state()
+        assert not any("w:1" in k for k in snap)
+        assert any("w:2" in k for k in snap)
+
+    def test_stale_origin_reads_degraded_before_eviction(self):
+        col = MetricsCollector(registry=None, stale_after_s=1e9)
+        col.ingest(payload())
+        assert col.health() == "serving"
+        # age the origin past the degrade horizon (stale_after/3)
+        # while staying inside the eviction horizon
+        with col._lock:
+            col._origins["w:1"].last_push_mono -= 5e8
+        assert col.health() == "degraded"
+
+    def test_worst_of_health(self):
+        col = MetricsCollector(registry=None)
+        col.ingest(payload(origin="w:1", health="serving"))
+        col.ingest(payload(origin="w:2", health="draining"))
+        assert col.health() == "draining"
+
+    def test_malformed_payloads_rejected(self):
+        col = MetricsCollector(registry=None)
+        assert not col.ingest(b"not json")
+        assert not col.ingest({"origin": "w:1"})        # no state
+        assert not col.ingest({"state": {}})            # no origin
+        assert not col.ingest(42)
+
+    def test_federated_histogram_renders_quantiles(self):
+        col = MetricsCollector(registry=None)
+        counts = [0] * 128
+        counts[40] = 100        # one hot bucket
+        col.ingest(payload(state={"nns_lat_us": {
+            "kind": "histogram", "count": 100, "total": 5e4,
+            "counts": counts}}))
+        text = col.render_prometheus()
+        assert 'nns_lat_us{origin="w:1",quantile="0.99"}' in text
+        assert 'nns_lat_us_count{origin="w:1"} 100' in text
+
+    def test_origin_label_escaped(self):
+        col = MetricsCollector(registry=None)
+        col.ingest(payload(origin='evil"host\\:1'))
+        text = col.render_prometheus()
+        assert 'origin="evil\\"host\\\\:1"' in text
+
+
+# ---------------------------------------------------------------------------
+# label-escaping satellite (obs/metrics.py render)
+# ---------------------------------------------------------------------------
+
+class TestLabelEscaping:
+    def test_render_escapes_label_values(self):
+        r = MetricsRegistry()
+        r.counter("nns_esc_total",
+                  path='C:\\tmp\\"x"\nend').inc(1)
+        text = r.render_prometheus()
+        line = [l for l in text.splitlines()
+                if l.startswith("nns_esc_total")][0]
+        assert line == ('nns_esc_total{path="C:\\\\tmp\\\\\\"x\\"'
+                        '\\nend"} 1')
+        # the exposition stays one-line-per-sample: the raw newline
+        # never reaches the wire
+        assert "\nend" not in line
+
+    def test_snapshot_state_keys_match_render_keys(self):
+        r = MetricsRegistry()
+        r.gauge("nns_g", fn=None, label='a"b').set(1.0)
+        snap_key = next(iter(r.snapshot_state()))
+        text = r.render_prometheus()
+        assert snap_key in text
+
+
+# ---------------------------------------------------------------------------
+# wire round trips (in-process publisher/collector)
+# ---------------------------------------------------------------------------
+
+class TestWireRoundTrip:
+    def test_publisher_pushes_and_estimates_offset(self):
+        worker = MetricsRegistry()
+        c = worker.counter("nns_req_total")
+        col = MetricsCollector(registry=None)
+        srv = CollectorServer(col, port=0)
+        pub = MetricsPublisher("127.0.0.1", srv.port, registry=worker,
+                               origin="w:9", offset_every=1)
+        try:
+            c.inc(4)
+            assert pub.push()
+            c.inc(2)
+            assert pub.push()
+            assert wait_for(lambda: col.snapshot_state().get(
+                'nns_req_total{origin="w:9"}', {}).get("value") == 6)
+            assert pub.offset.offset_us is not None
+            assert abs(pub.offset.offset_us) < 5_000_000
+            row = col.origins()[0]
+            assert row["origin"] == "w:9" and row["pushes"] == 2
+        finally:
+            pub.stop(final_push=False)
+            srv.close()
+
+    def test_deltas_only_carry_changed_keys(self):
+        worker = MetricsRegistry()
+        a = worker.counter("nns_a_total")
+        worker.counter("nns_b_total").inc(1)
+        col = MetricsCollector(registry=None)
+        srv = CollectorServer(col, port=0)
+        pub = MetricsPublisher("127.0.0.1", srv.port, registry=worker,
+                               origin="w:9", full_every=1000)
+        try:
+            a.inc(1)
+            assert pub.push()           # full (first)
+            a.inc(1)
+            assert pub.push()           # delta: only nns_a changed
+            # the collector still holds BOTH keys (ingest is async)
+            assert wait_for(lambda: col.snapshot_state().get(
+                'nns_a_total{origin="w:9"}', {}).get("value") == 2)
+            snap = col.snapshot_state()
+            assert snap['nns_b_total{origin="w:9"}']["value"] == 1
+            # and the publisher's delta really was narrow
+            assert pub._last_sent["nns_b_total"]["value"] == 1
+        finally:
+            pub.stop(final_push=False)
+            srv.close()
+
+    def test_collector_restart_mid_push_recovers_full_state(self):
+        """Kill the collector server between pushes; a NEW collector on
+        the same port must end up with the COMPLETE state (the
+        publisher reconnects and resends full)."""
+        worker = MetricsRegistry()
+        a = worker.counter("nns_a_total")
+        b = worker.counter("nns_b_total")
+        col1 = MetricsCollector(registry=None)
+        srv1 = CollectorServer(col1, port=0)
+        port = srv1.port
+        pub = MetricsPublisher("127.0.0.1", port, registry=worker,
+                               origin="w:9", full_every=1000)
+        try:
+            a.inc(5)
+            b.inc(5)
+            assert pub.push()
+            srv1.close()
+            col2 = MetricsCollector(registry=None)
+            # rebind the SAME port (deterministic restart)
+            for _ in range(20):
+                try:
+                    srv2 = CollectorServer(col2, port=port)
+                    break
+                except OSError:
+                    time.sleep(0.1)
+            else:
+                pytest.fail(f"could not rebind port {port}")
+            try:
+                a.inc(1)        # only nns_a changed since the last push
+                # keep pushing: the first post-restart send may be
+                # silently buffered into the half-closed socket (TCP
+                # half-close — the RST only arrives on the next send);
+                # the push after THAT reconnects and is forced full
+                def recovered():
+                    pub.push()
+                    return col2.snapshot_state().get(
+                        'nns_a_total{origin="w:9"}',
+                        {}).get("value") == 6
+
+                assert wait_for(recovered, timeout=15)
+                # the key that did NOT change since the crash arrived
+                # anyway: the reconnect push was FULL
+                snap = col2.snapshot_state()
+                assert snap['nns_b_total{origin="w:9"}']["value"] == 5
+            finally:
+                srv2.close()
+        finally:
+            pub.stop(final_push=False)
+            srv1.close()
+
+    def test_query_server_piggyback(self):
+        """A QueryServer with a collector attached ingests T_METRICS on
+        its ordinary data connections — no second wire."""
+        from nnstreamer_tpu.query.server import QueryServer
+
+        worker = MetricsRegistry()
+        worker.counter("nns_pig_total").inc(3)
+        col = MetricsCollector(registry=None)
+        srv = QueryServer(port=0)
+        srv.collector = col
+        pub = MetricsPublisher("127.0.0.1", srv.port, registry=worker,
+                               origin="w:9")
+        try:
+            assert pub.push()
+            assert wait_for(lambda: col.snapshot_state().get(
+                'nns_pig_total{origin="w:9"}', {}).get("value") == 3)
+        finally:
+            pub.stop(final_push=False)
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# ephemeral metrics port satellite
+# ---------------------------------------------------------------------------
+
+class TestEphemeralMetricsPort:
+    def test_port_zero_binds_ephemeral_and_exports(self):
+        from nnstreamer_tpu.obs.httpd import (bound_metrics_port,
+                                              start_metrics_server,
+                                              stop_metrics_server)
+
+        stop_metrics_server()       # suite hygiene: fresh singleton
+        server = start_metrics_server(0)
+        try:
+            port = server.server_address[1]
+            assert port != 0
+            assert bound_metrics_port() == port
+            assert os.environ.get("NNS_METRICS_BOUND_PORT") == str(port)
+            import urllib.request
+
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=5) as resp:
+                assert resp.status == 200
+        finally:
+            stop_metrics_server()
+        assert bound_metrics_port() is None
+        assert "NNS_METRICS_BOUND_PORT" not in os.environ
+
+
+# ---------------------------------------------------------------------------
+# two REAL processes, one federated scrape (the PR 5 --timeline pattern)
+# ---------------------------------------------------------------------------
+
+class TestTwoProcessFederation:
+    def test_merged_scrape_round_trip(self, tmp_path):
+        """Spawn launch.py serving a real query pipeline with
+        --push-metrics at OUR collector; this process runs its own
+        registry as the local origin and serves the federated
+        endpoint.  One scrape must show both origins' series under
+        correct origin labels, and the remote side's server gauges
+        must be the REAL ones (its query server port gauge exists)."""
+        from nnstreamer_tpu.obs.dashboard import (key_labels,
+                                                  parse_prometheus)
+        from nnstreamer_tpu.query.client import QueryConnection
+        from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+        local = MetricsRegistry()
+        local.counter("nns_local_marker_total").inc(1)
+        col = MetricsCollector(registry=local, local_origin="local:0")
+        srv = CollectorServer(col, port=0)
+
+        import socket as _socket
+
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        data_port = s.getsockname()[1]
+        s.close()
+        caps = ("other/tensors,format=static,num_tensors=1,"
+                "dimensions=4,types=float32,framerate=0/1")
+        line = (f"tensor_query_serversrc name=qsrc id=77 "
+                f"port={data_port} caps={caps} ! "
+                "tensor_transform mode=arithmetic option=mul:2 ! "
+                "tensor_query_serversink id=77")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "nnstreamer_tpu.launch", line,
+             "--soak", "30", "--push-metrics",
+             f"127.0.0.1:{srv.port}", "--push-interval", "0.2",
+             "--quiet"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            cwd=REPO, text=True)
+        try:
+            # drive ONE real query so the worker's serving gauges are
+            # live, then wait for its pushes to land
+            deadline = time.monotonic() + 60
+            served = False
+            while time.monotonic() < deadline and not served:
+                try:
+                    conn = QueryConnection("127.0.0.1", data_port,
+                                           timeout=5.0, max_retries=1)
+                    conn.connect()
+                    try:
+                        served = conn.query(TensorBuffer(tensors=[
+                            np.arange(4, dtype=np.float32)])) is not None
+                    finally:
+                        conn.close()
+                except (ConnectionError, TimeoutError, OSError):
+                    time.sleep(0.25)
+            assert served, proc.stderr.read() if proc.poll() else \
+                "worker up but never served"
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                snap = col.snapshot_state()
+                if any("nns_query_server_accepted_total" in k
+                       for k in snap):
+                    break
+                time.sleep(0.2)
+
+            # ONE federated rendering shows both origins
+            flat = parse_prometheus(col.render_prometheus())
+            origins = {key_labels(k).get("origin") for k in flat}
+            origins.discard(None)
+            assert "local:0" in origins
+            remote = origins - {"local:0"}
+            assert remote, f"no remote origin in scrape: {origins}"
+            # the local marker and the remote server gauge both present
+            assert any("nns_local_marker_total" in k and
+                       'origin="local:0"' in k for k in flat)
+            assert any("nns_query_server_accepted_total" in k and
+                       'origin="local:0"' not in k for k in flat)
+            # remote wall stamps re-based: offset within 5 s on
+            # loopback
+            rrow = [o for o in col.origins()
+                    if o["origin"] != "local:0"][0]
+            assert abs(rrow["offset_us"]) < 5_000_000
+        finally:
+            import signal
+
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+            srv.close()
+
+
+class TestFederatedHealthz:
+    def test_collector_health_rides_healthz(self):
+        """register_health(): a draining worker anywhere in the fleet
+        flips the federated endpoint's /healthz to 503."""
+        from nnstreamer_tpu.obs.httpd import (health_report,
+                                              unregister_health_source)
+
+        col = MetricsCollector(registry=None)
+        token = col.register_health()
+        try:
+            col.ingest(payload(origin="w:1", health="serving"))
+            assert health_report()["ready"]
+            col.ingest(payload(origin="w:1", seq=2, health="draining"))
+            report = health_report()
+            assert report["state"] == "draining"
+            assert not report["ready"]
+            assert report["sources"]["federation"] == "draining"
+        finally:
+            unregister_health_source(token)
+
+
+class TestEpochResurrection:
+    def test_late_old_epoch_full_push_rejected(self):
+        """A dying incarnation's straggler FULL push (SIGTERM final
+        push landing after the restart) must not resurrect dead state
+        or flip epoch tracking back."""
+        col = MetricsCollector(registry=None)
+        col.ingest(payload(seq=9, epoch="e1", state={
+            "nns_x_total": {"kind": "counter", "value": 900}}))
+        col.ingest(payload(seq=1, epoch="e2", full=True, state={
+            "nns_x_total": {"kind": "counter", "value": 1}}))
+        assert not col.ingest(payload(seq=15, epoch="e1", full=True,
+                                      state={"nns_x_total": {
+                                          "kind": "counter",
+                                          "value": 915}}))
+        snap = col.snapshot_state()
+        assert snap['nns_x_total{origin="w:1"}']["value"] == 1
+        # the live incarnation's NEXT delta still merges
+        assert col.ingest(payload(seq=2, epoch="e2", full=False,
+                                  state={"nns_x_total": {
+                                      "kind": "counter", "value": 2}}))
+        assert col.snapshot_state()[
+            'nns_x_total{origin="w:1"}']["value"] == 2

@@ -509,6 +509,24 @@ def test_hotpath_bench_obs_gate():
 
 
 @pytest.mark.perf
+def test_hotpath_bench_telemetry_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage telemetry fails
+    when an untraced compiled plan references timeseries/federation/
+    signal state (the extended obs-vocabulary scan) or when fused
+    dispatch with a 25 ms ring sampler + federation collector +
+    loopback publisher attached costs more than 2% over bare — the
+    telemetry plane must be cheap enough to leave on in production."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "telemetry"],
+                       capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, (
+        f"telemetry gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_telemetry_gate"' in r.stdout
+
+
+@pytest.mark.perf
 def test_hotpath_bench_profile_gate():
     """CI gate: tools/hotpath_bench.py --assert --stage profile fails
     when an untraced compiled plan references profiler/attribution

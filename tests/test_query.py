@@ -381,7 +381,7 @@ class TestWireCrc:
         msg = Message(T_DATA, payload=b"hello")
         wire = bytearray(pack(msg))
         # zero the crc field (offset: magic4+type1+cid8+seq8+pts8+epoch8
-        # +trace8+span8+origin8 — wire rev 5)
+        # +trace8+span8+origin8 — wire rev 6, layout unchanged since 4)
         _struct.pack_into("<I", wire, 61, 0)
         wire[-1] ^= 0xFF            # corrupt — but crc=0 disables the check
         a, b = _socket.socketpair()
@@ -390,8 +390,8 @@ class TestWireCrc:
         got = recv_msg(b)
         assert got is not None and got.payload != b"hello"
         b.close()
-        # wire rev 5 'NNSU': T_SHED + HELLO qos, same 69 B header layout
-        assert HEADER.size == 69 and MAGIC == 0x4E4E5355
+        # wire rev 6 'NNSV': + T_METRICS, same 69 B header layout
+        assert HEADER.size == 69 and MAGIC == 0x4E4E5356
 
 
 class TestEdgeIdleSubscription:

@@ -1,0 +1,421 @@
+"""obs/timeseries.py: snapshot ring, windowed math, sustained signals.
+
+Every timing-sensitive assertion here drives ``TimeSeriesRing.capture``
+with an INJECTED clock — the PR 6 evaluator-test discipline: window
+math and hold/disarm transitions are deterministic functions of (t,
+registry state), so the tests pin them exactly, including the two
+acceptance shapes from the issue: an overload-shaped history FIRES the
+sustained-shed signal, a clean-demo-shaped history records ZERO
+firings."""
+
+import pytest
+
+from nnstreamer_tpu.obs.metrics import MetricsRegistry, state_delta
+from nnstreamer_tpu.obs.timeseries import (RingSampler, SignalBus,
+                                           SustainedSignal,
+                                           TimeSeriesRing,
+                                           flatten_state)
+
+
+def make_registry():
+    r = MetricsRegistry()
+    return r
+
+
+# ---------------------------------------------------------------------------
+# ring + windowed math
+# ---------------------------------------------------------------------------
+
+class TestRingWindows:
+    def test_windowed_counter_rate(self):
+        r = make_registry()
+        c = r.counter("nns_req_total", qos="gold")
+        ring = TimeSeriesRing(r, interval_s=1.0, retention_s=60.0)
+        for t in range(11):
+            c.inc(5)
+            ring.capture(now=float(t))
+        # 10 s window over 1 Hz captures: 50 events / 10 s
+        assert ring.rate("nns_req_total", 10.0) == pytest.approx(5.0)
+        # short window sees only the newest interval
+        assert ring.rate("nns_req_total", 1.0) == pytest.approx(5.0)
+
+    def test_rate_sums_across_labels_and_match_filters(self):
+        r = make_registry()
+        gold = r.counter("nns_req_total", qos="gold")
+        bronze = r.counter("nns_req_total", qos="bronze")
+        ring = TimeSeriesRing(r)
+        for t in range(4):
+            gold.inc(1)
+            bronze.inc(3)
+            ring.capture(now=float(t))
+        assert ring.rate("nns_req_total", 3.0) == pytest.approx(4.0)
+        assert ring.rate("nns_req_total", 3.0,
+                         match='qos="bronze"') == pytest.approx(3.0)
+
+    def test_windowed_histogram_quantile(self):
+        r = make_registry()
+        h = r.histogram("nns_lat_us")
+        ring = TimeSeriesRing(r)
+        h.observe(100.0)
+        ring.capture(now=0.0)
+        # the WINDOW only holds what lands between captures
+        for _ in range(100):
+            h.observe(1000.0)
+        ring.capture(now=1.0)
+        p99 = ring.quantile("nns_lat_us", 0.99, 10.0)
+        assert 800 < p99 < 1300     # bucket-resolution tolerance
+
+    def test_retention_bounds_samples(self):
+        r = make_registry()
+        ring = TimeSeriesRing(r, interval_s=1.0, retention_s=10.0)
+        for t in range(100):
+            ring.capture(now=float(t))
+        assert ring.captures == 100
+        assert len(ring._samples) <= 12
+        # the window base degrades to the oldest RETAINED sample
+        span, _delta = ring.window(60.0)
+        assert span <= 12.0
+
+    def test_series_points(self):
+        r = make_registry()
+        g = r.gauge("nns_depth", fn=None)
+        ring = TimeSeriesRing(r)
+        for t in range(5):
+            g.set(float(t * 2))
+            ring.capture(now=float(t))
+        pts = ring.series("nns_depth")
+        assert [v for _t, v in pts] == [0.0, 2.0, 4.0, 6.0, 8.0]
+        assert [t for t, _v in pts] == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+    def test_flat_samples_render_quantile_keys(self):
+        r = make_registry()
+        h = r.histogram("nns_lat_us", element="f")
+        for v in (10.0, 20.0, 40.0):
+            h.observe(v)
+        r.counter("nns_req_total").inc(7)
+        ring = TimeSeriesRing(r)
+        ring.capture(now=0.0)
+        _t, flat = ring.flat_samples()[-1]
+        assert flat["nns_req_total"] == 7.0
+        assert flat['nns_lat_us_count{element="f"}'] == 3.0
+        assert 'nns_lat_us{element="f",quantile="0.99"}' in flat
+
+    def test_empty_ring_is_quiet(self):
+        ring = TimeSeriesRing(make_registry())
+        assert ring.rate("nns_x", 10.0) == 0.0
+        assert ring.quantile("nns_x", 0.99, 10.0) == 0.0
+        assert ring.latest() is None
+        assert ring.flat_samples() == []
+
+
+# ---------------------------------------------------------------------------
+# counter-reset hardening (satellite)
+# ---------------------------------------------------------------------------
+
+class TestCounterReset:
+    def test_state_delta_marks_counter_reset(self):
+        old = {"nns_x": {"kind": "counter", "value": 100}}
+        new = {"nns_x": {"kind": "counter", "value": 3}}
+        d = state_delta(new, old)
+        assert d["nns_x"]["value"] == 0
+        assert d["nns_x"]["reset"] is True
+        # forward motion carries no reset flag
+        d2 = state_delta({"nns_x": {"kind": "counter", "value": 103}},
+                         {"nns_x": {"kind": "counter", "value": 100}})
+        assert d2["nns_x"]["value"] == 3
+        assert "reset" not in d2["nns_x"]
+
+    def test_state_delta_marks_histogram_reset(self):
+        old = {"nns_h": {"kind": "histogram", "count": 50,
+                         "total": 500.0, "counts": (50, 0)}}
+        new = {"nns_h": {"kind": "histogram", "count": 2,
+                         "total": 20.0, "counts": (2, 0)}}
+        d = state_delta(new, old)
+        assert d["nns_h"]["count"] == 0
+        assert d["nns_h"]["reset"] is True
+
+    def test_ring_rate_after_worker_restart_never_negative(self):
+        """A restarted worker's counter going 1000 -> 5 must read as a
+        zero-rate window, not -995/s."""
+        r = make_registry()
+        c = r.counter("nns_req_total")
+        ring = TimeSeriesRing(r)
+        c.inc(1000)
+        ring.capture(now=0.0)
+        # simulate the restart: fresh registry state via direct
+        # capture of a synthetic snapshot
+        ring.capture(now=1.0, state={"nns_req_total":
+                                     {"kind": "counter", "value": 5}})
+        assert ring.rate("nns_req_total", 10.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# sustained signals
+# ---------------------------------------------------------------------------
+
+def shed_registry():
+    r = make_registry()
+    g = r.gauge("nns_query_server_shed_rate", fn=None)
+    return r, g
+
+
+class TestSustainedSignal:
+    def test_blip_never_fires(self):
+        """One hot scrape above threshold must not fire — min-hold is
+        the arming discipline."""
+        r, g = shed_registry()
+        ring = TimeSeriesRing(r)
+        sig = ring.add_signal(SustainedSignal(
+            "shed", "nns_query_server_shed_rate", threshold=0.2,
+            min_hold_s=5.0, kind="gauge"))
+        values = [0.0, 0.0, 0.9, 0.0, 0.0, 0.0, 0.0, 0.0]
+        for t, v in enumerate(values):
+            g.set(v)
+            ring.capture(now=float(t))
+        assert sig.firings == 0
+        states = [e["state"] for e in ring.bus.events]
+        assert "fired" not in states
+        assert states == ["armed", "cleared"]
+
+    def test_sustained_fires_once_and_latches(self):
+        r, g = shed_registry()
+        ring = TimeSeriesRing(r)
+        sig = ring.add_signal(SustainedSignal(
+            "shed", "nns_query_server_shed_rate", threshold=0.2,
+            min_hold_s=5.0, kind="gauge"))
+        for t in range(20):
+            g.set(0.5)
+            ring.capture(now=float(t))
+        assert sig.state == "fired"
+        assert sig.firings == 1     # latched: no re-fire while held
+        fired = [e for e in ring.bus.events if e["state"] == "fired"]
+        assert len(fired) == 1
+        assert fired[0]["t"] == 5.0     # armed at 0, held 5 s
+
+    def test_disarm_hysteresis(self):
+        """Dropping below threshold but above disarm_below neither
+        clears nor allows a re-fire; only crossing disarm_below
+        re-arms."""
+        r, g = shed_registry()
+        ring = TimeSeriesRing(r)
+        sig = ring.add_signal(SustainedSignal(
+            "shed", "nns_query_server_shed_rate", threshold=0.4,
+            disarm_below=0.1, min_hold_s=2.0, kind="gauge"))
+        t = 0.0
+        for v in (0.5, 0.5, 0.5):       # fires at t=2
+            g.set(v)
+            ring.capture(now=t)
+            t += 1.0
+        assert sig.state == "fired" and sig.firings == 1
+        for v in (0.2, 0.3, 0.2):       # in the hysteresis band: hold
+            g.set(v)
+            ring.capture(now=t)
+            t += 1.0
+        assert sig.state == "fired"
+        g.set(0.05)                     # below disarm: cleared
+        ring.capture(now=t)
+        t += 1.0
+        assert sig.state == "idle"
+        for v in (0.5, 0.5, 0.5):       # re-armable: second onset
+            g.set(v)
+            ring.capture(now=t)
+            t += 1.0
+        assert sig.firings == 2
+
+    def test_hold_clock_resets_on_dip(self):
+        """A dip below threshold inside the hold window restarts the
+        hold — 'sustained' means continuously sustained."""
+        r, g = shed_registry()
+        ring = TimeSeriesRing(r)
+        sig = ring.add_signal(SustainedSignal(
+            "shed", "nns_query_server_shed_rate", threshold=0.4,
+            disarm_below=0.0, min_hold_s=3.0, kind="gauge"))
+        pattern = [0.5, 0.5, 0.3, 0.5, 0.5, 0.3, 0.5, 0.5]
+        for t, v in enumerate(pattern):
+            g.set(v)
+            ring.capture(now=float(t))
+        assert sig.firings == 0
+
+    def test_rate_signal_fires_on_sustained_counter_growth(self):
+        r = make_registry()
+        c = r.counter("nns_query_server_shed_total", qos="bronze")
+        ring = TimeSeriesRing(r)
+        sig = ring.add_signal(SustainedSignal(
+            "shed_burst", "nns_query_server_shed_total",
+            threshold=5.0, min_hold_s=4.0, kind="rate", window_s=5.0))
+        for t in range(12):
+            c.inc(10)       # 10/s >> 5/s
+            ring.capture(now=float(t))
+        assert sig.state == "fired" and sig.firings == 1
+
+    def test_reset_samples_are_ignored(self):
+        """A counter reset inside the window (worker restart) freezes
+        the signal: no fire, no clear, hold clock intact."""
+        r = make_registry()
+        ring = TimeSeriesRing(r)
+        sig = ring.add_signal(SustainedSignal(
+            "shed_burst", "nns_shed_total", threshold=5.0,
+            min_hold_s=2.0, kind="rate", window_s=2.0))
+        snap = lambda v: {"nns_shed_total":
+                          {"kind": "counter", "value": v}}
+        ring.capture(now=0.0, state=snap(0))
+        ring.capture(now=1.0, state=snap(100))   # 100/s: arms
+        assert sig.state == "holding"
+        # restart: count plummets — the tick must be SKIPPED, not read
+        # as either a huge negative rate or a recovery
+        ring.capture(now=2.0, state=snap(3))
+        assert sig.state == "holding"
+        assert sig.firings == 0
+        assert all(e["state"] != "fired" for e in ring.bus.events)
+
+    def test_p99_signal(self):
+        r = make_registry()
+        h = r.histogram("nns_slo_latency_us")
+        ring = TimeSeriesRing(r)
+        sig = ring.add_signal(SustainedSignal(
+            "slow", "nns_slo_latency_us", threshold=100_000.0,
+            min_hold_s=2.0, kind="p99", window_s=5.0))
+        for t in range(6):
+            for _ in range(50):
+                h.observe(300_000.0)
+            ring.capture(now=float(t))
+        assert sig.state == "fired"
+
+    def test_disarm_above_threshold_rejected(self):
+        with pytest.raises(ValueError):
+            SustainedSignal("bad", "nns_x", threshold=1.0,
+                            disarm_below=2.0, min_hold_s=1.0)
+
+    def test_signal_state_gauge_exported(self):
+        r, g = shed_registry()
+        ring = TimeSeriesRing(r)
+        ring.add_signal(SustainedSignal(
+            "shed", "nns_query_server_shed_rate", threshold=0.2,
+            min_hold_s=0.0, kind="gauge"))
+        snap = r.snapshot_state()
+        key = 'nns_signal_state{signal="shed"}'
+        assert snap[key]["value"] == 0
+        g.set(0.9)
+        ring.capture(now=0.0)       # min_hold 0: fires immediately
+        assert r.snapshot_state()[key]["value"] == 2
+        ring.close()
+        assert key not in r.snapshot_state()
+
+
+# ---------------------------------------------------------------------------
+# acceptance shapes: overload fires, clean demo stays silent
+# ---------------------------------------------------------------------------
+
+class TestSoakSignalShapes:
+    def test_overload_shape_fires_clean_shape_does_not(self):
+        """The issue's pinned acceptance, injected-clock edition: the
+        overload soak's steady state (~50% shed fraction for the whole
+        run) fires sustained_shed; the clean demo's occasional
+        one-tick wobble records zero firings.  Signal set = the
+        default soak watch list (tools/soak.py default_signals)."""
+        import importlib.util
+        import os
+        tool = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "soak.py")
+        spec = importlib.util.spec_from_file_location("_soak", tool)
+        soak = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(soak)
+
+        def run(shed_values):
+            r = make_registry()
+            g = r.gauge("nns_query_server_shed_rate", fn=None)
+            r.gauge("nns_query_server_queue_depth", fn=None).set(0.0)
+            ring = TimeSeriesRing(r, registry=r)
+            soak.default_signals(ring, queue_depth=12)
+            for t, v in enumerate(shed_values):
+                g.set(v)
+                ring.capture(now=float(t))
+            return ring.signal_report()
+
+        overload = run([0.0, 0.2, 0.45, 0.5, 0.55, 0.5, 0.52, 0.5,
+                        0.51, 0.5, 0.5, 0.5])
+        assert "sustained_shed" in overload["fired"]
+        clean = run([0.0, 0.0, 0.0, 0.3, 0.0, 0.0, 0.0, 0.0,
+                     0.0, 0.0, 0.0, 0.0])
+        assert clean["firings"] == 0
+        assert clean["fired"] == []
+
+
+# ---------------------------------------------------------------------------
+# bus + sampler plumbing
+# ---------------------------------------------------------------------------
+
+class TestBusAndSampler:
+    def test_bus_subscribe_unsubscribe(self):
+        bus = SignalBus()
+        got = []
+        unsub = bus.subscribe(got.append)
+        bus.publish({"signal": "a", "state": "fired"})
+        unsub()
+        bus.publish({"signal": "b", "state": "fired"})
+        assert [e["signal"] for e in got] == ["a"]
+        assert len(bus.events) == 2
+
+    def test_raising_subscriber_does_not_break_delivery(self):
+        bus = SignalBus()
+        got = []
+
+        def bad(_e):
+            raise RuntimeError("consumer bug")
+
+        bus.subscribe(bad)
+        bus.subscribe(got.append)
+        bus.publish({"signal": "a", "state": "fired"})
+        assert got
+
+    def test_sampler_captures_on_real_clock(self):
+        r = make_registry()
+        r.counter("nns_tick_total").inc()
+        ring = TimeSeriesRing(r, interval_s=0.02, retention_s=2.0)
+        sampler = RingSampler(ring).start()
+        import time
+        time.sleep(0.2)
+        sampler.stop()
+        assert ring.captures >= 3
+        assert ring.latest() is not None
+
+    def test_flatten_state_plain(self):
+        flat = flatten_state({
+            "nns_c": {"kind": "counter", "value": 4},
+            "nns_g{x=\"y\"}": {"kind": "gauge", "value": 1.5}})
+        assert flat == {"nns_c": 4.0, "nns_g{x=\"y\"}": 1.5}
+
+
+class TestHoldClockObservedTime:
+    def test_skipped_gap_does_not_count_toward_min_hold(self):
+        """Hold progress is OBSERVED time: a run of reset-marked ticks
+        between two over-threshold observations must not let the
+        unobserved gap satisfy min_hold_s."""
+        from nnstreamer_tpu.obs.metrics import MetricsRegistry
+
+        r = MetricsRegistry()
+        ring = TimeSeriesRing(r)
+        sig = ring.add_signal(SustainedSignal(
+            "burst", "nns_x_total", threshold=5.0, min_hold_s=5.0,
+            kind="rate", window_s=2.0))
+        snap = lambda v: {"nns_x_total":
+                          {"kind": "counter", "value": v}}
+        ring.capture(now=0.0, state=snap(0))
+        ring.capture(now=1.0, state=snap(100))      # arms
+        assert sig.state == "holding"
+        # restart at t=2, then six quiet RESET-free ticks where the
+        # metric is ABSENT entirely (worker gone): nothing observed
+        ring.capture(now=2.0, state=snap(3))        # reset: skipped
+        for t in range(3, 9):
+            ring.capture(now=float(t), state={})    # absent: skipped
+        # worker back, hot again: only ~1 s of OBSERVED hold exists
+        ring.capture(now=9.0, state=snap(103))
+        ring.capture(now=10.0, state=snap(203))
+        assert sig.state == "holding"
+        assert sig.firings == 0
+        # sustained from here on: fires after 5 more OBSERVED seconds
+        v = 203
+        for t in range(11, 16):
+            v += 100
+            ring.capture(now=float(t), state=snap(v))
+        assert sig.state == "fired" and sig.firings == 1

@@ -371,3 +371,204 @@ class TestPbtxtRoundTripCorpus:
         row = _json.loads(out.stdout.strip().splitlines()[-1])
         assert row["value"] == 0 and "preprobe" in row["error"]
         assert out.returncode == 0   # row contract, not rc
+
+
+class TestNnsTop:
+    """obs/dashboard.py rendering + tools/nns_top.py CLI: the frame
+    builder and renderer are pure functions of flat samples, so the
+    tests pin them on synthetic histories; the CLI is driven --once
+    against a real federated endpoint."""
+
+    def _samples(self):
+        """A 6-tick synthetic history: rising admitted counter, a shed
+        burst, a queue filling, one element's occupancy, a fired
+        signal, two origins."""
+        base = {
+            'nns_query_server_admitted_total{origin="a:1",qos="gold"}':
+                0.0,
+            'nns_query_server_shed_total{origin="a:1",qos="bronze"}':
+                0.0,
+            'nns_query_server_queue_depth{origin="a:1"}': 0.0,
+            'nns_element_occupancy{element="f",origin="a:1"}': 0.82,
+            'nns_element_proctime_us{element="f",quantile="0.99"}':
+                1234.0,
+            'nns_mfu{origin="a:1"}': 0.126,
+            'nns_signal_state{signal="sustained_shed",origin="a:1"}':
+                2.0,
+            'nns_query_server_clients{origin="b:2"}': 8.0,
+        }
+        samples = []
+        for t in range(6):
+            flat = dict(base)
+            flat['nns_query_server_admitted_total{origin="a:1",'
+                 'qos="gold"}'] = 50.0 * t
+            flat['nns_query_server_shed_total{origin="a:1",'
+                 'qos="bronze"}'] = 5.0 * t
+            flat['nns_query_server_queue_depth{origin="a:1"}'] = \
+                float(t)
+            samples.append((float(t), flat))
+        return samples
+
+    def test_build_view_rates_and_sections(self):
+        from nnstreamer_tpu.obs.dashboard import build_view
+
+        view = build_view(self._samples(), window_s=10.0)
+        rates = {r["label"]: r for r in view["rates"]}
+        assert rates["admitted"]["rate"] == pytest.approx(50.0)
+        assert rates["shed"]["rate"] == pytest.approx(5.0)
+        gauges = {g["label"]: g for g in view["gauges"]}
+        assert gauges["queue depth"]["value"] == 5.0
+        assert gauges["mfu"]["value"] == pytest.approx(0.126)
+        assert gauges["clients"]["value"] == 8.0
+        # origins derived from labels when no collector rows given
+        assert [o["origin"] for o in view["origins"]] == ["a:1", "b:2"]
+        [el] = view["elements"]
+        assert el["element"] == "f"
+        assert el["occupancy"] == pytest.approx(0.82)
+        assert el["p99_us"] == 1234.0
+        [sig] = view["signals"]
+        assert sig["signal"] == "sustained_shed"
+        assert sig["state"] == "FIRED"
+
+    def test_render_frame_text(self):
+        from nnstreamer_tpu.obs.dashboard import build_view, render_frame
+
+        text = render_frame(build_view(self._samples(), window_s=10.0),
+                            clock=0.0)
+        assert "nns-top" in text
+        assert "admitted" in text and "shed" in text
+        assert "a:1" in text and "b:2" in text
+        assert "sustained_shed=FIRED" in text
+        assert "mfu" in text
+        # counter restarts must never render negative rates
+        from nnstreamer_tpu.obs.dashboard import _rate
+
+        samples = [(0.0, {"nns_x_total": 100.0}),
+                   (1.0, {"nns_x_total": 3.0})]
+        assert _rate(samples, "nns_x_total", 10.0) == 0.0
+
+    def test_sparkline_and_bar(self):
+        from nnstreamer_tpu.obs.dashboard import bar, sparkline
+
+        assert sparkline([]) == " " * 16
+        s = sparkline([0, 1, 2, 3], width=4)
+        assert len(s) == 4 and s[0] != s[-1]
+        assert bar(0.5, width=10) == "[#####.....]"
+        assert bar(2.0, width=4) == "[####]"      # clamped
+
+    def test_ring_source_round_trip(self):
+        """RingSource: a real TimeSeriesRing + signal report renders
+        without a wire."""
+        from nnstreamer_tpu.obs.dashboard import RingSource, TopLoop
+        from nnstreamer_tpu.obs.metrics import MetricsRegistry
+        from nnstreamer_tpu.obs.timeseries import (SustainedSignal,
+                                                   TimeSeriesRing)
+
+        r = MetricsRegistry()
+        g = r.gauge("nns_query_server_shed_rate", fn=None)
+        ring = TimeSeriesRing(r, registry=r)
+        ring.add_signal(SustainedSignal(
+            "shed", "nns_query_server_shed_rate", threshold=0.2,
+            min_hold_s=0.0, kind="gauge"))
+        g.set(0.9)
+        for t in range(3):
+            ring.capture(now=float(t))
+        loop = TopLoop(RingSource(ring, label="test"), ansi=False)
+        text = loop.render_once()
+        assert "shed=fired(x1)" in text or "shed=fired" in text
+        assert "test" in text
+
+    def test_cli_once_against_federated_endpoint(self):
+        """tools/nns_top.py --once scrapes a live federated endpoint
+        and renders both origins."""
+        import json as _json
+
+        from nnstreamer_tpu.obs.federation import (CollectorServer,
+                                                   MetricsCollector)
+        from nnstreamer_tpu.obs.metrics import MetricsRegistry
+
+        local = MetricsRegistry()
+        local.gauge("nns_query_server_queue_depth", fn=None).set(3.0)
+        col = MetricsCollector(registry=local, local_origin="loc:1")
+        col.ingest({"origin": "rem:2", "seq": 1, "epoch": "e",
+                    "full": True, "wall_us": 0, "offset_us": 0,
+                    "health": "serving",
+                    "state": {"nns_mfu": {"kind": "gauge",
+                                          "value": 0.2}}})
+        import http.server
+        import threading
+
+        # a private endpoint instance (the process singleton may be in
+        # use by other tests): serve the collector's rendering directly
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):   # noqa: N802
+                body = col.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(TOOLS, "nns_top.py"),
+                 "--port", str(httpd.server_address[1]), "--once"],
+                capture_output=True, text=True, timeout=60,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            assert r.returncode == 0, r.stdout + r.stderr
+            assert "loc:1" in r.stdout and "rem:2" in r.stdout
+            assert "queue depth" in r.stdout
+            assert "mfu" in r.stdout
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_cli_once_dead_endpoint_exits_1(self):
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "nns_top.py"),
+             "--url", "127.0.0.1:1", "--once"],
+            capture_output=True, text=True, timeout=60,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 1
+
+    def test_parse_prometheus_timestamps_and_spacey_labels(self):
+        from nnstreamer_tpu.obs.dashboard import parse_prometheus
+
+        flat = parse_prometheus(
+            'nns_a{l="x y"} 12 1718000000000\n'
+            "nns_b 3.5\n"
+            "# HELP nns_c nope\n"
+            "nns_c{broken 1\n"
+            "nns_d{q=\"0.99\"} 7\n")
+        assert flat['nns_a{l="x y"}'] == 12.0
+        assert flat["nns_b"] == 3.5
+        assert flat['nns_d{q="0.99"}'] == 7.0
+        assert not any("broken" in k for k in flat)
+
+    def test_label_escape_round_trip(self):
+        """metrics.py escapes, dashboard.py decodes: values with
+        backslash-n sequences must round-trip exactly (sequential
+        replaces would turn an escaped backslash + 'n' into a
+        newline)."""
+        from nnstreamer_tpu.obs.dashboard import key_labels
+        from nnstreamer_tpu.obs.metrics import _label_str
+
+        for value in ('C:\\network', 'a"b', "line\nbreak",
+                      "\\\\n", "plain"):
+            key = "nns_x" + _label_str({"p": value})
+            assert key_labels(key)["p"] == value, value
+
+    def test_scrape_source_appends_metrics_path_to_full_urls(self):
+        from nnstreamer_tpu.obs.dashboard import ScrapeSource
+
+        assert ScrapeSource("127.0.0.1:9090").url \
+            == "http://127.0.0.1:9090/metrics"
+        assert ScrapeSource("http://h:9").url == "http://h:9/metrics"
+        assert ScrapeSource("http://h:9/").url == "http://h:9/metrics"
+        assert ScrapeSource("http://h:9/custom").url \
+            == "http://h:9/custom"

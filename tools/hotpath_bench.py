@@ -276,10 +276,13 @@ def bench_dispatch(frames: int) -> dict:
 
 #: identifiers whose presence in an UNTRACED compiled plan betrays an
 #: observability reference (PR 5 scan, extended with the PR 8 profiler
-#: vocabulary: attribution/blame/occupancy/annotation state must be as
-#: absent from untraced plans as the tracer itself)
+#: vocabulary — attribution/blame/occupancy/annotation — and the PR 13
+#: telemetry-plane vocabulary: time-series ring, sustained signals and
+#: federation state must be as absent from untraced plans as the
+#: tracer itself)
 _OBS_SUSPICIOUS = ("tracer", "metric", "span", "obs", "profil",
-                   "attrib", "blame", "occup", "annotat")
+                   "attrib", "blame", "occup", "annotat",
+                   "timeseri", "federat", "sustain", "signal")
 
 
 def _closure_obs_refs(fn) -> list:
@@ -372,6 +375,88 @@ def bench_obs(frames: int) -> dict:
     return {"metric": "hotpath_obs_overhead_pct",
             "value": round(pct, 2), "unit": "pct_vs_metrics_off",
             "untraced_plan_obs_refs": refs, "frames": frames}
+
+
+def _telemetry_overhead_pct(frames: int, reps: int = 3) -> float:
+    """Fused-dispatch wall time with the WHOLE telemetry plane armed —
+    a time-series ring sampling the registry at 25 ms with a sustained
+    signal configured, plus a federation collector server fed by a
+    loopback publisher at the same period — vs bare, interleaved
+    min-of-reps.  Everything runs on background threads off the
+    dispatch path, so what this measures is GIL/lock interference: the
+    ring capture and the publisher snapshot both take the registry
+    lock the dispatch path never touches (lazy gauges), and <2% is the
+    contract that keeps the telemetry plane always-on-able."""
+    from nnstreamer_tpu.obs.federation import (CollectorServer,
+                                               MetricsCollector,
+                                               MetricsPublisher)
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+    from nnstreamer_tpu.obs.timeseries import (RingSampler,
+                                               SustainedSignal,
+                                               TimeSeriesRing)
+
+    off = on = None
+    for _ in range(reps):
+        dt = _dispatch_run(5, True, frames)[0]
+        off = dt if off is None else min(off, dt)
+        collector = MetricsCollector(registry=REGISTRY)
+        server = CollectorServer(collector, port=0)
+        publisher = MetricsPublisher("127.0.0.1", server.port,
+                                     interval_s=0.025)
+        ring = TimeSeriesRing(interval_s=0.025, retention_s=2.0)
+        ring.add_signal(SustainedSignal(
+            "tele_gate", "nns_query_server_shed_rate",
+            threshold=1e9, min_hold_s=1.0))
+        sampler = RingSampler(ring).start()
+        publisher.start()
+        try:
+            dt = _dispatch_run(5, True, frames)[0]
+            on = dt if on is None else min(on, dt)
+        finally:
+            sampler.stop(final_capture=False)
+            publisher.stop(final_push=False)
+            server.close()
+            ring.close()
+    return (on - off) / off * 100.0
+
+
+def bench_telemetry(frames: int) -> dict:
+    frames = max(frames, 1500)
+    refs = _plan_obs_refs()
+    pct = _telemetry_overhead_pct(frames)
+    return {"metric": "hotpath_telemetry_overhead_pct",
+            "value": round(pct, 2), "unit": "pct_vs_unattached",
+            "untraced_plan_obs_refs": refs, "frames": frames}
+
+
+def run_assert_telemetry() -> int:
+    """Telemetry-plane gate: untraced compiled plans hold zero
+    timeseries/federation/signal references (the extended PR 5
+    vocabulary scan), and fused dispatch with a 25 ms ring sampler +
+    collector + loopback publisher attached stays within 2% of bare
+    (min-of-reps with re-measures — scheduler noise is one-sided, a
+    real per-buffer cost survives)."""
+    failures = []
+    refs = _plan_obs_refs()
+    if refs:
+        failures.append("untraced compiled plan references telemetry "
+                        "state: " + "; ".join(refs))
+    pct = _telemetry_overhead_pct(3000)
+    for _ in range(3):   # noise is one-sided; a real residue survives
+        if pct <= 2.0:
+            break
+        pct = min(pct, _telemetry_overhead_pct(3000))
+    if pct > 2.0:
+        failures.append(
+            f"dispatch overhead with ring+collector attached "
+            f"{pct:.2f}% > 2%: the telemetry plane leaked cost onto "
+            "the dispatch path")
+    result = {"metric": "hotpath_telemetry_gate", "unit": "ok",
+              "value": 0 if failures else 1,
+              "overhead_pct": round(pct, 2),
+              "untraced_plan_obs_refs": refs, "failures": failures}
+    print(json.dumps(result), flush=True)
+    return 1 if failures else 0
 
 
 def _profile_session() -> None:
@@ -732,16 +817,23 @@ def run_assert_xbatch() -> int:
     for _ in range(2):
         if ratio >= 2.0 and overhead <= 2.0:
             break
-        # best-of retries on every side (each side's fastest run —
-        # min-of-times, the same shape the other gates use): probe
-        # noise is one-sided — a background burst on a shared 2-core
-        # host can halve one 3 s window — and a real regression
-        # survives every retry
+        # best-ATTEMPT retries, each criterion judged on paired
+        # numbers from ONE attempt: probe noise is one-sided — a
+        # background burst on a shared 2-core host can halve one 3 s
+        # window — and mixing sides across attempts (max of each)
+        # couples measurements from different load windows, which a
+        # full-suite run showed can hold a phantom few-percent "solo
+        # overhead" across every retry.  Within one attempt the
+        # per-frame and batching probes run seconds apart under the
+        # same load, so a REAL constant overhead shows up in all of
+        # them while a load-window artifact does not.
         s2, b2, p2, x2 = _xbatch_measure()
-        solo, batched = max(solo, s2), max(batched, b2)
-        pf1, xb1 = max(pf1, p2), max(xb1, x2)
-        ratio = batched / max(1e-9, solo)
-        overhead = (pf1 / max(1e-9, xb1) - 1.0) * 100.0
+        r2 = b2 / max(1e-9, s2)
+        o2 = (p2 / max(1e-9, x2) - 1.0) * 100.0
+        if r2 > ratio:
+            ratio, solo, batched = r2, s2, b2
+        if o2 < overhead:
+            overhead, pf1, xb1 = o2, p2, x2
     if ratio < 2.0:
         failures.append(
             f"batched dispatch only {ratio:.2f}x solo per-frame "
@@ -941,7 +1033,7 @@ def main() -> int:
     ap.add_argument("--stage", choices=["pool", "serialize", "wire", "shm",
                                         "dispatch", "obs", "admit",
                                         "profile", "xbatch", "fusexla",
-                                        "all"],
+                                        "telemetry", "all"],
                     default="all")
     ap.add_argument("--assert", dest="assert_gate", action="store_true",
                     help="regression gates (exit 1): copy gate (serialize "
@@ -965,6 +1057,8 @@ def main() -> int:
             rc |= run_assert_profile()
         if args.stage in ("all", "fusexla"):
             rc |= run_assert_fusexla()
+        if args.stage in ("all", "telemetry"):
+            rc |= run_assert_telemetry()
         if args.stage in ("all", "xbatch"):
             rc |= run_assert_xbatch()
         return rc
@@ -972,7 +1066,8 @@ def main() -> int:
               "wire": bench_wire, "shm": bench_shm,
               "dispatch": bench_dispatch, "obs": bench_obs,
               "admit": bench_admit, "profile": bench_profile,
-              "xbatch": bench_xbatch, "fusexla": bench_fusexla}
+              "xbatch": bench_xbatch, "fusexla": bench_fusexla,
+              "telemetry": bench_telemetry}
     picks = stages if args.stage == "all" else {args.stage:
                                                stages[args.stage]}
     for fn in picks.values():

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""``nns-top``: live terminal dashboard over a running pipeline fleet.
+
+Scrape mode (the default — works against ANY ``/metrics`` endpoint the
+framework serves: a single ``launch.py --metrics-port`` process or a
+federation collector's merged endpoint)::
+
+    python tools/nns_top.py --url 127.0.0.1:9090          # loop
+    python tools/nns_top.py --port 9090 --interval 0.5
+    python tools/nns_top.py --url 127.0.0.1:9090 --once   # one frame
+
+Renders per-element occupancy, bucket fill, MFU, queue depths,
+shed/admit rates with trends, and armed sustained signals — per origin
+when the endpoint is federated (obs/federation.py).  ``--once`` prints
+a single plain frame and exits (scriptable / CI-friendly); the loop
+refreshes in place until Ctrl-C or ``--duration``.
+
+The same view inside a launching process: ``launch.py <pipeline> --top``
+(obs/dashboard.py is the shared engine; this file is the scrape-side
+front door).
+"""
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))   # repo root: nnstreamer_tpu
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nns-top", description="live telemetry dashboard")
+    ap.add_argument("--url", default=None,
+                    help="metrics endpoint (host:port or full URL; "
+                         "/metrics appended when missing)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="shorthand for --url 127.0.0.1:PORT")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh/scrape period, seconds")
+    ap.add_argument("--window", type=float, default=10.0,
+                    help="rate window, seconds")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="exit after SECONDS (default: run until ^C)")
+    ap.add_argument("--once", action="store_true",
+                    help="scrape + print ONE plain frame and exit "
+                         "(no ANSI; exit 1 when the scrape fails)")
+    ap.add_argument("--no-ansi", action="store_true",
+                    help="append frames instead of redrawing in place")
+    args = ap.parse_args(argv)
+
+    if args.port is not None and args.url is None:
+        args.url = f"127.0.0.1:{args.port}"
+    if not args.url:
+        env_port = os.environ.get("NNS_METRICS_BOUND_PORT") \
+            or os.environ.get("NNS_METRICS_PORT")
+        if env_port and env_port != "0":
+            args.url = f"127.0.0.1:{env_port}"
+        else:
+            ap.error("--url or --port required (or NNS_METRICS_PORT "
+                     "in the environment)")
+
+    from nnstreamer_tpu.obs.dashboard import ScrapeSource, TopLoop
+
+    source = ScrapeSource(args.url)
+    loop = TopLoop(source, interval_s=args.interval,
+                   window_s=args.window, ansi=not args.no_ansi)
+    if args.once:
+        sys.stdout.write(loop.render_once())
+        if source.scrape_errors:
+            print(f"nns-top: scrape failed: {source.url}",
+                  file=sys.stderr)
+            return 1
+        return 0
+    try:
+        loop.run(duration_s=args.duration)
+    except KeyboardInterrupt:
+        pass
+    if source.scrape_errors and not source.samples:
+        print(f"nns-top: endpoint never answered: {source.url}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

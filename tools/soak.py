@@ -888,6 +888,116 @@ def run_xbatch(args, ap) -> int:
 
 
 
+FEDERATE_SERVER_ID = 93
+
+
+def spawn_federated_worker(out_dir: str, data_port: int,
+                           collector_port: int, soak_s: float,
+                           push_interval_s: float = 0.5):
+    """One out-of-process worker for the federated soak: the same demo
+    serving pipeline, launched via ``launch.py --push-metrics`` so its
+    registry streams into THIS process's collector.  Returns a Popen
+    (SIGTERM drains it — launch.py installs the drain handler)."""
+    import subprocess
+
+    os.makedirs(out_dir, exist_ok=True)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    line = (f"tensor_query_serversrc name=qsrc id={FEDERATE_SERVER_ID} "
+            f"port={data_port} caps={DEMO_CAPS} ! "
+            "tensor_transform mode=arithmetic option=mul:2 ! "
+            f"tensor_query_serversink id={FEDERATE_SERVER_ID}")
+    log = open(os.path.join(out_dir, "worker.log"), "w",
+               encoding="utf-8")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "nnstreamer_tpu.launch", line,
+         "--soak", str(soak_s),
+         "--push-metrics", f"127.0.0.1:{collector_port}",
+         "--push-interval", str(push_interval_s), "--quiet"],
+        stdout=log, stderr=log, env=env, cwd=root)
+    proc._soak_log = log    # closed by stop_worker
+    return proc
+
+
+def stop_worker(proc, grace_s: float = 15.0) -> None:
+    import signal
+
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=grace_s)
+    except Exception:   # noqa: BLE001 — hard stop after the grace
+        proc.kill()
+        proc.wait(timeout=10)
+    proc._soak_log.close()
+
+
+def wait_query_ready(host: str, port: int, payload,
+                     timeout_s: float = 60.0, proc=None) -> bool:
+    """Block until a query round trip succeeds against host:port.
+    ``proc`` (the serving Popen) fails fast when the process died at
+    startup instead of spinning out the whole timeout."""
+    import time as _time
+
+    import numpy as np
+
+    from nnstreamer_tpu.query.client import QueryConnection
+    from nnstreamer_tpu.tensor.buffer import TensorBuffer
+
+    deadline = _time.monotonic() + timeout_s
+    while _time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return False
+        try:
+            conn = QueryConnection(host, port, timeout=10.0,
+                                   max_retries=1)
+            conn.connect()
+            try:
+                if conn.query(TensorBuffer(
+                        tensors=[np.asarray(payload)])) is not None:
+                    return True
+            finally:
+                conn.close()
+        except (ConnectionError, TimeoutError, OSError):
+            _time.sleep(0.25)
+    return False
+
+
+def default_signals(ring, queue_depth: int):
+    """The standard sustained signals every soak watches — the same
+    bus the fleet autoscaler will subscribe to (ROADMAP item 3):
+
+    - ``sustained_shed``: shed fraction >= 0.2 held 5 s (disarm below
+      0.1) — the server has been genuinely refusing work, not one hot
+      scrape;
+    - ``sustained_queue``: worst queue depth >= 75 % of the bound held
+      5 s — backlog is structural, not a burst;
+    - ``shed_burst``: windowed shed rate >= 5/s held 5 s — volume
+      evidence next to the fraction.
+
+    The clean ``--demo`` soak must record ZERO firings on all three
+    (the false-positive gate); the ``--overload`` soak must fire
+    ``sustained_shed`` (57 % bronze shed is the designed steady state).
+    """
+    from nnstreamer_tpu.obs.timeseries import SustainedSignal
+
+    return [
+        ring.add_signal(SustainedSignal(
+            "sustained_shed", "nns_query_server_shed_rate",
+            threshold=0.2, disarm_below=0.1, min_hold_s=5.0,
+            kind="gauge", window_s=10.0)),
+        ring.add_signal(SustainedSignal(
+            "sustained_queue", "nns_query_server_queue_depth",
+            threshold=max(1.0, 0.75 * queue_depth), min_hold_s=5.0,
+            kind="gauge", window_s=10.0)),
+        ring.add_signal(SustainedSignal(
+            "shed_burst", "nns_query_server_shed_total",
+            threshold=5.0, min_hold_s=5.0, kind="rate",
+            window_s=10.0)),
+    ]
+
+
 def default_chaos(duration_s: float) -> str:
     """Demo chaos: a full connection kill at 35 % and a one-shot
     mid-stream disconnect at 60 % of the soak — both recoverable, so a
@@ -962,6 +1072,16 @@ def main(argv=None) -> int:
                          "capacity under the same SLO spec, and gate "
                          "on rps/admission-wait/nns_mfu vs the "
                          "PROFILE_r08 streaming baselines")
+    ap.add_argument("--federate", action="store_true",
+                    help="telemetry-federation acceptance mode (demo "
+                         "only): spawn a SECOND serving process "
+                         "(launch.py --push-metrics) next to the "
+                         "in-process demo server, drive load at both, "
+                         "serve ONE federated /metrics endpoint "
+                         "(obs/federation.py collector) whose scrape "
+                         "shows both origins, and record the federated "
+                         "per-origin timeline in the flight recorder "
+                         "so a breach bundle shows both sides")
     ap.add_argument("--xbatch-timeout-ms", type=float, default=30.0,
                     help="batch-timeout-ms for the --xbatch server.  "
                          "Default 30 (deadline mode): the soak's "
@@ -984,7 +1104,13 @@ def main(argv=None) -> int:
 
     os.makedirs(args.out, exist_ok=True)
     demo = args.demo or not args.port
+    if args.federate and not demo:
+        ap.error("--federate requires the --demo target (the collector "
+                 "and its federated endpoint live in the soak process)")
     server = tracer = None
+    collector = collector_server = worker = None
+    fed_endpoint = None
+    sampler = ring = None
     try:
         if demo:
             # overload mode bounds the demo queue to the latency
@@ -1013,6 +1139,46 @@ def main(argv=None) -> int:
                    "vs_baseline": None, "diagnosis": diagnosis}
             print(json.dumps(row), flush=True)
             return 2
+
+        worker_port = None
+        if args.federate:
+            # the soak process IS the collector: local registry (the
+            # demo server's gauges) merges as its own origin next to
+            # the pushed worker origins, and ONE endpoint serves the
+            # merged view (obs/federation.py)
+            from nnstreamer_tpu.obs.federation import (CollectorServer,
+                                                       MetricsCollector)
+            from nnstreamer_tpu.obs.httpd import start_metrics_server
+
+            from nnstreamer_tpu.obs.httpd import stop_metrics_server
+
+            collector = MetricsCollector()
+            collector.register_health()
+            collector_server = CollectorServer(collector, port=0)
+            # the process singleton may already be claimed (a set
+            # NNS_METRICS_PORT armed it at the demo pipeline's play(),
+            # bound to the PLAIN registry) — and start_metrics_server
+            # is idempotent, so without this the "federated" endpoint
+            # would silently serve origin-less metrics and fail the
+            # scrape check on a perfectly healthy run
+            stop_metrics_server()
+            fed_endpoint = start_metrics_server(0, registry=collector)
+            worker_port = _free_port()
+            worker = spawn_federated_worker(
+                os.path.join(args.out, "worker"), worker_port,
+                collector_server.port, soak_s=args.duration + 60.0)
+            import numpy as np
+
+            if not wait_query_ready("127.0.0.1", worker_port,
+                                    np.arange(4, dtype=np.float32),
+                                    proc=worker):
+                print(json.dumps({
+                    "metric": "soak_verdict", "verdict": "INFRA_DEAD",
+                    "pass": False, "status": "infra_dead",
+                    "vs_baseline": None,
+                    "reason": "federated worker never came up "
+                              "(see worker/worker.log)"}), flush=True)
+                return 2
 
         spec = load_spec(args.slo, duration_s=args.duration)
         if args.force_breach:
@@ -1072,10 +1238,31 @@ def main(argv=None) -> int:
                       if args.chaos is None else args.chaos)
         schedule = ChaosSchedule.parse(proxy, chaos_spec)
 
-        recorder = FlightRecorder(args.out, tracer=tracer)
+        recorder = FlightRecorder(args.out, tracer=tracer,
+                                  collector=collector)
         evaluator = Evaluator(spec, on_breach=recorder.on_breach)
         evaluator.on_tick = recorder.record
         monitor = SLOMonitor(evaluator)
+
+        # sustained-signal watch (obs/timeseries.py): the ring runs
+        # over the FEDERATED view when one exists — fleet-wide shed /
+        # queue evidence — else the local registry.  The clean demo
+        # must end with zero firings; the overload run must fire
+        # sustained_shed (its designed steady state IS sustained shed).
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+        from nnstreamer_tpu.obs.timeseries import (RingSampler,
+                                                   TimeSeriesRing)
+
+        ring = TimeSeriesRing(
+            collector if collector is not None else REGISTRY,
+            interval_s=1.0,
+            retention_s=max(60.0, args.duration + 10.0),
+            registry=REGISTRY)
+        from nnstreamer_tpu.query.server import DEFAULT_QUEUE_DEPTH
+
+        demo_depth = 12 if overload else DEFAULT_QUEUE_DEPTH
+        default_signals(ring, demo_depth)
+        sampler = RingSampler(ring).start()
 
         gen = LoadGenerator(
             proxy.host, proxy.port, clients=clients,
@@ -1083,6 +1270,17 @@ def main(argv=None) -> int:
             schedule=args.schedule, seed=args.seed,
             timeout=timeout,
             classes=classes, qos=overload)
+        worker_gen = None
+        if args.federate:
+            # the worker origin must show LIVE traffic on the federated
+            # endpoint, not just registered gauges: a quarter of the
+            # client population drives it directly (chaos stays on the
+            # primary so its bookkeeping is undisturbed)
+            worker_gen = LoadGenerator(
+                "127.0.0.1", worker_port,
+                clients=max(4, clients // 4), rate_hz=rate,
+                duration_s=args.duration, schedule=args.schedule,
+                seed=args.seed + 1, timeout=timeout, classes=classes)
 
         probe = None
         if overload:
@@ -1096,17 +1294,85 @@ def main(argv=None) -> int:
 
         schedule.start()
         monitor.start()
+        wthread = wsummary = None
+        if worker_gen is not None:
+            import threading as _threading
+
+            wresult = {}
+
+            def _drive_worker():
+                wresult["summary"] = worker_gen.run()
+
+            wthread = _threading.Thread(target=_drive_worker,
+                                        daemon=True,
+                                        name="federated-loadgen")
+            wthread.start()
         try:
             summary = gen.run()
         finally:
+            if wthread is not None:
+                wthread.join(timeout=args.duration + 60.0)
+                wsummary = wresult.get("summary")
             monitor.stop(final_tick=True)
             probe_stats = probe.stop() if probe is not None else None
             schedule.stop()
             proxy.close()
 
+        federation = None
+        if args.federate:
+            # scrape the ONE federated endpoint while BOTH origins are
+            # still live: the acceptance is that a single GET shows
+            # both processes' gauges under correct origin labels
+            import urllib.request
+
+            from nnstreamer_tpu.obs.dashboard import (key_labels,
+                                                      parse_prometheus)
+
+            fed_port = fed_endpoint.server_address[1]
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{fed_port}/metrics",
+                        timeout=5) as resp:
+                    scraped = parse_prometheus(
+                        resp.read().decode("utf-8", "replace"))
+            except OSError:
+                scraped = {}
+            per_origin = {}
+            for key in scraped:
+                o = key_labels(key).get("origin")
+                if o:
+                    per_origin[o] = per_origin.get(o, 0) + 1
+            origins = collector.origins()
+            federation = {
+                "endpoint_port": fed_port,
+                "collector_port": collector_server.port,
+                "origins": origins,
+                "scraped_series_by_origin": per_origin,
+                "worker_loadgen": wsummary,
+                "checks": {
+                    "two_origins_live": len(origins) >= 2,
+                    "scrape_shows_all_origins":
+                        len(per_origin) >= 2 and
+                        all(n > 0 for n in per_origin.values()),
+                    "worker_traffic_ok": bool(
+                        wsummary and wsummary.get("ok", 0) > 0
+                        and not wsummary.get("errors", 1)),
+                },
+            }
+            federation["pass"] = all(federation["checks"].values())
+
+        if sampler is not None:
+            sampler.stop(final_capture=True)
+
         verdict = evaluator.verdict()
         verdict["status"] = "live"
         verdict["loadgen"] = summary
+        if ring is not None:
+            verdict["signals"] = ring.signal_report()
+        if federation is not None:
+            verdict["federation"] = federation
+            verdict["pass"] = verdict["pass"] and federation["pass"]
+            verdict["verdict"] = "PASS" if verdict["pass"] else "FAIL"
         from nnstreamer_tpu.obs.profile import attribution_block
 
         attribution = attribution_block(tracer)
@@ -1152,6 +1418,17 @@ def main(argv=None) -> int:
             "bundles": recorder.dumps,
             "artifact": os.path.join(args.out, "verdict.json"),
         }
+        if ring is not None:
+            line["signals"] = {
+                "firings": verdict["signals"]["firings"],
+                "fired": verdict["signals"]["fired"]}
+        if federation is not None:
+            line["federation"] = {
+                "pass": federation["pass"],
+                "origins": [o["origin"] for o in federation["origins"]],
+                "scraped_series_by_origin":
+                    federation["scraped_series_by_origin"],
+                "checks": federation["checks"]}
         if attribution:
             line["attribution"] = {
                 "top": attribution["top"],
@@ -1170,6 +1447,18 @@ def main(argv=None) -> int:
         print(json.dumps(line), flush=True)
         return 0 if verdict["pass"] else 1
     finally:
+        if sampler is not None:
+            sampler.stop(final_capture=False)
+        if ring is not None:
+            ring.close()
+        if worker is not None:
+            stop_worker(worker)
+        if fed_endpoint is not None:
+            from nnstreamer_tpu.obs.httpd import stop_metrics_server
+
+            stop_metrics_server()
+        if collector_server is not None:
+            collector_server.close()
         if server is not None:
             server.stop()
             from nnstreamer_tpu.query.server import shutdown_server
