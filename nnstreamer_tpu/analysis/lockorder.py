@@ -42,6 +42,14 @@ HIERARCHY: Dict[str, int] = {
     "collectpads": 42,      # mux/merge N-pad sync engine
     "repo": 44,             # tensor_repo slot/caps table
     "shm.ring": 46,         # shm ring local wakeup condition
+    # fleet tier -------------------------------------------------------------
+    "fleet.autoscaler": 47,  # autoscaler cooldown/decision state; calls
+    #                          into the pool, so below fleet.pool
+    "fleet.pool": 48,       # worker-pool table; membership callbacks
+    #                         call into the router, so below fleet.router
+    "fleet.router": 49,     # router membership + routed-client table;
+    #                         rebalance calls FailoverConnection
+    #                         endpoint updates, so below query.client
     # query / transport layer ----------------------------------------------
     "query.registry": 50,   # server/broker connection registries
     "query.client": 52,     # FailoverConnection endpoint state

@@ -327,6 +327,31 @@ def _check_thread_structure(pipeline, findings: List[Finding]) -> None:
 
 
 # --------------------------------------------------------------------------
+# fleet configs (reported by --check on a .json argument)
+# --------------------------------------------------------------------------
+
+def verify_fleet_config(config) -> List[Finding]:
+    """Static findings for a fleet config document
+    (:class:`~nnstreamer_tpu.fleet.config.FleetConfig` or a dict/path
+    it loads from).  The fleet tier's structural failure modes are
+    graph-shaped — a router fronting zero workers, inverted autoscaler
+    bounds, a drain grace that cuts resident cross-stream buckets —
+    so they get the pipeline verifier's treatment: named errors BEFORE
+    anything spawns (``launch.py --check fleet.json``)."""
+    from ..fleet.config import load_fleet_config
+
+    try:
+        cfg = load_fleet_config(config)
+    except (OSError, ValueError, TypeError) as exc:
+        return [Finding("error", "fleet-config", str(config),
+                        f"cannot load fleet config: {exc}")]
+    findings = [Finding(sev, rule, "fleet", message)
+                for sev, rule, message in cfg.validate()]
+    findings.sort(key=lambda f: _SEV_ORDER.get(f.severity, 3))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # thread-boundary structure (reported by --check)
 # --------------------------------------------------------------------------
 

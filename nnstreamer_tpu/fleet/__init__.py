@@ -1,0 +1,37 @@
+"""Horizontal serving fleet: router + managed worker pool + autoscaler.
+
+ROADMAP item 3 composed from the pieces earlier PRs left waiting:
+
+- :mod:`fleet.ring` — seeded consistent-hash placement (model identity
+  → stable worker replica set; membership changes move ~1/N of keys);
+- :mod:`fleet.router` — one client endpoint fanning connections across
+  :class:`~nnstreamer_tpu.query.server.QueryServer` workers over PR 1
+  :class:`~nnstreamer_tpu.query.client.FailoverConnection` backend
+  legs (hot ``dest-hosts`` updates = storm-free rebalance; T_SHED/QoS
+  pass through untouched);
+- :mod:`fleet.pool` — spawns ``launch.py`` workers federating into a
+  PR 13 collector, restarts crashes with backoff, scales down via the
+  PR 7 SIGTERM drain (route-away first);
+- :mod:`fleet.autoscaler` — PR 13 sustained signals closed into a
+  control loop with cooldowns and hysteresis;
+- :mod:`fleet.config` — the JSON config document +
+  ``launch.py --check fleet.json`` static validation.
+
+Gated end to end by ``tools/soak.py --fleet`` (multi-process soak:
+worker kill mid-run with zero client errors, autoscale up on sustained
+load, drain on idle — SOAK_fleet artifacts).
+"""
+
+from .autoscaler import Autoscaler, default_autoscaler_signals
+from .config import AutoscalerConfig, FleetConfig, load_fleet_config
+from .pool import (FleetLoop, ManagedWorker, WorkerPool, free_port,
+                   launch_spawn_fn)
+from .ring import ConsistentHashRing
+from .router import TensorQueryRouter
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "ConsistentHashRing",
+    "FleetConfig", "FleetLoop", "ManagedWorker", "TensorQueryRouter",
+    "WorkerPool", "default_autoscaler_signals", "free_port",
+    "launch_spawn_fn", "load_fleet_config",
+]

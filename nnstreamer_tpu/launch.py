@@ -221,6 +221,18 @@ def main(argv=None) -> int:
     if args.check:
         return check(args.pipeline)
 
+    import os as _os
+
+    fleet_role = _os.environ.get("NNS_FLEET_ROLE")
+    if fleet_role:
+        # fleet membership tag (fleet/pool.py sets NNS_FLEET_ROLE=
+        # worker on spawned processes): rides the federated scrape so
+        # the nns-top fleet view labels each origin router/worker
+        from .obs.metrics import REGISTRY
+
+        REGISTRY.gauge("nns_fleet_role", fn=lambda: 1.0,
+                       role=str(fleet_role))
+
     t0 = time.time()
     slo_failed = False
     try:
@@ -477,6 +489,14 @@ def check(description: str, out=None) -> int:
 
     from . import parse_launch
 
+    import os as _os
+
+    if str(description).endswith(".json") \
+            and _os.path.exists(description):
+        # fleet config document (fleet/config.py), not a launch
+        # string: run the fleet verifier — router-with-zero-workers,
+        # min>max, drain-grace-vs-bucket-window are named errors here
+        return check_fleet(description, out=out)
     try:
         p = parse_launch(description)
     except ParseError as exc:
@@ -488,6 +508,23 @@ def check(description: str, out=None) -> int:
     for seg in thread_segments(p):
         members = " -> ".join(seg["elements"]) or "(boundary only)"
         print(f"check: thread {seg['thread']}: {members}", file=out)
+    errors = [f for f in findings if f.severity == "error"]
+    if errors:
+        print(f"check: FAIL ({len(errors)} error(s))", file=out)
+        return 1
+    print("check: OK", file=out)
+    return 0
+
+
+def check_fleet(path: str, out=None) -> int:
+    """``--check`` on a fleet config JSON: static validation without
+    spawning anything (analysis/verify.py verify_fleet_config)."""
+    out = out or sys.stderr
+    from .analysis.verify import verify_fleet_config
+
+    findings = verify_fleet_config(path)
+    for f in findings:
+        print(f"check: {f}", file=out)
     errors = [f for f in findings if f.severity == "error"]
     if errors:
         print(f"check: FAIL ({len(errors)} error(s))", file=out)

@@ -185,7 +185,33 @@ def build_view(samples: Sequence[Tuple[float, Dict[str, float]]],
         keys = sorted({key_labels(k).get("origin") for k in flat}
                       - {None})
         origins = [{"origin": o} for o in keys]
+
+    # -- fleet tier (fleet/router.py + launch.py NNS_FLEET_ROLE):
+    # per-origin role tags from the nns_fleet_role gauges, per-worker
+    # routed-connection counts + draining state from the router's
+    # gauges — all riding the same federated scrape, so the fleet view
+    # needs no side channel
+    roles: Dict[str, str] = {}
+    for k in _match(flat, "nns_fleet_role"):
+        labels = key_labels(k)
+        role = labels.get("role")
+        if role:
+            roles[labels.get("origin", "")] = role
+    origins = [dict(o) for o in origins]
+    for o in origins:
+        role = roles.get(str(o.get("origin", "")))
+        if role:
+            o["role"] = role
     view["origins"] = origins
+    fleet_workers: Dict[str, Dict[str, Any]] = {}
+    for k, v in _match(flat, "nns_fleet_routed_connections").items():
+        w = key_labels(k).get("worker", "?")
+        fleet_workers.setdefault(w, {"worker": w})["routed"] = v
+    for k, v in _match(flat, "nns_fleet_worker_draining").items():
+        w = key_labels(k).get("worker", "?")
+        fleet_workers.setdefault(w, {"worker": w})["draining"] = \
+            bool(v)
+    view["fleet"] = [fleet_workers[w] for w in sorted(fleet_workers)]
 
     # -- serving rates
     rates = []
@@ -329,6 +355,8 @@ def render_frame(view: Dict[str, Any], width: int = 96,
         for o in origins:
             cell = o["origin"]
             extra = []
+            if o.get("role"):
+                extra.append(str(o["role"]))
             if o.get("health"):
                 extra.append(str(o["health"]))
             if o.get("age_s") is not None:
@@ -337,6 +365,14 @@ def render_frame(view: Dict[str, Any], width: int = 96,
                 cell += " (" + ", ".join(extra) + ")"
             cells.append(cell)
         lines.append("origins: " + "   ".join(cells))
+
+    fleet = view.get("fleet") or []
+    if fleet:
+        lines.append(f"{'fleet worker':<24}{'routed':>8}  state")
+        for w in fleet:
+            state = "draining" if w.get("draining") else "serving"
+            lines.append(f"{w['worker']:<24}"
+                         f"{_fmt(w.get('routed')):>8}  {state}")
 
     if view.get("rates"):
         lines.append(f"{'throughput':<18}{'total':>12}{'rate/s':>10}"

@@ -125,6 +125,13 @@ class SustainedSignal:
     while holding resets the hold clock without a ``cleared`` event —
     hysteresis, not flapping.
 
+    ``direction="below"`` inverts the comparison for *idle* conditions
+    (the fleet autoscaler's drain-on-idle signal): the condition holds
+    while ``value <= threshold`` and disarms at ``value >=
+    disarm_above`` (default ``2 x threshold``, or ``1.0`` when the
+    threshold is 0 — "any traffic at all clears idleness").  Same
+    state machine, same reset discipline, mirrored band.
+
     Reset discipline: when any matching key's window delta carries
     ``reset: True`` (state_delta detected counters going backwards — a
     worker restart), the tick is SKIPPED: the hold clock neither
@@ -136,24 +143,50 @@ class SustainedSignal:
                  min_hold_s: float, kind: str = "gauge",
                  window_s: float = 10.0,
                  disarm_below: Optional[float] = None,
+                 direction: str = "above",
+                 disarm_above: Optional[float] = None,
                  agg: str = "max", match: Optional[str] = None) -> None:
         if kind not in ("gauge", "rate", "p99", "p95", "p50"):
             raise ValueError(f"signal {name}: kind={kind!r}")
         if agg not in ("max", "sum", "mean"):
             raise ValueError(f"signal {name}: agg={agg!r}")
+        if direction not in ("above", "below"):
+            raise ValueError(f"signal {name}: direction={direction!r}")
         self.name = name
         self.metric = metric
         self.kind = kind
+        self.direction = direction
         self.threshold = float(threshold)
         self.min_hold_s = float(min_hold_s)
         self.window_s = float(window_s)
-        self.disarm_below = (float(disarm_below) if disarm_below
-                             is not None else self.threshold / 2.0)
-        if self.disarm_below > self.threshold:
-            raise ValueError(
-                f"signal {name}: disarm_below {self.disarm_below} above "
-                f"threshold {self.threshold} (hysteresis must disarm "
-                "BELOW where it arms)")
+        if direction == "below":
+            if disarm_below is not None:
+                raise ValueError(
+                    f"signal {name}: direction=below disarms ABOVE the "
+                    "threshold — use disarm_above")
+            self.disarm_above = (float(disarm_above) if disarm_above
+                                 is not None
+                                 else (self.threshold * 2.0
+                                       or 1.0))
+            self.disarm_below = None
+            if self.disarm_above < self.threshold:
+                raise ValueError(
+                    f"signal {name}: disarm_above {self.disarm_above} "
+                    f"under threshold {self.threshold} (an idle signal "
+                    "must disarm ABOVE where it arms)")
+        else:
+            if disarm_above is not None:
+                raise ValueError(
+                    f"signal {name}: direction=above disarms BELOW the "
+                    "threshold — use disarm_below")
+            self.disarm_above = None
+            self.disarm_below = (float(disarm_below) if disarm_below
+                                 is not None else self.threshold / 2.0)
+            if self.disarm_below > self.threshold:
+                raise ValueError(
+                    f"signal {name}: disarm_below {self.disarm_below} "
+                    f"above threshold {self.threshold} (hysteresis "
+                    "must disarm BELOW where it arms)")
         self.agg = agg
         self.match = match
         self.state = SIGNAL_IDLE
@@ -214,6 +247,16 @@ class SustainedSignal:
             return None, reset
         return quantile_from_counts(counts, q), reset
 
+    def _breaches(self, value: float) -> bool:
+        if self.direction == "below":
+            return value <= self.threshold
+        return value >= self.threshold
+
+    def _disarms(self, value: float) -> bool:
+        if self.direction == "below":
+            return value >= self.disarm_above
+        return value <= self.disarm_below
+
     # -- lifecycle -----------------------------------------------------------
     def evaluate(self, now: float, newest: Dict[str, Any],
                  delta: Dict[str, Any], span_s: float,
@@ -241,7 +284,7 @@ class SustainedSignal:
             return []
         self.value = value
         if self.state == SIGNAL_HOLDING and self._last_valid_t is not None \
-                and value >= self.threshold:
+                and self._breaches(value):
             self._held_s += now - self._last_valid_t
         events: List[Dict[str, Any]] = []
 
@@ -249,34 +292,35 @@ class SustainedSignal:
             return {"signal": self.name, "state": state,
                     "t": round(now, 3), "value": round(value, 6),
                     "threshold": self.threshold,
+                    "direction": self.direction,
                     "metric": self.metric, "kind": self.kind,
                     "held_s": round(self._held_s, 3)}
 
         if self.state == SIGNAL_IDLE:
-            if value >= self.threshold:
+            if self._breaches(value):
                 self.state = SIGNAL_HOLDING
                 self._held_s = 0.0
                 events.append(_event("armed"))
         elif self.state == SIGNAL_HOLDING:
-            if value <= self.disarm_below:
+            if self._disarms(value):
                 self.state = SIGNAL_IDLE
                 self._held_s = 0.0
                 events.append(_event("cleared"))
                 self._last_valid_t = now
                 return events
-            if value < self.threshold:
+            if not self._breaches(value):
                 # hysteresis band: dip resets the hold clock but the
                 # signal stays watching (no cleared event)
                 self._held_s = 0.0
         if self.state == SIGNAL_HOLDING \
                 and self._held_s >= self.min_hold_s \
-                and value >= self.threshold:
+                and self._breaches(value):
             self.state = SIGNAL_FIRED
             self.firings += 1
             self._fired_at = now
             events.append(_event("fired"))
         elif self.state == SIGNAL_FIRED:
-            if value <= self.disarm_below:
+            if self._disarms(value):
                 self.state = SIGNAL_IDLE
                 self._held_s = 0.0
                 self._fired_at = None
@@ -287,7 +331,9 @@ class SustainedSignal:
     def report(self) -> Dict[str, Any]:
         return {"signal": self.name, "metric": self.metric,
                 "kind": self.kind, "threshold": self.threshold,
+                "direction": self.direction,
                 "disarm_below": self.disarm_below,
+                "disarm_above": self.disarm_above,
                 "min_hold_s": self.min_hold_s,
                 "window_s": self.window_s,
                 "state": self.state, "firings": self.firings,
@@ -548,36 +594,37 @@ def flatten_state(state: Dict[str, Any]) -> Dict[str, float]:
     return out
 
 
-class RingSampler:
-    """Background capture loop for a :class:`TimeSeriesRing`:
-    absolute-deadline pacing on ``Event.wait`` (drift-free; an
-    overrunning capture realigns rather than bunching — the SLOMonitor
-    discipline)."""
+class DeadlineLoop:
+    """Generic absolute-deadline background loop: ``Event.wait`` pacing
+    (drift-free; an overrunning pass realigns rather than bunching —
+    the SLOMonitor discipline), every registered fn called per pass, a
+    raising fn logged once and survived (a dead maintenance loop would
+    read as a clean pass).  Shared engine of :class:`RingSampler` and
+    the fleet's maintenance loop
+    (:class:`~nnstreamer_tpu.fleet.pool.FleetLoop`)."""
 
-    def __init__(self, ring: TimeSeriesRing,
-                 interval_s: Optional[float] = None) -> None:
-        self.ring = ring
-        self.interval_s = float(interval_s if interval_s is not None
-                                else ring.interval_s)
+    def __init__(self, fns, interval_s: float,
+                 name: str = "nns-loop") -> None:
+        self.fns = list(fns)
+        self.interval_s = max(1e-3, float(interval_s))
+        self.name = name
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def start(self) -> "RingSampler":
+    def start(self) -> "DeadlineLoop":
         if self._thread is None:
             self._stop.clear()
             self._thread = threading.Thread(target=self._loop,
                                             daemon=True,
-                                            name="nns-ts-sampler")
+                                            name=self.name)
             self._thread.start()
         return self
 
-    def stop(self, final_capture: bool = True) -> None:
+    def stop(self) -> None:
         self._stop.set()
         t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout=10)
-        if final_capture:
-            self.ring.capture()
 
     def _loop(self) -> None:
         logged = False
@@ -586,19 +633,42 @@ class RingSampler:
             wait = deadline - mono_ns() / 1e9
             if wait > 0 and self._stop.wait(wait):
                 return
-            try:
-                self.ring.capture()
-            except Exception:   # noqa: BLE001 — one bad snapshot
-                # (torn-down source, poisoned federated state) must
-                # not silently kill the sampler for the rest of the
-                # run: signals going dark would read as a clean pass
-                if not logged:
-                    logged = True
-                    from ..utils.log import ml_logw
+            for fn in list(self.fns):
+                try:
+                    fn()
+                except Exception:   # noqa: BLE001 — one bad pass
+                    # (torn-down source, poisoned federated state)
+                    # must not silently kill the loop for the rest of
+                    # the run
+                    if not logged:
+                        logged = True
+                        from ..utils.log import ml_logw
 
-                    ml_logw("timeseries sampler: capture failed "
-                            "(continuing)", exc_info=True)
+                        ml_logw("%s: pass failed (continuing)",
+                                self.name, exc_info=True)
             now = mono_ns() / 1e9
             deadline += self.interval_s
             if deadline < now:      # overran: realign, don't bunch
                 deadline = now + self.interval_s
+
+
+class RingSampler(DeadlineLoop):
+    """Background capture loop for a :class:`TimeSeriesRing` (one
+    :class:`DeadlineLoop` pass = one ``ring.capture()``)."""
+
+    def __init__(self, ring: TimeSeriesRing,
+                 interval_s: Optional[float] = None) -> None:
+        self.ring = ring
+        super().__init__([ring.capture],
+                         interval_s if interval_s is not None
+                         else ring.interval_s,
+                         name="nns-ts-sampler")
+
+    def start(self) -> "RingSampler":
+        super().start()
+        return self
+
+    def stop(self, final_capture: bool = True) -> None:
+        super().stop()
+        if final_capture:
+            self.ring.capture()

@@ -74,7 +74,8 @@ class QueryConnection:
     def __init__(self, host: str, port: int, timeout: float = 10.0,
                  max_retries: int = 3,
                  retry: Optional[RetryPolicy] = None,
-                 qos: Optional[str] = None):
+                 qos: Optional[str] = None,
+                 model: Optional[str] = None):
         self.host, self.port = host, port
         self.timeout = timeout
         self.max_retries = max_retries
@@ -84,6 +85,12 @@ class QueryConnection:
         #: ``buf.extra["nns_class"]`` implies a class negotiates it
         #: late (the loadgen's class tagging becomes the QoS default)
         self.qos = qos
+        #: model identity declared in the handshake (a ``model=`` HELLO
+        #: token): the fleet router's consistent-hash key — connections
+        #: naming the same model concentrate on the same workers so
+        #: their cross-stream buckets stay dense (fleet/router.py).
+        #: Plain servers ignore it.
+        self.model = model
         self.retry = retry or RetryPolicy(max_attempts=max(1, max_retries),
                                           base_delay=0.05, max_delay=0.5)
         # bounded by the request protocol: at most one outstanding
@@ -91,6 +98,10 @@ class QueryConnection:
         # nnslint: allow(unbounded-queue)
         self.replies: _queue.Queue = _queue.Queue()
         self.server_caps: Optional[str] = None
+        #: set when the server's HELLO answer lands (the caps arrive on
+        #: the reader thread; waiters — the fleet router forwarding a
+        #: handshake — block on this instead of polling)
+        self._caps_evt = threading.Event()
         self._pool = default_pool()   # reply payloads land in recycled slabs
         self._sock: Optional[socket.socket] = None
         self._reader: Optional[threading.Thread] = None
@@ -118,6 +129,17 @@ class QueryConnection:
         #: None (the default) costs one attribute test per query.
         self.on_outcome: Optional[Callable[[str, float, bool], None]] = None
 
+    def _hello_payload(self) -> bytes:
+        """`;`-token handshake payload (protocol.parse_hello_tokens):
+        QoS class for admission control, model identity for fleet
+        routing — both optional, empty when neither is set."""
+        parts = []
+        if self.qos:
+            parts.append(f"qos={self.qos}")
+        if self.model:
+            parts.append(f"model={self.model}")
+        return ";".join(parts).encode()
+
     def connect(self) -> None:
         def _dial():
             sock = checked_connect(
@@ -131,9 +153,10 @@ class QueryConnection:
             reader.start()
             try:
                 # caps handshake; declares this connection's QoS class
-                # when one is set (server-side admission control)
-                self._send(Message(T_HELLO, payload=(
-                    f"qos={self.qos}".encode() if self.qos else b"")))
+                # / model identity when set (server-side admission
+                # control; fleet-router placement)
+                self._send(Message(T_HELLO,
+                                   payload=self._hello_payload()))
             except OSError:
                 # tear this half-made connection down before the retry:
                 # otherwise every failed attempt leaks a socket and a
@@ -178,6 +201,7 @@ class QueryConnection:
                 return
             if msg.type == T_HELLO:
                 self.server_caps = msg.payload.decode()
+                self._caps_evt.set()
             elif msg.type in (T_REPLY, T_SHED):
                 # a shed is a first-class answer: it rides the reply
                 # queue so _await_reply matches it to ITS request by seq
@@ -193,6 +217,14 @@ class QueryConnection:
                 if waiter is not None:
                     waiter.epoch_us = msg.epoch_us
                     waiter.evt.set()
+
+    def wait_server_caps(self, timeout: float = 2.0) -> Optional[str]:
+        """Block until the server's HELLO answer (its caps string)
+        arrived, or ``timeout`` — the handshake-forwarding path's read
+        (a router must answer the client's HELLO with the WORKER's
+        caps, which land asynchronously on the reader thread)."""
+        self._caps_evt.wait(timeout)
+        return self.server_caps
 
     def ping(self, timeout: float = 1.0) -> float:
         """Heartbeat probe: send ``T_PING``, await the matching
@@ -293,8 +325,7 @@ class QueryConnection:
             return
         self.qos = implied
         try:
-            self._send(Message(T_HELLO,
-                               payload=f"qos={implied}".encode()))
+            self._send(Message(T_HELLO, payload=self._hello_payload()))
         except (OSError, AttributeError):
             pass   # connection is down: connect() re-announces
 
@@ -436,6 +467,19 @@ class FailoverConnection:
     admits a call; a heartbeat ``dead`` verdict demotes the active
     endpoint between frames so the next query fails over without eating
     a full reply timeout first.
+
+    The endpoint list is HOT-updatable (:meth:`set_endpoints` — the
+    fleet router's rebalance path): the active connection survives the
+    update when its endpoint is still listed, so a membership change
+    never causes a reconnect storm; a removed active endpoint rotates
+    on the NEXT query.
+
+    ``shed_passthrough=True`` (the router's forwarding mode) raises a
+    lone endpoint's :class:`ShedError` immediately instead of honoring
+    its retry-after in place — a proxy sleeping out the hint would turn
+    an explicit fast shed into opaque latency inside the caller's own
+    budget.  With alternates, sheds still rotate (routing away IS
+    honoring the hint) and only an all-candidates shed propagates.
     """
 
     _FAILURE = (TimeoutError, ConnectionError, OSError, AttributeError)
@@ -448,18 +492,23 @@ class FailoverConnection:
                  heartbeat_interval: float = 0.0,
                  heartbeat_max_missed: int = 3,
                  name: str = "query",
-                 qos: Optional[str] = None):
+                 qos: Optional[str] = None,
+                 model: Optional[str] = None,
+                 shed_passthrough: bool = False):
         if not endpoints:
             raise ValueError("FailoverConnection needs >= 1 endpoint")
         self.endpoints = list(endpoints)
         self.timeout = timeout
         self.max_retries = max_retries
         self.qos = qos
+        self.model = model
+        self.name = name
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_cooldown = float(breaker_cooldown)
+        self._shed_passthrough = bool(shed_passthrough)
         self.retry = retry or RetryPolicy(max_attempts=max(1, max_retries),
                                           base_delay=0.05, max_delay=0.5)
-        self.breakers = [CircuitBreaker(failure_threshold=breaker_failures,
-                                        cooldown=breaker_cooldown,
-                                        name=f"{name}:{h}:{p}")
+        self.breakers = [self._make_breaker(h, p)
                          for h, p in self.endpoints]
         self._idx = 0                    # preferred endpoint index
         self._active: Optional[QueryConnection] = None
@@ -475,9 +524,73 @@ class FailoverConnection:
                 on_down=self._on_endpoint_down, name=name)
 
     # -- endpoint bookkeeping ------------------------------------------------
+    def _make_breaker(self, host: str, port: int) -> CircuitBreaker:
+        return CircuitBreaker(failure_threshold=self.breaker_failures,
+                              cooldown=self.breaker_cooldown,
+                              name=f"{self.name}:{host}:{port}")
+
     def _key(self, idx: int) -> str:
         h, p = self.endpoints[idx]
         return f"{h}:{p}"
+
+    def set_endpoints(self, endpoints: List[Tuple[str, int]]) -> None:
+        """Hot ``dest-hosts`` update (the fleet router's rebalance
+        primitive).  Endpoints present before AND after keep their
+        circuit-breaker state; new ones start fresh.  When the ACTIVE
+        endpoint survives the update, the live connection is kept
+        untouched — a fleet membership change must move only the
+        clients whose assignment changed, never storm every socket.
+        When it was removed, the connection closes and the next query
+        dials the new preferred endpoint (rotate-on-update)."""
+        endpoints = [(str(h), int(p)) for h, p in endpoints]
+        if not endpoints:
+            raise ValueError("set_endpoints needs >= 1 endpoint")
+        with self._lock:
+            kept = {self._key(i): self.breakers[i]
+                    for i in range(len(self.endpoints))}
+            active_key = self._active_key
+            self.endpoints = endpoints
+            self.breakers = [kept.get(f"{h}:{p}")
+                             or self._make_breaker(h, p)
+                             for h, p in endpoints]
+            keys = [self._key(i) for i in range(len(endpoints))]
+            if active_key is not None and active_key in keys:
+                # active endpoint survives: same socket, new index
+                self._active_idx = self._idx = keys.index(active_key)
+                return
+            # active endpoint removed (or none yet): next query starts
+            # at the new preference head.  Close WITHOUT a failure mark
+            # — this is a routing decision, not an endpoint fault — and
+            # WITHOUT a BYE: a goodbye send can block on a wedged
+            # peer's full socket buffer, and the router calls this
+            # under its membership lock for every displaced client
+            # (one sick worker must not stall the whole control
+            # plane); shutdown_close's FIN tells the worker enough.
+            if self._active is not None:
+                if self.monitor is not None and active_key is not None:
+                    self.monitor.unwatch(active_key)
+                self._active.close(send_bye=False)
+                self._active = None
+                STATS.incr("query.rebalances")
+            self._active_idx = None
+            self._active_key = None
+            self._idx = 0
+            self._dead.clear()
+
+    def set_qos(self, qos: Optional[str]) -> None:
+        """Update the QoS class mid-stream: the active connection
+        re-announces the full token payload (servers accept a fresh
+        T_HELLO at any time) and later dials inherit it."""
+        with self._lock:
+            self.qos = qos
+            conn = self._active
+        if conn is not None:
+            conn.qos = qos
+            try:
+                conn._send(Message(T_HELLO,
+                                   payload=conn._hello_payload()))
+            except (OSError, AttributeError):
+                pass   # next dial re-announces
 
     def _on_endpoint_down(self, key: str) -> None:
         """Heartbeat verdict: the active endpoint stopped answering.
@@ -496,6 +609,14 @@ class FailoverConnection:
         with self._lock:
             return (self._active.server_caps
                     if self._active is not None else None)
+
+    def wait_server_caps(self, timeout: float = 2.0) -> Optional[str]:
+        """Active connection's :meth:`QueryConnection.wait_server_caps`
+        (None when no endpoint is live)."""
+        with self._lock:
+            conn = self._active
+        return (conn.wait_server_caps(timeout)
+                if conn is not None else None)
 
     @property
     def active_endpoint(self) -> Optional[Tuple[str, int]]:
@@ -586,7 +707,7 @@ class FailoverConnection:
             conn = QueryConnection(
                 host, port, self.timeout, self.max_retries,
                 retry=self.retry.with_deadline(self.timeout),
-                qos=self.qos)
+                qos=self.qos, model=self.model)
             try:
                 conn.connect()
             except ConnectionError as exc:
@@ -645,14 +766,19 @@ class FailoverConnection:
             with self._lock:
                 try:
                     conn = self._ensure_active()
-                    idx = self._active_idx
+                    # capture the breaker OBJECT, not the index: a
+                    # concurrent set_endpoints (the router's rebalance)
+                    # may replace/reorder/shrink self.breakers before
+                    # this request's outcome lands, and indexing then
+                    # would charge the wrong endpoint — or walk off the
+                    # end of a shrunken list
+                    breaker = self.breakers[self._active_idx]
                 except CircuitOpenError:
                     raise                # fail fast: no sleeping on OPEN
                 except ConnectionError as exc:
                     last = exc
                     conn = None
             if conn is not None:
-                breaker = self.breakers[idx]
                 try:
                     out = conn.query(buf)
                     breaker.record_success()
@@ -674,6 +800,13 @@ class FailoverConnection:
                     if len(self.endpoints) > 1:
                         with self._lock:
                             self._demote("shed")
+                    elif self._shed_passthrough:
+                        # forwarding mode (fleet router): no alternate
+                        # can absorb this — hand the worker's own shed
+                        # verdict to the caller NOW; sleeping out the
+                        # retry-after in a proxy would just disguise it
+                        # as latency
+                        raise
                     elif shed_budget <= 0:
                         raise          # budget spent honoring hints
                     else:
@@ -769,6 +902,13 @@ class TensorQueryClient(Element):
                       "query/overload.py).  Unset: inherited from the "
                       "first frame's nns_class tag, else the server's "
                       "silver default"),
+        "model": (None, "model identity declared in the handshake "
+                        "(fleet/router.py): a tensor_query_router "
+                        "endpoint consistent-hashes it so this "
+                        "stream's frames land on the same workers as "
+                        "every other stream of the model — per-model "
+                        "cross-stream buckets stay dense.  Plain "
+                        "servers ignore it"),
     }
 
     def _make_pads(self):
@@ -841,7 +981,9 @@ class TensorQueryClient(Element):
             heartbeat_interval=float(self.heartbeat_interval),
             heartbeat_max_missed=int(self.heartbeat_max_missed),
             name=self.name,
-            qos=qos)
+            qos=qos,
+            model=(str(self.model) if self.model not in (None, "")
+                   else None))
         try:
             self.conn.connect()
         except ConnectionError:

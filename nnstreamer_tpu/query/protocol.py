@@ -86,6 +86,23 @@ MAX_WIRE_PAYLOAD = int(os.environ.get("NNS_MAX_WIRE_PAYLOAD",
  T_SHED, T_METRICS) = 1, 2, 3, 4, 5, 6, 7, 8, 9, 10
 
 
+def parse_hello_tokens(payload) -> dict:
+    """Client→server T_HELLO payload grammar: ``;``-separated
+    ``key=value`` tokens (``qos=gold;model=resnet``).  Grown from the
+    original bare ``qos=<class>`` payload — a single token parses
+    identically, so old clients need no change; unknown tokens are kept
+    so the grammar can extend without a wire revision.  The ``model``
+    token is the fleet router's consistent-hash key
+    (fleet/router.py)."""
+    out = {}
+    for part in bytes(payload or b"").decode("utf-8",
+                                             "replace").split(";"):
+        key, sep, val = part.partition("=")
+        if sep and key:
+            out[key.strip()] = val.strip()
+    return out
+
+
 def create_connection(address, timeout=None):
     """``socket.create_connection`` with a loopback self-connect guard.
 

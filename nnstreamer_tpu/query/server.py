@@ -32,8 +32,8 @@ from .overload import (DEFAULT_QOS, QOS_CLASSES, AdmissionController,
                        TokenBucket, bucket_budget, qos_of_class)
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_METRICS,
                        T_PING, T_PONG, T_REPLY, T_SHED, T_TRACE,
-                       decode_tensors, recv_msg, send_msg, send_tensors,
-                       shutdown_close)
+                       decode_tensors, parse_hello_tokens, recv_msg,
+                       send_msg, send_tensors, shutdown_close)
 
 #: default bound on the server's incoming frame queue (frames, not
 #: bytes): deep enough that bursty-but-sustainable traffic never sheds,
@@ -216,12 +216,13 @@ class QueryServer:
                     break
                 if msg.type == T_HELLO:
                     # capability handshake: record the client's QoS
-                    # declaration (``qos=<class>`` payload —
-                    # query/overload.py), reply with server caps string
-                    payload = bytes(msg.payload or b"")
-                    if payload.startswith(b"qos="):
-                        qos = qos_of_class(payload[4:].decode(
-                            "utf-8", "replace"))
+                    # declaration (``qos=<class>`` token —
+                    # query/overload.py; the payload is the ``;``-token
+                    # grammar so fleet clients may also carry a model
+                    # identity), reply with server caps string
+                    tokens = parse_hello_tokens(msg.payload)
+                    if "qos" in tokens:
+                        qos = qos_of_class(tokens["qos"])
                         if qos is not None:
                             with self._lock:
                                 self._qos[cid] = qos

@@ -1,0 +1,893 @@
+"""fleet/: consistent-hash ring, router, worker pool, autoscaler.
+
+The multi-process half of the fleet story lives in ``tools/soak.py
+--fleet`` (real launch.py workers, real SIGKILL).  Here every
+timing-sensitive behavior is pinned the tier-1 way: fake worker
+processes, injected clocks, synthetic ring captures — the ISSUE 14
+acceptance names spawn-on-sustained-occupancy and drain-on-idle as
+injected-clock tests precisely so the control loop has zero wall-clock
+flakiness in CI.  Router tests run against real in-process serving
+pipelines over real sockets (the test_query fixture shape).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.fleet import (Autoscaler, AutoscalerConfig,
+                                  ConsistentHashRing, FleetConfig,
+                                  TensorQueryRouter, WorkerPool,
+                                  default_autoscaler_signals)
+from nnstreamer_tpu.obs.metrics import MetricsRegistry, REGISTRY
+from nnstreamer_tpu.obs.timeseries import SustainedSignal, TimeSeriesRing
+from nnstreamer_tpu.pipeline import Pipeline
+from nnstreamer_tpu.elements import TensorTransform
+from nnstreamer_tpu.query import shutdown_server
+from nnstreamer_tpu.query.client import (FailoverConnection,
+                                         QueryConnection)
+from nnstreamer_tpu.query.overload import ShedError
+from nnstreamer_tpu.query.server import (TensorQueryServerSink,
+                                         TensorQueryServerSrc)
+from nnstreamer_tpu.tensor import TensorBuffer
+
+
+def tcaps():
+    return ("other/tensors,format=static,num_tensors=1,dimensions=4,"
+            "types=float32,framerate=0/1")
+
+
+def serve(sid, mul=2, **src_props):
+    """One in-process serving pipeline; returns (pipeline, port)."""
+    p = Pipeline(f"fleet-server-{sid}")
+    src = TensorQueryServerSrc("qsrc", id=sid, port=0, caps=tcaps(),
+                               **src_props)
+    t = TensorTransform("t", mode="arithmetic", option=f"mul:{mul}")
+    sink = TensorQueryServerSink("qsink", id=sid)
+    p.add(src, t, sink)
+    p.link(src, t, sink)
+    p.play()
+    return p, src.bound_port
+
+
+def qframe(value=1.0):
+    return TensorBuffer(tensors=[np.full(4, value, np.float32)])
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring (satellite: property tests)
+# ---------------------------------------------------------------------------
+
+class TestConsistentHashRing:
+    KEYS = [f"model-{i}" for i in range(1000)]
+
+    def test_deterministic_across_processes(self):
+        # keyed blake2b, not salted hash(): the same member set yields
+        # the same placement in every process — pinned by rebuilding in
+        # a DIFFERENT insertion order (order independence is the
+        # process-independence proxy: no construction history leaks in)
+        members = [f"10.0.0.{i}:700{i}" for i in range(8)]
+        a = ConsistentHashRing(members)
+        b = ConsistentHashRing(reversed(members))
+        assert a.assignment(self.KEYS) == b.assignment(self.KEYS)
+
+    def test_remove_moves_at_most_about_one_nth(self):
+        members = [f"w{i}" for i in range(8)]
+        ring = ConsistentHashRing(members)
+        before = ring.assignment(self.KEYS)
+        ring.remove("w3")
+        after = ring.assignment(self.KEYS)
+        moved = [k for k in self.KEYS if before[k] != after[k]]
+        # exactly the removed member's keys move, nothing else
+        assert all(before[k] == "w3" for k in moved)
+        # ~1/N of the key space (vnode variance bounded at 2/N)
+        assert len(moved) <= 2 * len(self.KEYS) / 8
+        assert moved   # and it owned SOMETHING
+
+    def test_add_moves_only_to_new_member(self):
+        ring = ConsistentHashRing([f"w{i}" for i in range(7)])
+        before = ring.assignment(self.KEYS)
+        ring.add("w7")
+        after = ring.assignment(self.KEYS)
+        moved = [k for k in self.KEYS if before[k] != after[k]]
+        assert moved
+        assert all(after[k] == "w7" for k in moved)
+        assert len(moved) <= 2 * len(self.KEYS) / 8
+
+    def test_lookup_n_distinct_preference_order(self):
+        ring = ConsistentHashRing(["a", "b", "c"])
+        cands = ring.lookup_n("some-model", 2)
+        assert len(cands) == 2
+        assert len(set(cands)) == 2
+        # n beyond membership returns them all, once each
+        assert sorted(ring.lookup_n("some-model", 10)) == ["a", "b", "c"]
+        # lookup() is lookup_n()'s head
+        assert ring.lookup("some-model") == cands[0]
+
+    def test_empty_ring(self):
+        ring = ConsistentHashRing()
+        assert ring.lookup("x") is None
+        assert ring.lookup_n("x", 3) == []
+        assert not ring.remove("ghost")
+
+    def test_distinct_seeds_disagree(self):
+        members = [f"w{i}" for i in range(8)]
+        a = ConsistentHashRing(members, seed="fleet-a")
+        b = ConsistentHashRing(members, seed="fleet-b")
+        am, bm = a.assignment(self.KEYS), b.assignment(self.KEYS)
+        assert any(am[k] != bm[k] for k in self.KEYS)
+
+
+# ---------------------------------------------------------------------------
+# SustainedSignal direction="below" (the drain-on-idle primitive)
+# ---------------------------------------------------------------------------
+
+class TestBelowSignal:
+    def _ring_with_counter(self):
+        r = MetricsRegistry()
+        c = r.counter("nns_req_total")
+        ring = TimeSeriesRing(r, interval_s=1.0)
+        return r, c, ring
+
+    def test_idle_arms_fires_and_disarms_on_traffic(self):
+        _r, c, ring = self._ring_with_counter()
+        sig = ring.add_signal(SustainedSignal(
+            "idle", "nns_req_total", threshold=1.0, min_hold_s=3.0,
+            kind="rate", window_s=2.0, direction="below",
+            disarm_above=5.0))
+        for t in range(6):          # zero traffic: arms then fires
+            ring.capture(now=float(t))
+        assert sig.state == "fired"
+        assert sig.firings == 1
+        c.inc(100)                  # traffic: rate >= disarm_above
+        ring.capture(now=6.0)
+        assert sig.state == "idle"
+
+    def test_hysteresis_band_resets_hold_without_clearing(self):
+        _r, c, ring = self._ring_with_counter()
+        sig = ring.add_signal(SustainedSignal(
+            "idle", "nns_req_total", threshold=1.0, min_hold_s=5.0,
+            kind="rate", window_s=1.0, direction="below",
+            disarm_above=10.0))
+        ring.capture(now=0.0)
+        ring.capture(now=1.0)       # holding (rate 0)
+        ring.capture(now=2.0)
+        c.inc(3)                    # rate 3: inside (1, 10) band
+        ring.capture(now=3.0)
+        assert sig.state == "holding"       # not cleared...
+        assert sig._held_s == 0.0           # ...but the hold restarts
+        for t in range(4, 12):
+            ring.capture(now=float(t))
+        assert sig.state == "fired"
+
+    def test_direction_validation(self):
+        with pytest.raises(ValueError, match="disarm ABOVE"):
+            SustainedSignal("x", "m", threshold=5.0, min_hold_s=1.0,
+                            direction="below", disarm_above=2.0)
+        with pytest.raises(ValueError, match="use disarm_below"):
+            SustainedSignal("x", "m", threshold=5.0, min_hold_s=1.0,
+                            direction="above", disarm_above=9.0)
+        with pytest.raises(ValueError, match="use disarm_above"):
+            SustainedSignal("x", "m", threshold=5.0, min_hold_s=1.0,
+                            direction="below", disarm_below=1.0)
+
+
+# ---------------------------------------------------------------------------
+# FailoverConnection hot dest-hosts update (satellite)
+# ---------------------------------------------------------------------------
+
+class TestFailoverHotUpdate:
+    def test_rotate_on_update(self):
+        pa, port_a = serve(241, mul=2)
+        pb, port_b = serve(242, mul=3)
+        try:
+            fc = FailoverConnection([("127.0.0.1", port_a)],
+                                    timeout=5.0)
+            fc.connect()
+            out = fc.query(qframe(1.0))
+            np.testing.assert_array_equal(out.np(0),
+                                          np.full(4, 2.0, np.float32))
+            # hot update removing the active endpoint: the NEXT query
+            # must serve from the new list (rotate-on-update)
+            fc.set_endpoints([("127.0.0.1", port_b)])
+            out = fc.query(qframe(1.0))
+            np.testing.assert_array_equal(out.np(0),
+                                          np.full(4, 3.0, np.float32))
+            fc.close()
+        finally:
+            pa.stop()
+            pb.stop()
+            shutdown_server(241)
+            shutdown_server(242)
+
+    def test_surviving_active_keeps_connection(self):
+        pa, port_a = serve(243, mul=2)
+        pb, port_b = serve(244, mul=3)
+        try:
+            fc = FailoverConnection([("127.0.0.1", port_a)],
+                                    timeout=5.0)
+            fc.connect()
+            fc.query(qframe(1.0))
+            live = fc._active
+            # update ADDS an endpoint and keeps the active one: no
+            # reconnect storm — the very same QueryConnection survives
+            fc.set_endpoints([("127.0.0.1", port_b),
+                              ("127.0.0.1", port_a)])
+            out = fc.query(qframe(1.0))
+            assert fc._active is live
+            np.testing.assert_array_equal(out.np(0),
+                                          np.full(4, 2.0, np.float32))
+            assert fc._active_idx == 1     # re-indexed, not re-dialed
+            fc.close()
+        finally:
+            pa.stop()
+            pb.stop()
+            shutdown_server(243)
+            shutdown_server(244)
+
+    def test_kept_endpoints_keep_breaker_state(self):
+        fc = FailoverConnection([("127.0.0.1", 1), ("127.0.0.1", 2)],
+                                timeout=0.2)
+        fc.breakers[0].record_failure()
+        kept = fc.breakers[0]
+        fc.set_endpoints([("127.0.0.1", 1), ("127.0.0.1", 3)])
+        assert fc.breakers[0] is kept          # state survives
+        assert fc.breakers[1] is not kept      # new endpoint, fresh
+
+    def test_empty_update_rejected(self):
+        fc = FailoverConnection([("127.0.0.1", 1)])
+        with pytest.raises(ValueError):
+            fc.set_endpoints([])
+
+
+# ---------------------------------------------------------------------------
+# router
+# ---------------------------------------------------------------------------
+
+class TestRouter:
+    def _model_with_candidates(self, router, first_key):
+        """A model name whose ring preference order starts at
+        ``first_key`` (placement is deterministic, so search once)."""
+        for i in range(256):
+            cands = router.ring.lookup_n(f"m{i}", max(
+                1, router.replicas or len(router.ring)))
+            if cands and cands[0] == first_key:
+                return f"m{i}"
+        raise AssertionError("no model hashing to the wanted worker")
+
+    def test_round_trip_and_caps_passthrough(self):
+        p, port = serve(245, mul=2)
+        r = TensorQueryRouter(port=0)
+        try:
+            r.add_worker("127.0.0.1", port)
+            conn = QueryConnection("127.0.0.1", r.port, timeout=5.0)
+            conn.connect()
+            assert conn.wait_server_caps(5.0) == tcaps()
+            out = conn.query(qframe(2.0))
+            np.testing.assert_array_equal(out.np(0),
+                                          np.full(4, 4.0, np.float32))
+            assert r.workers()[0]["routed"] == 1
+            conn.close()
+        finally:
+            r.close()
+            p.stop()
+            shutdown_server(245)
+
+    def test_same_model_concentrates_same_worker(self):
+        pa, port_a = serve(246, mul=2)
+        pb, port_b = serve(247, mul=3)
+        r = TensorQueryRouter(port=0, replicas=1)
+        try:
+            r.add_worker("127.0.0.1", port_a)
+            r.add_worker("127.0.0.1", port_b)
+            conns = [QueryConnection("127.0.0.1", r.port, timeout=5.0,
+                                     model="resnet") for _ in range(3)]
+            answers = set()
+            for c in conns:
+                c.connect()
+                answers.add(float(c.query(qframe(1.0)).np(0)[0]))
+            # one model -> ONE worker serves every stream (dense
+            # buckets), whichever the ring picked
+            assert len(answers) == 1
+            rows = {w["worker"]: w["routed"] for w in r.workers()}
+            assert sorted(rows.values()) == [0, 3]
+            for c in conns:
+                c.close()
+        finally:
+            r.close()
+            pa.stop()
+            pb.stop()
+            shutdown_server(246)
+            shutdown_server(247)
+
+    def test_kill_rotates_zero_client_errors(self):
+        pa, port_a = serve(248, mul=2)
+        pb, port_b = serve(249, mul=3)
+        r = TensorQueryRouter(port=0, replicas=2)
+        try:
+            ka = r.add_worker("127.0.0.1", port_a)
+            r.add_worker("127.0.0.1", port_b)
+            model = self._model_with_candidates(r, ka)
+            conn = QueryConnection("127.0.0.1", r.port, timeout=10.0,
+                                   model=model)
+            conn.connect()
+            out = conn.query(qframe(1.0))
+            np.testing.assert_array_equal(out.np(0),
+                                          np.full(4, 2.0, np.float32))
+            # hard-kill the worker this client is routed to: the
+            # failover leg must rotate and the client sees only a
+            # slower answer, never an error
+            pa.stop()
+            shutdown_server(248)
+            out = conn.query(qframe(1.0))
+            np.testing.assert_array_equal(out.np(0),
+                                          np.full(4, 3.0, np.float32))
+            conn.close()
+        finally:
+            r.close()
+            for p, sid in ((pa, 248), (pb, 249)):
+                try:
+                    p.stop()
+                except Exception:   # noqa: BLE001 — already stopped
+                    pass
+                shutdown_server(sid)
+
+    def test_mark_draining_rebalances_live_client(self):
+        pa, port_a = serve(250, mul=2)
+        pb, port_b = serve(251, mul=3)
+        r = TensorQueryRouter(port=0, replicas=1)
+        try:
+            ka = r.add_worker("127.0.0.1", port_a)
+            kb = r.add_worker("127.0.0.1", port_b)
+            model = self._model_with_candidates(r, ka)
+            conn = QueryConnection("127.0.0.1", r.port, timeout=5.0,
+                                   model=model)
+            conn.connect()
+            assert float(conn.query(qframe(1.0)).np(0)[0]) == 2.0
+            # scale-down step 1: route away BEFORE any SIGTERM — the
+            # live client's endpoint list updates hot and its next
+            # frame serves from the peer
+            r.mark_draining(ka)
+            assert float(conn.query(qframe(1.0)).np(0)[0]) == 3.0
+            rows = {w["worker"]: w for w in r.workers()}
+            assert rows[ka]["draining"] is True
+            assert rows[kb]["draining"] is False
+            conn.close()
+        finally:
+            r.close()
+            pa.stop()
+            pb.stop()
+            shutdown_server(250)
+            shutdown_server(251)
+
+    def test_rehello_with_new_model_rebinds(self):
+        from nnstreamer_tpu.query.protocol import Message, T_HELLO
+
+        pa, port_a = serve(254, mul=2)
+        pb, port_b = serve(255, mul=3)
+        r = TensorQueryRouter(port=0, replicas=1)
+        try:
+            ka = r.add_worker("127.0.0.1", port_a)
+            kb = r.add_worker("127.0.0.1", port_b)
+            model_a = self._model_with_candidates(r, ka)
+            model_b = self._model_with_candidates(r, kb)
+            conn = QueryConnection("127.0.0.1", r.port, timeout=5.0,
+                                   model=model_a)
+            conn.connect()
+            assert float(conn.query(qframe(1.0)).np(0)[0]) == 2.0
+            # re-negotiate the model mid-connection: the router must
+            # rebind the backend leg to the NEW model's candidate set
+            # immediately, not at the next membership event
+            conn.model = model_b
+            conn._send(Message(T_HELLO,
+                               payload=conn._hello_payload()))
+            assert float(conn.query(qframe(1.0)).np(0)[0]) == 3.0
+            conn.close()
+        finally:
+            r.close()
+            pa.stop()
+            pb.stop()
+            shutdown_server(254)
+            shutdown_server(255)
+
+    def test_shed_passes_through_untouched(self):
+        # worker with a ~zero-rate token bucket: the second query sheds
+        # server-side; with no alternate the router must forward that
+        # exact T_SHED (retry-after intact), not absorb or retry it
+        p, port = serve(252, mul=2, **{"capacity-rps": 0.001})
+        r = TensorQueryRouter(port=0)
+        try:
+            r.add_worker("127.0.0.1", port)
+            conn = QueryConnection("127.0.0.1", r.port, timeout=5.0)
+            conn.connect()
+            conn.query(qframe(1.0))       # burst token
+            with pytest.raises(ShedError) as exc:
+                conn.query(qframe(1.0))
+            assert exc.value.retry_after_s > 0
+            conn.close()
+        finally:
+            r.close()
+            p.stop()
+            shutdown_server(252)
+
+    def test_gauges_cleaned_up_on_close(self):
+        p, port = serve(253, mul=2)
+        r = TensorQueryRouter(port=0)
+        r.add_worker("127.0.0.1", port)
+        assert any(k.startswith("nns_fleet_role")
+                   for k in REGISTRY.report())
+        r.close()
+        p.stop()
+        shutdown_server(253)
+        # every router metric unregisters at close — each instance
+        # labels its series with its ephemeral port, so leftovers
+        # would grow the registry once per router ever built
+        leftover = [k for k in REGISTRY.report()
+                    if k.startswith("nns_fleet_")]
+        assert leftover == []
+
+
+# ---------------------------------------------------------------------------
+# worker pool (fake processes, injected clock)
+# ---------------------------------------------------------------------------
+
+class FakeProc:
+    _next_pid = [50000]
+
+    def __init__(self):
+        FakeProc._next_pid[0] += 1
+        self.pid = FakeProc._next_pid[0]
+        self.rc = None
+        self.signals = []
+        self.killed = False
+
+    def poll(self):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def exit(self, rc=0):
+        self.rc = rc
+
+
+class PoolHarness:
+    def __init__(self, **kw):
+        self.clock = [0.0]
+        self.procs = []
+        self.events = []
+        self.ports = iter(range(7000, 7999))
+        kw.setdefault("ready_fn", lambda w: True)
+        self.pool = WorkerPool(
+            spawn_fn=self._spawn,
+            port_fn=lambda: next(self.ports),
+            clock=lambda: self.clock[0],
+            on_up=lambda w: self.events.append(("up", w.key)),
+            on_draining=lambda w: self.events.append(
+                ("draining", w.key)),
+            on_down=lambda w: self.events.append(("down", w.key)),
+            **kw)
+
+    def _spawn(self, host, port):
+        proc = FakeProc()
+        self.procs.append(proc)
+        return proc
+
+    def tick(self, t):
+        self.clock[0] = t
+        self.pool.tick(t)
+
+
+class TestWorkerPool:
+    def test_start_reaches_target_and_reports_up(self):
+        h = PoolHarness(min_workers=3, max_workers=5)
+        h.pool.start()
+        assert len(h.procs) == 3
+        h.tick(1.0)
+        assert h.pool.serving_count() == 3
+        assert [e for e in h.events if e[0] == "up"] \
+            == [("up", w["worker"]) for w in h.pool.workers()]
+
+    def test_crash_restarts_with_backoff(self):
+        h = PoolHarness(min_workers=1, max_workers=2,
+                        restart_backoff_s=2.0)
+        h.pool.start()
+        h.tick(1.0)
+        h.procs[0].exit(1)
+        h.tick(2.0)                    # crash detected, down reported
+        assert ("down", h.pool.events[0]["worker"]) in h.events \
+            or any(e[0] == "down" for e in h.events)
+        assert h.pool.alive_count() == 0
+        h.tick(3.0)                    # inside backoff: no respawn yet
+        assert len(h.procs) == 1
+        h.tick(4.5)                    # past now+2.0: respawn
+        assert len(h.procs) == 2
+        h.tick(5.0)
+        assert h.pool.serving_count() == 1
+
+    def test_backoff_grows_with_crash_streak_and_resets(self):
+        h = PoolHarness(min_workers=1, max_workers=2,
+                        restart_backoff_s=1.0,
+                        restart_backoff_max_s=8.0)
+        h.pool.start()
+        h.tick(0.5)
+        assert h.pool._crash_streak == 0
+        h.procs[-1].exit(1)
+        h.tick(1.0)
+        assert h.pool._backoff() == 1.0
+        h.tick(2.1)                    # respawn #2
+        h.procs[-1].exit(1)
+        h.tick(2.2)
+        assert h.pool._crash_streak == 2
+        assert h.pool._backoff() == 2.0
+        h.tick(4.3)                    # respawn...
+        h.tick(4.4)                    # ...reaches serving next tick
+        assert h.pool._crash_streak == 0   # streak resets on healthy
+
+    def test_scale_down_routes_away_before_sigterm(self):
+        import signal as _signal
+
+        h = PoolHarness(min_workers=1, max_workers=3)
+        h.pool.start()
+        h.tick(1.0)
+        h.pool.scale_up(1.0)
+        h.tick(2.0)
+        assert h.pool.serving_count() == 2
+        victim_proc = h.procs[-1]
+        wid = h.pool.scale_down(3.0)
+        assert wid is not None
+        # on_draining fired BEFORE the SIGTERM reached the process
+        drain_evt = [e for e in h.events if e[0] == "draining"]
+        assert drain_evt and victim_proc.signals == [_signal.SIGTERM]
+        victim_proc.exit(0)
+        h.tick(4.0)                    # reaped
+        assert any(e[0] == "down" for e in h.events)
+        assert h.pool.serving_count() == 1
+
+    def test_scale_down_refuses_below_min(self):
+        h = PoolHarness(min_workers=2, max_workers=3)
+        h.pool.start()
+        h.tick(1.0)
+        assert h.pool.scale_down(2.0) is None
+
+    def test_scale_up_refuses_above_max(self):
+        h = PoolHarness(min_workers=1, max_workers=1)
+        h.pool.start()
+        h.tick(1.0)
+        assert h.pool.scale_up(2.0) is None
+
+    def test_stale_origin_killed_and_replaced(self):
+        ages = {"age": 0.0}
+        h = PoolHarness(min_workers=1, max_workers=2,
+                        restart_backoff_s=1.0,
+                        stale_kill_s=10.0,
+                        origin_age_fn=lambda w: ages["age"])
+        # readiness comes from the origin-age default in this config
+        h.pool.ready_fn = None
+        h.pool.start()
+        h.tick(1.0)
+        assert h.pool.serving_count() == 1
+        ages["age"] = 30.0             # silent past the horizon
+        h.tick(2.0)
+        assert h.procs[0].killed
+        assert h.pool.serving_count() == 0
+        h.tick(3.5)                    # respawn after backoff
+        ages["age"] = 0.1
+        h.tick(4.0)
+        assert h.pool.serving_count() == 1
+
+    def test_evicted_origin_counts_as_stale(self):
+        # the collector evicts silent origins at ITS horizon (often
+        # shorter than stale_kill_s), after which the age reads None
+        # forever — a vanished once-seen origin must still be the
+        # staleness verdict, or the wedge-kill silently never fires
+        ages = {"age": 0.5}
+        h = PoolHarness(min_workers=1, max_workers=2,
+                        restart_backoff_s=1.0, stale_kill_s=20.0,
+                        origin_age_fn=lambda w: ages["age"])
+        h.pool.ready_fn = None
+        h.pool.start()
+        h.tick(1.0)
+        assert h.pool.serving_count() == 1
+        ages["age"] = None             # evicted, not just old
+        h.tick(2.0)
+        assert h.procs[0].killed
+        assert any(e["event"] == "stale-kill"
+                   for e in h.pool.events)
+
+    def test_ready_timeout_counts_as_crash(self):
+        h = PoolHarness(min_workers=1, max_workers=2,
+                        ready_fn=lambda w: False,
+                        ready_timeout_s=5.0)
+        h.pool.start()
+        h.tick(6.0)
+        assert h.procs[0].killed
+        assert any(e["event"] == "ready-timeout"
+                   for e in h.pool.events)
+
+    def test_spawn_failure_reverts_target_and_backs_off(self):
+        # a transient spawn failure must not ratchet the target (the
+        # autoscaler reads None as not-actuated and skips its
+        # cooldown, so a sticky +1 per failed attempt would walk
+        # target to max), and scale_up respects the failure backoff
+        clock = [0.0]
+
+        def boom(host, port):
+            raise OSError("no fds")
+
+        pool = WorkerPool(boom, min_workers=1, max_workers=3,
+                          ready_fn=lambda w: True,
+                          restart_backoff_s=5.0,
+                          port_fn=lambda: 7000,
+                          clock=lambda: clock[0])
+        pool.start()                        # initial spawn fails
+        assert pool.alive_count() == 0
+        assert pool.target == 1
+        clock[0] = 1.0
+        assert pool.scale_up(1.0) is None   # inside backoff
+        assert pool.target == 1
+        clock[0] = 10.0
+        assert pool.scale_up(10.0) is None  # spawn fails again
+        assert pool.target == 1             # ...and target reverted
+
+    def test_config_guards(self):
+        with pytest.raises(ValueError, match="fleet-zero-workers"):
+            PoolHarness(min_workers=0, max_workers=2)
+        with pytest.raises(ValueError, match="fleet-minmax"):
+            PoolHarness(min_workers=3, max_workers=2)
+
+
+# ---------------------------------------------------------------------------
+# autoscaler (injected clock + synthetic ring captures)
+# ---------------------------------------------------------------------------
+
+class AscHarness:
+    """WorkerPool on fakes + ring over a private registry + autoscaler,
+    all on ONE injected clock."""
+
+    def __init__(self, cfg=None, min_workers=1, max_workers=3):
+        self.cfg = cfg or AutoscalerConfig(
+            occupancy_high=0.0, queue_high_frac=0.0,
+            rate_high_rps=50.0, rate_low_rps=1.0,
+            hold_s=3.0, idle_hold_s=4.0,
+            spawn_cooldown_s=10.0, drain_cooldown_s=5.0,
+            post_spawn_guard_s=8.0)
+        self.pool_h = PoolHarness(min_workers=min_workers,
+                                  max_workers=max_workers)
+        self.registry = MetricsRegistry()
+        self.counter = self.registry.counter(
+            "nns_query_server_admitted_total")
+        self.ring = TimeSeriesRing(self.registry, interval_s=1.0)
+        signals = default_autoscaler_signals(self.ring, self.cfg)
+        self.asc = Autoscaler(self.pool_h.pool, signals["up"],
+                              signals["down"], cfg=self.cfg,
+                              clock=lambda: self.pool_h.clock[0]
+                              ).attach(self.ring)
+        self.pool_h.pool.start()
+        self.step(0.0, rps=0)
+
+    def step(self, t, rps=0):
+        """One second of fleet time: traffic, capture, maintenance."""
+        self.pool_h.clock[0] = t
+        self.counter.inc(int(rps))
+        self.ring.capture(now=t)
+        self.pool_h.pool.tick(t)
+        self.asc.tick(t)
+
+    @property
+    def serving(self):
+        return self.pool_h.pool.serving_count()
+
+
+class TestAutoscaler:
+    def test_sustained_load_spawns_blip_does_not(self):
+        h = AscHarness()
+        h.step(1.0)
+        assert h.serving == 1
+        h.step(2.0, rps=200)           # a single hot capture (blip)
+        h.step(3.0, rps=0)
+        h.step(4.0, rps=0)
+        assert h.asc.spawns == 0       # hysteresis: no flap on a blip
+        for t in range(5, 11):         # sustained past hold_s=3
+            h.step(float(t), rps=200)
+        assert h.asc.spawns == 1
+        h.step(11.0, rps=200)
+        assert h.serving == 2
+
+    def test_spawn_cooldown_then_step_to_max(self):
+        h = AscHarness()
+        for t in range(1, 8):
+            h.step(float(t), rps=200)
+        assert h.asc.spawns == 1
+        spawn_t = next(d["t"] for d in h.asc.decisions
+                       if d["outcome"] == "spawned")
+        # the signal stays FIRED; the next spawn waits the cooldown out
+        for t in range(8, int(spawn_t) + 10):
+            h.step(float(t), rps=200)
+            if t < spawn_t + 10.0:
+                assert h.asc.spawns == 1
+        for t in range(int(spawn_t) + 10, int(spawn_t) + 14):
+            h.step(float(t), rps=200)
+        assert h.asc.spawns == 2
+        assert h.serving == 3          # max_workers
+        # at max: further firing spawns nothing
+        for t in range(int(spawn_t) + 14, int(spawn_t) + 30):
+            h.step(float(t), rps=200)
+        assert h.asc.spawns == 2
+
+    def test_idle_drains_after_guard(self):
+        h = AscHarness()
+        for t in range(1, 8):          # scale to 2
+            h.step(float(t), rps=200)
+        assert h.serving == 2
+        spawn_t = next(d["t"] for d in h.asc.decisions
+                       if d["outcome"] == "spawned")
+        # traffic stops: fleet_idle arms, holds idle_hold_s=4 — but the
+        # post-spawn guard (8 s from the spawn) must block the drain
+        # until it lapses, then ONE worker drains back
+        t = 8.0
+        while t < spawn_t + 30.0 and h.asc.drains == 0:
+            h.step(t, rps=0)
+            assert h.serving >= 1
+            t += 1.0
+        assert h.asc.drains == 1
+        drain_t = next(d["t"] for d in h.asc.decisions
+                       if d["outcome"] == "drained")
+        assert drain_t >= spawn_t + h.cfg.post_spawn_guard_s
+        # the decision log names the bound that actually blocked: every
+        # pre-drain block inside the post-spawn window is "guard", not
+        # the drain cooldown (which has not been started yet)
+        blocked = [d for d in h.asc.decisions
+                   if d["action"] == "drain"
+                   and d["outcome"] in ("guard", "cooldown")]
+        assert blocked
+        assert all(d["outcome"] == "guard" for d in blocked
+                   if d["t"] < spawn_t + h.cfg.post_spawn_guard_s)
+        # drained back to min and never below (at-min afterwards)
+        for _ in range(10):
+            h.step(t, rps=0)
+            t += 1.0
+        assert h.asc.drains == 1
+        assert h.pool_h.pool.target == 1
+
+    def test_idle_never_fires_during_load(self):
+        h = AscHarness()
+        for t in range(1, 20):
+            h.step(float(t), rps=30)   # under the up watermark
+        idle = h.asc.down_signals[0]
+        assert idle.firings == 0
+        assert h.asc.drains == 0
+        assert h.asc.spawns == 0
+
+    def test_report_shape(self):
+        h = AscHarness()
+        rep = h.asc.report()
+        assert rep["spawns"] == 0
+        assert {s["signal"] for s in rep["signals"]["up"]} \
+            == {"fleet_load"}
+        assert {s["signal"] for s in rep["signals"]["down"]} \
+            == {"fleet_idle"}
+
+
+# ---------------------------------------------------------------------------
+# fleet config validation (+ the --check CLI surface)
+# ---------------------------------------------------------------------------
+
+GOOD_CONFIG = {
+    "worker_launch": "tensor_query_serversrc port={port} caps=x ! "
+                     "tensor_query_serversink",
+    "min_workers": 2, "max_workers": 4,
+    "drain_grace_s": 10.0, "worker_batch_timeout_ms": 30.0,
+}
+
+
+class TestFleetConfig:
+    def _rules(self, overrides):
+        cfg = FleetConfig.from_dict({**GOOD_CONFIG, **overrides})
+        return {rule for sev, rule, _m in cfg.validate()
+                if sev == "error"}
+
+    def test_good_config_clean(self):
+        assert FleetConfig.from_dict(GOOD_CONFIG).validate() == []
+
+    def test_zero_workers_named(self):
+        assert "fleet-zero-workers" in self._rules({"min_workers": 0})
+
+    def test_min_over_max_named(self):
+        assert "fleet-minmax" in self._rules(
+            {"min_workers": 5, "max_workers": 2})
+
+    def test_drain_grace_vs_bucket_window_named(self):
+        assert "fleet-drain-grace" in self._rules(
+            {"drain_grace_s": 0.02, "worker_batch_timeout_ms": 30.0})
+
+    def test_missing_port_placeholder_named(self):
+        assert "fleet-no-launch" in self._rules(
+            {"worker_launch": "tensor_query_serversrc port=5"})
+
+    def test_negative_cooldown_named(self):
+        # parity with Autoscaler.__init__: a --check-passing config
+        # must not crash at construction
+        assert "fleet-cooldown" in self._rules(
+            {"autoscaler": {"spawn_cooldown_s": -1.0}})
+
+    def test_unknown_keys_rejected(self):
+        with pytest.raises(ValueError, match="unknown fleet config"):
+            FleetConfig.from_dict({**GOOD_CONFIG, "wat": 1})
+
+    def test_check_cli_on_fleet_json(self, tmp_path, capsys):
+        from nnstreamer_tpu.launch import main as launch_main
+
+        bad = dict(GOOD_CONFIG, min_workers=9, max_workers=2)
+        path = tmp_path / "fleet.json"
+        path.write_text(json.dumps(bad))
+        assert launch_main([str(path), "--check"]) == 1
+        err = capsys.readouterr().err
+        assert "fleet-minmax" in err
+        path.write_text(json.dumps(GOOD_CONFIG))
+        assert launch_main([str(path), "--check"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# dashboard fleet view (satellite)
+# ---------------------------------------------------------------------------
+
+class TestDashboardFleetView:
+    FLAT = {
+        'nns_fleet_role{origin="h:1",port="9100",role="router"}': 1.0,
+        'nns_fleet_role{origin="h:2",role="worker"}': 1.0,
+        'nns_fleet_routed_connections{origin="h:1",port="9100",'
+        'worker="127.0.0.1:7001"}': 3.0,
+        'nns_fleet_routed_connections{origin="h:1",port="9100",'
+        'worker="127.0.0.1:7002"}': 1.0,
+        'nns_fleet_worker_draining{origin="h:1",port="9100",'
+        'worker="127.0.0.1:7001"}': 0.0,
+        'nns_fleet_worker_draining{origin="h:1",port="9100",'
+        'worker="127.0.0.1:7002"}': 1.0,
+    }
+
+    def test_build_view_roles_and_worker_rows(self):
+        from nnstreamer_tpu.obs.dashboard import build_view
+
+        view = build_view([(0.0, self.FLAT)])
+        roles = {o["origin"]: o.get("role") for o in view["origins"]}
+        assert roles == {"h:1": "router", "h:2": "worker"}
+        rows = {w["worker"]: w for w in view["fleet"]}
+        assert rows["127.0.0.1:7001"]["routed"] == 3.0
+        assert rows["127.0.0.1:7001"].get("draining") is False
+        assert rows["127.0.0.1:7002"].get("draining") is True
+
+    def test_render_frame_fleet_section(self):
+        from nnstreamer_tpu.obs.dashboard import build_view, render_frame
+
+        text = render_frame(build_view([(0.0, self.FLAT)]), clock=0.0)
+        assert "fleet worker" in text
+        assert "127.0.0.1:7002" in text
+        assert "draining" in text
+        assert "(router)" in text
+
+    def test_live_router_rides_scrape_shape(self):
+        # the router's own gauges flatten into exactly the keys the
+        # dashboard parses — pin the integration, not just synthetics
+        from nnstreamer_tpu.obs.dashboard import build_view
+        from nnstreamer_tpu.obs.timeseries import flatten_state
+
+        r = TensorQueryRouter(port=0)
+        try:
+            r.add_worker("127.0.0.1", 65001)
+            flat = flatten_state(REGISTRY.snapshot_state(
+                prefix="nns_fleet"))
+            view = build_view([(0.0, flat)])
+            assert [w["worker"] for w in view["fleet"]] \
+                == ["127.0.0.1:65001"]
+        finally:
+            r.close()

@@ -596,3 +596,20 @@ def test_hotpath_bench_fusexla_gate():
     assert r.returncode == 0, (
         f"fusexla gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
     assert '"hotpath_fusexla_gate"' in r.stdout
+
+
+@pytest.mark.perf
+def test_hotpath_bench_fleet_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage fleet fails
+    when the single-worker ROUTED path (fleet/router.py fronting one
+    out-of-process MLP serving worker) adds more than 5% p99 service
+    latency over direct-to-worker — the ISSUE 14 bound on what the
+    fleet tier may cost a request that never needed it."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "fleet"],
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, (
+        f"fleet gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_fleet_gate"' in r.stdout

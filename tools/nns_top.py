@@ -11,7 +11,11 @@ federation collector's merged endpoint)::
 
 Renders per-element occupancy, bucket fill, MFU, queue depths,
 shed/admit rates with trends, and armed sustained signals — per origin
-when the endpoint is federated (obs/federation.py).  ``--once`` prints
+when the endpoint is federated (obs/federation.py).  Fleets (fleet/)
+render too: origin rows carry their role (router/worker from the
+``nns_fleet_role`` gauges), and a fleet section lists each worker's
+routed-connection count and draining state from the router's gauges —
+all riding the same federated scrape.  ``--once`` prints
 a single plain frame and exits (scriptable / CI-friendly); the loop
 refreshes in place until Ctrl-C or ``--duration``.
 

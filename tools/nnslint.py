@@ -109,10 +109,13 @@ _LOWER_FUNCS = frozenset({"lower_step", "lower_decode"})
 _HOST_SYNC_ATTRS = frozenset({"np", "block_until_ready", "device_get"})
 
 #: directories where unbounded queue/deque construction is a finding
-#: (the dataflow layers the overload story bounds)
+#: (the dataflow layers the overload story bounds; the fleet tier is a
+#: dataflow layer — an unbounded buffer in the router would absorb a
+#: worker outage as unbounded memory exactly like the pre-PR 7 server)
 _BOUNDED_QUEUE_DIRS = (
     os.path.join("nnstreamer_tpu", "query") + os.sep,
     os.path.join("nnstreamer_tpu", "pipeline") + os.sep,
+    os.path.join("nnstreamer_tpu", "fleet") + os.sep,
 )
 
 #: method names that are per-buffer dataflow paths for wallclock-in-chain

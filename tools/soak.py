@@ -889,6 +889,273 @@ def run_xbatch(args, ap) -> int:
 
 
 FEDERATE_SERVER_ID = 93
+FLEET_SERVER_ID = 94
+
+#: the fleet workers' launch template (fleet/pool.py launch_spawn_fn
+#: fills {port}): the light demo serving pipeline, so the soak
+#: exercises fleet mechanics — routing, kill/rebalance, autoscaling —
+#: not model compile time
+FLEET_WORKER_TEMPLATE = (
+    f"tensor_query_serversrc name=qsrc id={FLEET_SERVER_ID} "
+    "port={port} caps=" + DEMO_CAPS + " ! "
+    "tensor_transform mode=arithmetic option=mul:2 ! "
+    f"tensor_query_serversink id={FLEET_SERVER_ID}")
+
+
+def run_fleet(args, ap) -> int:
+    """Fleet acceptance soak (ROADMAP item 3, the ISSUE 14 gate): a
+    REAL multi-process fleet — router in this process, >=3 launch.py
+    workers federating into this process's collector — driven through
+    three phases:
+
+    1. **kill leg**: PR 6 open-loop load through the router under the
+       demo latency SLO; mid-phase one worker is SIGKILLed.  The pool
+       restarts it, the router rebalances its clients over the PR 1
+       failover path — the gate is ZERO client errors (sheds allowed:
+       rebalanced/shed traffic is the designed degradation) with the
+       admitted-latency objective held.
+    2. **autoscale-up leg**: offered load steps past the autoscaler's
+       sustained admitted-rate watermark; after the hold, the fleet
+       must provably spawn (serving count reaches N+1).
+    3. **idle leg**: load stops; the ``fleet_idle`` below-threshold
+       signal holds and the fleet must provably drain one worker back
+       (route-away -> SIGTERM drain -> reap, PR 7 semantics).
+
+    Rate thresholds derive from a live capacity probe through the
+    router, so the same soak is honest on any host speed."""
+    import threading as _threading
+    import time as _time
+
+    import numpy as np
+
+    from nnstreamer_tpu.fleet import (Autoscaler, AutoscalerConfig,
+                                      FleetLoop, TensorQueryRouter,
+                                      WorkerPool,
+                                      default_autoscaler_signals,
+                                      launch_spawn_fn)
+    from nnstreamer_tpu.obs.federation import (CollectorServer,
+                                               MetricsCollector)
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+    from nnstreamer_tpu.obs.timeseries import (RingSampler,
+                                               TimeSeriesRing)
+    from nnstreamer_tpu.slo import (Evaluator, LoadGenerator,
+                                    SLOMonitor, load_spec)
+
+    os.makedirs(args.out, exist_ok=True)
+    n = max(3, int(args.fleet_workers))
+    duration = max(40.0, args.duration)
+    phase_a = max(24.0, 0.5 * duration)
+    phase_b = max(16.0, 0.3 * duration)
+    clients = args.clients or 32
+    payload = np.arange(4, dtype=np.float32)
+
+    collector = MetricsCollector()
+    collector_server = CollectorServer(collector, port=0)
+    router = TensorQueryRouter(port=0, replicas=2, timeout=5.0,
+                               collector=collector)
+    pool = WorkerPool(
+        launch_spawn_fn(FLEET_WORKER_TEMPLATE,
+                        collector_port=collector_server.port,
+                        push_interval_s=0.5,
+                        drain_grace_s=args.fleet_drain_grace,
+                        soak_s=duration + 600.0,
+                        log_dir=os.path.join(args.out, "workers")),
+        min_workers=n, max_workers=n + 1, collector=collector,
+        restart_backoff_s=0.5, stale_kill_s=10.0,
+        drain_grace_s=args.fleet_drain_grace,
+        on_up=lambda w: router.add_worker(w.host, w.port),
+        on_draining=lambda w: router.mark_draining(w.key),
+        on_down=lambda w: router.remove_worker(w.key))
+
+    ring = sampler = loop = None
+    kill_info = {}
+    try:
+        pool.start()
+        loop = FleetLoop([pool.tick], interval_s=0.5).start()
+        deadline = _time.monotonic() + 180.0
+        while pool.serving_count() < n and _time.monotonic() < deadline:
+            _time.sleep(0.5)
+        if pool.serving_count() < n:
+            print(json.dumps({
+                "metric": "soak_fleet", "verdict": "INFRA_DEAD",
+                "pass": False, "status": "infra_dead",
+                "vs_baseline": None,
+                "reason": f"only {pool.serving_count()}/{n} workers "
+                          "came up (see workers/*.log)"}), flush=True)
+            return 2
+        if not wait_query_ready("127.0.0.1", router.port, payload,
+                                timeout_s=30.0):
+            print(json.dumps({
+                "metric": "soak_fleet", "verdict": "INFRA_DEAD",
+                "pass": False, "status": "infra_dead",
+                "vs_baseline": None,
+                "reason": "router endpoint never served a round "
+                          "trip"}), flush=True)
+            return 2
+
+        # honest thresholds on any host: probe the ROUTED capacity,
+        # size phase A at ~30% of it (comfortably under the SLO), the
+        # spawn watermark in the gap, and phase B past the watermark
+        # but still under ~2/3 of capacity (the autoscale leg must
+        # prove scaling on sustained RATE, not queueing collapse)
+        measure_capacity("127.0.0.1", router.port, seconds=2.0,
+                         payload=payload)                   # warm-up
+        capacity = measure_capacity("127.0.0.1", router.port,
+                                    seconds=3.0, payload=payload)
+        rate_a = min(150.0, 0.30 * capacity)
+        up_rps = 1.5 * rate_a
+        rate_b = 2.2 * rate_a
+        asc_cfg = AutoscalerConfig(
+            rate_high_rps=up_rps, rate_low_rps=1.0,
+            hold_s=4.0, idle_hold_s=6.0,
+            spawn_cooldown_s=15.0, drain_cooldown_s=10.0,
+            post_spawn_guard_s=10.0)
+        ring = TimeSeriesRing(collector, interval_s=0.5,
+                              retention_s=duration + 120.0,
+                              registry=REGISTRY)
+        from nnstreamer_tpu.query.server import DEFAULT_QUEUE_DEPTH
+
+        signals = default_autoscaler_signals(
+            ring, asc_cfg, queue_depth=DEFAULT_QUEUE_DEPTH)
+        autoscaler = Autoscaler(pool, signals["up"], signals["down"],
+                                cfg=asc_cfg).attach(ring)
+        sampler = RingSampler(ring).start()
+        loop.fns.append(autoscaler.tick)
+
+        # -- phase 1: kill leg under the latency SLO ----------------------
+        spec = load_spec(args.slo, duration_s=phase_a)
+        evaluator = Evaluator(spec)
+        monitor = SLOMonitor(evaluator)
+        gen_a = LoadGenerator(
+            "127.0.0.1", router.port, clients=clients,
+            rate_hz=rate_a / clients, duration_s=phase_a,
+            schedule=args.schedule, seed=args.seed,
+            timeout=max(args.timeout, 3.0), payload=payload)
+
+        def _kill_one():
+            # SIGKILL (not the graceful SIGTERM): this leg proves the
+            # CRASH path — no drain, no shed hints, just a dead socket
+            # the failover legs must rotate through
+            rows = [w for w in router.workers() if w["routed"]]
+            key = (rows or router.workers())[0]["worker"]
+            with pool._lock:
+                victim = next((w for w in pool._workers.values()
+                               if w.key == key), None)
+            if victim is None:
+                return
+            kill_info.update({"worker": victim.key,
+                              "wid": victim.wid,
+                              "routed_at_kill": next(
+                                  (r["routed"] for r in rows
+                                   if r["worker"] == key), 0),
+                              "at_s": round(_time.monotonic() - t0, 1)})
+            victim.proc.kill()
+
+        t0 = _time.monotonic()
+        killer = _threading.Timer(0.4 * phase_a, _kill_one)
+        killer.daemon = True
+        killer.start()
+        monitor.start()
+        try:
+            summary_a = gen_a.run()
+        finally:
+            killer.cancel()
+            monitor.stop(final_tick=True)
+        verdict_a = evaluator.verdict()
+        # pool recovery: the respawned worker must be serving again
+        deadline = _time.monotonic() + 60.0
+        while pool.serving_count() < n and _time.monotonic() < deadline:
+            _time.sleep(0.5)
+        recovered = pool.serving_count() >= n
+
+        # -- phase 2: sustained load -> spawn -----------------------------
+        gen_b = LoadGenerator(
+            "127.0.0.1", router.port, clients=clients,
+            rate_hz=rate_b / clients, duration_s=phase_b,
+            schedule=args.schedule, seed=args.seed + 1,
+            timeout=max(args.timeout, 3.0), payload=payload)
+        summary_b = gen_b.run()
+        deadline = _time.monotonic() + 30.0
+        while pool.serving_count() < n + 1 \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.5)
+        scaled_up = (autoscaler.spawns >= 1
+                     and pool.serving_count() >= n + 1)
+
+        # -- phase 3: idle -> drain ---------------------------------------
+        deadline = _time.monotonic() + max(
+            40.0, asc_cfg.idle_hold_s + asc_cfg.post_spawn_guard_s
+            + 20.0)
+        while (autoscaler.drains < 1
+               or pool.serving_count() > n) \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.5)
+        scaled_down = (autoscaler.drains >= 1
+                       and pool.serving_count() <= n)
+
+        if sampler is not None:
+            sampler.stop(final_capture=True)
+            sampler = None
+        checks = {
+            "three_plus_workers": n >= 3,
+            "zero_client_errors": summary_a["errors"] == 0
+            and summary_b["errors"] == 0,
+            "latency_slo_held": bool(verdict_a["pass"]),
+            "worker_killed_mid_run": bool(kill_info),
+            "pool_recovered": recovered,
+            "spawn_on_sustained_load": scaled_up,
+            "drain_on_idle": scaled_down,
+        }
+        verdict = {
+            "metric": "soak_fleet", "status": "live",
+            "pass": all(checks.values()),
+            "verdict": "PASS" if all(checks.values()) else "FAIL",
+            "checks": checks,
+            "fleet": {
+                "workers": n, "clients": clients,
+                "capacity_routed_rps": round(capacity, 1),
+                "rate_kill_leg_rps": round(rate_a, 1),
+                "rate_autoscale_leg_rps": round(rate_b, 1),
+                "spawn_watermark_rps": round(up_rps, 1),
+                "drain_grace_s": args.fleet_drain_grace,
+                "replicas": router.replicas,
+            },
+            "kill": kill_info,
+            "kill_leg": {"loadgen": summary_a, "slo": verdict_a},
+            "autoscale_leg": {"loadgen": summary_b},
+            "router_workers": router.workers(),
+            "pool_events": list(pool.events),
+            "autoscaler": autoscaler.report(),
+            "signals": ring.signal_report(),
+            "federation_origins": collector.origins(),
+        }
+        with open(os.path.join(args.out, "verdict.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(verdict, fh, indent=2)
+        line = {"metric": "soak_fleet", "verdict": verdict["verdict"],
+                "pass": verdict["pass"], "status": "live",
+                "workers": n,
+                "kill": kill_info,
+                "errors": summary_a["errors"] + summary_b["errors"],
+                "sheds": summary_a.get("shed", 0)
+                + summary_b.get("shed", 0),
+                "kill_leg_latency_us": summary_a["latency_us"],
+                "spawns": autoscaler.spawns,
+                "drains": autoscaler.drains,
+                "checks": checks,
+                "artifact": os.path.join(args.out, "verdict.json")}
+        print(json.dumps(line), flush=True)
+        return 0 if verdict["pass"] else 1
+    finally:
+        if sampler is not None:
+            sampler.stop(final_capture=False)
+        if ring is not None:
+            ring.close()
+        if loop is not None:
+            loop.stop()
+        pool.stop(drain=False)
+        router.close()
+        collector_server.close()
 
 
 def spawn_federated_worker(out_dir: str, data_port: int,
@@ -1082,6 +1349,20 @@ def main(argv=None) -> int:
                          "shows both origins, and record the federated "
                          "per-origin timeline in the flight recorder "
                          "so a breach bundle shows both sides")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet acceptance mode (fleet/): spawn a "
+                         "router + >=3 out-of-process launch.py "
+                         "workers federating into this process's "
+                         "collector, soak through a mid-run worker "
+                         "SIGKILL (gate: zero client errors, latency "
+                         "SLO held), then prove the autoscaler spawns "
+                         "on sustained load and drains on idle")
+    ap.add_argument("--fleet-workers", type=int, default=3,
+                    help="initial fleet size for --fleet (min 3; the "
+                         "autoscale leg scales to N+1 and back)")
+    ap.add_argument("--fleet-drain-grace", type=float, default=5.0,
+                    help="worker SIGTERM drain budget for --fleet "
+                         "scale-downs (seconds)")
     ap.add_argument("--xbatch-timeout-ms", type=float, default=30.0,
                     help="batch-timeout-ms for the --xbatch server.  "
                          "Default 30 (deadline mode): the soak's "
@@ -1101,6 +1382,8 @@ def main(argv=None) -> int:
 
     if args.xbatch is not None:
         return run_xbatch(args, ap)
+    if args.fleet:
+        return run_fleet(args, ap)
 
     os.makedirs(args.out, exist_ok=True)
     demo = args.demo or not args.port
