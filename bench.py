@@ -754,6 +754,40 @@ def bench_lm(emit=None) -> dict:
         out["decode_tok_s_multistream"] = round(stream_tok_s, 1)
     elif "out_err" in locals():
         out["multistream_error"] = out_err
+
+    # continuous-batching SERVING tier (nnstreamer_tpu/llm): the
+    # slot-pooled decode step the tensor_llm element dispatches —
+    # unlike the vmap-over-full-caches multistream point above, this is
+    # the shape that serves (sessions at HETEROGENEOUS positions in one
+    # shared cache pool, join/leave quantized onto warm padded
+    # executables).  Bucket tok/s vs the same engine stepped one
+    # session at a time = the win the SOAK_llm acceptance gates live.
+    try:
+        from nnstreamer_tpu.llm.engine import DecodeEngine
+        from nnstreamer_tpu.llm.pool import KVCachePool
+
+        pool = KVCachePool(cfg, n_streams)
+        eng = DecodeEngine(params, cfg, pool, capacity=n_streams)
+        sessions = [pool.acquire(i) for i in range(n_streams)]
+        for i, s in enumerate(sessions):
+            s.max_new, s.next_token = 1 << 30, i + 1
+        eng.step(sessions)                    # compile bucket shape
+        eng.step(sessions[:1])                # compile solo lane
+        t0 = time.monotonic()
+        for _ in range(steps):
+            eng.step(sessions)
+        pooled = steps * n_streams / (time.monotonic() - t0)
+        t0 = time.monotonic()
+        for _ in range(steps):
+            eng.step(sessions[:1])
+        pooled_solo = steps / (time.monotonic() - t0)
+        out["llm_serve_tok_s"] = round(pooled, 1)
+        out["llm_serve_solo_tok_s"] = round(pooled_solo, 1)
+        out["llm_serve_bucket"] = n_streams
+        out["llm_serve_vs_solo"] = round(pooled / max(1e-9,
+                                                      pooled_solo), 2)
+    except Exception as exc:  # noqa: BLE001 — enrich, never lose the row
+        out["llm_serve_error"] = repr(exc)[:160]
     if emit is not None:
         # flush before the cost-analysis extra (it re-jits the naive path)
         emit(out)

@@ -37,6 +37,15 @@ HIERARCHY: Dict[str, int] = {
     "element": 20,          # Element._lock (per-element state guard)
     "filter.coalesce": 30,  # tensor_filter micro-batch coalescer
     "filter.workers": 32,   # tensor_filter worker-pool condition
+    "llm.engine": 34,       # tensor_llm pending-queue/session condition
+    #                         (llm/element.py): the decode thread takes
+    #                         session bookkeeping under it but NEVER
+    #                         pushes downstream while holding it, and
+    #                         chain() enqueues under it — so everything
+    #                         a push can reach (queue slots, send locks,
+    #                         tracer, pool) must rank above
+    "llm.pool": 36,         # KVCachePool slot table (llm/pool.py); the
+    #                         engine acquires it with llm.engine held
     # thread boundaries ----------------------------------------------------
     "queue.space": 40,      # Queue slot condition (bounded-buffer wait)
     "collectpads": 42,      # mux/merge N-pad sync engine

@@ -270,9 +270,20 @@ def _check_element_configs(pipeline, findings: List[Finding]) -> None:
                 "error", "misconfig", el.name,
                 f"static_check failed: {exc!r}", el))
             continue
-        for severity, message in checks:
+        for check in checks:
+            # two shapes: (severity, message) — the original hook
+            # contract, reported under the generic "misconfig" rule —
+            # and (severity, rule, message) for elements whose checks
+            # are named rules of their own (the llm element's
+            # llm-slots-lt-batch / llm-no-max-seq family), so --check
+            # output and tests can address them by name
+            if len(check) == 3:
+                severity, rule, message = check
+            else:
+                severity, message = check
+                rule = "misconfig"
             findings.append(Finding(
-                severity, "misconfig", _chain_path(el), message, el))
+                severity, rule, _chain_path(el), message, el))
 
 
 def _check_lowering(pipeline, findings: List[Finding]) -> None:

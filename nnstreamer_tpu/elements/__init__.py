@@ -20,6 +20,7 @@ from . import srciio  # noqa: F401
 from . import tensor_if  # noqa: F401
 from . import trainer  # noqa: F401
 from . import transform  # noqa: F401
+from ..llm import element as _llm_element  # noqa: F401
 from ..query import client as _query_client  # noqa: F401
 from ..query import edge as _query_edge  # noqa: F401
 from ..query import grpc_service as _query_grpc  # noqa: F401

@@ -1,0 +1,158 @@
+"""Client half of the token-streaming reply contract.
+
+The query wire protocol is UNCHANGED: a token-stream request is one
+ordinary ``T_DATA`` frame, and the answer is MANY ``T_REPLY`` frames
+sharing the request's seq — each carrying one ``[1, 1] int32`` token
+with ``pts`` = token index.  The stream ends by the stop-token
+contract: after ``max_new`` frames, or earlier at the first frame whose
+token equals the request's ``stop_token`` (that frame is delivered and
+IS the end marker); a NEGATIVE token is unconditionally terminal (the
+server's refusal/eviction markers — real vocab tokens are never
+negative).  ``T_SHED`` for the seq surfaces as
+:class:`~nnstreamer_tpu.query.overload.ShedError` exactly like the
+request/response path — slot exhaustion is an explicit, retryable
+refusal.
+
+Built over :class:`~nnstreamer_tpu.query.client.QueryConnection`'s
+transport internals (socket, reader thread, reply queue, seq
+allocation) so HELLO/QoS negotiation, clock-offset sampling and the
+T_TRACE piggyback all apply unchanged.  One outstanding stream per
+connection (the synchronous QueryConnection discipline).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import time
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..query.client import QueryConnection
+from ..query.overload import ShedError
+from ..query.protocol import (T_DATA, T_REPLY, T_SHED, decode_tensors,
+                              parse_retry_after, send_tensors)
+from ..tensor.buffer import TensorBuffer
+from .element import REQ_HEADER
+
+
+def encode_request(prompt: Sequence[int], max_new: int,
+                   stop_token: int = -1,
+                   frame_len: Optional[int] = None) -> np.ndarray:
+    """The ``tensor_llm`` request framing: ``(N,) int32`` =
+    ``[prompt_len, max_new, stop_token, prompt...]``, zero-padded to
+    ``frame_len`` (the serving caps' fixed tensor length)."""
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    n = REQ_HEADER + prompt.shape[0]
+    total = int(frame_len) if frame_len else n
+    if total < n:
+        raise ValueError(f"frame_len={frame_len} cannot hold a "
+                         f"{prompt.shape[0]}-token prompt")
+    out = np.zeros((total,), np.int32)
+    out[0] = prompt.shape[0]
+    out[1] = int(max_new)
+    out[2] = int(stop_token)
+    out[REQ_HEADER:n] = prompt
+    return out
+
+
+class TokenStreamClient:
+    """One token-streaming connection to a ``tensor_llm`` server."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 qos: Optional[str] = None,
+                 model: Optional[str] = None) -> None:
+        self._conn = QueryConnection(host, port, timeout=timeout,
+                                     qos=qos, model=model)
+        self.timeout = float(timeout)
+
+    def connect(self) -> "TokenStreamClient":
+        self._conn.connect()
+        return self
+
+    def close(self) -> None:
+        self._conn.close()
+        # drain undelivered replies: a stream abandoned mid-flight
+        # (disconnect, shed, caller bailed) leaves leased token frames
+        # queued — their pooled slabs must return to the pool NOW, not
+        # whenever the queue object happens to be collected
+        while True:
+            try:
+                msg = self._conn.replies.get_nowait()
+            except _queue.Empty:
+                break
+            if msg is not None and msg.lease is not None:
+                msg.payload = b""
+                msg.lease.release()
+
+    @property
+    def connection(self) -> QueryConnection:
+        return self._conn
+
+    def stream(self, prompt: Sequence[int], max_new: int,
+               stop_token: int = -1,
+               frame_len: Optional[int] = None
+               ) -> Iterator[Tuple[int, int]]:
+        """Send one request; yield ``(index, token)`` pairs as reply
+        frames arrive, ending by the stop-token contract.  Raises
+        :class:`ShedError` on an explicit slot shed, ``TimeoutError``
+        when the next token misses the per-token deadline, and
+        ``ValueError`` on an out-of-order token index (the exact
+        per-client order gate — ``pts`` must count 0, 1, 2, …)."""
+        conn = self._conn
+        req = encode_request(prompt, max_new, stop_token, frame_len)
+        with conn._waiters_lock:
+            conn._seq += 1
+            seq = conn._seq
+        with conn._send_lock:
+            send_tensors(conn._sock, T_DATA,
+                         TensorBuffer(tensors=[req]), seq=seq)
+        got = 0
+        while got < max_new:
+            deadline = time.monotonic() + self.timeout
+            reply = None
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no token within {self.timeout}s "
+                        f"(received {got}/{max_new})")
+                try:
+                    reply = conn.replies.get(timeout=remaining)
+                except _queue.Empty:
+                    continue
+                if reply is None:
+                    raise ConnectionError(
+                        "server closed connection mid-stream")
+                if reply.seq == seq:
+                    break
+                # stale reply of an earlier timed-out request: discard
+            if reply.type == T_SHED:
+                raise ShedError(parse_retry_after(reply.payload),
+                                qos=conn.qos or "default")
+            assert reply.type == T_REPLY
+            tok = int(np.asarray(decode_tensors(
+                reply.payload)[0]).reshape(-1)[0])
+            idx = int(reply.pts or 0)
+            if idx != got:
+                raise ValueError(
+                    f"token order violated: expected index {got}, "
+                    f"got {idx}")
+            got += 1
+            yield idx, tok
+            if tok < 0 or (stop_token >= 0 and tok == stop_token):
+                # a NEGATIVE token is unconditionally terminal: real
+                # vocab tokens are >= 0, so the element's refusal /
+                # eviction markers (emitted as the request's stop_token,
+                # -1 when none was set) must end the stream even for
+                # callers that set no stop token — without this the
+                # "deterministic refusal" would read as a hang until
+                # the per-token timeout
+                return
+
+    def generate(self, prompt: Sequence[int], max_new: int,
+                 stop_token: int = -1,
+                 frame_len: Optional[int] = None) -> List[int]:
+        """Collect a whole stream (order-checked by :meth:`stream`)."""
+        return [tok for _, tok in self.stream(prompt, max_new,
+                                              stop_token, frame_len)]

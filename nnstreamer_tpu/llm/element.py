@@ -1,0 +1,647 @@
+"""``tensor_llm``: the stateful token-streaming serving element.
+
+One element sits between ``tensor_query_serversrc`` and
+``tensor_query_serversink`` and turns the request/response serving
+plane into a continuous-batching token stream server:
+
+- **requests in**: one ``(N,) int32`` frame per session —
+  ``[prompt_len, max_new_tokens, stop_token, prompt...]`` (in-band
+  header framing, so the wire caps stay one static tensor).  The
+  serversrc's queue-depth admission and QoS negotiation apply unchanged
+  BEFORE the frame reaches this element.
+- **slot admission**: a session needs a KV-cache slot
+  (:class:`~nnstreamer_tpu.llm.pool.KVCachePool`); no free slot ⇒ the
+  request is answered with an explicit ``T_SHED`` + retry-after through
+  the paired server (``QueryServer.shed_frame``) — never queued as
+  unbounded memory.
+- **decode loop**: ONE decode thread owns admission, prefill
+  (flash-path, ``models/streamformer_lm.prefill_kv``), the per-step
+  padded ``decode_step_pooled`` invoke over the whole resident set, and
+  every downstream push — so per-client token order is exact BY
+  CONSTRUCTION (single pusher, bucket re-forms every step, sessions
+  join mid-flight after their prefill and leave on stop-token /
+  max-new / disconnect).
+- **streaming egress**: per-token ``[1, 1] int32`` frames flow to the
+  serversink carrying the request's extras (client id, wire seq, QoS,
+  trace context), ``pts`` = token index, and ``extra["nns_more"]`` on
+  every frame but the last (the server's in-flight unit stays open for
+  the whole stream, so drain waits for completions).
+- **eviction**: client disconnect (polled via the server table) and a
+  progress deadline reclaim slots mid-stream; EOS / ``Pipeline.drain``
+  finish resident sessions before the element lets go.
+
+Stop-token semantics (the client contract): the stream for one request
+ends when the client has received ``max_new_tokens`` frames, or earlier
+when a frame's token equals the request's ``stop_token`` (that frame is
+delivered and IS the end marker); a NEGATIVE token is unconditionally
+terminal — vocab tokens are never negative, so refusal/eviction
+markers end a stream even for requests that set no stop token.  A
+prompt too long for the cache (``prompt_len + max_new > max_seq``) is
+answered with a single stop-token frame — a deterministic refusal, not
+a shed (retrying an over-length prompt can never succeed).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..analysis.sanitizer import make_condition
+from ..pipeline.caps import Caps
+from ..pipeline.element import Element, EOSEvent, FlowReturn
+from ..pipeline.registry import register_element
+from ..tensor.buffer import TensorBuffer
+from ..tensor.caps_util import tensors_template_caps
+
+#: request header length: [prompt_len, max_new_tokens, stop_token]
+REQ_HEADER = 3
+
+
+class _Request:
+    """A parsed, slab-free copy of one request frame (the pooled wire
+    slab releases the moment chain() returns)."""
+
+    __slots__ = ("key", "prompt", "max_new", "stop_token", "qos",
+                 "extra", "born_s", "truncated")
+
+    def __init__(self, key, prompt, max_new, stop_token, qos, extra,
+                 born_s, truncated=False) -> None:
+        self.key = key
+        self.prompt = prompt
+        self.max_new = max_new
+        self.stop_token = stop_token
+        self.qos = qos
+        self.extra = extra
+        self.born_s = born_s
+        #: the request asked for MORE than the server's max-new-tokens
+        #: cap: the stream must end with an explicit terminal marker
+        #: frame, or the client (counting toward ITS ask) would hang
+        self.truncated = truncated
+
+
+@register_element
+class TensorLLM(Element):
+    FACTORY = "tensor_llm"
+    PROPERTIES = {
+        "custom": (None, "streamformer_lm sizing grammar "
+                         "(models/streamformer_lm.config_from_custom): "
+                         "layers/width/heads/head_dim/mlp/vocab/"
+                         "experts/max_seq/dtype — max_seq MUST be "
+                         "named (it times slots is the cache memory "
+                         "bound)"),
+        "seed": (0, "deterministic weight seed"),
+        "slots": (8, "KV-cache slots = max concurrently-resident "
+                     "sessions; cache memory = (slots+1) x layers x "
+                     "max_seq x heads x head_dim x 2 x itemsize, fixed "
+                     "at start"),
+        "batch": (4, "decode bucket capacity: resident sequences "
+                     "advanced per shared device step (> slots is a "
+                     "misconfig — the bucket could never fill)"),
+        "max-new-tokens": (64, "hard cap on one session's continuation "
+                               "(requests asking more are clamped)"),
+        "prefill": ("auto", "prompt path: auto (flash where the length "
+                            "gate says it wins) | flash | naive | step "
+                            "(token-by-token through the decode loop — "
+                            "the decode-without-prefill misconfig path)"),
+        "id": (-1, "paired query-server table id: >= 0 enables T_SHED "
+                   "egress for slot sheds and disconnect pruning "
+                   "(sessions of vanished clients reclaim their slot); "
+                   "-1 = standalone (appsrc/tensor_sink pipelines — "
+                   "sheds emit a stop-token frame tagged "
+                   "extra['nns_llm_shed'])"),
+        "admit-timeout-ms": (0.0, "how long a request may wait for a "
+                                  "slot before shedding (0 = shed "
+                                  "immediately when no slot is free)"),
+        "session-timeout-ms": (0.0, "slot-lease deadline: a session "
+                                    "older than this (since admission) "
+                                    "is force-completed with a "
+                                    "terminal stop-token frame and its "
+                                    "slot reclaimed (0 = off; max-new "
+                                    "already bounds well-behaved "
+                                    "streams)"),
+        "queue-depth": (0, "pending-request bound before chain() "
+                           "backpressures (0 = 2 x slots)"),
+    }
+
+    # -- pads / caps -----------------------------------------------------
+    def _make_pads(self):
+        self.add_sink_pad(tensors_template_caps(), "sink")
+        self.add_src_pad(tensors_template_caps(), "src")
+
+    def set_caps(self, pad, caps):
+        from ..tensor.caps_util import config_from_caps
+
+        cfg = config_from_caps(caps)
+        info = cfg.info
+        if info.num_tensors != 1:
+            raise ValueError(f"{self.name}: request caps must carry ONE "
+                             f"int32 tensor (got {info.num_tensors})")
+        t = info[0]
+        if str(t.np_dtype) != "int32" or len(t.np_shape) != 1 \
+                or t.np_shape[0] < REQ_HEADER + 1:
+            raise ValueError(
+                f"{self.name}: request tensor must be (N,) int32 with "
+                f"N >= {REQ_HEADER + 1} ([prompt_len, max_new, "
+                f"stop_token, prompt...]); got {t.np_shape} "
+                f"{t.np_dtype}")
+        self._req_cap = int(t.np_shape[0])
+        self.announce_src_caps(Caps.from_string(
+            "other/tensors,format=static,num_tensors=1,dimensions=1:1,"
+            "types=int32,framerate=0/1"))
+
+    # -- verifier hook ---------------------------------------------------
+    def static_check(self):
+        from ..filter.framework import FilterProperties
+        from ..models.streamformer_lm import config_from_custom
+
+        out = []
+
+        def _num(key, default):
+            try:
+                return int(self.get_property(key) or default)
+            except (TypeError, ValueError):
+                out.append(("error", f"llm-bad-{key}",
+                            f"{self.name}: {key}="
+                            f"{self.get_property(key)!r} is not an "
+                            "integer"))
+                return default
+
+        slots = _num("slots", 8)
+        batch = _num("batch", 4)
+        if slots < 1 or batch < 1:
+            out.append(("warning", "misconfig",
+                        f"{self.name}: slots/batch below 1 is clamped "
+                        "to 1 at start"))
+            slots, batch = max(1, slots), max(1, batch)
+        if slots < batch:
+            out.append(("error", "llm-slots-lt-batch",
+                        f"{self.name}: slots={slots} < batch={batch}: "
+                        "the decode bucket is wider than the session "
+                        "pool — it could never fill; size slots >= "
+                        "batch (cache memory scales with slots, "
+                        "throughput with filled batch)"))
+        custom = FilterProperties.parse_custom(self.custom)
+        if "max_seq" not in custom:
+            out.append(("error", "llm-no-max-seq",
+                        f"{self.name}: custom= names no max_seq — the "
+                        "KV-cache slot shape (and with it the tier's "
+                        "whole cache memory, slots x layers x max_seq "
+                        "x heads x head_dim x 2) would be an implicit "
+                        "default; the serving tier must size its cache "
+                        "explicitly"))
+        else:
+            try:
+                config_from_custom(custom)
+            except (ValueError, TypeError) as exc:
+                out.append(("error", "misconfig",
+                            f"{self.name}: custom= rejected: {exc}"))
+        mode = str(self.prefill or "auto")
+        if mode not in ("auto", "flash", "naive", "step"):
+            out.append(("error", "misconfig",
+                        f"{self.name}: prefill={mode!r} (want auto | "
+                        "flash | naive | step)"))
+        elif mode == "step":
+            out.append(("warning", "llm-decode-without-prefill",
+                        f"{self.name}: prefill=step decodes each "
+                        "prompt token-by-token through the decode "
+                        "loop: correct, but the prompt costs T GEMV "
+                        "steps and the flash-attention prefill (which "
+                        "never materializes (T,T) scores) is bypassed "
+                        "— intended only for tiny prompts or "
+                        "debugging"))
+        return out
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        from ..filter.framework import FilterProperties
+        from ..models.registry import host_init
+        from ..models.streamformer_lm import config_from_custom
+        from ..obs.clock import mono_ns
+        from ..parallel.train_step import init_params
+        from .engine import DecodeEngine
+        from .pool import KVCachePool
+
+        custom = FilterProperties.parse_custom(self.custom)
+        self.cfg = config_from_custom(custom)
+        self._slots = max(1, int(self.slots or 1))
+        self._batch = max(1, int(self.batch or 1))
+        self._max_new_cap = max(1, int(self.max_new_tokens or 1))
+        self._admit_timeout = max(0.0,
+                                  float(self.admit_timeout_ms or 0)) / 1e3
+        self._sess_timeout = max(0.0,
+                                 float(self.session_timeout_ms or 0)) / 1e3
+        self._depth = int(self.queue_depth or 0) or 2 * self._slots
+        params = host_init(
+            lambda: init_params(self.cfg, int(self.seed or 0)))
+        self.pool = KVCachePool(self.cfg, self._slots)
+        self.engine = DecodeEngine(params, self.cfg, self.pool,
+                                   capacity=self._batch,
+                                   prefill_mode=str(self.prefill
+                                                    or "auto"))
+        self.engine.warmup()
+        self._mono_ns = mono_ns
+        self._cv = make_condition("llm.engine")
+        self._pending: List[_Request] = []   # bounded by _depth (cv)
+        self._stopping = False
+        self._flushing = False
+        self._req_n = 0                      # standalone session keys
+        self.shed_total = 0
+        self.rejected_total = 0
+        self.evicted_total = 0
+        self.sessions_total = 0
+        self._register_gauges()
+        self._thread = threading.Thread(target=self._decode_loop,
+                                        daemon=True,
+                                        name=f"llm-decode:{self.name}")
+        self._thread.start()
+
+    def _register_gauges(self) -> None:
+        from ..obs.metrics import REGISTRY, Gauge
+
+        labels = {"element": self.name,
+                  "pipeline": getattr(self.pipeline, "name", "") or ""}
+        eng, pool = self.engine, self.pool
+        rate_state = {"tokens": None, "t": None}
+
+        def _tokens_per_s() -> float:
+            # scrape-to-scrape token rate (first scrape: lifetime —
+            # the filter gauges' _make_rate discipline)
+            import time as _time
+
+            now = _time.monotonic()
+            tokens = eng.tokens_total
+            prev_t, prev_n = rate_state["t"], rate_state["tokens"]
+            rate_state["t"], rate_state["tokens"] = now, tokens
+            if prev_t is None or now - prev_t < 0.05:
+                total = max(1e-9, eng.phases.report()["total_s"])
+                return tokens / total
+            return max(0.0, (tokens - prev_n) / (now - prev_t))
+
+        self._obs_gauges = [REGISTRY.register(Gauge(n, dict(labels),
+                                                    fn=f))
+                            for n, f in (
+            ("nns_llm_active_seqs", lambda: pool.live),
+            ("nns_llm_cache_occupancy", lambda: pool.occupancy),
+            ("nns_llm_cache_bytes", pool.cache_bytes),
+            ("nns_llm_tokens_per_s", _tokens_per_s),
+            ("nns_llm_decode_fill",
+             lambda: eng.last_fill / max(1, eng.capacity)),
+            ("nns_llm_pending", lambda: len(self._pending)),
+        )]
+        self._obs_counters = {
+            n: REGISTRY.counter(n, **labels) for n in (
+                "nns_llm_tokens_total", "nns_llm_sessions_total",
+                "nns_llm_shed_total", "nns_llm_evicted_total",
+                "nns_llm_rejected_total")}
+        self._ctr_tokens = 0    # counter mirror of engine.tokens_total
+
+    def stop(self):
+        from ..obs.metrics import REGISTRY
+
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        thread = getattr(self, "_thread", None)
+        if thread is not None:
+            thread.join(timeout=30)
+            self._thread = None
+        for g in getattr(self, "_obs_gauges", ()):
+            REGISTRY.unregister(g)
+        self._obs_gauges = []
+        self.engine = None
+        self.pool = None
+
+    def unblock(self):
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+
+    def health_state(self):
+        pool = getattr(self, "pool", None)
+        if pool is not None and pool.admission.draining:
+            return "draining"
+        return None
+
+    def drain(self, deadline: float = 5.0) -> None:
+        """Pipeline.drain hook: stop admitting sessions (new requests
+        shed with a drain-sized retry-after), finish every resident
+        stream, within ``deadline``."""
+        pool = getattr(self, "pool", None)
+        if pool is None:
+            return
+        pool.admission.start_drain(deadline)
+        with self._cv:
+            self._cv.notify_all()
+            self._cv.wait_for(
+                lambda: not self._pending and pool.live == 0,
+                timeout=max(0.0, deadline))
+
+    # -- ingress ---------------------------------------------------------
+    def chain(self, pad, buf: TensorBuffer) -> FlowReturn:
+        arr = np.asarray(buf.np(0)).reshape(-1)
+        bad = None
+        plen = 0
+        if arr.shape[0] < REQ_HEADER + 1:
+            bad = (f"request frame too short ({arr.shape[0]} < "
+                   f"{REQ_HEADER + 1})")
+        else:
+            plen = int(arr[0])
+            if plen < 1 or plen > arr.shape[0] - REQ_HEADER:
+                bad = (f"prompt_len={plen} out of range for a "
+                       f"{arr.shape[0]}-element request frame")
+        extra = dict(buf.extra)
+        if bad is not None:
+            if extra.get("query_client_id") is None:
+                # developer path (appsrc tests): loud
+                raise ValueError(f"{self.name}: {bad}")
+            # serving path: a malformed frame is a CLIENT error — it
+            # must not error the pipeline every other client shares.
+            # A reject request rides the decode thread (the single
+            # pusher) and is answered with one terminal frame there,
+            # settling the request's in-flight unit.
+            from ..utils.log import ml_logw
+
+            ml_logw("%s: %s — answering with a terminal frame",
+                    self.name, bad)
+            prompt = None
+            asked, max_new, stop_token = 0, 0, -1
+        else:
+            asked = max(1, int(arr[1]))
+            max_new = min(self._max_new_cap, asked)
+            stop_token = int(arr[2])
+            # slab-free copy: the request's pooled wire slab releases
+            # when this buffer dies at return — a disconnecting client
+            # can never strand a slab behind a resident session
+            prompt = np.array(arr[REQ_HEADER:REQ_HEADER + plen],
+                              np.int32)
+        cid = extra.get("query_client_id")
+        wseq = extra.get("query_seq")
+        with self._cv:
+            self._req_n += 1
+            # the local counter keeps keys unique even against a buggy
+            # or hostile client REUSING a wire seq while its first
+            # stream is resident — a key collision must never reach
+            # pool.acquire's ValueError (one client's duplicate would
+            # error the pipeline every client shares); reply routing
+            # rides the extras (cid, seq), not the key
+            key = ((cid, wseq, self._req_n) if cid is not None
+                   else ("local", self._req_n))
+            req = _Request(key, prompt, max_new, stop_token,
+                           str(extra.get("nns_class", "silver")),
+                           extra, self._now(),
+                           truncated=(prompt is not None
+                                      and asked > max_new))
+            # bounded pending: backpressure the serving thread (and
+            # through it the serversrc's bounded queue, whose admission
+            # sheds at ITS watermarks) rather than queue unbounded
+            self._cv.wait_for(
+                lambda: len(self._pending) < self._depth
+                or self._stopping)
+            if self._stopping:
+                return FlowReturn.EOS
+            self._pending.append(req)
+            self._cv.notify_all()
+        return FlowReturn.OK
+
+    def _now(self) -> float:
+        return self._mono_ns() / 1e9
+
+    # -- events ----------------------------------------------------------
+    def on_event(self, pad, event):
+        if isinstance(event, EOSEvent):
+            # finish every admitted stream before EOS crosses: resident
+            # sessions are ADMITTED work (inflight-counted server-side)
+            with self._cv:
+                self._flushing = True
+                self._cv.notify_all()
+                self._cv.wait_for(
+                    lambda: self._stopping
+                    or (not self._pending
+                        and (self.pool is None or self.pool.live == 0)),
+                    timeout=120.0)
+                self._flushing = False
+        super().on_event(pad, event)
+
+    # -- decode loop -----------------------------------------------------
+    def _server(self):
+        sid = int(self.id if self.id is not None else -1)
+        if sid < 0:
+            return None
+        from ..query.server import peek_server
+
+        return peek_server(sid)
+
+    def _decode_loop(self) -> None:
+        try:
+            self._decode_loop_inner()
+        except Exception as exc:  # noqa: BLE001 — surfaced as pipeline err
+            if self.pipeline is not None:
+                self.pipeline.post_error(self, exc)
+
+    def _decode_loop_inner(self) -> None:
+        eng = self.engine
+        pool = self.pool
+        rr = 0                         # round-robin cursor
+        while True:
+            with self._cv:
+                if self._stopping:
+                    return
+                if not self._pending and pool.live == 0:
+                    eng.phases.enter("idle")
+                    # idle tick bounds disconnect-prune latency too
+                    self._cv.wait(0.05)
+                    if self._stopping:
+                        return
+                taken, self._pending = self._pending, []
+                self._cv.notify_all()   # free chain() backpressure slots
+            self._prune_sessions()
+            requeue = self._admit(taken)
+            sessions = pool.sessions()
+            if sessions:
+                n = len(sessions)
+                pick = [sessions[(rr + i) % n]
+                        for i in range(min(n, self._batch))]
+                rr = (rr + len(pick)) % max(1, n)
+                self._run_step(pick)
+            if requeue:
+                with self._cv:
+                    self._pending[:0] = requeue
+            with self._cv:
+                if not self._pending and pool.live == 0:
+                    self._cv.notify_all()   # EOS/drain waiters
+
+    # -- admission -------------------------------------------------------
+    def _admit(self, reqs: List[_Request]) -> List[_Request]:
+        """Admit / shed / requeue pending requests.  Returns the
+        requests still inside their admit-timeout window (no slot yet,
+        not shed by policy)."""
+        eng, pool = self.engine, self.pool
+        requeue: List[_Request] = []
+        for req in reqs:
+            prev = eng.phases.enter("admit")
+            try:
+                if req.prompt is None \
+                        or len(req.prompt) + req.max_new \
+                        > self.cfg.max_seq:
+                    # deterministic refusal (malformed / over-length):
+                    # a retry can never succeed, so this is a terminal
+                    # stop-token answer, not a shed
+                    self.rejected_total += 1
+                    self._obs_counters["nns_llm_rejected_total"].inc()
+                    self._emit(req.extra, req.stop_token, 0, last=True)
+                    continue
+                verdict = pool.admit(req.qos,
+                                     no_slot_retry_s=eng
+                                     .retry_after_hint())
+                if verdict is not None:
+                    if self._admit_timeout > 0 \
+                            and self._now() - req.born_s \
+                            < self._admit_timeout \
+                            and not pool.admission.draining:
+                        requeue.append(req)
+                    else:
+                        self._shed(req, verdict)
+                    continue
+                sess = pool.acquire(req.key, qos=req.qos,
+                                    extra=req.extra)
+                sess.max_new = req.max_new
+                sess.stop_token = req.stop_token
+                sess.truncated = req.truncated
+                self.sessions_total += 1
+                self._obs_counters["nns_llm_sessions_total"].inc()
+                t0 = self._mono_ns()
+                first = eng.prefill(sess, req.prompt)
+                tracer = self._tracer()
+                if tracer is not None:
+                    ctx = req.extra.get("nns_trace")
+                    if ctx is not None and ctx.trace_id:
+                        # the session's one-time prompt cost, in the
+                        # CLIENT's merged timeline (obs/attrib.py
+                        # llm-prefill state)
+                        tracer.annotate_span("llm-prefill", t0,
+                                             self._mono_ns(), seq=-1,
+                                             trace_id=ctx.trace_id)
+                sess.next_token = first
+                # the prefill's token is this session's first answer —
+                # emit it NOW (time-to-first-token is the prefill, not
+                # the prefill plus one bucket cycle)
+                self._finish_or_emit(sess, first)
+            finally:
+                eng.phases.enter(prev)
+        return requeue
+
+    def _shed(self, req: _Request, retry_after_s: float) -> None:
+        self.shed_total += 1
+        self._obs_counters["nns_llm_shed_total"].inc()
+        srv = self._server()
+        if srv is not None:
+            srv.shed_frame(req.extra, retry_after_s)
+            return
+        # standalone pipelines (appsrc/tensor_sink): the shed is a
+        # tagged stop-token frame so the consumer still sees an
+        # explicit, final answer
+        extra = dict(req.extra)
+        extra["nns_llm_shed"] = retry_after_s
+        self._emit(extra, req.stop_token, 0, last=True)
+
+    # -- stepping / egress -----------------------------------------------
+    def _run_step(self, picked) -> None:
+        eng = self.engine
+        t0 = self._mono_ns()
+        toks = eng.step(picked)
+        t1 = self._mono_ns()
+        self._ctr_sync()
+        tracer = self._tracer()
+        if tracer is not None:
+            # the SHARED decode window, once per resident trace — the
+            # cross-stream device-invoke convention (per-token
+            # wall-clock truth, not a 1/n share)
+            for sess in picked:
+                ctx = sess.extra.get("nns_trace")
+                if ctx is not None and ctx.trace_id:
+                    tracer.annotate_span("llm-decode", t0, t1, seq=-1,
+                                         trace_id=ctx.trace_id)
+        for sess, tok in zip(picked, toks):
+            sess.next_token = tok
+            self._finish_or_emit(sess, tok)
+
+    def _finish_or_emit(self, sess, tok: int) -> None:
+        """Emit one token frame for ``sess``; release its slot when the
+        stream is complete (stop token, or the granted length).  A
+        TRUNCATED stream (the request asked more than the server's
+        max-new-tokens cap) that runs out without hitting its stop
+        token gets one extra terminal MARKER frame (the stop token, -1
+        when none — negative is unconditionally terminal client-side):
+        the client counts toward ITS ask, so a silently clamped stream
+        would otherwise hang it until the per-token timeout."""
+        sess.emitted += 1
+        by_stop = sess.stop_token >= 0 and tok == sess.stop_token
+        done = sess.emitted >= sess.max_new or by_stop
+        marker = done and sess.truncated and not by_stop
+        self._emit(sess.extra, tok, sess.emitted - 1,
+                   last=done and not marker)
+        if marker:
+            self._emit(sess.extra, sess.stop_token, sess.emitted,
+                       last=True)
+        if done:
+            self.pool.release(sess.key)
+
+    def _emit(self, extra: Dict[str, Any], tok: int, index: int,
+              last: bool) -> None:
+        prev = self.engine.phases.enter("egress")
+        try:
+            out_extra = dict(extra)
+            if not last:
+                out_extra["nns_more"] = True
+            buf = TensorBuffer(
+                tensors=[np.array([[tok]], np.int32)], pts=index,
+                extra=out_extra)
+            # the decode thread is the only pusher: per-client frame
+            # order IS emission order
+            self.push(buf)
+        finally:
+            self.engine.phases.enter(prev)
+
+    # -- eviction --------------------------------------------------------
+    def _prune_sessions(self) -> None:
+        """Reclaim slots of disconnected clients (polled on the server
+        table) and deadline-overrun sessions.  Every eviction still
+        EMITS a terminal stop-token frame: for a vanished client the
+        reply is unsendable but settles the stream's in-flight unit
+        (drain must converge), for a live one it explicitly ends the
+        stream under the stop-token contract."""
+        pool = self.pool
+        srv = self._server()
+        dead = []
+        if srv is not None:
+            for sess in pool.sessions():
+                cid = sess.extra.get("query_client_id")
+                if cid is not None and not srv.client_connected(cid):
+                    dead.append(sess.key)
+        if self._sess_timeout > 0:
+            dead.extend(pool.aged_keys(self._sess_timeout))
+        for key in dead:
+            sess = pool.release(key)
+            if sess is not None:
+                self.evicted_total += 1
+                self._obs_counters["nns_llm_evicted_total"].inc()
+                self._emit(sess.extra, sess.stop_token, sess.emitted,
+                           last=True)
+
+    # -- helpers ---------------------------------------------------------
+    def _tracer(self):
+        pl = self.pipeline
+        tracer = pl.tracer if pl is not None else None
+        if tracer is not None and tracer.ring is not None:
+            return tracer
+        return None
+
+    def _ctr_sync(self) -> None:
+        """Mirror the engine's token count into the registry counter
+        (counters are monotonic-inc only)."""
+        delta = self.engine.tokens_total - self._ctr_tokens
+        if delta > 0:
+            self._obs_counters["nns_llm_tokens_total"].inc(delta)
+            self._ctr_tokens = self.engine.tokens_total

@@ -4,6 +4,13 @@ The reference loads vendor model files (.tflite/.pb/.pt …) through per-SDK
 subplugins (SURVEY.md §2.4).  TPU-native, a "model" is a pure JAX function +
 params compiled by XLA; the registry replaces file-extension dispatch with
 named model specs (file paths to orbax checkpoints also resolve here).
+
+Sizing is a ``custom=`` grammar, not code: ``mlp``
+(``custom=width:2048,depth:32``, models/mlp.py) and ``streamformer_lm``
+(``custom=layers:8,width:512,max_seq:1024``,
+models/streamformer_lm.config_from_custom — shared with the
+``tensor_llm`` serving tier) both size from the launch line, so soak
+and bench servers pick a realistically heavy model without edits.
 """
 
 from __future__ import annotations

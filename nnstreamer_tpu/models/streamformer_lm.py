@@ -88,6 +88,53 @@ def forward_logits(params: Dict[str, Any], tokens: jnp.ndarray,
     return jnp.einsum("td,dv->tv", x, params["head"])
 
 
+def config_from_custom(custom: Dict[str, Any],
+                       default_seq: int = 64) -> StreamFormerConfig:
+    """The ``custom=`` sizing grammar, shared by the registry builder and
+    the LLM serving tier (``nnstreamer_tpu/llm/``) — the same
+    parameterization discipline ``models/mlp.py`` established, so a soak
+    server sizes a realistically heavy decoder from the launch line
+    alone::
+
+        custom=layers:8,width:512,heads:8,head_dim:64,max_seq:1024
+
+    Keys: ``vocab`` ``dim``/``width`` (aliases) ``heads`` ``head_dim``
+    ``mlp`` ``layers`` ``experts`` ``max_seq`` ``dtype`` (``seq`` — the
+    registry filter's window length — and ``seed`` stay with their
+    callers).  ``max_seq`` defaults to ``max(seq, 64)`` for the
+    full-sequence filter's historical sizing; the decode tier sets it
+    explicitly (its KV-cache memory is ``slots x layers x max_seq x
+    heads x head_dim x 2``, the bound the cache pool enforces)."""
+    if "dim" in custom and "width" in custom \
+            and str(custom["dim"]) != str(custom["width"]):
+        raise ValueError("streamformer_lm: custom dim and width are "
+                         "aliases; give one")
+    # ``seq`` is the full-sequence FILTER's window length; the decode
+    # tier never sets it (its sequence axis is the cache), so the
+    # window-fits-cache validation only applies when a caller names it
+    seq = int(custom["seq"]) if "seq" in custom else int(default_seq)
+    cfg = StreamFormerConfig(
+        vocab=int(custom.get("vocab", 256)),
+        dim=int(custom.get("dim", custom.get("width", 128))),
+        heads=int(custom.get("heads", 8)),
+        head_dim=int(custom.get("head_dim", 16)),
+        mlp=int(custom.get("mlp", 512)),
+        layers=int(custom.get("layers", 2)),
+        experts=int(custom.get("experts", 2)),
+        max_seq=int(custom.get("max_seq", max(seq, 64))),
+        dtype=jnp.dtype(custom.get("dtype", "bfloat16")))
+    if min(cfg.vocab, cfg.dim, cfg.heads, cfg.head_dim, cfg.mlp,
+           cfg.layers, cfg.experts, cfg.max_seq) < 1:
+        raise ValueError(
+            "streamformer_lm: vocab/dim/heads/head_dim/mlp/layers/"
+            "experts/max_seq must all be >= 1")
+    if "seq" in custom and cfg.max_seq < seq:
+        raise ValueError(
+            f"streamformer_lm: max_seq={cfg.max_seq} < seq={seq}: the "
+            "KV cache could not hold one full input window")
+    return cfg
+
+
 def init_cache(cfg: StreamFormerConfig) -> Dict[str, jnp.ndarray]:
     """Static-shape KV cache: (layers, max_seq, heads, head_dim)."""
     L = cfg.layers
@@ -95,6 +142,114 @@ def init_cache(cfg: StreamFormerConfig) -> Dict[str, jnp.ndarray]:
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),
             "pos": jnp.zeros((), jnp.int32)}
+
+
+def prefill_kv(params: Dict[str, Any], tokens: jnp.ndarray,
+               cfg: StreamFormerConfig, flash: "bool | None" = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Full-prompt prefill for the KV-cache serving tier: one
+    full-sequence forward (the :func:`forward_logits` math, length-gated
+    onto the Pallas flash kernel so long prompts never materialize
+    (T, T) scores) that ALSO returns every layer's keys/values —
+    ``tokens (T,) int32 → (logits (T, vocab) f32, k (L, T, H, Dh),
+    v (L, T, H, Dh))`` in ``cfg.dtype``.
+
+    A prompt prefilled here and continued through
+    :func:`decode_step` / :func:`decode_step_pooled` produces the same
+    logits as scanning :func:`decode_step` over the whole prompt — at
+    full-sequence GEMM arithmetic intensity instead of T GEMV steps
+    (the consistency contract tests/test_llm.py pins)."""
+    t = tokens.shape[0]
+    if flash is None:
+        from ..ops.flash_attention import flash_wins
+
+        flash = flash_wins(t)
+    pos = jnp.arange(t)
+    x = (params["embed"][tokens] + params["pos"][pos]).astype(cfg.dtype)
+    ks, vs = [], []
+    for lyr in params["layers"]:
+        y = _ln(x.astype(jnp.float32), lyr["ln1"]).astype(cfg.dtype)
+        qkv = jnp.einsum("td,dchn->tchn", y, lyr["wqkv"].astype(cfg.dtype))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        ks.append(k)
+        vs.append(v)
+        if flash:
+            from ..ops.flash_attention import flash_attention
+
+            attn = flash_attention(q, k, v, causal=True)
+        else:
+            from ..parallel.ring_attention import local_attention
+
+            attn = local_attention(q, k, v, causal=True)
+        o = jnp.einsum("qhd,hdn->qn", attn.astype(cfg.dtype),
+                       lyr["wo"].astype(cfg.dtype))
+        x = x + o
+        y = _ln(x.astype(jnp.float32), lyr["ln2"]).astype(cfg.dtype)
+        m = jnp.einsum("td,df->tf", y, lyr["w1"].astype(cfg.dtype))
+        m = jnp.einsum("tf,fd->td", jax.nn.gelu(m),
+                       lyr["w2"].astype(cfg.dtype))
+        x = x + m + _moe_dense(y, lyr, cfg)
+    x = _ln(x.astype(jnp.float32), params["ln_f"])
+    logits = jnp.einsum("td,dv->tv", x, params["head"])
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def decode_step_pooled(params: Dict[str, Any], k_pool: jnp.ndarray,
+                       v_pool: jnp.ndarray, tokens: jnp.ndarray,
+                       pos: jnp.ndarray, slots: jnp.ndarray,
+                       cfg: StreamFormerConfig
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One continuous-batching decode step over a SLOT-POOLED cache:
+    ``B`` resident sequences — each at its own position, each owning one
+    cache slot — advance together through one batched invoke.
+
+    - ``k_pool``/``v_pool``: ``(S, L, max_seq, H, Dh)`` — the whole
+      session pool's cache, ``S`` static slots (the llm/ tier's bounded
+      memory: nothing here ever allocates per-sequence);
+    - ``tokens``/``pos``/``slots``: ``(B,) int32`` — this step's token,
+      position and cache-slot id per lane.  Padding lanes (partial
+      buckets) point at a caller-reserved scratch slot, so their
+      scatter writes can never touch a live session;
+    - returns ``(logits (B, vocab) f32, k_pool', v_pool')``.
+
+    Same math as :func:`decode_step` (scatter the new K/V at
+    ``(slot, layer, pos)``, attend the single query against the slot's
+    prefix, positions beyond ``pos`` masked) — lane *i* of this step
+    equals a solo :func:`decode_step` on slot *i*'s cache, which is the
+    correctness spine the batched serving tier rests on.  The batched
+    shape is the point: B GEMV-shaped single-token steps become one
+    GEMM-shaped step (the PR 9 padded-bucket economics, applied to the
+    decode loop), and ONE executable per padded B serves every fill."""
+    x = (params["embed"][tokens] + params["pos"][pos]).astype(cfg.dtype)
+    valid = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]   # (B, T)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    for li, lyr in enumerate(params["layers"]):
+        y = _ln(x.astype(jnp.float32), lyr["ln1"]).astype(cfg.dtype)
+        qkv = jnp.einsum("bd,dchn->bchn", y,
+                         lyr["wqkv"].astype(cfg.dtype))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # (B, H, Dh)
+        li_ix = jnp.full_like(slots, li)
+        k_pool = k_pool.at[slots, li_ix, pos].set(k)
+        v_pool = v_pool.at[slots, li_ix, pos].set(v)
+        kcur = k_pool[slots, li_ix]                 # (B, max_seq, H, Dh)
+        vcur = v_pool[slots, li_ix]
+        s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                       kcur.astype(jnp.float32)) * scale
+        s = jnp.where(valid[:, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bht,bthd->bhd", p,
+                          vcur.astype(jnp.float32))
+        o = jnp.einsum("bhd,hdn->bn", attn.astype(cfg.dtype),
+                       lyr["wo"].astype(cfg.dtype))
+        x = x + o
+        y = _ln(x.astype(jnp.float32), lyr["ln2"]).astype(cfg.dtype)
+        m = jnp.einsum("bd,df->bf", y, lyr["w1"].astype(cfg.dtype))
+        m = jnp.einsum("bf,fd->bd", jax.nn.gelu(m),
+                       lyr["w2"].astype(cfg.dtype))
+        x = x + m + _moe_dense(y, lyr, cfg)
+    x = _ln(x.astype(jnp.float32), params["ln_f"])
+    return (jnp.einsum("bd,dv->bv", x, params["head"]),
+            k_pool, v_pool)
 
 
 def decode_step(params: Dict[str, Any], cache: Dict[str, jnp.ndarray],
@@ -208,16 +363,10 @@ def _build_registry_model(custom_props):
 
     seed = int(custom_props.get("seed", 0))
     seq = int(custom_props.get("seq", 64))
-    cfg = StreamFormerConfig(
-        vocab=int(custom_props.get("vocab", 256)),
-        dim=int(custom_props.get("dim", 128)),
-        heads=int(custom_props.get("heads", 8)),
-        head_dim=int(custom_props.get("head_dim", 16)),
-        mlp=int(custom_props.get("mlp", 512)),
-        layers=int(custom_props.get("layers", 2)),
-        experts=int(custom_props.get("experts", 2)),
-        max_seq=max(seq, 64),
-        dtype=jnp.dtype(custom_props.get("dtype", "bfloat16")))
+    # one sizing grammar for every streamformer_lm consumer (registry
+    # filter here, the llm/ decode tier, soak servers): layers/width/
+    # heads/head_dim/max_seq all launch-line parameterizable
+    cfg = config_from_custom(custom_props)
     params = host_init(lambda: init_params(cfg, seed))
 
     def forward(params, tokens):

@@ -47,6 +47,18 @@ lifetime maps to exactly one):
 ``device-compile``        first-call JIT compilation (split from invoke)
 ``reorder-wait``          a finished result holding for stream order
                           (filter worker pool's strict-seq pusher)
+``llm-prefill``           KV-cache prompt prefill: the full-prompt
+                          forward that seeds a session's cache slot
+                          (``nnstreamer_tpu/llm`` decode engine;
+                          annotated under the REQUEST's trace id, so a
+                          client timeline shows its prompt's one-time
+                          cost apart from the per-token stream)
+``llm-decode``            one continuous-batching decode step's shared
+                          window — like the cross-stream
+                          ``device-invoke``, every resident sequence of
+                          the step annotates the SAME interval under
+                          its own trace id (per-token wall-clock truth,
+                          not a 1/n share)
 ``sink``                  inside the sink element's chain
 ``dispatch``              inter-element scheduling glue (gaps not
                           explained by any state above)
@@ -93,7 +105,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 STATES = (
     "source-pacing", "element-compute", "serialize", "queue-wait",
     "admission-wait", "wire", "device-invoke", "device-compile",
-    "reorder-wait", "sink", "dispatch", "unattributed",
+    "reorder-wait", "llm-prefill", "llm-decode", "sink", "dispatch",
+    "unattributed",
 )
 
 #: span-name prefix for explicit state annotations

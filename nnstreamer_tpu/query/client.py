@@ -44,8 +44,9 @@ from ..tensor.buffer import TensorBuffer, default_pool
 from ..tensor.caps_util import tensors_template_caps
 from .overload import ShedError, qos_of_class
 from .protocol import (Message, T_BYE, T_DATA, T_HELLO, T_PING, T_PONG,
-                       T_REPLY, T_SHED, T_TRACE, decode_tensors, recv_msg,
-                       send_msg, send_tensors, shutdown_close)
+                       T_REPLY, T_SHED, T_TRACE, decode_tensors,
+                       parse_retry_after, recv_msg, send_msg,
+                       send_tensors, shutdown_close)
 from .protocol import create_connection as checked_connect
 from .resilience import (STATS, CircuitBreaker, CircuitOpenError,
                          HealthMonitor, RetryExhausted, RetryPolicy)
@@ -366,10 +367,7 @@ class QueryConnection:
                 # by admission control and told us when to come back.
                 # NOT a failure — the caller's resilience layer must
                 # keep breakers closed and honor the retry-after.
-                try:
-                    retry_after = int(bytes(reply.payload) or b"100") / 1e3
-                except ValueError:
-                    retry_after = 0.1
+                retry_after = parse_retry_after(reply.payload)
                 qos = self.qos or "default"
                 STATS.incr("query.sheds")
                 STATS.incr(f"query.sheds.{qos}")

@@ -86,6 +86,18 @@ MAX_WIRE_PAYLOAD = int(os.environ.get("NNS_MAX_WIRE_PAYLOAD",
  T_SHED, T_METRICS) = 1, 2, 3, 4, 5, 6, 7, 8, 9, 10
 
 
+def parse_retry_after(payload, default_s: float = 0.1) -> float:
+    """The ``T_SHED`` payload contract in ONE place: ASCII retry-after
+    milliseconds → seconds, ``default_s`` on an empty or malformed
+    payload.  Both reply consumers (QueryConnection's request/response
+    path and the llm tier's TokenStreamClient) parse through here so
+    the wire format can never silently diverge between them."""
+    try:
+        return int(bytes(payload or b"") or b"100") / 1e3
+    except ValueError:
+        return float(default_s)
+
+
 def parse_hello_tokens(payload) -> dict:
     """Client→server T_HELLO payload grammar: ``;``-separated
     ``key=value`` tokens (``qos=gold;model=resnet``).  Grown from the

@@ -370,8 +370,47 @@ class QueryServer:
             # in-flight accounting runs on EVERY outcome — including a
             # reply for a client that disconnected mid-request — so
             # drain() converges exactly when the last admitted frame
-            # has been answered (or become unanswerable)
+            # has been answered (or become unanswerable).  STREAMING
+            # answers (the llm tier's per-token frames) mark every
+            # frame but the last with ``extra["nns_more"]``: one
+            # admitted request stays ONE in-flight unit until its final
+            # frame, so drain() waits for whole token streams, not just
+            # their first token.
+            if not buf.extra.get("nns_more"):
+                self._dec_inflight()
+
+    def shed_frame(self, extra: Dict, retry_after_s: float) -> bool:
+        """Explicit ``T_SHED`` for an ALREADY-ADMITTED frame that a
+        downstream serving stage refused — the llm tier's KV-cache slot
+        admission (``nnstreamer_tpu/llm``): queue-depth admission at the
+        wire cannot see slot exhaustion, so the element answers the
+        frame's client here with a retry-after hint instead of holding
+        the request as unbounded memory.  Settles the frame's in-flight
+        unit (a shed IS its final answer); returns False when the
+        client is already gone (its accounting still settles)."""
+        cid = extra.get("query_client_id")
+        seq = extra.get("query_seq", 0)
+        try:
+            with self._lock:
+                conn = self._clients.get(cid)
+                slock = self._send_locks.get(cid)
+            if conn is None:
+                return False
+            if slock is None:
+                slock = make_lock("query.send")   # teardown race
+            self._send_shed(conn, slock, cid, seq, retry_after_s)
+            return True
+        except OSError:
+            return False
+        finally:
             self._dec_inflight()
+
+    def client_connected(self, cid) -> bool:
+        """Is this client id still connected?  The llm tier's session
+        pruner polls it so a disconnected client's cache slot reclaims
+        promptly instead of decoding tokens nobody will read."""
+        with self._lock:
+            return cid in self._clients
 
     def _reply(self, buf: TensorBuffer) -> bool:
         cid = buf.extra.get("query_client_id")
@@ -489,6 +528,14 @@ def get_server(server_id: int, host: str = "127.0.0.1",
                 send_timeout=(DEFAULT_SEND_TIMEOUT if send_timeout is None
                               else float(send_timeout)))
         return _SERVERS[server_id]
+
+
+def peek_server(server_id: int) -> Optional[QueryServer]:
+    """Server-table read WITHOUT creation: consumers that only want an
+    existing server's state (the llm element's disconnect pruner) must
+    not conjure a default-configured server into the table."""
+    with _SERVERS_LOCK:
+        return _SERVERS.get(server_id)
 
 
 def shutdown_server(server_id: int) -> None:

@@ -665,6 +665,26 @@ class TestPerfDiff:
                    row["verdict"] == "MISSING"
                    for row in verdict["rows"])
 
+    def test_unit_direction_matches_word_tokens_not_substrings(self):
+        """Satellite fix (ISSUE 15): direction comes from the unit's
+        word tokens.  The old raw-substring match made any unit
+        CONTAINING the letters "ns" lower-is-better — "tokens_per_s"
+        inverted the gate, so a collapsed token throughput PASSED and
+        an improvement would have paged."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "perf_diff", os.path.join(TOOLS, "perf_diff.py"))
+        pd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pd)
+        assert pd.lower_is_better("ns")
+        assert pd.lower_is_better("ns/decision")
+        assert pd.lower_is_better("us/bucket")
+        assert pd.lower_is_better("pct_vs_metrics_off")
+        assert not pd.lower_is_better("tokens_per_s")
+        assert not pd.lower_is_better("sessions_per_run")
+        assert not pd.lower_is_better("fps")
+
     def test_progressive_reemits_last_row_wins(self, tmp_path):
         """bench.py re-emits the same metric row progressively enriched
         (core value first, attribution added later): the LAST line must

@@ -613,3 +613,23 @@ def test_hotpath_bench_fleet_gate():
     assert r.returncode == 0, (
         f"fleet gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
     assert '"hotpath_fleet_gate"' in r.stdout
+
+
+@pytest.mark.perf
+def test_hotpath_bench_llmdecode_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage llmdecode fails
+    when the LLM tier's batched decode step drops under 2x the
+    sequential per-session decode rate at bucket 8, or a lone session
+    inside a bucket-capacity engine pays more than 5% vs a dedicated
+    capacity-1 engine (the ISSUE 15 continuous-batching bounds: the
+    shared-step win must hold, and nobody pays for a pool they don't
+    share — a donation regression shows up here as a whole-pool copy
+    per step)."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "llmdecode"],
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, (
+        f"llmdecode gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_llmdecode_gate"' in r.stdout

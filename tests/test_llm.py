@@ -1,0 +1,786 @@
+"""LLM serving tier (nnstreamer_tpu/llm): session-keyed KV-cache pool +
+continuous-batching decode plane.
+
+The consistency contract, end-to-end: token-by-token decode THROUGH the
+``tensor_llm`` element — sessions joining and leaving a shared decode
+bucket — reproduces the full-sequence ``forward_logits`` math at every
+position (pinned against the compiled ``generate()`` scan, which the
+streamformer suite pins against ``forward_logits``).  Plus the serving
+invariants: slot admission sheds explicitly (T_SHED with retry-after,
+never unbounded memory), per-client token order is exact, mid-stream
+disconnect reclaims the slot with zero leaked pooled slabs, and the
+decode thread's prefill/decode wall-time attribution is 100 % conserved
+by construction.
+"""
+
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu import parse_launch
+from nnstreamer_tpu.analysis.verify import verify_pipeline
+from nnstreamer_tpu.llm.client import TokenStreamClient, encode_request
+from nnstreamer_tpu.llm.engine import (DecodeEngine, PhaseClock,
+                                       quantize_prompt)
+from nnstreamer_tpu.llm.pool import KVCachePool
+from nnstreamer_tpu.models.streamformer_lm import (config_from_custom,
+                                                   decode_step,
+                                                   decode_step_pooled,
+                                                   forward_logits,
+                                                   generate, init_cache,
+                                                   prefill_kv)
+from nnstreamer_tpu.parallel.train_step import (StreamFormerConfig,
+                                                init_params)
+from nnstreamer_tpu.query.overload import ShedError
+from nnstreamer_tpu.query.server import get_server, shutdown_server
+from nnstreamer_tpu.tensor.buffer import TensorBuffer, default_pool
+
+
+def _cfg(**kw):
+    base = dict(vocab=61, dim=32, heads=4, head_dim=8, mlp=64, layers=2,
+                experts=2, max_seq=48, dtype=jnp.float32)
+    base.update(kw)
+    return StreamFormerConfig(**base)
+
+
+CUSTOM = ("vocab:61,dim:32,heads:4,head_dim:8,mlp:64,layers:2,"
+          "max_seq:48,dtype:float32")
+REQ_CAPS = ("other/tensors,format=static,num_tensors=1,dimensions=24,"
+            "types=int32,framerate=0/1")
+
+
+def wait_until(cond, timeout=15.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# core math
+# ---------------------------------------------------------------------------
+
+class TestPooledDecode:
+    def test_lanes_equal_solo_decode_steps(self):
+        """Lane i of one pooled step == a solo decode_step on slot i's
+        cache — the batched serving tier's correctness spine."""
+        cfg = _cfg()
+        params = init_params(cfg, 1)
+        S = 3
+        shape = (S + 1, cfg.layers, cfg.max_seq, cfg.heads, cfg.head_dim)
+        kp = jnp.zeros(shape, cfg.dtype)
+        vp = jnp.zeros(shape, cfg.dtype)
+        toks = jnp.asarray([5, 17, 42], jnp.int32)
+        logits, kp, vp = decode_step_pooled(
+            params, kp, vp, toks, jnp.zeros(3, jnp.int32),
+            jnp.arange(3, dtype=jnp.int32), cfg)
+        for i, t in enumerate([5, 17, 42]):
+            solo, _ = decode_step(params, init_cache(cfg),
+                                  jnp.int32(t), cfg)
+            np.testing.assert_allclose(np.asarray(logits[i]),
+                                       np.asarray(solo),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_padding_lane_cannot_touch_live_slots(self):
+        """Padding lanes write the SCRATCH slot only: a partial bucket's
+        pad rows must never corrupt a resident session's cache."""
+        cfg = _cfg()
+        params = init_params(cfg, 2)
+        S = 2
+        shape = (S + 1, cfg.layers, cfg.max_seq, cfg.heads, cfg.head_dim)
+        kp = jnp.ones(shape, cfg.dtype)
+        vp = jnp.ones(shape, cfg.dtype)
+        # lane 0 live (slot 0), lane 1 = padding pointed at scratch (2)
+        _, kp2, _ = decode_step_pooled(
+            params, kp, vp, jnp.asarray([3, 0], jnp.int32),
+            jnp.asarray([0, 0], jnp.int32),
+            jnp.asarray([0, 2], jnp.int32), cfg)
+        # slot 1 (untouched live slot) is bit-identical
+        np.testing.assert_array_equal(np.asarray(kp2[1]),
+                                      np.asarray(kp[1]))
+
+    def test_teacher_forced_pooled_decode_matches_full_forward(self):
+        """The consistency contract at the math layer: stepping a fixed
+        token sequence through the pooled cache reproduces
+        forward_logits at EVERY position."""
+        cfg = _cfg()
+        params = init_params(cfg, 3)
+        toks = np.random.default_rng(0).integers(0, 61, 14)
+        full = np.asarray(forward_logits(
+            params, jnp.asarray(toks, jnp.int32), cfg, flash=False))
+        shape = (2, cfg.layers, cfg.max_seq, cfg.heads, cfg.head_dim)
+        kp = jnp.zeros(shape, cfg.dtype)
+        vp = jnp.zeros(shape, cfg.dtype)
+        for i, t in enumerate(toks):
+            logits, kp, vp = decode_step_pooled(
+                params, kp, vp, jnp.asarray([t], jnp.int32),
+                jnp.asarray([i], jnp.int32),
+                jnp.asarray([0], jnp.int32), cfg)
+            np.testing.assert_allclose(np.asarray(logits[0]), full[i],
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_prefill_kv_matches_decode_scan(self):
+        """prefill_kv's logits == forward_logits; its K/V == what a
+        decode_step scan over the prompt would have cached."""
+        cfg = _cfg()
+        params = init_params(cfg, 4)
+        toks = jnp.asarray(
+            np.random.default_rng(1).integers(0, 61, 11), jnp.int32)
+        full = forward_logits(params, toks, cfg, flash=False)
+        logits, ks, vs = prefill_kv(params, toks, cfg, flash=False)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                                   atol=1e-4, rtol=1e-4)
+        cache = init_cache(cfg)
+        for t in toks:
+            _, cache = decode_step(params, cache, t, cfg)
+        np.testing.assert_allclose(np.asarray(ks),
+                                   np.asarray(cache["k"][:, :11]),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(vs),
+                                   np.asarray(cache["v"][:, :11]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+class TestCustomGrammar:
+    def test_width_alias_and_max_seq(self):
+        cfg = config_from_custom({"width": "64", "layers": "3",
+                                  "heads": "2", "head_dim": "8",
+                                  "max_seq": "128"})
+        assert (cfg.dim, cfg.layers, cfg.heads, cfg.max_seq) \
+            == (64, 3, 2, 128)
+
+    def test_conflicting_aliases_rejected(self):
+        with pytest.raises(ValueError, match="alias"):
+            config_from_custom({"dim": "64", "width": "128"})
+
+    def test_max_seq_must_hold_window(self):
+        with pytest.raises(ValueError, match="max_seq"):
+            config_from_custom({"seq": "128", "max_seq": "64"})
+
+    def test_sizes_validated(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            config_from_custom({"layers": "0"})
+
+    def test_quantize_prompt_bounded(self):
+        assert quantize_prompt(1, 1024) == 8
+        assert quantize_prompt(8, 1024) == 8
+        assert quantize_prompt(9, 1024) == 16
+        assert quantize_prompt(900, 1024) == 1024
+        assert quantize_prompt(40, 48) == 48   # capped at max_seq
+
+
+# ---------------------------------------------------------------------------
+# pool
+# ---------------------------------------------------------------------------
+
+class TestKVCachePool:
+    def _pool(self, slots=4, clock=None):
+        return KVCachePool(_cfg(), slots, clock=clock)
+
+    def test_acquire_release_cycle(self):
+        pool = self._pool(2)
+        a = pool.acquire("a")
+        b = pool.acquire("b")
+        assert {a.slot, b.slot} == {0, 1}
+        assert pool.live == 2 and pool.occupancy == 1.0
+        assert pool.admit("gold") is not None   # hard boundary
+        pool.release("a")
+        assert pool.admit("gold") is None
+        c = pool.acquire("c")
+        assert c.slot == a.slot                  # slot recycled
+
+    def test_duplicate_key_rejected(self):
+        pool = self._pool(2)
+        pool.acquire("a")
+        with pytest.raises(ValueError, match="already live"):
+            pool.acquire("a")
+
+    def test_qos_watermarks_shed_bronze_before_full(self):
+        """Bronze sessions shed at 80 % slot occupancy (hysteretic),
+        gold only at the hard no-free-slot boundary."""
+        pool = self._pool(10)
+        for i in range(8):
+            pool.acquire(i)
+        assert pool.admit("bronze") is not None   # armed at 0.8
+        assert pool.admit("gold") is None
+        # hysteresis: dropping just under the arm point stays armed
+        pool.release(7)
+        assert pool.admit("bronze") is not None
+        for i in range(7):
+            pool.release(i)
+        assert pool.admit("bronze") is None       # disarmed at half
+
+    def test_no_slot_hint_passthrough(self):
+        pool = self._pool(1)
+        pool.acquire("a", qos="gold")
+        assert pool.admit("gold", no_slot_retry_s=1.5) \
+            == pytest.approx(1.5)
+
+    def test_aged_keys_injected_clock(self):
+        now = [100.0]
+        pool = self._pool(4, clock=lambda: now[0])
+        pool.acquire("old")
+        now[0] = 103.0
+        pool.acquire("young")
+        assert pool.aged_keys(5.0) == []
+        now[0] = 106.0
+        assert pool.aged_keys(5.0) == ["old"]
+        assert pool.aged_keys(0.0) == []          # disabled
+
+    def test_cache_bytes_constant(self):
+        pool = self._pool(3)
+        before = pool.cache_bytes()
+        for i in range(3):
+            pool.acquire(i)
+        assert pool.cache_bytes() == before
+        cfg = pool.cfg
+        want = (4 * cfg.layers * cfg.max_seq * cfg.heads * cfg.head_dim
+                * np.dtype(np.float32).itemsize * 2)
+        assert before == want
+
+    def test_lru_key(self):
+        now = [0.0]
+        pool = self._pool(3, clock=lambda: now[0])
+        pool.acquire("a")
+        now[0] = 1.0
+        pool.acquire("b")
+        now[0] = 2.0
+        pool.touch("a")
+        assert pool.lru_key() == "b"
+
+
+class TestPhaseClock:
+    def test_conservation_identity(self):
+        ms = 1_000_000                     # ns per ms
+        now = [0]
+        clk = PhaseClock(clock_ns=lambda: now[0])
+        now[0] = 10 * ms
+        clk.enter("admit")
+        now[0] = 30 * ms
+        prev = clk.enter("prefill")
+        assert prev == "admit"
+        now[0] = 70 * ms
+        clk.enter(prev)
+        now[0] = 100 * ms
+        rep = clk.report()
+        assert rep["conserved_pct"] == 100.0
+        s = rep["states_s"]
+        assert s["idle"] == pytest.approx(0.010)
+        assert s["admit"] == pytest.approx(0.020 + 0.030)
+        assert s["prefill"] == pytest.approx(0.040)
+
+
+class TestEngine:
+    def test_bounded_executables_across_fills(self):
+        """Sequences joining/leaving between steps never recompile:
+        after warmup, every fill level hits a warm padded executable."""
+        cfg = _cfg()
+        params = init_params(cfg, 5)
+        pool = KVCachePool(cfg, 8)
+        eng = DecodeEngine(params, cfg, pool, capacity=8)
+        eng.warmup()
+        compiled = eng.compiles
+        sessions = [pool.acquire(i) for i in range(5)]
+        for s in sessions:
+            s.max_new = 4
+            s.next_token = s.key + 1
+        for fill in (5, 3, 1, 4, 2):
+            eng.step(sessions[:fill])
+        assert eng.compiles == compiled
+        assert eng.steps_total == 5
+
+    def test_retry_after_hint_tracks_soonest_finisher(self):
+        cfg = _cfg()
+        params = init_params(cfg, 5)
+        pool = KVCachePool(cfg, 2)
+        eng = DecodeEngine(params, cfg, pool, capacity=2)
+        a = pool.acquire("a")
+        a.max_new, a.emitted = 10, 8
+        b = pool.acquire("b")
+        b.max_new, b.emitted = 30, 0
+        eng.ewma_step_s = 0.1
+        assert eng.retry_after_hint() == pytest.approx(0.2)
+
+
+# ---------------------------------------------------------------------------
+# element: the consistency contract END TO END
+# ---------------------------------------------------------------------------
+
+def build_local(extra_props="", custom=CUSTOM, caps=REQ_CAPS):
+    p = parse_launch(
+        f"appsrc name=src caps={caps} ! "
+        f"tensor_llm name=llm custom={custom} seed=0 {extra_props} ! "
+        "tensor_sink name=out")
+    by_key = {}
+    order = []
+
+    def on_data(b):
+        key = b.extra.get("tag")
+        tok = int(np.asarray(b.tensors[0]).reshape(-1)[0])
+        by_key.setdefault(key, []).append((b.pts, tok, b.extra))
+        order.append(key)
+    p.get("out").connect("new-data", on_data)
+    return p, by_key, order
+
+
+class TestElementLocal:
+    def test_sessions_share_bucket_and_match_generate(self):
+        """THE contract: sessions joining/leaving a shared decode
+        bucket token-by-token THROUGH the element reproduce the
+        compiled generate() scan (itself pinned against forward_logits
+        at every position)."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 61, 4 + 2 * i).astype(np.int32)
+                   for i in range(3)]
+        lens = [7, 4, 9]   # heterogeneous: sessions LEAVE at different
+        #                    steps while others continue
+        refs = [generate(params, cfg, pr, n).tolist()
+                for pr, n in zip(prompts, lens)]
+        p, by_key, _ = build_local("slots=4 batch=4")
+        p.play()
+        for i, (pr, n) in enumerate(zip(prompts, lens)):
+            buf = TensorBuffer(tensors=[encode_request(
+                pr, max_new=n, frame_len=24)])
+            buf.extra["tag"] = i
+            p.get("src").push_buffer(buf)
+        p.get("src").end_of_stream()
+        p.wait(timeout=180)
+        p.stop()
+        for i in range(3):
+            toks = [t for _, t, _ in by_key[i]]
+            pts = [q for q, _, _ in by_key[i]]
+            assert pts == list(range(lens[i]))      # exact order
+            assert toks == refs[i], (i, toks, refs[i])
+            # streaming markers: every frame but the last carries
+            # nns_more
+            mores = [bool(e.get("nns_more")) for _, _, e in by_key[i]]
+            assert mores == [True] * (lens[i] - 1) + [False]
+
+    def test_stop_token_ends_stream_early(self):
+        """The stream ends AT the first stop-token frame (delivered,
+        then the slot releases)."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        prompt = np.asarray([3, 1, 4], np.int32)
+        ref = generate(params, cfg, prompt, 12).tolist()
+        stop = ref[4]   # a token generate() emits mid-stream
+        want = ref[:5]
+        p, by_key, _ = build_local("slots=2 batch=2")
+        p.play()
+        buf = TensorBuffer(tensors=[encode_request(
+            prompt, max_new=12, stop_token=stop, frame_len=24)])
+        buf.extra["tag"] = 0
+        p.get("src").push_buffer(buf)
+        p.get("src").end_of_stream()
+        p.wait(timeout=120)
+        p.stop()
+        assert [t for _, t, _ in by_key[0]] == want
+
+    def test_overlength_prompt_refused_terminally(self):
+        """prompt + max_new > max_seq can never succeed: one terminal
+        stop-token frame, no shed, no session."""
+        p, by_key, _ = build_local("slots=2 batch=2")
+        p.play()
+        buf = TensorBuffer(tensors=[encode_request(
+            np.arange(20, dtype=np.int32), max_new=40, stop_token=9,
+            frame_len=24)])
+        buf.extra["tag"] = 0
+        p.get("src").push_buffer(buf)
+        p.get("src").end_of_stream()
+        p.wait(timeout=60)
+        llm = p.get("llm")
+        assert llm.rejected_total == 1
+        assert llm.sessions_total == 0
+        p.stop()
+        assert [t for _, t, _ in by_key[0]] == [9]
+
+    def test_standalone_slot_shed_is_tagged(self):
+        """No server table: a slot shed still yields an explicit,
+        final, tagged answer (never a silent drop)."""
+        p, by_key, _ = build_local("slots=1 batch=1")
+        p.play()
+        for i in range(2):
+            buf = TensorBuffer(tensors=[encode_request(
+                np.asarray([1, 2], np.int32), max_new=25,
+                stop_token=-1, frame_len=24)])
+            buf.extra["tag"] = i
+            p.get("src").push_buffer(buf)
+        p.get("src").end_of_stream()
+        p.wait(timeout=120)
+        llm = p.get("llm")
+        p.stop()
+        assert llm.shed_total == 1
+        shed_frames = [e for frames in by_key.values()
+                       for _, _, e in frames if "nns_llm_shed" in e]
+        assert len(shed_frames) == 1
+        # the admitted session still streamed fully
+        full = [k for k, frames in by_key.items() if len(frames) == 25]
+        assert len(full) == 1
+
+    def test_phase_attribution_conserved(self):
+        p, by_key, _ = build_local("slots=2 batch=2")
+        p.play()
+        buf = TensorBuffer(tensors=[encode_request(
+            np.asarray([5, 6, 7], np.int32), max_new=8, frame_len=24)])
+        buf.extra["tag"] = 0
+        p.get("src").push_buffer(buf)
+        p.get("src").end_of_stream()
+        p.wait(timeout=60)
+        report = p.get("llm").engine.report()
+        p.stop()
+        phases = report["phases"]
+        assert phases["conserved_pct"] == pytest.approx(100.0, abs=0.1)
+        assert phases["states_s"]["prefill"] > 0
+        assert phases["states_s"]["decode"] > 0
+        assert report["tokens"] == 8
+
+
+# ---------------------------------------------------------------------------
+# element over the query wire
+# ---------------------------------------------------------------------------
+
+SID = 4510
+
+#: long-cache sizing for the tests that need a stream still RUNNING
+#: while something else happens (sheds, disconnects): hundreds of
+#: decode steps of wall-clock window
+CUSTOM_LONG = ("vocab:61,dim:32,heads:4,head_dim:8,mlp:64,layers:2,"
+               "max_seq:2048,dtype:float32")
+
+
+def build_server(extra="slots=4 batch=4", sid=SID, src_extra="",
+                 custom=CUSTOM):
+    p = parse_launch(
+        f"tensor_query_serversrc name=qsrc id={sid} port=0 {src_extra} "
+        f"caps={REQ_CAPS} ! "
+        f"tensor_llm name=llm custom={custom} seed=0 {extra} id={sid} ! "
+        f"tensor_query_serversink id={sid}")
+    p.play()
+    return p, p.get("qsrc").bound_port
+
+
+class TestElementWire:
+    def teardown_method(self):
+        shutdown_server(SID)
+
+    def test_multi_client_streams_exact_order_and_content(self):
+        """Concurrent clients with heterogeneous prompt/output lengths:
+        every stream arrives complete, in exact order (pts 0,1,2,… —
+        TokenStreamClient raises on any violation), token-identical to
+        the reference scan."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        p, port = build_server()
+        rng = np.random.default_rng(3)
+        jobs = [(rng.integers(0, 61, 3 + i).astype(np.int32), 4 + 2 * i)
+                for i in range(4)]
+        refs = [generate(params, cfg, pr, n).tolist() for pr, n in jobs]
+        results = {}
+
+        def run(i):
+            cli = TokenStreamClient("127.0.0.1", port,
+                                    timeout=60.0).connect()
+            try:
+                pr, n = jobs[i]
+                results[i] = cli.generate(pr, n, frame_len=24)
+            except Exception as exc:  # noqa: BLE001 — asserted below
+                results[i] = repr(exc)
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        srv = get_server(SID)
+        assert wait_until(lambda: srv._inflight == 0, timeout=10)
+        p.stop()
+        for i in range(4):
+            assert results[i] == refs[i], (i, results[i])
+        gc.collect()
+        assert default_pool().stats["pending"] == 0
+
+    def test_slot_exhaustion_sheds_explicitly(self):
+        """slots=1: a second concurrent stream gets an explicit T_SHED
+        with a retry-after — never queued as unbounded memory."""
+        p, port = build_server("slots=1 batch=1 max-new-tokens=1500",
+                               sid=SID, custom=CUSTOM_LONG)
+        a = TokenStreamClient("127.0.0.1", port, timeout=60.0).connect()
+        b = TokenStreamClient("127.0.0.1", port, timeout=20.0).connect()
+        stream = a.stream(np.asarray([1, 2, 3], np.int32), 1200,
+                          frame_len=24)
+        next(stream)                      # session A is resident
+        llm = p.get("llm")
+        assert wait_until(lambda: llm.pool.live == 1, timeout=10)
+        with pytest.raises(ShedError) as err:
+            b.generate(np.asarray([4], np.int32), 5, frame_len=24)
+        assert err.value.retry_after_s > 0
+        assert llm.shed_total >= 1
+        a.close()                          # disconnect mid-stream
+        b.close()
+        assert wait_until(lambda: llm.pool.live == 0, timeout=15)
+        assert llm.evicted_total >= 1      # slot reclaimed
+        srv = get_server(SID)
+        assert wait_until(lambda: srv._inflight == 0, timeout=10)
+        p.stop()
+        gc.collect()
+        assert default_pool().stats["pending"] == 0
+
+    def test_disconnect_mid_stream_reclaims_slot_no_leaks(self):
+        """A client vanishing mid-stream: its session evicts, the slot
+        frees for the next session, peers are unaffected, ZERO pooled
+        slabs leak."""
+        cfg = _cfg(max_seq=2048)
+        params = init_params(cfg, 0)
+        p, port = build_server("slots=2 batch=2 max-new-tokens=1500",
+                               custom=CUSTOM_LONG)
+        llm = p.get("llm")
+        doomed = TokenStreamClient("127.0.0.1", port,
+                                   timeout=60.0).connect()
+        stream = doomed.stream(np.asarray([9, 9], np.int32), 1200,
+                               frame_len=24)
+        for _ in range(3):
+            next(stream)
+        doomed.close()                     # vanish mid-stream
+        assert wait_until(lambda: llm.pool.live == 0, timeout=15)
+        assert llm.evicted_total == 1
+        # the pool is whole again: a fresh session serves correctly
+        pr = np.asarray([2, 4, 6], np.int32)
+        ref = generate(params, cfg, pr, 6).tolist()
+        survivor = TokenStreamClient("127.0.0.1", port,
+                                     timeout=60.0).connect()
+        assert survivor.generate(pr, 6, frame_len=24) == ref
+        survivor.close()
+        srv = get_server(SID)
+        assert wait_until(lambda: srv._inflight == 0, timeout=10)
+        p.stop()
+        shutdown_server(SID)
+        gc.collect()
+        assert default_pool().stats["pending"] == 0
+
+    def test_duplicate_wire_seq_cannot_error_the_pipeline(self):
+        """A client REUSING a wire seq while its first stream is
+        resident (hostile or buggy — query_seq is client-controlled)
+        must not collide session keys and error the pipeline every
+        other client shares (code-review finding: pool.acquire's
+        duplicate-key ValueError reached the decode loop's
+        post_error)."""
+        import socket as _socket
+
+        from nnstreamer_tpu.query.protocol import (T_DATA,
+                                                   send_tensors)
+
+        cfg = _cfg(max_seq=2048)
+        params = init_params(cfg, 0)
+        p, port = build_server("slots=4 batch=4 max-new-tokens=1500",
+                               custom=CUSTOM_LONG)
+        sock = _socket.create_connection(("127.0.0.1", port),
+                                         timeout=10)
+        req = encode_request(np.asarray([1, 2], np.int32), 1200,
+                             frame_len=24)
+        # two requests, SAME seq, pipelined on one connection
+        send_tensors(sock, T_DATA, TensorBuffer(tensors=[req]), seq=7)
+        send_tensors(sock, T_DATA, TensorBuffer(tensors=[req]), seq=7)
+        llm = p.get("llm")
+        assert wait_until(lambda: llm.pool.live == 2, timeout=15)
+        assert p._error is None if hasattr(p, "_error") else True
+        # an unrelated client still serves correctly end to end
+        ref = generate(params, cfg, np.asarray([3, 4], np.int32),
+                       5).tolist()
+        cli = TokenStreamClient("127.0.0.1", port,
+                                timeout=60.0).connect()
+        assert cli.generate(np.asarray([3, 4], np.int32), 5,
+                            frame_len=24) == ref
+        cli.close()
+        sock.close()
+        assert wait_until(lambda: llm.pool.live == 0, timeout=15)
+        p.stop()
+
+    def test_overcap_request_ends_with_terminal_marker(self):
+        """A request asking MORE than the server's max-new-tokens cap
+        is truncated — and the stream says so: cap tokens plus one
+        explicit terminal marker frame, never a silent clamp the
+        client (counting toward ITS ask) would wait out as a timeout
+        (code-review finding)."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        p, port = build_server("slots=2 batch=2 max-new-tokens=6")
+        pr = np.asarray([4, 5], np.int32)
+        ref = generate(params, cfg, pr, 6).tolist()
+        cli = TokenStreamClient("127.0.0.1", port, timeout=30.0).connect()
+        t0 = time.monotonic()
+        toks = cli.generate(pr, 30, frame_len=24)   # asks 30, cap 6
+        assert time.monotonic() - t0 < 15.0
+        assert toks == ref + [-1]       # 6 real tokens + the marker
+        cli.close()
+        p.stop()
+
+    def test_refusal_is_terminal_without_stop_token(self):
+        """An over-length request from a client with NO stop token set
+        must end the stream immediately (negative tokens are
+        unconditionally terminal), not hang until the per-token
+        timeout (code-review finding)."""
+        p, port = build_server("slots=2 batch=2")
+        cli = TokenStreamClient("127.0.0.1", port, timeout=60.0).connect()
+        t0 = time.monotonic()
+        toks = cli.generate(np.arange(20, dtype=np.int32), 40,
+                            stop_token=-1, frame_len=24)
+        assert toks == [-1]                 # one terminal marker frame
+        assert time.monotonic() - t0 < 10.0
+        cli.close()
+        p.stop()
+
+    def test_drain_finishes_streams_and_sheds_new(self):
+        """Pipeline.drain: resident streams complete, a late request
+        sheds with a drain-sized retry-after."""
+        p, port = build_server("slots=2 batch=2")
+        cli = TokenStreamClient("127.0.0.1", port, timeout=60.0).connect()
+        stream = cli.stream(np.asarray([1, 2], np.int32), 30,
+                            frame_len=24)
+        got = [next(stream)]
+        done = threading.Event()
+
+        def _drain():
+            p.drain(deadline=30.0)
+            done.set()
+
+        threading.Thread(target=_drain, daemon=True).start()
+        llm = p.get("llm")
+        assert wait_until(lambda: llm.pool.admission.draining,
+                          timeout=10)
+        got.extend(stream)                 # the stream COMPLETES
+        assert len(got) == 30
+        assert done.wait(timeout=30)
+        cli.close()
+        p.stop()
+
+
+# ---------------------------------------------------------------------------
+# verifier rules
+# ---------------------------------------------------------------------------
+
+class TestVerifyRules:
+    def _findings(self, llm_props, custom=CUSTOM):
+        p = parse_launch(
+            f"appsrc name=src caps={REQ_CAPS} ! "
+            f"tensor_llm name=llm custom={custom} {llm_props} ! "
+            "fakesink")
+        return verify_pipeline(p)
+
+    def _rules(self, findings):
+        return {f.rule for f in findings}
+
+    def test_slots_lt_batch_is_named_error(self):
+        fs = self._findings("slots=2 batch=8")
+        hit = [f for f in fs if f.rule == "llm-slots-lt-batch"]
+        assert hit and hit[0].severity == "error"
+        assert "llm" in hit[0].path
+
+    def test_no_max_seq_is_named_error(self):
+        fs = self._findings(
+            "slots=4 batch=2",
+            custom="vocab:61,dim:32,heads:4,head_dim:8,layers:2")
+        hit = [f for f in fs if f.rule == "llm-no-max-seq"]
+        assert hit and hit[0].severity == "error"
+
+    def test_prefill_step_warns_decode_without_prefill(self):
+        fs = self._findings("slots=4 batch=2 prefill=step")
+        hit = [f for f in fs if f.rule == "llm-decode-without-prefill"]
+        assert hit and hit[0].severity == "warning"
+
+    def test_clean_config_has_no_llm_findings(self):
+        fs = self._findings("slots=4 batch=2")
+        assert not [f for f in fs if f.rule.startswith("llm-")]
+
+    def test_preflight_rejects_bad_config_at_play(self):
+        from nnstreamer_tpu.pipeline.graph import VerifyError
+
+        p = parse_launch(
+            f"appsrc name=src caps={REQ_CAPS} ! "
+            f"tensor_llm name=llm custom={CUSTOM} slots=2 batch=8 ! "
+            "fakesink")
+        with pytest.raises(VerifyError, match="llm-slots-lt-batch"):
+            p.play()
+
+
+# ---------------------------------------------------------------------------
+# pinned perf_diff gate on the committed acceptance artifact
+# ---------------------------------------------------------------------------
+
+class TestPerfDiffPinned:
+    """The committed SOAK_llm_r15.json rows pin the perf_diff gate: an
+    eroded continuous-batching win FAILS and the attribution delta
+    names the regressed stage (the test_xbatch.py discipline)."""
+
+    def _load(self):
+        import importlib.util
+        import json
+        import os
+
+        root = os.path.join(os.path.dirname(__file__), "..")
+        spec = importlib.util.spec_from_file_location(
+            "perf_diff", os.path.join(root, "tools", "perf_diff.py"))
+        pd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pd)
+        with open(os.path.join(root, "SOAK_llm_r15.json"),
+                  encoding="utf-8") as fh:
+            rows = json.load(fh)["rows"]
+        return pd, rows
+
+    def test_committed_rows_self_pass(self):
+        pd, rows = self._load()
+        verdict = pd.diff([rows, rows], rows, margin_pct=10.0)
+        assert verdict["pass"], verdict
+
+    def test_eroded_win_regresses_and_names_stage(self):
+        import copy
+
+        pd, rows = self._load()
+        eroded = copy.deepcopy(rows)
+        for row in eroded:
+            if row["metric"] == "soak_llm_tokens_per_s":
+                row["value"] *= 0.4          # batching win collapsed
+                states = row.setdefault("attribution", {}).setdefault(
+                    "states", {})
+                # e.g. a donation regression: per-step pool copies land
+                # as decode share while tokens/s falls
+                states["decode"] = states.get("decode", 0.0) + 25.0
+        verdict = pd.diff([rows, rows], eroded, margin_pct=10.0)
+        assert not verdict["pass"]
+        reg = [r for r in verdict["regressions"]
+               if r["metric"] == "soak_llm_tokens_per_s"]
+        assert reg, verdict["regressions"]
+        blame = reg[0].get("attribution")
+        assert blame and blame["regressed_stage"] == "decode"
+
+    def test_committed_artifact_gates_hold(self):
+        """The committed artifact itself must BE a pass with every
+        acceptance box checked — committing a FAIL (or a gutted
+        verdict) turns tier-1 red here."""
+        import json
+        import os
+
+        root = os.path.join(os.path.dirname(__file__), "..")
+        with open(os.path.join(root, "SOAK_llm_r15.json"),
+                  encoding="utf-8") as fh:
+            v = json.load(fh)
+        assert v["pass"] and v["verdict"] == "PASS"
+        checks = v["llm"]["checks"]
+        for name in ("zero_errors", "exact_order", "sheds_explicit",
+                     "cache_bounded", "batched_2x_solo",
+                     "consistency_under_batching",
+                     "attribution_conserved", "disconnects_reclaimed"):
+            assert checks.get(name) is True, (name, checks)
+        assert v["llm"]["speedup_vs_solo"] >= 2.0
+        assert v["attribution"]["conserved_pct"] == 100.0

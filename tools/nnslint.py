@@ -116,6 +116,7 @@ _BOUNDED_QUEUE_DIRS = (
     os.path.join("nnstreamer_tpu", "query") + os.sep,
     os.path.join("nnstreamer_tpu", "pipeline") + os.sep,
     os.path.join("nnstreamer_tpu", "fleet") + os.sep,
+    os.path.join("nnstreamer_tpu", "llm") + os.sep,
 )
 
 #: method names that are per-buffer dataflow paths for wallclock-in-chain

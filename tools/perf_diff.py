@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -89,8 +90,14 @@ def load_rows(path: str) -> List[Dict[str, Any]]:
 
 
 def lower_is_better(unit: str) -> bool:
-    unit = (unit or "").lower()
-    return any(u in unit for u in _LOWER_BETTER)
+    """Direction from the unit's WORD tokens, not raw substrings: a
+    bare ``in`` made every unit containing the letters "ns" (e.g.
+    ``tokens_per_s``) silently lower-is-better — which would let a
+    collapsed throughput metric PASS the gate (and page on an
+    improvement).  ``p99_us``/``latency_ms``/``alloc_bytes`` still
+    match on their token."""
+    tokens = re.split(r"[^a-z]+", (unit or "").lower())
+    return any(t in _LOWER_BETTER for t in tokens if t)
 
 
 def _attribution_delta(base_rows: List[Dict[str, Any]],

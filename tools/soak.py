@@ -888,6 +888,321 @@ def run_xbatch(args, ap) -> int:
 
 
 
+LLM_SERVER_ID = 95
+
+#: the --llm soak's decoder sizing (registry custom= grammar,
+#: models/streamformer_lm.config_from_custom — the ISSUE 15 satellite:
+#: the soak server sizes a realistically heavy decoder from config
+#: alone).  4 layers x d256/mlp1024 with a 512 vocab head: sequential
+#: decode is a ~5 ms GEMV chain on the 2-core CPU host, so the batched
+#: step's GEMM + single-dispatch economics are what the 2x gate
+#: measures.  max_seq 512 bounds one slot's cache at
+#: 4x512x8x32x4Bx2 = 2.1 MB; 12 slots + scratch = ~27 MB, FIXED.
+LLM_CUSTOM = ("vocab:512,dim:256,heads:8,head_dim:32,mlp:1024,"
+              "layers:4,max_seq:512,dtype:float32")
+LLM_REQ_CAP = 96      # request frame length: header 3 + prompt <= 93
+LLM_CAPS = (f"other/tensors,format=static,num_tensors=1,"
+            f"dimensions={LLM_REQ_CAP},types=int32,framerate=0/1")
+
+
+def llm_server_line(slots: int, batch: int,
+                    sid: int = LLM_SERVER_ID) -> str:
+    return (f"tensor_query_serversrc name=qsrc id={sid} port=0 "
+            f"caps={LLM_CAPS} ! "
+            f"tensor_llm name=llm custom={LLM_CUSTOM} seed=0 "
+            f"slots={slots} batch={batch} id={sid} "
+            f"max-new-tokens=96 ! "
+            f"tensor_query_serversink id={sid}")
+
+
+def run_llm(args, ap) -> int:
+    """Token-streaming LLM serving acceptance soak (ISSUE 15): a
+    multi-client soak against the ``tensor_llm`` continuous-batching
+    serving pipeline, clients with wildly different prompt/output
+    lengths joining and leaving continuously.  Gates:
+
+    - **zero client errors** and **exact per-client token order**
+      (TokenStreamClient raises on any pts gap — an order violation IS
+      an error);
+    - **explicit overload**: every refused session is a counted
+      T_SHED with retry-after (clients honor it and retry), server and
+      client shed counts agree;
+    - **bounded cache memory**: the pooled cache's device bytes are
+      IDENTICAL before and after the soak (static by construction) and
+      zero pooled wire slabs leak;
+    - **continuous batching pays**: aggregate soak tokens/s >= 2x the
+      one-session-at-a-time baseline measured on the same server;
+    - **consistency under batching**: a probe prompt replayed
+      mid-soak (different bucket compositions) yields byte-identical
+      token streams;
+    - **conserved attribution**: the decode thread's prefill/decode/
+      idle wall-time attribution sums to 100% exactly (PhaseClock
+      identity), recorded in the verdict the way PR 8 profiles are.
+    """
+    import threading as _threading
+    import time as _time
+
+    import numpy as np
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.llm.client import TokenStreamClient
+    from nnstreamer_tpu.query.overload import ShedError
+    from nnstreamer_tpu.query.server import get_server, shutdown_server
+    from nnstreamer_tpu.tensor.buffer import default_pool
+
+    os.makedirs(args.out, exist_ok=True)
+    slots, batch = args.llm_slots, args.llm_batch
+    clients = args.clients or 16
+    duration = args.duration
+    pipeline = parse_launch(llm_server_line(slots, batch))
+    pipeline.play()
+    port = pipeline.get("qsrc").bound_port
+    llm = pipeline.get("llm")
+    cache_bytes_start = llm.pool.cache_bytes()
+
+    probe_prompt = np.arange(7, dtype=np.int32) % 512
+    probe_new = 24
+
+    def one_session(cli, rng, counters):
+        plen = int(rng.integers(4, 64))
+        n_new = int(rng.integers(8, 72))
+        prompt = rng.integers(0, 512, plen).astype(np.int32)
+        while True:
+            try:
+                toks = cli.generate(prompt, n_new,
+                                    frame_len=LLM_REQ_CAP)
+                counters["tokens"] += len(toks)
+                counters["sessions"] += 1
+                return
+            except ShedError as exc:
+                counters["sheds"] += 1
+                _time.sleep(min(exc.retry_after_s, 1.0))
+
+    # 1. solo baseline: ONE client, sessions back to back — the
+    # one-session-at-a-time decode rate the batched soak must beat 2x
+    solo = {"tokens": 0, "sessions": 0, "sheds": 0}
+    cli = TokenStreamClient("127.0.0.1", port, timeout=60.0).connect()
+    rng = np.random.default_rng(args.seed)
+    one_session(cli, rng, solo)            # warm (prefill compiles)
+    solo = {"tokens": 0, "sessions": 0, "sheds": 0}
+    t0 = _time.monotonic()
+    while _time.monotonic() - t0 < max(8.0, duration / 5):
+        one_session(cli, rng, solo)
+    solo_s = _time.monotonic() - t0
+    cli.close()
+    solo_tok_s = solo["tokens"] / solo_s
+
+    # 2. the soak: clients join and leave continuously (half reconnect
+    # per session — connection churn exercises disconnect pruning on
+    # top of clean completions)
+    stop = _threading.Event()
+    stats = []
+    errors = []
+
+    def client_loop(i):
+        counters = {"tokens": 0, "sessions": 0, "sheds": 0}
+        stats.append(counters)
+        rng = np.random.default_rng(1000 + args.seed + i)
+        reconnect = i % 2 == 0
+        cli = None
+        try:
+            cli = TokenStreamClient("127.0.0.1", port,
+                                    timeout=120.0).connect()
+            while not stop.is_set():
+                one_session(cli, rng, counters)
+                if reconnect and not stop.is_set():
+                    cli.close()
+                    _time.sleep(float(rng.uniform(0, 0.05)))
+                    cli = TokenStreamClient(
+                        "127.0.0.1", port, timeout=120.0).connect()
+        except Exception as exc:  # noqa: BLE001 — the zero-errors gate
+            if not stop.is_set():
+                errors.append(f"client {i}: {exc!r}")
+        finally:
+            if cli is not None:
+                cli.close()
+
+    def abandoner_loop(i):
+        """Mid-stream disconnector: starts a long stream, reads a few
+        tokens, vanishes.  The element's disconnect pruner must
+        reclaim the slot (evicted counter) with zero leaked slabs —
+        abandonment is designed behavior, never an error."""
+        counters = {"tokens": 0, "sessions": 0, "sheds": 0}
+        stats.append(counters)
+        rng = np.random.default_rng(5000 + args.seed + i)
+        while not stop.is_set():
+            cli = None
+            try:
+                cli = TokenStreamClient("127.0.0.1", port,
+                                        timeout=120.0).connect()
+                prompt = rng.integers(0, 512, 8).astype(np.int32)
+                stream = cli.stream(prompt, 80, frame_len=LLM_REQ_CAP)
+                for _ in range(int(rng.integers(2, 6))):
+                    next(stream)
+            except ShedError:
+                counters["sheds"] += 1
+            except StopIteration:
+                pass
+            except Exception as exc:  # noqa: BLE001
+                if not stop.is_set():
+                    errors.append(f"abandoner {i}: {exc!r}")
+            finally:
+                if cli is not None:
+                    cli.close()          # vanish mid-stream
+            stop.wait(float(rng.uniform(0.3, 0.8)))
+
+    def probe_loop():
+        """Mid-soak consistency probe: the SAME prompt replayed under
+        different bucket compositions must stream identical tokens."""
+        runs = []
+        counters = {"tokens": 0, "sessions": 0, "sheds": 0}
+        stats.append(counters)
+        try:
+            cli = TokenStreamClient("127.0.0.1", port,
+                                    timeout=120.0).connect()
+            for _ in range(2):
+                _time.sleep(duration / 4)
+                while True:
+                    try:
+                        runs.append(cli.generate(
+                            probe_prompt, probe_new,
+                            frame_len=LLM_REQ_CAP))
+                        break
+                    except ShedError as exc:
+                        counters["sheds"] += 1
+                        _time.sleep(min(exc.retry_after_s, 1.0))
+            cli.close()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(f"probe: {exc!r}")
+        probe_results.extend(runs)
+
+    probe_results = []
+    threads = [_threading.Thread(target=client_loop, args=(i,),
+                                 daemon=True) for i in range(clients)]
+    threads.extend(_threading.Thread(target=abandoner_loop, args=(i,),
+                                     daemon=True) for i in range(2))
+    threads.append(_threading.Thread(target=probe_loop, daemon=True))
+    t0 = _time.monotonic()
+    for t in threads:
+        t.start()
+    stop.wait(duration)
+    stop.set()
+    for t in threads:
+        t.join(timeout=180)
+    soak_s = _time.monotonic() - t0
+
+    srv = get_server(LLM_SERVER_ID)
+    deadline = _time.monotonic() + 30
+    while srv._inflight > 0 and _time.monotonic() < deadline:
+        _time.sleep(0.1)
+    engine_report = llm.engine.report()
+    cache_bytes_end = llm.pool.cache_bytes()
+    shed_server = llm.shed_total
+    evicted = llm.evicted_total
+    sessions_started = llm.sessions_total
+    inflight_end = srv._inflight
+    pipeline.stop()
+    shutdown_server(LLM_SERVER_ID)
+    import gc
+
+    gc.collect()
+    pool_pending = default_pool().stats["pending"]
+
+    tokens = sum(c["tokens"] for c in stats)
+    sessions = sum(c["sessions"] for c in stats)
+    sheds_client = sum(c["sheds"] for c in stats)
+    tok_s = tokens / soak_s
+    phases = engine_report["phases"]
+    checks = {
+        "zero_errors": not errors,
+        "exact_order": not any("order" in e for e in errors),
+        "sheds_explicit": sheds_client == shed_server,
+        "cache_bounded": (cache_bytes_end == cache_bytes_start
+                          and pool_pending == 0),
+        "batched_2x_solo": tok_s >= 2.0 * solo_tok_s,
+        "consistency_under_batching": (
+            len(probe_results) == 2
+            and probe_results[0] == probe_results[1]),
+        "attribution_conserved":
+            abs(phases["conserved_pct"] - 100.0) < 0.1,
+        "inflight_settled": inflight_end == 0,
+        # the abandoner clients guarantee mid-stream disconnects
+        # happened; the pruner must have reclaimed every one (final
+        # live == 0 is implied by inflight_settled + pipeline.stop)
+        "disconnects_reclaimed": evicted >= 1,
+    }
+    attribution = {
+        "states": dict(phases["states_pct"]),
+        "conserved_pct": phases["conserved_pct"],
+        "note": "DecodeEngine PhaseClock: every decode-thread "
+                "nanosecond in exactly one of idle/admit/prefill/"
+                "decode/egress — conservation is an identity "
+                "(obs/attrib.py llm-prefill/llm-decode are the "
+                "per-frame trace twins)"}
+    verdict = {
+        "metric": "soak_llm", "status": "live",
+        "pass": all(checks.values()),
+        "verdict": "PASS" if all(checks.values()) else "FAIL",
+        "config": {"server": llm_server_line(slots, batch),
+                   "clients": clients, "duration_s": round(soak_s, 1),
+                   "note": "in-process serving pipeline + threaded "
+                           "token-stream clients; prompt lengths "
+                           "4..63, output lengths 8..71, half the "
+                           "clients reconnect per session"},
+        "llm": {
+            "slots": slots, "batch": batch,
+            "tokens": tokens, "sessions": sessions,
+            "sessions_started_server": sessions_started,
+            "tokens_per_s": round(tok_s, 1),
+            "solo_tokens_per_s": round(solo_tok_s, 1),
+            "speedup_vs_solo": round(tok_s / max(1e-9, solo_tok_s), 2),
+            "mean_step_fill": engine_report["mean_fill"],
+            "ewma_step_ms": engine_report["ewma_step_ms"],
+            "compiles": engine_report["compiles"],
+            "sheds_client": sheds_client, "sheds_server": shed_server,
+            "evicted_sessions": evicted,
+            "cache_bytes": cache_bytes_end,
+            "pool_pending_slabs": pool_pending,
+            "errors": errors[:10],
+            "checks": checks,
+        },
+        "attribution": attribution,
+    }
+    tok_row = {"metric": "soak_llm_tokens_per_s",
+               "value": round(tok_s, 1), "unit": "tokens_per_s",
+               "status": "live", "attribution": attribution}
+    verdict["rows"] = [
+        tok_row,
+        {"metric": "soak_llm_solo_tokens_per_s",
+         "value": round(solo_tok_s, 1), "unit": "tokens_per_s",
+         "status": "live"},
+        {"metric": "soak_llm_speedup_vs_solo",
+         "value": round(tok_s / max(1e-9, solo_tok_s), 2),
+         "unit": "x_higher_better", "status": "live"},
+        {"metric": "soak_llm_mean_step_fill",
+         "value": engine_report["mean_fill"],
+         "unit": "seqs_per_step", "status": "live"},
+    ]
+    with open(os.path.join(args.out, "verdict.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(verdict, fh, indent=2)
+    line = {"metric": "soak_llm", "verdict": verdict["verdict"],
+            "pass": verdict["pass"],
+            "tokens_per_s": round(tok_s, 1),
+            "solo_tokens_per_s": round(solo_tok_s, 1),
+            "speedup_vs_solo": round(tok_s / max(1e-9, solo_tok_s), 2),
+            "mean_step_fill": engine_report["mean_fill"],
+            "sessions": sessions, "sheds": sheds_client,
+            "evicted": evicted, "errors": len(errors),
+            "prefill_pct": phases["states_pct"].get("prefill"),
+            "decode_pct": phases["states_pct"].get("decode"),
+            "conserved_pct": phases["conserved_pct"],
+            "checks": checks,
+            "artifact": os.path.join(args.out, "verdict.json")}
+    print(json.dumps(line), flush=True)
+    return 0 if verdict["pass"] else 1
+
+
 FEDERATE_SERVER_ID = 93
 FLEET_SERVER_ID = 94
 
@@ -1363,6 +1678,19 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet-drain-grace", type=float, default=5.0,
                     help="worker SIGTERM drain budget for --fleet "
                          "scale-downs (seconds)")
+    ap.add_argument("--llm", action="store_true",
+                    help="token-streaming LLM serving acceptance soak "
+                         "(ISSUE 15): multi-client continuous-batching "
+                         "token streams with heterogeneous prompt/"
+                         "output lengths through tensor_llm — gates "
+                         "zero errors, exact per-client order, bounded "
+                         "cache memory, explicit sheds, >=2x the solo "
+                         "baseline, conserved prefill/decode "
+                         "attribution")
+    ap.add_argument("--llm-slots", type=int, default=12,
+                    help="--llm: KV-cache slots (sessions resident)")
+    ap.add_argument("--llm-batch", type=int, default=8,
+                    help="--llm: decode bucket capacity")
     ap.add_argument("--xbatch-timeout-ms", type=float, default=30.0,
                     help="batch-timeout-ms for the --xbatch server.  "
                          "Default 30 (deadline mode): the soak's "
@@ -1384,6 +1712,8 @@ def main(argv=None) -> int:
         return run_xbatch(args, ap)
     if args.fleet:
         return run_fleet(args, ap)
+    if args.llm:
+        return run_llm(args, ap)
 
     os.makedirs(args.out, exist_ok=True)
     demo = args.demo or not args.port
