@@ -788,6 +788,44 @@ def bench_lm(emit=None) -> dict:
                                                       pooled_solo), 2)
     except Exception as exc:  # noqa: BLE001 — enrich, never lose the row
         out["llm_serve_error"] = repr(exc)[:160]
+
+    # block-paged serving tier (ISSUE 17): the same bucket decoding
+    # from the page arena instead of dense slots — the rate must hold
+    # (the hotpath llmpaged gate pins within-10%) while memory scales
+    # with use, not max_seq
+    try:
+        from nnstreamer_tpu.llm.paged import PagedKVCachePool
+
+        ps = 16 if cfg.max_seq % 16 == 0 \
+            and cfg.max_seq >= 32 + steps + 8 else 0
+        if ps:
+            pages = (n_streams + 1) * (cfg.max_seq // ps) - 1
+            ppool = PagedKVCachePool(cfg, pages, ps, slots=n_streams)
+            peng = DecodeEngine(params, cfg, ppool, capacity=n_streams)
+            # a 2-page prompt starts every lane at position 32, so the
+            # pow2 table width holds at 4 through position 64 — the
+            # whole timed window runs one warm executable (no
+            # mid-measurement width crossing), like the dense point
+            two_pages = np.asarray(
+                rng.integers(0, cfg.vocab, (2 * ps,)), np.int32)
+            psess = []
+            for i in range(n_streams):
+                s = ppool.acquire(i, prompt=two_pages,
+                                  max_new=steps + 8)
+                s.max_new = 1 << 30
+                s.next_token = peng.prefill(s, two_pages)
+                psess.append(s)
+            peng.step(psess)                  # compile bucket shape
+            t0 = time.monotonic()
+            for _ in range(steps):
+                peng.step(psess)
+            paged_rate = steps * n_streams / (time.monotonic() - t0)
+            out["llm_serve_paged_tok_s"] = round(paged_rate, 1)
+            out["llm_serve_paged_vs_dense"] = round(
+                paged_rate / max(1e-9, pooled), 3)
+            out["llm_serve_page_size"] = ps
+    except Exception as exc:  # noqa: BLE001 — enrich, never lose the row
+        out["llm_serve_paged_error"] = repr(exc)[:160]
     if emit is not None:
         # flush before the cost-analysis extra (it re-jits the naive path)
         emit(out)

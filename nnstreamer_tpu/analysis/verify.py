@@ -275,8 +275,9 @@ def _check_element_configs(pipeline, findings: List[Finding]) -> None:
             # contract, reported under the generic "misconfig" rule —
             # and (severity, rule, message) for elements whose checks
             # are named rules of their own (the llm element's
-            # llm-slots-lt-batch / llm-no-max-seq family), so --check
-            # output and tests can address them by name
+            # llm-slots-lt-batch / llm-no-max-seq / llm-page-size /
+            # llm-prefix-without-pages family), so --check output and
+            # tests can address them by name
             if len(check) == 3:
                 severity, rule, message = check
             else:

@@ -31,13 +31,19 @@ Everything REUSES the existing serving plane rather than forking it:
   ``tensor_query_serversink`` in exact per-client order, with the
   existing trace-context piggyback (one merged Chrome timeline shows
   prefill, per-step decode windows, and queue-wait per token).
+- **paged.py** — :class:`PagedKVCachePool`: the block-paged arena
+  (vLLM/PagedAttention layout) behind the same pool contract — memory
+  proportional to what a session USES, content-hash prefix reuse
+  (copy-on-write, refcounted), commitment-based page admission.
 - **client.py** — :class:`TokenStreamClient`: the client half of the
   streaming reply contract over the unchanged query wire protocol.
 """
 
-from .client import TokenStreamClient
+from .client import TokenStreamClient, TokenTimeoutError
 from .engine import DecodeEngine, PhaseClock
+from .paged import PagedKVCachePool
 from .pool import KVCachePool, slot_admission_controller
 
-__all__ = ["DecodeEngine", "KVCachePool", "PhaseClock",
-           "TokenStreamClient", "slot_admission_controller"]
+__all__ = ["DecodeEngine", "KVCachePool", "PagedKVCachePool",
+           "PhaseClock", "TokenStreamClient", "TokenTimeoutError",
+           "slot_admission_controller"]

@@ -36,6 +36,23 @@ from ..tensor.buffer import TensorBuffer
 from .element import REQ_HEADER
 
 
+class TokenTimeoutError(TimeoutError):
+    """The next token missed the per-token inactivity deadline.
+
+    Raised by :meth:`TokenStreamClient.stream` with the undelivered
+    reply queue already DRAINED (leased wire slabs released): the
+    caller sees a named, catchable verdict and the slab pool sees its
+    memory back immediately — an abandoned stream must not hold pooled
+    slabs hostage until garbage collection.
+    """
+
+    def __init__(self, msg: str, got: int = 0,
+                 timeout_s: float = 0.0) -> None:
+        super().__init__(msg)
+        self.got = got               # tokens delivered before the stall
+        self.timeout_s = timeout_s   # the deadline that fired
+
+
 def encode_request(prompt: Sequence[int], max_new: int,
                    stop_token: int = -1,
                    frame_len: Optional[int] = None) -> np.ndarray:
@@ -61,10 +78,20 @@ class TokenStreamClient:
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
                  qos: Optional[str] = None,
-                 model: Optional[str] = None) -> None:
+                 model: Optional[str] = None,
+                 token_timeout: Optional[float] = None) -> None:
         self._conn = QueryConnection(host, port, timeout=timeout,
                                      qos=qos, model=model)
         self.timeout = float(timeout)
+        #: per-token inactivity deadline (seconds) — how long a stream
+        #: may go WITHOUT a next token before it is declared stalled
+        #: (:class:`TokenTimeoutError`); ``None`` inherits the
+        #: transport timeout, but a serving caller should set it from
+        #: its own latency budget: the transport default is a connect/
+        #: request deadline and says nothing about inter-token gaps
+        self.token_timeout = (float(token_timeout)
+                              if token_timeout is not None
+                              else self.timeout)
 
     def connect(self) -> "TokenStreamClient":
         self._conn.connect()
@@ -72,10 +99,14 @@ class TokenStreamClient:
 
     def close(self) -> None:
         self._conn.close()
-        # drain undelivered replies: a stream abandoned mid-flight
-        # (disconnect, shed, caller bailed) leaves leased token frames
-        # queued — their pooled slabs must return to the pool NOW, not
-        # whenever the queue object happens to be collected
+        self._drain_replies()
+
+    def _drain_replies(self) -> None:
+        """Release every undelivered reply's leased wire slab: a
+        stream abandoned mid-flight (disconnect, shed, stall, caller
+        bailed) leaves leased token frames queued — their pooled slabs
+        must return to the pool NOW, not whenever the queue object
+        happens to be collected."""
         while True:
             try:
                 msg = self._conn.replies.get_nowait()
@@ -91,15 +122,21 @@ class TokenStreamClient:
 
     def stream(self, prompt: Sequence[int], max_new: int,
                stop_token: int = -1,
-               frame_len: Optional[int] = None
+               frame_len: Optional[int] = None,
+               token_timeout: Optional[float] = None
                ) -> Iterator[Tuple[int, int]]:
         """Send one request; yield ``(index, token)`` pairs as reply
         frames arrive, ending by the stop-token contract.  Raises
-        :class:`ShedError` on an explicit slot shed, ``TimeoutError``
-        when the next token misses the per-token deadline, and
+        :class:`ShedError` on an explicit slot shed,
+        :class:`TokenTimeoutError` when the next token misses the
+        per-token inactivity deadline (``token_timeout`` here, the
+        client's ``token_timeout`` otherwise — raised with the reply
+        queue drained and its leased slabs released), and
         ``ValueError`` on an out-of-order token index (the exact
         per-client order gate — ``pts`` must count 0, 1, 2, …)."""
         conn = self._conn
+        gap = (float(token_timeout) if token_timeout is not None
+               else self.token_timeout)
         req = encode_request(prompt, max_new, stop_token, frame_len)
         with conn._waiters_lock:
             conn._seq += 1
@@ -109,14 +146,16 @@ class TokenStreamClient:
                          TensorBuffer(tensors=[req]), seq=seq)
         got = 0
         while got < max_new:
-            deadline = time.monotonic() + self.timeout
+            deadline = time.monotonic() + gap
             reply = None
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise TimeoutError(
-                        f"no token within {self.timeout}s "
-                        f"(received {got}/{max_new})")
+                    self._drain_replies()
+                    raise TokenTimeoutError(
+                        f"no token within {gap}s "
+                        f"(received {got}/{max_new})",
+                        got=got, timeout_s=gap)
                 try:
                     reply = conn.replies.get(timeout=remaining)
                 except _queue.Empty:

@@ -123,6 +123,27 @@ class TensorLLM(Element):
                                     "streams)"),
         "queue-depth": (0, "pending-request bound before chain() "
                            "backpressures (0 = 2 x slots)"),
+        "page-size": (0, "KV-cache page size in tokens: > 0 serves "
+                         "from the block-paged arena (memory scales "
+                         "with what a session USES, not max_seq); "
+                         "must tile max_seq evenly; 0 (default) = the "
+                         "dense per-session max_seq slot pool — paged "
+                         "serving is explicit opt-in so dense "
+                         "reference configs stay dense"),
+        "pages": (0, "paged arena size in pages; 0 = "
+                     "(slots+1) x max_seq / page_size - 1 — byte-"
+                     "identical arena to the dense pool at the same "
+                     "slots (the apples-to-apples residency sizing)"),
+        "prefill-chunk": (-1, "interleaved prefill chunk in tokens: "
+                              "the decode loop advances one bounded "
+                              "chunk between decode steps so a long "
+                              "prompt cannot stall resident streams; "
+                              "0 = whole-prompt prefill; -1 = auto "
+                              "(32 when paged, off when dense)"),
+        "prefix-cache": (-1, "content-hash prefix reuse over full "
+                             "prompt pages (chain-hashed, refcounted, "
+                             "copy-on-write): 1 on / 0 off / -1 auto "
+                             "(on when paged; requires pages)"),
     }
 
     # -- pads / caps -----------------------------------------------------
@@ -159,12 +180,17 @@ class TensorLLM(Element):
         out = []
 
         def _num(key, default):
+            val = self.get_property(key)
+            if val is None or val == "":
+                return default
             try:
-                return int(self.get_property(key) or default)
+                # NOT `val or default`: 0 is a meaningful setting here
+                # (page-size=0 = dense pool) and must not read back as
+                # the default
+                return int(val)
             except (TypeError, ValueError):
                 out.append(("error", f"llm-bad-{key}",
-                            f"{self.name}: {key}="
-                            f"{self.get_property(key)!r} is not an "
+                            f"{self.name}: {key}={val!r} is not an "
                             "integer"))
                 return default
 
@@ -182,7 +208,35 @@ class TensorLLM(Element):
                         "pool — it could never fill; size slots >= "
                         "batch (cache memory scales with slots, "
                         "throughput with filled batch)"))
+        ps = _num("page-size", 0)
+        pages = _num("pages", 0)
+        chunk = _num("prefill-chunk", -1)
+        pfx = _num("prefix-cache", -1)
         custom = FilterProperties.parse_custom(self.custom)
+        if ps < 0 or pages < 0:
+            out.append(("error", "llm-page-size",
+                        f"{self.name}: page-size={ps} / pages={pages} "
+                        "below 0 is meaningless (0 = dense pool / "
+                        "auto-sized arena)"))
+        elif ps > 0 and "max_seq" in custom:
+            try:
+                max_seq = int(custom["max_seq"])
+            except (TypeError, ValueError):
+                max_seq = 0
+            if max_seq > 0 and (ps > max_seq or max_seq % ps != 0):
+                out.append(("error", "llm-page-size",
+                            f"{self.name}: page-size={ps} must tile "
+                            f"max_seq={max_seq} evenly (block tables "
+                            "map position j to page j//page_size; a "
+                            "ragged last page would alias positions)"))
+        if ps == 0 and (pfx == 1 or chunk > 0):
+            out.append(("error", "llm-prefix-without-pages",
+                        f"{self.name}: prefix-cache={pfx} / "
+                        f"prefill-chunk={chunk} with page-size=0: "
+                        "prefix reuse shares content-hashed PAGES and "
+                        "chunked prefill writes into them — neither "
+                        "lever exists over dense per-session slots; "
+                        "set page-size > 0 or drop both"))
         if "max_seq" not in custom:
             out.append(("error", "llm-no-max-seq",
                         f"{self.name}: custom= names no max_seq — the "
@@ -235,11 +289,32 @@ class TensorLLM(Element):
         self._depth = int(self.queue_depth or 0) or 2 * self._slots
         params = host_init(
             lambda: init_params(self.cfg, int(self.seed or 0)))
-        self.pool = KVCachePool(self.cfg, self._slots)
+        ps = max(0, int(self.page_size if self.page_size is not None
+                        else 0))
+        chunk = int(self.prefill_chunk
+                    if self.prefill_chunk is not None else -1)
+        pfx = int(self.prefix_cache
+                  if self.prefix_cache is not None else -1)
+        if ps > 0:
+            from .paged import PagedKVCachePool
+
+            table_max = self.cfg.max_seq // ps
+            pages = int(self.pages or 0) \
+                or (self._slots + 1) * table_max - 1
+            self.pool = PagedKVCachePool(
+                self.cfg, pages=pages, page_size=ps,
+                slots=self._slots, prefix_cache=(pfx != 0))
+            self._chunk = 32 if chunk < 0 else chunk
+            if str(self.prefill or "auto") == "step":
+                self._chunk = 0   # prompt rides the decode grid instead
+        else:
+            self.pool = KVCachePool(self.cfg, self._slots)
+            self._chunk = 0
         self.engine = DecodeEngine(params, self.cfg, self.pool,
                                    capacity=self._batch,
                                    prefill_mode=str(self.prefill
-                                                    or "auto"))
+                                                    or "auto"),
+                                   chunk=self._chunk)
         self.engine.warmup()
         self._mono_ns = mono_ns
         self._cv = make_condition("llm.engine")
@@ -290,6 +365,18 @@ class TensorLLM(Element):
              lambda: eng.last_fill / max(1, eng.capacity)),
             ("nns_llm_pending", lambda: len(self._pending)),
         )]
+        if getattr(eng, "paged", False):
+            self._obs_gauges.extend(
+                REGISTRY.register(Gauge(n, dict(labels), fn=f))
+                for n, f in (
+                    ("nns_llm_free_pages", lambda: pool.free_pages),
+                    ("nns_llm_cached_pages",
+                     lambda: pool.stats()["reclaimable"]),
+                    ("nns_llm_prefix_hits",
+                     lambda: pool.prefix_hits),
+                    ("nns_llm_prefix_tokens_reused",
+                     lambda: pool.prefix_tokens_reused),
+                ))
         self._obs_counters = {
             n: REGISTRY.counter(n, **labels) for n in (
                 "nns_llm_tokens_total", "nns_llm_sessions_total",
@@ -458,13 +545,20 @@ class TensorLLM(Element):
                 self._cv.notify_all()   # free chain() backpressure slots
             self._prune_sessions()
             requeue = self._admit(taken)
-            sessions = pool.sessions()
+            sessions = [s for s in pool.sessions()
+                        if not getattr(s, "prefilling", False)]
             if sessions:
                 n = len(sessions)
                 pick = [sessions[(rr + i) % n]
                         for i in range(min(n, self._batch))]
                 rr = (rr + len(pick)) % max(1, n)
                 self._run_step(pick)
+            # interleaved chunked prefill: ONE bounded chunk per loop
+            # iteration, so a long prompt time-shares the decode thread
+            # with resident streams instead of stalling them (with no
+            # decodable sessions the loop spins here chunk after chunk
+            # — full prefill throughput when there is no one to starve)
+            self._advance_prefills()
             if requeue:
                 with self._cv:
                     self._pending[:0] = requeue
@@ -494,7 +588,9 @@ class TensorLLM(Element):
                     continue
                 verdict = pool.admit(req.qos,
                                      no_slot_retry_s=eng
-                                     .retry_after_hint())
+                                     .retry_after_hint(),
+                                     prompt=req.prompt,
+                                     max_new=req.max_new)
                 if verdict is not None:
                     if self._admit_timeout > 0 \
                             and self._now() - req.born_s \
@@ -505,12 +601,21 @@ class TensorLLM(Element):
                         self._shed(req, verdict)
                     continue
                 sess = pool.acquire(req.key, qos=req.qos,
-                                    extra=req.extra)
+                                    extra=req.extra, prompt=req.prompt,
+                                    max_new=req.max_new)
                 sess.max_new = req.max_new
                 sess.stop_token = req.stop_token
                 sess.truncated = req.truncated
                 self.sessions_total += 1
                 self._obs_counters["nns_llm_sessions_total"].inc()
+                if self._chunk > 0:
+                    # chunked prefill: the session joins RESIDENT but
+                    # not yet decodable — the decode loop advances one
+                    # bounded chunk per iteration (_advance_prefills),
+                    # so this prompt cannot stall the streams already
+                    # emitting tokens; its first token emits when the
+                    # last chunk lands
+                    continue
                 t0 = self._mono_ns()
                 first = eng.prefill(sess, req.prompt)
                 tracer = self._tracer()
@@ -545,6 +650,34 @@ class TensorLLM(Element):
         extra = dict(req.extra)
         extra["nns_llm_shed"] = retry_after_s
         self._emit(extra, req.stop_token, 0, last=True)
+
+    def _advance_prefills(self) -> None:
+        """Advance ONE bounded prefill chunk — the oldest prefilling
+        session, admission order — and emit its first token when the
+        prompt completes.  One chunk per decode-loop iteration is the
+        interleave contract: a 2048-token prompt costs resident streams
+        ``ceil(2048/chunk)`` extra bounded slices, never one monolithic
+        stall (the PhaseClock's ``llm-prefill-chunk`` share is the
+        proof)."""
+        if self._chunk <= 0:
+            return
+        eng, pool = self.engine, self.pool
+        for sess in pool.sessions():
+            if not getattr(sess, "prefilling", False):
+                continue
+            t0 = self._mono_ns()
+            first = eng.prefill_chunk_step(sess)
+            t1 = self._mono_ns()
+            tracer = self._tracer()
+            if tracer is not None:
+                ctx = sess.extra.get("nns_trace")
+                if ctx is not None and ctx.trace_id:
+                    tracer.annotate_span("llm-prefill-chunk", t0, t1,
+                                         seq=-1, trace_id=ctx.trace_id)
+            if first is not None:
+                sess.next_token = first
+                self._finish_or_emit(sess, first)
+            return
 
     # -- stepping / egress -----------------------------------------------
     def _run_step(self, picked) -> None:

@@ -35,8 +35,47 @@ from ..filter.backends._jitexec import JitExecMixin
 from .pool import KVCachePool, Session
 
 #: PhaseClock states (closed set; every decode-thread nanosecond lands
-#: in exactly one)
-PHASES = ("idle", "admit", "prefill", "decode", "egress")
+#: in exactly one).  ``llm-prefill-chunk`` is the paged tier's
+#: interleaved-prefill share: time spent advancing ONE bounded prompt
+#: chunk between decode steps — its presence (and the decode share
+#: staying alive next to it) is the proof a long prompt no longer
+#: stalls resident token streams.
+PHASES = ("idle", "admit", "prefill", "llm-prefill-chunk", "decode",
+          "egress")
+
+
+def quantize_pages(n: int, table_max: int) -> int:
+    """Padded block-table WIDTH for a paged dispatch: next power of two
+    capped at ``table_max`` (= ``max_seq // page_size``) — the
+    ``quantize_prompt`` discipline applied to the page axis, so block
+    tables of every length land on a bounded ``log2``-ish executable
+    set.  Padding entries point at the scratch page."""
+    cap = max(1, int(table_max))
+    q = 1
+    while q < n:
+        q <<= 1
+    return min(q, cap)
+
+
+def _cfg_key(cfg) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in vars(cfg).items()))
+
+
+#: process-wide jitted-callable memo: engines with the SAME model
+#: config share one jit object per executable family (jax re-
+#: specializes per operand shape inside it), so a test suite or fleet
+#: restarting elements does not re-trace identical math.  Per-engine
+#: ``compiles`` counters still count warm-set entries per engine — the
+#: bounded-executables evidence is unchanged.
+_EXEC_MEMO: Dict[tuple, Any] = {}
+
+
+def _memo_jit(key: tuple, make):
+    fn = _EXEC_MEMO.get(key)
+    if fn is None:
+        fn = make()
+        _EXEC_MEMO[key] = fn
+    return fn
 
 
 class PhaseClock:
@@ -118,7 +157,7 @@ class DecodeEngine:
 
     def __init__(self, params, cfg, pool: KVCachePool,
                  capacity: int, prefill_mode: str = "auto",
-                 clock=None) -> None:
+                 clock=None, chunk: int = 0) -> None:
         import jax
 
         self.params = params
@@ -131,8 +170,13 @@ class DecodeEngine:
         self.prefill_mode = prefill_mode
         self._clock = clock if clock is not None else time.monotonic
         self._jax = jax
-        self._step_jit: Dict[int, Any] = {}      # padded B -> executable
-        self._prefill_jit: Dict[int, Any] = {}   # padded T -> executable
+        #: paged pool?  (block-paged arena + tables instead of slots)
+        self.paged = getattr(pool, "page_size", 0) > 0
+        #: interleaved-prefill chunk size in tokens (paged only;
+        #: 0 = whole remaining prompt in one chunk executable)
+        self.chunk = max(0, int(chunk)) if self.paged else 0
+        self._step_jit: Dict[Any, Any] = {}      # padded B[, W] -> exec
+        self._prefill_jit: Dict[Any, Any] = {}   # padded T / (C, W)
         self.phases = PhaseClock()
         # live accounting the gauges read.  tokens_total counts every
         # GENERATED token (incl. each session's first, argmaxed from
@@ -142,6 +186,7 @@ class DecodeEngine:
         self.step_tokens = 0
         self.steps_total = 0
         self.prefills_total = 0
+        self.prefill_chunks_total = 0
         self.last_fill = 0
         self.ewma_step_s = 0.0
         self.compiles = 0
@@ -150,44 +195,104 @@ class DecodeEngine:
     def _step_fn(self, padded: int):
         fn = self._step_jit.get(padded)
         if fn is None:
-            from ..models.streamformer_lm import decode_step_pooled
-
             cfg = self.cfg
 
-            def _step(params, k, v, tokens, pos, slots):
-                return decode_step_pooled(params, k, v, tokens, pos,
-                                          slots, cfg)
+            def _make():
+                from ..models.streamformer_lm import decode_step_pooled
 
-            fn = self._jax.jit(_step, donate_argnums=(1, 2))
+                def _step(params, k, v, tokens, pos, slots):
+                    return decode_step_pooled(params, k, v, tokens,
+                                              pos, slots, cfg)
+
+                return self._jax.jit(_step, donate_argnums=(1, 2))
+
+            fn = _memo_jit(("step", _cfg_key(cfg)), _make)
             self._step_jit[padded] = fn
+            self.compiles += 1
+        return fn
+
+    def _pstep_fn(self, padded: int, width: int):
+        """Paged decode executable: one per ``(padded B, table width)``
+        pair — both axes quantized, so the warm set stays a bounded
+        ``|pad_rows| x |quantize_pages|`` grid."""
+        key = (padded, width)
+        fn = self._step_jit.get(key)
+        if fn is None:
+            cfg = self.cfg
+            ps = self.pool.page_size
+
+            def _make():
+                from ..models.streamformer_lm import decode_step_paged
+
+                def _step(params, k, v, tokens, pos, tables):
+                    return decode_step_paged(params, k, v, tokens, pos,
+                                             tables, cfg, ps)
+
+                return self._jax.jit(_step, donate_argnums=(1, 2))
+
+            fn = _memo_jit(("pstep", _cfg_key(cfg), ps), _make)
+            self._step_jit[key] = fn
+            self.compiles += 1
+        return fn
+
+    def _chunk_fn(self, padded_c: int, width: int):
+        """Paged prefill-chunk executable per ``(padded C, table
+        width)``; chunk origin and real length ride as traced operands,
+        so ONE executable serves every chunk of every prompt at every
+        prefix-hit offset under its quantized bucket."""
+        key = ("chunk", padded_c, width)
+        fn = self._prefill_jit.get(key)
+        if fn is None:
+            cfg = self.cfg
+            ps = self.pool.page_size
+
+            def _make():
+                from ..models.streamformer_lm import prefill_chunk_paged
+
+                def _chunk(params, k, v, tokens, table, start, true_len,
+                           scratch):
+                    return prefill_chunk_paged(params, k, v, tokens,
+                                               table, start, true_len,
+                                               cfg, ps, scratch)
+
+                return self._jax.jit(_chunk, donate_argnums=(1, 2))
+
+            fn = _memo_jit(("chunk", _cfg_key(cfg), ps), _make)
+            self._prefill_jit[key] = fn
             self.compiles += 1
         return fn
 
     def _prefill_fn(self, padded_t: int):
         fn = self._prefill_jit.get(padded_t)
         if fn is None:
-            from ..models.streamformer_lm import prefill_kv
-
             cfg = self.cfg
             flash = {"auto": None, "flash": True,
                      "naive": False}[self.prefill_mode]
+            jax = self._jax
 
-            def _prefill(params, k_pool, v_pool, tokens, slot, true_len):
-                logits, ks, vs = prefill_kv(params, tokens, cfg,
-                                            flash=flash)
-                # install the whole padded K/V run into the slot: rows
-                # past true_len are garbage the decode mask never reads
-                # (valid = arange <= pos), so one static-shape update
-                # serves every real length under this quantized bucket
-                k_pool = self._jax.lax.dynamic_update_slice(
-                    k_pool, ks[None], (slot, 0, 0, 0, 0))
-                v_pool = self._jax.lax.dynamic_update_slice(
-                    v_pool, vs[None], (slot, 0, 0, 0, 0))
-                last = self._jax.lax.dynamic_index_in_dim(
-                    logits, true_len - 1, axis=0, keepdims=False)
-                return last, k_pool, v_pool
+            def _make():
+                from ..models.streamformer_lm import prefill_kv
 
-            fn = self._jax.jit(_prefill, donate_argnums=(1, 2))
+                def _prefill(params, k_pool, v_pool, tokens, slot,
+                             true_len):
+                    logits, ks, vs = prefill_kv(params, tokens, cfg,
+                                                flash=flash)
+                    # install the whole padded K/V run into the slot:
+                    # rows past true_len are garbage the decode mask
+                    # never reads (valid = arange <= pos), so one
+                    # static-shape update serves every real length
+                    # under this quantized bucket
+                    k_pool = jax.lax.dynamic_update_slice(
+                        k_pool, ks[None], (slot, 0, 0, 0, 0))
+                    v_pool = jax.lax.dynamic_update_slice(
+                        v_pool, vs[None], (slot, 0, 0, 0, 0))
+                    last = jax.lax.dynamic_index_in_dim(
+                        logits, true_len - 1, axis=0, keepdims=False)
+                    return last, k_pool, v_pool
+
+                return jax.jit(_prefill, donate_argnums=(1, 2))
+
+            fn = _memo_jit(("prefill", _cfg_key(cfg), flash), _make)
             self._prefill_jit[padded_t] = fn
             self.compiles += 1
         return fn
@@ -204,6 +309,9 @@ class DecodeEngine:
         prompt-length bucket compiled mid-serve)."""
         import jax.numpy as jnp
 
+        if self.paged:
+            self._warmup_paged()
+            return
         shapes = sorted({JitExecMixin.pad_rows(n, self.capacity)
                          for n in range(1, self.capacity + 1)})
         for rows in shapes:
@@ -234,6 +342,70 @@ class DecodeEngine:
         # scratch writes during warmup are garbage by design; zero the
         # scratch lane is unnecessary (no session ever reads it)
 
+    def _widths(self):
+        """The pow2-quantized block-table widths live dispatch can
+        produce — a bounded ``log2(table_max)``-ish set."""
+        table_max = self.pool.table_max
+        out, w = set(), 1
+        while True:
+            out.add(min(w, table_max))
+            if w >= table_max:
+                break
+            w <<= 1
+        return sorted(out)
+
+    def _chunk_lengths(self):
+        """Padded chunk sizes the paged prefill path can dispatch:
+        the fixed chunk when interleaving, else the pow2 prompt
+        quantization (one whole-suffix chunk per bucket)."""
+        if self.chunk > 0:
+            return [self.chunk]
+        lengths, t = [], 8
+        while True:
+            lengths.append(min(t, self.cfg.max_seq))
+            if t >= self.cfg.max_seq:
+                break
+            t <<= 1
+        return sorted(set(lengths))
+
+    def _warmup_paged(self) -> None:
+        """Paged warm set: the ``pad_rows x quantize_pages`` decode
+        grid plus every ``(chunk length, width)`` prefill pair whose
+        width can cover the chunk — all dispatched at the scratch page,
+        so live serving never meets a cold executable (the
+        zero-steady-state-compiles acceptance)."""
+        import jax.numpy as jnp
+
+        pool = self.pool
+        widths = self._widths()
+        rows_set = sorted({JitExecMixin.pad_rows(n, self.capacity)
+                           for n in range(1, self.capacity + 1)})
+        for rows in rows_set:
+            for w in widths:
+                toks = jnp.zeros((rows,), jnp.int32)
+                pos = jnp.zeros((rows,), jnp.int32)
+                tables = jnp.full((rows, w), pool.scratch, jnp.int32)
+                fn = self._pstep_fn(rows, w)
+                logits, pool.k, pool.v = fn(
+                    self.params, pool.k, pool.v, toks, pos, tables)
+                self._jax.block_until_ready(logits)
+        if self.prefill_mode == "step":
+            return   # prompt decode rides the paged step grid above
+        ps = pool.page_size
+        for c in self._chunk_lengths():
+            min_w = quantize_pages(-(-c // ps), pool.table_max)
+            for w in widths:
+                if w < min_w:
+                    continue
+                fn = self._chunk_fn(c, w)
+                last, pool.k, pool.v = fn(
+                    self.params, pool.k, pool.v,
+                    jnp.zeros((c,), jnp.int32),
+                    jnp.full((w,), pool.scratch, jnp.int32),
+                    jnp.int32(0), jnp.int32(1),
+                    jnp.int32(pool.scratch))
+                self._jax.block_until_ready(last)
+
     # -- prefill ---------------------------------------------------------
     def prefill(self, sess: Session, prompt: np.ndarray) -> int:
         """Seed ``sess``'s cache slot from its prompt and return the
@@ -248,6 +420,11 @@ class DecodeEngine:
 
         prev = self.phases.enter("prefill")
         t = int(prompt.shape[0])
+        if self.paged:
+            try:
+                return self._prefill_paged(sess)
+            finally:
+                self.phases.enter(prev)
         if self.prefill_mode == "step":
             logits = None
             for i in range(t):
@@ -271,7 +448,116 @@ class DecodeEngine:
         self.phases.enter(prev)
         return int(np.argmax(logits))
 
+    # -- paged prefill ---------------------------------------------------
+    def _prefill_paged(self, sess) -> int:
+        """Whole-prompt paged prefill: walk :meth:`_advance_chunk` to
+        completion inline (the non-interleaved path — ``chunk == 0``
+        makes it ONE whole-suffix chunk).  ``prefill_mode="step"``
+        instead decodes the prompt token-by-token through the paged
+        step grid (the decode-without-prefill misconfig path, paged)."""
+        if self.prefill_mode == "step":
+            pool = self.pool
+            prompt = sess.prompt
+            first = None
+            for i in range(sess.prefill_pos, sess.plen):
+                pool.grow(sess, i + 1)
+                logits = self._dispatch_paged(
+                    [(sess.table, i, int(prompt[i]))])
+                first = int(np.argmax(logits[0]))
+            pool.note_prefill(sess, sess.plen)
+            sess.pos = sess.plen
+            self.prefills_total += 1
+            self.tokens_total += 1
+            sess.last_step_s = self._clock()
+            return first
+        while True:
+            first = self._advance_chunk(sess)
+            if first is not None:
+                return first
+
+    def prefill_chunk_step(self, sess) -> Optional[int]:
+        """Advance ``sess``'s prefill by ONE bounded chunk — the
+        element's decode loop interleaves these between decode steps so
+        a long prompt cannot stall resident token streams.  Returns the
+        session's first generated token when the prompt completes,
+        ``None`` while chunks remain.  Attributed to the PhaseClock's
+        ``llm-prefill-chunk`` share (the interleaving proof)."""
+        prev = self.phases.enter("llm-prefill-chunk")
+        try:
+            return self._advance_chunk(sess)
+        finally:
+            self.phases.enter(prev)
+
+    def _advance_chunk(self, sess) -> Optional[int]:
+        """One paged prefill chunk: grow the table over the chunk's
+        real positions, dispatch the ``(padded C, width)`` executable
+        (origin and real length as traced operands), register any
+        newly-full prompt pages with the prefix cache.  Returns the
+        first generated token on the FINAL chunk (argmax of position
+        ``plen - 1``'s logits), else ``None``."""
+        import jax.numpy as jnp
+
+        pool = self.pool
+        ps = pool.page_size
+        cfg = self.cfg
+        start = sess.prefill_pos
+        remaining = sess.plen - start
+        if remaining <= 0:
+            raise RuntimeError(f"session {sess.key!r} is not prefilling")
+        c_real = remaining if self.chunk <= 0 \
+            else min(self.chunk, remaining)
+        c_pad = self.chunk if self.chunk > 0 \
+            else quantize_prompt(c_real, cfg.max_seq)
+        pool.grow(sess, start + c_real)
+        span = min(start + c_pad, cfg.max_seq)
+        w = quantize_pages(-(-span // ps), pool.table_max)
+        toks = np.zeros((c_pad,), np.int32)
+        toks[:c_real] = sess.prompt[start:start + c_real]
+        table = np.full((w,), pool.scratch, np.int32)
+        m = min(len(sess.table), w)
+        table[:m] = sess.table[:m]
+        fn = self._chunk_fn(c_pad, w)
+        last, pool.k, pool.v = fn(
+            self.params, pool.k, pool.v, jnp.asarray(toks),
+            jnp.asarray(table), jnp.int32(start), jnp.int32(c_real),
+            jnp.int32(pool.scratch))
+        pool.note_prefill(sess, start + c_real)
+        self.prefill_chunks_total += 1
+        sess.last_step_s = self._clock()
+        if sess.prefilling:
+            return None
+        sess.pos = sess.plen
+        self.prefills_total += 1
+        self.tokens_total += 1
+        return int(np.argmax(np.asarray(last)))
+
     # -- decode ----------------------------------------------------------
+    def _dispatch_paged(self, lanes):
+        """(table, pos, token) lanes → one paged step dispatch.  The
+        table width is the max lane's page count pow2-quantized;
+        padding lanes and padding table entries point at the scratch
+        page, so their scatter-appends can never touch a live page."""
+        import jax.numpy as jnp
+
+        pool = self.pool
+        ps = pool.page_size
+        n = len(lanes)
+        padded = JitExecMixin.pad_rows(n, self.capacity)
+        w = quantize_pages(max(-(-(p + 1) // ps)
+                               for _, p, _ in lanes), pool.table_max)
+        toks = np.zeros((padded,), np.int32)
+        pos = np.zeros((padded,), np.int32)
+        tables = np.full((padded, w), pool.scratch, np.int32)
+        for i, (table, p, tok) in enumerate(lanes):
+            pos[i], toks[i] = p, tok
+            m = min(len(table), w)
+            tables[i, :m] = table[:m]
+        fn = self._pstep_fn(padded, w)
+        logits, pool.k, pool.v = fn(
+            self.params, pool.k, pool.v, jnp.asarray(toks),
+            jnp.asarray(pos), jnp.asarray(tables))
+        return np.asarray(logits)[:n]
+
     def _lane_arrays(self, lanes: Sequence[Tuple[int, int, int]]):
         """(slot, pos, token) lanes → padded device operands.  Padding
         lanes point at the pool's scratch slot, position 0 — their
@@ -305,8 +591,14 @@ class DecodeEngine:
             return []
         t0 = self._clock()
         prev = self.phases.enter("decode")
-        lanes = [(s.slot, s.pos, s.next_token) for s in sessions]
-        logits = self._dispatch(*self._lane_arrays(lanes))
+        if self.paged:
+            for s in sessions:
+                self.pool.grow(s, s.pos + 1)   # lazy tail-page alloc
+            logits = self._dispatch_paged(
+                [(s.table, s.pos, s.next_token) for s in sessions])
+        else:
+            lanes = [(s.slot, s.pos, s.next_token) for s in sessions]
+            logits = self._dispatch(*self._lane_arrays(lanes))
         out = np.argmax(logits, axis=1).astype(np.int32)
         now = self._clock()
         for s in sessions:
@@ -337,7 +629,7 @@ class DecodeEngine:
 
     def report(self) -> Dict[str, Any]:
         phases = self.phases.report()
-        return {
+        out = {
             "tokens": self.tokens_total,
             "steps": self.steps_total,
             "prefills": self.prefills_total,
@@ -348,3 +640,7 @@ class DecodeEngine:
             "cache_bytes": self.pool.cache_bytes(),
             "phases": phases,
         }
+        if self.paged:
+            out["prefill_chunks"] = self.prefill_chunks_total
+            out["paged"] = self.pool.stats()
+        return out

@@ -131,15 +131,18 @@ class KVCachePool:
             return self._live.get(key)
 
     # -- admission -------------------------------------------------------
-    def admit(self, qos: str,
-              no_slot_retry_s: float = 0.25) -> Optional[float]:
+    def admit(self, qos: str, no_slot_retry_s: float = 0.25,
+              prompt=None, max_new: int = 0) -> Optional[float]:
         """Slot-admission decision BEFORE allocation: ``None`` admits
         (a free slot exists and the occupancy policy agrees), a float
         sheds with that retry-after hint.  Policy first (QoS-tiered
         occupancy watermarks + drain mode), the hard no-free-slot
         boundary second — its hint is ``no_slot_retry_s``, which the
         engine sizes from its live step-time EWMA (≈ when the
-        soonest-finishing session should free a slot)."""
+        soonest-finishing session should free a slot).  ``prompt`` /
+        ``max_new`` are accepted for pool-interface parity (the paged
+        pool admits on page commitment) and ignored here: a dense slot
+        costs ``max_seq`` regardless of what the session uses."""
         with self._lock:
             depth = len(self._live)
             free = bool(self._free)
@@ -151,11 +154,13 @@ class KVCachePool:
         return None
 
     def acquire(self, key, qos: str = "silver",
-                extra: Optional[Dict[str, Any]] = None) -> Session:
+                extra: Optional[Dict[str, Any]] = None,
+                prompt=None, max_new: int = 0) -> Session:
         """Allocate a slot for ``key``.  Caller must have gotten a
         ``None`` from :meth:`admit`; raises when no slot is free (the
         admit/acquire pair runs on the single decode thread, so the
-        check cannot go stale)."""
+        check cannot go stale).  ``prompt`` / ``max_new`` are ignored
+        (pool-interface parity with the paged pool)."""
         now = self._clock()
         with self._lock:
             if key in self._live:

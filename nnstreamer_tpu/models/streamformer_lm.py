@@ -252,6 +252,154 @@ def decode_step_pooled(params: Dict[str, Any], k_pool: jnp.ndarray,
             k_pool, v_pool)
 
 
+def decode_step_paged(params: Dict[str, Any], k_pages: jnp.ndarray,
+                      v_pages: jnp.ndarray, tokens: jnp.ndarray,
+                      pos: jnp.ndarray, tables: jnp.ndarray,
+                      cfg: StreamFormerConfig, page_size: int
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One continuous-batching decode step over a BLOCK-PAGED cache:
+    the vLLM/PagedAttention layout, where a session's cache is a chain
+    of fixed-size pages named by a block table instead of one dense
+    ``max_seq`` lane.
+
+    - ``k_pages``/``v_pages``: ``(P, L, page_size, H, Dh)`` — ONE fixed
+      arena shared by every session; a page belongs to whichever block
+      table names it.  The last page is the caller's scratch page;
+    - ``tokens``/``pos``: ``(B,) int32`` per lane, as in
+      :func:`decode_step_pooled`;
+    - ``tables``: ``(B, W) int32`` — each lane's block table, pages in
+      sequence order (page ``j`` holds positions ``[j*page_size,
+      (j+1)*page_size)``).  ``W`` must satisfy ``W*page_size > max(pos)``
+      (the caller pow2-quantizes it so the executable set stays
+      bounded); entries past a lane's allocated pages — and every entry
+      of a padding lane — point at the scratch page;
+    - returns ``(logits (B, vocab) f32, k_pages', v_pages')``.
+
+    Per layer: scatter-append the new K/V into the TAIL page
+    (``tables[b, pos//page_size]`` at offset ``pos % page_size``),
+    gather the lane's pages back as one ``(W*page_size,)`` run and
+    attend with the same causal-prefix mask as the dense step — lane
+    *i* equals a solo :func:`decode_step` on the same history, the
+    correctness spine the paged pool rests on.  The arena is donated by
+    the engine exactly like the dense pool (the in-place-update
+    discipline: without donation the WHOLE arena copies per step)."""
+    ps = int(page_size)
+    b, w = tables.shape
+    span = w * ps
+    x = (params["embed"][tokens] + params["pos"][pos]).astype(cfg.dtype)
+    valid = jnp.arange(span)[None, :] <= pos[:, None]      # (B, W*ps)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    # tail-page coordinates for this step's scatter-append
+    wpage = jnp.take_along_axis(tables, (pos // ps)[:, None],
+                                axis=1)[:, 0]              # (B,)
+    woff = pos % ps
+    for li, lyr in enumerate(params["layers"]):
+        y = _ln(x.astype(jnp.float32), lyr["ln1"]).astype(cfg.dtype)
+        qkv = jnp.einsum("bd,dchn->bchn", y,
+                         lyr["wqkv"].astype(cfg.dtype))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # (B, H, Dh)
+        li_ix = jnp.full_like(wpage, li)
+        k_pages = k_pages.at[wpage, li_ix, woff].set(k)
+        v_pages = v_pages.at[wpage, li_ix, woff].set(v)
+        kcur = k_pages[tables, li].reshape(
+            b, span, cfg.heads, cfg.head_dim)              # page gather
+        vcur = v_pages[tables, li].reshape(
+            b, span, cfg.heads, cfg.head_dim)
+        s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                       kcur.astype(jnp.float32)) * scale
+        s = jnp.where(valid[:, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bht,bthd->bhd", p,
+                          vcur.astype(jnp.float32))
+        o = jnp.einsum("bhd,hdn->bn", attn.astype(cfg.dtype),
+                       lyr["wo"].astype(cfg.dtype))
+        x = x + o
+        y = _ln(x.astype(jnp.float32), lyr["ln2"]).astype(cfg.dtype)
+        m = jnp.einsum("bd,df->bf", y, lyr["w1"].astype(cfg.dtype))
+        m = jnp.einsum("bf,fd->bd", jax.nn.gelu(m),
+                       lyr["w2"].astype(cfg.dtype))
+        x = x + m + _moe_dense(y, lyr, cfg)
+    x = _ln(x.astype(jnp.float32), params["ln_f"])
+    return (jnp.einsum("bd,dv->bv", x, params["head"]),
+            k_pages, v_pages)
+
+
+def prefill_chunk_paged(params: Dict[str, Any], k_pages: jnp.ndarray,
+                        v_pages: jnp.ndarray, tokens: jnp.ndarray,
+                        table: jnp.ndarray, start: jnp.ndarray,
+                        true_len: jnp.ndarray, cfg: StreamFormerConfig,
+                        page_size: int, scratch: int
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                   jnp.ndarray]:
+    """One bounded prefill CHUNK for a paged session: process ``C``
+    prompt tokens starting at absolute position ``start``, writing
+    their K/V into the session's pages and attending over everything
+    the pages already hold (a cached/shared prefix, earlier chunks) plus
+    the chunk itself — causally, so chaining chunks reproduces the
+    full-prompt prefill's math.
+
+    - ``tokens (C,) int32``: the chunk, zero-padded past ``true_len``;
+    - ``table (W,) int32``: the session's block table, scratch-padded;
+      ``W*page_size >= start + C`` (caller-quantized);
+    - ``start ()`` / ``true_len ()`` int32: chunk origin and real
+      length — traced operands, so ONE ``(C, W)`` executable serves
+      every chunk of every prompt at every prefix-hit offset;
+    - ``scratch``: the arena's scratch page id (static) — padding
+      queries' writes land there;
+    - returns ``(last_logits (vocab,), k_pages', v_pages')`` where
+      ``last_logits`` is position ``start + true_len - 1``'s row — the
+      final chunk's caller argmaxes it into the session's first token.
+
+    This one function is BOTH levers pages buy: chunked prefill (the
+    engine interleaves these between decode steps so a long prompt
+    cannot stall resident streams) and prefix-cache suffix completion
+    (a prefix hit starts the chunk walk at the shared-page boundary
+    instead of position 0)."""
+    ps = int(page_size)
+    c = tokens.shape[0]
+    w = table.shape[0]
+    span = w * ps
+    qpos = start + jnp.arange(c)                           # (C,) absolute
+    qvalid = jnp.arange(c) < true_len
+    x = (params["embed"][tokens] + params["pos"][qpos]).astype(cfg.dtype)
+    # key position t is visible to chunk query i iff t <= start + i
+    kvalid = jnp.arange(span)[None, :] <= qpos[:, None]    # (C, W*ps)
+    wpage = jnp.where(qvalid, table[qpos // ps], scratch)  # (C,)
+    woff = qpos % ps
+    scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
+    for li, lyr in enumerate(params["layers"]):
+        y = _ln(x.astype(jnp.float32), lyr["ln1"]).astype(cfg.dtype)
+        qkv = jnp.einsum("td,dchn->tchn", y,
+                         lyr["wqkv"].astype(cfg.dtype))
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # (C, H, Dh)
+        li_ix = jnp.full_like(wpage, li)
+        k_pages = k_pages.at[wpage, li_ix, woff].set(k)
+        v_pages = v_pages.at[wpage, li_ix, woff].set(v)
+        kcur = k_pages[table, li].reshape(
+            span, cfg.heads, cfg.head_dim)
+        vcur = v_pages[table, li].reshape(
+            span, cfg.heads, cfg.head_dim)
+        s = jnp.einsum("chd,thd->cht", q.astype(jnp.float32),
+                       kcur.astype(jnp.float32)) * scale
+        s = jnp.where(kvalid[:, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("cht,thd->chd", p,
+                          vcur.astype(jnp.float32))
+        o = jnp.einsum("chd,hdn->cn", attn.astype(cfg.dtype),
+                       lyr["wo"].astype(cfg.dtype))
+        x = x + o
+        y = _ln(x.astype(jnp.float32), lyr["ln2"]).astype(cfg.dtype)
+        m = jnp.einsum("td,df->tf", y, lyr["w1"].astype(cfg.dtype))
+        m = jnp.einsum("tf,fd->td", jax.nn.gelu(m),
+                       lyr["w2"].astype(cfg.dtype))
+        x = x + m + _moe_dense(y, lyr, cfg)
+    x = _ln(x.astype(jnp.float32), params["ln_f"])
+    logits = jnp.einsum("td,dv->tv", x, params["head"])
+    last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=0,
+                                        keepdims=False)
+    return last, k_pages, v_pages
+
+
 def decode_step(params: Dict[str, Any], cache: Dict[str, jnp.ndarray],
                 token: jnp.ndarray, cfg: StreamFormerConfig
                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:

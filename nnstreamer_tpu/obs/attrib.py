@@ -53,6 +53,13 @@ lifetime maps to exactly one):
                           annotated under the REQUEST's trace id, so a
                           client timeline shows its prompt's one-time
                           cost apart from the per-token stream)
+``llm-prefill-chunk``     one BOUNDED prefill chunk interleaved into the
+                          decode loop (paged pool, ``prefill-chunk``
+                          > 0): a long prompt's one-time cost shows as
+                          many small slices time-sharing the decode
+                          thread instead of one monolithic
+                          ``llm-prefill`` stall — the interleave proof
+                          the PhaseClock's share mirrors
 ``llm-decode``            one continuous-batching decode step's shared
                           window — like the cross-stream
                           ``device-invoke``, every resident sequence of
@@ -105,8 +112,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 STATES = (
     "source-pacing", "element-compute", "serialize", "queue-wait",
     "admission-wait", "wire", "device-invoke", "device-compile",
-    "reorder-wait", "llm-prefill", "llm-decode", "sink", "dispatch",
-    "unattributed",
+    "reorder-wait", "llm-prefill", "llm-prefill-chunk", "llm-decode",
+    "sink", "dispatch", "unattributed",
 )
 
 #: span-name prefix for explicit state annotations

@@ -633,3 +633,22 @@ def test_hotpath_bench_llmdecode_gate():
     assert r.returncode == 0, (
         f"llmdecode gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
     assert '"hotpath_llmdecode_gate"' in r.stdout
+
+
+@pytest.mark.perf
+def test_hotpath_bench_llmpaged_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage llmpaged fails
+    when the block-paged KV cache (ISSUE 17) loses any of its bounds:
+    paged decode must stay within 10% of dense tok/s at equal
+    residency, admit >= 2x the short-chat sessions at equal arena
+    bytes, re-prefill a shared long prompt >= 5x faster on a
+    prefix-cache hit than cold, and add zero steady-state compiles
+    after warmup (the bounded block-table/chunk executable grid)."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "llmpaged"],
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, (
+        f"llmpaged gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_llmpaged_gate"' in r.stdout

@@ -24,9 +24,11 @@ import jax.numpy as jnp
 
 from nnstreamer_tpu import parse_launch
 from nnstreamer_tpu.analysis.verify import verify_pipeline
-from nnstreamer_tpu.llm.client import TokenStreamClient, encode_request
+from nnstreamer_tpu.llm.client import (TokenStreamClient,
+                                       TokenTimeoutError, encode_request)
 from nnstreamer_tpu.llm.engine import (DecodeEngine, PhaseClock,
-                                       quantize_prompt)
+                                       quantize_pages, quantize_prompt)
+from nnstreamer_tpu.llm.paged import PagedKVCachePool, chain_hashes
 from nnstreamer_tpu.llm.pool import KVCachePool
 from nnstreamer_tpu.models.streamformer_lm import (config_from_custom,
                                                    decode_step,
@@ -784,3 +786,588 @@ class TestPerfDiffPinned:
             assert checks.get(name) is True, (name, checks)
         assert v["llm"]["speedup_vs_solo"] >= 2.0
         assert v["attribution"]["conserved_pct"] == 100.0
+
+# ---------------------------------------------------------------------------
+# paged KV cache (block tables + prefix reuse + chunked prefill)
+# ---------------------------------------------------------------------------
+
+class TestQuantizePages:
+    def test_pow2_widths_bounded(self):
+        assert [quantize_pages(n, 12) for n in (1, 2, 3, 4, 5, 8, 9, 12)] \
+            == [1, 2, 4, 4, 8, 8, 12, 12]
+        # the warm set over a 12-page table is {1, 2, 4, 8, 12}: five
+        # executables cover EVERY session length
+        assert {quantize_pages(n, 12) for n in range(1, 13)} \
+            == {1, 2, 4, 8, 12}
+
+
+class TestChainHashes:
+    def test_chain_extends_not_commutes(self):
+        """h_j commits to the WHOLE prefix, not page j alone: two
+        prompts sharing page 1's bytes but not page 0's must not
+        collide (a positional hash would cross-link their caches)."""
+        a = chain_hashes(np.arange(8, dtype=np.int32), 4)
+        b = chain_hashes(np.concatenate([np.arange(4, 8),
+                                         np.arange(4, 8)]).astype(np.int32),
+                         4)
+        assert len(a) == len(b) == 2
+        assert a[1] != b[1]          # same page-1 tokens, different chain
+
+    def test_partial_tail_page_never_hashed(self):
+        assert len(chain_hashes(np.arange(7, dtype=np.int32), 4)) == 1
+
+
+class TestPagedPool:
+    def _pool(self, pages=59, slots=8, ps=4, clock=None, **kw):
+        return PagedKVCachePool(_cfg(), pages, ps, slots=slots,
+                                clock=clock, **kw)
+
+    def test_arena_bytes_match_dense_at_element_sizing(self):
+        """(slots+1)*table_max - 1 pages + scratch == the dense pool's
+        (slots+1) full-length lanes, byte for byte — the residency
+        claim is apples to apples."""
+        cfg = _cfg()
+        dense = KVCachePool(cfg, 8)
+        paged = self._pool(pages=(8 + 1) * 12 - 1, slots=8)
+        assert paged.cache_bytes() == dense.cache_bytes()
+
+    def test_prefix_hit_pins_and_cow_isolates(self):
+        # 10 tokens = 2 full pages + a 2-token tail (the tail keeps the
+        # exact-length cap out of the way: cap (10-1)//4 = 2 pages)
+        pool = self._pool()
+        prompt = (np.arange(10) % 61).astype(np.int32)
+        a = pool.acquire("a", prompt=prompt, max_new=4)
+        pool.grow(a, 10)
+        pool.note_prefill(a, 10)
+        shared = list(a.table[:2])
+        pool.release("a")
+        assert pool.stats()["reclaimable"] == 2   # registered, refs 0
+        b = pool.acquire("b", prompt=prompt, max_new=4)
+        assert pool.prefix_hits == 1
+        assert b.shared_tokens == 8 and b.prefill_pos == 8
+        assert b.table[:2] == shared              # the SAME pages
+        pool.grow(b, 10)                          # b's private tail page
+        assert pool._page_hash[b.table[2]] is None  # unhashed: COW land
+        pool.release("b")
+        assert pool.free_pages == pool.pages
+        assert pool.check_leaks() == []
+
+    def test_hit_capped_below_full_prompt(self):
+        """An exact-length hit must leave >= 1 suffix token to compute
+        (the model needs a forward pass to emit token 0)."""
+        pool = self._pool()
+        prompt = (np.arange(8) % 61).astype(np.int32)
+        a = pool.acquire("a", prompt=prompt, max_new=2)
+        pool.grow(a, 8)
+        pool.note_prefill(a, 8)
+        pool.release("a")
+        b = pool.acquire("b", prompt=prompt, max_new=2)
+        assert b.shared_tokens == 4               # cap (8-1)//4 = 1 page
+        pool.release("b")
+
+    def test_admission_is_commitment_based(self):
+        """admit() reasons about worst-case PAGES net of the prefix
+        hit, not slots: a request whose private remainder cannot fit
+        sheds BEFORE acquire, so grow() can never fail mid-stream."""
+        pool = self._pool(pages=7, slots=8)
+        big = np.arange(20, dtype=np.int32) % 61
+        assert pool.admit("gold", prompt=big, max_new=9) is not None
+        assert pool.admit("gold", prompt=big, max_new=8) is None
+        sess = pool.acquire("a", prompt=big, max_new=8)
+        pool.grow(sess, 28)                       # the full commitment
+        assert pool.admit("gold", prompt=np.arange(4, dtype=np.int32),
+                          max_new=1) is not None  # arena exhausted
+        pool.release("a")
+        assert pool.check_leaks() == []
+
+    def test_reclaim_is_lru_and_reset_frees(self):
+        pool = self._pool(pages=8, slots=4)
+        for i, key in enumerate(("a", "b")):
+            prompt = ((np.arange(8) + 10 * i) % 61).astype(np.int32)
+            s = pool.acquire(key, prompt=prompt, max_new=4)
+            pool.grow(s, 8)
+            pool.note_prefill(s, 8)
+            pool.release(key)
+        assert pool.stats()["reclaimable"] == 4
+        assert pool.free_pages == 8
+        # allocation pressure past the free list (4 free pages, c needs
+        # 5) reclaims a registered page, LRU chain first
+        c = pool.acquire("c", prompt=np.full(12, 7, np.int32), max_new=8)
+        pool.grow(c, 20)
+        assert pool.pages_reclaimed >= 1
+        pool.release("c")
+        assert pool.reset_prefix_cache() > 0
+        assert pool.stats()["reclaimable"] == 0
+        assert pool.free_pages == 8
+
+    def test_fragmentation_churn_property(self):
+        """Satellite 3: randomized join/leave churn — short chats,
+        shared prefixes, mid-prefill abandons, cache resets — must end
+        with every page back (free_pages == pages) and zero refcount /
+        reservation leaks, under an injected clock (no wall-time
+        dependence).  The mid-churn conservation identity holds too:
+        free + reclaimable + uniquely-held == pages at every audit."""
+        t = {"now": 0.0}
+        pool = self._pool(clock=lambda: t["now"])
+        rng = np.random.default_rng(1234)
+        live = {}
+        for step in range(400):
+            t["now"] += 0.01
+            roll = rng.random()
+            if live and (len(live) >= pool.slots or roll < 0.40):
+                key = list(live)[int(rng.integers(0, len(live)))]
+                live.pop(key)
+                pool.release(key)
+            elif roll < 0.45:
+                pool.reset_prefix_cache()
+            else:
+                plen = int(rng.integers(1, 20))
+                max_new = int(rng.integers(1, 12))
+                if rng.random() < 0.5:   # shared-prompt family: hits
+                    prompt = (np.arange(plen) % 61).astype(np.int32)
+                else:
+                    prompt = rng.integers(0, 61, plen).astype(np.int32)
+                if pool.admit("silver", prompt=prompt,
+                              max_new=max_new) is not None:
+                    continue
+                key = f"s{step}"
+                sess = pool.acquire(key, prompt=prompt, max_new=max_new)
+                live[key] = sess
+                # drive the engine's paged life cycle to a random depth:
+                # abandon mid-prefill, after prefill, or mid-decode
+                upto = int(rng.integers(sess.prefill_pos, plen + 1))
+                pool.grow(sess, upto)
+                pool.note_prefill(sess, upto)
+                if upto == plen and rng.random() < 0.7:
+                    pool.grow(sess, plen + int(rng.integers(0, max_new)))
+            if step % 25 == 0:
+                held = {pg for s in live.values() for pg in s.table}
+                stats = pool.stats()
+                assert stats["free"] + stats["reclaimable"] \
+                    + len(held) == pool.pages, (step, stats)
+        for key in list(live):
+            pool.release(key)
+        assert pool.free_pages == pool.pages
+        assert pool.check_leaks() == []
+        assert pool.stats()["reserved"] == 0
+
+
+PAGED = "slots=4 batch=4 page-size=4"
+
+
+class TestPagedElementLocal:
+    def _refs(self, params, cfg, prompts, lens):
+        from nnstreamer_tpu.models.streamformer_lm import generate
+        return [generate(params, cfg, pr, n).tolist()
+                for pr, n in zip(prompts, lens)]
+
+    def _run(self, props, prompts, lens, sequential=False):
+        p, by_key, _ = build_local(props)
+        p.play()
+        for i, (pr, n) in enumerate(zip(prompts, lens)):
+            buf = TensorBuffer(tensors=[encode_request(
+                pr, max_new=n, frame_len=24)])
+            buf.extra["tag"] = i
+            p.get("src").push_buffer(buf)
+            if sequential:
+                assert wait_until(
+                    lambda i=i, n=n: len(by_key.get(i, [])) >= n,
+                    timeout=120)
+        p.get("src").end_of_stream()
+        p.wait(timeout=180)
+        llm = p.get("llm")
+        eng, pool = llm.engine, llm.pool
+        snap = {                 # stop() drops engine+pool: snapshot
+            "paged": eng.paged, "chunk": eng.chunk,
+            "compiles": eng.compiles, "report": eng.report(),
+            "cache_bytes": pool.cache_bytes(),
+            "prefix_hits": getattr(pool, "prefix_hits", 0),
+            "prefix_tokens_reused": getattr(pool,
+                                            "prefix_tokens_reused", 0),
+            "free_pages": getattr(pool, "free_pages", None),
+            "pages": getattr(pool, "pages", None),
+            "leaks": (pool.check_leaks()
+                      if hasattr(pool, "check_leaks") else []),
+        }
+        p.stop()
+        return by_key, snap
+
+    def test_paged_whole_prefill_matches_generate(self):
+        """THE paged contract: block-table decode through the element
+        is token-byte-identical to the compiled generate() scan."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 61, 4 + 2 * i).astype(np.int32)
+                   for i in range(3)]
+        lens = [7, 4, 9]
+        refs = self._refs(params, cfg, prompts, lens)
+        by_key, snap = self._run(PAGED + " prefill-chunk=0",
+                                 prompts, lens)
+        assert snap["paged"]
+        for i in range(3):
+            toks = [t for _, t, _ in by_key[i]]
+            pts = [q for q, _, _ in by_key[i]]
+            assert pts == list(range(lens[i]))
+            assert toks == refs[i], (i, toks, refs[i])
+
+    def test_paged_chunked_prefill_matches_generate(self):
+        """Chunked prefill (bounded chunks interleaved with decode
+        steps) lands on the SAME tokens: prompts longer than the chunk
+        force multi-chunk prefills while earlier sessions decode."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, 61, n).astype(np.int32)
+                   for n in (13, 5, 17)]
+        lens = [6, 8, 5]
+        refs = self._refs(params, cfg, prompts, lens)
+        by_key, snap = self._run(PAGED + " prefill-chunk=4",
+                                 prompts, lens)
+        assert snap["chunk"] == 4
+        assert snap["report"]["prefill_chunks"] >= 2
+        for i in range(3):
+            assert [t for _, t, _ in by_key[i]] == refs[i]
+
+    def test_prefix_hit_reuses_pages_and_isolates_tails(self):
+        """Two sessions sharing an 8-token system prompt, run back to
+        back: the second admits onto the first's registered pages (hit
+        counted, 8 tokens never re-prefilled) and BOTH streams still
+        match their own generate() — copy-on-write isolation."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        pre = (np.arange(8) % 61).astype(np.int32)
+        prompts = [np.concatenate([pre, np.asarray(t, np.int32)])
+                   for t in ([3, 9], [44, 1])]
+        lens = [6, 6]
+        refs = self._refs(params, cfg, prompts, lens)
+        by_key, snap = self._run(PAGED, prompts, lens,
+                                 sequential=True)
+        assert snap["prefix_hits"] >= 1
+        assert snap["prefix_tokens_reused"] >= 8
+        for i in range(2):
+            assert [t for _, t, _ in by_key[i]] == refs[i]
+        assert snap["leaks"] == []
+        assert snap["free_pages"] == snap["pages"]
+
+    def test_dense_mode_unchanged_and_bytes_equal(self):
+        """page-size=0 still runs the dense pool, and the default
+        paged arena sizes to the SAME device bytes."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        prompts = [np.asarray([5, 6, 7], np.int32)]
+        refs = self._refs(params, cfg, prompts, [5])
+        by_key, snap_d = self._run("slots=4 batch=4 page-size=0",
+                                   prompts, [5])
+        assert not snap_d["paged"]
+        assert [t for _, t, _ in by_key[0]] == refs[0]
+        by_key2, snap_p = self._run(PAGED, prompts, [5])
+        assert [t for _, t, _ in by_key2[0]] == refs[0]
+        assert snap_p["cache_bytes"] == snap_d["cache_bytes"]
+
+    def test_zero_steady_state_compiles_after_warmup(self):
+        """The pow2 width/row grid warmed at start() covers the whole
+        serving mix: a heterogeneous session stream adds ZERO compiles
+        (the bounded-executables contract, paged edition)."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        p, by_key, _ = build_local(PAGED + " prefill-chunk=4")
+        p.play()
+        warm = p.get("llm").engine.compiles
+        rng = np.random.default_rng(3)
+        # 4 sessions for 4 slots: nothing sheds, every stream completes
+        for i, (plen, n) in enumerate([(3, 5), (14, 7),
+                                       (19, 6), (1, 8)]):
+            buf = TensorBuffer(tensors=[encode_request(
+                rng.integers(0, 61, plen).astype(np.int32),
+                max_new=n, frame_len=24)])
+            buf.extra["tag"] = i
+            p.get("src").push_buffer(buf)
+        p.get("src").end_of_stream()
+        p.wait(timeout=180)
+        compiles = p.get("llm").engine.compiles
+        p.stop()
+        assert sum(len(v) for v in by_key.values()) == 5 + 7 + 6 + 8
+        assert compiles == warm, (warm, compiles)
+
+
+# ---------------------------------------------------------------------------
+# per-token inactivity timeout (client)
+# ---------------------------------------------------------------------------
+
+class TestTokenTimeout:
+    def test_stalled_stream_raises_named_error_and_drains(self):
+        """A server that accepts the request then never replies: the
+        stream raises TokenTimeoutError (not a bare socket timeout) at
+        the per-token deadline, carrying how many tokens arrived — and
+        the reply queue's leased slabs are drained, not leaked."""
+        import socket
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        held = []
+
+        def accept_and_stall():
+            conn, _ = srv.accept()
+            held.append(conn)          # read nothing, send nothing
+
+        t = threading.Thread(target=accept_and_stall, daemon=True)
+        t.start()
+        gc.collect()
+        pending0 = default_pool().stats["pending"]
+        cli = TokenStreamClient("127.0.0.1", port, timeout=30.0,
+                                token_timeout=0.3).connect()
+        t0 = time.monotonic()
+        with pytest.raises(TokenTimeoutError) as ei:
+            cli.generate(np.asarray([1, 2, 3], np.int32), 8,
+                         frame_len=24)
+        took = time.monotonic() - t0
+        assert took < 5.0                      # the PER-TOKEN deadline,
+        #                                        not the 30 s transport
+        assert ei.value.got == 0
+        assert ei.value.timeout_s == pytest.approx(0.3)
+        assert isinstance(ei.value, TimeoutError)
+        cli.close()
+        for c in held:
+            c.close()
+        srv.close()
+        gc.collect()
+        assert default_pool().stats["pending"] == pending0
+
+    def test_stream_override_beats_constructor_default(self):
+        import socket
+
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        held = []
+        threading.Thread(target=lambda: held.append(srv.accept()),
+                         daemon=True).start()
+        cli = TokenStreamClient("127.0.0.1", port, timeout=30.0,
+                                token_timeout=20.0).connect()
+        with pytest.raises(TokenTimeoutError) as ei:
+            list(cli.stream(np.asarray([1], np.int32), 4, frame_len=24,
+                            token_timeout=0.2))
+        assert ei.value.timeout_s == pytest.approx(0.2)
+        cli.close()
+        for c, _ in held:
+            c.close()
+        srv.close()
+
+    def test_healthy_stream_unaffected(self):
+        """A generous per-token budget never fires on a live server."""
+        cfg = _cfg()
+        params = init_params(cfg, 0)
+        prompt = np.asarray([5, 6], np.int32)
+        ref = generate(params, cfg, prompt, 6).tolist()
+        p, port = build_server("slots=2 batch=2", sid=SID + 70)
+        cli = TokenStreamClient("127.0.0.1", port, timeout=60.0,
+                                token_timeout=30.0).connect()
+        assert cli.generate(prompt, 6, frame_len=24) == ref
+        cli.close()
+        p.stop()
+        shutdown_server(SID + 70)
+
+
+class TestVerifyRulesPaged:
+    def _findings(self, llm_props, custom=CUSTOM):
+        p = parse_launch(
+            f"appsrc name=src caps={REQ_CAPS} ! "
+            f"tensor_llm name=llm custom={custom} {llm_props} ! "
+            "fakesink")
+        return verify_pipeline(p)
+
+    def test_page_size_must_tile_max_seq(self):
+        fs = self._findings("slots=4 batch=2 page-size=5")
+        hit = [f for f in fs if f.rule == "llm-page-size"]
+        assert hit and hit[0].severity == "error"
+        assert "tile" in hit[0].message
+
+    def test_negative_page_size_is_named_error(self):
+        fs = self._findings("slots=4 batch=2 page-size=-1")
+        assert [f for f in fs if f.rule == "llm-page-size"]
+
+    def test_prefix_without_pages_is_named_error(self):
+        fs = self._findings("slots=4 batch=2 page-size=0 prefix-cache=1")
+        hit = [f for f in fs if f.rule == "llm-prefix-without-pages"]
+        assert hit and hit[0].severity == "error"
+
+    def test_chunk_without_pages_is_named_error(self):
+        fs = self._findings("slots=4 batch=2 page-size=0 "
+                            "prefill-chunk=8")
+        assert [f for f in fs
+                if f.rule == "llm-prefix-without-pages"]
+
+    def test_clean_paged_config_has_no_findings(self):
+        fs = self._findings("slots=4 batch=2 page-size=4 "
+                            "prefill-chunk=8 prefix-cache=1")
+        assert not [f for f in fs if f.rule.startswith("llm-")]
+
+
+# ---------------------------------------------------------------------------
+# perf_diff: renamed/vanished metrics FAIL by name
+# ---------------------------------------------------------------------------
+
+def _load_perf_diff():
+    import importlib.util
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    spec = importlib.util.spec_from_file_location(
+        "perf_diff", os.path.join(root, "tools", "perf_diff.py"))
+    pd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pd)
+    return pd
+
+
+class TestPerfDiffMissingMetric:
+    def _row(self, metric, value, unit="tokens_per_s"):
+        return {"metric": metric, "value": value, "unit": unit,
+                "status": "live"}
+
+    def test_renamed_metric_fails_and_names_suspect(self):
+        """Satellite: a candidate whose stage renamed its metric key
+        must FAIL with the old name AND point at the likely new key —
+        not silently skip the band it was gated by."""
+        pd = _load_perf_diff()
+        base = [self._row("soak_llm_tokens_per_s", 100.0)]
+        cand = [self._row("soak_llm_tok_s", 99.0)]
+        verdict = pd.diff([base, base], cand)
+        assert not verdict["pass"]
+        missing = [r for r in verdict["regressions"]
+                   if r["verdict"] == "MISSING"]
+        assert missing and missing[0]["metric"] == "soak_llm_tokens_per_s"
+        assert missing[0]["rename_suspects"] == ["soak_llm_tok_s"]
+        assert "soak_llm_tok_s" in missing[0]["reason"]
+
+    def test_single_baseline_sample_still_fails_missing(self):
+        """Even ONE baseline run measuring the metric arms the check:
+        a single-sample metric can never regress by value (no band),
+        but vanishing entirely is a gate failure regardless."""
+        pd = _load_perf_diff()
+        a = [self._row("hotpath_llmpaged_tok_s", 50.0),
+             self._row("other", 1.0)]
+        b = [self._row("other", 1.0)]
+        cand = [self._row("other", 1.0)]
+        verdict = pd.diff([a, b], cand)
+        assert not verdict["pass"]
+        missing = [r for r in verdict["regressions"]
+                   if r["verdict"] == "MISSING"]
+        assert missing[0]["metric"] == "hotpath_llmpaged_tok_s"
+        assert "1 baseline run(s)" in missing[0]["reason"]
+        assert "rename_suspects" not in missing[0]
+
+    def test_present_metric_still_passes(self):
+        pd = _load_perf_diff()
+        base = [self._row("a", 100.0)]
+        verdict = pd.diff([base, base], [self._row("a", 101.0)])
+        assert verdict["pass"]
+
+
+# ---------------------------------------------------------------------------
+# pinned perf_diff gate on the committed paged acceptance artifact
+# ---------------------------------------------------------------------------
+
+class TestPerfDiffPinnedPaged:
+    """The committed SOAK_llm_paged_r17.json rows pin the paged-serving
+    acceptance: an eroded residency win or a ballooned prefill share
+    FAILS tier-1 here, and the attribution delta names the regressed
+    stage (the SOAK_llm_r15.json discipline, paged edition)."""
+
+    def _load(self):
+        import json
+        import os
+
+        pd = _load_perf_diff()
+        root = os.path.join(os.path.dirname(__file__), "..")
+        with open(os.path.join(root, "SOAK_llm_paged_r17.json"),
+                  encoding="utf-8") as fh:
+            doc = json.load(fh)
+        return pd, doc
+
+    def test_committed_rows_self_pass(self):
+        pd, doc = self._load()
+        rows = doc["rows"]
+        verdict = pd.diff([rows, rows], rows, margin_pct=10.0)
+        assert verdict["pass"], verdict
+
+    def test_eroded_residency_regresses(self):
+        import copy
+
+        pd, doc = self._load()
+        rows = doc["rows"]
+        eroded = copy.deepcopy(rows)
+        for row in eroded:
+            if row["metric"] == "soak_llm_paged_residency_ratio":
+                row["value"] *= 0.4      # paging win collapsed to dense
+        verdict = pd.diff([rows, rows], eroded, margin_pct=10.0)
+        assert not verdict["pass"]
+        assert [r for r in verdict["regressions"]
+                if r["metric"] == "soak_llm_paged_residency_ratio"]
+
+    def test_eroded_throughput_names_chunk_stage(self):
+        import copy
+
+        pd, doc = self._load()
+        rows = doc["rows"]
+        eroded = copy.deepcopy(rows)
+        for row in eroded:
+            if row["metric"] == "soak_llm_paged_tokens_per_s":
+                row["value"] *= 0.4
+                states = row.setdefault("attribution", {}).setdefault(
+                    "states", {})
+                # e.g. unbounded chunks stalling decode: chunk share
+                # balloons while tokens/s falls
+                states["llm-prefill-chunk"] = states.get(
+                    "llm-prefill-chunk", 0.0) + 30.0
+        verdict = pd.diff([rows, rows], eroded, margin_pct=10.0)
+        assert not verdict["pass"]
+        reg = [r for r in verdict["regressions"]
+               if r["metric"] == "soak_llm_paged_tokens_per_s"]
+        assert reg, verdict["regressions"]
+        blame = reg[0].get("attribution")
+        assert blame \
+            and blame["regressed_stage"] == "llm-prefill-chunk"
+
+    def test_renamed_row_fails_missing_with_suspect(self):
+        """The satellite wired to the artifact: dropping/renaming a
+        pinned row key fails by NAME (never a silent skip)."""
+        import copy
+
+        pd, doc = self._load()
+        rows = doc["rows"]
+        renamed = copy.deepcopy(rows)
+        for row in renamed:
+            if row["metric"] == "soak_llm_paged_prefix_hits_warm":
+                row["metric"] = "soak_llm_paged_hits"
+        verdict = pd.diff([rows, rows], renamed, margin_pct=10.0)
+        assert not verdict["pass"]
+        missing = [r for r in verdict["regressions"]
+                   if r["verdict"] == "MISSING"]
+        assert missing[0]["metric"] == "soak_llm_paged_prefix_hits_warm"
+        assert "soak_llm_paged_hits" in missing[0]["rename_suspects"]
+
+    def test_committed_artifact_gates_hold(self):
+        """The committed artifact must BE a pass with every paged
+        acceptance box checked — committing a FAIL (or gutting a
+        check) turns tier-1 red here."""
+        _, doc = self._load()
+        assert doc["pass"] and doc["verdict"] == "PASS"
+        checks = doc["llm_paged"]["checks"]
+        for name in ("zero_errors", "exact_order",
+                     "arena_bytes_equal_dense", "arena_bytes_fixed",
+                     "residency_2x_dense", "replay_identical_to_dense",
+                     "prefix_hits_warm", "prefill_share_drops_warm",
+                     "chunk_share_present", "zero_steady_compiles",
+                     "zero_page_leaks", "slabs_settled",
+                     "attribution_conserved"):
+            assert checks.get(name) is True, (name, checks)
+        lp = doc["llm_paged"]
+        assert lp["residency_ratio_vs_dense"] >= 2.0
+        assert lp["arena_bytes"] == lp["dense_arena_bytes"]
+        assert lp["prefix_hits_warm"] > 0
+        assert lp["steady_state_compiles"] == 0

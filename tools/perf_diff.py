@@ -185,19 +185,29 @@ def diff(baselines: List[List[Dict[str, Any]]],
                 row["attribution"] = attr
             regressions.append(row)
         results.append(row)
-    # a metric BOTH baselines measured that the candidate no longer
-    # emits is a failure, not a silent pass: a run that crashed before
-    # producing its rows (or a stage that stopped measuring) must not
-    # exit 0 — removing a measurement has to be acknowledged by
-    # refreshing the baselines
+    # a metric ANY baseline measured that the candidate no longer emits
+    # is a failure, not a silent pass: a run that crashed before
+    # producing its rows, a stage that stopped measuring, or a RENAMED
+    # key (tokens_per_s -> tok_s evades every band it was gated by)
+    # must not exit 0 — removing a measurement has to be acknowledged
+    # by refreshing the baselines.  Candidate-only metrics are named as
+    # rename suspects so the verdict points at the likely new key.
+    cand_only = sorted(m for m in cand_by_metric if m not in by_metric)
     for m, base_rows in sorted(by_metric.items()):
-        if m in cand_by_metric or len(base_rows) < 2:
+        if m in cand_by_metric:
             continue
+        n = len(base_rows)
+        reason = (f"measured by {n} baseline run(s), absent from the "
+                  "candidate")
+        if cand_only:
+            reason += (" — candidate-only metric(s) "
+                       f"{', '.join(cand_only)} are rename suspects")
         row = {"metric": m, "verdict": "MISSING",
                "band": [min(float(r["value"]) for r in base_rows),
                         max(float(r["value"]) for r in base_rows)],
-               "reason": "measured by both baselines, absent from the "
-                         "candidate"}
+               "reason": reason}
+        if cand_only:
+            row["rename_suspects"] = list(cand_only)
         regressions.append(row)
         results.append(row)
     return {"metric": "perf_diff", "pass": not regressions,

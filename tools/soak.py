@@ -1203,6 +1203,372 @@ def run_llm(args, ap) -> int:
     return 0 if verdict["pass"] else 1
 
 
+LLM_DENSE_REF_ID = 96
+
+
+def llm_paged_server_line(slots: int, batch: int, pages: int,
+                          page_size: int, chunk: int,
+                          sid: int = LLM_SERVER_ID) -> str:
+    return (f"tensor_query_serversrc name=qsrc id={sid} port=0 "
+            f"caps={LLM_CAPS} ! "
+            f"tensor_llm name=llm custom={LLM_CUSTOM} seed=0 "
+            f"slots={slots} batch={batch} id={sid} "
+            f"page-size={page_size} pages={pages} "
+            f"prefill-chunk={chunk} prefix-cache=1 "
+            f"max-new-tokens=96 ! "
+            f"tensor_query_serversink id={sid}")
+
+
+def run_llm_paged(args, ap) -> int:
+    """Paged-KV serving acceptance soak (ISSUE 17): the short-chat mix
+    against a ``tensor_llm`` server backed by the block-paged arena,
+    sized to the SAME device bytes as a dense reference server.  Gates:
+
+    - **memory-proportional residency**: peak concurrently-resident
+      sessions on the paged server >= 2x the dense server's slot count
+      at identical arena bytes (the whole point of paging);
+    - **byte-identity**: a probe prompt streamed on the DENSE server is
+      the reference; the paged server replays it mid-soak (different
+      bucket compositions, chunked prefill interleave) and idle — every
+      stream must be token-identical;
+    - **prefix caching pays**: phase A runs UNIQUE prompts (cold),
+      phase B the same mix behind one shared 64-token system prompt —
+      phase B must show prefix-cache hits and a busy-time prefill share
+      measurably below phase A's (only the per-client tail computes);
+    - **chunked prefill interleaves**: the PhaseClock's
+      ``llm-prefill-chunk`` share is nonzero (prompts advance in
+      bounded chunks between decode steps, never as one stall);
+    - **bounded memory**: arena bytes identical before/after, zero page
+      / refcount / reservation leaks after drain, zero leaked slabs;
+    - **zero steady-state compiles** after the paged warmup grid;
+    - **zero client errors** and **exact per-client order**, as ever.
+    """
+    import threading as _threading
+    import time as _time
+
+    import numpy as np
+
+    from nnstreamer_tpu import parse_launch
+    from nnstreamer_tpu.llm.client import TokenStreamClient
+    from nnstreamer_tpu.query.overload import ShedError
+    from nnstreamer_tpu.query.server import get_server, shutdown_server
+    from nnstreamer_tpu.tensor.buffer import default_pool
+
+    os.makedirs(args.out, exist_ok=True)
+    batch = args.llm_batch
+    dense_slots = max(3, args.llm_slots // 2)
+    paged_slots = 4 * dense_slots
+    page_size = 8
+    table_max = 512 // page_size          # LLM_CUSTOM max_seq
+    pages = (dense_slots + 1) * table_max - 1   # == dense arena bytes
+    # chunk 8 = one page per chunk: a cold 84-88-token prompt costs 11
+    # chunks, a warm one (10 shared pages hit, a <=8-token tail) exactly
+    # 1 — the contrast the prefill-share gate measures
+    chunk = 8
+    clients = args.clients or paged_slots + 4
+    duration = args.duration
+    probe_prompt = np.arange(7, dtype=np.int32) % 512
+    probe_new = 24
+    sys_prompt = (np.arange(80, dtype=np.int32) * 7 + 11) % 512
+
+    def _probe(cli, counters):
+        while True:
+            try:
+                return cli.generate(probe_prompt, probe_new,
+                                    frame_len=LLM_REQ_CAP)
+            except ShedError as exc:
+                counters["sheds"] += 1
+                _time.sleep(min(exc.retry_after_s, 1.0))
+
+    # 1. dense reference server: the probe's byte-identity baseline and
+    # the arena-bytes / residency baseline (a dense pool can never hold
+    # more than `dense_slots` sessions — that IS the waste)
+    dense_batch = min(batch, dense_slots)
+    dense = parse_launch(llm_server_line(dense_slots, dense_batch,
+                                         sid=LLM_DENSE_REF_ID))
+    dense.play()
+    dense_port = dense.get("qsrc").bound_port
+    dense_bytes = dense.get("llm").pool.cache_bytes()
+    ref_counters = {"sheds": 0}
+    cli = TokenStreamClient("127.0.0.1", dense_port,
+                            timeout=120.0).connect()
+    probe_ref = _probe(cli, ref_counters)
+    probe_ref2 = _probe(cli, ref_counters)
+    cli.close()
+    dense.stop()
+    shutdown_server(LLM_DENSE_REF_ID)
+
+    # 2. the paged server, at the DENSE server's arena bytes
+    pipeline = parse_launch(llm_paged_server_line(
+        paged_slots, batch, pages, page_size, chunk))
+    pipeline.play()
+    port = pipeline.get("qsrc").bound_port
+    llm = pipeline.get("llm")
+    pool = llm.pool
+    cache_bytes_start = pool.cache_bytes()
+    compiles_warm = llm.engine.compiles   # warmup grid is complete here
+
+    stop = _threading.Event()
+    phase = {"mode": "cold"}
+    stats = []
+    errors = []
+    peak = {"live": 0}
+
+    def sampler_loop():
+        while not stop.is_set():
+            peak["live"] = max(peak["live"], pool.live)
+            stop.wait(0.03)
+
+    def client_loop(i):
+        counters = {"tokens": 0, "sessions": 0, "sheds": 0}
+        stats.append(counters)
+        rng = np.random.default_rng(2000 + args.seed + i)
+        try:
+            cli = TokenStreamClient("127.0.0.1", port,
+                                    timeout=120.0).connect()
+            while not stop.is_set():
+                if phase["mode"] == "cold":
+                    # unique prompt, same length as the warm mix: the
+                    # prefill WORK matches, only the sharing differs
+                    prompt = rng.integers(
+                        0, 512, 80 + int(rng.integers(4, 9))
+                    ).astype(np.int32)
+                else:
+                    tail = rng.integers(
+                        0, 512, int(rng.integers(4, 9))).astype(np.int32)
+                    prompt = np.concatenate([sys_prompt, tail])
+                # 24-41 output tokens: long enough that a warm session
+                # (one tail chunk) is decode-dominated while a cold one
+                # (11 chunks) stays prefill-bound — the share contrast
+                # the warm gate measures
+                n_new = int(rng.integers(24, 42))
+                try:
+                    toks = cli.generate(prompt, n_new,
+                                        frame_len=LLM_REQ_CAP)
+                    counters["tokens"] += len(toks)
+                    counters["sessions"] += 1
+                    # a short think time keeps demand rate-limited, not
+                    # saturation-limited: cheaper prefill then SHOWS as
+                    # a smaller busy share instead of more admissions
+                    stop.wait(0.04)
+                except ShedError as exc:
+                    counters["sheds"] += 1
+                    _time.sleep(min(exc.retry_after_s, 1.0))
+            cli.close()
+        except Exception as exc:  # noqa: BLE001 — the zero-errors gate
+            if not stop.is_set():
+                errors.append(f"client {i}: {exc!r}")
+
+    probe_paged = []
+
+    def probe_loop():
+        counters = {"sheds": 0}
+        try:
+            cli = TokenStreamClient("127.0.0.1", port,
+                                    timeout=120.0).connect()
+            for _ in range(2):
+                _time.sleep(duration / 4)
+                probe_paged.append(_probe(cli, counters))
+            cli.close()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(f"probe: {exc!r}")
+
+    threads = [_threading.Thread(target=client_loop, args=(i,),
+                                 daemon=True) for i in range(clients)]
+    threads.append(_threading.Thread(target=probe_loop, daemon=True))
+    threads.append(_threading.Thread(target=sampler_loop, daemon=True))
+    t0 = _time.monotonic()
+    for t in threads:
+        t.start()
+
+    def _phase_snap():
+        rep = llm.engine.phases.report()
+        return (dict(rep["states_s"]),
+                {"hits": pool.prefix_hits,
+                 "reused": pool.prefix_tokens_reused})
+
+    cold0, pfx0 = _phase_snap()
+    stop.wait(duration / 2)
+    # seed the warm registry BEFORE the cohort flips: prefix pages
+    # register only as a prefill ADVANCES past them, so 24 sessions
+    # admitting the shared prompt simultaneously would all miss (the
+    # cold-identical race) — one completed session first, and every
+    # warm admission after it hits
+    seed_cli = TokenStreamClient("127.0.0.1", port,
+                                 timeout=120.0).connect()
+    while True:
+        try:
+            seed_cli.generate(
+                np.concatenate([sys_prompt,
+                                np.asarray([1, 2, 3], np.int32)]),
+                8, frame_len=LLM_REQ_CAP)
+            break
+        except ShedError as exc:
+            _time.sleep(min(exc.retry_after_s, 1.0))
+    seed_cli.close()
+    cold1, pfx1 = _phase_snap()
+    phase["mode"] = "warm"
+    stop.wait(duration / 2)
+    warm1, pfx2 = _phase_snap()
+    stop.set()
+    for t in threads:
+        t.join(timeout=180)
+    soak_s = _time.monotonic() - t0
+
+    def _busy_prefill_share(a, b):
+        d = {k: b[k] - a[k] for k in b}
+        busy = sum(v for k, v in d.items() if k != "idle")
+        pre = d.get("prefill", 0.0) + d.get("llm-prefill-chunk", 0.0)
+        return pre / max(1e-9, busy), d
+
+    cold_share, cold_states = _busy_prefill_share(cold0, cold1)
+    warm_share, warm_states = _busy_prefill_share(cold1, warm1)
+    hits_cold = pfx1["hits"] - pfx0["hits"]
+    hits_warm = pfx2["hits"] - pfx1["hits"]
+    reused_warm = pfx2["reused"] - pfx1["reused"]
+
+    srv = get_server(LLM_SERVER_ID)
+    deadline = _time.monotonic() + 30
+    while srv._inflight > 0 and _time.monotonic() < deadline:
+        _time.sleep(0.1)
+    # idle replay: bucket composition nothing like mid-soak
+    final_counters = {"sheds": 0}
+    cli = TokenStreamClient("127.0.0.1", port, timeout=120.0).connect()
+    probe_paged.append(_probe(cli, final_counters))
+    cli.close()
+    deadline = _time.monotonic() + 30
+    while srv._inflight > 0 and _time.monotonic() < deadline:
+        _time.sleep(0.1)
+    engine_report = llm.engine.report()
+    compiles_end = llm.engine.compiles
+    cache_bytes_end = pool.cache_bytes()
+    leaks = pool.check_leaks()
+    free_end = pool.free_pages
+    inflight_end = srv._inflight
+    evicted = llm.evicted_total
+    pipeline.stop()
+    shutdown_server(LLM_SERVER_ID)
+    import gc
+
+    gc.collect()
+    pool_pending = default_pool().stats["pending"]
+
+    tokens = sum(c["tokens"] for c in stats)
+    sessions = sum(c["sessions"] for c in stats)
+    sheds_client = sum(c["sheds"] for c in stats)
+    tok_s = tokens / soak_s
+    phases = engine_report["phases"]
+    probes_all = [probe_ref, probe_ref2] + probe_paged
+    checks = {
+        "zero_errors": not errors,
+        "exact_order": not any("order" in e for e in errors),
+        "arena_bytes_equal_dense": cache_bytes_start == dense_bytes,
+        "arena_bytes_fixed": cache_bytes_end == cache_bytes_start,
+        "residency_2x_dense": peak["live"] >= 2 * dense_slots,
+        "replay_identical_to_dense": (
+            len(probe_paged) == 3
+            and all(p == probe_ref for p in probes_all)),
+        "prefix_hits_warm": hits_warm > 0 and reused_warm > 0,
+        "prefill_share_drops_warm": warm_share <= 0.75 * cold_share,
+        "chunk_share_present":
+            phases["states_s"].get("llm-prefill-chunk", 0.0) > 0.0,
+        "zero_steady_compiles": compiles_end == compiles_warm,
+        "zero_page_leaks": not leaks and free_end == pages,
+        "slabs_settled": pool_pending == 0 and inflight_end == 0,
+        "attribution_conserved":
+            abs(phases["conserved_pct"] - 100.0) < 0.1,
+    }
+    verdict = {
+        "metric": "soak_llm_paged", "status": "live",
+        "pass": all(checks.values()),
+        "verdict": "PASS" if all(checks.values()) else "FAIL",
+        "config": {
+            "server": llm_paged_server_line(paged_slots, batch, pages,
+                                            page_size, chunk),
+            "dense_reference": llm_server_line(dense_slots, dense_batch,
+                                               sid=LLM_DENSE_REF_ID),
+            "clients": clients, "duration_s": round(soak_s, 1),
+            "note": "short-chat mix (84-88 token prompts, 24-41 new, "
+                    "40 ms think time); phase A unique prompts (cold), "
+                    "phase B one shared 80-token system prompt + "
+                    "unique tails (warm, registry seeded at the flip); "
+                    "paged arena sized byte-identical to the dense "
+                    "reference"},
+        "llm_paged": {
+            "page_size": page_size, "pages": pages,
+            "paged_slots": paged_slots, "dense_slots": dense_slots,
+            "batch": batch,
+            "tokens": tokens, "sessions": sessions,
+            "tokens_per_s": round(tok_s, 1),
+            "arena_bytes": cache_bytes_end,
+            "dense_arena_bytes": dense_bytes,
+            "peak_resident": peak["live"],
+            "residency_ratio_vs_dense": round(
+                peak["live"] / max(1, dense_slots), 2),
+            "prefix_hits_cold": hits_cold,
+            "prefix_hits_warm": hits_warm,
+            "prefix_tokens_reused_warm": reused_warm,
+            "cold_busy_prefill_share": round(cold_share, 4),
+            "warm_busy_prefill_share": round(warm_share, 4),
+            "warm_vs_cold_prefill": round(
+                warm_share / max(1e-9, cold_share), 3),
+            "cold_states_s": {k: round(v, 3)
+                              for k, v in cold_states.items()},
+            "warm_states_s": {k: round(v, 3)
+                              for k, v in warm_states.items()},
+            "prefill_chunks": engine_report.get("prefill_chunks"),
+            "compiles_after_warmup": compiles_warm,
+            "steady_state_compiles": compiles_end - compiles_warm,
+            "sheds_client": sheds_client,
+            "evicted_sessions": evicted,
+            "page_leaks": leaks,
+            "pool_pending_slabs": pool_pending,
+            "paged_stats": engine_report.get("paged"),
+            "errors": errors[:10],
+            "checks": checks,
+        },
+    }
+    attribution = {
+        "states": dict(phases["states_pct"]),
+        "conserved_pct": phases["conserved_pct"],
+        "note": "DecodeEngine PhaseClock with the llm-prefill-chunk "
+                "state: bounded prefill chunks interleaved between "
+                "decode steps — a ballooning chunk share IS the blame "
+                "signature of a chunked-prefill regression"}
+    verdict["attribution"] = attribution
+    verdict["rows"] = [
+        {"metric": "soak_llm_paged_tokens_per_s",
+         "value": round(tok_s, 1), "unit": "tokens_per_s",
+         "status": "live", "attribution": attribution},
+        {"metric": "soak_llm_paged_residency_ratio",
+         "value": round(peak["live"] / max(1, dense_slots), 2),
+         "unit": "x_higher_better", "status": "live"},
+        {"metric": "soak_llm_paged_prefix_hits_warm",
+         "value": hits_warm, "unit": "sessions", "status": "live"},
+        {"metric": "soak_llm_paged_warm_vs_cold_prefill_pct",
+         "value": round(100.0 * warm_share / max(1e-9, cold_share), 1),
+         "unit": "pct", "status": "live"},
+    ]
+    with open(os.path.join(args.out, "verdict.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(verdict, fh, indent=2)
+    line = {"metric": "soak_llm_paged", "verdict": verdict["verdict"],
+            "pass": verdict["pass"],
+            "tokens_per_s": round(tok_s, 1),
+            "peak_resident": peak["live"],
+            "residency_ratio_vs_dense": round(
+                peak["live"] / max(1, dense_slots), 2),
+            "prefix_hits_warm": hits_warm,
+            "warm_vs_cold_prefill": round(
+                warm_share / max(1e-9, cold_share), 3),
+            "steady_state_compiles": compiles_end - compiles_warm,
+            "sessions": sessions, "errors": len(errors),
+            "checks": checks,
+            "artifact": os.path.join(args.out, "verdict.json")}
+    print(json.dumps(line), flush=True)
+    return 0 if verdict["pass"] else 1
+
+
 FEDERATE_SERVER_ID = 93
 FLEET_SERVER_ID = 94
 
@@ -1691,6 +2057,15 @@ def main(argv=None) -> int:
                     help="--llm: KV-cache slots (sessions resident)")
     ap.add_argument("--llm-batch", type=int, default=8,
                     help="--llm: decode bucket capacity")
+    ap.add_argument("--llm-paged", action="store_true",
+                    help="paged-KV serving acceptance soak (ISSUE 17): "
+                         "short-chat mix against the block-paged arena "
+                         "at dense arena bytes — gates >=2x resident "
+                         "sessions vs dense, probe byte-identity to "
+                         "the dense server, warm-phase prefix-cache "
+                         "hits with prefill share below the cold "
+                         "phase, chunked-prefill interleave, zero "
+                         "steady-state compiles, zero page leaks")
     ap.add_argument("--xbatch-timeout-ms", type=float, default=30.0,
                     help="batch-timeout-ms for the --xbatch server.  "
                          "Default 30 (deadline mode): the soak's "
@@ -1712,6 +2087,8 @@ def main(argv=None) -> int:
         return run_xbatch(args, ap)
     if args.fleet:
         return run_fleet(args, ap)
+    if args.llm_paged:
+        return run_llm_paged(args, ap)
     if args.llm:
         return run_llm(args, ap)
 
