@@ -1,0 +1,806 @@
+"""Static JIT-boundary auditor: the bounded-executable discipline as a
+machine-checked property.
+
+Every headline win since the padded-bucket tier rests on one unwritten
+contract: shapes reaching a jit boundary are QUANTIZED (``pad_rows`` /
+pow2), mutated pools are DONATED (``donate_argnums``), and lowered code
+never host-syncs.  Violated once, a hot path silently recompiles per
+fill level or copies a whole KV arena per step — the donation lesson
+cost >50 % of solo-session throughput before it was found by hand.
+This module makes the contract a named static property, the way
+``lockorder.py`` did for lock ranks: an AST dataflow pass over the jit
+call graph (``jax.jit`` call sites and decorators, the model-function
+roots, ``lower_step``/``lower_decode`` traced closures) reporting five
+named findings:
+
+``unquantized-shape-at-jit``
+    A shape-derived value (``len(x)``, ``x.shape[i]``, arithmetic on
+    them) reaches an executable-cache key — a shape-keyed executable
+    getter (``_step_fn``/``_pstep_fn``/``_chunk_fn``/``_prefill_fn``)
+    — without flowing through a registered quantizer (``pad_rows``,
+    ``quantize_prompt``, ``quantize_pages``, ``_next_pow2``).  Raw lengths
+    at a jit signature mean one executable PER FILL LEVEL: a compile
+    storm.
+
+``missing-donation``
+    A function handed to ``jax.jit`` updates an array parameter in
+    place (``p = p.at[...]...`` / ``dynamic_update_slice(p, ...)``),
+    directly or one call level down, and the jit call does not donate
+    that parameter.  Without donation XLA materializes an input+output
+    copy of the WHOLE buffer per step.
+
+``host-sync-in-jit``
+    ``np.asarray``/``np.array``, ``float()``/``int()``/``bool()``,
+    ``.block_until_ready()``, ``.item()``/``.tolist()`` or
+    ``jax.device_get`` applied to a traced value anywhere in the jit
+    call graph — the whole-graph extension of nnslint's
+    ``host-sync-in-lower`` (which only covers the lowering hooks).
+    Tracedness is propagated interprocedurally: a helper called with
+    only static arguments (a shape, a config) stays host code even
+    when a jitted function calls it at trace time.
+
+``tracer-branch``
+    A Python ``if``/``while`` on a traced value inside the jit graph.
+    Under tracing this concretizes (error) at best; at worst it forks
+    the executable set.  Branching on shapes/``len()`` is static and
+    fine; ``is None`` structure checks are fine.
+
+``unbounded-signature``
+    An executable-cache key builder (``_sig``/``_cfg_key``-style
+    functions) iterates a parameter collection with no declared bound
+    — a dict/list signature component whose cardinality nothing caps
+    is an unbounded executable set by construction.  Declare the bound
+    (slice, cap) or pragma WITH the reason the arity is fixed
+    elsewhere.
+
+Pragma: append ``# nnsjit: allow(<rule>)`` to the offending line or the
+comment line directly above it (give the reason in the comment) — the
+``nnslint`` convention.
+
+The pass is intentionally import-free (pure ``ast``): it audits files
+that import jax without needing jax in the environment, the same
+standalone discipline as ``tools/nnslint.py``.  The RUNTIME half of the
+contract — every compile that actually happens, attributed to a site
+and diffed against its nearest cached neighbor — lives in
+:mod:`nnstreamer_tpu.analysis.compileledger`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+RULES = ("unquantized-shape-at-jit", "missing-donation",
+         "host-sync-in-jit", "tracer-branch", "unbounded-signature")
+
+#: registered shape quantizers: a value that flowed through one of
+#: these is bounded by construction (their laws — idempotent, monotone,
+#: >= input, capped — are pinned by tests/test_quantizers.py, which is
+#: what licenses this whitelist)
+QUANTIZERS = frozenset({"pad_rows", "quantize_prompt", "quantize_pages",
+                        "_next_pow2"})
+
+#: shape-keyed executable getters: their int arguments ARE the
+#: executable-cache key (llm/engine.py warm-set dicts), so a raw
+#: length here is a compile per fill level
+SHAPE_KEYED_GETTERS = frozenset({"_step_fn", "_pstep_fn", "_chunk_fn",
+                                 "_prefill_fn"})
+
+#: executable-cache key builders: unbounded-signature applies to their
+#: bodies
+SIG_BUILDERS = frozenset({"_sig", "_cfg_key"})
+
+#: jit-graph roots that are wired through runtime indirection the AST
+#: cannot see (registry forwards jitted by the filter backend, the
+#: decode/prefill twins jitted through closures): audited as if
+#: directly jitted
+KNOWN_JIT_ROOTS = frozenset({
+    "forward_logits", "prefill_kv", "decode_step", "decode_step_pooled",
+    "decode_step_paged", "prefill_chunk_paged",
+})
+
+#: lowering hooks whose nested defs are traced closures (PR 12
+#: contract: LoweredStep.fn joins the segment's jitted computation)
+LOWER_HOOKS = frozenset({"lower_step", "lower_decode"})
+
+#: attribute calls that force a device->host sync on a traced value
+HOST_SYNC_METHODS = frozenset({"block_until_ready", "item", "tolist"})
+
+#: builtins that force concretization when applied to a tracer
+HOST_CAST_BUILTINS = frozenset({"float", "int", "bool"})
+
+#: annotation substrings marking a parameter as STATIC (python-level)
+#: rather than traced: branches and casts on these are fine
+_STATIC_ANN_TOKENS = ("int", "float", "bool", "str", "Config", "None",
+                      "Callable")
+_TRACED_ANN_TOKENS = ("ndarray", "Array", "Dict", "dict", "List",
+                      "list", "Any", "Tuple", "tuple")
+
+#: attribute roots that are module namespaces, not instances — a call
+#: through them never resolves to a repo-local def by bare name
+_MODULE_ROOTS = ("jnp", "np", "_np", "numpy", "jax", "lax", "nn", "os",
+                 "time", "math", "json", "re", "sys", "ast")
+
+#: higher-order callees whose Name arguments are function references
+#: entering the traced graph (jax transforms); a bare Name argument to
+#: anything else is just a value
+_HOF_CALLEES = frozenset({"jit", "scan", "cond", "while_loop",
+                          "fori_loop", "switch", "vmap", "pmap",
+                          "remat", "checkpoint", "custom_vjp",
+                          "custom_jvp", "grad", "value_and_grad"})
+
+
+@dataclasses.dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    func: str
+    message: str
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{self.func}]: {self.message}")
+
+
+def _pragma_lines(source: str) -> Dict[int, Set[str]]:
+    """line number -> rules allowed there; a pragma on a pure comment
+    line also covers the next non-comment line (nnslint convention)."""
+    allowed: Dict[int, Set[str]] = {}
+    pending: Set[str] = set()
+    for i, text in enumerate(source.splitlines(), start=1):
+        rules: Set[str] = set()
+        marker = "# nnsjit: allow("
+        pos = text.find(marker)
+        if pos >= 0:
+            inner = text[pos + len(marker):]
+            rules = {r.strip() for r in
+                     inner.partition(")")[0].split(",") if r.strip()}
+        stripped = text.strip()
+        if stripped.startswith("#"):
+            pending |= rules
+            continue
+        here = rules | pending
+        if stripped:
+            pending = set()
+        if here:
+            allowed[i] = allowed.get(i, set()) | here
+    return allowed
+
+
+def _call_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _is_jit_callee(func: ast.AST) -> bool:
+    """``jax.jit`` / ``self._jax.jit`` / bare ``jit`` as a call target
+    or decorator."""
+    if isinstance(func, ast.Attribute) and func.attr == "jit":
+        return True
+    if isinstance(func, ast.Name) and func.id == "jit":
+        return True
+    return False
+
+
+def _is_shape_access(node: ast.AST) -> bool:
+    """Expressions that are STATIC under tracing even when rooted at a
+    traced value: ``x.shape``/``x.shape[i]``, ``x.ndim``, ``x.dtype``,
+    ``len(x)`` — abstract-value metadata, not data."""
+    if isinstance(node, ast.Subscript):
+        return _is_shape_access(node.value)
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("shape", "ndim", "dtype", "size",
+                             "weak_type")
+    if isinstance(node, ast.Call):
+        fn = node.func
+        return isinstance(fn, ast.Name) and fn.id == "len"
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_shape_access(e) or isinstance(e, ast.Constant)
+                   for e in node.elts)
+    return False
+
+
+def _params(node: ast.AST) -> List[ast.arg]:
+    a = node.args
+    return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+
+def _param_is_traced(arg: ast.arg) -> bool:
+    """A parameter counts as traced unless its annotation names a
+    static python scalar/config type.  Unannotated parameters (jit
+    closures) are traced — that is what being jitted means."""
+    ann = arg.annotation
+    if ann is None:
+        return True
+    try:
+        text = ast.unparse(ann)
+    except Exception:
+        text = ""
+    if any(tok in text for tok in _TRACED_ANN_TOKENS):
+        return True
+    return not any(tok in text for tok in _STATIC_ANN_TOKENS)
+
+
+def _expr_traced(expr: ast.AST, tainted: Set[str]) -> bool:
+    """True when a traced value's DATA (not its static metadata) feeds
+    the expression: prune shape accesses at every level."""
+    if _is_shape_access(expr):
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    return any(_expr_traced(child, tainted)
+               for child in ast.iter_child_nodes(expr))
+
+
+def _own_nodes(node: ast.AST) -> List[ast.AST]:
+    """All descendants of ``node`` EXCLUDING nested function bodies
+    (nested defs are audited as their own functions)."""
+    out: List[ast.AST] = []
+
+    def walk(cur: ast.AST) -> None:
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            out.append(child)
+            walk(child)
+
+    walk(node)
+    return out
+
+
+def _compute_taint(node: ast.AST, initial: Set[str]) -> Set[str]:
+    """Forward taint over the function's OWN statements (two passes:
+    the hot-path code shape is straight-line math, but a second pass
+    picks up simple use-before-redef orderings)."""
+    tainted = set(initial)
+    own = _own_nodes(node)
+    for _ in range(2):
+        for sub in own:
+            if isinstance(sub, ast.Assign):
+                rhs = _expr_traced(sub.value, tainted)
+                for t in sub.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            if rhs:
+                                tainted.add(n.id)
+                            else:
+                                tainted.discard(n.id)
+            elif isinstance(sub, ast.AugAssign):
+                if isinstance(sub.target, ast.Name) \
+                        and _expr_traced(sub.value, tainted):
+                    tainted.add(sub.target.id)
+            elif isinstance(sub, ast.For):
+                if _expr_traced(sub.iter, tainted):
+                    for n in ast.walk(sub.target):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+    # params are axioms: a reassignment cannot untaint the NAME when
+    # the update derives from itself (p = p.at[...].set(v))
+    tainted |= initial & _compute_selfupdates(node)
+    return tainted
+
+
+def _compute_selfupdates(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in _own_nodes(node):
+        if isinstance(sub, ast.Assign):
+            rhs_names = {n.id for n in ast.walk(sub.value)
+                         if isinstance(n, ast.Name)}
+            for t in sub.targets:
+                if isinstance(t, ast.Name) and t.id in rhs_names:
+                    out.add(t.id)
+    return out
+
+
+@dataclasses.dataclass
+class _FuncInfo:
+    name: str
+    qual: str
+    node: ast.AST                       # FunctionDef | AsyncFunctionDef
+    file: "_FileInfo"
+    parent_names: Tuple[str, ...]       # enclosing def/class names
+
+
+@dataclasses.dataclass
+class _FileInfo:
+    path: str
+    rel: str
+    tree: ast.Module
+    source: str
+    allowed: Dict[int, Set[str]]
+
+
+class _JitGraph:
+    """Cross-file function table + interprocedural traced-parameter
+    masks, propagated from the jit roots: a callee's parameter is
+    traced iff SOME in-graph call site feeds it a traced argument (or
+    the callee is itself a root, where annotations decide)."""
+
+    def __init__(self, files: List[_FileInfo]) -> None:
+        self.files = files
+        self.funcs: List[_FuncInfo] = []
+        self.by_name: Dict[str, List[_FuncInfo]] = {}
+        self.by_id: Dict[int, _FuncInfo] = {}
+        self.jit_sites: List[Tuple[_FileInfo, ast.Call]] = []
+        for fi in files:
+            self._collect_file(fi)
+        #: id(node) -> set of traced parameter names (membership in
+        #: this dict IS "in the jit graph")
+        self.masks: Dict[int, Set[str]] = {}
+        self._propagate()
+
+    # -- collection ----------------------------------------------------
+    def _collect_file(self, fi: _FileInfo) -> None:
+        stack: List[str] = []
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    info = _FuncInfo(child.name,
+                                     ".".join(stack + [child.name]),
+                                     child, fi, tuple(stack))
+                    self.funcs.append(info)
+                    self.by_name.setdefault(child.name, []).append(info)
+                    self.by_id[id(child)] = info
+                    stack.append(child.name)
+                    walk(child)
+                    stack.pop()
+                elif isinstance(child, ast.ClassDef):
+                    stack.append(child.name)
+                    walk(child)
+                    stack.pop()
+                else:
+                    walk(child)
+
+        walk(fi.tree)
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Call) and _is_jit_callee(node.func):
+                self.jit_sites.append((fi, node))
+
+    def resolve(self, name: str, fi: _FileInfo) -> Optional[_FuncInfo]:
+        """Callee resolution: same file first, else a UNIQUE global
+        match (ambiguous bare names are skipped, not guessed)."""
+        cands = self.by_name.get(name, [])
+        local = [c for c in cands if c.file is fi]
+        if len(local) == 1:
+            return local[0]
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    # -- roots + mask propagation --------------------------------------
+    def _root_mask(self, info: _FuncInfo) -> Set[str]:
+        return {a.arg for a in _params(info.node)
+                if _param_is_traced(a)}
+
+    def _roots(self) -> List[_FuncInfo]:
+        out: List[_FuncInfo] = []
+        seen: Set[int] = set()
+
+        def add(info: Optional[_FuncInfo]) -> None:
+            if info is not None and id(info.node) not in seen:
+                seen.add(id(info.node))
+                out.append(info)
+
+        for fi, call in self.jit_sites:
+            if call.args and isinstance(call.args[0], ast.Name):
+                add(self.resolve(call.args[0].id, fi))
+        for info in self.funcs:
+            for deco in getattr(info.node, "decorator_list", ()):
+                target = deco.func if isinstance(deco, ast.Call) else deco
+                if _is_jit_callee(target):
+                    add(info)
+            if info.name in KNOWN_JIT_ROOTS:
+                add(info)
+            elif info.parent_names and \
+                    info.parent_names[-1] in LOWER_HOOKS:
+                add(info)
+        return out
+
+    def _propagate(self) -> None:
+        work: List[_FuncInfo] = []
+        for info in self._roots():
+            self.masks[id(info.node)] = self._root_mask(info)
+            work.append(info)
+        while work:
+            info = work.pop()
+            mask = self.masks[id(info.node)]
+            taint = _compute_taint(info.node, mask)
+            for sub in _own_nodes(info.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                self._flow_call(info, sub, taint, work)
+                # functions passed BY NAME into a jax transform
+                # (lax.scan/cond/vmap, jax.jit) are traced with all
+                # their (unannotated) params
+                if _call_name(sub) in _HOF_CALLEES:
+                    for a in list(sub.args) + [kw.value
+                                               for kw in sub.keywords]:
+                        if isinstance(a, ast.Name):
+                            target = self.resolve(a.id, info.file)
+                            if target is not None:
+                                self._grow(target,
+                                           self._root_mask(target),
+                                           work)
+
+    def _flow_call(self, info: _FuncInfo, call: ast.Call,
+                   taint: Set[str], work: List[_FuncInfo]) -> None:
+        callee_name: Optional[str] = None
+        fn = call.func
+        same_file_only = False
+        if isinstance(fn, ast.Name):
+            callee_name = fn.id
+        elif isinstance(fn, ast.Attribute):
+            root = fn.value
+            if not (isinstance(root, ast.Name)
+                    and root.id in _MODULE_ROOTS):
+                # a bare method name is only trustworthy within its
+                # own file — `x.find(...)` must not resolve to an
+                # unrelated global `find` elsewhere in the package
+                callee_name = fn.attr
+                same_file_only = True
+        if callee_name is None:
+            return
+        target = self.resolve(callee_name, info.file)
+        if same_file_only and target is not None \
+                and target.file is not info.file:
+            return
+        if target is None or target.node is info.node:
+            return
+        names = [a.arg for a in _params(target.node)]
+        # methods called through an instance: drop the self slot
+        offset = 1 if names[:1] == ["self"] and not (
+            isinstance(fn, ast.Name)) else 0
+        grow: Set[str] = set()
+        for pos, a in enumerate(call.args):
+            idx = pos + offset
+            if idx < len(names) and _expr_traced(a, taint):
+                grow.add(names[idx])
+        for kw in call.keywords:
+            if kw.arg in names and _expr_traced(kw.value, taint):
+                grow.add(kw.arg)
+        if grow:
+            self._grow(target, grow, work)
+
+    def _grow(self, info: _FuncInfo, add: Set[str],
+              work: List[_FuncInfo]) -> None:
+        cur = self.masks.get(id(info.node))
+        if cur is None:
+            self.masks[id(info.node)] = set(add)
+            work.append(info)
+        elif not add <= cur:
+            cur |= add
+            work.append(info)
+
+
+class _Auditor:
+    def __init__(self, graph: _JitGraph) -> None:
+        self.graph = graph
+        self.findings: List[Finding] = []
+
+    def _add(self, fi: _FileInfo, node: ast.AST, rule: str, func: str,
+             message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if rule in fi.allowed.get(line, ()):
+            return
+        self.findings.append(Finding(fi.rel, line, rule, func, message))
+
+    def run(self) -> List[Finding]:
+        for info in self.graph.funcs:
+            mask = self.graph.masks.get(id(info.node))
+            if mask is not None:
+                self._audit_traced(info, mask)
+            if info.name in SIG_BUILDERS:
+                self._audit_signature(info)
+            self._audit_host_quantization(info)
+        self._audit_donation()
+        # one finding per site+rule (nested walks overlap)
+        seen, unique = set(), []
+        for f in sorted(self.findings,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            key = (f.path, f.line, f.rule)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        return unique
+
+    # -- traced-body rules: host-sync-in-jit + tracer-branch -----------
+    def _audit_traced(self, info: _FuncInfo, mask: Set[str]) -> None:
+        tainted = _compute_taint(info.node, mask)
+        for sub in _own_nodes(info.node):
+            if isinstance(sub, ast.Call):
+                self._check_host_sync(info, sub, tainted)
+            elif isinstance(sub, (ast.If, ast.While)):
+                self._check_branch(info, sub, tainted)
+
+    def _check_host_sync(self, info: _FuncInfo, call: ast.Call,
+                         tainted: Set[str]) -> None:
+        fn = call.func
+        name = _call_name(call)
+        arg_traced = any(_expr_traced(a, tainted) for a in call.args)
+        if isinstance(fn, ast.Attribute):
+            if name in HOST_SYNC_METHODS \
+                    and _expr_traced(fn.value, tainted):
+                self._add(info.file, call, "host-sync-in-jit",
+                          info.qual,
+                          f".{name}() on a traced value forces a "
+                          "device->host sync inside the jit graph — "
+                          "return the value and materialize outside")
+                return
+            if name in ("asarray", "array") \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in ("np", "numpy", "_np") \
+                    and arg_traced:
+                self._add(info.file, call, "host-sync-in-jit",
+                          info.qual,
+                          f"np.{name}() on a traced value "
+                          "materializes on host mid-trace — use jnp, "
+                          "or hoist the conversion out of the jit "
+                          "graph")
+                return
+            if name == "device_get" and arg_traced:
+                self._add(info.file, call, "host-sync-in-jit",
+                          info.qual,
+                          "jax.device_get inside the jit graph is a "
+                          "blocking transfer — hoist it to the caller")
+                return
+        if isinstance(fn, ast.Name) and name in HOST_CAST_BUILTINS \
+                and arg_traced:
+            self._add(info.file, call, "host-sync-in-jit", info.qual,
+                      f"{name}() on a traced value concretizes the "
+                      "tracer (device sync + retrace hazard) — keep "
+                      "it an array or make the input static")
+
+    def _check_branch(self, info: _FuncInfo, node: ast.AST,
+                      tainted: Set[str]) -> None:
+        test = node.test
+        # `x is None` / `x is not None` is pytree STRUCTURE, not data
+        if isinstance(test, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in test.ops):
+            return
+        if _expr_traced(test, tainted):
+            kw = "while" if isinstance(node, ast.While) else "if"
+            self._add(info.file, node, "tracer-branch", info.qual,
+                      f"python `{kw}` on a traced value: under "
+                      "tracing this concretizes (error) or forks the "
+                      "executable set — use jnp.where / lax.cond, or "
+                      "branch on shapes (static)")
+
+    # -- unbounded-signature -------------------------------------------
+    def _audit_signature(self, info: _FuncInfo) -> None:
+        params = {a.arg for a in _params(info.node)}
+        for sub in ast.walk(info.node):
+            iters: List[ast.AST] = []
+            if isinstance(sub, (ast.GeneratorExp, ast.ListComp,
+                                ast.SetComp, ast.DictComp)):
+                iters = [c.iter for c in sub.generators]
+            elif isinstance(sub, ast.For):
+                iters = [sub.iter]
+            for it in iters:
+                root = it
+                # unwrap sorted(x) / vars(x).items() / enumerate(x)
+                while True:
+                    if isinstance(root, ast.Call):
+                        if isinstance(root.func, ast.Attribute):
+                            root = root.func.value
+                            continue
+                        if root.args:
+                            root = root.args[0]
+                            continue
+                    break
+                if isinstance(root, ast.Subscript):
+                    continue   # x[:n] — an explicit bound
+                if isinstance(root, ast.Name) and root.id in params:
+                    self._add(
+                        info.file, sub, "unbounded-signature",
+                        info.qual,
+                        f"signature builder iterates parameter "
+                        f"{root.id!r} with no declared bound: a "
+                        "dict/list key component nothing caps is an "
+                        "unbounded executable set — slice/cap it, or "
+                        "pragma WITH the reason the arity is fixed")
+
+    # -- unquantized-shape-at-jit --------------------------------------
+    def _audit_host_quantization(self, info: _FuncInfo) -> None:
+        """Host-side pass over EVERY function: shape-derived ints must
+        be quantized before keying an executable getter."""
+        shape_vars: Set[str] = set()
+        clean_vars: Set[str] = set()
+
+        def tainted_expr(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Call):
+                if _call_name(expr) in QUANTIZERS:
+                    return False
+                if _call_name(expr) == "len":
+                    return True
+                return any(tainted_expr(a) for a in expr.args)
+            if _is_shape_access(expr):
+                return True
+            if isinstance(expr, ast.Name):
+                return expr.id in shape_vars and \
+                    expr.id not in clean_vars
+            if isinstance(expr, (ast.BinOp, ast.IfExp, ast.Tuple,
+                                 ast.List, ast.Compare, ast.BoolOp,
+                                 ast.UnaryOp)):
+                return any(tainted_expr(c)
+                           for c in ast.iter_child_nodes(expr))
+            return False
+
+        own = _own_nodes(info.node)
+        for sub in own:
+            if isinstance(sub, ast.Assign):
+                is_taint = tainted_expr(sub.value)
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        if is_taint:
+                            shape_vars.add(t.id)
+                            clean_vars.discard(t.id)
+                        else:
+                            clean_vars.add(t.id)
+                            shape_vars.discard(t.id)
+        for sub in own:
+            if not isinstance(sub, ast.Call):
+                continue
+            if _call_name(sub) in SHAPE_KEYED_GETTERS:
+                for a in sub.args:
+                    if tainted_expr(a):
+                        self._add(
+                            info.file, sub, "unquantized-shape-at-jit",
+                            info.qual,
+                            f"shape-derived value reaches "
+                            f"{_call_name(sub)}() — an executable-"
+                            "cache key — without a registered "
+                            "quantizer (pad_rows / quantize_prompt / "
+                            "quantize_pages / _next_pow2): one "
+                            "executable "
+                            "per fill level")
+
+    # -- missing-donation ----------------------------------------------
+    def _audit_donation(self) -> None:
+        for fi, call in self.graph.jit_sites:
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue
+            target = self.graph.resolve(call.args[0].id, fi)
+            if target is None:
+                continue
+            mutated = self._mutated_param_indices(target, depth=1)
+            if not mutated:
+                continue
+            donated = self._donated(call)
+            if donated is None:
+                self._add(
+                    fi, call, "missing-donation",
+                    call.args[0].id,
+                    f"jitted function mutates array parameter(s) "
+                    f"{sorted(mutated)} in place but the jit call "
+                    "declares no donate_argnums: XLA will copy the "
+                    "whole buffer per step (the >50% pool-copy tax)")
+            else:
+                missing = mutated - donated
+                if missing:
+                    self._add(
+                        fi, call, "missing-donation",
+                        call.args[0].id,
+                        f"donate_argnums={sorted(donated)} does not "
+                        f"cover mutated parameter(s) {sorted(missing)}")
+
+    @staticmethod
+    def _donated(call: ast.Call) -> Optional[Set[int]]:
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = set()
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, int):
+                        out.add(el.value)
+                return out
+            return set()   # computed donation: trust but cannot check
+        return None
+
+    def _mutated_param_indices(self, info: _FuncInfo,
+                               depth: int) -> Set[int]:
+        """Positional param indices updated in place: ``p = p.at[..]``
+        chains and ``p = ...dynamic_update_slice(p, ...)`` — plus one
+        level of positional flow into callees that do the same."""
+        node = info.node
+        index = {a.arg: i for i, a in enumerate(_params(node))}
+        mutated: Set[int] = set()
+        for sub in _own_nodes(node):
+            if not isinstance(sub, ast.Assign):
+                continue
+            for t in sub.targets:
+                if isinstance(t, ast.Name) and t.id in index \
+                        and self._inplace_update_of(sub.value, t.id):
+                    mutated.add(index[t.id])
+        if depth > 0:
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                callee = self.graph.resolve(_call_name(sub), info.file)
+                if callee is None or callee.node is node:
+                    continue
+                inner = self._mutated_param_indices(callee,
+                                                    depth=depth - 1)
+                if not inner:
+                    continue
+                for pos, a in enumerate(sub.args):
+                    if isinstance(a, ast.Name) and a.id in index \
+                            and pos in inner:
+                        mutated.add(index[a.id])
+        return mutated
+
+    @staticmethod
+    def _inplace_update_of(expr: ast.AST, name: str) -> bool:
+        for n in ast.walk(expr):
+            # name.at[...].set/add/...(...)
+            if isinstance(n, ast.Attribute) and n.attr == "at" \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == name:
+                return True
+            # dynamic_update_slice(name, ...) / scatter*(name, ...)
+            if isinstance(n, ast.Call):
+                cn = _call_name(n)
+                if (cn.startswith("dynamic_update_slice")
+                        or cn.startswith("scatter")) and n.args \
+                        and isinstance(n.args[0], ast.Name) \
+                        and n.args[0].id == name:
+                    return True
+        return False
+
+
+def _iter_py(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        else:
+            out.append(path)
+    return out
+
+
+def audit_paths(paths: List[str],
+                root: Optional[str] = None) -> List[Finding]:
+    """The entry point ``tools/nnsjit.py`` and ``launch.py --check
+    --jit`` share: parse every file, build ONE cross-file jit graph
+    (the decode twins are defined in models/ and jitted from llm/), and
+    run the five rules."""
+    root = root or os.getcwd()
+    files: List[_FileInfo] = []
+    findings: List[Finding] = []
+    for path in _iter_py(paths):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            findings.append(Finding(rel, exc.lineno or 0, "syntax", "-",
+                                    f"cannot parse: {exc.msg}"))
+            continue
+        except OSError as exc:
+            findings.append(Finding(rel, 0, "io", "-", str(exc)))
+            continue
+        files.append(_FileInfo(path, rel, tree, source,
+                               _pragma_lines(source)))
+    graph = _JitGraph(files)
+    findings.extend(_Auditor(graph).run())
+    return findings

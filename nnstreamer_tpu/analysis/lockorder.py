@@ -80,6 +80,11 @@ HIERARCHY: Dict[str, int] = {
     #                         snapshots, so below obs.metrics
     "tracer": 70,           # Tracer stats table
     "obs.ring": 72,         # SpanRing append/snapshot (obs/span.py)
+    "analysis.ledger": 73,  # compile-ledger event/budget tables
+    #                         (analysis/compileledger.py); exports the
+    #                         nns_jit_compiles_total counter, which is
+    #                         incremented OUTSIDE this lock, so it ranks
+    #                         below obs.metrics
     "obs.metrics": 74,      # metrics registry + per-metric state
     #                         (obs/metrics.py; scrape snapshots under the
     #                         registry lock, then evaluates gauges

@@ -353,6 +353,8 @@ class TensorFilter(Element):
                               _parse_combination(outs) or [])
         # micro-batching state (double-buffered: one batch collecting, one
         # dispatched-in-flight — see FilterFramework.invoke_batched)
+        # batch=0 and unset both mean "no micro-batching" (the max()
+        # clamp folds them) # nnslint: allow(falsy-zero-default)
         self._batch = max(1, int(self.batch or 1))
         if self._batch > 1 and not getattr(self.fw, "SUPPORTS_BATCHING",
                                            False):
@@ -394,6 +396,8 @@ class TensorFilter(Element):
         from collections import deque
 
         self._inflight: deque = deque()
+        # inflight=0 and unset both mean depth 1 (max() clamp)
+        # nnslint: allow(falsy-zero-default)
         self._inflight_depth = max(1, int(self.inflight or 1))
         if self._inflight_depth > 1 and self._batch <= 1:
             from ..utils.log import ml_logw
@@ -430,6 +434,8 @@ class TensorFilter(Element):
         # sequence order before pushing downstream.  Orthogonal to the
         # micro-batch machinery: batch>1 already overlaps dispatch via
         # inflight, so workers collapses to 1 there.
+        # workers=0 and unset both mean no pool (max() clamp)
+        # nnslint: allow(falsy-zero-default)
         self._workers_n = max(1, int(self.workers or 1))
         if self._workers_n > 1 and self._batch > 1:
             from ..utils.log import ml_logw
@@ -684,8 +690,10 @@ class TensorFilter(Element):
         return self._plan_invoke
 
     def lower_reason(self):
+        # 0/unset alike collapse to 1 # nnslint: allow(falsy-zero-default)
         if max(1, int(self.batch or 1)) > 1:
             return "batch>1: the micro-batch coalescer owns dispatch"
+        # 0/unset alike collapse to 1 # nnslint: allow(falsy-zero-default)
         if max(1, int(self.workers or 1)) > 1:
             return "workers>1: the invoke pool owns dispatch"
         fw = getattr(self, "fw", None)

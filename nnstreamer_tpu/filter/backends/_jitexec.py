@@ -16,6 +16,7 @@ from typing import Any, List
 
 import numpy as np
 
+from ...analysis import compileledger
 from ...pipeline.tracing import annotate, annotation_active
 from ...tensor.buffer import BatchView, is_device_array
 from ..framework import Accelerator, FilterError, start_output_transfers
@@ -172,6 +173,7 @@ class JitExecMixin:
         self._jitted = jax.jit(forward_fn)
         self._vjit = None
         self._mesh = mesh
+        self._nns_sig_seen = None   # compile-ledger signature mirror
         # wait-state attribution (obs/attrib.py): the first dispatch of
         # a cold executable is device-compile, not device-invoke — the
         # warm-up below (when inputs are given) pays it outside the
@@ -306,12 +308,36 @@ class JitExecMixin:
                 return moved
         return x
 
+    def _ledger_note(self, site: str, arrays) -> None:
+        """Sentinel-on only: mirror jax's per-executable signature
+        cache so each NOVEL dispatch signature reaches the compile
+        ledger (jax compiles exactly when the signature is new — this
+        set tracks the same key, per executable generation).  The hot
+        key is raw ``(shape, dtype)`` pairs; the field-named ledger
+        signature is built only on a miss, so a warm dispatch pays one
+        genexp + one set probe."""
+        seen = getattr(self, "_nns_sig_seen", None)
+        if seen is None:
+            seen = self._nns_sig_seen = set()
+        key = (site,) + tuple((getattr(a, "shape", None),
+                               getattr(a, "dtype", None))
+                              for a in arrays)
+        if key in seen:
+            return
+        seen.add(key)
+        compileledger.record(site, tuple(
+            (f"arg[{i}]", (tuple(getattr(a, "shape", ())),
+                           str(getattr(a, "dtype", type(a).__name__))))
+            for i, a in enumerate(arrays)))
+
     def _invoke_device(self, inputs: List[Any]):
         import jax
 
         inputs = [x.device_slice() if isinstance(x, BatchView) else x
                   for x in inputs]
         inputs = [self._ensure_device(x) for x in inputs]
+        if compileledger.ENABLED:
+            self._ledger_note("filter.jitexec.invoke", inputs)
         with jax.default_device(self._device):
             return self._jitted(self._params_dev, *inputs)
 
@@ -515,6 +541,8 @@ class JitExecMixin:
     def _dispatch_batched(self, stacked, emit_device: bool = False):
         import jax
 
+        if compileledger.ENABLED:
+            self._ledger_note("filter.jitexec.vmap", stacked)
         mesh = getattr(self, "_mesh", None)
         n_in = len(stacked)
         if mesh is not None:
@@ -580,6 +608,7 @@ class JitExecMixin:
         self._forward_fn = fused
         self._jitted = jax.jit(fused)
         self._vjit = None  # rebuild the batched executable around the fusion
+        self._nns_sig_seen = None   # new executables: signatures reset
         self._annot_cold = True   # next dispatch re-compiles
         self._nns_cost_cache = None   # fused graph has a new cost model
         # marker for the element's post-reload re-apply: a backend that

@@ -230,6 +230,16 @@ def _data_inputs(node: _Node) -> List[str]:
     return [i for i in node.inputs if not i.startswith("^")]
 
 
+def _attr_or(node: _Node, key: str, default: float) -> float:
+    """Float attr with a None-safe default.  `attrs.get(k) or d` folds
+    an EXPLICIT 0.0 in the graph into the default — but zero is a real
+    setting here (LeakyRelu alpha=0.0 is plain relu, FusedBatchNorm
+    epsilon=0.0 is exact normalization); only a MISSING attr falls
+    back."""
+    val = node.attrs.get(key)
+    return float(val) if val is not None else float(default)
+
+
 def _require_nhwc(node: _Node) -> None:
     """Lowerings assume NHWC (TF's CPU default); fail NCHW graphs by name
     instead of producing silently wrong layouts."""
@@ -314,7 +324,7 @@ def _fused_bn(node, ins, ctx):
 
     _require_nhwc(node)
     x, scale, offset, mean, var = ins[:5]
-    eps = float(node.attrs.get("epsilon") or 1e-3)
+    eps = _attr_or(node, "epsilon", 1e-3)
     inv = scale * (1.0 / jnp.sqrt(var + eps))
     return x * inv + (offset - mean * inv)
 
@@ -462,7 +472,7 @@ def _make_ops() -> Dict[str, Callable]:
         "Relu": _unary(jax.nn.relu),
         "Relu6": _unary(lambda x: jnp.clip(x, 0, 6)),
         "LeakyRelu": lambda node, ins, ctx: jax.nn.leaky_relu(
-            ins[0], float(node.attrs.get("alpha") or 0.2)),
+            ins[0], _attr_or(node, "alpha", 0.2)),
         "Elu": _unary(jax.nn.elu), "Selu": _unary(jax.nn.selu),
         "Sigmoid": _unary(jax.nn.sigmoid), "Tanh": _unary(jnp.tanh),
         "Softmax": _softmax,
@@ -747,7 +757,8 @@ class TensorFlowFilter(JitExecMixin, FilterFramework):
             if decode is not None:
                 want_n = int(decode.attrs.get("desired_samples") or 0) \
                     or int(custom.get("audio_samples", "0"))
-                want_c = int(decode.attrs.get("desired_channels") or 1)
+                ch = decode.attrs.get("desired_channels")
+                want_c = int(ch) if ch is not None else 1
                 if want_n <= 0:
                     raise FilterError(
                         "tensorflow: DecodeWav without desired_samples "

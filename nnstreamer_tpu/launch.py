@@ -80,6 +80,15 @@ def main(argv=None) -> int:
                          "cycles, dead branches and scheduler "
                          "misconfigurations are reported with element "
                          "paths (analysis/verify.py); exit 1 on errors")
+    ap.add_argument("--jit", action="store_true",
+                    help="with --check: also run the static JIT-boundary "
+                         "audit (analysis/jitaudit.py) over the package "
+                         "— unquantized shapes at jit signatures, "
+                         "missing donations, host syncs and tracer "
+                         "branches in the jit call graph, unbounded "
+                         "cache keys — and print the declared compile "
+                         "budgets; a pipeline string is optional "
+                         "(audit-only mode); exit 1 on findings")
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--print-sink", default=None,
                     help="tensor_sink name whose outputs to print")
@@ -198,7 +207,7 @@ def main(argv=None) -> int:
 
     if args.inspect is not None:
         return inspect(args.inspect or args.pipeline)
-    if not args.pipeline:
+    if not args.pipeline and not (args.check and args.jit):
         ap.error("pipeline launch string required (or use --inspect)")
 
     if args.no_fuse:
@@ -219,7 +228,10 @@ def main(argv=None) -> int:
     from . import parse_launch
 
     if args.check:
-        return check(args.pipeline)
+        rc = check(args.pipeline) if args.pipeline else 0
+        if args.jit:
+            rc = max(rc, check_jit())
+        return rc
 
     import os as _os
 
@@ -513,6 +525,35 @@ def check(description: str, out=None) -> int:
         print(f"check: FAIL ({len(errors)} error(s))", file=out)
         return 1
     print("check: OK", file=out)
+    return 0
+
+
+def check_jit(out=None) -> int:
+    """``--check --jit``: the static JIT-boundary audit
+    (analysis/jitaudit.py) over the installed package, plus the
+    declared compile budgets — the same pass ``tools/nnsjit.py`` runs,
+    surfaced through the launcher's front door."""
+    import os as _os
+
+    out = out or sys.stderr
+    from .analysis.jitaudit import audit_paths
+    from .analysis import compileledger
+
+    pkg = _os.path.dirname(_os.path.abspath(__file__))
+    findings = audit_paths([pkg], root=_os.path.dirname(pkg))
+    for f in findings:
+        print(f"check: jit: {f}", file=out)
+    try:
+        # importing the engine registers its @compile_budget sites
+        from .llm import engine as _engine  # noqa: F401
+    except Exception:
+        pass
+    for site, n in sorted(compileledger.budgets().items()):
+        print(f"check: jit: budget {site} = {n} executables", file=out)
+    if findings:
+        print(f"check: jit: FAIL ({len(findings)} finding(s))", file=out)
+        return 1
+    print("check: jit: OK", file=out)
     return 0
 
 

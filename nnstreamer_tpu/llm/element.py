@@ -279,8 +279,13 @@ class TensorLLM(Element):
 
         custom = FilterProperties.parse_custom(self.custom)
         self.cfg = config_from_custom(custom)
+        # for slots/batch/max_new_tokens, 0 and unset both clamp to 1:
+        # the `or` default loses nothing under max()
+        # nnslint: allow(falsy-zero-default)
         self._slots = max(1, int(self.slots or 1))
+        # nnslint: allow(falsy-zero-default)
         self._batch = max(1, int(self.batch or 1))
+        # nnslint: allow(falsy-zero-default)
         self._max_new_cap = max(1, int(self.max_new_tokens or 1))
         self._admit_timeout = max(0.0,
                                   float(self.admit_timeout_ms or 0)) / 1e3

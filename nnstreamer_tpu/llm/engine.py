@@ -31,6 +31,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis import compileledger
+from ..analysis.compileledger import compile_budget
 from ..filter.backends._jitexec import JitExecMixin
 from .pool import KVCachePool, Session
 
@@ -58,6 +60,9 @@ def quantize_pages(n: int, table_max: int) -> int:
 
 
 def _cfg_key(cfg) -> tuple:
+    # arity is fixed: cfg is a frozen StreamFormerConfig dataclass, so
+    # the field set is a compile-time constant of the class
+    # nnsjit: allow(unbounded-signature)
     return tuple(sorted((k, str(v)) for k, v in vars(cfg).items()))
 
 
@@ -192,9 +197,12 @@ class DecodeEngine:
         self.compiles = 0
 
     # -- executables -----------------------------------------------------
+    @compile_budget(16, site="llm.engine.step")
     def _step_fn(self, padded: int):
         fn = self._step_jit.get(padded)
         if fn is None:
+            compileledger.record("llm.engine.step",
+                                 (("padded", padded),))
             cfg = self.cfg
 
             def _make():
@@ -211,6 +219,7 @@ class DecodeEngine:
             self.compiles += 1
         return fn
 
+    @compile_budget(64, site="llm.engine.pstep")
     def _pstep_fn(self, padded: int, width: int):
         """Paged decode executable: one per ``(padded B, table width)``
         pair — both axes quantized, so the warm set stays a bounded
@@ -218,6 +227,9 @@ class DecodeEngine:
         key = (padded, width)
         fn = self._step_jit.get(key)
         if fn is None:
+            compileledger.record("llm.engine.pstep",
+                                 (("padded", padded),
+                                  ("width", width)))
             cfg = self.cfg
             ps = self.pool.page_size
 
@@ -235,6 +247,7 @@ class DecodeEngine:
             self.compiles += 1
         return fn
 
+    @compile_budget(64, site="llm.engine.chunk")
     def _chunk_fn(self, padded_c: int, width: int):
         """Paged prefill-chunk executable per ``(padded C, table
         width)``; chunk origin and real length ride as traced operands,
@@ -243,6 +256,9 @@ class DecodeEngine:
         key = ("chunk", padded_c, width)
         fn = self._prefill_jit.get(key)
         if fn is None:
+            compileledger.record("llm.engine.chunk",
+                                 (("padded_c", padded_c),
+                                  ("width", width)))
             cfg = self.cfg
             ps = self.pool.page_size
 
@@ -262,9 +278,12 @@ class DecodeEngine:
             self.compiles += 1
         return fn
 
+    @compile_budget(32, site="llm.engine.prefill")
     def _prefill_fn(self, padded_t: int):
         fn = self._prefill_jit.get(padded_t)
         if fn is None:
+            compileledger.record("llm.engine.prefill",
+                                 (("padded_t", padded_t),))
             cfg = self.cfg
             flash = {"auto": None, "flash": True,
                      "naive": False}[self.prefill_mode]

@@ -171,6 +171,9 @@ class SegmentExec:
 
     @staticmethod
     def _sig(arrays, extra: tuple = ()) -> tuple:
+        # arity is fixed: `arrays` is the segment's input tuple, whose
+        # length the compiled plan pins at build time
+        # nnsjit: allow(unbounded-signature)
         return extra + tuple(
             (tuple(a.shape), str(a.dtype), bool(getattr(a, "weak_type",
                                                         False)))
@@ -180,6 +183,8 @@ class SegmentExec:
         import jax
 
         self.compiles += 1
+        from ..analysis import compileledger
+        compileledger.record("pipeline.segment", key)
         exe = jax.jit(fun).lower(self.params, *args).compile()
         self._cache[key] = exe
         return exe

@@ -926,6 +926,8 @@ class TensorQueryClient(Element):
                 raise ValueError(f"{self.name}: connect-type=HYBRID "
                                  "requires topic")
             broker_host = str(self.dest_host or "127.0.0.1")
+            # port 0 is never a routable broker port: 0/unset both
+            # mean "default" # nnslint: allow(falsy-zero-default)
             broker_port = int(self.dest_port or 1883)
             record = fetch_retained_record(
                 broker_host, broker_port, f"nns/query/{self.topic}",

@@ -615,6 +615,8 @@ class TensorQueryServerSrc(Source):
         checks)."""
         out = []
         try:
+            # mirrors start(): batch=0 and unset both serve unbatched
+            # nnslint: allow(falsy-zero-default)
             batch = int(self.batch or 1)
         except (TypeError, ValueError):
             out.append(("error", f"{self.name}: batch={self.batch!r} is "
@@ -650,6 +652,8 @@ class TensorQueryServerSrc(Source):
         # id, which pairs exactly one serving pipeline / negotiated
         # caps / model — coalescing admitted frames ACROSS client
         # connections, reusing tensor_filter's bucket/dispatch core
+        # batch=0 and unset both clamp to 1 under max()
+        # nnslint: allow(falsy-zero-default)
         self._xbatch = max(1, int(self.batch or 1))
         self._xb_timeout = max(0.0, float(self.batch_timeout_ms or 0)) / 1e3
         self._xb_hold = None          # shape-mismatch holdover frame

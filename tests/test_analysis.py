@@ -358,6 +358,28 @@ class TestNnslint:
             "        time.sleep(0.01)\n")
         assert nnslint.lint_paths([str(bad)]) == []
 
+    def test_falsy_zero_default_rule(self, tmp_path):
+        """int/float over an `or`-defaulted read with a NONZERO
+        constant fallback fires (an explicit 0 silently becomes the
+        default); `or 0` / non-read lefts / pragma'd sites stay
+        clean."""
+        bad = tmp_path / "props.py"
+        bad.write_text(
+            "class E:\n"
+            "    def start(self, node):\n"
+            "        a = float(node.attrs.get('alpha') or 0.2)\n"
+            "        p = int(self.dest_port or 1883)\n"
+            "        ok0 = int(self.batch or 0)\n"
+            "        v = self.batch\n"
+            "        ok1 = int(v or 3)\n"
+            "        # port 0 is never routable\n"
+            "        # nnslint: allow(falsy-zero-default)\n"
+            "        ok2 = int(self.port or 5001)\n"
+            "        return a, p, ok0, ok1, ok2\n")
+        got = [v for v in nnslint.lint_paths([str(bad)])
+               if v.rule == "falsy-zero-default"]
+        assert {v.line for v in got} == {3, 4}, got
+
     def test_unbounded_queue_rule(self, tmp_path):
         """queue.Queue()/deque() without a bound in query//pipeline/ is
         a finding; bounded construction and out-of-scope files are not;
@@ -768,3 +790,258 @@ class TestLockOrderRegistry:
         assert lockorder.check_order("pool", "planner") is not None
         assert lockorder.check_order("queue.space", "queue.space") is None
         assert lockorder.check_order("pool", "pool") is not None
+
+
+# ==========================================================================
+# nnsjit static JIT-boundary auditor (ISSUE 19 tentpole)
+# ==========================================================================
+
+from nnstreamer_tpu.analysis import compileledger, jitaudit  # noqa: E402
+
+
+class TestJitAudit:
+    def test_self_run_is_clean(self):
+        """The standing gate: the package passes its own jit audit —
+        every future jit-touching PR inherits this bar (the nnslint
+        self-run discipline, applied to the bounded-executable
+        contract)."""
+        findings = jitaudit.audit_paths(
+            [os.path.join(REPO, "nnstreamer_tpu")], root=REPO)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_seeded_violations_all_fire(self, tmp_path):
+        bad = tmp_path / "seeded.py"
+        bad.write_text(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def _step_fn(padded):\n"
+            "    return padded\n"
+            "def model(params, x):\n"
+            "    n = float(x)\n"            # host-sync-in-jit
+            "    if x > 0:\n"               # tracer-branch
+            "        n += 1\n"
+            "    return n\n"
+            "_j = jax.jit(model)\n"
+            "def mutator(params, pool, x):\n"
+            "    pool = pool.at[0].set(x)\n"
+            "    return pool\n"
+            "_m = jax.jit(mutator)\n"       # missing-donation
+            "def host_driver(tokens):\n"
+            "    t = len(tokens)\n"
+            "    return _step_fn(t)\n"      # unquantized-shape-at-jit
+            "def _sig(arrays):\n"
+            "    return tuple(a.dtype for a in arrays)\n")  # unbounded
+        got = {f.rule for f in jitaudit.audit_paths([str(bad)],
+                                                    root=str(tmp_path))}
+        assert got == {"host-sync-in-jit", "tracer-branch",
+                       "missing-donation", "unquantized-shape-at-jit",
+                       "unbounded-signature"}, got
+
+    def test_disciplined_code_is_clean(self, tmp_path):
+        """The mirror image: the same shapes of code written WITH the
+        discipline — quantized lengths, donated pools, shape-only
+        branches, host work on static arguments — produce no
+        findings."""
+        ok = tmp_path / "ok.py"
+        ok.write_text(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def pad_rows(n, cap):\n"
+            "    return min(cap, n)\n"
+            "def _step_fn(padded):\n"
+            "    return padded\n"
+            "def model(params, x):\n"
+            "    if x.shape[0] > 8:\n"          # shape branch: static
+            "        return jnp.sum(x)\n"
+            "    return jnp.max(x)\n"
+            "_j = jax.jit(model)\n"
+            "def mutator(params, pool, x):\n"
+            "    pool = pool.at[0].set(x)\n"
+            "    return pool\n"
+            "_m = jax.jit(mutator, donate_argnums=(1,))\n"
+            "def host_driver(tokens):\n"
+            "    t = len(tokens)\n"
+            "    return _step_fn(pad_rows(t, 64))\n"
+            "def host_report(cfg: object, n: int):\n"
+            "    return float(n) if n > 0 else 0.0\n")
+        findings = jitaudit.audit_paths([str(ok)], root=str(tmp_path))
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_pragma_suppresses(self, tmp_path):
+        bad = tmp_path / "pragma.py"
+        bad.write_text(
+            "import jax\n"
+            "def model(params, x):\n"
+            "    # trace-time constant fold, arity fixed by caller\n"
+            "    # nnsjit: allow(host-sync-in-jit)\n"
+            "    return float(x)\n"
+            "_j = jax.jit(model)\n")
+        assert jitaudit.audit_paths([str(bad)],
+                                    root=str(tmp_path)) == []
+
+    def test_syntax_error_is_a_finding_not_a_crash(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def oops(:\n")
+        findings = jitaudit.audit_paths([str(bad)], root=str(tmp_path))
+        assert len(findings) == 1
+        assert findings[0].rule == "syntax"
+
+    def test_cli_exits_nonzero_on_findings(self, tmp_path):
+        import subprocess
+        bad = tmp_path / "seeded.py"
+        bad.write_text("import jax\n"
+                       "def model(params, x):\n"
+                       "    return float(x)\n"
+                       "_j = jax.jit(model)\n")
+        tool = os.path.join(REPO, "tools", "nnsjit.py")
+        r = subprocess.run([sys.executable, tool, str(bad)],
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 1
+        assert "host-sync-in-jit" in r.stdout
+        r2 = subprocess.run([sys.executable, tool, "--list-rules"],
+                            capture_output=True, text=True, timeout=60)
+        assert r2.returncode == 0
+        assert set(r2.stdout.split()) == set(jitaudit.RULES)
+
+
+# ==========================================================================
+# compile-ledger sentinel (ISSUE 19 tentpole, runtime half)
+# ==========================================================================
+
+@pytest.fixture
+def clean_ledger():
+    was = compileledger.ENABLED
+    compileledger.configure(True)
+    compileledger.reset()
+    yield
+    compileledger.configure(was)
+    compileledger.reset()
+
+
+class TestCompileLedger:
+    def test_record_counts_and_snapshot(self, clean_ledger):
+        compileledger.record("t.site.a", (("padded", 8),))
+        compileledger.record("t.site.a", (("padded", 16),))
+        compileledger.record("t.site.b", (("width", 4),))
+        assert compileledger.count("t.site.a") == 2
+        assert compileledger.count("t.site.b") == 1
+        snap = compileledger.snapshot()
+        assert snap["t.site.a"] == 2 and snap["t.site.b"] == 1
+
+    def test_duplicate_signature_is_not_novel(self, clean_ledger):
+        """Budgets cap the executable SET, not the compile count: a
+        cache re-warm of a signature already seen never raises."""
+        compileledger.declare_budget("t.site.dup", 1)
+        compileledger.record("t.site.dup", (("padded", 8),))
+        compileledger.record("t.site.dup", (("padded", 8),))
+        compileledger.record("t.site.dup", (("padded", 8),))
+        assert compileledger.count("t.site.dup") == 3
+
+    def test_budget_overflow_raises_with_both_signatures_diffed(
+            self, clean_ledger):
+        compileledger.declare_budget("t.site.over", 1)
+        compileledger.record("t.site.over", (("padded", 8),))
+        with pytest.raises(compileledger.CompileBudgetExceeded) as ei:
+            compileledger.record("t.site.over", (("padded", 136),))
+        msg = str(ei.value)
+        assert "t.site.over" in msg
+        assert "padded" in msg and "8" in msg and "136" in msg
+        # the evidence is kept: the over-budget compile IS recorded
+        assert compileledger.count("t.site.over") == 2
+
+    def test_nearest_neighbor_diff_picks_fewest_fields(
+            self, clean_ledger):
+        site = "t.site.nn"
+        compileledger.record(site, (("a", 1), ("b", 2)))
+        compileledger.record(site, (("a", 1), ("b", 3)))
+        ev = compileledger.record(site, (("a", 9), ("b", 3)))
+        # neighbor is the SECOND signature (one field away), not the
+        # first (two fields away)
+        assert ev.diff == (("a", 1, 9),)
+
+    def test_first_compile_has_empty_diff(self, clean_ledger):
+        ev = compileledger.record("t.site.first", (("padded", 8),))
+        assert ev.diff == ()
+        assert "first compile" in compileledger.format_diff(ev.diff)
+
+    def test_reset_clears_events_keeps_budgets(self, clean_ledger):
+        compileledger.declare_budget("t.site.keep", 7)
+        compileledger.record("t.site.keep", (("padded", 8),))
+        compileledger.reset()
+        assert compileledger.count() == 0
+        assert compileledger.budgets()["t.site.keep"] == 7
+
+    def test_off_is_a_noop(self, clean_ledger):
+        compileledger.configure(False)
+        assert compileledger.record("t.site.off", (("padded", 8),)) \
+            is None
+        assert compileledger.count("t.site.off") == 0
+
+    def test_metric_export(self, clean_ledger):
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+        before = REGISTRY.counter("nns_jit_compiles_total",
+                                  site="t.site.metric").value
+        compileledger.record("t.site.metric", (("padded", 8),))
+        compileledger.record("t.site.metric", (("padded", 16),))
+        after = REGISTRY.counter("nns_jit_compiles_total",
+                                 site="t.site.metric").value
+        assert after - before == 2
+
+    def test_engine_sites_declare_budgets(self):
+        """Importing the engine registers its four decorated sites —
+        the wiring `--check --jit` surfaces."""
+        pytest.importorskip("jax")
+        import nnstreamer_tpu.llm.engine  # noqa: F401
+        b = compileledger.budgets()
+        for site in ("llm.engine.step", "llm.engine.pstep",
+                     "llm.engine.chunk", "llm.engine.prefill"):
+            assert b.get(site, 0) > 0, site
+
+    def test_engine_warmup_records_and_steady_state_is_silent(
+            self, clean_ledger):
+        """The acceptance shape, in-process: a warm engine records its
+        executable set once; further steps at warm fill levels add
+        ZERO ledger events."""
+        pytest.importorskip("jax")
+        import jax.numpy as jnp
+        from nnstreamer_tpu.llm.engine import DecodeEngine
+        from nnstreamer_tpu.llm.pool import KVCachePool
+        from nnstreamer_tpu.parallel.train_step import (StreamFormerConfig,
+                                                        init_params)
+
+        cfg = StreamFormerConfig(vocab=31, dim=16, heads=2, head_dim=8,
+                                 mlp=32, layers=1, experts=2, max_seq=16,
+                                 dtype=jnp.float32)
+        params = init_params(cfg, 5)
+        pool = KVCachePool(cfg, 2)
+        eng = DecodeEngine(params, cfg, pool, capacity=2)
+        eng.warmup()
+        warm = sum(n for s, n in compileledger.snapshot().items()
+                   if s.startswith("llm.engine."))
+        assert warm >= 1
+        sessions = [pool.acquire(i) for i in range(2)]
+        for s in sessions:
+            s.max_new = 8
+            s.next_token = s.key + 1
+        mark = compileledger.snapshot()
+        for fill in (2, 1, 2, 1):
+            eng.step(sessions[:fill])
+        after = compileledger.snapshot()
+        steady = sum(
+            after.get(s, 0) - mark.get(s, 0)
+            for s in set(after) | set(mark)
+            if s.startswith("llm.engine."))
+        assert steady == 0, (mark, after)
+
+
+class TestCheckJitCLI:
+    def test_check_jit_flag_stands_alone_prints_budgets(self, capsys):
+        """``--check --jit`` needs no pipeline string (the jit audit
+        has nothing to parse), audits the package clean, and surfaces
+        the declared compile budgets."""
+        from nnstreamer_tpu.launch import main as launch_main
+
+        assert launch_main(["--check", "--jit"]) == 0
+        err = capsys.readouterr().err
+        assert "check: jit: OK" in err
+        assert "budget llm.engine.step" in err

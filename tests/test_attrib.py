@@ -685,6 +685,42 @@ class TestPerfDiff:
         assert not pd.lower_is_better("sessions_per_run")
         assert not pd.lower_is_better("fps")
 
+    def test_compile_counters_lower_better_by_name(self, tmp_path):
+        """Satellite (ISSUE 19): compile counts are costs — the ledger
+        exports ``nns_jit_compiles_total`` unitless, so the metric NAME
+        must carry the direction.  A compile-count increase is a
+        REGRESSION, never read as throughput."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "perf_diff", os.path.join(TOOLS, "perf_diff.py"))
+        pd = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pd)
+        assert pd.lower_is_better("", metric="nns_jit_compiles_total")
+        assert pd.lower_is_better(
+            "", metric='nns_jit_compiles_total{site="llm.engine.step"}')
+        assert pd.lower_is_better("", metric="steady_compiles")
+        assert pd.lower_is_better("count", metric="segment_recompiles")
+        # names that merely contain "compile" letters elsewhere or are
+        # throughput stay higher-is-better
+        assert not pd.lower_is_better("", metric="tokens_total")
+        assert not pd.lower_is_better("fps", metric="flagship_fps")
+        # end-to-end: a compile-count rise REGRESSES through the gate
+        a = tmp_path / "a.jsonl"
+        b = tmp_path / "b.jsonl"
+        c = tmp_path / "c.jsonl"
+        row = {"metric": "nns_jit_compiles_total", "value": 4, "unit": ""}
+        self._write(a, [row])
+        self._write(b, [dict(row, value=5)])
+        self._write(c, [dict(row, value=40)])
+        r = self._run("--baseline", str(a), "--baseline", str(b),
+                      "--candidate", str(c), "--json")
+        assert r.returncode == 1, r.stdout + r.stderr
+        verdict = json.loads(r.stdout)
+        [reg] = verdict["regressions"]
+        assert reg["metric"] == "nns_jit_compiles_total"
+        assert reg["direction"] == "lower_better"
+
     def test_progressive_reemits_last_row_wins(self, tmp_path):
         """bench.py re-emits the same metric row progressively enriched
         (core value first, attribution added later): the LAST line must

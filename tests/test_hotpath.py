@@ -652,3 +652,22 @@ def test_hotpath_bench_llmpaged_gate():
     assert r.returncode == 0, (
         f"llmpaged gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
     assert '"hotpath_llmpaged_gate"' in r.stdout
+
+
+@pytest.mark.perf
+def test_hotpath_bench_jitledger_gate():
+    """CI gate: tools/hotpath_bench.py --assert --stage jitledger fails
+    when the compile-ledger sentinel (ISSUE 19) breaks its bargain:
+    the sentinel-OFF guard on the dispatch path must cost < 2% of a
+    stacked dispatch, warmup must record >= 1 attributed compile at
+    the filter site, the steady-state window over every fill level
+    must record ZERO novel compiles, and an over-budget signature must
+    raise CompileBudgetExceeded naming the differing field."""
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "hotpath_bench.py")
+    r = subprocess.run([sys.executable, tool, "--assert", "--stage",
+                        "jitledger"],
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (
+        f"jitledger gate failed:\nstdout: {r.stdout}\nstderr: {r.stderr}")
+    assert '"hotpath_jitledger_gate"' in r.stdout

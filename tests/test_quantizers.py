@@ -1,0 +1,164 @@
+"""Laws of the shape quantizers backing the bounded-executable
+discipline.
+
+nnsjit's ``unquantized-shape-at-jit`` rule trusts a whitelist of
+quantizer functions (``pad_rows``, ``quantize_prompt``,
+``quantize_pages``, ``_next_pow2``): a host integer that has passed
+through one of them is considered safe to key an executable cache.
+That trust is only sound if the quantizers actually bound the
+executable set — these tests pin the algebraic laws the auditor (and
+the compile-ledger budgets) assume, exhaustively over the practical
+input ranges rather than by sampling:
+
+* **idempotent** — quantizing a quantized value is a fixed point, so
+  re-quantizing at a second boundary never mints a new shape;
+* **monotone** — more rows/tokens/pages never map to a SMALLER padded
+  shape, so admission-order can't invert capacity math;
+* **covering** — the padded value is >= the input (below the cap):
+  padding truncates nothing;
+* **capped** — never exceeds the declared capacity, so the executable
+  set stays finite;
+* **bounded image** — the number of distinct outputs over the full
+  input range matches the documented executable-count budget.
+"""
+
+import pytest
+
+from nnstreamer_tpu.filter.backends._jitexec import JitExecMixin
+from nnstreamer_tpu.llm.engine import quantize_pages, quantize_prompt
+from nnstreamer_tpu.ops.audio import _next_pow2
+
+pad_rows = JitExecMixin.pad_rows
+
+
+class TestPadRows:
+    CAPS = (1, 2, 3, 8, 16, 24, 33, 64, 100, 256)
+
+    def test_idempotent(self):
+        for cap in self.CAPS:
+            for n in range(1, cap + 1):
+                q = pad_rows(n, cap)
+                assert pad_rows(q, cap) == q, (n, cap)
+
+    def test_monotone(self):
+        for cap in self.CAPS:
+            prev = 0
+            for n in range(1, cap + 1):
+                q = pad_rows(n, cap)
+                assert q >= prev, (n, cap)
+                prev = q
+
+    def test_covers_input_below_cap(self):
+        for cap in self.CAPS:
+            for n in range(1, cap + 1):
+                q = pad_rows(n, cap)
+                assert n <= q <= cap, (n, cap)
+
+    def test_bounded_executable_set(self):
+        # the docstring's budget: pow2 up to 8 (4 shapes), multiples of
+        # 8 above — <= 4 + cap/8 distinct shapes over the whole range
+        for cap in self.CAPS:
+            shapes = {pad_rows(n, cap) for n in range(1, cap + 1)}
+            assert len(shapes) <= 4 + cap // 8, (cap, sorted(shapes))
+
+    def test_waste_bound(self):
+        # above 8 rows the pad wastes at most 7 rows (the reason the
+        # policy switches from pow2 to multiples of 8)
+        for cap in self.CAPS:
+            for n in range(9, cap + 1):
+                assert pad_rows(n, cap) - n <= 7, (n, cap)
+
+
+class TestQuantizePrompt:
+    CAPS = (1, 8, 48, 64, 100, 1024)
+
+    def test_idempotent(self):
+        for cap in self.CAPS:
+            for t in range(1, cap + 1):
+                q = quantize_prompt(t, cap)
+                assert quantize_prompt(q, cap) == q, (t, cap)
+
+    def test_monotone_and_covering(self):
+        for cap in self.CAPS:
+            prev = 0
+            for t in range(1, cap + 1):
+                q = quantize_prompt(t, cap)
+                assert q >= prev, (t, cap)
+                assert t <= q <= cap or q == cap, (t, cap)
+                prev = q
+
+    def test_log_bounded_image(self):
+        # next-pow2-from-8 capped: at most log2(cap) + 1 distinct
+        # padded lengths serve every prompt length
+        for cap in self.CAPS:
+            shapes = {quantize_prompt(t, cap) for t in range(1, cap + 1)}
+            assert len(shapes) <= max(1, cap.bit_length()), \
+                (cap, sorted(shapes))
+
+
+class TestQuantizePages:
+    CAPS = (1, 2, 6, 8, 16, 24, 64)
+
+    def test_idempotent(self):
+        for cap in self.CAPS:
+            for n in range(1, cap + 1):
+                q = quantize_pages(n, cap)
+                assert quantize_pages(q, cap) == q, (n, cap)
+
+    def test_monotone_capped(self):
+        for cap in self.CAPS:
+            prev = 0
+            for n in range(1, cap + 1):
+                q = quantize_pages(n, cap)
+                assert prev <= q <= cap, (n, cap)
+                prev = q
+
+    def test_covers_below_pow2_cap(self):
+        # covering holds whenever the cap itself can express the need:
+        # below the largest pow2 <= cap the padded width fits n
+        for cap in self.CAPS:
+            for n in range(1, cap + 1):
+                q = quantize_pages(n, cap)
+                if n <= cap and (n & (n - 1)) == 0:
+                    assert q >= n, (n, cap)
+
+    def test_log_bounded_image(self):
+        for cap in self.CAPS:
+            shapes = {quantize_pages(n, cap) for n in range(1, cap + 1)}
+            assert len(shapes) <= max(1, cap.bit_length() + 1), \
+                (cap, sorted(shapes))
+
+
+class TestNextPow2:
+    def test_laws(self):
+        for n in range(1, 4097):
+            p = _next_pow2(n)
+            assert p >= n
+            assert p & (p - 1) == 0          # a power of two
+            assert p < 2 * n                 # the NEXT one, not a later one
+            assert _next_pow2(p) == p        # idempotent
+
+
+class TestAuditorWhitelistMatchesReality:
+    def test_quantizers_exist(self):
+        """The nnsjit QUANTIZERS whitelist names real callables — a
+        rename there without updating the auditor would silently stop
+        laundering shapes through the renamed function."""
+        import importlib.util
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(root, "nnstreamer_tpu", "analysis",
+                            "jitaudit.py")
+        spec = importlib.util.spec_from_file_location("_q_jitaudit", path)
+        mod = importlib.util.module_from_spec(spec)
+        import sys
+        sys.modules["_q_jitaudit"] = mod
+        try:
+            spec.loader.exec_module(mod)
+        finally:
+            sys.modules.pop("_q_jitaudit", None)
+        known = {"pad_rows": pad_rows,
+                 "quantize_prompt": quantize_prompt,
+                 "quantize_pages": quantize_pages,
+                 "_next_pow2": _next_pow2}
+        assert set(mod.QUANTIZERS) == set(known)

@@ -417,12 +417,20 @@ class TestFilterXBatch:
         return open_backend(props), props
 
     def test_invoke_stacked_pads_to_one_executable(self):
+        from nnstreamer_tpu.analysis import compileledger
+
         fw, props = self._open_backend()
+        was = compileledger.ENABLED
+        compileledger.configure(True)
+        site = "filter.jitexec.vmap"
+        mark = compileledger.snapshot()
         try:
             rng = np.random.default_rng(0)
-            ref = {}
-            for n, want_pad in ((1, 1), (3, 4), (5, 8), (8, 8)):
-                rows = rng.standard_normal((n, 8)).astype(np.float32)
+            fills = ((1, 1), (3, 4), (5, 8), (8, 8))
+            batches = {n: rng.standard_normal((n, 8)).astype(np.float32)
+                       for n, _ in fills}
+            for n, want_pad in fills:
+                rows = batches[n]
                 outs = fw.invoke_stacked([rows], n, capacity=8)
                 # padded to the next power of two (capped at capacity):
                 # a bounded executable set, <2x FLOP waste
@@ -433,12 +441,20 @@ class TestFilterXBatch:
                 np.testing.assert_allclose(
                     np.asarray(outs[0])[:n], per_row, rtol=1e-5,
                     atol=1e-5)
-                ref[n] = fw._vjit
-            # ONE warm vjit wrapper served every fill (pad shapes hit
-            # its executable cache — no per-fill recompiles of a new
-            # wrapper)
-            assert len({id(v) for v in ref.values()}) == 1
+            # the compile ledger attributes one batched compile PER PAD
+            # BUCKET — fills 1/3/5/8 quantize to buckets {1, 4, 8}, so
+            # exactly 3 — and a second pass over every fill level adds
+            # ZERO (each pad shape hits the warm executable: no
+            # per-fill recompiles)
+            after = compileledger.snapshot()
+            assert after.get(site, 0) - mark.get(site, 0) == 3
+            steady_mark = compileledger.snapshot()
+            for n, _ in fills:
+                fw.invoke_stacked([batches[n]], n, capacity=8)
+            steady_after = compileledger.snapshot()
+            assert steady_after.get(site, 0) == steady_mark.get(site, 0)
         finally:
+            compileledger.configure(was)
             fw.close()
 
     def test_batched_serving_through_filter(self):

@@ -576,6 +576,45 @@ FUSEXLA_LAUNCH = (
 _FUSEXLA_BUCKET = 8
 
 
+class _LedgerWindow:
+    """Shared zero-steady-state-compile window over the compile ledger
+    (analysis/compileledger.py): flip the sentinel on, ``mark()`` at
+    the warm boundary, and ``steady()`` is the number of compiles the
+    wired sites recorded since — the ONE mechanism behind every
+    per-stage "zero compiles after warmup" gate (fusexla, llmdecode,
+    llmpaged, and the in-process xbatch warm-set test), replacing each
+    stage's hand-rolled executable-counter diff."""
+
+    def __init__(self):
+        from nnstreamer_tpu.analysis import compileledger
+
+        self.cl = compileledger
+        self._was = compileledger.ENABLED
+        compileledger.configure(True)
+        self._mark = compileledger.snapshot()
+
+    def mark(self) -> None:
+        self._mark = self.cl.snapshot()
+
+    def steady(self, prefix: str = "") -> int:
+        after = self.cl.snapshot()
+        return sum(v - self._mark.get(k, 0) for k, v in after.items()
+                   if k.startswith(prefix))
+
+    def sites(self, prefix: str = "") -> dict:
+        """Nonzero per-site deltas since mark — the failure message's
+        evidence."""
+        after = self.cl.snapshot()
+        out = {}
+        for k, v in after.items():
+            if k.startswith(prefix) and v - self._mark.get(k, 0):
+                out[k] = v - self._mark.get(k, 0)
+        return out
+
+    def close(self) -> None:
+        self.cl.configure(self._was)
+
+
 def _fusexla_session(tier: str, warmup: int, buckets: int):
     """One pipeline per tier: feed ``warmup`` stacked bucket-8 buffers
     (compiles happen here), snapshot the plan, then time ``buckets``
@@ -585,7 +624,10 @@ def _fusexla_session(tier: str, warmup: int, buckets: int):
     push count: the fuse-xla double buffer holds a frame only while the
     appsrc fifo carries the next item (``has_pending_input`` gate), so
     the final bucket always flushes synchronously.
-    Returns (seconds_for_buckets, warm_plans, final_plans)."""
+    Returns (seconds_for_buckets, warm_plans, final_plans,
+    ledger_steady) — ledger_steady is the compile-ledger delta over the
+    timed window (pipeline.segment site; None for the python tier,
+    which jits nothing)."""
     from nnstreamer_tpu import parse_launch
     from nnstreamer_tpu.pipeline.graph import Pipeline
     from nnstreamer_tpu.tensor.buffer import XBatchMeta
@@ -628,18 +670,25 @@ def _fusexla_session(tier: str, warmup: int, buckets: int):
             raise RuntimeError(f"fusexla bench stalled (tier={tier}, "
                                f"got {n_got[0]}/{target[0]})")
 
+    ledger = _LedgerWindow() if tier == "xla" else None
     try:
         push_and_wait(warmup)
         warm_plans = p.planner.plans()
+        if ledger is not None:
+            ledger.mark()
         t0 = time.perf_counter()
         push_and_wait(buckets)
         dt = time.perf_counter() - t0
+        steady = (ledger.steady("pipeline.segment")
+                  if ledger is not None else None)
         final_plans = p.planner.plans()
         src.end_of_stream()
         p.wait(timeout=60)
     finally:
+        if ledger is not None:
+            ledger.close()
         p.stop()
-    return dt, warm_plans, final_plans
+    return dt, warm_plans, final_plans, steady
 
 
 def _fusexla_measure(buckets: int = 300, reps: int = 3):
@@ -647,24 +696,25 @@ def _fusexla_measure(buckets: int = 300, reps: int = 3):
     xla_us_per_bucket, warm_plans, final_plans) with the plan snapshots
     from the best xla run (compile/hit counters feed the cache gate)."""
     py = xla = None
-    warm = final = None
+    warm = final = steady = None
     for _ in range(reps):
-        dt, _, _ = _fusexla_session("python", warmup=12, buckets=buckets)
+        dt, _, _, _ = _fusexla_session("python", warmup=12,
+                                       buckets=buckets)
         py = dt if py is None else min(py, dt)
-        dt, w, f = _fusexla_session("xla", warmup=12, buckets=buckets)
+        dt, w, f, s = _fusexla_session("xla", warmup=12, buckets=buckets)
         if xla is None or dt < xla:
-            xla, warm, final = dt, w, f
-    return (py / buckets * 1e6, xla / buckets * 1e6, warm, final)
+            xla, warm, final, steady = dt, w, f, s
+    return (py / buckets * 1e6, xla / buckets * 1e6, warm, final,
+            steady)
 
 
 def bench_fusexla(frames: int) -> dict:
     buckets = max(100, frames)
-    py_us, xla_us, warm, final = _fusexla_measure(buckets)
+    py_us, xla_us, warm, final, steady_compiles = \
+        _fusexla_measure(buckets)
     seg = next((pl for pl in final if pl.get("lowering") == "xla"), {})
     warm_seg = next((pl for pl in warm
                      if pl.get("lowering") == "xla"), {})
-    steady_compiles = (seg.get("compiles", 0)
-                      - warm_seg.get("compiles", 0))
     return {"metric": "hotpath_fusexla_speedup",
             "value": round(py_us / max(1e-9, xla_us), 2), "unit": "x",
             "python_us_per_bucket": round(py_us, 1),
@@ -684,16 +734,18 @@ def run_assert_fusexla() -> int:
     where python pays a device invoke plus per-element host math), the
     chain must actually lower (4 fused elements, lowering=xla, no
     fallback), and the per-segment executable cache must be 100% warm
-    in steady state: ZERO compiles after warmup, every timed bucket a
-    cache hit.  Min-of-reps with re-measure on a miss: scheduler noise
-    is one-sided, a real regression survives."""
+    in steady state: ZERO compiles after warmup (read from the compile
+    ledger's pipeline.segment site — the shared sentinel every stage's
+    zero-compile gate now rides), every timed bucket a cache hit.
+    Min-of-reps with re-measure on a miss: scheduler noise is
+    one-sided, a real regression survives."""
     failures = []
-    py_us, xla_us, warm, final = _fusexla_measure()
+    py_us, xla_us, warm, final, steady = _fusexla_measure()
     ratio = py_us / max(1e-9, xla_us)
     for _ in range(2):
         if ratio >= 2.0:
             break
-        p2, x2, warm, final = _fusexla_measure()
+        p2, x2, warm, final, steady = _fusexla_measure()
         py_us, xla_us = max(py_us, p2), min(xla_us, x2)
         ratio = py_us / max(1e-9, xla_us)
     seg = next((pl for pl in final if pl.get("lowering") == "xla"), None)
@@ -702,14 +754,14 @@ def run_assert_fusexla() -> int:
             f"the 4-element chain did not lower to fuse-xla (plans: "
             f"{final})")
     else:
-        warm_seg = next((pl for pl in warm
-                         if pl.get("lowering") == "xla"), {})
-        steady = seg.get("compiles", 0) - warm_seg.get("compiles", 0)
-        if steady > 0:
+        if steady:
             failures.append(
-                f"{steady} XLA compile(s) AFTER warmup: the per-segment "
+                f"{steady} XLA compile(s) AFTER warmup (compile "
+                "ledger, pipeline.segment): the per-segment "
                 "executable cache is recompiling in steady state "
                 "(per-fill or per-frame cache-key churn)")
+        warm_seg = next((pl for pl in warm
+                         if pl.get("lowering") == "xla"), {})
         hits = seg.get("exec_cache_hits", 0) - \
             warm_seg.get("exec_cache_hits", 0)
         dispatched = seg.get("dispatches", 0) - \
@@ -909,23 +961,30 @@ def _llmdecode_measure(bucket: int = 8, steps: int = 60):
     pool = KVCachePool(cfg, bucket)
     eng = DecodeEngine(params, cfg, pool, capacity=bucket)
     eng.warmup()
-    sessions = [pool.acquire(i) for i in range(bucket)]
-    for s in sessions:
-        s.max_new, s.next_token = 1 << 30, 1 + s.slot
-    batched = _tok_s(eng, sessions, steps, per_session=False)
-    sequential = _tok_s(eng, sessions, steps, per_session=True)
-    solo = _tok_s(eng, sessions[:1], steps * 3, per_session=False)
+    ledger = _LedgerWindow()
+    try:
+        sessions = [pool.acquire(i) for i in range(bucket)]
+        for s in sessions:
+            s.max_new, s.next_token = 1 << 30, 1 + s.slot
+        batched = _tok_s(eng, sessions, steps, per_session=False)
+        sequential = _tok_s(eng, sessions, steps, per_session=True)
+        solo = _tok_s(eng, sessions[:1], steps * 3, per_session=False)
+        # read BEFORE the capacity-1 engine warms up (its compiles are
+        # legitimate): every fill level above hit a warm executable
+        steady = ledger.steady("llm.engine.")
+    finally:
+        ledger.close()
     pool1 = KVCachePool(cfg, 1)
     eng1 = DecodeEngine(params, cfg, pool1, capacity=1)
     eng1.warmup()
     s1 = pool1.acquire("solo")
     s1.max_new, s1.next_token = 1 << 30, 3
     dedicated = _tok_s(eng1, [s1], steps * 3, per_session=False)
-    return batched, sequential, solo, dedicated
+    return batched, sequential, solo, dedicated, steady
 
 
 def bench_llmdecode(frames: int) -> dict:
-    batched, sequential, solo, dedicated = _llmdecode_measure()
+    batched, sequential, solo, dedicated, steady = _llmdecode_measure()
     return {"metric": "hotpath_llmdecode_tok_s",
             "value": round(batched, 1), "unit": "tokens_per_s",
             "sequential_tok_s": round(sequential, 1),
@@ -934,6 +993,7 @@ def bench_llmdecode(frames: int) -> dict:
             "dedicated_tok_s": round(dedicated, 1),
             "solo_overhead_pct": round(
                 (dedicated / max(1e-9, solo) - 1.0) * 100.0, 2),
+            "steady_compiles": steady,
             "bucket": 8}
 
 
@@ -948,19 +1008,30 @@ def run_assert_llmdecode() -> int:
     whole pool copies per step and a solo session is taxed >50% for
     merely sharing a large pool).  Best-attempt retry on a miss
     (scheduler noise on a shared host is one-sided; a real regression
-    survives both attempts — run_assert_xbatch discipline)."""
+    survives both attempts — run_assert_xbatch discipline).  The
+    warmed engine must also show ZERO steady-state compiles on the
+    ledger across every fill level the measure drives (8-at-once,
+    one-at-a-time, solo) — the bounded-executables contract the
+    padded-lane quantization exists to keep."""
     failures = []
-    batched, sequential, solo, dedicated = _llmdecode_measure()
+    batched, sequential, solo, dedicated, steady = _llmdecode_measure()
     ratio = batched / max(1e-9, sequential)
     overhead = (dedicated / max(1e-9, solo) - 1.0) * 100.0
     if ratio < 2.0 or overhead > 5.0:
-        b2, s2, so2, d2 = _llmdecode_measure()
+        b2, s2, so2, d2, st2 = _llmdecode_measure()
         r2 = b2 / max(1e-9, s2)
         o2 = (d2 / max(1e-9, so2) - 1.0) * 100.0
         if r2 > ratio:
             ratio, batched, sequential = r2, b2, s2
         if o2 < overhead:
             overhead, solo, dedicated = o2, so2, d2
+        steady = min(steady, st2)   # compile gate: deterministic, but a
+        #                             retried run may warm from the memo
+    if steady:
+        failures.append(
+            f"{steady} steady-state compile(s) on the ledger across "
+            "the measured fill levels: warmup no longer covers the "
+            "padded decode lanes")
     if ratio < 2.0:
         failures.append(
             f"batched decode only {ratio:.2f}x sequential "
@@ -978,6 +1049,7 @@ def run_assert_llmdecode() -> int:
               "batched_tok_s": round(batched, 1),
               "sequential_tok_s": round(sequential, 1),
               "solo_overhead_pct": round(overhead, 2),
+              "steady_compiles": steady,
               "failures": failures}
     print(json.dumps(result), flush=True)
     return 1 if failures else 0
@@ -1009,8 +1081,9 @@ def _llmpaged_measure(bucket: int = 4, steps: int = 60):
       with an empty prefix cache vs the same prompt re-arriving after
       a release (chain-hash hit maps the shared pages; only the tail
       suffix computes).
-    - ``steady_compiles``: executable-cache growth during the measured
-      decode/prefill traffic — must be 0 after warmup.
+    - ``steady_compiles``: compile-ledger growth (llm.engine.* sites)
+      during the measured decode/prefill traffic — must be 0 after
+      warmup.
     """
     import numpy as _np
 
@@ -1064,7 +1137,7 @@ def _llmpaged_measure(bucket: int = 4, steps: int = 60):
         s.max_new = 1 << 30
         s.next_token = eng_p.prefill(s, prompt1)
         sess_p.append(s)
-    compiles0 = eng_p.compiles
+    ledger = _LedgerWindow()
     paged_tok_s = _tok_s(eng_p, sess_p, steps)
     for s in sess_d:
         pool_d.release(s.key)
@@ -1108,7 +1181,8 @@ def _llmpaged_measure(bucket: int = 4, steps: int = 60):
     _prefill_s(1, cold=False)    # seed the registry warm
     warm_s = _prefill_s(4, cold=False)
     hits = pool_p.prefix_hits
-    steady = eng_p.compiles - compiles0
+    steady = ledger.steady("llm.engine.")
+    ledger.close()
     return {"dense_tok_s": dense_tok_s, "paged_tok_s": paged_tok_s,
             "dense_resident": dense_resident,
             "paged_resident": paged_resident,
@@ -1191,6 +1265,160 @@ def run_assert_llmpaged() -> int:
               "prefix_speedup": round(speedup, 2),
               "prefix_hits": m["prefix_hits"],
               "steady_compiles": m["steady_compiles"],
+              "failures": failures}
+    print(json.dumps(result), flush=True)
+    return 1 if failures else 0
+
+
+def _jitledger_measure(reps: int = 5, steps: int = 200):
+    """Compile-ledger sentinel stage, two halves:
+
+    - **overhead**: the sentinel-OFF guard is exactly one module
+      attribute load + falsy branch per dispatch site
+      (``if compileledger.ENABLED:``), so its cost is measured
+      DIRECTLY — a tight loop over the guard expression — and gated
+      against the measured steady-state ``invoke_stacked`` dispatch
+      time (min-of-reps).  Sentinel-ON adds the per-dispatch
+      signature-set probe; its OFF-vs-ON delta is reported as info
+      (diagnostic mode's price, not gated — you turn the sentinel on
+      to hunt a compile storm, not to serve).
+    - **function**: during warmup the ledger must see the pad-bucket
+      compiles (one per distinct padded shape); across every fill
+      level afterwards it must see ZERO; and a site that exceeds its
+      declared budget must raise with the signature diff in the
+      message.
+
+    Returns (guard_us, off_us, on_us, warm_compiles, steady,
+    budget_ok) — guard_us is the per-dispatch cost of the TWO
+    sentinel-off guards on the stacked path."""
+    from nnstreamer_tpu.analysis import compileledger
+    from nnstreamer_tpu.analysis.compileledger import (
+        CompileBudgetExceeded)
+    from nnstreamer_tpu.filter.framework import (FilterProperties,
+                                                 open_backend)
+
+    props = FilterProperties(
+        framework="xla", model="mlp",
+        custom_properties={"in_dim": "64", "width": "128", "depth": "2",
+                           "out_dim": "8", "seed": "3"})
+    fw = open_backend(props)
+    was = compileledger.ENABLED
+    try:
+        ledger = _LedgerWindow()
+        fw.warmup_stacked(8)
+        warm_compiles = ledger.steady("filter.jitexec.")
+        rng = np.random.default_rng(11)
+        rows = rng.standard_normal((8, 64)).astype(np.float32)
+        ledger.mark()
+        for n in (5, 3, 1, 8, 2, 6, 4, 7):
+            fw.invoke_stacked([rows[:n]], n, capacity=8)
+        steady = ledger.steady("filter.jitexec.")
+        ledger.close()
+
+        def _us(sentinel_on: bool) -> float:
+            compileledger.configure(sentinel_on)
+            for _ in range(5):
+                np.asarray(fw.invoke_stacked([rows[:5]], 5,
+                                             capacity=8)[0])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                np.asarray(fw.invoke_stacked([rows[:5]], 5,
+                                             capacity=8)[0])
+            return (time.perf_counter() - t0) / steps * 1e6
+
+        off_us = on_us = float("inf")
+        for _ in range(reps):
+            off_us = min(off_us, _us(False))
+            on_us = min(on_us, _us(True))
+        # the off-guard itself, amortized: two guard sites fire per
+        # stacked dispatch (invoke path + vmap path at most)
+        compileledger.configure(False)
+        n_guard = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n_guard):
+            if compileledger.ENABLED:
+                pass
+        guard_us = 2 * (time.perf_counter() - t0) / n_guard * 1e6
+    finally:
+        compileledger.configure(was)
+        fw.close()
+    # budget enforcement on a scratch site: the second DISTINCT
+    # signature must raise, naming the differing field
+    compileledger.configure(True)
+    budget_ok = False
+    try:
+        compileledger.declare_budget("bench.jitledger.scratch", 1)
+        compileledger.record("bench.jitledger.scratch",
+                             (("padded", 8),))
+        try:
+            compileledger.record("bench.jitledger.scratch",
+                                 (("padded", 9),))
+        except CompileBudgetExceeded as exc:
+            budget_ok = "padded" in str(exc)
+    finally:
+        compileledger.configure(was)
+    return guard_us, off_us, on_us, warm_compiles, steady, budget_ok
+
+
+def bench_jitledger(frames: int) -> dict:
+    guard_us, off_us, on_us, warm, steady, budget_ok = \
+        _jitledger_measure()
+    return {"metric": "hotpath_jitledger_overhead_pct",
+            "value": round(100.0 * guard_us / max(1e-9, off_us), 3),
+            "unit": "pct",
+            "guard_us_per_dispatch": round(guard_us, 4),
+            "off_us_per_dispatch": round(off_us, 1),
+            "on_us_per_dispatch": round(on_us, 1),
+            "sentinel_on_overhead_pct": round(
+                (on_us / max(1e-9, off_us) - 1.0) * 100.0, 2),
+            "warmup_compiles": warm, "steady_compiles": steady,
+            "budget_enforced": budget_ok}
+
+
+def run_assert_jitledger() -> int:
+    """Compile-ledger sentinel gate (ISSUE 19): the sentinel-off guard
+    cost (measured directly — it is one module attribute load + branch
+    per dispatch site) must stay < 2% of a steady-state stacked
+    dispatch; the ledger must attribute the warmup's pad-bucket
+    compiles, read ZERO across post-warmup fill levels, and enforce a
+    declared budget with a diffed raise.  Best-attempt retry on the
+    overhead miss only (scheduler noise is one-sided); the functional
+    checks are deterministic."""
+    failures = []
+    guard_us, off_us, on_us, warm, steady, budget_ok = \
+        _jitledger_measure()
+    overhead = 100.0 * guard_us / max(1e-9, off_us)
+    if overhead > 2.0:
+        g2, o2, n2, w2, s2, b2 = _jitledger_measure()
+        if 100.0 * g2 / max(1e-9, o2) < overhead:
+            guard_us, off_us, on_us = g2, o2, n2
+            overhead = 100.0 * guard_us / max(1e-9, off_us)
+        warm, steady = max(warm, w2), min(steady, s2)
+        budget_ok = budget_ok or b2
+    if overhead > 2.0:
+        failures.append(
+            f"sentinel-off guard overhead {overhead:.3f}% > 2% "
+            f"({guard_us:.3f} us guard vs {off_us:.1f} us dispatch): "
+            "the ledger guard is taxing the steady state")
+    if warm < 1:
+        failures.append(
+            "warmup recorded no filter.jitexec compiles on the "
+            "ledger: the sentinel is not seeing the executable caches")
+    if steady:
+        failures.append(
+            f"{steady} steady-state compile(s) across post-warmup "
+            "fill levels: pad_rows quantization is leaking raw shapes")
+    if not budget_ok:
+        failures.append(
+            "CompileBudgetExceeded did not fire (or lost the "
+            "signature diff) on a budget-1 scratch site")
+    result = {"metric": "hotpath_jitledger_gate", "unit": "ok",
+              "value": 0 if failures else 1,
+              "overhead_pct": round(overhead, 3),
+              "sentinel_on_overhead_pct": round(
+                  (on_us / max(1e-9, off_us) - 1.0) * 100.0, 2),
+              "warmup_compiles": warm, "steady_compiles": steady,
+              "budget_enforced": budget_ok,
               "failures": failures}
     print(json.dumps(result), flush=True)
     return 1 if failures else 0
@@ -1489,7 +1717,8 @@ def main() -> int:
                                         "dispatch", "obs", "admit",
                                         "profile", "xbatch", "fusexla",
                                         "telemetry", "fleet",
-                                        "llmdecode", "llmpaged", "all"],
+                                        "llmdecode", "llmpaged",
+                                        "jitledger", "all"],
                     default="all")
     ap.add_argument("--assert", dest="assert_gate", action="store_true",
                     help="regression gates (exit 1): copy gate (serialize "
@@ -1523,6 +1752,8 @@ def main() -> int:
             rc |= run_assert_llmdecode()
         if args.stage in ("all", "llmpaged"):
             rc |= run_assert_llmpaged()
+        if args.stage in ("all", "jitledger"):
+            rc |= run_assert_jitledger()
         return rc
     stages = {"pool": bench_pool, "serialize": bench_serialize,
               "wire": bench_wire, "shm": bench_shm,
@@ -1531,7 +1762,8 @@ def main() -> int:
               "xbatch": bench_xbatch, "fusexla": bench_fusexla,
               "telemetry": bench_telemetry, "fleet": bench_fleet,
               "llmdecode": bench_llmdecode,
-              "llmpaged": bench_llmpaged}
+              "llmpaged": bench_llmpaged,
+              "jitledger": bench_jitledger}
     picks = stages if args.stage == "all" else {args.stage:
                                                stages[args.stage]}
     for fn in picks.values():

@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""nnsjit: static JIT-boundary audit for nnstreamer_tpu's
+bounded-executable discipline.
+
+Thin CLI over :mod:`nnstreamer_tpu.analysis.jitaudit` (loaded straight
+from its file, so the audit runs without jax in the environment — the
+``nnslint`` discipline).  Five named rules over the jit call graph:
+
+- ``unquantized-shape-at-jit`` — a shape-derived value keys an
+  executable cache without flowing through a registered quantizer
+- ``missing-donation`` — an in-place-updated array parameter is not
+  donated into its jit call
+- ``host-sync-in-jit`` — np()/float()/bool()/block_until_ready on a
+  traced value anywhere in the jit graph
+- ``tracer-branch`` — python ``if``/``while`` on a traced value
+- ``unbounded-signature`` — a cache-key builder iterates an uncapped
+  parameter collection
+
+Pragma: ``# nnsjit: allow(<rule>)`` on the line or the comment line
+directly above (reason in the comment).
+
+Usage::
+
+    python tools/nnsjit.py [path ...]     # default: nnstreamer_tpu/
+    python tools/nnsjit.py --list-rules
+    python tools/nnsjit.py --json
+
+Exit status 1 when findings remain (the tier-1 suite runs this over
+the package: a finding fails CI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_jitaudit():
+    path = os.path.join(REPO_ROOT, "nnstreamer_tpu", "analysis",
+                        "jitaudit.py")
+    spec = importlib.util.spec_from_file_location("_nns_jitaudit", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations through sys.modules
+    sys.modules["_nns_jitaudit"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nnsjit", description="static JIT-boundary audit "
+                                   "(bounded-executable discipline)")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO_ROOT, "nnstreamer_tpu")])
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    args = ap.parse_args(argv)
+    jitaudit = _load_jitaudit()
+    if args.list_rules:
+        for rule in jitaudit.RULES:
+            print(rule)
+        return 0
+    findings = jitaudit.audit_paths(list(args.paths), root=REPO_ROOT)
+    if args.json:
+        print(json.dumps([vars(f) for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+    if findings:
+        print(f"nnsjit: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    if not args.json:
+        print("nnsjit: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

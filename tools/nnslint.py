@@ -74,6 +74,18 @@ This tool checks exactly those repo rules:
     bounded by construction elsewhere (a slot condition, a ≤1-in-flight
     protocol) take the pragma WITH the reason in the comment.
 
+``falsy-zero-default``
+    ``int(get_property(k) or default)``-style reads with a NONZERO
+    constant default.  ``or`` cannot distinguish "property unset" from
+    "property explicitly 0/0.0/empty", so a user who configures zero
+    silently gets the default back — the LeakyReLU ``alpha or 0.2``
+    class of bug (alpha=0.0 is a valid, meaningful setting).  Compare
+    against None instead (``v = read(...); x = int(v) if v is not None
+    else default``).  ``or 0`` / ``or 0.0`` stay exempt: when the
+    default equals the falsy trap there is nothing to lose.  Sites
+    where zero is genuinely invalid (a port number, a positive queue
+    bound) take the pragma WITH the reason.
+
 Pragma: append ``# nnslint: allow(<rule>)`` to the offending line or
 the comment line directly above it (give a reason in the comment).
 
@@ -100,7 +112,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 RULES = ("sleep-poll", "io-under-lock", "lock-order", "unknown-lock",
          "tracer-in-untraced-plan", "readonly-view-mutation",
-         "wallclock-in-chain", "unbounded-queue", "host-sync-in-lower")
+         "wallclock-in-chain", "unbounded-queue", "host-sync-in-lower",
+         "falsy-zero-default")
 
 #: function names whose bodies must stay pure jax traces (the fuse=xla
 #: lowering hooks — pipeline/element.py LoweredStep contract)
@@ -417,6 +430,29 @@ class _FileLinter(ast.NodeVisitor):
                           "deque() without maxlen in a dataflow layer: "
                           "bound it or pragma WITH the reason it is "
                           "bounded elsewhere")
+        # falsy-zero-default: int/float over an `or`-defaulted read
+        # with a NONZERO constant fallback — an explicit 0/0.0/"" from
+        # the property read is falsy and silently becomes the default
+        if name in ("int", "float") and isinstance(node.func, ast.Name) \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.BoolOp) \
+                and isinstance(node.args[0].op, ast.Or):
+            vals = node.args[0].values
+            default = vals[-1]
+            reads = any(isinstance(v, (ast.Call, ast.Attribute,
+                                       ast.Subscript))
+                        for v in vals[:-1])
+            if reads and isinstance(default, ast.Constant) \
+                    and isinstance(default.value, (int, float)) \
+                    and not isinstance(default.value, bool) \
+                    and default.value != 0:
+                self._add(node, "falsy-zero-default",
+                          f"{name}(<read> or {default.value!r}) folds an "
+                          "explicit zero/empty property value into the "
+                          "default — compare against None (v = read(); "
+                          f"{name}(v) if v is not None else "
+                          f"{default.value!r}), or pragma WITH the "
+                          "reason zero is invalid here")
         # io-under-lock
         if name in _IO_CALLS and self._with_stack:
             for held, held_line in self._with_stack:

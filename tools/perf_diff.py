@@ -50,6 +50,11 @@ from typing import Any, Dict, List, Optional
 #: unit substrings where LOWER is better; everything else (fps, MB/s,
 #: acquires/s, ok) treats higher as better
 _LOWER_BETTER = ("ns", "us", "ms", "pct", "percent", "seconds", "bytes")
+#: metric-NAME tokens that are lower-is-better regardless of unit: a
+#: compile count is a cost (the bounded-executable discipline), and
+#: the ledger exports it unitless — ``compiles``/``nns_jit_compiles``
+#: rows must not be read as throughput
+_LOWER_BETTER_METRICS = ("compiles", "recompiles", "nns_jit_compiles")
 #: absolute tolerance floor: metrics this close to zero are below the
 #: resolution any scheduler can promise
 _ABS_FLOOR = 1e-9
@@ -89,15 +94,21 @@ def load_rows(path: str) -> List[Dict[str, Any]]:
     return out
 
 
-def lower_is_better(unit: str) -> bool:
+def lower_is_better(unit: str, metric: str = "") -> bool:
     """Direction from the unit's WORD tokens, not raw substrings: a
     bare ``in`` made every unit containing the letters "ns" (e.g.
     ``tokens_per_s``) silently lower-is-better — which would let a
     collapsed throughput metric PASS the gate (and page on an
     improvement).  ``p99_us``/``latency_ms``/``alloc_bytes`` still
-    match on their token."""
+    match on their token.  The metric NAME overrides a missing/neutral
+    unit for compile counters: ``nns_jit_compiles_total`` /
+    ``steady_compiles`` are costs (bounded-executable discipline) even
+    though the ledger exports them unitless."""
     tokens = re.split(r"[^a-z]+", (unit or "").lower())
-    return any(t in _LOWER_BETTER for t in tokens if t)
+    if any(t in _LOWER_BETTER for t in tokens if t):
+        return True
+    mtokens = re.split(r"[^a-z]+", (metric or "").lower())
+    return any(t in _LOWER_BETTER_METRICS for t in mtokens if t)
 
 
 def _attribution_delta(base_rows: List[Dict[str, Any]],
@@ -163,7 +174,8 @@ def diff(baselines: List[List[Dict[str, Any]]],
         tol = max(hi - lo, abs(center) * margin_pct / 100.0, _ABS_FLOOR)
         val = float(cand["value"])
         lower = lower_is_better(str(cand.get("unit")
-                                    or base_rows[0].get("unit") or ""))
+                                    or base_rows[0].get("unit") or ""),
+                                metric=m)
         if lower:
             regressed = val > hi + tol
             improved = val < lo - tol
