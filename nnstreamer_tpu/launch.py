@@ -321,6 +321,14 @@ def main(argv=None) -> int:
         try:
             p.play()
             if slo_monitor is not None:
+                # breach bundles grow per-session token timelines when
+                # a tensor_llm element is recording (token-obs=1; the
+                # recorder exists at play, the element's plane does not
+                # until start() — wire it here)
+                recorder.session_obs = next(
+                    (el._tok_obs for el in p.elements
+                     if getattr(el, "_tok_obs", None) is not None),
+                    None)
                 slo_monitor.start()
             if publisher is not None:
                 publisher.start()

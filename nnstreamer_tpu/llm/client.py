@@ -92,6 +92,13 @@ class TokenStreamClient:
         self.token_timeout = (float(token_timeout)
                               if token_timeout is not None
                               else self.timeout)
+        #: per-token receive stamps (``mono_ns``) of the CURRENT /
+        #: most recent stream, reset at each :meth:`stream` send — the
+        #: wire-side half of the token-latency contract: the loadgen
+        #: measures coordinated-omission-free TTFT as ``stamps_ns[0] -
+        #: scheduled arrival`` and ITL from consecutive stamps, so a
+        #: stalled server cannot hide behind a late send
+        self.stamps_ns: List[int] = []
 
     def connect(self) -> "TokenStreamClient":
         self._conn.connect()
@@ -138,6 +145,9 @@ class TokenStreamClient:
         gap = (float(token_timeout) if token_timeout is not None
                else self.token_timeout)
         req = encode_request(prompt, max_new, stop_token, frame_len)
+        from ..obs.clock import mono_ns
+
+        self.stamps_ns = stamps = []
         with conn._waiters_lock:
             conn._seq += 1
             seq = conn._seq
@@ -178,6 +188,7 @@ class TokenStreamClient:
                     f"token order violated: expected index {got}, "
                     f"got {idx}")
             got += 1
+            stamps.append(mono_ns())
             yield idx, tok
             if tok < 0 or (stop_token >= 0 and tok == stop_token):
                 # a NEGATIVE token is unconditionally terminal: real

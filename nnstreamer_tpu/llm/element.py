@@ -144,6 +144,14 @@ class TensorLLM(Element):
                              "prompt pages (chain-hashed, refcounted, "
                              "copy-on-write): 1 on / 0 off / -1 auto "
                              "(on when paged; requires pages)"),
+        "token-obs": (1, "token-level observability plane: per-session "
+                         "lifecycle records, TTFT/ITL histograms "
+                         "(class-labeled), terminal-cause counters and "
+                         "head-of-line blame (llm/tokenobs.py); 0 "
+                         "disables it structurally — every hot-path "
+                         "hook collapses to one attribute test (the "
+                         "annotation_active() discipline, gated <2% by "
+                         "hotpath_bench --stage llmobs)"),
     }
 
     # -- pads / caps -----------------------------------------------------
@@ -343,6 +351,14 @@ class TensorLLM(Element):
         labels = {"element": self.name,
                   "pipeline": getattr(self.pipeline, "name", "") or ""}
         eng, pool = self.engine, self.pool
+        # token-level observability plane: constructed only when on —
+        # when off, self._tok_obs is None and every hook site in the
+        # decode loop pays exactly one attribute test
+        self._tok_obs = None
+        if int(self.token_obs if self.token_obs is not None else 1):
+            from .tokenobs import TokenObs
+
+            self._tok_obs = TokenObs(eng.phases, labels=dict(labels))
         rate_state = {"tokens": None, "t": None}
 
         def _tokens_per_s() -> float:
@@ -381,13 +397,24 @@ class TensorLLM(Element):
                      lambda: pool.prefix_hits),
                     ("nns_llm_prefix_tokens_reused",
                      lambda: pool.prefix_tokens_reused),
+                    # prefix-hit RATE: the time-series signal sources
+                    # (tokenobs.default_llm_signals) and the nns-top
+                    # LLM panel read a fraction, not raw counts
+                    ("nns_llm_prefix_hit_rate",
+                     lambda: pool.prefix_hits
+                     / max(1, pool.prefix_hits + pool.prefix_misses)),
                 ))
+        names = ["nns_llm_tokens_total", "nns_llm_sessions_total",
+                 "nns_llm_shed_total", "nns_llm_evicted_total",
+                 "nns_llm_rejected_total"]
+        if getattr(eng, "paged", False):
+            from .tokenobs import PAGES_RECLAIMED_TOTAL
+
+            names.append(PAGES_RECLAIMED_TOTAL)
         self._obs_counters = {
-            n: REGISTRY.counter(n, **labels) for n in (
-                "nns_llm_tokens_total", "nns_llm_sessions_total",
-                "nns_llm_shed_total", "nns_llm_evicted_total",
-                "nns_llm_rejected_total")}
+            n: REGISTRY.counter(n, **labels) for n in names}
         self._ctr_tokens = 0    # counter mirror of engine.tokens_total
+        self._ctr_reclaimed = 0  # mirror of pool.pages_reclaimed
 
     def stop(self):
         from ..obs.metrics import REGISTRY
@@ -589,6 +616,8 @@ class TensorLLM(Element):
                     # stop-token answer, not a shed
                     self.rejected_total += 1
                     self._obs_counters["nns_llm_rejected_total"].inc()
+                    if self._tok_obs is not None:
+                        self._tok_obs.on_refused(req.qos, "reject")
                     self._emit(req.extra, req.stop_token, 0, last=True)
                     continue
                 verdict = pool.admit(req.qos,
@@ -613,6 +642,12 @@ class TensorLLM(Element):
                 sess.truncated = req.truncated
                 self.sessions_total += 1
                 self._obs_counters["nns_llm_sessions_total"].inc()
+                if self._tok_obs is not None:
+                    # the lifecycle record opens HERE, inside the admit
+                    # phase: TTFT measures admit → first emitted token,
+                    # chunk interleave and bucket waits included — what
+                    # the client waited, not what one executable cost
+                    self._tok_obs.on_admit(sess)
                 if self._chunk > 0:
                     # chunked prefill: the session joins RESIDENT but
                     # not yet decodable — the decode loop advances one
@@ -645,6 +680,10 @@ class TensorLLM(Element):
     def _shed(self, req: _Request, retry_after_s: float) -> None:
         self.shed_total += 1
         self._obs_counters["nns_llm_shed_total"].inc()
+        if self._tok_obs is not None:
+            # counted, never observed: a fast shed must not flatter
+            # the admitted-traffic TTFT distribution
+            self._tok_obs.on_refused(req.qos, "shed")
         srv = self._server()
         if srv is not None:
             srv.shed_frame(req.extra, retry_after_s)
@@ -673,6 +712,8 @@ class TensorLLM(Element):
             t0 = self._mono_ns()
             first = eng.prefill_chunk_step(sess)
             t1 = self._mono_ns()
+            if self._tok_obs is not None:
+                self._tok_obs.on_chunk(sess)
             tracer = self._tracer()
             if tracer is not None:
                 ctx = sess.extra.get("nns_trace")
@@ -723,6 +764,12 @@ class TensorLLM(Element):
         if marker:
             self._emit(sess.extra, sess.stop_token, sess.emitted,
                        last=True)
+        tobs = self._tok_obs
+        if tobs is not None:
+            # after the push: first-token latency includes its egress
+            tobs.on_token(sess)
+            if done:
+                tobs.on_terminal(sess, "stop" if by_stop else "max_new")
         if done:
             self.pool.release(sess.key)
 
@@ -757,14 +804,20 @@ class TensorLLM(Element):
             for sess in pool.sessions():
                 cid = sess.extra.get("query_client_id")
                 if cid is not None and not srv.client_connected(cid):
-                    dead.append(sess.key)
+                    dead.append((sess.key, "disconnect"))
         if self._sess_timeout > 0:
-            dead.extend(pool.aged_keys(self._sess_timeout))
-        for key in dead:
+            dead.extend((k, "evict")
+                        for k in pool.aged_keys(self._sess_timeout))
+        for key, cause in dead:
             sess = pool.release(key)
             if sess is not None:
                 self.evicted_total += 1
                 self._obs_counters["nns_llm_evicted_total"].inc()
+                if self._tok_obs is not None:
+                    # the terminal marker frame is NOT a token: the
+                    # record closes under its cause without observing
+                    # TTFT/ITL (a reaped zombie must not poison p99)
+                    self._tok_obs.on_terminal(sess, cause)
                 self._emit(sess.extra, sess.stop_token, sess.emitted,
                            last=True)
 
@@ -777,9 +830,19 @@ class TensorLLM(Element):
         return None
 
     def _ctr_sync(self) -> None:
-        """Mirror the engine's token count into the registry counter
-        (counters are monotonic-inc only)."""
+        """Mirror the engine's token count — and the paged pool's
+        reclaim churn plus the blame aggregates when token obs is on —
+        into the registry counters (counters are monotonic-inc only)."""
         delta = self.engine.tokens_total - self._ctr_tokens
         if delta > 0:
             self._obs_counters["nns_llm_tokens_total"].inc(delta)
             self._ctr_tokens = self.engine.tokens_total
+        reclaimed = getattr(self.pool, "pages_reclaimed", 0)
+        if reclaimed > self._ctr_reclaimed:
+            from .tokenobs import PAGES_RECLAIMED_TOTAL
+
+            self._obs_counters[PAGES_RECLAIMED_TOTAL].inc(
+                reclaimed - self._ctr_reclaimed)
+            self._ctr_reclaimed = reclaimed
+        if self._tok_obs is not None:
+            self._tok_obs.sync_blame_counters()

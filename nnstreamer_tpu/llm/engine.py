@@ -41,9 +41,14 @@ from .pool import KVCachePool, Session
 #: interleaved-prefill share: time spent advancing ONE bounded prompt
 #: chunk between decode steps — its presence (and the decode share
 #: staying alive next to it) is the proof a long prompt no longer
-#: stalls resident token streams.
+#: stalls resident token streams.  ``compile`` is the cold-executable
+#: share: a dispatch whose executable was not yet in this engine's warm
+#: set charges its whole device call here instead of decode/prefill, so
+#: a mid-serve XLA compile is NAMED in the attribution (and the
+#: zero-steady-state-compiles discipline shows up as this share being
+#: exactly the warmup, never growing after).
 PHASES = ("idle", "admit", "prefill", "llm-prefill-chunk", "decode",
-          "egress")
+          "egress", "compile")
 
 
 def quantize_pages(n: int, table_max: int) -> int:
@@ -108,6 +113,19 @@ class PhaseClock:
         prev, self._state = self._state, state
         self._t0 = now
         return prev
+
+    def totals_ns(self) -> Dict[str, int]:
+        """Integer per-state totals INCLUDING the in-progress state's
+        open interval — the per-session blame-snapshot primitive: two
+        snapshots subtract into an EXACT integer partition of the wall
+        time between them (sum of per-state deltas == clock delta, the
+        same identity :meth:`report` rounds for humans), so a session's
+        accumulated blame reconciles with its admit→terminal window to
+        the nanosecond."""
+        now = self._clock_ns()
+        ns = dict(self.ns)
+        ns[self._state] += now - self._t0
+        return ns
 
     def report(self) -> Dict[str, Any]:
         """Per-state seconds + shares; ``conserved_pct`` is exactly 100
@@ -195,6 +213,14 @@ class DecodeEngine:
         self.last_fill = 0
         self.ewma_step_s = 0.0
         self.compiles = 0
+        #: set by the executable getters on a per-engine warm-set miss,
+        #: consumed by the next dispatch (:meth:`_enter_cold`): that
+        #: dispatch's device call charges the ``compile`` phase instead
+        #: of decode/prefill.  Per-ENGINE coldness on purpose — the
+        #: process-wide ``_EXEC_MEMO`` may make the call cheap, but the
+        #: attribution question is "did THIS engine meet a cold
+        #: executable", which after :meth:`warmup` must never happen.
+        self._cold_exec = False
 
     # -- executables -----------------------------------------------------
     @compile_budget(16, site="llm.engine.step")
@@ -217,6 +243,7 @@ class DecodeEngine:
             fn = _memo_jit(("step", _cfg_key(cfg)), _make)
             self._step_jit[padded] = fn
             self.compiles += 1
+            self._cold_exec = True
         return fn
 
     @compile_budget(64, site="llm.engine.pstep")
@@ -245,6 +272,7 @@ class DecodeEngine:
             fn = _memo_jit(("pstep", _cfg_key(cfg), ps), _make)
             self._step_jit[key] = fn
             self.compiles += 1
+            self._cold_exec = True
         return fn
 
     @compile_budget(64, site="llm.engine.chunk")
@@ -276,6 +304,7 @@ class DecodeEngine:
             fn = _memo_jit(("chunk", _cfg_key(cfg), ps), _make)
             self._prefill_jit[key] = fn
             self.compiles += 1
+            self._cold_exec = True
         return fn
 
     @compile_budget(32, site="llm.engine.prefill")
@@ -314,7 +343,18 @@ class DecodeEngine:
             fn = _memo_jit(("prefill", _cfg_key(cfg), flash), _make)
             self._prefill_jit[padded_t] = fn
             self.compiles += 1
+            self._cold_exec = True
         return fn
+
+    def _enter_cold(self) -> Optional[str]:
+        """Consume the cold-executable flag: when the last getter
+        missed this engine's warm set, move the PhaseClock to
+        ``compile`` and return the phase to restore after the dispatch
+        (None when warm — the hot path pays one attribute read)."""
+        if not self._cold_exec:
+            return None
+        self._cold_exec = False
+        return self.phases.enter("compile")
 
     def warmup(self) -> None:
         """Pre-compile every executable live serving can dispatch (the
@@ -326,6 +366,18 @@ class DecodeEngine:
         exactly the mid-soak latency spike warmup exists to prevent
         (prefills were the gap a code-review pass caught: a fresh
         prompt-length bucket compiled mid-serve)."""
+        # the whole warmup charges the ``compile`` phase: it IS the
+        # compile cost, paid up front — after it the share must never
+        # grow (the zero-steady-state-compiles gate, made visible in
+        # the attribution instead of only the ledger)
+        cprev = self.phases.enter("compile")
+        try:
+            self._warmup_impl()
+        finally:
+            self.phases.enter(cprev)
+            self._cold_exec = False
+
+    def _warmup_impl(self) -> None:
         import jax.numpy as jnp
 
         if self.paged:
@@ -456,10 +508,16 @@ class DecodeEngine:
             buf = np.zeros((padded,), np.int32)
             buf[:t] = prompt
             fn = self._prefill_fn(padded)
-            last, self.pool.k, self.pool.v = fn(
-                self.params, self.pool.k, self.pool.v,
-                jnp.asarray(buf), jnp.int32(sess.slot), jnp.int32(t))
-            logits = np.asarray(last)
+            cold = self._enter_cold()
+            try:
+                last, self.pool.k, self.pool.v = fn(
+                    self.params, self.pool.k, self.pool.v,
+                    jnp.asarray(buf), jnp.int32(sess.slot),
+                    jnp.int32(t))
+                logits = np.asarray(last)
+            finally:
+                if cold is not None:
+                    self.phases.enter(cold)
             sess.pos = t
         self.prefills_total += 1
         self.tokens_total += 1
@@ -536,10 +594,15 @@ class DecodeEngine:
         m = min(len(sess.table), w)
         table[:m] = sess.table[:m]
         fn = self._chunk_fn(c_pad, w)
-        last, pool.k, pool.v = fn(
-            self.params, pool.k, pool.v, jnp.asarray(toks),
-            jnp.asarray(table), jnp.int32(start), jnp.int32(c_real),
-            jnp.int32(pool.scratch))
+        cold = self._enter_cold()
+        try:
+            last, pool.k, pool.v = fn(
+                self.params, pool.k, pool.v, jnp.asarray(toks),
+                jnp.asarray(table), jnp.int32(start),
+                jnp.int32(c_real), jnp.int32(pool.scratch))
+        finally:
+            if cold is not None:
+                self.phases.enter(cold)
         pool.note_prefill(sess, start + c_real)
         self.prefill_chunks_total += 1
         sess.last_step_s = self._clock()
@@ -572,10 +635,15 @@ class DecodeEngine:
             m = min(len(table), w)
             tables[i, :m] = table[:m]
         fn = self._pstep_fn(padded, w)
-        logits, pool.k, pool.v = fn(
-            self.params, pool.k, pool.v, jnp.asarray(toks),
-            jnp.asarray(pos), jnp.asarray(tables))
-        return np.asarray(logits)[:n]
+        cold = self._enter_cold()
+        try:
+            logits, pool.k, pool.v = fn(
+                self.params, pool.k, pool.v, jnp.asarray(toks),
+                jnp.asarray(pos), jnp.asarray(tables))
+            return np.asarray(logits)[:n]
+        finally:
+            if cold is not None:
+                self.phases.enter(cold)
 
     def _lane_arrays(self, lanes: Sequence[Tuple[int, int, int]]):
         """(slot, pos, token) lanes → padded device operands.  Padding
@@ -596,9 +664,15 @@ class DecodeEngine:
 
     def _dispatch(self, toks, pos, slots, padded: int, n: int):
         fn = self._step_fn(padded)
-        logits, self.pool.k, self.pool.v = fn(
-            self.params, self.pool.k, self.pool.v, toks, pos, slots)
-        return np.asarray(logits)[:n]
+        cold = self._enter_cold()
+        try:
+            logits, self.pool.k, self.pool.v = fn(
+                self.params, self.pool.k, self.pool.v, toks, pos,
+                slots)
+            return np.asarray(logits)[:n]
+        finally:
+            if cold is not None:
+                self.phases.enter(cold)
 
     def step(self, sessions: Sequence[Session]) -> List[int]:
         """One continuous-batching decode step over ``sessions`` (≤

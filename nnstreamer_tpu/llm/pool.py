@@ -67,6 +67,11 @@ class Session:
     born_s: float = 0.0
     last_step_s: float = 0.0    # progress stamp (deadline eviction)
     order: int = 0              # admission order (stable round-robin)
+    #: per-session lifecycle record (llm/tokenobs.SessionRecord) when
+    #: the element's token-level observability is on; None when off —
+    #: every hot-path hook gates on this single attribute test (the
+    #: annotation_active() zero-cost discipline)
+    obs: Any = None
 
 
 class KVCachePool:

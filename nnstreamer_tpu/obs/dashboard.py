@@ -288,6 +288,46 @@ def build_view(samples: Sequence[Tuple[float, Dict[str, float]]],
     view["elements"] = [{"element": n, **row}
                         for n, row in sorted(elements.items())]
 
+    # -- LLM serving panel (llm/element.py gauges + llm/tokenobs.py
+    # histograms): resident sessions, mean decode-step fill, decode
+    # tok/s, TTFT p99 sparkline, free-pages trend — present only when
+    # an LLM element is exporting (the families exist), so non-LLM
+    # dashboards render unchanged
+    llm: Dict[str, Any] = {}
+    active = _gauge("nns_llm_active_seqs", agg=sum)
+    if active is not None:
+        llm["active_seqs"] = active
+        llm["active_spark"] = _series(samples, "nns_llm_active_seqs")
+    fill_llm = _gauge("nns_llm_decode_fill")
+    if fill_llm is not None:
+        llm["decode_fill"] = fill_llm
+    toks = _gauge("nns_llm_tokens_per_s", agg=sum)
+    if toks is not None:
+        llm["tokens_per_s"] = toks
+        llm["tokens_spark"] = _series(samples, "nns_llm_tokens_per_s")
+    for k, v in flat.items():
+        if key_name(k) == "nns_llm_ttft_us" and \
+                key_labels(k).get("quantile") == "0.99":
+            llm["ttft_p99_us"] = max(v, llm.get("ttft_p99_us", 0.0))
+    if "ttft_p99_us" in llm:
+        # per-sample max across class labels — the worst class's trend
+        spark: List[float] = []
+        for _, f in samples:
+            vals = [v for k, v in f.items()
+                    if key_name(k) == "nns_llm_ttft_us"
+                    and key_labels(k).get("quantile") == "0.99"]
+            spark.append(max(vals) if vals else 0.0)
+        llm["ttft_spark"] = spark
+    free = _gauge("nns_llm_free_pages", agg=min)
+    if free is not None:
+        llm["free_pages"] = free
+        llm["pages_spark"] = _series(samples, "nns_llm_free_pages")
+    hit = _gauge("nns_llm_prefix_hit_rate")
+    if hit is not None:
+        llm["prefix_hit_rate"] = hit
+    if llm:
+        view["llm"] = llm
+
     # -- sustained signals: the ring's own report when available, else
     # reconstructed from nns_signal_state gauges (scrape / federated)
     signals = []
@@ -393,6 +433,34 @@ def render_frame(view: Dict[str, Any], width: int = 96,
                 val = _fmt(g["value"])
             lines.append(f"{g['label']:<18}{val:>12}{meter:>14}  "
                          f"{sparkline(g['spark'])}")
+
+    llm = view.get("llm") or {}
+    if llm:
+        lines.append(f"{'llm serving':<18}{'value':>12}{'':>10}  trend")
+        if "active_seqs" in llm:
+            lines.append(f"{'resident sessions':<18}"
+                         f"{_fmt(llm['active_seqs']):>12}{'':>14}  "
+                         f"{sparkline(llm.get('active_spark', ()))}")
+        if "decode_fill" in llm:
+            lines.append(f"{'decode step fill':<18}"
+                         f"{_fmt(llm['decode_fill']):>12}"
+                         f"{bar(llm['decode_fill']):>14}")
+        if "tokens_per_s" in llm:
+            lines.append(f"{'decode tok/s':<18}"
+                         f"{_fmt(llm['tokens_per_s']):>12}{'':>14}  "
+                         f"{sparkline(llm.get('tokens_spark', ()))}")
+        if "ttft_p99_us" in llm:
+            lines.append(f"{'ttft p99 us':<18}"
+                         f"{_fmt(llm['ttft_p99_us']):>12}{'':>14}  "
+                         f"{sparkline(llm.get('ttft_spark', ()))}")
+        if "free_pages" in llm:
+            lines.append(f"{'free pages':<18}"
+                         f"{_fmt(llm['free_pages']):>12}{'':>14}  "
+                         f"{sparkline(llm.get('pages_spark', ()))}")
+        if "prefix_hit_rate" in llm:
+            lines.append(f"{'prefix hit rate':<18}"
+                         f"{_fmt(llm['prefix_hit_rate']):>12}"
+                         f"{bar(llm['prefix_hit_rate']):>14}")
 
     if view.get("latency"):
         for row in view["latency"]:

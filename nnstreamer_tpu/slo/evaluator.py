@@ -39,7 +39,8 @@ from ..obs.clock import mono_ns
 from ..obs.metrics import (REGISTRY, MetricsRegistry,
                            count_over_threshold, quantile_from_counts,
                            state_delta)
-from .spec import ERRORS_TOTAL, LATENCY_US, REQUESTS_TOTAL, Objective, SLOSpec
+from .spec import (ERRORS_TOTAL, HIST_FAMILY, HIST_KINDS,
+                   REQUESTS_TOTAL, Objective, SLOSpec)
 
 
 def _family(key: str) -> str:
@@ -139,9 +140,14 @@ class Evaluator:
     # -- evaluation ----------------------------------------------------------
     def _objective_window(self, obj: Objective, delta: Dict[str, Any],
                           span_s: float) -> Dict[str, Any]:
-        if obj.kind == "latency":
-            total, counts = _sum_hist(delta, obj.metric or LATENCY_US,
-                                      obj)
+        if obj.kind in HIST_KINDS:
+            # latency / ttft / itl share the histogram-threshold
+            # accounting; only the default family differs (the token
+            # kinds read the loadgen's schedule-anchored families
+            # unless ``metric`` points at the server-side ones)
+            total, counts = _sum_hist(delta,
+                                      obj.metric
+                                      or HIST_FAMILY[obj.kind], obj)
             bad = (count_over_threshold(counts, obj.threshold_us)
                    if counts else 0)
             p99 = (quantile_from_counts(counts, 0.99)

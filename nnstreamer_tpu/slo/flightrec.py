@@ -25,6 +25,15 @@ onset (``on_breach``):
                           snapshot + objective burn rates (the time
                           series leading INTO the breach)
     ``metrics_final.json`` — full registry report at dump time
+    ``sessions.json``   — per-session token timelines (llm/tokenobs.py
+                          records: admit → first-token → terminal,
+                          TTFT/ITL, head-of-line blame partition) when
+                          a ``session_obs`` provider is attached; the
+                          same sessions also land in ``trace.json`` as
+                          one Chrome lane per session, merged onto the
+                          span ring's timebase — a breach bundle from
+                          an LLM soak shows WHICH sessions sat behind
+                          what, next to the server's element spans
 
 Dumps are capped (``max_dumps``) so a flapping objective cannot fill a
 disk; every breach past the cap still lands in the evaluator's verdict.
@@ -55,7 +64,8 @@ class FlightRecorder:
     def __init__(self, out_dir: str, tracer: Optional[Any] = None,
                  registry: MetricsRegistry = REGISTRY,
                  capacity: int = 512, max_dumps: int = 3,
-                 collector: Optional[Any] = None) -> None:
+                 collector: Optional[Any] = None,
+                 session_obs: Optional[Any] = None) -> None:
         self.out_dir = out_dir
         self.tracer = tracer
         self.registry = registry
@@ -64,6 +74,13 @@ class FlightRecorder:
         #: so a breach bundle from an N-process run shows ALL sides'
         #: timelines, not just the process that happened to breach
         self.collector = collector
+        #: token-observability provider (llm/tokenobs.TokenObs): when
+        #: attached, bundles grow ``sessions.json`` (the breach
+        #: window's per-session timelines + blame) and the sessions'
+        #: Chrome lanes merge into ``trace.json`` — both sides share
+        #: the mono-ns timebase, so session bars line up under the
+        #: server spans that caused them
+        self.session_obs = session_obs
         self.max_dumps = int(max_dumps)
         self._lock = make_lock("slo")
         self._ring: "deque[Dict[str, Any]]" = deque(
@@ -129,9 +146,28 @@ class FlightRecorder:
 
         if breach is not None:
             _write("breach.json", breach)
+        session_events: List[Dict[str, Any]] = []
+        if self.session_obs is not None:
+            # breach-window session timelines: the tokenobs ring holds
+            # the most recently CLOSED sessions plus every live one —
+            # at dump time that IS the breach neighborhood
+            _write("sessions.json",
+                   {"sessions": self.session_obs.records(),
+                    "blame": self.session_obs.blame_report()})
+            session_events = self.session_obs.chrome_events()
         if self.tracer is not None and \
                 getattr(self.tracer, "ring", None) is not None:
-            _write("trace.json", self.tracer.chrome_trace())
+            trace = self.tracer.chrome_trace()
+            if session_events:
+                # merge the session lanes onto the span ring's export:
+                # both stamp mono-ns, so the bars line up under the
+                # server spans that caused them (re-sort keeps the
+                # merged stream globally time-monotonic, M events first)
+                events = trace["traceEvents"] + session_events
+                events.sort(key=lambda e: (e["ph"] != "M",
+                                           e.get("ts", 0.0)))
+                trace["traceEvents"] = events
+            _write("trace.json", trace)
             from ..obs.profile import attribution_block
 
             blame = attribution_block(self.tracer)
@@ -141,6 +177,11 @@ class FlightRecorder:
                 # states that ate the breaching frames' time without
                 # opening the Chrome trace
                 _write("blame.json", blame)
+        elif session_events:
+            # no span tracer attached: the session lanes alone are
+            # still a valid Chrome export
+            _write("trace.json", {"traceEvents": session_events,
+                                  "displayTimeUnit": "ms"})
         _write("metrics_timeline.jsonl", timeline)
         _write("metrics_final.json", self.registry.report())
         manifest = {"tag": tag, "wall_us": wall_us(),

@@ -46,7 +46,8 @@ from ..obs.metrics import REGISTRY, MetricsRegistry, quantile_from_counts
 from ..query.client import QueryConnection
 from ..query.overload import ShedError
 from ..tensor.buffer import TensorBuffer
-from .spec import ERRORS_TOTAL, LATENCY_US, REQUESTS_TOTAL
+from .spec import (ERRORS_TOTAL, ITL_US, LATENCY_US, REQUESTS_TOTAL,
+                   TTFT_US)
 
 SERVICE_US = "nns_query_service_us"
 #: requests refused by server admission control (explicit T_SHED) — a
@@ -347,6 +348,12 @@ class LoadGenerator:
                 "max_sched_lag_ms": round(max(self._lag_us) / 1e3, 1)}
 
     @staticmethod
+    def _hist_bases(hists: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-class bucket baselines at run start (shared registry
+        instances accumulate across runs — summaries must diff)."""
+        return {c: h.state()[2] for c, h in hists.items()}
+
+    @staticmethod
     def _quantiles(hists: Dict[str, Any],
                    bases: Dict[str, Any]) -> Dict[str, float]:
         counts: Optional[List[int]] = None
@@ -365,3 +372,152 @@ class LoadGenerator:
         return {q: round(quantile_from_counts(counts, v), 1)
                 for q, v in (("p50", 0.50), ("p95", 0.95),
                              ("p99", 0.99))}
+
+
+class TokenLoadGenerator(LoadGenerator):
+    """Open-loop TOKEN-STREAM load: every schedule slot opens one
+    ``tensor_llm`` stream (:class:`~nnstreamer_tpu.llm.client.
+    TokenStreamClient`) and the per-token receive stamps become the
+    coordinated-omission-free token-latency families the ttft/itl SLO
+    kinds gate:
+
+    - ``nns_slo_ttft_us{class=}`` — first token stamp minus the
+      *scheduled* arrival, NOT the actual send: a worker that fell
+      behind schedule charges the queueing to TTFT exactly as an
+      independent client arriving on time would have experienced it
+      (the open-loop correction, applied to token streams).  A stream
+      that produced no first token at all (per-token timeout, dead
+      connection) observes its elapsed time as a LOWER bound — a
+      stalled server burns the TTFT budget instead of vanishing.
+    - ``nns_slo_itl_us{class=}`` — consecutive receive-stamp gaps of
+      REAL tokens (a negative terminal marker — the server's refusal /
+      eviction frame — is not a token: its gap never observes, and a
+      marker-only answer is an error with no TTFT at all, so refusals
+      cannot flatter the admitted distribution).
+    - sheds land in ``nns_slo_shed_total`` and observe nothing, as in
+      the base generator.
+    """
+
+    def __init__(self, host: str, port: int,
+                 prompt: Sequence[int] = (1, 2, 3, 4),
+                 max_new: int = 16, stop_token: int = -1,
+                 frame_len: Optional[int] = None,
+                 token_timeout: Optional[float] = None,
+                 **kw: Any) -> None:
+        super().__init__(host, port, **kw)
+        self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        self.max_new = int(max_new)
+        self.stop_token = int(stop_token)
+        self.frame_len = frame_len
+        self.token_timeout = (float(token_timeout)
+                              if token_timeout is not None
+                              else self.timeout)
+        registry = self.registry
+        self._m_ttft = {c: registry.histogram(TTFT_US, **{"class": c})
+                        for c, _ in self.classes}
+        self._m_itl = {c: registry.histogram(ITL_US, **{"class": c})
+                       for c, _ in self.classes}
+
+    def run(self, warmup_s: Optional[float] = None) -> Dict[str, Any]:
+        self._ttft_base = self._hist_bases(self._m_ttft)
+        self._itl_base = self._hist_bases(self._m_itl)
+        return super().run(warmup_s)
+
+    def _worker(self, idx: int, offsets: List[float],
+                cls_picks: List[str],
+                worker_qos: Optional[str]) -> None:
+        from ..llm.client import TokenStreamClient, TokenTimeoutError
+
+        self._stop.wait(idx * 0.025)
+        cli = TokenStreamClient(self.host, self.port,
+                                timeout=self.timeout, qos=worker_qos,
+                                token_timeout=self.token_timeout)
+        try:
+            cli.connect()
+        except ConnectionError:
+            pass    # stream() raises per slot; down-at-start = errors
+        with self._lock:
+            self._live += 1
+            self._peak_live = max(self._peak_live, self._live)
+        sent = ok = errors = 0
+        shed_by_class: Dict[str, int] = {}
+        try:
+            for i, off in enumerate(offsets):
+                target = self._t0 + off
+                wait = target - mono_ns() / 1e9
+                if wait > 0 and self._stop.wait(wait):
+                    break
+                if self._stop.is_set():
+                    break
+                cls = cls_picks[i]
+                sent += 1
+                shed = False
+                failed = False
+                toks: List[int] = []
+                try:
+                    for _, tok in cli.stream(self.prompt, self.max_new,
+                                             self.stop_token,
+                                             self.frame_len):
+                        toks.append(tok)
+                except ShedError:
+                    shed = True
+                except (TokenTimeoutError, TimeoutError,
+                        ConnectionError, OSError, ValueError):
+                    failed = True
+                end = mono_ns() / 1e9
+                self._lag_us[idx] = max(0, int((end - target) * 1e6))
+                self._m_req[cls].inc()
+                if shed:
+                    shed_by_class[cls] = shed_by_class.get(cls, 0) + 1
+                    self._m_shed[cls].inc()
+                    continue
+                stamps = list(cli.stamps_ns)
+                n_real = len(toks)
+                if toks and toks[-1] < 0:
+                    n_real -= 1    # terminal marker, not a token
+                if n_real > 0:
+                    # schedule-anchored TTFT (open-loop correction)
+                    self._m_ttft[cls].observe(max(
+                        0.0, (stamps[0] / 1e9 - target)) * 1e6)
+                    hist = self._m_itl[cls]
+                    for j in range(1, n_real):
+                        hist.observe(max(0.0, (stamps[j]
+                                               - stamps[j - 1]) / 1e3))
+                elif failed:
+                    # no first token at all: elapsed is a LOWER bound
+                    self._m_ttft[cls].observe(
+                        max(0.0, end - target) * 1e6)
+                # a negative LAST token is the server's refusal /
+                # eviction marker: the stream answered, but not with
+                # the requested generation — an error, though any real
+                # tokens before the marker still observed above
+                good = not failed and n_real > 0 and toks[-1] >= 0
+                if good:
+                    ok += 1
+                else:
+                    errors += 1
+                    self._m_err[cls].inc()
+        finally:
+            try:
+                cli.close()
+            except OSError:
+                pass
+            with self._lock:
+                self._live -= 1
+                self._counts["sent"] += sent
+                self._counts["ok"] += ok
+                self._counts["errors"] += errors
+                self._counts["shed"] += sum(shed_by_class.values())
+                for c, n in shed_by_class.items():
+                    self._shed_by_class[c] = \
+                        self._shed_by_class.get(c, 0) + n
+
+    def summary(self, elapsed_s: float) -> Dict[str, Any]:
+        out = super().summary(elapsed_s)
+        out["token_latency"] = {
+            "ttft_us": self._quantiles(self._m_ttft,
+                                       getattr(self, "_ttft_base", {})),
+            "itl_us": self._quantiles(self._m_itl,
+                                      getattr(self, "_itl_base", {})),
+        }
+        return out

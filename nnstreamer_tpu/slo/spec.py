@@ -29,6 +29,16 @@ metrics registry via the snapshot/diff API, no bespoke plumbing):
     target — "did the service answer at all" vs "did it answer
     correctly/fast"; kept a distinct kind so verdicts name the right
     contract.
+``ttft`` / ``itl``
+    Token-latency kinds for the LLM serving tier: first tokens slower
+    than ``threshold_us`` since their *scheduled* arrival (``ttft``),
+    or inter-token gaps longer than ``threshold_us`` (``itl``), are
+    bad.  Same histogram accounting as ``latency`` over the
+    ``nns_slo_ttft_us`` / ``nns_slo_itl_us`` families the token
+    loadgen writes — or, via ``metric``, the server-side
+    ``nns_llm_ttft_us`` / ``nns_llm_itl_us`` the ``tensor_llm``
+    element observes; kept distinct kinds so verdicts name the token
+    contract they gate.
 
 Specs serialize as plain JSON (``to_dict``/``from_dict``,
 ``load``/``dump``) — the ``tools/soak.py --slo spec.json`` format and
@@ -41,13 +51,21 @@ import dataclasses
 import json
 from typing import Any, Dict, Optional, Tuple
 
-KINDS = ("latency", "error_rate", "availability")
+KINDS = ("latency", "error_rate", "availability", "ttft", "itl")
+#: kinds whose accounting is histogram-threshold (bucket vector math)
+HIST_KINDS = ("latency", "ttft", "itl")
 
 #: metric families the evaluator reads; the loadgen writes them and any
 #: other client may too (one shared contract, obs/metrics.py registry)
 REQUESTS_TOTAL = "nns_slo_requests_total"
 ERRORS_TOTAL = "nns_slo_errors_total"
 LATENCY_US = "nns_slo_latency_us"
+#: token-latency families (schedule-anchored, client-side — the
+#: coordinated-omission-free halves of the TTFT/ITL contract)
+TTFT_US = "nns_slo_ttft_us"
+ITL_US = "nns_slo_itl_us"
+#: default histogram family per histogram-threshold kind
+HIST_FAMILY = {"latency": LATENCY_US, "ttft": TTFT_US, "itl": ITL_US}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,17 +76,19 @@ class Objective:
     class (``buf.extra["nns_class"]``, query/client.py); empty matches
     every class (sums across labels).
 
-    ``metric`` (latency kind only) overrides the histogram family the
-    objective reads — e.g. ``nns_element_proctime_us`` gates a
-    pipeline's own per-element latency instead of the loadgen's
-    request latency; ``match`` further restricts to metric keys
-    containing the substring (e.g. ``element="filter"``).
+    ``metric`` (histogram kinds: latency/ttft/itl) overrides the
+    histogram family the objective reads — e.g.
+    ``nns_element_proctime_us`` gates a pipeline's own per-element
+    latency instead of the loadgen's request latency, and
+    ``nns_llm_ttft_us`` gates the server-observed first-token latency;
+    ``match`` further restricts to metric keys containing the
+    substring (e.g. ``element="filter"``).
     """
 
     name: str
     kind: str                      # one of KINDS
     target: float                  # success fraction in (0, 1)
-    threshold_us: float = 0.0      # latency kind: slower-than = bad
+    threshold_us: float = 0.0      # histogram kinds: slower-than = bad
     request_class: str = ""
     metric: str = ""               # latency kind: histogram family
     match: str = ""                # raw key-substring label filter
@@ -80,9 +100,9 @@ class Objective:
         if not 0.0 < self.target < 1.0:
             raise ValueError(f"objective {self.name!r}: target "
                              f"{self.target} must be in (0, 1)")
-        if self.kind == "latency" and self.threshold_us <= 0:
-            raise ValueError(f"objective {self.name!r}: latency kind "
-                             "requires threshold_us > 0")
+        if self.kind in HIST_KINDS and self.threshold_us <= 0:
+            raise ValueError(f"objective {self.name!r}: {self.kind} "
+                             "kind requires threshold_us > 0")
 
     @property
     def budget(self) -> float:

@@ -201,6 +201,57 @@ class TestCollectorMerge:
         text = col.render_prometheus()
         assert 'origin="evil\\"host\\\\:1"' in text
 
+    def test_llm_token_families_federate(self):
+        """ISSUE 20: the token-observability families
+        (``nns_llm_ttft_us``/``itl``/terminal/blame counters) ride the
+        existing push wire unchanged — a worker's TokenObs state merges
+        with origin labels and renders quantiles at the collector, no
+        federation-side changes required."""
+        from nnstreamer_tpu.llm.tokenobs import (BLAME_NS_TOTAL,
+                                                 TERMINAL_TOTAL,
+                                                 TokenObs, TTFT_US)
+
+        class _Phases:
+            def totals_ns(self):
+                return {"decode": 7_000, "prefill": 3_000}
+
+        class _Sess:
+            key, qos, extra, obs = "s", "gold", {}, None
+
+        worker = MetricsRegistry()
+        now = [0]
+        tobs = TokenObs(_Phases(), clock_ns=lambda: now[0],
+                        registry=worker,
+                        labels={"element": "llm", "pipeline": "p0"})
+        s = _Sess()
+        tobs.on_admit(s)
+        now[0] = 250_000                    # 250 us to first token
+        tobs.on_token(s)
+        tobs.on_terminal(s, "stop")
+        tobs.on_refused("silver", "shed")
+        tobs.sync_blame_counters()
+
+        col = MetricsCollector(registry=None)
+        assert col.ingest(payload(
+            state=worker.snapshot_state(prefix="nns_llm_")))
+        snap = col.snapshot_state(prefix="nns_llm_")
+        ttft = [v for k, v in snap.items()
+                if k.partition("{")[0] == TTFT_US
+                and 'origin="w:1"' in k]
+        assert len(ttft) == 1 and ttft[0]["count"] == 1
+        causes = {k.partition('cause="')[2].partition('"')[0]:
+                  v["value"] for k, v in snap.items()
+                  if k.partition("{")[0] == TERMINAL_TOTAL}
+        assert causes == {"stop": 1, "shed": 1}
+        blame = {k.partition('cause="')[2].partition('"')[0]:
+                 v["value"] for k, v in snap.items()
+                 if k.partition("{")[0] == BLAME_NS_TOTAL}
+        assert blame == {"decode-compute": 7_000,
+                         "prefill-chunk-steal": 3_000}
+        text = col.render_prometheus()
+        assert f'{TTFT_US}_count' in text
+        assert 'quantile="0.99"' in text
+
 
 # ---------------------------------------------------------------------------
 # label-escaping satellite (obs/metrics.py render)

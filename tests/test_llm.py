@@ -279,6 +279,210 @@ class TestPhaseClock:
         assert s["prefill"] == pytest.approx(0.040)
 
 
+class _FakePhases:
+    """A hand-cranked PhaseClock stand-in: tests set the totals dict
+    directly, so blame folds are checked against exact integers."""
+
+    def __init__(self, **totals):
+        self.totals = dict(totals)
+
+    def totals_ns(self):
+        return dict(self.totals)
+
+
+class _FakeSess:
+    def __init__(self, key="k", qos="gold"):
+        self.key = key
+        self.qos = qos
+        self.extra = {}
+        self.obs = None
+
+
+class TestTokenObs:
+    """Token-level observability (ISSUE 20): TTFT/ITL math under an
+    injected clock, blame conservation against the PhaseClock identity,
+    shed/evict exclusion from the histograms, and the monotone blame
+    counter mirror."""
+
+    def _fixture(self, phases=None):
+        from nnstreamer_tpu.llm.tokenobs import TokenObs
+        from nnstreamer_tpu.obs.metrics import MetricsRegistry
+
+        now = [0]
+        reg = MetricsRegistry()
+        tobs = TokenObs(phases if phases is not None else _FakePhases(),
+                        clock_ns=lambda: now[0], registry=reg,
+                        labels={"element": "t", "pipeline": "t"})
+        return now, reg, tobs
+
+    def _hist_state(self, reg, family):
+        snap = reg.snapshot_state(prefix="nns_llm_")
+        return {k: v for k, v in snap.items()
+                if k.partition("{")[0] == family
+                and v["kind"] == "histogram"}
+
+    def test_ttft_and_itl_from_injected_clock(self):
+        """TTFT is admit -> FIRST emitted token (chunk interleave
+        included: two chunks happen in between and change nothing);
+        every later token observes the inter-token gap."""
+        from nnstreamer_tpu.llm.tokenobs import ITL_US, TTFT_US
+
+        now, reg, tobs = self._fixture()
+        s = _FakeSess()
+        now[0] = 1_000
+        tobs.on_admit(s)
+        tobs.on_chunk(s)
+        tobs.on_chunk(s)
+        now[0] = 2_501_000                      # +2.5 ms to first token
+        tobs.on_token(s)
+        now[0] = 2_601_000                      # +100 us gap
+        tobs.on_token(s)
+        now[0] = 2_801_000                      # +200 us gap
+        tobs.on_token(s)
+        (ttft,) = self._hist_state(reg, TTFT_US).values()
+        assert ttft["count"] == 1
+        assert ttft["total"] == pytest.approx(2_500.0)    # us
+        (itl,) = self._hist_state(reg, ITL_US).values()
+        assert itl["count"] == 2
+        assert itl["total"] == pytest.approx(300.0)
+        assert s.obs.tokens == 3 and s.obs.chunks == 2
+
+    def test_blame_conserves_phaseclock_wall_time(self):
+        """A session's accumulated blame sums EXACTLY to its
+        admit->terminal window: the snapshots partition the decode
+        thread's wall time, so conservation is integer arithmetic."""
+        ms = 1_000_000
+        now = [0]
+        clk = PhaseClock(clock_ns=lambda: now[0])
+        _, _, tobs = self._fixture(phases=clk)
+        tobs._clock_ns = lambda: now[0]
+        s = _FakeSess(qos="silver")
+        now[0] = 10 * ms
+        tobs.on_admit(s)
+        clk.enter("prefill")
+        now[0] = 30 * ms
+        clk.enter("decode")
+        now[0] = 50 * ms
+        tobs.on_token(s)                        # first token
+        clk.enter("llm-prefill-chunk")          # another session's chunk
+        now[0] = 70 * ms
+        clk.enter("decode")
+        now[0] = 90 * ms
+        tobs.on_token(s)
+        clk.enter("idle")
+        now[0] = 100 * ms
+        tobs.on_terminal(s, "stop")
+        rec = tobs.records()[-1]
+        assert rec["cause"] == "stop" and rec["tokens"] == 2
+        assert rec["ttft_us"] == pytest.approx(40_000.0)
+        blame = rec["blame_ns"]
+        # both prefill phases fold to the steal cause; the partition
+        # covers the 90 ms admit->terminal window to the nanosecond
+        assert blame["prefill-chunk-steal"] == 40 * ms
+        assert blame["decode-compute"] == 40 * ms
+        assert blame["idle"] == 10 * ms
+        assert sum(blame.values()) == 90 * ms
+        assert rec["blame_conserved_pct"] == 100.0
+
+    def test_shed_evict_excluded_from_histograms(self):
+        """Refused streams and token-less evictions land in the
+        terminal-cause counters ONLY: a fast refusal must not flatter
+        p50, a reaped zombie must not poison p99."""
+        from nnstreamer_tpu.llm.tokenobs import (ITL_US, TERMINAL_TOTAL,
+                                                 TTFT_US)
+
+        now, reg, tobs = self._fixture()
+        tobs.on_refused("silver", "shed")
+        tobs.on_refused("silver", "shed")
+        tobs.on_refused("gold", "reject")
+        s = _FakeSess()
+        now[0] = 1_000
+        tobs.on_admit(s)
+        now[0] = 9_000_000
+        tobs.on_terminal(s, "evict")            # reaped before a token
+        assert not self._hist_state(reg, TTFT_US)
+        assert not self._hist_state(reg, ITL_US)
+        snap = reg.snapshot_state(prefix="nns_llm_")
+        causes = {}
+        for key, st in snap.items():
+            if key.partition("{")[0] == TERMINAL_TOTAL:
+                cause = key.partition('cause="')[2].partition('"')[0]
+                causes[cause] = causes.get(cause, 0) + st["value"]
+        assert causes == {"shed": 2, "reject": 1, "evict": 1}
+        assert s.obs is None                    # record closed exactly once
+        assert tobs.records()[-1]["cause"] == "evict"
+
+    def test_sync_blame_counters_monotone_no_double_publish(self):
+        from nnstreamer_tpu.llm.tokenobs import BLAME_NS_TOTAL
+
+        phases = _FakePhases(decode=100, prefill=50)
+        _, reg, tobs = self._fixture(phases=phases)
+
+        def _blame(reg):
+            out = {}
+            for key, st in reg.snapshot_state(
+                    prefix="nns_llm_").items():
+                if key.partition("{")[0] == BLAME_NS_TOTAL:
+                    cause = key.partition(
+                        'cause="')[2].partition('"')[0]
+                    out[cause] = st["value"]
+            return out
+
+        tobs.sync_blame_counters()
+        assert _blame(reg) == {"decode-compute": 100,
+                               "prefill-chunk-steal": 50}
+        tobs.sync_blame_counters()              # idempotent: no growth
+        assert _blame(reg)["decode-compute"] == 100
+        phases.totals["decode"] = 175
+        phases.totals["llm-prefill-chunk"] = 25
+        tobs.sync_blame_counters()
+        assert _blame(reg) == {"decode-compute": 175,
+                               "prefill-chunk-steal": 75}
+
+    def test_cold_engine_first_dispatch_charged_to_compile(self):
+        """A fresh (un-warmed) engine's first decode step compiles; the
+        PhaseClock charges that wall time to ``compile``, not
+        ``decode`` — blame must name the cold start, not smear it over
+        decode-compute."""
+        cfg = _cfg()
+        params = init_params(cfg, 1)
+        pool = KVCachePool(cfg, 2)
+        eng = DecodeEngine(params, cfg, pool, capacity=2)
+        s = pool.acquire("a")
+        s.max_new, s.next_token = 2, 5
+        eng.step([s])
+        tot = eng.phases.totals_ns()
+        assert tot.get("compile", 0) > 0
+        # the compiled dispatch dominates the warm part of the step
+        assert tot["compile"] > tot["decode"]
+        pool.release("a")
+
+    def test_chrome_events_session_lanes(self):
+        now, _, tobs = self._fixture()
+        s = _FakeSess(key="sess-1")
+        now[0] = 1_000_000
+        tobs.on_admit(s)
+        now[0] = 3_000_000
+        tobs.on_token(s)
+        now[0] = 5_000_000
+        tobs.on_token(s)
+        now[0] = 6_000_000
+        tobs.on_terminal(s, "max_new")
+        events = tobs.chrome_events(pid=9)
+        meta = [e for e in events if e["ph"] == "M"]
+        spans = [e for e in events if e["ph"] == "X"]
+        assert {e["name"] for e in meta} == {"process_name",
+                                             "thread_name"}
+        assert [e["name"] for e in spans] == ["ttft", "decode"]
+        ttft, decode = spans
+        assert ttft["dur"] == pytest.approx(2_000.0)      # us
+        assert decode["dur"] == pytest.approx(3_000.0)
+        assert decode["args"]["cause"] == "max_new"
+        assert decode["args"]["tokens"] == 2
+        # metadata sorts ahead of spans (the chrome_trace merge key)
+        assert events[:len(meta)] == meta
+
+
 class TestEngine:
     def test_bounded_executables_across_fills(self):
         """Sequences joining/leaving between steps never recompile:
@@ -1268,6 +1472,37 @@ class TestPerfDiffMissingMetric:
         assert verdict["pass"]
 
 
+class TestPerfDiffTokenLatencyDirection:
+    """Satellite (ISSUE 20): ``ttft``/``itl``/``latency`` metric-name
+    tokens pin lower-is-better regardless of how a row spelled its
+    unit — an inflated first-token latency must read as REGRESSION."""
+
+    def _row(self, metric, value, unit=""):
+        return {"metric": metric, "value": value, "unit": unit,
+                "status": "live"}
+
+    @pytest.mark.parametrize("metric", [
+        "soak_llm_paged_ttft_p99",       # bare unit: name token only
+        "soak_llm_itl_p99",
+        "client_latency_mean",
+    ])
+    def test_inflated_token_latency_regresses(self, metric):
+        pd = _load_perf_diff()
+        base = [self._row(metric, 100_000.0)]
+        verdict = pd.diff([base, base],
+                          [self._row(metric, 1_000_000.0)])
+        assert not verdict["pass"]
+        assert [r for r in verdict["regressions"]
+                if r["metric"] == metric]
+
+    def test_reduced_ttft_is_an_improvement(self):
+        pd = _load_perf_diff()
+        base = [self._row("soak_llm_ttft_p99", 100_000.0)]
+        verdict = pd.diff([base, base],
+                          [self._row("soak_llm_ttft_p99", 50_000.0)])
+        assert verdict["pass"]
+
+
 # ---------------------------------------------------------------------------
 # pinned perf_diff gate on the committed paged acceptance artifact
 # ---------------------------------------------------------------------------
@@ -1371,3 +1606,112 @@ class TestPerfDiffPinnedPaged:
         assert lp["arena_bytes"] == lp["dense_arena_bytes"]
         assert lp["prefix_hits_warm"] > 0
         assert lp["steady_state_compiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# pinned perf_diff gate on the committed token-observability artifact
+# ---------------------------------------------------------------------------
+
+class TestPerfDiffPinnedObs:
+    """The committed SOAK_llm_obs_r20.json pins the token-latency
+    acceptance (ISSUE 20): inflated TTFT/ITL FAILS tier-1 here (the
+    lower-is-better name tokens), the blame-conservation and
+    warm-vs-cold evidence must BE in the artifact, and the ttft/itl
+    SLO objectives must have passed."""
+
+    def _load(self):
+        import json
+        import os
+
+        pd = _load_perf_diff()
+        root = os.path.join(os.path.dirname(__file__), "..")
+        with open(os.path.join(root, "SOAK_llm_obs_r20.json"),
+                  encoding="utf-8") as fh:
+            doc = json.load(fh)
+        return pd, doc
+
+    def test_committed_rows_self_pass(self):
+        pd, doc = self._load()
+        rows = doc["rows"]
+        verdict = pd.diff([rows, rows], rows, margin_pct=10.0)
+        assert verdict["pass"], verdict
+
+    def test_inflated_ttft_regresses(self):
+        """A candidate whose first tokens got 3x slower must FAIL even
+        though the row's raw value got BIGGER — direction is pinned by
+        the ``ttft`` name token + ``us`` unit."""
+        import copy
+
+        pd, doc = self._load()
+        rows = doc["rows"]
+        inflated = copy.deepcopy(rows)
+        for row in inflated:
+            if row["metric"] == "soak_llm_paged_ttft_p99_us":
+                row["value"] *= 3.0
+        verdict = pd.diff([rows, rows], inflated, margin_pct=10.0)
+        assert not verdict["pass"]
+        assert [r for r in verdict["regressions"]
+                if r["metric"] == "soak_llm_paged_ttft_p99_us"]
+
+    def test_inflated_itl_regresses(self):
+        import copy
+
+        pd, doc = self._load()
+        rows = doc["rows"]
+        inflated = copy.deepcopy(rows)
+        for row in inflated:
+            if row["metric"] == "soak_llm_paged_itl_p99_us":
+                row["value"] *= 5.0
+        verdict = pd.diff([rows, rows], inflated, margin_pct=10.0)
+        assert not verdict["pass"]
+        assert [r for r in verdict["regressions"]
+                if r["metric"] == "soak_llm_paged_itl_p99_us"]
+
+    def test_committed_artifact_gates_hold(self):
+        """The artifact must BE a pass with the token-latency boxes
+        checked: per-class distributions with sheds excluded, blame
+        conservation at 100 %, warm-prefix TTFT decisively below cold
+        IN THE SAME RUN, and the ttft/itl SLO verdict green."""
+        _, doc = self._load()
+        assert doc["pass"] and doc["verdict"] == "PASS"
+        checks = doc["llm_paged"]["checks"]
+        for name in ("token_slo_pass", "session_blame_conserved",
+                     "ttft_warm_below_cold", "zero_errors",
+                     "exact_order", "zero_steady_compiles",
+                     "attribution_conserved"):
+            assert checks.get(name) is True, (name, checks)
+        tl = doc["token_latency"]
+        # per-class distributions present, sheds in the cause counters
+        # only (they can never reach the histograms by construction)
+        assert tl["ttft_us"] and tl["itl_us"]
+        assert tl["terminal_causes"].get("shed", 0) > 0
+        assert tl["sessions_recorded"] > 0
+        # blame shares fold the PhaseClock partition: they sum to 100 %
+        # of the decode thread's windowed wall time
+        assert sum(tl["blame_shares_pct"].values()) \
+            == pytest.approx(100.0, abs=0.1)
+        cons = tl["session_blame_conserved_pct"]
+        assert abs(cons["mean"] - 100.0) < 1.0
+        assert cons["n"] > 0
+        # the warm-prefix win, measured inside ONE run: warm-phase
+        # median TTFT well under the cold phase's
+        assert 0.0 < tl["ttft_warm_vs_cold_p50"] <= 0.9
+        slo = doc["slo"]
+        assert slo["pass"] and slo["verdict"] == "PASS"
+        assert {o["name"] for o in slo["objectives"]} \
+            >= {"ttft", "itl"}
+
+    def test_renamed_ttft_row_fails_missing(self):
+        import copy
+
+        pd, doc = self._load()
+        rows = doc["rows"]
+        renamed = copy.deepcopy(rows)
+        for row in renamed:
+            if row["metric"] == "soak_llm_paged_ttft_p99_us":
+                row["metric"] = "soak_llm_paged_first_tok_p99_us"
+        verdict = pd.diff([rows, rows], renamed, margin_pct=10.0)
+        assert not verdict["pass"]
+        missing = [r for r in verdict["regressions"]
+                   if r["verdict"] == "MISSING"]
+        assert missing[0]["metric"] == "soak_llm_paged_ttft_p99_us"

@@ -18,6 +18,7 @@ All tier-1-fast: loopback sockets, no models, no sleeps beyond pacing.
 
 import json
 import logging
+import os
 import threading
 import time
 import urllib.request
@@ -732,6 +733,12 @@ def fake_iio_tree(tmp_path):
 
 
 class TestSrcIioPacing:
+    @pytest.mark.skipif(
+        (os.cpu_count() or 1) < 2,
+        reason="wall-clock pacing bound needs >=2 cores: on one core "
+               "the paced streaming thread contends with the rest of "
+               "the suite and misses deadlines for scheduler reasons, "
+               "not drift")
     def test_absolute_deadline_rate_holds(self, fake_iio_tree):
         """10 buffers at 50 Hz = 9 inter-buffer gaps ≈ 180 ms; relative
         sleep pacing would ALSO pass this, but drift-free absolute

@@ -15,7 +15,12 @@ when the endpoint is federated (obs/federation.py).  Fleets (fleet/)
 render too: origin rows carry their role (router/worker from the
 ``nns_fleet_role`` gauges), and a fleet section lists each worker's
 routed-connection count and draining state from the router's gauges —
-all riding the same federated scrape.  ``--once`` prints
+all riding the same federated scrape.  When a ``tensor_llm`` element
+is exporting, an LLM serving panel appears: resident sessions, mean
+decode-step fill, decode tok/s, the TTFT p99 sparkline
+(``nns_llm_ttft_us{quantile="0.99"}``, worst class) and the free-pages
+trend — the llm/tokenobs.py families ride the same scrape, so the
+panel is federated for free.  ``--once`` prints
 a single plain frame and exits (scriptable / CI-friendly); the loop
 refreshes in place until Ctrl-C or ``--duration``.
 

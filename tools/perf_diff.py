@@ -53,8 +53,12 @@ _LOWER_BETTER = ("ns", "us", "ms", "pct", "percent", "seconds", "bytes")
 #: metric-NAME tokens that are lower-is-better regardless of unit: a
 #: compile count is a cost (the bounded-executable discipline), and
 #: the ledger exports it unitless — ``compiles``/``nns_jit_compiles``
-#: rows must not be read as throughput
-_LOWER_BETTER_METRICS = ("compiles", "recompiles", "nns_jit_compiles")
+#: rows must not be read as throughput.  ``ttft``/``itl``/``latency``
+#: pin the token-latency direction even if a row ships a bare or
+#: unconventional unit: an inflated first-token latency must read as
+#: REGRESSION no matter how the artifact spelled its unit
+_LOWER_BETTER_METRICS = ("compiles", "recompiles", "nns_jit_compiles",
+                         "ttft", "itl", "latency")
 #: absolute tolerance floor: metrics this close to zero are below the
 #: resolution any scheduler can promise
 _ABS_FLOOR = 1e-9

@@ -48,6 +48,7 @@ no circuit breaker tripped (shed is not failure).
 import argparse
 import json
 import os
+import re
 import sys
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -915,6 +916,129 @@ def llm_server_line(slots: int, batch: int,
             f"tensor_query_serversink id={sid}")
 
 
+def _token_hist_quantiles(delta, family):
+    """Per-class p50/p99 of one server-side token-latency histogram
+    family (``nns_llm_ttft_us`` / ``nns_llm_itl_us``) from a
+    ``snapshot_state`` window delta — the same bucket math the SLO
+    evaluator uses, so the summary and the gate cannot disagree."""
+    from nnstreamer_tpu.obs.metrics import quantile_from_counts
+
+    per_class = {}
+    for key, st in delta.items():
+        if st.get("kind") != "histogram" \
+                or key.partition("{")[0] != family:
+            continue
+        m = re.search(r'class="([^"]*)"', key)
+        cls = m.group(1) if m else "default"
+        cur = per_class.setdefault(cls, [0, None])
+        cur[0] += int(st["count"])
+        if cur[1] is None:
+            cur[1] = list(st["counts"])
+        else:
+            for i, c in enumerate(st["counts"]):
+                cur[1][i] += c
+    out = {}
+    for cls, (count, counts) in sorted(per_class.items()):
+        if count and counts:
+            out[cls] = {
+                "count": count,
+                "p50_us": round(quantile_from_counts(counts, 0.50), 1),
+                "p99_us": round(quantile_from_counts(counts, 0.99), 1)}
+    return out
+
+
+def _token_latency_block(llm, delta):
+    """The ``token_latency`` verdict block (ISSUE 20): per-class
+    TTFT/ITL distributions (sheds/rejects excluded by construction —
+    they only reach the terminal-cause counters), decode-plane blame
+    shares (PhaseClock fold: sum to 100%% of decode-thread wall time
+    by identity), terminal-cause counts, and per-session conservation
+    evidence from the completed-record ring."""
+    from nnstreamer_tpu.llm import tokenobs as _to
+
+    tobs = getattr(llm, "_tok_obs", None)
+    blame = tobs.blame_report() if tobs is not None else {}
+    recs = tobs.records() if tobs is not None else []
+    causes = {}
+    for key, st in delta.items():
+        if st.get("kind") != "counter" \
+                or key.partition("{")[0] != _to.TERMINAL_TOTAL:
+            continue
+        m = re.search(r'cause="([^"]*)"', key)
+        cause = m.group(1) if m else "?"
+        v = int(st.get("value", 0))
+        if v:
+            causes[cause] = causes.get(cause, 0) + v
+    conserved = [r["blame_conserved_pct"] for r in recs
+                 if r.get("wall_ms", 0.0) > 1.0]
+    # windowed blame from the monotone nns_llm_blame_ns_total
+    # counters' delta (the soak's own decode-thread time); the
+    # lifetime fold (which includes the warmup's compile share) rides
+    # along as evidence
+    blame_win = {}
+    for key, st in delta.items():
+        if st.get("kind") != "counter" \
+                or key.partition("{")[0] != _to.BLAME_NS_TOTAL:
+            continue
+        m = re.search(r'cause="([^"]*)"', key)
+        cause = m.group(1) if m else "?"
+        v = int(st.get("value", 0))
+        if v:
+            blame_win[cause] = blame_win.get(cause, 0) + v
+    total_win = sum(blame_win.values())
+    block = {
+        "ttft_us": _token_hist_quantiles(delta, _to.TTFT_US),
+        "itl_us": _token_hist_quantiles(delta, _to.ITL_US),
+        "blame_shares_pct": (
+            {c: round(100.0 * v / total_win, 3)
+             for c, v in sorted(blame_win.items())}
+            if total_win else blame.get("shares_pct", {})),
+        "blame_window_ns": total_win,
+        "blame_lifetime_shares_pct": blame.get("shares_pct", {}),
+        "blame_conserved_pct": blame.get("conserved_pct"),
+        "terminal_causes": causes,
+        "sessions_recorded": len(recs),
+        "session_sample": recs[-3:],
+    }
+    if conserved:
+        block["session_blame_conserved_pct"] = {
+            "min": round(min(conserved), 3),
+            "mean": round(sum(conserved) / len(conserved), 3),
+            "max": round(max(conserved), 3), "n": len(conserved)}
+    return block
+
+
+def _llm_slo_monitor(duration_s, ttft_us=5_000_000.0,
+                     itl_us=1_000_000.0):
+    """Token-latency SLO monitor over the SERVER-side families: the
+    ``ttft``/``itl`` objective kinds with ``metric`` overrides pointing
+    at ``nns_llm_ttft_us``/``nns_llm_itl_us`` (the element's own
+    observations — the soak's clients are in-process threads, so the
+    wire-side loadgen families are not in play here).  Windows scale
+    with the soak the way demo_spec's do; thresholds are CPU-host
+    budgets (first token within 5 s by default — the paged soak
+    passes 10 s because its cold half saturates admission by design —
+    every inter-token gap within 1 s, >= 90%% of each): generous
+    against a healthy run, decisively breached by a stalled decode
+    plane."""
+    from nnstreamer_tpu.llm.tokenobs import ITL_US, TTFT_US
+    from nnstreamer_tpu.slo.evaluator import Evaluator, SLOMonitor
+    from nnstreamer_tpu.slo.spec import Objective, SLOSpec
+
+    fast = max(2.0, duration_s / 6.0)
+    spec = SLOSpec(
+        name="llm-token-latency",
+        window_fast_s=fast, window_slow_s=fast * 10.0,
+        burn_threshold=2.0, tick_s=max(0.25, fast / 10.0),
+        objectives=(
+            Objective("ttft", "ttft", target=0.90,
+                      threshold_us=ttft_us, metric=TTFT_US),
+            Objective("itl", "itl", target=0.90,
+                      threshold_us=itl_us, metric=ITL_US),
+        ))
+    return SLOMonitor(Evaluator(spec))
+
+
 def run_llm(args, ap) -> int:
     """Token-streaming LLM serving acceptance soak (ISSUE 15): a
     multi-client soak against the ``tensor_llm`` continuous-batching
@@ -991,6 +1115,21 @@ def run_llm(args, ap) -> int:
     solo_s = _time.monotonic() - t0
     cli.close()
     solo_tok_s = solo["tokens"] / solo_s
+
+    # token-latency plane (ISSUE 20): baseline the server-side
+    # nns_llm_* families AFTER the solo warmup so the soak's block is
+    # the soak's distribution, and gate the run with the ttft/itl SLO
+    # kinds over the same histograms
+    from nnstreamer_tpu.obs.metrics import REGISTRY as _REG
+    from nnstreamer_tpu.obs.metrics import state_delta as _state_delta
+
+    if llm._tok_obs is not None:
+        # flush pre-soak blame (warmup compile) into the counters so
+        # the baseline snapshot absorbs it — the windowed blame shares
+        # below must describe the SOAK, not the element's lifetime
+        llm._tok_obs.sync_blame_counters()
+    tok0 = _REG.snapshot_state(prefix="nns_llm_")
+    slo_monitor = _llm_slo_monitor(duration).start()
 
     # 2. the soak: clients join and leave continuously (half reconnect
     # per session — connection churn exercises disconnect pruning on
@@ -1095,6 +1234,13 @@ def run_llm(args, ap) -> int:
     deadline = _time.monotonic() + 30
     while srv._inflight > 0 and _time.monotonic() < deadline:
         _time.sleep(0.1)
+    slo_monitor.stop()
+    slo_verdict = slo_monitor.evaluator.verdict()
+    if llm._tok_obs is not None:
+        llm._tok_obs.sync_blame_counters()
+    tok_delta = _state_delta(_REG.snapshot_state(prefix="nns_llm_"),
+                             tok0)
+    token_latency = _token_latency_block(llm, tok_delta)
     engine_report = llm.engine.report()
     cache_bytes_end = llm.pool.cache_bytes()
     shed_server = llm.shed_total
@@ -1130,6 +1276,16 @@ def run_llm(args, ap) -> int:
         # happened; the pruner must have reclaimed every one (final
         # live == 0 is implied by inflight_settled + pipeline.stop)
         "disconnects_reclaimed": evicted >= 1,
+        # ISSUE 20 token-latency gates: the ttft/itl SLO objectives
+        # never breached, and the per-session blame accumulators
+        # reconcile with each session's own admit->terminal wall time
+        # (the partition is an identity; the sub-ms slack is the
+        # independent clock reads that stamp the window's edges)
+        "token_slo_pass": slo_verdict["pass"],
+        "session_blame_conserved": (
+            "session_blame_conserved_pct" in token_latency
+            and abs(token_latency["session_blame_conserved_pct"]
+                    ["mean"] - 100.0) < 1.0),
     }
     attribution = {
         "states": dict(phases["states_pct"]),
@@ -1167,6 +1323,8 @@ def run_llm(args, ap) -> int:
             "checks": checks,
         },
         "attribution": attribution,
+        "token_latency": token_latency,
+        "slo": slo_verdict,
     }
     tok_row = {"metric": "soak_llm_tokens_per_s",
                "value": round(tok_s, 1), "unit": "tokens_per_s",
@@ -1183,6 +1341,18 @@ def run_llm(args, ap) -> int:
          "value": engine_report["mean_fill"],
          "unit": "seqs_per_step", "status": "live"},
     ]
+    ttft_p99 = max((v["p99_us"]
+                    for v in token_latency["ttft_us"].values()),
+                   default=0.0)
+    itl_p99 = max((v["p99_us"]
+                   for v in token_latency["itl_us"].values()),
+                  default=0.0)
+    verdict["rows"].extend([
+        {"metric": "soak_llm_ttft_p99_us", "value": ttft_p99,
+         "unit": "us", "status": "live"},
+        {"metric": "soak_llm_itl_p99_us", "value": itl_p99,
+         "unit": "us", "status": "live"},
+    ])
     with open(os.path.join(args.out, "verdict.json"), "w",
               encoding="utf-8") as fh:
         json.dump(verdict, fh, indent=2)
@@ -1197,6 +1367,8 @@ def run_llm(args, ap) -> int:
             "prefill_pct": phases["states_pct"].get("prefill"),
             "decode_pct": phases["states_pct"].get("decode"),
             "conserved_pct": phases["conserved_pct"],
+            "ttft_p99_us": ttft_p99, "itl_p99_us": itl_p99,
+            "token_slo": slo_verdict["verdict"],
             "checks": checks,
             "artifact": os.path.join(args.out, "verdict.json")}
     print(json.dumps(line), flush=True)
@@ -1308,6 +1480,28 @@ def run_llm_paged(args, ap) -> int:
     cache_bytes_start = pool.cache_bytes()
     compiles_warm = llm.engine.compiles   # warmup grid is complete here
 
+    # token-latency plane (ISSUE 20): baseline the server-side
+    # nns_llm_* families (the dense reference ran first — diffing
+    # excludes it) and gate with the ttft/itl SLO kinds; a second
+    # snapshot at the cold->warm flip splits the TTFT distribution so
+    # the warm-prefix win is measured INSIDE one run
+    from nnstreamer_tpu.obs.metrics import REGISTRY as _REG
+    from nnstreamer_tpu.obs.metrics import state_delta as _state_delta
+
+    if llm._tok_obs is not None:
+        # flush pre-soak blame (the paged plan's warmup compile) into
+        # the counters so the baseline absorbs it — otherwise the
+        # first lazy sync lands the whole warmup inside the window
+        llm._tok_obs.sync_blame_counters()
+    tok0 = _REG.snapshot_state(prefix="nns_llm_")
+    # the cold half DELIBERATELY saturates admission: every client
+    # replays an ~85-token prompt as 11 prefill chunks, so first
+    # tokens queue for seconds by design.  10 s is the budget that
+    # separates "saturated but flowing" from a stalled decode plane
+    # (a head-of-line stall parks first tokens for the whole phase).
+    slo_monitor = _llm_slo_monitor(duration,
+                                   ttft_us=10_000_000.0).start()
+
     stop = _threading.Event()
     phase = {"mode": "cold"}
     stats = []
@@ -1407,6 +1601,9 @@ def run_llm_paged(args, ap) -> int:
             _time.sleep(min(exc.retry_after_s, 1.0))
     seed_cli.close()
     cold1, pfx1 = _phase_snap()
+    if llm._tok_obs is not None:
+        llm._tok_obs.sync_blame_counters()
+    tok_flip = _REG.snapshot_state(prefix="nns_llm_")
     phase["mode"] = "warm"
     stop.wait(duration / 2)
     warm1, pfx2 = _phase_snap()
@@ -1414,6 +1611,11 @@ def run_llm_paged(args, ap) -> int:
     for t in threads:
         t.join(timeout=180)
     soak_s = _time.monotonic() - t0
+    slo_monitor.stop()
+    slo_verdict = slo_monitor.evaluator.verdict()
+    if llm._tok_obs is not None:
+        llm._tok_obs.sync_blame_counters()
+    tok_end = _REG.snapshot_state(prefix="nns_llm_")
 
     def _busy_prefill_share(a, b):
         d = {k: b[k] - a[k] for k in b}
@@ -1426,6 +1628,25 @@ def run_llm_paged(args, ap) -> int:
     hits_cold = pfx1["hits"] - pfx0["hits"]
     hits_warm = pfx2["hits"] - pfx1["hits"]
     reused_warm = pfx2["reused"] - pfx1["reused"]
+
+    from nnstreamer_tpu.llm.tokenobs import TTFT_US as _TTFT
+
+    token_latency = _token_latency_block(
+        llm, _state_delta(tok_end, tok0))
+    ttft_cold = _token_hist_quantiles(_state_delta(tok_flip, tok0),
+                                      _TTFT)
+    ttft_warm = _token_hist_quantiles(_state_delta(tok_end, tok_flip),
+                                      _TTFT)
+
+    def _agg_p50(block):
+        return max((v["p50_us"] for v in block.values()), default=0.0)
+
+    ttft_cold_p50 = _agg_p50(ttft_cold)
+    ttft_warm_p50 = _agg_p50(ttft_warm)
+    token_latency["ttft_cold_phase_us"] = ttft_cold
+    token_latency["ttft_warm_phase_us"] = ttft_warm
+    token_latency["ttft_warm_vs_cold_p50"] = round(
+        ttft_warm_p50 / max(1e-9, ttft_cold_p50), 3)
 
     srv = get_server(LLM_SERVER_ID)
     deadline = _time.monotonic() + 30
@@ -1477,6 +1698,20 @@ def run_llm_paged(args, ap) -> int:
         "slabs_settled": pool_pending == 0 and inflight_end == 0,
         "attribution_conserved":
             abs(phases["conserved_pct"] - 100.0) < 0.1,
+        # ISSUE 20 token-latency gates: the ttft/itl SLO objectives
+        # never breached; per-session blame reconciles with each
+        # session's own wall window; and a warm-prefix first token is
+        # measurably cheaper than a cold one INSIDE this run (a warm
+        # 4-8 token tail prefills in 1 chunk vs 11 cold — p50 must
+        # show it through the interleave)
+        "token_slo_pass": slo_verdict["pass"],
+        "session_blame_conserved": (
+            "session_blame_conserved_pct" in token_latency
+            and abs(token_latency["session_blame_conserved_pct"]
+                    ["mean"] - 100.0) < 1.0),
+        "ttft_warm_below_cold": (
+            ttft_warm_p50 > 0.0
+            and ttft_warm_p50 <= 0.9 * ttft_cold_p50),
     }
     verdict = {
         "metric": "soak_llm_paged", "status": "live",
@@ -1527,6 +1762,8 @@ def run_llm_paged(args, ap) -> int:
             "errors": errors[:10],
             "checks": checks,
         },
+        "token_latency": token_latency,
+        "slo": slo_verdict,
     }
     attribution = {
         "states": dict(phases["states_pct"]),
@@ -1549,6 +1786,22 @@ def run_llm_paged(args, ap) -> int:
          "value": round(100.0 * warm_share / max(1e-9, cold_share), 1),
          "unit": "pct", "status": "live"},
     ]
+    ttft_p99 = max((v["p99_us"]
+                    for v in token_latency["ttft_us"].values()),
+                   default=0.0)
+    itl_p99 = max((v["p99_us"]
+                   for v in token_latency["itl_us"].values()),
+                  default=0.0)
+    verdict["rows"].extend([
+        {"metric": "soak_llm_paged_ttft_p99_us", "value": ttft_p99,
+         "unit": "us", "status": "live"},
+        {"metric": "soak_llm_paged_itl_p99_us", "value": itl_p99,
+         "unit": "us", "status": "live"},
+        {"metric": "soak_llm_paged_ttft_warm_vs_cold_pct",
+         "value": round(100.0 * ttft_warm_p50
+                        / max(1e-9, ttft_cold_p50), 1),
+         "unit": "pct", "status": "live"},
+    ])
     with open(os.path.join(args.out, "verdict.json"), "w",
               encoding="utf-8") as fh:
         json.dump(verdict, fh, indent=2)
@@ -1563,6 +1816,10 @@ def run_llm_paged(args, ap) -> int:
                 warm_share / max(1e-9, cold_share), 3),
             "steady_state_compiles": compiles_end - compiles_warm,
             "sessions": sessions, "errors": len(errors),
+            "ttft_p99_us": ttft_p99, "itl_p99_us": itl_p99,
+            "ttft_warm_vs_cold_p50":
+                token_latency["ttft_warm_vs_cold_p50"],
+            "token_slo": slo_verdict["verdict"],
             "checks": checks,
             "artifact": os.path.join(args.out, "verdict.json")}
     print(json.dumps(line), flush=True)
@@ -2052,7 +2309,10 @@ def main(argv=None) -> int:
                          "zero errors, exact per-client order, bounded "
                          "cache memory, explicit sheds, >=2x the solo "
                          "baseline, conserved prefill/decode "
-                         "attribution")
+                         "attribution, plus the token_latency block "
+                         "(ISSUE 20): per-class TTFT/ITL with ttft/"
+                         "itl SLO objectives gating the verdict and "
+                         "per-session blame conservation")
     ap.add_argument("--llm-slots", type=int, default=12,
                     help="--llm: KV-cache slots (sessions resident)")
     ap.add_argument("--llm-batch", type=int, default=8,
@@ -2065,7 +2325,10 @@ def main(argv=None) -> int:
                          "the dense server, warm-phase prefix-cache "
                          "hits with prefill share below the cold "
                          "phase, chunked-prefill interleave, zero "
-                         "steady-state compiles, zero page leaks")
+                         "steady-state compiles, zero page leaks, "
+                         "and (ISSUE 20) ttft/itl SLO objectives "
+                         "with warm-prefix TTFT measured below cold "
+                         "inside the same run")
     ap.add_argument("--xbatch-timeout-ms", type=float, default=30.0,
                     help="batch-timeout-ms for the --xbatch server.  "
                          "Default 30 (deadline mode): the soak's "
